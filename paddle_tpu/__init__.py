@@ -1,0 +1,22 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A from-scratch rebuild of PaddlePaddle's (~v0.11) capability set —
+ProgramDesc-style graph capture, an op zoo with automatic backward,
+optimizers-as-ops, feed/fetch execution, readers/datasets, checkpointing,
+distributed data-parallel training — re-architected for JAX/XLA on TPU:
+whole program blocks compile to single XLA computations, gradients come from
+jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
+instead of parameter servers. See SURVEY.md at the repo root for the full
+mapping onto the reference.
+"""
+from . import initializer, layers, nets, optimizer, regularizer
+from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
+                   default_main_program, default_startup_program, global_scope,
+                   program_guard)
+from .core.backward import append_backward
+from .param_attr import ParamAttr
+
+# ops must be imported so kernels register before any program runs
+from . import ops as _ops  # noqa: F401
+
+__version__ = "0.1.0"

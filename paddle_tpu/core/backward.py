@@ -1,0 +1,348 @@
+"""Symbolic backward-pass construction over the Program IR.
+
+The TPU-native analogue of the reference's AppendBackward
+(/root/reference/paddle/framework/backward.cc:523) and the python
+append_backward_ops (/root/reference/python/paddle/v2/fluid/backward.py):
+walks the block in reverse from the loss, emits one gradient op per forward
+op, and sum-accumulates fan-out gradients, naming grad variables
+``<var>@GRAD`` exactly like the reference.
+
+Where the reference needs a hand-written GradOpDescMaker + grad kernel per op
+(grad_op_desc_maker.h), we emit a generic ``grad`` op whose kernel computes
+``jax.vjp`` of the registered forward function. The recomputed forward
+subexpressions are CSE'd by XLA inside the single fused block computation, so
+this is free at run time and guarantees analytically-consistent gradients for
+every op. Ops with randomness or custom sparse grads register an explicit
+``grad_fn`` and get a ``grad_custom`` op instead.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .program import GRAD_SUFFIX, Block, Program, Variable, grad_var_name
+from .registry import get_op, register_op
+from .types import is_floating
+
+# Ops after which there is nothing to differentiate.
+NON_DIFFERENTIABLE = {
+    "fill_constant", "gaussian_random", "uniform_random", "feed", "fetch",
+    "accuracy", "top_k", "assign_value", "fill_constant_batch_size_like",
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "argmax", "one_hot", "truncated_gaussian_random",
+}
+
+
+# --------------------------------------------------------------------------
+# Generic grad kernels
+# --------------------------------------------------------------------------
+def _rebuild_ins(attrs, ins):
+    """Reconstruct the forward op's input dict from the grad op's I: slots."""
+    return {slot: ins["I:" + slot] for slot in attrs["in_slots"] if "I:" + slot in ins}
+
+
+@register_op("grad")
+def generic_grad(attrs, ins):
+    """vjp-of-forward gradient kernel.
+
+    attrs:
+      fwd_type, fwd_attrs — the forward op
+      in_slots  — {slot: n_inputs} of the forward op
+      out_slots — [slot, ...] deterministic output slot order
+      og        — {slot: [bool per output]} which outputs have incoming grads
+      diff      — {slot: [bool per input]} which inputs need gradients
+    """
+    opdef = get_op(attrs["fwd_type"])
+    fwd_attrs = attrs["fwd_attrs"]
+    primal = _rebuild_ins(attrs, ins)
+    diff_mask: Dict[str, List[bool]] = attrs["diff"]
+
+    # Split inputs into differentiated leaves and fixed leaves.
+    diff_ins = {
+        slot: [a for a, d in zip(primal[slot], diff_mask[slot]) if d]
+        for slot in diff_mask
+        if any(diff_mask[slot])
+    }
+
+    def merge(d_ins):
+        full = {}
+        for slot, arrs in primal.items():
+            mask = diff_mask.get(slot)
+            if not mask or not any(mask):
+                full[slot] = list(arrs)
+                continue
+            it = iter(d_ins[slot])
+            full[slot] = [next(it) if d else a for a, d in zip(arrs, mask)]
+        return full
+
+    # Discover float output leaf positions by abstract evaluation.
+    probe = jax.eval_shape(lambda p: opdef.fn(fwd_attrs, p), primal)
+    float_pos = [
+        (slot, i)
+        for slot in attrs["out_slots"]
+        for i in range(len(probe.get(slot, [])))
+        if is_floating(probe[slot][i].dtype)
+    ]
+
+    def f(d_ins):
+        o = opdef.fn(fwd_attrs, merge(d_ins))
+        return [o[s][i] for (s, i) in float_pos]
+
+    outs, vjp = jax.vjp(f, diff_ins)
+
+    # Build cotangents aligned with float_pos; missing grads are zeros.
+    og_mask = attrs["og"]
+    og_arrays: Dict[str, List] = {}
+    for slot, mask in og_mask.items():
+        arrs = iter(ins.get("OG:" + slot, []))
+        og_arrays[slot] = [next(arrs) if m else None for m in mask]
+    cts = []
+    for (slot, i), leaf in zip(float_pos, outs):
+        g = og_arrays.get(slot, [None] * (i + 1))[i] if slot in og_arrays else None
+        cts.append(g.astype(leaf.dtype) if g is not None else jnp.zeros_like(leaf))
+    (gins,) = vjp(cts)
+
+    result = {}
+    for slot, arrs in gins.items():
+        result["IG:" + slot] = list(arrs)
+    return result
+
+
+@register_op("grad_custom")
+def custom_grad(attrs, ins):
+    """Dispatch to an op's registered grad_fn (ops with rng/sparse grads)."""
+    opdef = get_op(attrs["fwd_type"])
+    fwd_attrs = attrs["fwd_attrs"]
+    primal = _rebuild_ins(attrs, ins)
+    outs = {slot: ins["O:" + slot] for slot in attrs["out_slots"] if "O:" + slot in ins}
+    og_mask = attrs["og"]
+    ogs = {}
+    for slot, mask in og_mask.items():
+        arrs = iter(ins.get("OG:" + slot, []))
+        vals = [next(arrs) if m else None for m in mask]
+        if any(m for m in mask):
+            ogs[slot] = vals
+    grads = opdef.grad_fn(fwd_attrs, primal, outs, ogs)
+    result = {}
+    diff_mask = attrs["diff"]
+    for slot, mask in diff_mask.items():
+        if not any(mask):
+            continue
+        vals = grads.get(slot, [None] * len(mask))
+        picked = []
+        for idx, (v, d) in enumerate(zip(vals, mask)):
+            if not d:
+                continue
+            if v is None:  # grad_fn declined: zero gradient
+                v = jnp.zeros_like(primal[slot][idx])
+            picked.append(v)
+        result["IG:" + slot] = picked
+    return result
+
+
+# --------------------------------------------------------------------------
+# append_backward
+# --------------------------------------------------------------------------
+def _is_float_var(block: Block, name: str) -> bool:
+    if not block.has_var(name):
+        return True  # unknown vars: assume float tensors
+    return is_floating(block.var(name).dtype)
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence[str]] = None,
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[Tuple[Variable, Variable]]:
+    """Append gradient ops for ``loss`` to its program's global block.
+
+    Returns [(param, grad_var)] pairs, matching fluid's contract used by
+    Optimizer.minimize (reference optimizer.py / backward.py).
+    """
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+
+    # 1. Find ops on the path to the loss (forward ops only — grad ops are
+    # appended below and must not be revisited).
+    n_fwd = len(block.ops)
+    relevant: Set[str] = {loss.name}
+    op_needed = [False] * n_fwd
+    for i in range(n_fwd - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in relevant for n in op.output_names()):
+            if op.type in NON_DIFFERENTIABLE:
+                continue
+            op_needed[i] = True
+            for name in op.input_names():
+                if _is_float_var(block, name) and name not in no_grad:
+                    var = block.var(name) if block.has_var(name) else None
+                    if var is not None and var.stop_gradient and not var.is_parameter:
+                        continue
+                    relevant.add(name)
+
+    # 2. Count grad contributions per var (outputs consumed by needed ops).
+    contributions: Dict[str, List[str]] = {}
+
+    # 3. Seed: d loss / d loss = 1.
+    loss_grad_name = grad_var_name(loss.name)
+    block.create_var(name=loss_grad_name, shape=loss.shape or (1,),
+                     dtype=loss.dtype, stop_gradient=True)
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad_name]},
+        attrs={"shape": list(loss.shape or ()), "value": 1.0,
+               "dtype": str(loss.dtype)},
+    )
+    contributions[loss.name] = [loss_grad_name]
+    finalized: Dict[str, Optional[str]] = {}
+
+    def finalize_grad(name: str) -> Optional[str]:
+        """Emit accumulation op if needed; returns grad var name or None."""
+        if name in finalized:
+            return finalized[name]
+        contribs = contributions.get(name, [])
+        gname = grad_var_name(name)
+        if not contribs:
+            result = None
+        elif len(contribs) == 1:
+            result = contribs[0]
+        else:
+            block.create_var(name=gname, stop_gradient=True)
+            block.append_op("sum", inputs={"X": contribs}, outputs={"Out": [gname]})
+            result = gname
+        finalized[name] = result
+        return result
+
+    def add_contribution(name: str, gname: str):
+        contributions.setdefault(name, []).append(gname)
+
+    # 4. Walk forward ops in reverse, emitting grad ops.
+    for i in range(n_fwd - 1, -1, -1):
+        if not op_needed[i]:
+            continue
+        op = block.ops[i]
+        opdef = get_op(op.type)
+
+        out_slots = sorted(op.outputs)
+        og_mask = {}
+        og_inputs = {}
+        any_og = False
+        for slot in out_slots:
+            mask = []
+            arrs = []
+            for name in op.outputs[slot]:
+                g = finalize_grad(name)
+                mask.append(g is not None)
+                if g is not None:
+                    arrs.append(g)
+                    any_og = True
+            og_mask[slot] = mask
+            if arrs:
+                og_inputs["OG:" + slot] = arrs
+        if not any_og:
+            continue
+
+        diff_mask = {}
+        ig_outputs = {}
+        for slot, names in op.inputs.items():
+            mask = []
+            outs_for_slot = []
+            for name in names:
+                ok = (
+                    name in relevant
+                    and _is_float_var(block, name)
+                    and name not in no_grad
+                )
+                if ok and block.has_var(name):
+                    v = block.var(name)
+                    if v.stop_gradient and not v.is_parameter:
+                        ok = False
+                mask.append(ok)
+                if ok:
+                    g = program.unique_name(grad_var_name(name) + "@R")
+                    # Single-contribution grads keep the canonical name.
+                    outs_for_slot.append((name, g))
+            diff_mask[slot] = mask
+            if outs_for_slot:
+                ig_outputs[slot] = outs_for_slot
+        if not ig_outputs:
+            continue
+
+        use_custom = opdef.grad_fn is not None
+        if opdef.needs_rng and not use_custom:
+            raise NotImplementedError(
+                f"op {op.type!r} uses randomness and has no custom grad_fn"
+            )
+
+        grad_inputs = {("I:" + slot): list(names) for slot, names in op.inputs.items()
+                       if names}
+        if use_custom:
+            for slot, names in op.outputs.items():
+                if names:
+                    grad_inputs["O:" + slot] = list(names)
+        grad_inputs.update(og_inputs)
+
+        grad_outputs = {}
+        for slot, pairs in ig_outputs.items():
+            slot_outs = []
+            for name, gvar in pairs:
+                block.create_var(name=gvar, stop_gradient=True)
+                slot_outs.append(gvar)
+                add_contribution(name, gvar)
+            grad_outputs["IG:" + slot] = slot_outs
+
+        block.append_op(
+            "grad_custom" if use_custom else "grad",
+            inputs=grad_inputs,
+            outputs=grad_outputs,
+            attrs={
+                "fwd_type": op.type,
+                "fwd_attrs": dict(op.attrs),
+                "in_slots": {slot: len(names) for slot, names in op.inputs.items()},
+                "out_slots": out_slots,
+                "og": og_mask,
+                "diff": diff_mask,
+            },
+        )
+
+    # 5. Finalize remaining contributions (producer-less vars: feeds/params)
+    # and give every finalized grad its canonical ``<var>@GRAD`` alias so
+    # users and transforms can fetch it by name. Unfetched grads are DCE'd by
+    # XLA, so unused aliases cost nothing.
+    for name in list(contributions):
+        g = finalize_grad(name)
+        canonical = grad_var_name(name)
+        if g is not None and g != canonical and not block.has_var(canonical):
+            src = block.var(name) if block.has_var(name) else None
+            block.create_var(name=canonical,
+                             shape=src.shape if src is not None else None,
+                             dtype=src.dtype if src is not None else "float32",
+                             stop_gradient=True)
+            block.append_op("assign", inputs={"X": [g]},
+                            outputs={"Out": [canonical]})
+
+    # 6. Collect (param, grad) pairs.
+    params = (
+        [block.var(n) for n in parameter_list]
+        if parameter_list
+        else block.all_parameters()
+    )
+    result = []
+    for p in params:
+        g = finalize_grad(p.name)
+        if g is None:
+            continue
+        canonical = grad_var_name(p.name)
+        if not block.has_var(canonical):  # single direct contribution
+            block.create_var(name=canonical, shape=p.shape, dtype=p.dtype,
+                             stop_gradient=True)
+            block.append_op("assign", inputs={"X": [g]},
+                            outputs={"Out": [canonical]})
+        result.append((p, block.var(canonical)))
+    return result

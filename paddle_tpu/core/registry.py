@@ -1,0 +1,110 @@
+"""Op registry: op type name -> pure JAX kernel + metadata.
+
+The TPU-native replacement for the reference's OpRegistry / kernel-registry
+pair (/root/reference/paddle/framework/op_registry.h:148,
+/root/reference/paddle/framework/operator.cc:463-556). There is no per-device
+kernel selection: every op is a pure JAX function; XLA picks the TPU lowering
+and fuses across op boundaries because the executor compiles whole blocks.
+
+Shape inference (the reference's InferShape, shape_inference.h) is derived
+from the kernel itself via ``jax.eval_shape`` — one source of truth.
+
+Gradients: ops normally do NOT register hand-written grad kernels. The
+backward pass (core/backward.py) emits generic ``grad`` ops whose kernel
+computes ``jax.vjp`` of the registered forward. Recomputed forward
+subexpressions are CSE'd by XLA inside the single fused computation, so this
+costs nothing relative to hand-written grad ops. Ops may still register a
+custom ``grad_fn`` when vjp-of-forward is wrong or wasteful (e.g. ops with
+integer inputs that need SelectedRows-style sparse grads).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+Arrays = Dict[str, List[jax.Array]]  # slot -> list of arrays
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    fn: Callable  # fn(attrs, ins: Arrays, [rng]) -> Arrays
+    needs_rng: bool = False
+    # Custom vjp: grad_fn(attrs, ins, outs, out_grads) -> dict varslot->grads
+    grad_fn: Optional[Callable] = None
+    # Ops whose semantics are stateful/structural and are handled specially by
+    # the executor trace (feed/fetch/control-flow) rather than called as fns.
+    special: bool = False
+    # Input slots that may legally be absent/empty (e.g. optional Bias).
+    optional_inputs: tuple = ()
+    # If set, only these input slots get gradients even if others are float.
+    stop_gradient_inputs: tuple = ()
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    fn: Callable = None,
+    *,
+    needs_rng: bool = False,
+    grad_fn: Callable = None,
+    special: bool = False,
+    optional_inputs=(),
+    stop_gradient_inputs=(),
+):
+    """Register an op kernel. Usable as decorator or direct call."""
+
+    def _do(f):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} already registered")
+        _REGISTRY[type] = OpDef(
+            type=type,
+            fn=f,
+            needs_rng=needs_rng,
+            grad_fn=grad_fn,
+            special=special,
+            optional_inputs=tuple(optional_inputs),
+            stop_gradient_inputs=tuple(stop_gradient_inputs),
+        )
+        return f
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def get_op(type: str) -> OpDef:
+    if type not in _REGISTRY:
+        raise KeyError(f"op {type!r} is not registered (known: {sorted(_REGISTRY)})")
+    return _REGISTRY[type]
+
+
+def has_op(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def infer_outputs(op_type: str, attrs, in_shapes: Arrays) -> Dict[str, List[jax.ShapeDtypeStruct]]:
+    """Abstractly evaluate an op to get output shapes/dtypes.
+
+    ``in_shapes`` maps slot -> list of ShapeDtypeStruct. Replaces the
+    reference's per-op InferShape implementations.
+    """
+    opdef = get_op(op_type)
+    kwargs = {}
+    if opdef.needs_rng:
+        kwargs["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+        def f(ins, rng):
+            return opdef.fn(attrs, ins, rng=rng)
+
+        return jax.eval_shape(f, in_shapes, kwargs["rng"])
+    return jax.eval_shape(lambda ins: opdef.fn(attrs, ins), in_shapes)

@@ -1,0 +1,77 @@
+"""Scope: run-time name -> device-array store.
+
+Analogue of the reference's hierarchical Scope (paddle/framework/scope.h:38),
+holding jax.Arrays (device-resident, possibly sharded) instead of C++
+Variables. The executor reads persistable state from the scope before a step
+and writes updated state back after — the functional-XLA equivalent of the
+reference's in-place variable mutation.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, jax.Array] = {}
+        self.parent = parent
+        self.kids = []
+        if parent is not None:
+            parent.kids.append(self)
+
+    def new_scope(self) -> "Scope":
+        return Scope(self)
+
+    # -- access ------------------------------------------------------------
+    def set(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def get(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        raise KeyError(f"variable {name!r} not found in scope")
+
+    def has(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def delete(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._vars.keys())
+
+    def find_var_scope(self, name: str) -> Optional["Scope"]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s
+            s = s.parent
+        return None
+
+    # -- numpy convenience ---------------------------------------------------
+    def get_numpy(self, name: str) -> np.ndarray:
+        return np.asarray(self.get(name))
+
+    def __contains__(self, name: str) -> bool:
+        return self.has(name)
+
+    def __repr__(self):
+        return f"Scope({sorted(self._vars)})"
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope

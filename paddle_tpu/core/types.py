@@ -1,0 +1,54 @@
+"""Dtype and variable-type vocabulary for the program IR.
+
+Mirrors the role of the reference's ``framework.proto`` dtype/var-type enums
+(/root/reference/paddle/framework/framework.proto) but maps directly onto JAX
+dtypes — the TPU-native compute substrate — instead of a C++ enum.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VarType(enum.Enum):
+    """Variable kinds, analogous to VarDesc.VarType in the reference."""
+
+    DENSE_TENSOR = "dense_tensor"  # reference: LOD_TENSOR (lod_tensor.h:84)
+    SELECTED_ROWS = "selected_rows"  # sparse row-subset gradient (selected_rows.h)
+    TENSOR_ARRAY = "tensor_array"  # LoDTensorArray for dynamic RNN
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+
+def to_dtype(dtype) -> np.dtype:
+    """Normalise a user-supplied dtype spec to a numpy dtype object."""
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[dtype])
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    dt = to_dtype(dtype)
+    return jnp.issubdtype(dt, jnp.floating)

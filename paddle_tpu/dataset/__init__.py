@@ -1,0 +1,4 @@
+"""Datasets (reference python/paddle/v2/dataset package API)."""
+from . import common, mnist, uci_housing
+
+__all__ = ["common", "mnist", "uci_housing"]

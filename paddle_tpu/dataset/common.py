@@ -1,0 +1,86 @@
+"""Dataset infrastructure.
+
+API parity with /root/reference/python/paddle/v2/dataset/common.py (download
+cache, md5, cluster file splitting). This environment has no network egress,
+so ``download`` resolves only against the local cache or an explicit
+``DATA_HOME`` drop; every dataset module provides a deterministic synthetic
+fallback with the real dataset's shapes, dtype and vocabulary so models,
+readers and tests exercise identical code paths.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Callable
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                              "~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str = None) -> str:
+    """Resolve a dataset file from the local cache (no network egress)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename) and (md5sum is None or md5file(filename) == md5sum):
+        return filename
+    raise FileNotFoundError(
+        f"dataset file {filename} not present and downloads are disabled; "
+        f"place the file manually or use the synthetic reader")
+
+
+def split(reader, line_count: int, suffix: str = "%05d.pickle",
+          dumper: Callable = pickle.dump):
+    """Split a reader's samples into multiple pickled files
+    (reference common.py split)."""
+    lines = []
+    index = 0
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            with open(suffix % index, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            index += 1
+    if lines:
+        with open(suffix % index, "wb") as f:
+            dumper(lines, f)
+        index += 1
+    return index
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader: Callable = pickle.load):
+    """Read this trainer's shard of pickled sample files
+    (reference common.py cluster_files_reader)."""
+    import glob
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        for i, fn in enumerate(file_list):
+            if i % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    yield from loader(f)
+
+    return reader
+
+
+def synthetic_rng(name: str) -> np.random.RandomState:
+    """Deterministic per-dataset RNG so synthetic data is reproducible."""
+    seed = int(hashlib.md5(name.encode()).hexdigest()[:8], 16) % (2**31)
+    return np.random.RandomState(seed)

@@ -1,0 +1,87 @@
+"""MNIST dataset (reference python/paddle/v2/dataset/mnist.py API).
+
+Samples are ``(image, label)`` with image a flat float32[784] in [-1, 1] and
+label int in [0, 10), exactly like the reference. With no network egress the
+default readers serve a deterministic synthetic MNIST: 10 fixed blob-pattern
+prototypes + noise — linearly separable enough that LeNet converges, so the
+book tests exercise the full training path.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+
+def _prototypes():
+    rng = common.synthetic_rng("mnist-protos")
+    protos = []
+    for d in range(10):
+        img = np.zeros((28, 28), np.float32)
+        # each digit: 3 gaussian blobs at digit-specific locations
+        for _ in range(3):
+            cy, cx = rng.randint(4, 24, size=2)
+            yy, xx = np.mgrid[0:28, 0:28]
+            img += np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
+        protos.append(np.clip(img, 0, 1))
+    return protos
+
+
+def _synthetic_reader(n: int, seed_name: str):
+    protos = _prototypes()
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for i in range(n):
+            label = int(rng.randint(0, 10))
+            img = protos[label] + rng.normal(0, 0.15, (28, 28)).astype(np.float32)
+            img = np.clip(img, 0, 1) * 2.0 - 1.0  # [-1, 1] like the reference
+            yield img.reshape(784).astype(np.float32), label
+
+    return reader
+
+
+def _idx_reader(img_path: str, lab_path: str):
+    """Parse real MNIST IDX files if present in the data cache
+    (format per the reference's reader_creator mnist.py)."""
+
+    def reader():
+        with gzip.open(img_path, "rb") as fi, gzip.open(lab_path, "rb") as fl:
+            fi.read(16)
+            fl.read(8)
+            while True:
+                buf = fi.read(784)
+                if len(buf) < 784:
+                    break
+                lab = fl.read(1)
+                img = np.frombuffer(buf, np.uint8).astype(np.float32)
+                img = img / 127.5 - 1.0
+                yield img, int(lab[0])
+
+    return reader
+
+
+def _reader(kind: str, n: int):
+    d = os.path.join(common.DATA_HOME, "mnist")
+    img = os.path.join(d, f"{kind}-images-idx3-ubyte.gz")
+    lab = os.path.join(d, f"{kind}-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lab):
+        return _idx_reader(img, lab)
+    return _synthetic_reader(n, f"mnist-{kind}")
+
+
+def train():
+    return _reader("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader("t10k", TEST_SIZE)

@@ -1,0 +1,43 @@
+"""UCI housing regression dataset (reference v2/dataset/uci_housing.py API).
+
+Samples: (features float32[13], price float32[1]). Synthetic fallback draws
+features then prices from a fixed linear model + noise, so fit_a_line-style
+book tests converge deterministically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+
+def _synthetic(n, seed_name):
+    w_rng = common.synthetic_rng("uci-weights")
+    true_w = w_rng.randn(13, 1).astype(np.float32)
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            x = rng.rand(13).astype(np.float32)
+            y = (x @ true_w).astype(np.float32) + rng.normal(0, 0.05, 1).astype(np.float32)
+            yield x, y
+
+    return reader
+
+
+def train():
+    return _synthetic(TRAIN_SIZE, "uci-train")
+
+
+def test():
+    return _synthetic(TEST_SIZE, "uci-test")

@@ -1,0 +1,204 @@
+"""Checkpoint & inference-model persistence.
+
+Parity surface of /root/reference/python/paddle/v2/fluid/io.py:32-218
+(save_vars/save_params/save_persistables, load_*, save_inference_model,
+load_inference_model) and the save/load ops
+(/root/reference/paddle/operators/save_op.cc, load_op.cc).
+
+The TPU-native design difference: the reference emits save/load ops into a
+program and runs them through the per-op executor; here persistence is a
+host-side operation on the scope (device->host DMA + npz/pickle), since
+serialisation is not compute and does not belong in an XLA computation.
+Program serialisation uses a stable JSON-encodable dict (the analogue of the
+ProgramDesc protobuf) so saved models are portable across processes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.program import (Block, Operator, Parameter, Program, Variable,
+                           default_main_program)
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "program_to_dict", "program_from_dict",
+]
+
+
+# --------------------------------------------------------------------------
+# Program (de)serialisation — ProgramDesc-protobuf equivalent
+# --------------------------------------------------------------------------
+def program_to_dict(program: Program) -> dict:
+    blocks = []
+    for b in program.blocks:
+        blocks.append({
+            "idx": b.idx,
+            "parent_idx": b.parent_idx,
+            "vars": [
+                {
+                    "name": v.name,
+                    "shape": list(v.shape) if v.shape is not None else None,
+                    "dtype": str(v.dtype),
+                    "persistable": v.persistable,
+                    "stop_gradient": v.stop_gradient,
+                    "lod_level": v.lod_level,
+                    "is_data": v.is_data,
+                    "is_parameter": isinstance(v, Parameter),
+                }
+                for v in b.vars.values()
+            ],
+            "ops": [
+                {"type": op.type, "inputs": op.inputs, "outputs": op.outputs,
+                 "attrs": op.attrs}
+                for op in b.ops
+            ],
+        })
+    return {"blocks": blocks, "version": 1}
+
+
+def program_from_dict(d: dict) -> Program:
+    p = Program()
+    p.blocks = []
+    for bd in d["blocks"]:
+        b = Block(p, bd["idx"], bd["parent_idx"])
+        for vd in bd["vars"]:
+            cls = Parameter if vd.get("is_parameter") else Variable
+            v = cls(b, vd["name"], shape=vd["shape"], dtype=vd["dtype"],
+                    persistable=vd["persistable"],
+                    stop_gradient=vd["stop_gradient"],
+                    lod_level=vd.get("lod_level", 0),
+                    is_data=vd.get("is_data", False))
+            b.vars[vd["name"]] = v
+        for od in bd["ops"]:
+            b.ops.append(Operator(b, od["type"], od["inputs"], od["outputs"],
+                                  od["attrs"]))
+        p.blocks.append(b)
+    return p
+
+
+# --------------------------------------------------------------------------
+# Variable persistence
+# --------------------------------------------------------------------------
+def _is_persistable(v: Variable) -> bool:
+    return v.persistable
+
+
+def _is_parameter(v: Variable) -> bool:
+    return isinstance(v, Parameter)
+
+
+def save_vars(executor, dirname: str, main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None, predicate=None,
+              scope: Optional[Scope] = None):
+    """Save selected scope variables to ``dirname`` (one .npy per var +
+    manifest), mirroring io.py save_vars semantics."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    manifest = []
+    for v in vars:
+        if not scope.has(v.name):
+            continue
+        arr = scope.get_numpy(v.name)
+        fname = v.name.replace("/", "__")
+        np.save(os.path.join(dirname, fname + ".npy"), arr)
+        manifest.append({"name": v.name, "file": fname + ".npy"})
+    with open(os.path.join(dirname, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def save_params(executor, dirname, main_program=None, scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_parameter, scope)
+
+
+def save_persistables(executor, dirname, main_program=None, scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_persistable, scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              scope=None):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate(v)]
+    with open(os.path.join(dirname, "MANIFEST.json")) as f:
+        manifest = {e["name"]: e["file"] for e in json.load(f)}
+    import jax.numpy as jnp
+
+    for v in vars:
+        if v.name not in manifest:
+            continue
+        arr = np.load(os.path.join(dirname, manifest[v.name]))
+        scope.set(v.name, jnp.asarray(arr))
+
+
+def load_params(executor, dirname, main_program=None, scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_parameter, scope)
+
+
+def load_persistables(executor, dirname, main_program=None, scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_persistable, scope)
+
+
+# --------------------------------------------------------------------------
+# Inference model: program pruning + save
+# --------------------------------------------------------------------------
+def prune_program(program: Program, feed_names: List[str],
+                  fetch_names: List[str]) -> Program:
+    """Slice the program to the subgraph producing ``fetch_names`` from
+    ``feed_names`` (the reference's prune.cc / inference_optimize)."""
+    pruned = program.clone()
+    block = pruned.global_block
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(o in needed for o in op.output_names()):
+            keep.append(op)
+            needed.update(n for n in op.input_names() if n not in feed_names)
+    keep.reverse()
+    block.ops = keep
+    used = set(feed_names) | set(fetch_names)
+    for op in keep:
+        used.update(op.input_names())
+        used.update(op.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    return pruned
+
+
+def save_inference_model(dirname: str, feeded_var_names: List[str],
+                         target_vars: List[Variable], executor,
+                         main_program: Optional[Program] = None, scope=None):
+    """Prune to the inference subgraph and persist program + params
+    (reference io.py:165 save_inference_model)."""
+    program = main_program or default_main_program()
+    fetch_names = [v.name if hasattr(v, "name") else v for v in target_vars]
+    pruned = prune_program(program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__.json"), "w") as f:
+        json.dump({
+            "program": program_to_dict(pruned),
+            "feed_names": feeded_var_names,
+            "fetch_names": fetch_names,
+        }, f)
+    save_vars(executor, os.path.join(dirname, "params"),
+              main_program=pruned, predicate=_is_persistable, scope=scope)
+
+
+def load_inference_model(dirname: str, executor, scope=None):
+    """Returns (program, feed_names, fetch_names); parameters are loaded into
+    the scope (reference io.py load_inference_model)."""
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        payload = json.load(f)
+    program = program_from_dict(payload["program"])
+    load_vars(executor, os.path.join(dirname, "params"),
+              main_program=program, predicate=_is_persistable, scope=scope)
+    return program, payload["feed_names"], payload["fetch_names"]

@@ -1,0 +1,19 @@
+"""User-facing layer functions (fluid layers package parity)."""
+from .io import data
+from .nn import (accuracy, batch_norm, conv2d, cross_entropy, dropout,
+                 embedding, fc, layer_norm, lrn, pool2d, square_error_cost,
+                 softmax_with_cross_entropy, topk)
+from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
+from .ops import __all__ as _ops_all
+from .tensor import (argmax, assign, cast, concat, create_global_var,
+                     fill_constant, mean, one_hot, reshape, scale, split,
+                     sums, transpose)
+
+__all__ = (
+    ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
+     "dropout", "lrn", "cross_entropy", "softmax_with_cross_entropy",
+     "square_error_cost", "accuracy", "topk",
+     "fill_constant", "create_global_var", "cast", "concat", "sums", "assign",
+     "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax"]
+    + list(_ops_all)
+)

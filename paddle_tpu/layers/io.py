@@ -1,0 +1,23 @@
+"""Data-entry layer (fluid layers.data parity)."""
+from __future__ import annotations
+
+from ..core.program import default_main_program
+from .layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         main_program=None, stop_gradient=True):
+    """Declare a feed variable.
+
+    Matches /root/reference/python/paddle/v2/fluid/layers (data): by default a
+    -1 batch dimension is prepended; the executor concretises it from the
+    actual feed and re-jits per batch-shape signature.
+    """
+    helper = LayerHelper("data", main_program=main_program)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.block.create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True,
+    )

@@ -1,0 +1,235 @@
+"""Core NN layers — the fluid layers/nn.py parity surface.
+
+Each function builds ops into the default (or given) program via LayerHelper;
+shapes are inferred from the kernels themselves. Citations:
+/root/reference/python/paddle/v2/fluid/layers/nn.py (fc, embedding, conv2d,
+pool2d, batch_norm, dropout, cross_entropy, accuracy, ...).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.types import to_dtype
+from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from .layer_helper import LayerHelper
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, main_program=None, startup_program=None):
+    """Fully-connected layer (reference nn.py fc): mul per input + sum + bias
+    + activation. Multiple inputs each get their own weight."""
+    helper = LayerHelper("fc", main_program=main_program,
+                         startup_program=startup_program)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_shape = inp.shape
+        fan_in = int(np.prod(in_shape[num_flatten_dims:]))
+        w = helper.create_parameter(
+            param_attr, shape=[fan_in, size], dtype=inp.dtype,
+            default_initializer=XavierInitializer())
+        mul_results.append(
+            helper.simple_op("mul", {"X": [inp], "Y": [w]},
+                             {"x_num_col_dims": num_flatten_dims,
+                              "y_num_col_dims": 1}))
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.simple_op("sum", {"X": mul_results})
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        pre_act = helper.append_bias_op(pre_bias, bias_attr, size, dim_start=1)
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32", main_program=None, startup_program=None):
+    """Embedding lookup (reference nn.py embedding / lookup_table_op.cc).
+    ``is_sparse`` is accepted for API parity; the TPU grad is a scatter-add
+    (SelectedRows-equivalent segment sum) either way."""
+    helper = LayerHelper("embedding", main_program=main_program,
+                         startup_program=startup_program)
+    w = helper.create_parameter(
+        param_attr, shape=list(size), dtype=dtype,
+        default_initializer=XavierInitializer())
+    return helper.simple_op(
+        "lookup_table", {"W": [w], "Ids": [input]},
+        {"padding_idx": padding_idx})
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, main_program=None,
+           startup_program=None):
+    helper = LayerHelper("conv2d", main_program=main_program,
+                         startup_program=startup_program)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    channel_axis = 1 if data_format == "NCHW" else 3
+    num_channels = input.shape[channel_axis]
+    if data_format == "NCHW":
+        filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+    else:  # HWIO for NHWC
+        filter_shape = list(filter_size) + [num_channels // groups, num_filters]
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(
+        param_attr, shape=filter_shape, dtype=input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    pre_bias = helper.simple_op(
+        "conv2d", {"Input": [input], "Filter": [w]},
+        {"strides": stride, "paddings": padding, "dilations": dilation,
+         "groups": groups, "data_format": data_format},
+        out_slot="Output")
+    pre_act = helper.append_bias_op(pre_bias, bias_attr,
+                                    num_filters, dim_start=channel_axis)
+    return helper.append_activation(pre_act, act)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, data_format="NCHW", main_program=None,
+           startup_program=None):
+    helper = LayerHelper("pool2d", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op(
+        "pool2d", {"X": [input]},
+        {"pooling_type": pool_type, "ksize": pool_size,
+         "strides": pool_stride, "paddings": pool_padding,
+         "global_pooling": global_pooling, "data_format": data_format})
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               main_program=None, startup_program=None):
+    """Batch normalisation (reference nn.py batch_norm / batch_norm_op.cc).
+
+    Mean/Variance are persistable running stats; MeanOut/VarianceOut alias
+    them so the executor's functional state-threading updates them in place.
+    """
+    helper = LayerHelper("batch_norm", main_program=main_program,
+                         startup_program=startup_program)
+    if data_layout == "NCHW":
+        channels = input.shape[1]
+    else:
+        channels = input.shape[-1]
+    dtype = "float32"  # stats/affine in f32 even under bf16 compute
+    scale = helper.create_parameter(
+        param_attr, shape=[channels], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(
+        bias_attr, shape=[channels], dtype=dtype, is_bias=True)
+    # Running stats live in BOTH programs: init ops in startup, state in main.
+    mean_name = scale.name + ".mean"
+    var_name = scale.name + ".var"
+    block = helper.main_program.global_block
+    mean = block.create_var(name=mean_name, shape=[channels], dtype=dtype,
+                            persistable=True, stop_gradient=True)
+    variance = block.create_var(name=var_name, shape=[channels], dtype=dtype,
+                                persistable=True, stop_gradient=True)
+    sb = helper.startup_program.global_block
+    for name, value in ((mean_name, 0.0), (var_name, 1.0)):
+        v = sb.create_var(name=name, shape=[channels], dtype=dtype,
+                          persistable=True)
+        ConstantInitializer(value)(v, sb)
+    y = helper.create_tmp_variable(input.dtype, shape=input.shape)
+    saved_mean = helper.create_tmp_variable(dtype, shape=[channels],
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, shape=[channels],
+                                           stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        {"X": [input], "Scale": [scale], "Bias": [bias],
+         "Mean": [mean], "Variance": [variance]},
+        {"Y": [y], "MeanOut": [mean], "VarianceOut": [variance],
+         "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout},
+    )
+    return helper.append_activation(y, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, main_program=None,
+               startup_program=None):
+    helper = LayerHelper("layer_norm", main_program=main_program,
+                         startup_program=startup_program)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape, dtype="float32",
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape, dtype="float32",
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    outs, _ = helper.append_op("layer_norm", inputs, ["Y", "Mean", "Variance"],
+                               {"epsilon": epsilon,
+                                "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(outs["Y"][0], act)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, main_program=None,
+            startup_program=None):
+    helper = LayerHelper("dropout", main_program=main_program,
+                         startup_program=startup_program)
+    outs, _ = helper.append_op("dropout", {"X": [x]}, ["Out", "Mask"],
+                               {"dropout_prob": dropout_prob, "is_test": is_test})
+    return outs["Out"][0]
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, main_program=None,
+        startup_program=None):
+    helper = LayerHelper("lrn", main_program=main_program,
+                         startup_program=startup_program)
+    outs, _ = helper.append_op("lrn", {"X": [input]}, ["Out", "MidOut"],
+                               {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return outs["Out"][0]
+
+
+# --- losses -----------------------------------------------------------------
+def cross_entropy(input, label, soft_label=False, main_program=None,
+                  startup_program=None):
+    helper = LayerHelper("cross_entropy", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("cross_entropy", {"X": [input], "Label": [label]},
+                            {"soft_label": soft_label}, out_slot="Y")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               main_program=None, startup_program=None):
+    helper = LayerHelper("softmax_with_cross_entropy",
+                         main_program=main_program,
+                         startup_program=startup_program)
+    outs, _ = helper.append_op(
+        "softmax_with_cross_entropy", {"Logits": [logits], "Label": [label]},
+        ["Softmax", "Loss"], {"soft_label": soft_label})
+    return outs["Loss"][0]
+
+
+def square_error_cost(input, label, main_program=None, startup_program=None):
+    helper = LayerHelper("square_error_cost", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("square_error_cost", {"X": [input], "Y": [label]})
+
+
+# --- metrics ----------------------------------------------------------------
+def topk(input, k, main_program=None, startup_program=None):
+    helper = LayerHelper("top_k", main_program=main_program,
+                         startup_program=startup_program)
+    outs, _ = helper.append_op("top_k", {"X": [input]}, ["Out", "Indices"],
+                               {"k": k})
+    return outs["Out"][0], outs["Indices"][0]
+
+
+def accuracy(input, label, k=1, main_program=None, startup_program=None):
+    """Classification accuracy via top-k (reference nn.py accuracy)."""
+    helper = LayerHelper("accuracy", main_program=main_program,
+                         startup_program=startup_program)
+    values, indices = topk(input, k, main_program=main_program,
+                           startup_program=startup_program)
+    outs, _ = helper.append_op(
+        "accuracy", {"Out": [values], "Indices": [indices], "Label": [label]},
+        ["Accuracy", "Correct", "Total"], {})
+    return outs["Accuracy"][0]

@@ -1,0 +1,95 @@
+"""Tensor-manipulation layers (fluid layers/tensor.py + parts of ops.py)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+
+def _helper(name, main_program=None, startup_program=None):
+    return LayerHelper(name, main_program=main_program,
+                       startup_program=startup_program)
+
+
+def fill_constant(shape, dtype, value, main_program=None, startup_program=None):
+    h = _helper("fill_constant", main_program, startup_program)
+    return h.simple_op("fill_constant", {},
+                       {"shape": list(shape), "dtype": str(dtype), "value": value})
+
+
+def create_global_var(shape, value, dtype, persistable=True, name=None,
+                      main_program=None, startup_program=None):
+    """A persistable var initialised in the startup program (reference
+    tensor.py create_global_var) — used for learning rates, counters."""
+    h = _helper("global_var", main_program, startup_program)
+    var = h.create_global_variable(name=name, shape=shape, dtype=dtype,
+                                   persistable=persistable)
+    sb = h.startup_program.global_block
+    sv = sb.create_var(name=var.name, shape=shape, dtype=dtype, persistable=True)
+    sb.append_op("fill_constant", outputs={"Out": [sv.name]},
+                 attrs={"shape": list(shape), "dtype": str(sv.dtype),
+                        "value": value})
+    return var
+
+
+def cast(x, dtype, main_program=None, startup_program=None):
+    h = _helper("cast", main_program, startup_program)
+    return h.simple_op("cast", {"X": [x]}, {"out_dtype": str(dtype)})
+
+
+def concat(input, axis=0, main_program=None, startup_program=None):
+    h = _helper("concat", main_program, startup_program)
+    return h.simple_op("concat", {"X": list(input)}, {"axis": axis})
+
+
+def sums(input, main_program=None, startup_program=None):
+    h = _helper("sum", main_program, startup_program)
+    return h.simple_op("sum", {"X": list(input)})
+
+
+def assign(input, output=None, main_program=None, startup_program=None):
+    h = _helper("assign", main_program, startup_program)
+    if output is None:
+        return h.simple_op("assign", {"X": [input]})
+    h.append_op("assign", {"X": [input]}, {"Out": [output]}, {})
+    return output
+
+
+def mean(x, main_program=None, startup_program=None):
+    h = _helper("mean", main_program, startup_program)
+    return h.simple_op("mean", {"X": [x]})
+
+
+def scale(x, scale=1.0, bias=0.0, main_program=None, startup_program=None):
+    h = _helper("scale", main_program, startup_program)
+    return h.simple_op("scale", {"X": [x]}, {"scale": scale, "bias": bias})
+
+
+def reshape(x, shape, main_program=None, startup_program=None):
+    h = _helper("reshape", main_program, startup_program)
+    return h.simple_op("reshape", {"X": [x]}, {"shape": list(shape)})
+
+
+def transpose(x, perm, main_program=None, startup_program=None):
+    h = _helper("transpose", main_program, startup_program)
+    return h.simple_op("transpose", {"X": [x]}, {"axis": list(perm)})
+
+
+def split(x, num_or_sections, dim=0, main_program=None, startup_program=None):
+    h = _helper("split", main_program, startup_program)
+    if isinstance(num_or_sections, int):
+        attrs = {"num": num_or_sections, "axis": dim}
+        n = num_or_sections
+    else:
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+        n = len(num_or_sections)
+    outs, _ = h.append_op("split", {"X": [x]}, ["Out"], attrs)
+    return outs["Out"]
+
+
+def one_hot(input, depth, main_program=None, startup_program=None):
+    h = _helper("one_hot", main_program, startup_program)
+    return h.simple_op("one_hot", {"X": [input]}, {"depth": depth})
+
+
+def argmax(x, axis=-1, main_program=None, startup_program=None):
+    h = _helper("argmax", main_program, startup_program)
+    return h.simple_op("argmax", {"X": [x]}, {"axis": axis})

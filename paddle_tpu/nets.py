@@ -1,0 +1,67 @@
+"""Composite network helpers (fluid nets.py parity:
+/root/reference/python/paddle/v2/fluid/nets.py — simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu)."""
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, pool_type="max", data_format="NCHW",
+                         param_attr=None, main_program=None,
+                         startup_program=None):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act, data_format=data_format,
+        main_program=main_program, startup_program=startup_program)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, data_format=data_format,
+        main_program=main_program, startup_program=startup_program)
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_size=2, pool_stride=2, pool_type="max",
+                   data_format="NCHW", main_program=None,
+                   startup_program=None):
+    """VGG-style conv block: N convs (+BN/dropout) then one pool."""
+    tmp = input
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+    n = len(conv_num_filter)
+
+    def per_conv(x, default):
+        return x if isinstance(x, (list, tuple)) else [x] * n
+
+    sizes = per_conv(conv_filter_size, 3)
+    with_bn = per_conv(conv_with_batchnorm, False)
+    drop = per_conv(conv_batchnorm_drop_rate, 0.0)
+    for i in range(n):
+        local_act = conv_act if not with_bn[i] else None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i], filter_size=sizes[i],
+            padding=(sizes[i] - 1) // 2, act=local_act, data_format=data_format,
+            main_program=main_program, startup_program=startup_program)
+        if with_bn[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act,
+                                    data_layout=data_format,
+                                    main_program=main_program,
+                                    startup_program=startup_program)
+            if drop[i] > 0:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop[i],
+                                     main_program=main_program,
+                                     startup_program=startup_program)
+    return layers.pool2d(input=tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, data_format=data_format,
+                         main_program=main_program,
+                         startup_program=startup_program)
+
+
+def glu(input, dim=-1, main_program=None, startup_program=None):
+    a, b = layers.split(input, 2, dim=dim, main_program=main_program,
+                        startup_program=startup_program)
+    gate = layers.sigmoid(b, main_program=main_program,
+                          startup_program=startup_program)
+    return layers.elementwise_mul(a, gate, main_program=main_program,
+                                  startup_program=startup_program)

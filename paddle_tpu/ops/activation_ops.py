@@ -1,0 +1,153 @@
+"""Activation ops.
+
+Covers the reference's activation zoo
+(/root/reference/paddle/operators/activation_op.cc — ~20 registrations, and
+the legacy set in gserver/activations/ActivationFunction.cpp — 17 types).
+All are single jnp/jax.nn calls; XLA fuses them into adjacent matmuls/convs
+so there is no standalone kernel cost on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import out, single
+
+
+def _unary(op):
+    def fn(attrs, ins):
+        return out(Out=op(single(ins, "X")))
+
+    return fn
+
+
+register_op("relu", _unary(jax.nn.relu))
+register_op("sigmoid", _unary(jax.nn.sigmoid))
+register_op("logsigmoid", _unary(jax.nn.log_sigmoid))
+register_op("tanh", _unary(jnp.tanh))
+register_op("exp", _unary(jnp.exp))
+register_op("log", _unary(jnp.log))
+register_op("sqrt", _unary(jnp.sqrt))
+register_op("rsqrt", _unary(jax.lax.rsqrt))
+register_op("abs", _unary(jnp.abs))
+register_op("ceil", _unary(jnp.ceil))
+register_op("floor", _unary(jnp.floor))
+register_op("round", _unary(jnp.round))
+register_op("reciprocal", _unary(jnp.reciprocal))
+register_op("square", _unary(jnp.square))
+register_op("softplus", _unary(jax.nn.softplus))
+register_op("softsign", _unary(jax.nn.soft_sign))
+register_op("gelu", _unary(jax.nn.gelu))
+register_op("sin", _unary(jnp.sin))
+register_op("cos", _unary(jnp.cos))
+
+
+@register_op("tanh_shrink")
+def tanh_shrink(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=x - jnp.tanh(x))
+
+
+@register_op("softshrink")
+def softshrink(attrs, ins):
+    x = single(ins, "X")
+    lam = attrs.get("lambda", 0.5)
+    return out(Out=jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0)))
+
+
+@register_op("hard_shrink")
+def hard_shrink(attrs, ins):
+    x = single(ins, "X")
+    t = attrs.get("threshold", 0.5)
+    return out(Out=jnp.where(jnp.abs(x) > t, x, 0.0))
+
+
+@register_op("brelu")
+def brelu(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=jnp.clip(x, attrs.get("t_min", 0.0), attrs.get("t_max", 24.0)))
+
+
+@register_op("relu6")
+def relu6(attrs, ins):
+    return out(Out=jnp.clip(single(ins, "X"), 0.0, attrs.get("threshold", 6.0)))
+
+
+@register_op("leaky_relu")
+def leaky_relu(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=jax.nn.leaky_relu(x, negative_slope=attrs.get("alpha", 0.02)))
+
+
+@register_op("elu")
+def elu(attrs, ins):
+    return out(Out=jax.nn.elu(single(ins, "X"), alpha=attrs.get("alpha", 1.0)))
+
+
+@register_op("pow")
+def pow_op(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=jnp.power(x, jnp.asarray(attrs.get("factor", 1.0), dtype=x.dtype)))
+
+
+@register_op("stanh")
+def stanh(attrs, ins):
+    x = single(ins, "X")
+    a = attrs.get("scale_a", 2.0 / 3.0)
+    b = attrs.get("scale_b", 1.7159)
+    return out(Out=b * jnp.tanh(a * x))
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(attrs, ins):
+    x = single(ins, "X")
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return out(Out=jnp.clip(slope * x + offset, 0.0, 1.0))
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(attrs, ins):
+    x = single(ins, "X")
+    t = attrs.get("threshold", 1.0)
+    return out(Out=jnp.where(x > t, x, 0.0))
+
+
+@register_op("swish")
+def swish(attrs, ins):
+    x = single(ins, "X")
+    beta = attrs.get("beta", 1.0)
+    return out(Out=x * jax.nn.sigmoid(beta * x))
+
+
+@register_op("softmax")
+def softmax(attrs, ins):
+    return out(Out=jax.nn.softmax(single(ins, "X"), axis=attrs.get("axis", -1)))
+
+
+@register_op("log_softmax")
+def log_softmax(attrs, ins):
+    return out(Out=jax.nn.log_softmax(single(ins, "X"), axis=attrs.get("axis", -1)))
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(attrs, ins):
+    # Softmax over the last axis of a padded [batch, time] tensor with a mask
+    # handled at the layer level; kernel-level alias of softmax.
+    return out(Out=jax.nn.softmax(single(ins, "X"), axis=-1))
+
+
+@register_op("maxout")
+def maxout(attrs, ins):
+    x = single(ins, "X")  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return out(Out=jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
+
+
+@register_op("prelu")
+def prelu(attrs, ins):
+    x = single(ins, "X")
+    alpha = single(ins, "Alpha")
+    return out(Out=jnp.where(x > 0, x, alpha * x))

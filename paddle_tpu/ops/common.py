@@ -1,0 +1,42 @@
+"""Shared helpers for op kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def single(ins, slot):
+    """Fetch the single array bound to ``slot`` (errors if absent)."""
+    return ins[slot][0]
+
+
+def maybe(ins, slot):
+    vals = ins.get(slot)
+    return vals[0] if vals else None
+
+
+def out(**kw):
+    """Build an output dict: out(Out=x) -> {"Out": [x]}; lists pass through."""
+    return {k: (v if isinstance(v, list) else [v]) for k, v in kw.items()}
+
+
+def broadcast_to_x(x, y, axis: int = -1):
+    """Reference elementwise broadcast semantics (elementwise_op.h):
+
+    ``y``'s shape must match a contiguous run of ``x``'s dims starting at
+    ``axis`` (axis=-1 means trailing-aligned, i.e. standard numpy rules).
+    Returns y reshaped so jnp broadcasting against x is valid.
+    """
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def normalize_pair(v, n=2):
+    """int -> [v]*n ; list passes through."""
+    if isinstance(v, (int, np.integer)):
+        return [int(v)] * n
+    return [int(x) for x in v]

@@ -1,0 +1,162 @@
+"""Loss ops.
+
+Covers the reference loss families
+(/root/reference/paddle/operators/cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, hinge_loss_op.cc, huber_loss_op.cc,
+log_loss_op.cc, margin_rank_loss_op.cc, rank_loss_op.cc,
+squared_l2_distance_op.cc, smooth_l1_loss_op.cc and the legacy CostLayer
+zoo in gserver/layers/CostLayer.cpp).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import broadcast_to_x, maybe, out, single
+
+
+def _take_label_prob(x, label):
+    """Pick per-row probability at integer label; label [N,1] or [N]."""
+    lab = label.reshape(-1)
+    return jnp.take_along_axis(x, lab[:, None].astype(jnp.int32), axis=1)
+
+
+@register_op("cross_entropy")
+def cross_entropy(attrs, ins):
+    x = single(ins, "X")  # probabilities [N, D]
+    label = single(ins, "Label")
+    eps = 1e-12
+    if attrs.get("soft_label", False):
+        y = -jnp.sum(label * jnp.log(x + eps), axis=1, keepdims=True)
+    else:
+        y = -jnp.log(_take_label_prob(x, label) + eps)
+    return out(Y=y)
+
+
+def _softmax_with_ce_grad(attrs, ins, outs, ogs):
+    """Fused, numerically-exact gradient: d_logits = (softmax - onehot) * dY."""
+    logits = single(ins, "Logits")
+    label = single(ins, "Label")
+    sm = jax.nn.softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        grad = sm - label
+    else:
+        onehot = jax.nn.one_hot(label.reshape(-1), logits.shape[-1], dtype=sm.dtype)
+        grad = sm - onehot
+    dy = ogs["Loss"][0]
+    return {"Logits": [grad * dy], "Label": [None]}
+
+
+@register_op("softmax_with_cross_entropy", grad_fn=_softmax_with_ce_grad)
+def softmax_with_cross_entropy(attrs, ins):
+    logits = single(ins, "Logits")
+    label = single(ins, "Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        loss = -_take_label_prob(logp, label)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register_op("square_error_cost")
+def square_error_cost(attrs, ins):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    return out(Out=jnp.square(x - y))
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(attrs, ins):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    diff = x - y
+    return {"sub_result": [diff],
+            "Out": [jnp.sum(jnp.square(diff), axis=-1, keepdims=True)]}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=jnp.sum(jnp.square(x)).reshape(1))
+
+
+@register_op("hinge_loss")
+def hinge_loss(attrs, ins):
+    logits = single(ins, "Logits")
+    labels = single(ins, "Labels").astype(logits.dtype)
+    signs = 2.0 * labels - 1.0
+    return out(Loss=jnp.maximum(0.0, 1.0 - signs * logits))
+
+
+@register_op("huber_loss")
+def huber_loss(attrs, ins):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Residual": [r], "Out": [loss]}
+
+
+@register_op("log_loss")
+def log_loss(attrs, ins):
+    p = single(ins, "Predicted")
+    y = single(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return out(Loss=-y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps))
+
+
+@register_op("rank_loss")
+def rank_loss(attrs, ins):
+    label = single(ins, "Label")
+    left = single(ins, "Left")
+    right = single(ins, "Right")
+    d = left - right
+    return out(Out=jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(attrs, ins):
+    label = single(ins, "Label")
+    x1 = single(ins, "X1")
+    x2 = single(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    o = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [o], "Activated": [(o > 0).astype(x1.dtype)]}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(attrs, ins):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    in_w = maybe(ins, "InsideWeight")
+    out_w = maybe(ins, "OutsideWeight")
+    if in_w is not None:
+        diff = diff * in_w
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if out_w is not None:
+        elem = elem * out_w
+    return {"Diff": [diff], "Out": [jnp.sum(elem, axis=-1, keepdims=True)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(attrs, ins):
+    x = single(ins, "X")
+    label = single(ins, "Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return out(Out=loss)
+
+
+@register_op("bce_loss")
+def bce_loss(attrs, ins):
+    x = single(ins, "X")
+    label = single(ins, "Label")
+    eps = 1e-12
+    return out(Out=-(label * jnp.log(x + eps) + (1 - label) * jnp.log(1 - x + eps)))

@@ -1,0 +1,261 @@
+"""Neural-net structural ops: conv / pool / norm / dropout.
+
+TPU-native replacements for the reference's cuDNN-backed kernels
+(/root/reference/paddle/operators/conv_op.cc, conv_cudnn_op.cu.cc,
+pool_op.cc, batch_norm_op.cc, lrn_op.cc, dropout_op.cc,
+operators/math/im2col.cc — im2col+gemm is never needed here: XLA lowers
+lax.conv_general_dilated straight onto the MXU).
+
+Layout: ops accept a ``data_format`` attr ("NCHW" reference default, "NHWC"
+TPU-preferred). Models built for benchmarking use NHWC so the channel dim
+lands on the 128-lane axis without relayout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import maybe, normalize_pair, out, single
+
+
+def _conv_dn(fmt: str):
+    if fmt == "NHWC":
+        return ("NHWC", "HWIO", "NHWC")
+    return ("NCHW", "OIHW", "NCHW")
+
+
+@register_op("conv2d")
+def conv2d(attrs, ins):
+    x = single(ins, "Input")
+    w = single(ins, "Filter")
+    fmt = attrs.get("data_format", "NCHW")
+    strides = normalize_pair(attrs.get("strides", [1, 1]))
+    pads = normalize_pair(attrs.get("paddings", [0, 0]))
+    dilations = normalize_pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    y = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=_conv_dn(fmt),
+        feature_group_count=groups,
+        precision=(jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    return out(Output=y.astype(x.dtype))
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(attrs, ins):
+    a = dict(attrs)
+    x = single(ins, "Input")
+    fmt = a.get("data_format", "NCHW")
+    channels = x.shape[1] if fmt == "NCHW" else x.shape[-1]
+    a["groups"] = channels
+    return conv2d(a, ins)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(attrs, ins):
+    x = single(ins, "Input")
+    w = single(ins, "Filter")  # reference layout: [in_c, out_c, kh, kw]
+    fmt = attrs.get("data_format", "NCHW")
+    strides = normalize_pair(attrs.get("strides", [1, 1]))
+    pads = normalize_pair(attrs.get("paddings", [0, 0]))
+    dilations = normalize_pair(attrs.get("dilations", [1, 1]))
+    if fmt == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        kh, kw = w.shape[0], w.shape[1]
+    else:
+        dn = ("NCHW", "IOHW", "NCHW")
+        kh, kw = w.shape[2], w.shape[3]
+    pad_h = dilations[0] * (kh - 1) - pads[0]
+    pad_w = dilations[1] * (kw - 1) - pads[1]
+    y = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        transpose_kernel=True,
+    )
+    return out(Output=y)
+
+
+@register_op("pool2d")
+def pool2d(attrs, ins):
+    x = single(ins, "X")
+    fmt = attrs.get("data_format", "NCHW")
+    ptype = attrs.get("pooling_type", "max")
+    ksize = normalize_pair(attrs.get("ksize", [2, 2]))
+    strides = normalize_pair(attrs.get("strides", [1, 1]))
+    pads = normalize_pair(attrs.get("paddings", [0, 0]))
+    if fmt == "NHWC":
+        window = (1, ksize[0], ksize[1], 1)
+        stride = (1, strides[0], strides[1], 1)
+        padding = [(0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0)]
+        spatial = (1, 2)
+    else:
+        window = (1, 1, ksize[0], ksize[1])
+        stride = (1, 1, strides[0], strides[1])
+        padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+        spatial = (2, 3)
+    if attrs.get("global_pooling", False):
+        window = tuple(x.shape[i] if i in spatial else 1 for i in range(x.ndim))
+        stride = (1,) * x.ndim
+        padding = [(0, 0)] * x.ndim
+    # init values must be Python scalars so JAX recognises the monoid and
+    # uses the differentiable reduce_window_{sum,max} primitives
+    if ptype == "max":
+        init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) else np.iinfo(x.dtype).min
+        y = jax.lax.reduce_window(x, init, jax.lax.max,
+                                  window, stride, padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                  window, stride, padding)
+        if attrs.get("exclusive", True) and any(p != (0, 0) for p in padding):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                        window, stride, padding)
+            y = s / cnt
+        else:
+            y = s / np.prod([w for w in window])
+    return out(Out=y.astype(x.dtype))
+
+
+@register_op("batch_norm")
+def batch_norm(attrs, ins):
+    """Reference batch_norm_op.cc semantics.
+
+    Training: normalise with batch stats, update running Mean/Variance with
+    ``momentum``. The layer aliases MeanOut/VarianceOut onto Mean/Variance so
+    the functional state-threading performs the reference's in-place update.
+    """
+    x = single(ins, "X")
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    mean = single(ins, "Mean")
+    var = single(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    fmt = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
+    is_test = attrs.get("is_test", False)
+
+    if fmt == "NCHW" and x.ndim == 4:
+        axes = (0, 2, 3)
+        bshape = (1, -1, 1, 1)
+    elif x.ndim == 4:  # NHWC
+        axes = (0, 1, 2)
+        bshape = (1, 1, 1, -1)
+    else:  # 2-D [N, C]
+        axes = (0,)
+        bshape = (1, -1)
+
+    xf = x.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_inv_std = jax.lax.rsqrt(var + eps)
+    else:
+        bmean = jnp.mean(xf, axis=axes)
+        bvar = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        mean_out = momentum * mean + (1 - momentum) * bmean
+        var_out = momentum * var + (1 - momentum) * bvar
+        saved_mean = bmean
+        saved_inv_std = jax.lax.rsqrt(bvar + eps)
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * (inv * scale).reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_inv_std],
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(attrs, ins):
+    x = single(ins, "X")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv
+    scale = maybe(ins, "Scale")
+    bias = maybe(ins, "Bias")
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * begin + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * begin + norm_shape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [mean.reshape(x.shape[:begin])],
+        "Variance": [var.reshape(x.shape[:begin])],
+    }
+
+
+@register_op("lrn")
+def lrn(attrs, ins):
+    """Local response normalisation across channels (lrn_op.cc), NCHW."""
+    x = single(ins, "X")
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+def _dropout_grad(attrs, ins, outs, ogs):
+    mask = outs["Mask"][0]
+    og = ogs["Out"][0]
+    return {"X": [og * mask.astype(og.dtype)]}
+
+
+@register_op("dropout", needs_rng=True, grad_fn=_dropout_grad)
+def dropout(attrs, ins, rng):
+    x = single(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False):
+        # Reference (downscale-in-infer mode) scales at inference.
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+    return {"Out": [jnp.where(keep, x, 0.0)], "Mask": [keep.astype(x.dtype)]}
+
+
+@register_op("im2sequence")
+def im2sequence(attrs, ins):
+    """Extract conv-style patches into a [N*outH*outW, C*kh*kw] matrix
+    (im2sequence_op.cc / legacy BlockExpandLayer)."""
+    x = single(ins, "X")  # NCHW
+    kh, kw = normalize_pair(attrs["kernels"])
+    sh, sw = normalize_pair(attrs.get("strides", [1, 1]))
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    xp = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[2] if len(p) > 2 else p[0]),
+                     (p[1] if len(p) > 1 else p[0], p[3] if len(p) > 3 else p[1])])
+    n, c, h, w = xp.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    seq = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n * oh * ow, c * kh * kw)
+    return out(Out=seq)

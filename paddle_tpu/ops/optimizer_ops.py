@@ -1,0 +1,194 @@
+"""Optimizers-as-ops.
+
+Mirrors the reference's design where each optimizer update is itself an op in
+the program (/root/reference/paddle/operators/sgd_op.cc, momentum_op.cc,
+adam_op.cc, adamax_op.cc, adagrad_op.cc, decayed_adagrad_op.cc,
+adadelta_op.cc, rmsprop_op.cc, ftrl_op.cc, proximal_gd_op.cc,
+proximal_adagrad_op.cc; legacy: paddle/parameter/FirstOrderOptimizer.cpp and
+the C-ABI lib paddle/optimizer). Because the whole block compiles to one XLA
+computation, every parameter's update fuses into the same program as the
+backward pass — the TPU equivalent of the reference's fused
+TrainingAlgorithmOp kernels — and donated buffers make updates in-place.
+
+All slot names match the reference so program transforms stay portable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import maybe, out, single
+
+
+@register_op("sgd")
+def sgd(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    lr = single(ins, "LearningRate").astype(p.dtype).reshape(())
+    return out(ParamOut=p - lr * g.astype(p.dtype))
+
+
+@register_op("momentum")
+def momentum(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(p.dtype)
+    v = single(ins, "Velocity")
+    lr = single(ins, "LearningRate").astype(p.dtype).reshape(())
+    mu = attrs.get("mu", 0.9)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam")
+def adam(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    m1 = single(ins, "Moment1")
+    m2 = single(ins, "Moment2")
+    b1p = single(ins, "Beta1Pow").reshape(())
+    b2p = single(ins, "Beta2Pow").reshape(())
+    lr = single(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - (lr_t * m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
+    return {
+        "ParamOut": [p_out],
+        "Moment1Out": [m1_out],
+        "Moment2Out": [m2_out],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("adamax")
+def adamax(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    m = single(ins, "Moment")
+    inf_norm = single(ins, "InfNorm")
+    b1p = single(ins, "Beta1Pow").reshape(())
+    lr = single(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    p_out = p - (lr_t * m_out / (inf_out + eps)).astype(p.dtype)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out],
+            "Beta1PowOut": [b1p * b1]}
+
+
+@register_op("adagrad")
+def adagrad(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    p_out = p - (lr * g / (jnp.sqrt(mom_out) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register_op("decayed_adagrad")
+def decayed_adagrad(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - (lr * g / (jnp.sqrt(mom_out) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register_op("adadelta")
+def adadelta(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    avg_sq_grad = single(ins, "AvgSquaredGrad")
+    avg_sq_upd = single(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg_out = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    return {"ParamOut": [p + update.astype(p.dtype)],
+            "AvgSquaredGradOut": [asg_out],
+            "AvgSquaredUpdateOut": [asu_out]}
+
+
+@register_op("rmsprop")
+def rmsprop(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    ms = single(ins, "MeanSquare")
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-10)
+    momentum_c = attrs.get("momentum", 0.0)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = momentum_c * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [p - mom_out.astype(p.dtype)],
+            "MomentOut": [mom_out], "MeanSquareOut": [ms_out]}
+
+
+@register_op("ftrl")
+def ftrl(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    sq_acc = single(ins, "SquaredAccumulator")
+    lin_acc = single(ins, "LinearAccumulator")
+    lr = single(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq_acc + jnp.square(g)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq_acc, -power)) / lr
+    new_lin = lin_acc + g - sigma * p
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_out = (pre / denom).astype(p.dtype)
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register_op("proximal_gd")
+def proximal_gd(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    lr = single(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return out(ParamOut=p_out.astype(p.dtype))
+
+
+@register_op("proximal_adagrad")
+def proximal_adagrad(attrs, ins):
+    p = single(ins, "Param")
+    g = single(ins, "Grad").astype(jnp.float32)
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mom_out = mom + jnp.square(g)
+    lr_t = lr / jnp.sqrt(mom_out)
+    prox = p - lr_t * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
+             / (1.0 + lr_t * l2))
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [mom_out]}

@@ -1,0 +1,329 @@
+"""Optimizer classes: build backward + update ops into the program.
+
+Mirrors /root/reference/python/paddle/v2/fluid/optimizer.py: ``minimize``
+appends the backward pass then one update op per parameter, creating
+accumulator state (velocity/moments/pows) as persistable vars initialised in
+the startup program. Because the executor compiles the whole block, the
+entire step — forward, backward, all N parameter updates — is one fused XLA
+computation with donated parameter buffers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core.backward import append_backward
+from .core.program import Program, Variable, default_startup_program
+from .layers.layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+
+class Optimizer:
+    op_type: str = None
+
+    def __init__(self, learning_rate: float = 0.001, global_step=None,
+                 regularization=None):
+        self.learning_rate = learning_rate
+        self.global_step = global_step
+        self.regularization = regularization
+        self._lr_var: Optional[Variable] = None
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+
+    # -- learning rate -----------------------------------------------------
+    def _create_lr_var(self, program: Program, startup: Program) -> Variable:
+        if self._lr_var is not None:
+            return self._lr_var
+        name = program.unique_name("learning_rate")
+        block = program.global_block
+        v = block.create_var(name=name, shape=[1], dtype="float32",
+                             persistable=True, stop_gradient=True)
+        sb = startup.global_block
+        sv = sb.create_var(name=name, shape=[1], dtype="float32", persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [name]},
+                     attrs={"shape": [1], "dtype": "float32",
+                            "value": float(self.learning_rate)})
+        self._lr_var = v
+        return v
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, startup: Program,
+                         fill_value: float = 0.0, shape=None,
+                         dtype="float32") -> Variable:
+        shape = list(shape if shape is not None else param.shape)
+        var_name = f"{param.name}_{name}_acc"
+        block = param.block.program.global_block
+        v = block.create_var(name=var_name, shape=shape, dtype=dtype,
+                             persistable=True, stop_gradient=True)
+        sb = startup.global_block
+        sb.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": [var_name]},
+                     attrs={"shape": shape, "dtype": dtype,
+                            "value": float(fill_value)})
+        self._accumulators.setdefault(name, {})[param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param) -> Variable:
+        return self._accumulators[name][param.name]
+
+    # -- per-algorithm hooks ----------------------------------------------
+    def _create_accumulators(self, startup, params: List[Variable]):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def minimize(self, loss: Variable, startup_program: Optional[Program] = None,
+                 parameter_list=None, no_grad_set=None
+                 ) -> List[Tuple[Variable, Variable]]:
+        startup = startup_program or default_startup_program()
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        block = loss.block
+        lr_var = self._create_lr_var(block.program, startup)
+        self._create_accumulators(startup, [p for p, _ in params_grads])
+        for pg in params_grads:
+            self._append_optimize_op(block, pg, lr_var)
+        if self.global_step is not None:
+            block.append_op("increment", inputs={"X": [self.global_step.name]},
+                            outputs={"Out": [self.global_step.name]},
+                            attrs={"step": 1.0})
+        return params_grads
+
+
+class SGDOptimizer(Optimizer):
+    op_type = "sgd"
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        block.append_op(
+            "sgd",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name]})
+
+
+class MomentumOptimizer(Optimizer):
+    op_type = "momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("velocity", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            "momentum",
+            inputs={"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self.momentum, "use_nesterov": self.use_nesterov})
+
+
+class AdamOptimizer(Optimizer):
+    op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("moment1", p, startup)
+            self._add_accumulator("moment2", p, startup)
+            self._add_accumulator("beta1_pow", p, startup, self.beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, startup, self.beta2, shape=[1])
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        block.append_op(
+            "adam",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [lr_var.name],
+                    "Moment1": [self._get_accumulator("moment1", p).name],
+                    "Moment2": [self._get_accumulator("moment2", p).name],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow", p).name],
+                    "Beta2Pow": [self._get_accumulator("beta2_pow", p).name]},
+            outputs={"ParamOut": [p.name],
+                     "Moment1Out": [self._get_accumulator("moment1", p).name],
+                     "Moment2Out": [self._get_accumulator("moment2", p).name],
+                     "Beta1PowOut": [self._get_accumulator("beta1_pow", p).name],
+                     "Beta2PowOut": [self._get_accumulator("beta2_pow", p).name]},
+            attrs={"beta1": self.beta1, "beta2": self.beta2,
+                   "epsilon": self.epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    op_type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("moment", p, startup)
+            self._add_accumulator("inf_norm", p, startup)
+            self._add_accumulator("beta1_pow", p, startup, self.beta1, shape=[1])
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        block.append_op(
+            "adamax",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [lr_var.name],
+                    "Moment": [self._get_accumulator("moment", p).name],
+                    "InfNorm": [self._get_accumulator("inf_norm", p).name],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow", p).name]},
+            outputs={"ParamOut": [p.name],
+                     "MomentOut": [self._get_accumulator("moment", p).name],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p).name],
+                     "Beta1PowOut": [self._get_accumulator("beta1_pow", p).name]},
+            attrs={"beta1": self.beta1, "beta2": self.beta2,
+                   "epsilon": self.epsilon})
+
+
+class AdagradOptimizer(Optimizer):
+    op_type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("moment", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            "adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self.epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    op_type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.epsilon = decay, epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("moment", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"decay": self.decay, "epsilon": self.epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    op_type = "adadelta"
+
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("avg_sq_grad", p, startup)
+            self._add_accumulator("avg_sq_update", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        asg = self._get_accumulator("avg_sq_grad", p)
+        asu = self._get_accumulator("avg_sq_update", p)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "AvgSquaredGrad": [asg.name], "AvgSquaredUpdate": [asu.name]},
+            outputs={"ParamOut": [p.name], "AvgSquaredGradOut": [asg.name],
+                     "AvgSquaredUpdateOut": [asu.name]},
+            attrs={"rho": self.rho, "epsilon": self.epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    op_type = "rmsprop"
+
+    def __init__(self, learning_rate, decay=0.9, momentum=0.0, epsilon=1e-10,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.momentum, self.epsilon = decay, momentum, epsilon
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("mean_square", p, startup)
+            self._add_accumulator("moment", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        ms = self._get_accumulator("mean_square", p)
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            "rmsprop",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "MeanSquare": [ms.name], "Moment": [m.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name], "MeanSquareOut": [ms.name],
+                     "MomentOut": [m.name]},
+            attrs={"decay": self.decay, "momentum": self.momentum,
+                   "epsilon": self.epsilon})
+
+
+class FtrlOptimizer(Optimizer):
+    op_type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, startup, params):
+        for p in params:
+            self._add_accumulator("squared_acc", p, startup)
+            self._add_accumulator("linear_acc", p, startup)
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        sq = self._get_accumulator("squared_acc", p)
+        lin = self._get_accumulator("linear_acc", p)
+        block.append_op(
+            "ftrl",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "SquaredAccumulator": [sq.name],
+                    "LinearAccumulator": [lin.name],
+                    "LearningRate": [lr_var.name]},
+            outputs={"ParamOut": [p.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self.l1, "l2": self.l2, "lr_power": self.lr_power})
+
+
+# fluid aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

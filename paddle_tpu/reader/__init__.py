@@ -1,0 +1,3 @@
+from .decorator import (PipeReader, buffered, cache, chain, compose, firstn,
+                        map_readers, shuffle, xmap_readers)
+from .minibatch import batch

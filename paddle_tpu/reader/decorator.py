@@ -1,0 +1,218 @@
+"""Composable reader decorators.
+
+Port-equivalent of /root/reference/python/paddle/v2/reader/decorator.py:17-236
+(map_readers, buffered, shuffle, chain, compose, firstn, xmap_readers,
+PipeReader) — pure-Python data plumbing, re-implemented with the same
+contracts. A *reader creator* is a zero-arg callable returning an iterable of
+samples.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import subprocess
+import threading
+from typing import Any, Callable, Iterable, List
+
+__all__ = [
+    "map_readers", "buffered", "shuffle", "chain", "compose", "firstn",
+    "xmap_readers", "cache", "PipeReader",
+]
+
+
+def map_readers(func: Callable, *readers):
+    """Apply func to the entries read from the given readers, zipped."""
+
+    def reader():
+        its = [r() for r in readers]
+        for parts in zip(*its):
+            yield func(*parts)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+
+    def shuffled():
+        buf: List[Any] = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into combined tuples: (a, (b1, b2)) -> (a, b1, b2)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        if check_alignment:
+            for parts in zip(*its):
+                yield sum((make_tuple(p) for p in parts), ())
+            # detect ragged tails
+            for it in its:
+                if next(it, None) is not None:
+                    raise ComposeNotAligned("readers have different lengths")
+        else:
+            for parts in zip(*its):
+                yield sum((make_tuple(p) for p in parts), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """Prefetch up to ``size`` samples on a background thread (the
+    DoubleBuffer analogue: reference DataProvider.h:249-271)."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def reader_n():
+        return itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader):
+    """Materialise a reader once; replay from memory afterwards."""
+    all_data: List[Any] = []
+    loaded = [False]
+
+    def cached():
+        if not loaded[0]:
+            for d in reader():
+                all_data.append(d)
+                yield d
+            loaded[0] = True
+        else:
+            yield from all_data
+
+    return cached
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over a reader with ``process_num`` worker threads."""
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, d = item
+            if order:
+                pending[i] = d
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+            else:
+                yield d
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+class PipeReader:
+    """Stream samples from a shell command's stdout
+    (reference decorator.py PipeReader)."""
+
+    def __init__(self, command: str, bufsize: int = 8192, file_type: str = "plain"):
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines: bool = True, line_break: bytes = b"\n"):
+        proc = subprocess.Popen(self.command.split(), bufsize=self.bufsize,
+                                stdout=subprocess.PIPE)
+        remained = b""
+        while True:
+            buff = proc.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop()
+                for line in lines:
+                    yield line.decode()
+            else:
+                yield buff
+        if remained:
+            yield remained.decode()

@@ -1,0 +1,66 @@
+"""Weight-decay regularizers (fluid regularizer.py parity).
+
+Appends decay ops onto each parameter's gradient before the optimizer op, as
+the reference does (/root/reference/python/paddle/v2/fluid/regularizer.py).
+"""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def append_decay(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def append_decay(self, param, grad, block):
+        program = block.program
+        decay = program.unique_name(param.name + "@L2DECAY")
+        block.create_var(name=decay, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op("scale", inputs={"X": [param.name]},
+                        outputs={"Out": [decay]}, attrs={"scale": self.coeff})
+        out = program.unique_name(grad.name + "@REG")
+        block.create_var(name=out, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op("sum", inputs={"X": [grad.name, decay]},
+                        outputs={"Out": [out]})
+        return block.var(out)
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def append_decay(self, param, grad, block):
+        program = block.program
+        decay = program.unique_name(param.name + "@L1DECAY")
+        block.create_var(name=decay, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op("l1_decay_sign", inputs={"X": [param.name]},
+                        outputs={"Out": [decay]}, attrs={"coeff": self.coeff})
+        out = program.unique_name(grad.name + "@REG")
+        block.create_var(name=out, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op("sum", inputs={"X": [grad.name, decay]},
+                        outputs={"Out": [out]})
+        return block.var(out)
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """Apply per-param (or global) regularizers to gradients."""
+    result = []
+    for param, grad in params_grads:
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is None:
+            result.append((param, grad))
+            continue
+        new_grad = reg.append_decay(param, grad, param.block.program.global_block)
+        result.append((param, new_grad))
+    return result
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

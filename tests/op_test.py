@@ -1,0 +1,159 @@
+"""OpTest harness: single-op programs checked for output correctness and
+gradients against finite differences.
+
+Mirrors the reference's workhorse test pattern
+(/root/reference/python/paddle/v2/fluid/tests/op_test.py:194,80,342):
+build a one-op program, run it, and compare the program-generated backward
+(vjp-derived grad ops) against a numeric gradient.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.backward import append_backward
+from paddle_tpu.core.program import Program
+from paddle_tpu.core.registry import get_op
+
+
+class OpTest:
+    """Subclass and set: op_type, inputs {slot: np array | [(name, arr), ...]},
+    attrs, outputs (expected, optional)."""
+
+    op_type: str = None
+    attrs: dict = {}
+
+    def _norm_io(self, io: Dict) -> Dict[str, List]:
+        norm = {}
+        for slot, v in io.items():
+            if isinstance(v, list):
+                norm[slot] = v
+            else:
+                norm[slot] = [(f"{slot.lower()}0", v)]
+        return norm
+
+    def _build(self, for_grad=False):
+        main, startup = Program(), Program()
+        ins = self._norm_io(self.inputs)
+        with pt.program_guard(main, startup):
+            in_vars = {}
+            feed = {}
+            for slot, pairs in ins.items():
+                vars_for_slot = []
+                for name, arr in pairs:
+                    arr = np.asarray(arr)
+                    v = main.global_block.create_var(
+                        name=name, shape=arr.shape, dtype=arr.dtype,
+                        stop_gradient=False)
+                    feed[name] = arr
+                    vars_for_slot.append(name)
+                in_vars[slot] = vars_for_slot
+            # discover outputs via abstract eval
+            import jax
+
+            abstract = {
+                slot: [jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                            np.asarray(a).dtype)
+                       for _, a in pairs]
+                for slot, pairs in ins.items()
+            }
+            opdef = get_op(self.op_type)
+            if opdef.needs_rng:
+                key = jax.ShapeDtypeStruct((2,), np.uint32)
+                probe = jax.eval_shape(
+                    lambda i, k: opdef.fn(self.attrs, i, rng=k), abstract, key)
+            else:
+                probe = jax.eval_shape(lambda i: opdef.fn(self.attrs, i), abstract)
+            out_vars = {}
+            for slot, sds_list in probe.items():
+                names = []
+                for i, sds in enumerate(sds_list):
+                    n = f"out_{slot.lower()}_{i}"
+                    main.global_block.create_var(name=n, shape=sds.shape,
+                                                 dtype=sds.dtype)
+                    names.append(n)
+                out_vars[slot] = names
+            main.global_block.append_op(self.op_type, inputs=in_vars,
+                                        outputs=out_vars, attrs=self.attrs)
+        return main, startup, feed, in_vars, out_vars
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed, _, out_vars = self._build()
+        exe = pt.Executor(pt.CPUPlace())
+        expect = self._norm_io(self.outputs)
+        fetch = [n for slot in expect for n in out_vars[slot]]
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+        got = dict(zip(fetch, res))
+        for slot, pairs in expect.items():
+            for (name, arr), out_name in zip(pairs, out_vars[slot]):
+                np.testing.assert_allclose(
+                    got[out_name], np.asarray(arr), atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}/{out_name}")
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check: List[str], output_name: str,
+                   max_relative_error=0.005, delta=5e-3):
+        """Compare program-built gradients to central finite differences."""
+        main, startup, feed, in_vars, out_vars = self._build()
+        with pt.program_guard(main, startup):
+            # scalar target: mean(square(out)) — non-linear so linear ops and
+            # normalised outputs (softmax rows summing to 1) still produce
+            # informative gradients
+            target_in = None
+            for slot, names in out_vars.items():
+                for n in names:
+                    if n.endswith(output_name.lower() + "_0") or n == output_name:
+                        target_in = main.global_block.var(n)
+            assert target_in is not None, f"no output {output_name}"
+            sq = pt.layers.square(target_in, main_program=main,
+                                  startup_program=startup)
+            loss = pt.layers.mean(sq, main_program=main,
+                                  startup_program=startup)
+        append_backward(loss, parameter_list=None,
+                        no_grad_set={n for n in feed if n not in inputs_to_check})
+
+        grad_names = []
+        for n in inputs_to_check:
+            contribs = [v for v in main.global_block.vars
+                        if v.startswith(n + "@GRAD")]
+            assert contribs, f"no grad var generated for {n}"
+            grad_names.append(sorted(contribs)[0])
+        exe = pt.Executor(pt.CPUPlace())
+        analytic = dict(zip(inputs_to_check,
+                            exe.run(main, feed=feed, fetch_list=grad_names)))
+
+        # numeric gradient of mean(output) wrt each checked input
+        fetch_out = None
+        for slot, names in out_vars.items():
+            for n in names:
+                if n.endswith(output_name.lower() + "_0") or n == output_name:
+                    fetch_out = n
+
+        def eval_loss(feed_dict):
+            (o,) = exe.run(main, feed=feed_dict, fetch_list=[fetch_out])
+            return float(np.mean(np.square(o.astype(np.float64))))
+
+        for name in inputs_to_check:
+            base = feed[name].astype(np.float64)
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                pert = feed.copy()
+                up = flat.copy()
+                up[i] += delta
+                pert[name] = up.reshape(base.shape).astype(feed[name].dtype)
+                lo = flat.copy()
+                lo[i] -= delta
+                pert2 = feed.copy()
+                pert2[name] = lo.reshape(base.shape).astype(feed[name].dtype)
+                num.reshape(-1)[i] = (eval_loss(pert) - eval_loss(pert2)) / (2 * delta)
+            a = np.asarray(analytic[name], dtype=np.float64)
+            denom = np.maximum(np.abs(num), np.abs(a))
+            denom[denom == 0] = 1.0
+            rel = np.abs(a - num) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {name}: max rel err {rel.max():.4g}\n"
+                f"analytic={a}\nnumeric={num}")

@@ -1,0 +1,103 @@
+"""Executor tests: feed/fetch contract, state threading, compile caching."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _setup(main, startup):
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    return exe
+
+
+def test_feed_fetch_roundtrip():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[3])
+        y = pt.layers.scale(x, scale=2.0, bias=1.0)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+def test_startup_initialises_persistables():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[4])
+        y = pt.layers.fc(input=x, size=2,
+                         param_attr=pt.ParamAttr(
+                             name="w1",
+                             initializer=pt.initializer.Constant(0.5)),
+                         bias_attr=pt.ParamAttr(
+                             name="b1",
+                             initializer=pt.initializer.Constant(0.25)))
+    exe = _setup(main, startup)
+    w = pt.global_scope().get_numpy("w1")
+    np.testing.assert_allclose(w, np.full((4, 2), 0.5), rtol=1e-6)
+    xv = np.ones((3, 4), dtype=np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((3, 2), 4 * 0.5 + 0.25), rtol=1e-6)
+
+
+def test_missing_startup_raises():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[4])
+        y = pt.layers.fc(input=x, size=2)
+    exe = pt.Executor(pt.CPUPlace())
+    with pytest.raises(RuntimeError, match="startup"):
+        exe.run(main, feed={"x": np.ones((1, 4), np.float32)}, fetch_list=[y])
+
+
+def test_batch_size_polymorphism():
+    """-1 batch dims re-jit per concrete shape; results stay correct."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[3])
+        y = pt.layers.scale(x, scale=3.0)
+    exe = pt.Executor(pt.CPUPlace())
+    for bs in (1, 4, 7):
+        xv = np.ones((bs, 3), np.float32)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        assert out.shape == (bs, 3)
+        np.testing.assert_allclose(out, 3.0 * xv)
+
+
+def test_persistable_state_survives_runs():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        counter = pt.layers.create_global_var(shape=[1], value=0.0,
+                                              dtype="float32", name="counter")
+        main.global_block.append_op(
+            "increment", inputs={"X": [counter.name]},
+            outputs={"Out": [counter.name]}, attrs={"step": 1.0})
+    exe = _setup(main, startup)
+    for expected in (1.0, 2.0, 3.0):
+        exe.run(main, fetch_list=[])
+        assert pt.global_scope().get_numpy("counter")[0] == expected
+
+
+def test_rng_ops_vary_across_runs():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[100])
+        y = pt.layers.dropout(x, dropout_prob=0.5)
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.ones((2, 100), np.float32)
+    (a,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert not np.array_equal(a, b)  # different rng folds
+    assert set(np.unique(a)) <= {0.0, 1.0}
+
+
+def test_check_nan_inf():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[2])
+        y = pt.layers.log(x)
+    exe = pt.Executor(pt.CPUPlace(), check_nan_inf=True)
+    with pytest.raises(FloatingPointError):
+        exe.run(main, feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                fetch_list=[y])

@@ -1,0 +1,75 @@
+"""Persistence tests: save/load params, program serialisation, inference
+model (reference: fluid tests for io.py + save/load ops)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+
+
+def _build_net():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[4])
+        h = pt.layers.fc(input=x, size=8, act="relu",
+                         param_attr=pt.ParamAttr(name="w0"))
+        y = pt.layers.fc(input=h, size=2, param_attr=pt.ParamAttr(name="w1"))
+    return main, startup, y
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, y = _build_net()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    w0 = pt.global_scope().get_numpy("w0")
+    pio.save_persistables(exe, str(tmp_path / "ckpt"), main_program=main)
+
+    # clobber and reload
+    import jax.numpy as jnp
+    pt.global_scope().set("w0", jnp.zeros_like(pt.global_scope().get("w0")))
+    pio.load_persistables(exe, str(tmp_path / "ckpt"), main_program=main)
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w0"), w0)
+
+
+def test_program_dict_roundtrip():
+    main, startup, y = _build_net()
+    d = pio.program_to_dict(main)
+    back = pio.program_from_dict(d)
+    assert len(back.global_block.ops) == len(main.global_block.ops)
+    assert set(back.global_block.vars) == set(main.global_block.vars)
+    assert [o.type for o in back.global_block.ops] == \
+        [o.type for o in main.global_block.ops]
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, y = _build_net()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.random.rand(3, 4).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    pio.save_inference_model(str(tmp_path / "model"), ["x"], [y], exe,
+                             main_program=main)
+
+    # fresh scope + executor, as a deployment process would have
+    scope = pt.Scope()
+    exe2 = pt.Executor(pt.CPUPlace())
+    prog, feeds, fetches = pio.load_inference_model(str(tmp_path / "model"),
+                                                    exe2, scope=scope)
+    assert feeds == ["x"]
+    (out,) = exe2.run(prog, feed={"x": xv}, fetch_list=fetches, scope=scope)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_prune_removes_training_ops():
+    main, startup, y = _build_net()
+    with pt.program_guard(main, startup):
+        label = pt.layers.data("label", shape=[2])
+        loss = pt.layers.mean(pt.layers.square_error_cost(y, label))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    pruned = pio.prune_program(main, ["x"], [y.name])
+    types = [op.type for op in pruned.global_block.ops]
+    assert "sgd" not in types and "grad" not in types
+    assert "mul" in types

@@ -1,0 +1,338 @@
+"""Per-op output + gradient checks (reference pattern: test_*_op.py files
+under python/paddle/v2/fluid/tests)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup_method(self, _):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0", "y0"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+    attrs = {"axis": 1}
+
+    def setup_method(self, _):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0", "y0"], "Out")
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, _):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(5, 3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x0", "y0"], "Out", max_relative_error=0.02)
+
+
+class TestMulHighRank(OpTest):
+    op_type = "mul"
+    attrs = {"x_num_col_dims": 2}
+
+    def setup_method(self, _):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup_method(self, _):
+        x = np.random.rand(3, 7).astype(np.float32)
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(axis=1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Out", max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup_method(self, _):
+        probs = np.random.rand(4, 5).astype(np.float32) + 0.1
+        probs /= probs.sum(axis=1, keepdims=True)
+        label = np.random.randint(0, 5, (4, 1)).astype(np.int64)
+        y = -np.log(probs[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"X": probs, "Label": label}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Y", max_relative_error=0.02)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, _):
+        logits = np.random.randn(4, 6).astype(np.float32)
+        label = np.random.randint(0, 6, (4, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        sm = e / e.sum(axis=1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["logits0"], "Loss", max_relative_error=0.05)
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def setup_method(self, _):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.mean(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Out")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "data_format": "NCHW"}
+
+    def setup_method(self, _):
+        import jax
+
+        x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=jax.lax.Precision.HIGHEST)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": np.asarray(ref)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["input0", "filter0"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+    attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0], "data_format": "NCHW"}
+
+    def setup_method(self, _):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": ref}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+    attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0], "data_format": "NCHW"}
+
+    def setup_method(self, _):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": ref}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Out")
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup_method(self, _):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1], [3], [1], [7]], dtype=np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.ravel()]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # custom scatter-add grad (SelectedRows equivalent)
+        self.check_grad(["w0"], "Out", max_relative_error=0.02)
+
+
+class TestSgd(OpTest):
+    op_type = "sgd"
+
+    def setup_method(self, _):
+        p = np.random.rand(4, 3).astype(np.float32)
+        g = np.random.rand(4, 3).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAdam(OpTest):
+    op_type = "adam"
+    attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+
+    def setup_method(self, _):
+        p = np.random.rand(3, 2).astype(np.float32)
+        g = np.random.rand(3, 2).astype(np.float32)
+        m1 = np.random.rand(3, 2).astype(np.float32)
+        m2 = np.random.rand(3, 2).astype(np.float32)
+        b1p = np.array([0.9], np.float32)
+        b2p = np.array([0.999], np.float32)
+        lr = np.array([0.01], np.float32)
+        m1o = 0.9 * m1 + 0.1 * g
+        m2o = 0.999 * m2 + 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2p) / (1 - b1p)
+        po = p - lr_t * m1o / (np.sqrt(m2o) + 1e-8)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr}
+        self.outputs = {"ParamOut": po, "Moment1Out": m1o, "Moment2Out": m2o,
+                        "Beta1PowOut": b1p * 0.9, "Beta2PowOut": b2p * 0.999}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+    attrs = {"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+             "data_layout": "NCHW"}
+
+    def setup_method(self, _):
+        x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = ((x - bm.reshape(1, 3, 1, 1))
+             / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+             * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {"Y": y, "MeanOut": 0.9 * mean + 0.1 * bm,
+                        "VarianceOut": 0.9 * var + 0.1 * bv}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+    attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+
+    def setup_method(self, _):
+        x = np.random.rand(3, 4, 2).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+    attrs = {"axis": 1}
+
+    def setup_method(self, _):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 4).astype(np.float32)
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b"], "Out")
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+    attrs = {"k": 2}
+
+    def setup_method(self, _):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([[3.0, 2.0], [6.0, 5.0]], np.float32),
+                        "Indices": np.array([[1, 2], [2, 0]], np.int64)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+    attrs = {"shape": [0, 8]}
+
+    def setup_method(self, _):
+        x = np.random.rand(3, 2, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(3, 8)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0"], "Out")

@@ -1,0 +1,60 @@
+"""Program IR construction tests (mirrors the reference's
+python/paddle/v2/fluid/tests/test_program.py / test_operator_desc.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.program import Program
+
+
+def test_block_and_var_creation():
+    p = Program()
+    b = p.global_block
+    v = b.create_var(name="x", shape=[-1, 4], dtype="float32")
+    assert v.shape == (-1, 4)
+    assert b.var("x") is v
+    assert not v.persistable
+
+
+def test_parameter_creation():
+    p = Program()
+    w = p.global_block.create_parameter(name="w", shape=[4, 5], dtype="float32")
+    assert w.persistable and w.is_parameter
+    assert p.all_parameters() == [w]
+
+
+def test_nested_block_lookup():
+    p = Program()
+    p.global_block.create_var(name="outer", shape=[1], dtype="float32")
+    sub = p.create_block()
+    assert sub.var("outer").name == "outer"
+    p.rollback()
+    assert p.current_block() is p.global_block
+
+
+def test_program_clone_is_independent():
+    p = Program()
+    p.global_block.create_var(name="x", shape=[2], dtype="float32")
+    p.global_block.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+    q = p.clone()
+    q.global_block.append_op("relu", {"X": ["y"]}, {"Out": ["z"]})
+    assert len(p.global_block.ops) == 1
+    assert len(q.global_block.ops) == 2
+
+
+def test_version_bumps_on_mutation():
+    p = Program()
+    v0 = p.version
+    p.global_block.create_var(name="x", shape=[1], dtype="float32")
+    assert p.version > v0
+
+
+def test_program_guard_routes_layers():
+    main, startup = Program(), Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", shape=[4])
+        y = pt.layers.fc(input=x, size=3)
+    assert x.block.program is main
+    assert len(main.global_block.ops) >= 1
+    assert len(startup.global_block.ops) >= 1  # param init ops
+    assert pt.default_main_program() is not main

@@ -1,0 +1,77 @@
+"""Reader decorator + dataset tests (reference: python/paddle/v2/reader/tests,
+dataset/tests)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import reader as rd
+from paddle_tpu import dataset
+
+
+def _counter(n):
+    def r():
+        return iter(range(n))
+    return r
+
+
+def test_map_readers():
+    out = list(rd.map_readers(lambda a, b: a + b, _counter(3), _counter(3))())
+    assert out == [0, 2, 4]
+
+
+def test_shuffle_preserves_multiset():
+    out = list(rd.shuffle(_counter(10), 4)())
+    assert sorted(out) == list(range(10))
+
+
+def test_chain():
+    assert list(rd.chain(_counter(2), _counter(3))()) == [0, 1, 0, 1, 2]
+
+
+def test_compose():
+    out = list(rd.compose(_counter(3), _counter(3))())
+    assert out == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_buffered():
+    assert list(rd.buffered(_counter(5), 2)()) == list(range(5))
+
+
+def test_firstn():
+    assert list(rd.firstn(_counter(100), 3)()) == [0, 1, 2]
+
+
+def test_xmap_ordered():
+    out = list(rd.xmap_readers(lambda x: x * 2, _counter(20), 4, 8, order=True)())
+    assert out == [2 * i for i in range(20)]
+
+
+def test_batch():
+    batches = list(rd.batch(_counter(7), 3)())
+    assert [len(b) for b in batches] == [3, 3, 1]
+    assert list(rd.batch(_counter(7), 3, drop_last=True)()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_cache():
+    r = rd.cache(_counter(4))
+    assert list(r()) == list(r()) == [0, 1, 2, 3]
+
+
+def test_mnist_reader_shapes():
+    sample = next(dataset.mnist.train()())
+    img, label = sample
+    assert img.shape == (784,)
+    assert img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label < 10
+
+
+def test_mnist_deterministic():
+    a = [s[1] for s in rd.firstn(dataset.mnist.train(), 20)()]
+    b = [s[1] for s in rd.firstn(dataset.mnist.train(), 20)()]
+    assert a == b
+
+
+def test_uci_housing():
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(dataset.uci_housing.feature_names) == 13
