@@ -9,7 +9,7 @@ jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
 instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
-from . import initializer, layers, nets, optimizer, regularizer
+from . import initializer, layers, models, nets, optimizer, regularizer
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,
                    program_guard)

@@ -179,12 +179,13 @@ def dropout(x, dropout_prob=0.5, is_test=False, main_program=None,
     return outs["Out"][0]
 
 
-def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, main_program=None,
-        startup_program=None):
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, data_format="NCHW",
+        main_program=None, startup_program=None):
     helper = LayerHelper("lrn", main_program=main_program,
                          startup_program=startup_program)
     outs, _ = helper.append_op("lrn", {"X": [input]}, ["Out", "MidOut"],
-                               {"n": n, "k": k, "alpha": alpha, "beta": beta})
+                               {"n": n, "k": k, "alpha": alpha, "beta": beta,
+                                "data_format": data_format})
     return outs["Out"][0]
 
 

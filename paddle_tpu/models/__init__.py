@@ -1,0 +1,23 @@
+"""Model zoo: the reference's benchmark/demo model families, rebuilt on the
+paddle_tpu layer API.
+
+Reference configs: /root/reference/benchmark/paddle/image/{alexnet,googlenet,
+resnet,vgg,smallnet_mnist_cifar}.py and /root/reference/v1_api_demo/mnist
+(LeNet). The RNN/LSTM families land with the sequence machinery.
+
+All builders take a data Variable and append ops to the default (or given)
+program; they return the logits variable. ``data_format`` defaults to NHWC —
+the TPU-native layout (channels-last maps directly onto the MXU's lane
+dimension) — whereas the reference hardcodes NCHW for cuDNN.
+"""
+from .lenet import lenet5
+from .alexnet import alexnet
+from .vgg import vgg
+from .resnet import resnet_imagenet, resnet_cifar10
+from .googlenet import googlenet
+from .smallnet import smallnet_mnist_cifar
+
+__all__ = [
+    "lenet5", "alexnet", "vgg", "resnet_imagenet", "resnet_cifar10",
+    "googlenet", "smallnet_mnist_cifar",
+]

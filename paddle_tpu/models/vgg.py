@@ -1,0 +1,19 @@
+"""VGG-16/19 — parity with /root/reference/benchmark/paddle/image/vgg.py."""
+from .. import layers
+from ..nets import img_conv_group
+
+
+def vgg(images, num_classes=1000, depth=19, data_format="NHWC",
+        is_test=False):
+    """VGG-16 or VGG-19 (reference vgg.py:24 selects conv counts by depth)."""
+    assert depth in (16, 19), f"vgg depth must be 16 or 19, got {depth}"
+    nums = [2, 2, 3, 3, 3] if depth == 16 else [2, 2, 4, 4, 4]
+    x = images
+    for filters, n in zip([64, 128, 256, 512, 512], nums):
+        x = img_conv_group(x, [filters] * n, conv_filter_size=3,
+                           conv_act="relu", data_format=data_format)
+    fc1 = layers.fc(x, size=4096, act="relu")
+    fc1 = layers.dropout(fc1, 0.5, is_test=is_test)
+    fc2 = layers.fc(fc1, size=4096, act="relu")
+    fc2 = layers.dropout(fc2, 0.5, is_test=is_test)
+    return layers.fc(fc2, size=num_classes)

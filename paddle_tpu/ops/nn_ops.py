@@ -209,16 +209,22 @@ def layer_norm(attrs, ins):
 
 @register_op("lrn")
 def lrn(attrs, ins):
-    """Local response normalisation across channels (lrn_op.cc), NCHW."""
+    """Local response normalisation across channels (lrn_op.cc); the
+    data_format attr extends the reference's NCHW-only kernel to NHWC."""
     x = single(ins, "X")
     n = attrs.get("n", 5)
     k = attrs.get("k", 2.0)
     alpha = attrs.get("alpha", 1e-4)
     beta = attrs.get("beta", 0.75)
+    ch_axis = 3 if attrs.get("data_format", "NCHW") == "NHWC" else 1
+    nch = x.shape[ch_axis]
     sq = jnp.square(x)
     half = n // 2
-    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
-    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    pad_widths = [(0, 0)] * x.ndim
+    pad_widths[ch_axis] = (half, half)
+    pad = jnp.pad(sq, pad_widths)
+    acc = sum(jax.lax.slice_in_dim(pad, i, i + nch, axis=ch_axis)
+              for i in range(n))
     mid = k + alpha * acc
     return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
 

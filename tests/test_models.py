@@ -1,0 +1,77 @@
+"""Model zoo smoke tests: build, run forward, and one training step.
+
+Mirrors the reference's model-level integration strategy (SURVEY.md §4.4):
+book-style tests that a model builds and its loss decreases.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def _run_forward(build_fn, img_shape, num_classes=10, batch=2):
+    images = layers.data("images", shape=list(img_shape))
+    logits = build_fn(images)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    x = np.random.rand(batch, *img_shape).astype("float32")
+    out, = exe.run(feed={"images": x}, fetch_list=[logits])
+    assert out.shape == (batch, num_classes)
+    return out
+
+
+def test_lenet5_forward():
+    _run_forward(lambda im: models.lenet5(im), (28, 28, 1))
+
+
+def test_smallnet_forward():
+    _run_forward(lambda im: models.smallnet_mnist_cifar(im), (32, 32, 3))
+
+
+def test_resnet_cifar_forward():
+    _run_forward(lambda im: models.resnet_cifar10(im, depth=8), (32, 32, 3))
+
+
+def test_alexnet_forward():
+    _run_forward(lambda im: models.alexnet(im, num_classes=10), (224, 224, 3),
+                 batch=1)
+
+
+def test_vgg16_forward():
+    # 64x64 keeps CPU compile+run time reasonable; spatial dims stay valid.
+    _run_forward(lambda im: models.vgg(im, num_classes=10, depth=16),
+                 (64, 64, 3), batch=1)
+
+
+def test_googlenet_forward():
+    _run_forward(lambda im: models.googlenet(im, num_classes=10),
+                 (224, 224, 3), batch=1)
+
+
+def test_resnet50_imagenet_forward():
+    _run_forward(lambda im: models.resnet_imagenet(im, num_classes=10,
+                                                   depth=50),
+                 (64, 64, 3), batch=1)
+
+
+def test_lenet5_trains():
+    """One SGD step on LeNet must run and reduce loss over a few steps."""
+    images = layers.data("images", shape=[28, 28, 1])
+    label = layers.data("label", shape=[1], dtype="int64")
+    logits = models.lenet5(images)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    opt = pt.optimizer.SGDOptimizer(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 28, 28, 1).astype("float32")
+    y = rng.randint(0, 10, size=(16, 1)).astype("int64")
+    losses = []
+    for _ in range(5):
+        out, = exe.run(feed={"images": x, "label": y}, fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < losses[0]
