@@ -9,12 +9,15 @@ jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
 instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
-from . import initializer, layers, models, nets, optimizer, regularizer
+from . import (event, initializer, layers, models, nets, optimizer, parallel,
+               regularizer, trainer)
+from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,
                    program_guard)
 from .core.backward import append_backward
 from .param_attr import ParamAttr
+from .ops.common import amp_enabled, set_amp, set_mxu_precision
 
 # ops must be imported so kernels register before any program runs
 from . import ops as _ops  # noqa: F401

@@ -66,17 +66,23 @@ def _check_nan_inf(name: str, value) -> None:
 class _Compiled:
     """A compiled (program-block, signature) -> jitted callable record."""
 
-    __slots__ = ("fn", "feed_names", "ro_state_names", "rw_state_names",
-                 "out_state_names", "uses_rng")
+    __slots__ = ("fn", "raw_fn", "feed_names", "ro_state_names",
+                 "rw_state_names", "out_state_names", "uses_rng",
+                 "feed_shardings", "ro_shardings", "rw_shardings")
 
-    def __init__(self, fn, feed_names, ro_state_names, rw_state_names,
-                 out_state_names, uses_rng):
+    def __init__(self, fn, raw_fn, feed_names, ro_state_names, rw_state_names,
+                 out_state_names, uses_rng, feed_shardings=None,
+                 ro_shardings=None, rw_shardings=None):
         self.fn = fn
+        self.raw_fn = raw_fn
         self.feed_names = feed_names
         self.ro_state_names = ro_state_names
         self.rw_state_names = rw_state_names
         self.out_state_names = out_state_names
         self.uses_rng = uses_rng
+        self.feed_shardings = feed_shardings
+        self.ro_shardings = ro_shardings
+        self.rw_shardings = rw_shardings
 
 
 class Executor:
@@ -87,9 +93,22 @@ class Executor:
     state are scanned for non-finite values on the host.
     """
 
-    def __init__(self, place: Optional[TPUPlace] = None, check_nan_inf: bool = False):
+    def __init__(self, place: Optional[TPUPlace] = None,
+                 check_nan_inf: bool = False, mesh=None, plan=None):
+        """``mesh``/``plan`` enable SPMD execution: the whole block is jitted
+        with jax.sharding annotations from the parallel.ShardingPlan and XLA
+        GSPMD inserts the collectives — the in-graph replacement for the
+        reference's pserver / NCCL / MultiGradientMachine paths (SURVEY.md
+        §5.8). With a mesh and no plan, a pure data-parallel plan is used.
+        """
         self.place = place or TPUPlace(0)
         self.check_nan_inf = check_nan_inf
+        self.mesh = mesh
+        if mesh is not None and plan is None:
+            from ..parallel import data_parallel_plan
+            plan = data_parallel_plan(
+                mesh, data_axis=mesh.axis_names[0])
+        self.plan = plan
         self._cache: Dict[Tuple, _Compiled] = {}
 
     # ------------------------------------------------------------------
@@ -109,19 +128,9 @@ class Executor:
         fetch_names = [f.name if hasattr(f, "name") else str(f) for f in fetch_list]
         block = program.global_block
 
-        # Normalise feeds to device-dtype arrays.
-        feed_vals = {}
-        for name, value in feed.items():
-            dtype = block.var(name).dtype if block.has_var(name) else None
-            arr = np.asarray(value, dtype=dtype)
-            feed_vals[name] = arr
+        feed_vals = self._normalize_feeds(block, feed)
 
-        feed_sig = tuple(sorted((n, v.shape, str(v.dtype)) for n, v in feed_vals.items()))
-        # The data-flow classification depends on which names exist in the
-        # scope (state inputs), so the set of scope keys is part of the key.
-        scope_keys = frozenset(self._all_scope_keys(scope))
-        key = (id(program), program.version, feed_sig, tuple(fetch_names),
-               id(scope), scope_keys)
+        key = self._cache_key(program, feed_vals, fetch_names, scope)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._compile(program, feed_vals, fetch_names, scope)
@@ -130,6 +139,16 @@ class Executor:
         feed_args = [feed_vals[n] for n in compiled.feed_names]
         ro_args = [scope.get(n) for n in compiled.ro_state_names]
         rw_args = [scope.get(n) for n in compiled.rw_state_names]
+        if self.mesh is not None:
+            # device_put is a no-op when the array already has the target
+            # sharding; otherwise it reshards (e.g. state initialised by a
+            # single-device startup run).
+            feed_args = [jax.device_put(a, s)
+                         for a, s in zip(feed_args, compiled.feed_shardings)]
+            ro_args = [jax.device_put(a, s)
+                       for a, s in zip(ro_args, compiled.ro_shardings)]
+            rw_args = [jax.device_put(a, s)
+                       for a, s in zip(rw_args, compiled.rw_shardings)]
         if compiled.uses_rng:
             rng = self._rng_state(program, scope)
             fetches, new_states, new_rng = compiled.fn(feed_args, ro_args, rw_args, rng)
@@ -149,6 +168,64 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def as_function(self, program: Program, feed: Dict[str, Any],
+                    fetch_list: Sequence, scope: Optional[Scope] = None):
+        """Export a program block as a pure jittable function.
+
+        Returns ``(fn, example_args)`` where ``fn(feed_args, ro_state,
+        rw_state[, rng])`` is the untraced closure over the block (suitable
+        for jax.jit / embedding in larger JAX programs) and ``example_args``
+        are concrete arrays drawn from ``feed`` and the scope.
+        """
+        scope = scope or global_scope()
+        feed_vals = self._normalize_feeds(program.global_block, feed)
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in fetch_list]
+        key = self._cache_key(program, feed_vals, fetch_names, scope)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, feed_vals, fetch_names, scope)
+            self._cache[key] = compiled
+        args = (
+            [feed_vals[n] for n in compiled.feed_names],
+            [scope.get(n) for n in compiled.ro_state_names],
+            [scope.get(n) for n in compiled.rw_state_names],
+        )
+        if compiled.uses_rng:
+            args = args + (self._rng_state(program, scope),)
+        return compiled.raw_fn, args
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize_feeds(block, feed: Dict[str, Any]) -> Dict[str, Any]:
+        """Normalise feeds to device-dtype arrays. Feeds that are already
+        device-resident jax.Arrays of the right dtype pass through without a
+        host round-trip (on-device input pipelines depend on this)."""
+        feed_vals = {}
+        for name, value in feed.items():
+            dtype = block.var(name).dtype if block.has_var(name) else None
+            if isinstance(value, jax.Array) and (
+                    dtype is None or value.dtype == dtype):
+                feed_vals[name] = value
+            else:
+                feed_vals[name] = np.asarray(value, dtype=dtype)
+        return feed_vals
+
+    def _cache_key(self, program: Program, feed_vals, fetch_names,
+                   scope: Scope) -> Tuple:
+        from ..ops import common as ops_common
+
+        feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
+                                for n, v in feed_vals.items()))
+        # The data-flow classification depends on which names exist in the
+        # scope (state inputs), so the set of scope keys is part of the key —
+        # as are the global dtype policies (AMP / MXU precision) and the
+        # mesh/plan, all of which change the traced computation.
+        scope_keys = frozenset(self._all_scope_keys(scope))
+        return (id(program), program.version, feed_sig, tuple(fetch_names),
+                id(scope), scope_keys, ops_common.amp_enabled(),
+                ops_common.mxu_precision(), id(self.mesh), id(self.plan))
+
     @staticmethod
     def _all_scope_keys(scope: Scope):
         s = scope
@@ -247,13 +324,34 @@ class Executor:
                 return fetches, new_states
             return fetches, new_states, rng
 
-        jitted = jax.jit(run_traced, donate_argnums=(2,))
+        feed_sh = ro_sh = rw_sh = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _nd(name):
+                v = block.var(name) if block.has_var(name) else None
+                if v is not None and v.shape is not None:
+                    return len(v.shape)
+                val = scope.get(name) if scope.has(name) else feed_vals.get(name)
+                return np.ndim(val)
+
+            feed_sh = [self.plan.feed_sharding(n, _nd(n)) for n in feed_names]
+            ro_sh = [self.plan.state_sharding(n, _nd(n)) for n in ro_state]
+            rw_sh = [self.plan.state_sharding(n, _nd(n)) for n in rw_state]
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            in_shardings = (feed_sh, ro_sh, rw_sh)
+            if uses_rng:
+                in_shardings = in_shardings + (replicated,)
+            jitted = jax.jit(run_traced, donate_argnums=(2,),
+                             in_shardings=in_shardings)
+        else:
+            jitted = jax.jit(run_traced, donate_argnums=(2,))
         logger.debug(
             "compiled block: %d ops, %d feeds, %d state vars, %d outputs",
             len(ops), len(feed_names), len(state_names), len(fetch_names),
         )
-        return _Compiled(jitted, feed_names, ro_state, rw_state, written_persist,
-                         uses_rng)
+        return _Compiled(jitted, run_traced, feed_names, ro_state, rw_state,
+                         written_persist, uses_rng, feed_sh, ro_sh, rw_sh)
 
     def close(self):
         self._cache.clear()

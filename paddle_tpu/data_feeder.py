@@ -1,0 +1,68 @@
+"""DataFeeder: reader minibatches -> executor feed dicts.
+
+Parity with /root/reference/python/paddle/v2/data_feeder.py and the SWIG
+DataProviderConverter (/root/reference/paddle/py_paddle/
+dataprovider_converter.py): a reader yields rows (tuples ordered like
+``feed_order``); the feeder stacks each column into a dense device-ready
+array of the declared dtype/shape.
+
+Variable-length (LoD) columns — rows whose entries are sequences of
+differing length — are padded to the batch max and returned together with a
+``<name>@len`` int32 length vector, the dense+mask TPU replacement for the
+reference's sequenceStartPositions (SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.program import Variable
+
+
+def _is_ragged(col) -> bool:
+    try:
+        first = np.asarray(col[0])
+    except Exception:
+        return True
+    if first.ndim == 0:
+        return False
+    lengths = set()
+    for item in col:
+        arr = np.asarray(item)
+        lengths.add(arr.shape[0] if arr.ndim else 0)
+        if len(lengths) > 1:
+            return True
+    return False
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[Variable], place=None):
+        self.feed_vars = list(feed_list)
+        self.place = place
+
+    def feed(self, data: Sequence[Sequence]) -> Dict[str, np.ndarray]:
+        """Convert a minibatch (list of rows) into {name: array} feeds."""
+        out: Dict[str, np.ndarray] = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [row[i] for row in data]
+            dtype = var.dtype
+            if var.lod_level > 0 or _is_ragged(col):
+                out.update(self._pad_sequences(var, col))
+            else:
+                arr = np.asarray(col, dtype=dtype)
+                shape = tuple(d for d in (var.shape or ()) if d != -1)
+                if shape and arr.shape[1:] != shape and arr.size == len(col) * int(np.prod(shape)):
+                    arr = arr.reshape((len(col),) + shape)
+                out[var.name] = arr
+        return out
+
+    def _pad_sequences(self, var, col) -> Dict[str, np.ndarray]:
+        seqs = [np.asarray(item, dtype=var.dtype) for item in col]
+        lengths = np.asarray([s.shape[0] for s in seqs], dtype=np.int32)
+        max_len = int(lengths.max()) if len(lengths) else 0
+        tail = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
+        padded = np.zeros((len(seqs), max_len) + tail, dtype=var.dtype)
+        for i, s in enumerate(seqs):
+            padded[i, : s.shape[0]] = s
+        return {var.name: padded, f"{var.name}@len": lengths}

@@ -1,0 +1,43 @@
+"""Training events, parity with /root/reference/python/paddle/v2/event.py:13.
+
+The v2 trainer drives an event_handler callback with these marker objects so
+user scripts can log, test, checkpoint, or plot mid-training without touching
+the train loop.
+"""
+
+
+class WithMetric:
+    def __init__(self, metrics):
+        # metrics: dict name -> float (evaluator results for the span)
+        self.metrics = dict(metrics or {})
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        super().__init__(metrics)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+
+
+class TestResult(WithMetric):
+    def __init__(self, cost, metrics=None):
+        super().__init__(metrics)
+        self.cost = cost

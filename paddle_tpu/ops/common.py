@@ -4,6 +4,67 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+# ---------------------------------------------------------------------------
+# MXU precision policy for matmul/conv ops.
+#
+# None (the default) lets XLA use the MXU fast path: bf16 multiplies with f32
+# accumulation — the TPU-native training tradeoff. "highest" forces multi-pass
+# f32-exact contraction (~6x slower on the MXU); the checkgrad job and
+# tight-tolerance numeric tests switch to it, mirroring the reference's
+# --job=checkgrad mode (/root/reference/paddle/trainer/TrainerMain.cpp:54).
+# ---------------------------------------------------------------------------
+_MXU_PRECISION = None
+
+
+def set_mxu_precision(p):
+    """Set contraction precision globally: None/'default' | 'high' | 'highest'."""
+    global _MXU_PRECISION
+    import jax
+
+    table = {
+        None: None, "default": None,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST,
+    }
+    _MXU_PRECISION = table[p]
+
+
+def mxu_precision(*_arrays):
+    return _MXU_PRECISION
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision (AMP) policy: bf16 compute with f32 master weights.
+#
+# When enabled, matmul/conv kernels cast f32 operands to bf16 on entry and
+# emit bf16 activations, halving HBM traffic and using the MXU's native input
+# width; accumulation stays f32 (preferred_element_type) and parameters in
+# the scope stay f32 — gradients flow back through the casts and arrive f32
+# at the optimizer ops (master-weight training). Loss/normalisation ops
+# compute their reductions in f32. The reference's float16 support
+# (/root/reference/paddle/math/float16.h) never reached its training path;
+# on TPU bf16 is the idiomatic default for the hot ops.
+# ---------------------------------------------------------------------------
+_AMP = False
+
+
+def set_amp(enabled: bool):
+    global _AMP
+    _AMP = bool(enabled)
+
+
+def amp_enabled() -> bool:
+    return _AMP
+
+
+def amp_cast(*arrays):
+    """Under AMP, cast f32 arrays to bf16 (others pass through)."""
+    if not _AMP:
+        return arrays if len(arrays) > 1 else arrays[0]
+    cast = tuple(a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
+                 for a in arrays)
+    return cast if len(cast) > 1 else cast[0]
+
 
 def single(ins, slot):
     """Fetch the single array bound to ``slot`` (errors if absent)."""

@@ -52,7 +52,8 @@ def _softmax_with_ce_grad(attrs, ins, outs, ogs):
 def softmax_with_cross_entropy(attrs, ins):
     logits = single(ins, "Logits")
     label = single(ins, "Label")
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # Loss reductions always run in f32 (stable under bf16 AMP activations).
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:

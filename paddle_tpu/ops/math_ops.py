@@ -23,13 +23,8 @@ def _flatten2d(x, num_col_dims):
     return x.reshape(lead, -1)
 
 
-def _precision(*arrays):
-    """f32 inputs use exact f32 accumulation; bf16/f16 ride the MXU fast path."""
-    import jax
-
-    if all(a.dtype == jnp.float32 for a in arrays):
-        return jax.lax.Precision.HIGHEST
-    return None
+from .common import amp_cast
+from .common import mxu_precision as _precision
 
 
 @register_op("mul")
@@ -41,6 +36,7 @@ def mul(attrs, ins):
     yd = attrs.get("y_num_col_dims", 1)
     x2 = _flatten2d(x, xd)
     y2 = y.reshape(int(np.prod(y.shape[:yd])), -1)
+    x2, y2 = amp_cast(x2, y2)
     res = jnp.dot(x2, y2, precision=_precision(x2, y2))
     out_shape = x.shape[:xd] + y.shape[yd:]
     return out(Out=res.reshape(out_shape))
@@ -54,6 +50,7 @@ def matmul(attrs, ins):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if attrs.get("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    x, y = amp_cast(x, y)
     res = jnp.matmul(x, y, precision=_precision(x, y))
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:

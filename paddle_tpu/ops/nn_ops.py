@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
+from . import common
 from .common import maybe, normalize_pair, out, single
 
 
@@ -30,6 +31,7 @@ def _conv_dn(fmt: str):
 def conv2d(attrs, ins):
     x = single(ins, "Input")
     w = single(ins, "Filter")
+    x, w = common.amp_cast(x, w)
     fmt = attrs.get("data_format", "NCHW")
     strides = normalize_pair(attrs.get("strides", [1, 1]))
     pads = normalize_pair(attrs.get("paddings", [0, 0]))
@@ -43,8 +45,10 @@ def conv2d(attrs, ins):
         rhs_dilation=dilations,
         dimension_numbers=_conv_dn(fmt),
         feature_group_count=groups,
-        precision=(jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        precision=common.mxu_precision(),
+        # No preferred_element_type: the MXU accumulates bf16 products in f32
+        # internally either way, and a widened output dtype breaks the
+        # transpose(conv) dtype match under jax.vjp.
     )
     return out(Output=y.astype(x.dtype))
 

@@ -1,0 +1,24 @@
+"""Distributed/parallel execution: meshes, sharding plans, SPMD helpers.
+
+This package replaces the reference's entire distributed plane — the
+multi-threaded ring gather/scatter of MultiGradientMachine
+(/root/reference/paddle/gserver/gradientmachines/MultiGradientMachine.h:43-105),
+the C++ parameter server (/root/reference/paddle/pserver/ParameterServer2.h:73),
+the Go pserver/master control plane (/root/reference/go/pserver/service.go:134),
+the Fluid gRPC send/recv ops (/root/reference/paddle/operators/send_op.cc:30)
+and the NCCL ops (/root/reference/paddle/operators/nccl_op.cc:68) — with
+in-graph XLA collectives over ICI/DCN, driven by jax.sharding annotations.
+
+The user picks a Mesh and a ShardingPlan; the executor jits the whole program
+block with those shardings and XLA GSPMD inserts all-reduce / all-gather /
+reduce-scatter where the data flow demands them. There is no parameter-server
+process, no gradient RPC, and no explicit communication op in user programs.
+"""
+from .mesh import make_mesh, mesh_axis_size
+from .plan import (ShardingPlan, data_parallel_plan, megatron_plan,
+                   zero_plan)
+
+__all__ = [
+    "make_mesh", "mesh_axis_size",
+    "ShardingPlan", "data_parallel_plan", "megatron_plan", "zero_plan",
+]

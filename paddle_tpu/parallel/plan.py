@@ -1,0 +1,127 @@
+"""Sharding plans: declarative variable-name -> PartitionSpec mapping.
+
+The reference distributes parameters by slicing them into blocks and
+round-robining blocks across parameter servers
+(/root/reference/paddle/pserver/ParameterServer2.h:94-100) or by name-hash
+(/root/reference/go/pserver/client/client.go partition), and distributes data
+by splitting the batch across trainer threads
+(/root/reference/paddle/gserver/gradientmachines/MultiGradientMachine.h:43-105).
+Here both are the same mechanism: a PartitionSpec per variable over a named
+mesh. XLA GSPMD propagates the specs through the whole-block computation and
+inserts the collectives (psum for data-parallel grad reduction, all-gather /
+reduce-scatter for tensor-parallel layers) in-graph.
+
+Optimizer accumulators (named ``<param>_<kind>_acc``) automatically inherit
+their parameter's spec because rules match on name substrings — the analogue
+of the pserver keeping momentum state sharded exactly like its parameter
+blocks (ParameterServer2.h:57-72).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SpecLike = Union[P, Callable[[str, int], P]]
+
+
+class ShardingPlan:
+    """Ordered rule list mapping variable names to PartitionSpecs.
+
+    rules: sequence of (regex, spec) — first match wins. ``spec`` is either a
+    PartitionSpec (applied only if its rank fits the variable's ndim) or a
+    callable (name, ndim) -> PartitionSpec.
+    data_axis: mesh axis the leading (batch) dim of feed variables shards on.
+    """
+
+    def __init__(self, mesh: Mesh,
+                 rules: Optional[Sequence[Tuple[str, SpecLike]]] = None,
+                 data_axis: Optional[str] = "dp",
+                 default: P = P()):
+        self.mesh = mesh
+        self.rules: List[Tuple[re.Pattern, SpecLike]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])
+        ]
+        self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.default = default
+
+    # ------------------------------------------------------------------
+    def spec_for_state(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if callable(spec):
+                    return spec(name, ndim)
+                if len(spec) <= ndim:
+                    return spec
+        return self.default
+
+    def spec_for_feed(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec(name, ndim) if callable(spec) else spec
+        if self.data_axis is None or ndim == 0:
+            return P()
+        return P(self.data_axis, *([None] * (ndim - 1)))
+
+    def state_sharding(self, name: str, ndim: int) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec_for_state(name, ndim))
+
+    def feed_sharding(self, name: str, ndim: int) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec_for_feed(name, ndim))
+
+
+# ----------------------------------------------------------------------
+# Canned plans
+# ----------------------------------------------------------------------
+
+def data_parallel_plan(mesh: Mesh, data_axis: str = "dp") -> ShardingPlan:
+    """Pure data parallelism: batch sharded, every parameter replicated.
+
+    The in-graph analogue of MultiGradientMachine / the sync pserver path:
+    GSPMD turns the grad contractions into psum over ``data_axis``.
+    """
+    return ShardingPlan(mesh, rules=[], data_axis=data_axis)
+
+
+def megatron_plan(mesh: Mesh, data_axis: str = "dp",
+                  model_axis: str = "mp") -> ShardingPlan:
+    """Hybrid data + tensor parallelism (Megatron-style).
+
+    FC weights (in, out) and conv kernels (kh, kw, cin, cout) shard their
+    output dim over ``model_axis``; matching biases shard too. GSPMD inserts
+    the all-reduce where a following layer contracts over the sharded dim.
+    """
+    def fc_w(name: str, ndim: int) -> P:
+        if ndim >= 2:
+            return P(*([None] * (ndim - 1)), model_axis)
+        return P(model_axis)
+
+    return ShardingPlan(
+        mesh,
+        rules=[
+            (r"\.w", fc_w),      # fc/conv weights + their optimizer accs
+            (r"\.b", P(model_axis)),
+        ],
+        data_axis=data_axis,
+    )
+
+
+def zero_plan(mesh: Mesh, data_axis: str = "dp") -> ShardingPlan:
+    """ZeRO-style: optimizer accumulators sharded over the data axis.
+
+    The TPU answer to the pserver owning optimizer state in shards
+    (/root/reference/go/pserver/optimizer.go:51): accumulator tensors shard
+    their leading dim across data-parallel workers; parameters stay
+    replicated for the forward pass.
+    """
+    def acc_spec(name: str, ndim: int) -> P:
+        if ndim >= 1:
+            return P(data_axis, *([None] * (ndim - 1)))
+        return P()
+
+    return ShardingPlan(
+        mesh,
+        rules=[(r"_acc$", acc_spec)],
+        data_axis=data_axis,
+    )

@@ -1,0 +1,129 @@
+"""Event-driven training loop, parity with the v2 SGD trainer
+(/root/reference/python/paddle/v2/trainer.py:24,124-202) on top of the
+whole-block XLA executor.
+
+Differences from the reference, all TPU-motivated:
+- No parameter/updater objects: the optimizer appends its update ops into
+  the program (fluid-style) and the whole step — forward, backward,
+  update — is one compiled XLA computation per batch signature.
+- Distribution is an argument (mesh + ShardingPlan), not a different
+  updater class: the same loop runs single-chip or SPMD over a slice.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from . import event as evt
+from . import io as io_mod
+from .core.executor import Executor, TPUPlace
+from .core.program import (Program, Variable, default_main_program,
+                           default_startup_program)
+from .core.scope import Scope, global_scope
+from .data_feeder import DataFeeder
+
+
+class SGD:
+    """``SGD(cost, optimizer, feed_list).train(reader, ...)``.
+
+    ``metrics`` maps display names to program variables (e.g. the output of
+    layers.accuracy) fetched and averaged alongside the cost — the analogue
+    of the reference's in-loop Evaluators (TrainerInternal.cpp:140-153).
+    """
+
+    def __init__(self, cost: Variable, optimizer, feed_list: Sequence[Variable],
+                 place: Optional[TPUPlace] = None, mesh=None, plan=None,
+                 metrics: Optional[Dict[str, Variable]] = None,
+                 scope: Optional[Scope] = None, check_nan_inf: bool = False):
+        self.cost = cost
+        self.metrics = dict(metrics or {})
+        self.main_program: Program = cost.block.program
+        self.startup_program = default_startup_program()
+        # Inference/test clone is taken BEFORE optimizer ops are appended, the
+        # equivalent of fluid's Program.clone(for_test=True).
+        self.test_program = self.main_program.clone()
+        optimizer.minimize(cost, startup_program=self.startup_program)
+        self.feeder = DataFeeder(feed_list)
+        self.scope = scope or global_scope()
+        self.exe = Executor(place or TPUPlace(0), check_nan_inf=check_nan_inf,
+                            mesh=mesh, plan=plan)
+        self._initialized = False
+
+    # ------------------------------------------------------------------
+    def _init_params(self):
+        if not self._initialized:
+            self.exe.run(self.startup_program, scope=self.scope)
+            self._initialized = True
+
+    def _fetch_list(self):
+        return [self.cost] + list(self.metrics.values())
+
+    def _split(self, fetched):
+        cost = float(np.asarray(fetched[0]))
+        names = list(self.metrics.keys())
+        vals = {n: float(np.mean(np.asarray(v)))
+                for n, v in zip(names, fetched[1:])}
+        return cost, vals
+
+    # ------------------------------------------------------------------
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              test_reader: Optional[Callable] = None):
+        """Run ``num_passes`` over ``reader`` (a batched reader: yields
+        minibatches of rows ordered like ``feed_list``)."""
+        event_handler = event_handler or (lambda e: None)
+        self._init_params()
+        for pass_id in range(num_passes):
+            event_handler(evt.BeginPass(pass_id))
+            pass_costs, pass_metrics = [], []
+            for batch_id, batch in enumerate(reader()):
+                event_handler(evt.BeginIteration(pass_id, batch_id))
+                feed = self.feeder.feed(batch)
+                fetched = self.exe.run(self.main_program, feed=feed,
+                                       fetch_list=self._fetch_list(),
+                                       scope=self.scope)
+                cost, mvals = self._split(fetched)
+                pass_costs.append(cost)
+                pass_metrics.append(mvals)
+                event_handler(evt.EndIteration(pass_id, batch_id, cost, mvals))
+            summary = _mean_metrics(pass_metrics)
+            summary["cost"] = float(np.mean(pass_costs)) if pass_costs else 0.0
+            if test_reader is not None:
+                result = self.test(test_reader)
+                event_handler(evt.EndPass(pass_id, metrics=summary))
+                event_handler(result)
+            else:
+                event_handler(evt.EndPass(pass_id, metrics=summary))
+
+    def test(self, reader: Callable) -> "evt.TestResult":
+        self._init_params()
+        costs, metrics = [], []
+        for batch in reader():
+            feed = self.feeder.feed(batch)
+            fetched = self.exe.run(self.test_program, feed=feed,
+                                   fetch_list=self._fetch_list(),
+                                   scope=self.scope)
+            cost, mvals = self._split(fetched)
+            costs.append(cost)
+            metrics.append(mvals)
+        return evt.TestResult(float(np.mean(costs)) if costs else 0.0,
+                              _mean_metrics(metrics))
+
+    # ------------------------------------------------------------------
+    def save_params(self, dirname: str):
+        io_mod.save_params(self.exe, dirname, self.main_program,
+                           scope=self.scope)
+
+    def load_params(self, dirname: str):
+        self._init_params()
+        io_mod.load_params(self.exe, dirname, self.main_program,
+                           scope=self.scope)
+
+
+def _mean_metrics(per_batch):
+    out: Dict[str, float] = {}
+    if per_batch:
+        for key in per_batch[0]:
+            out[key] = float(np.mean([m[key] for m in per_batch]))
+    return out

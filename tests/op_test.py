@@ -81,6 +81,15 @@ class OpTest:
 
     # ------------------------------------------------------------------
     def check_output(self, atol=1e-5, rtol=1e-5):
+        # Tight-tolerance comparisons force exact f32 contraction — the
+        # checkgrad dtype policy (on TPU the default is the bf16 MXU path).
+        pt.set_mxu_precision("highest")
+        try:
+            self._check_output(atol, rtol)
+        finally:
+            pt.set_mxu_precision(None)
+
+    def _check_output(self, atol, rtol):
         main, startup, feed, _, out_vars = self._build()
         exe = pt.Executor(pt.CPUPlace())
         expect = self._norm_io(self.outputs)
@@ -97,6 +106,15 @@ class OpTest:
     def check_grad(self, inputs_to_check: List[str], output_name: str,
                    max_relative_error=0.005, delta=5e-3):
         """Compare program-built gradients to central finite differences."""
+        pt.set_mxu_precision("highest")
+        try:
+            self._check_grad(inputs_to_check, output_name,
+                             max_relative_error, delta)
+        finally:
+            pt.set_mxu_precision(None)
+
+    def _check_grad(self, inputs_to_check: List[str], output_name: str,
+                    max_relative_error, delta):
         main, startup, feed, in_vars, out_vars = self._build()
         with pt.program_guard(main, startup):
             # scalar target: mean(square(out)) — non-linear so linear ops and

@@ -1,0 +1,81 @@
+"""v2-style trainer loop tests (reader -> events -> metrics).
+
+Mirrors the reference's api_train pattern
+(/root/reference/v1_api_demo/mnist/api_train.py) and v2 trainer tests.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import event, layers, reader as reader_mod
+from paddle_tpu.trainer import SGD
+
+
+def _toy_reader(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(n, 8).astype("float32")
+    w = rng.rand(8, 3)
+    ys = np.argmax(xs @ w, axis=1).astype("int64")
+
+    def r():
+        for i in range(n):
+            yield xs[i], ys[i : i + 1]
+    return r
+
+
+def test_trainer_mnist_style_loop():
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    logits = layers.fc(x, size=3)
+    cost = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    acc = layers.accuracy(logits, y)
+
+    events = []
+    trainer = SGD(cost=cost,
+                  optimizer=pt.optimizer.SGDOptimizer(learning_rate=0.5),
+                  feed_list=[x, y], place=pt.CPUPlace(),
+                  metrics={"acc": acc})
+    batched = reader_mod.batch(_toy_reader(), batch_size=16)
+    trainer.train(batched, num_passes=4, event_handler=events.append,
+                  test_reader=reader_mod.batch(_toy_reader(seed=1), 16))
+
+    end_passes = [e for e in events if isinstance(e, event.EndPass)]
+    iters = [e for e in events if isinstance(e, event.EndIteration)]
+    tests = [e for e in events if isinstance(e, event.TestResult)]
+    assert len(end_passes) == 4 and len(iters) == 16 and len(tests) == 4
+    assert end_passes[-1].metrics["cost"] < end_passes[0].metrics["cost"]
+    assert end_passes[-1].metrics["acc"] >= end_passes[0].metrics["acc"] - 0.05
+    assert 0.0 <= iters[0].metrics["acc"] <= 1.0
+
+
+def test_trainer_test_program_isolated_from_optimizer():
+    """test() must not run optimizer ops (params unchanged)."""
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    logits = layers.fc(x, size=3)
+    cost = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    trainer = SGD(cost=cost,
+                  optimizer=pt.optimizer.SGDOptimizer(learning_rate=0.5),
+                  feed_list=[x, y], place=pt.CPUPlace())
+    trainer._init_params()
+    pname = pt.default_main_program().all_parameters()[0].name
+    before = np.asarray(trainer.scope.get(pname)).copy()
+    trainer.test(reader_mod.batch(_toy_reader(), 16))
+    after = np.asarray(trainer.scope.get(pname))
+    np.testing.assert_array_equal(before, after)
+
+
+def test_trainer_save_load_params(tmp_path):
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    cost = layers.mean(layers.square_error_cost(layers.fc(x, size=1),
+                                                layers.cast(y, "float32")))
+    trainer = SGD(cost=cost,
+                  optimizer=pt.optimizer.SGDOptimizer(learning_rate=0.1),
+                  feed_list=[x, y], place=pt.CPUPlace())
+    trainer.train(reader_mod.batch(_toy_reader(), 16), num_passes=1)
+    pname = pt.default_main_program().all_parameters()[0].name
+    trained = np.asarray(trainer.scope.get(pname)).copy()
+    trainer.save_params(str(tmp_path))
+    trainer.scope.set(pname, np.zeros_like(trained))
+    trainer.load_params(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(trainer.scope.get(pname)), trained)
