@@ -5,6 +5,11 @@ from .nn import (accuracy, batch_norm, conv2d, cross_entropy, dropout,
                  softmax_with_cross_entropy, topk)
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
 from .ops import __all__ as _ops_all
+from .sequence import (dynamic_gru, dynamic_lstm, gru_unit, lstm_unit,
+                       row_conv, sequence_concat, sequence_conv,
+                       sequence_expand, sequence_first_step,
+                       sequence_last_step, sequence_pool, sequence_reverse,
+                       sequence_softmax)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
                      fill_constant, mean, one_hot, reshape, scale, split,
                      sums, transpose)
@@ -14,6 +19,10 @@ __all__ = (
      "dropout", "lrn", "cross_entropy", "softmax_with_cross_entropy",
      "square_error_cost", "accuracy", "topk",
      "fill_constant", "create_global_var", "cast", "concat", "sums", "assign",
-     "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax"]
+     "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax",
+     "sequence_pool", "sequence_first_step", "sequence_last_step",
+     "sequence_softmax", "sequence_expand", "sequence_reverse",
+     "sequence_conv", "sequence_concat", "row_conv",
+     "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit"]
     + list(_ops_all)
 )

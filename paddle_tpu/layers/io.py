@@ -15,6 +15,26 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     """
     helper = LayerHelper("data", main_program=main_program)
     shape = list(shape)
+    if lod_level > 0:
+        # Dense+mask sequence feed (SURVEY.md §5.7): the tensor is padded to
+        # [batch, T, *shape] and a companion int32 ``<name>@len`` [batch]
+        # carries true lengths — the feeder (data_feeder.py) emits both. The
+        # reference instead packs rows and threads LoD offsets
+        # (/root/reference/paddle/framework/lod_tensor.h:43-58).
+        import numpy as _np
+        is_ids = (len(shape) == 1 and shape[0] == 1
+                  and _np.issubdtype(_np.dtype(dtype), _np.integer))
+        shape = [-1, -1] + ([] if is_ids else shape)
+        var = helper.block.create_var(
+            name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+            stop_gradient=stop_gradient, is_data=True,
+        )
+        len_var = helper.block.create_var(
+            name=f"{name}@len", shape=[-1], dtype="int32",
+            stop_gradient=True, is_data=True,
+        )
+        var.seq_len = len_var
+        return var
     if append_batch_size:
         shape = [-1] + shape
     return helper.block.create_var(

@@ -135,7 +135,19 @@ class LayerHelper:
                   out_slot: str = "Out"):
         """Common case: one auto-created output variable in ``out_slot``."""
         outputs, _ = self.append_op(op_type, inputs, [out_slot], attrs)
-        return outputs[out_slot][0]
+        result = outputs[out_slot][0]
+        # Thread sequence lengths through shape-preserving ops (elementwise,
+        # activations, per-timestep fc): if any input carries a seq_len and
+        # the output keeps the [batch, time] leading dims, propagate it.
+        for vs in inputs.values():
+            for v in vs:
+                sl = getattr(v, "seq_len", None)
+                if (sl is not None and result.shape is not None
+                        and v.shape is not None
+                        and result.shape[:2] == v.shape[:2]):
+                    result.seq_len = sl
+                    return result
+        return result
 
     # -- activation sugar --------------------------------------------------
     def append_activation(self, var, act: Optional[str]):

@@ -39,7 +39,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     if bias_attr is False:
         pre_act = pre_bias
     else:
-        pre_act = helper.append_bias_op(pre_bias, bias_attr, size, dim_start=1)
+        pre_act = helper.append_bias_op(pre_bias, bias_attr, size,
+                                        dim_start=num_flatten_dims)
     return helper.append_activation(pre_act, act)
 
 

@@ -1,0 +1,240 @@
+"""Sequence & recurrent layer functions.
+
+Parity targets: the reference's fluid sequence layers
+(/root/reference/python/paddle/v2/fluid/layers/nn.py: sequence_pool,
+sequence_conv, dynamic_lstm, dynamic_gru, sequence_expand, sequence_first/
+last_step) and the v1 helpers they wrap.
+
+Sequence-ness here is a build-time property: a Variable carries a
+``seq_len`` pointer to its companion int32 ``[batch]`` lengths Variable
+(created by ``layers.data(..., lod_level>0)`` — the dense+mask replacement
+for the reference's LoD, SURVEY.md §5.7). Layer functions thread it from
+inputs to outputs, so masked ops always see the right lengths without the
+user plumbing them by hand.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import XavierInitializer
+from .layer_helper import LayerHelper
+
+
+def get_seq_len(var):
+    """The lengths Variable travelling with ``var`` (or None)."""
+    return getattr(var, "seq_len", None)
+
+
+def _len_input(var):
+    sl = get_seq_len(var)
+    return {"Length": [sl]} if sl is not None else {}
+
+
+def sequence_pool(input, pool_type="average", main_program=None,
+                  startup_program=None):
+    helper = LayerHelper("sequence_pool", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op(
+        "sequence_pool", {"X": [input], **_len_input(input)},
+        {"pool_type": pool_type})
+
+
+def sequence_first_step(input, **kw):
+    return sequence_pool(input, "first", **kw)
+
+
+def sequence_last_step(input, **kw):
+    return sequence_pool(input, "last", **kw)
+
+
+def sequence_softmax(input, main_program=None, startup_program=None):
+    helper = LayerHelper("sequence_softmax", main_program=main_program,
+                         startup_program=startup_program)
+    y = helper.simple_op("sequence_softmax",
+                         {"X": [input], **_len_input(input)})
+    y.seq_len = get_seq_len(input)
+    return y
+
+
+def sequence_expand(x, y, main_program=None, startup_program=None):
+    """Broadcast each row of ``x`` across ``y``'s time axis (reference
+    sequence_expand with y's LoD)."""
+    helper = LayerHelper("sequence_expand", main_program=main_program,
+                         startup_program=startup_program)
+    o = helper.simple_op(
+        "sequence_expand", {"X": [x], "Y": [y], **_len_input(y)})
+    o.seq_len = get_seq_len(y)
+    return o
+
+
+def sequence_reverse(input, main_program=None, startup_program=None):
+    helper = LayerHelper("sequence_reverse", main_program=main_program,
+                         startup_program=startup_program)
+    outs, _ = helper.append_op(
+        "sequence_reverse", {"X": [input], **_len_input(input)}, ["Y"])
+    y = outs["Y"][0]
+    y.seq_len = get_seq_len(input)
+    return y
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  main_program=None, startup_program=None):
+    """Context-window conv over a sequence (reference nn.py sequence_conv)."""
+    if filter_stride != 1:
+        # The reference op enforces contextStride == 1 too
+        # (sequence_conv_op.cc PADDLE_ENFORCE).
+        raise ValueError("sequence_conv only supports filter_stride=1")
+    if padding is not None:
+        raise NotImplementedError(
+            "trainable context padding (PaddingData) is not supported; "
+            "out-of-range context rows are zero-padded")
+    helper = LayerHelper("sequence_conv", main_program=main_program,
+                         startup_program=startup_program)
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    filt = helper.create_parameter(
+        param_attr, shape=filter_shape, dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    pre_bias = helper.simple_op(
+        "sequence_conv",
+        {"X": [input], "Filter": [filt], **_len_input(input)},
+        {"contextLength": filter_size, "contextStart": -int(filter_size // 2),
+         "contextStride": filter_stride})
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, num_filters,
+                                    dim_start=2)
+    o = helper.append_activation(pre_act, act)
+    o.seq_len = get_seq_len(input)
+    return o
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             main_program=None, startup_program=None):
+    helper = LayerHelper("row_conv", main_program=main_program,
+                         startup_program=startup_program)
+    d = input.shape[-1]
+    filt = helper.create_parameter(
+        param_attr, shape=[future_context_size, d], dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    o = helper.simple_op(
+        "row_conv", {"X": [input], "Filter": [filt], **_len_input(input)})
+    o.seq_len = get_seq_len(input)
+    return helper.append_activation(o, act)
+
+
+def sequence_concat(inputs, main_program=None, startup_program=None):
+    helper = LayerHelper("sequence_concat", main_program=main_program,
+                         startup_program=startup_program)
+    lens = [get_seq_len(v) for v in inputs]
+    ins = {"X": list(inputs)}
+    if all(l is not None for l in lens):
+        ins["Length"] = lens
+    outs, _ = helper.append_op("sequence_concat", ins, ["Out", "OutLength"])
+    o = outs["Out"][0]
+    o.seq_len = outs["OutLength"][0]
+    return o
+
+
+def dynamic_lstm(input, size, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", param_attr=None, bias_attr=None,
+                 h0=None, c0=None, main_program=None, startup_program=None):
+    """LSTM over a pre-projected sequence (reference nn.py dynamic_lstm /
+    lstm_op.cc). ``input`` is [b, T, size] with size = 4*hidden; returns
+    (hidden_seq, cell_seq)."""
+    helper = LayerHelper("lstm", main_program=main_program,
+                         startup_program=startup_program)
+    hidden = size // 4
+    w = helper.create_parameter(
+        param_attr, shape=[hidden, 4 * hidden], dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    bias_cols = 7 * hidden if use_peepholes else 4 * hidden
+    bias = None if bias_attr is False else helper.create_parameter(
+        bias_attr, shape=[1, bias_cols], dtype=input.dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], **_len_input(input)}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if h0 is not None:
+        ins["H0"] = [h0]
+    if c0 is not None:
+        ins["C0"] = [c0]
+    outs, _ = helper.append_op(
+        "lstm", ins, ["Hidden", "Cell", "LastH", "LastC"],
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation})
+    h_seq, c_seq = outs["Hidden"][0], outs["Cell"][0]
+    h_seq.seq_len = get_seq_len(input)
+    c_seq.seq_len = get_seq_len(input)
+    return h_seq, c_seq
+
+
+def dynamic_gru(input, size, is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", param_attr=None, bias_attr=None,
+                h0=None, main_program=None, startup_program=None):
+    """GRU over a pre-projected sequence (reference gru_op.cc): ``input`` is
+    [b, T, 3*size], returns hidden sequence [b, T, size]."""
+    helper = LayerHelper("gru", main_program=main_program,
+                         startup_program=startup_program)
+    w = helper.create_parameter(
+        param_attr, shape=[size, 3 * size], dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    bias = None if bias_attr is False else helper.create_parameter(
+        bias_attr, shape=[1, 3 * size], dtype=input.dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], **_len_input(input)}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if h0 is not None:
+        ins["H0"] = [h0]
+    outs, _ = helper.append_op(
+        "gru", ins, ["Hidden", "LastH"],
+        {"is_reverse": is_reverse, "gate_activation": gate_activation,
+         "activation": candidate_activation})
+    h_seq = outs["Hidden"][0]
+    h_seq.seq_len = get_seq_len(input)
+    return h_seq
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, main_program=None,
+              startup_program=None):
+    """One LSTM step from raw inputs (reference nn.py lstm_unit): concat
+    [x, h] -> fc to 4h -> lstm_unit op. Returns (h, c)."""
+    from . import nn as nn_layers
+    from . import tensor as tensor_layers
+
+    helper = LayerHelper("lstm_unit", main_program=main_program,
+                         startup_program=startup_program)
+    size = cell_t_prev.shape[-1]
+    concat = tensor_layers.concat([x_t, hidden_t_prev], axis=-1)
+    gates = nn_layers.fc(concat, size=4 * size, param_attr=param_attr,
+                         bias_attr=bias_attr,
+                         main_program=helper.main_program,
+                         startup_program=helper.startup_program)
+    outs, _ = helper.append_op(
+        "lstm_unit", {"X": [gates], "C_prev": [cell_t_prev]},
+        ["C", "H"], {"forget_bias": forget_bias})
+    return outs["H"][0], outs["C"][0]
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             main_program=None, startup_program=None):
+    """One GRU step (reference nn.py gru_unit): ``input`` pre-projected
+    [b, 3*h]; returns (new_hidden, gates, reset_hidden_prev)."""
+    helper = LayerHelper("gru_unit", main_program=main_program,
+                         startup_program=startup_program)
+    hdim = size
+    w = helper.create_parameter(
+        param_attr, shape=[hdim, 3 * hdim], dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    bias = None if bias_attr is False else helper.create_parameter(
+        bias_attr, shape=[1, 3 * hdim], dtype=input.dtype, is_bias=True)
+    ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    outs, _ = helper.append_op(
+        "gru_unit", ins, ["Hidden", "Gate", "ResetHiddenPrev"],
+        {"activation": activation, "gate_activation": gate_activation})
+    return outs["Hidden"][0], outs["Gate"][0], outs["ResetHiddenPrev"][0]

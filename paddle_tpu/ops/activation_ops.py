@@ -131,13 +131,6 @@ def log_softmax(attrs, ins):
     return out(Out=jax.nn.log_softmax(single(ins, "X"), axis=attrs.get("axis", -1)))
 
 
-@register_op("sequence_softmax")
-def sequence_softmax(attrs, ins):
-    # Softmax over the last axis of a padded [batch, time] tensor with a mask
-    # handled at the layer level; kernel-level alias of softmax.
-    return out(Out=jax.nn.softmax(single(ins, "X"), axis=-1))
-
-
 @register_op("maxout")
 def maxout(attrs, ins):
     x = single(ins, "X")  # NCHW

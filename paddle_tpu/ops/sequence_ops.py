@@ -1,0 +1,246 @@
+"""Sequence ops over padded [batch, time, ...] tensors + per-row lengths.
+
+TPU-native replacement for the reference's LoD-walking sequence kernels
+(/root/reference/paddle/operators/sequence_pool_op.cc, sequence_softmax_op.cc,
+sequence_expand_op.cc, sequence_conv_op.cc + math/context_project.h,
+sequence_concat_op.cc, row_conv_op.cc, sequence_reshape_op.cc and the legacy
+hl_sequence.h kernels). The reference stores variable-length batches as
+concatenated rows delimited by LoD offsets (framework/lod_tensor.h:43-58) and
+walks them with per-sequence loops; XLA wants static shapes, so here every
+sequence tensor is dense-padded to the batch max length and carries an int32
+``Length`` companion ([batch]) — the SURVEY.md §5.7 dense+mask design. Masked
+reductions compile to single fused reduce ops on TPU instead of per-sequence
+scalar loops.
+
+Convention: data X is [batch, T, ...feature], Length is int32 [batch],
+positions t >= Length[b] are padding (contents arbitrary; ops ignore them and
+produce zeros there).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import maybe, out, single
+
+
+def time_mask(lengths, T, dtype=jnp.float32):
+    """[batch, T] mask: 1.0 where t < length, else 0."""
+    t = jnp.arange(T, dtype=lengths.dtype)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+def _expand_mask(mask, x):
+    """Broadcast a [b, T] mask over x's trailing feature dims."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_pool", optional_inputs=("Length",))
+def sequence_pool(attrs, ins):
+    x = single(ins, "X")  # [b, T, ...]
+    lengths = maybe(ins, "Length")
+    ptype = attrs.get("pool_type", "average").lower()
+    T = x.shape[1]
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), T, dtype=jnp.int32)
+    mask = time_mask(lengths, T, x.dtype)
+    m = _expand_mask(mask, x)
+    denom = jnp.maximum(lengths, 1).astype(x.dtype)
+    denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "sum":
+        y = jnp.sum(x * m, axis=1)
+    elif ptype == "average":
+        y = jnp.sum(x * m, axis=1) / denom
+    elif ptype == "sqrt":
+        y = jnp.sum(x * m, axis=1) / jnp.sqrt(denom)
+    elif ptype == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.iinfo(x.dtype).min, x.dtype)
+        y = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+        # empty sequences pool to 0, matching the reference's zero-fill
+        y = jnp.where(lengths.reshape(denom.shape) > 0, y, jnp.zeros_like(y))
+    elif ptype == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        y = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "first":
+        y = x[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {ptype!r}")
+    return out(Out=y)
+
+
+@register_op("sequence_softmax", optional_inputs=("Length",))
+def sequence_softmax(attrs, ins):
+    x = single(ins, "X")  # [b, T] or [b, T, 1]
+    lengths = maybe(ins, "Length")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    z = x[..., 0] if squeeze else x
+    T = z.shape[1]
+    if lengths is None:
+        mask = jnp.ones(z.shape[:2], z.dtype)
+    else:
+        mask = time_mask(lengths, T, z.dtype)
+    neg = jnp.finfo(z.dtype).min
+    z = jnp.where(mask > 0, z, neg)
+    y = jax.nn.softmax(z, axis=1) * mask
+    if squeeze:
+        y = y[..., None]
+    return out(Out=y)
+
+
+@register_op("sequence_expand", optional_inputs=("Length",))
+def sequence_expand(attrs, ins):
+    """Broadcast per-row vectors across the ref sequence's time axis.
+
+    Reference sequence_expand_op.cc repeats row i of X lod(Y)[i] times; in
+    padded form that is a broadcast of X [b, d] to [b, T, d] with padding
+    masked to zero (T and the mask come from the reference sequence Y).
+    """
+    x = single(ins, "X")  # [b, d...]
+    y = single(ins, "Y")  # [b, T, ...] provides T
+    lengths = maybe(ins, "Length")
+    T = y.shape[1]
+    expanded = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    if lengths is not None:
+        mask = _expand_mask(time_mask(lengths, T, x.dtype), expanded)
+        expanded = expanded * mask
+    return out(Out=expanded)
+
+
+@register_op("sequence_reverse", optional_inputs=("Length",))
+def sequence_reverse(attrs, ins):
+    """Reverse each row's valid prefix, leaving padding in place
+    (sequence_reverse semantics; feeds bidirectional RNNs)."""
+    x = single(ins, "X")  # [b, T, ...]
+    lengths = maybe(ins, "Length")
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)
+    if lengths is None:
+        idx = jnp.broadcast_to(t[::-1][None, :], x.shape[:2])
+    else:
+        rev = lengths[:, None] - 1 - t[None, :]
+        idx = jnp.where(t[None, :] < lengths[:, None], rev, t[None, :])
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return out(Y=jnp.take_along_axis(x, idx, axis=1))
+
+
+@register_op("sequence_conv", optional_inputs=("Length", "PaddingData"))
+def sequence_conv(attrs, ins):
+    """Context-window projection + filter matmul.
+
+    Reference sequence_conv_op.cc / operators/math/context_project.h: for each
+    timestep, gather [context_start, context_start+context_length) neighbour
+    rows (zeros outside the sequence), concatenate features, multiply by
+    Filter [ctx_len*d, out]. Padded form: shift-and-concat along time, mask,
+    one [b*T, k*d] x [k*d, out] matmul on the MXU.
+    """
+    x = single(ins, "X")  # [b, T, d]
+    filt = single(ins, "Filter")  # [k*d, out]
+    lengths = maybe(ins, "Length")
+    k = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    start = int(attrs.get("contextStart", attrs.get("context_start", -(k // 2))))
+    b, T, d = x.shape
+    mask = (time_mask(lengths, T, x.dtype)[..., None]
+            if lengths is not None else jnp.ones((b, T, 1), x.dtype))
+    xm = x * mask
+    cols = []
+    for off in range(start, start + k):
+        if off < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+        elif off > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    ctx = jnp.concatenate(cols, axis=-1)  # [b, T, k*d]
+    y = jnp.einsum("btc,co->bto", ctx, filt)
+    return out(Out=y * mask)
+
+
+@register_op("row_conv", optional_inputs=("Length",))
+def row_conv(attrs, ins):
+    """Lookahead row convolution (row_conv_op.cc): out[t] = sum_j w[j]*x[t+j]."""
+    x = single(ins, "X")  # [b, T, d]
+    w = single(ins, "Filter")  # [future_ctx, d]
+    lengths = maybe(ins, "Length")
+    b, T, d = x.shape
+    k = w.shape[0]
+    mask = (time_mask(lengths, T, x.dtype)[..., None]
+            if lengths is not None else jnp.ones((b, T, 1), x.dtype))
+    xm = x * mask
+    y = jnp.zeros_like(x)
+    for j in range(k):
+        shifted = jnp.pad(xm, ((0, 0), (0, j), (0, 0)))[:, j:] if j else xm
+        y = y + shifted * w[j]
+    return out(Out=y * mask)
+
+
+@register_op("sequence_concat", optional_inputs=("Length",))
+def sequence_concat(attrs, ins):
+    """Concatenate sequences along time per batch row
+    (sequence_concat_op.cc with axis=0/level=0 semantics).
+
+    Inputs: X = list of [b, T_i, d] tensors, Length = matching list of [b]
+    length vectors. Output: [b, sum(T_i), d] with rows packed back-to-back
+    and the summed length vector.
+    """
+    xs = ins["X"]
+    lens = ins.get("Length")
+    b = xs[0].shape[0]
+    if lens is None or not lens:
+        lens = [jnp.full((b,), x.shape[1], jnp.int32) for x in xs]
+    total_T = sum(x.shape[1] for x in xs)
+    out_len = sum(lens)
+    # Build, for every output slot t, (which input, source timestep) by
+    # comparing t against the running sum of this row's lengths.
+    t_idx = jnp.arange(total_T, dtype=jnp.int32)[None, :]  # [1, total_T]
+    starts = []
+    acc = jnp.zeros((b, 1), jnp.int32)
+    for ln in lens:
+        starts.append(acc)
+        acc = acc + ln[:, None]
+    result = jnp.zeros((b, total_T) + xs[0].shape[2:], xs[0].dtype)
+    for x, ln, st in zip(xs, lens, starts):
+        Ti = x.shape[1]
+        src_t = jnp.clip(t_idx - st, 0, Ti - 1)
+        src_t = src_t.reshape(src_t.shape + (1,) * (x.ndim - 2))
+        gathered = jnp.take_along_axis(
+            jnp.broadcast_to(x, (b,) + x.shape[1:]), src_t, axis=1)
+        sel = (t_idx >= st) & (t_idx < st + ln[:, None])
+        sel = sel.reshape(sel.shape + (1,) * (x.ndim - 2))
+        result = jnp.where(sel, gathered, result)
+    return out(Out=result, OutLength=out_len.astype(jnp.int32))
+
+
+@register_op("sequence_enumerate", optional_inputs=("Length",))
+def sequence_enumerate(attrs, ins):
+    """Sliding n-gram window over id sequences (sequence_enumerate_op.cc):
+    out[b, t] = [ids[t], ids[t+1], ..., ids[t+win-1]], pad_value past end."""
+    x = single(ins, "X")  # [b, T] int ids
+    lengths = maybe(ins, "Length")
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    b, T = x.shape[:2]
+    if lengths is None:
+        lengths = jnp.full((b,), T, jnp.int32)
+    cols = []
+    for j in range(win):
+        shifted = jnp.pad(x, ((0, 0), (0, j)), constant_values=pad)[:, j:]
+        valid = (jnp.arange(T, dtype=jnp.int32)[None, :] + j) < lengths[:, None]
+        cols.append(jnp.where(valid, shifted, pad))
+    return out(Out=jnp.stack(cols, axis=-1))
+
+
+@register_op("sequence_mask")
+def sequence_mask(attrs, ins):
+    """Lengths -> [b, maxlen] 0/1 mask (sequence_mask semantics)."""
+    lengths = single(ins, "X")
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_mask requires a static maxlen attr on TPU")
+    dtype = attrs.get("out_dtype", "float32")
+    return out(Y=time_mask(lengths, maxlen, jnp.dtype(dtype)))

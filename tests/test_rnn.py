@@ -1,0 +1,176 @@
+"""RNN tests: scan-based LSTM/GRU vs explicit numpy recurrences + an
+end-to-end sentiment-style training smoke (embedding -> lstm -> pool -> fc),
+mirroring the reference book test understand_sentiment
+(/root/reference/python/paddle/v2/fluid/tests/book/
+test_understand_sentiment_lstm.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, w, bias, lengths, h0=None, c0=None):
+    """x [b,T,4h] pre-projected; gate order (c, i, f, o) per lstm_op.cc."""
+    b, T, four_h = x.shape
+    h_dim = four_h // 4
+    h = np.zeros((b, h_dim), np.float32) if h0 is None else h0
+    c = np.zeros((b, h_dim), np.float32) if c0 is None else c0
+    hs = np.zeros((b, T, h_dim), np.float32)
+    cs = np.zeros((b, T, h_dim), np.float32)
+    for t in range(T):
+        gates = x[:, t] + h @ w + bias
+        gc, gi, gf, go = np.split(gates, 4, axis=-1)
+        i, f, o = sigmoid(gi), sigmoid(gf), sigmoid(go)
+        c_new = f * c + i * np.tanh(gc)
+        h_new = o * np.tanh(c_new)
+        alive = (t < lengths)[:, None]
+        h = np.where(alive, h_new, h)
+        c = np.where(alive, c_new, c)
+        hs[:, t] = np.where(alive, h_new, 0)
+        cs[:, t] = np.where(alive, c_new, 0)
+    return hs, cs, h, c
+
+
+def np_gru(x, w, bias, lengths):
+    """x [b,T,3h]; w [:, :2h] = (update, reset), [:, 2h:] = candidate."""
+    b, T, three_h = x.shape
+    h_dim = three_h // 3
+    h = np.zeros((b, h_dim), np.float32)
+    hs = np.zeros((b, T, h_dim), np.float32)
+    wg, wc = w[:, : 2 * h_dim], w[:, 2 * h_dim:]
+    for t in range(T):
+        xt = x[:, t] + bias
+        xg, xc = xt[:, : 2 * h_dim], xt[:, 2 * h_dim:]
+        g = sigmoid(xg + h @ wg)
+        u, r = g[:, :h_dim], g[:, h_dim:]
+        cand = np.tanh(xc + (r * h) @ wc)
+        h_new = (1 - u) * h + u * cand  # gru_op.cc:142
+        alive = (t < lengths)[:, None]
+        h = np.where(alive, h_new, h)
+        hs[:, t] = np.where(alive, h_new, 0)
+    return hs, h
+
+
+def run_op(op_type, ins, attrs=None):
+    import jax.numpy as jnp
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins.items()}
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+class TestLSTMOp:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.b, self.T, self.h = 3, 6, 4
+        self.x = rng.randn(self.b, self.T, 4 * self.h).astype(np.float32) * 0.5
+        self.w = rng.randn(self.h, 4 * self.h).astype(np.float32) * 0.3
+        self.bias = rng.randn(1, 4 * self.h).astype(np.float32) * 0.1
+        self.lengths = np.array([6, 3, 5], np.int32)
+
+    def test_matches_numpy(self):
+        outs = run_op("lstm", {"Input": [self.x], "Weight": [self.w],
+                               "Bias": [self.bias], "Length": [self.lengths]})
+        hs, cs, h, c = np_lstm(self.x, self.w, self.bias[0], self.lengths)
+        np.testing.assert_allclose(np.asarray(outs["Hidden"][0]), hs,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs["Cell"][0]), cs,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs["LastH"][0]), h,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs["LastC"][0]), c,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_reverse_full_lengths(self):
+        full = np.full((self.b,), self.T, np.int32)
+        outs = run_op("lstm", {"Input": [self.x], "Weight": [self.w],
+                               "Bias": [self.bias], "Length": [full]},
+                      {"is_reverse": True})
+        hs_rev, _, _, _ = np_lstm(self.x[:, ::-1], self.w, self.bias[0], full)
+        np.testing.assert_allclose(np.asarray(outs["Hidden"][0]),
+                                   hs_rev[:, ::-1], rtol=1e-4, atol=1e-5)
+
+    def test_lstm_unit(self):
+        rng = np.random.RandomState(2)
+        gates = rng.randn(2, 4 * self.h).astype(np.float32)
+        c_prev = rng.randn(2, self.h).astype(np.float32)
+        outs = run_op("lstm_unit", {"X": [gates], "C_prev": [c_prev]})
+        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        c = sigmoid(gf) * c_prev + sigmoid(gi) * np.tanh(gc)
+        h = sigmoid(go) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(outs["C"][0]), c, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs["H"][0]), h, rtol=1e-5)
+
+
+class TestGRUOp:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        b, T, h = 3, 5, 4
+        x = rng.randn(b, T, 3 * h).astype(np.float32) * 0.5
+        w = rng.randn(h, 3 * h).astype(np.float32) * 0.3
+        bias = rng.randn(1, 3 * h).astype(np.float32) * 0.1
+        lengths = np.array([5, 2, 4], np.int32)
+        outs = run_op("gru", {"Input": [x], "Weight": [w], "Bias": [bias],
+                              "Length": [lengths]})
+        hs, hlast = np_gru(x, w, bias[0], lengths)
+        np.testing.assert_allclose(np.asarray(outs["Hidden"][0]), hs,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs["LastH"][0]), hlast,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_unit(self):
+        rng = np.random.RandomState(3)
+        b, h = 2, 4
+        xt = rng.randn(b, 3 * h).astype(np.float32)
+        hp = rng.randn(b, h).astype(np.float32)
+        w = rng.randn(h, 3 * h).astype(np.float32) * 0.3
+        outs = run_op("gru_unit",
+                      {"Input": [xt], "HiddenPrev": [hp], "Weight": [w]})
+        g = sigmoid(xt[:, : 2 * h] + hp @ w[:, : 2 * h])
+        u, r = g[:, :h], g[:, h:]
+        cand = np.tanh(xt[:, 2 * h:] + (r * hp) @ w[:, 2 * h:])
+        ref = (1 - u) * hp + u * cand
+        np.testing.assert_allclose(np.asarray(outs["Hidden"][0]), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSentimentTraining:
+    def test_lstm_classifier_learns(self):
+        """Tiny understand_sentiment: label = (first word id < vocab/2)."""
+        vocab, emb_dim, hid = 20, 8, 8
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
+            label = layers.data("label", shape=[1], dtype="int64")
+            emb = layers.embedding(words, size=[vocab, emb_dim])
+            emb.seq_len = words.seq_len
+            proj = layers.fc(emb, size=4 * hid, num_flatten_dims=2,
+                             bias_attr=False)
+            h_seq, _ = layers.dynamic_lstm(proj, size=4 * hid)
+            pooled = layers.sequence_pool(h_seq, "max")
+            logits = layers.fc(pooled, size=2)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            opt = pt.optimizer.AdamOptimizer(learning_rate=0.05)
+            opt.minimize(loss, startup_program=startup)
+
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+
+        rng = np.random.RandomState(0)
+        b, T = 16, 7
+        losses = []
+        for step in range(30):
+            lengths = rng.randint(1, T + 1, size=b).astype(np.int32)
+            ids = rng.randint(0, vocab, size=(b, T)).astype(np.int64)
+            y = (ids[:, 0] < vocab // 2).astype(np.int64)[:, None]
+            (lo,) = exe.run(main, feed={"words": ids, "words@len": lengths,
+                                        "label": y},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(lo))
+        assert losses[-1] < losses[0] * 0.5, losses
