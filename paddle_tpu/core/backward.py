@@ -35,6 +35,8 @@ NON_DIFFERENTIABLE = {
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "argmax", "one_hot", "truncated_gaussian_random",
+    # lax.while_loop is not reverse-differentiable; these are decode-side.
+    "while", "beam_search_decoder",
 }
 
 

@@ -1,8 +1,11 @@
 """User-facing layer functions (fluid layers package parity)."""
 from .io import data
-from .nn import (accuracy, batch_norm, conv2d, cross_entropy, dropout,
-                 embedding, fc, layer_norm, lrn, pool2d, square_error_cost,
+from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
+                 cross_entropy, dropout, embedding, fc, layer_norm,
+                 linear_chain_crf, lrn, pool2d, square_error_cost,
                  softmax_with_cross_entropy, topk)
+from .control_flow import (StaticRNN, While, array_read, array_write,
+                           beam_search_decoder, create_array, increment)
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
 from .ops import __all__ as _ops_all
 from .sequence import (dynamic_gru, dynamic_lstm, gru_unit, lstm_unit,
@@ -11,18 +14,21 @@ from .sequence import (dynamic_gru, dynamic_lstm, gru_unit, lstm_unit,
                        sequence_last_step, sequence_pool, sequence_reverse,
                        sequence_softmax)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
-                     fill_constant, mean, one_hot, reshape, scale, split,
-                     sums, transpose)
+                     fill_constant, fill_constant_batch_size_like, mean,
+                     one_hot, reshape, scale, split, sums, transpose)
 
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
      "dropout", "lrn", "cross_entropy", "softmax_with_cross_entropy",
      "square_error_cost", "accuracy", "topk",
-     "fill_constant", "create_global_var", "cast", "concat", "sums", "assign",
+     "linear_chain_crf", "crf_decoding", "chunk_eval",
+     "fill_constant", "fill_constant_batch_size_like", "create_global_var", "cast", "concat", "sums", "assign",
      "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax",
      "sequence_pool", "sequence_first_step", "sequence_last_step",
      "sequence_softmax", "sequence_expand", "sequence_reverse",
      "sequence_conv", "sequence_concat", "row_conv",
-     "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit"]
+     "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
+     "StaticRNN", "While", "create_array", "array_write", "array_read",
+     "increment", "beam_search_decoder"]
     + list(_ops_all)
 )

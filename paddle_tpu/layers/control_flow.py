@@ -1,0 +1,290 @@
+"""Control-flow builders: StaticRNN, While, tensor arrays, beam decode.
+
+Parity with the reference's fluid control_flow layer API
+(/root/reference/python/paddle/v2/fluid/layers/control_flow.py: StaticRNN,
+While, array_write/array_read/increment, DynamicRNN) and the decode stack
+(beam_search + beam_search_decode ops,
+/root/reference/python/paddle/v2/fluid/tests/book/test_machine_translation.py).
+
+Builder mechanics: entering ``rnn.step()`` / ``while.block()`` pushes a
+sub-block on the program; layers called inside append ops there as usual. On
+exit the builder SERIALIZES the sub-block's ops into the parent ``static_rnn``
+/ ``while`` op's attrs (plain data) — see ops/control_flow_ops.py for how the
+kernel re-materialises them under lax.scan / lax.while_loop. External
+variables referenced by the body (weights created by fc etc.) are collected
+automatically into the op's Param input slot.
+
+DynamicRNN is subsumed: the reference needs lod_rank_table +
+shrink_rnn_memory to batch variable-length rows (control_flow.py:609 area);
+here StaticRNN takes the sequence's Length and applies the same
+freeze-memory/zero-output masking in one scan.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.program import Variable, default_main_program
+from .layer_helper import LayerHelper
+from .sequence import get_seq_len
+
+
+def _collect_body(block, bound_names):
+    """Serialize a sub-block's ops; classify external reads as params."""
+    body_ops = []
+    produced = set(bound_names)
+    params: List[str] = []
+    for op in block.ops:
+        for slot, names in op.inputs.items():
+            for n in names:
+                if n not in produced and n not in params:
+                    params.append(n)
+        for n in op.output_names():
+            produced.add(n)
+        body_ops.append({
+            "type": op.type,
+            "inputs": {s: list(ns) for s, ns in op.inputs.items()},
+            "outputs": {s: list(ns) for s, ns in op.outputs.items()},
+            "attrs": dict(op.attrs),
+        })
+    return body_ops, params
+
+
+class StaticRNN:
+    """Scan-based user-defined recurrence (fluid StaticRNN,
+    control_flow.py; reference runtime recurrent_op.cc:222).
+
+    with rnn.step():
+        xt = rnn.step_input(seq)         # [b, d] slice of [b, T, d]
+        h  = rnn.memory(init=h0)         # loop-carried
+        new_h = some_layers(xt, h)
+        rnn.update_memory(h, new_h)
+        rnn.step_output(new_h)
+    out, = rnn()                          # [b, T, ...]
+    """
+
+    def __init__(self, name=None, main_program=None, startup_program=None):
+        self.helper = LayerHelper("static_rnn", main_program=main_program,
+                                  startup_program=startup_program)
+        self.seq_vars: List[Variable] = []
+        self.x_vars: List[Variable] = []
+        self.mem_init: List[Variable] = []
+        self.mem_vars: List[Variable] = []
+        self.mem_out: Dict[str, Optional[str]] = {}
+        self.out_vars: List[Variable] = []
+        self.block = None
+        self._len_var = None
+
+    # -- context ----------------------------------------------------------
+    class _Step:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = self.rnn.helper.main_program
+            self.rnn.block = prog.create_block()
+            return self.rnn
+
+        def __exit__(self, exc_type, *a):
+            prog = self.rnn.helper.main_program
+            prog.rollback()
+            if exc_type is None:
+                self.rnn._complete()
+
+    def step(self):
+        return StaticRNN._Step(self)
+
+    # -- body API ---------------------------------------------------------
+    def step_input(self, seq: Variable) -> Variable:
+        """Register a [b, T, ...] sequence; returns its per-step [b, ...]
+        view usable inside the body."""
+        prog = self.helper.main_program
+        if self._len_var is None:
+            self._len_var = get_seq_len(seq)
+        shape = (seq.shape[0],) + tuple(seq.shape[2:])
+        xt = self.block.create_var(
+            name=prog.unique_name("static_rnn.x"), shape=shape,
+            dtype=seq.dtype)
+        self.seq_vars.append(seq)
+        self.x_vars.append(xt)
+        return xt
+
+    def memory(self, init: Variable) -> Variable:
+        """Loop-carried state seeded from ``init`` ([b, ...])."""
+        prog = self.helper.main_program
+        mem = self.block.create_var(
+            name=prog.unique_name("static_rnn.mem"), shape=init.shape,
+            dtype=init.dtype)
+        self.mem_init.append(init)
+        self.mem_vars.append(mem)
+        self.mem_out[mem.name] = None
+        return mem
+
+    def update_memory(self, mem: Variable, new: Variable):
+        self.mem_out[mem.name] = new.name
+
+    def step_output(self, o: Variable):
+        self.out_vars.append(o)
+
+    output = step_output
+
+    # -- completion -------------------------------------------------------
+    def _complete(self):
+        for m, tgt in self.mem_out.items():
+            if tgt is None:
+                raise ValueError(f"memory {m!r} was never update_memory()'d")
+        bound = [v.name for v in self.x_vars] + [v.name for v in self.mem_vars]
+        body_ops, params = _collect_body(self.block, bound)
+        ins = {
+            "X": self.seq_vars,
+            "MemInit": self.mem_init,
+            "Param": [self.helper.block.var(n) if self.helper.block.has_var(n)
+                      else n for n in params],
+        }
+        if self._len_var is not None:
+            ins["Length"] = [self._len_var]
+        attrs = {
+            "body_ops": body_ops,
+            "x_names": [v.name for v in self.x_vars],
+            "mem_names": [v.name for v in self.mem_vars],
+            "mem_out_names": [self.mem_out[v.name] for v in self.mem_vars],
+            "out_names": [v.name for v in self.out_vars],
+            "param_names": params,
+            "seq_len_static": (self.seq_vars[0].shape[1]
+                               if self.seq_vars else 0),
+        }
+        outs, _ = self.helper.append_op("static_rnn", ins,
+                                        ["Out", "LastMem"], attrs)
+        self._outputs = outs["Out"]
+        self._last_mems = outs["LastMem"]
+        for o in self._outputs:
+            o.seq_len = self._len_var
+
+    def __call__(self):
+        outs = self._outputs
+        return outs[0] if len(outs) == 1 else outs
+
+
+class While:
+    """Functional while loop (fluid layers.While / while_op.cc).
+
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        ... body ops; every loop-carried var (including cond) must be
+        written each iteration (use layers.assign(new, output=var)) ...
+    Loop-carried vars are detected as body-written names that exist in the
+    enclosing block.
+    """
+
+    def __init__(self, cond: Variable, main_program=None,
+                 startup_program=None):
+        self.helper = LayerHelper("while", main_program=main_program,
+                                  startup_program=startup_program)
+        self.cond = cond
+        self.sub_block = None
+
+    class _Block:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            self.w.outer_block = self.w.helper.main_program.current_block()
+            self.w.sub_block = self.w.helper.main_program.create_block()
+            return self.w
+
+        def __exit__(self, exc_type, *a):
+            self.w.helper.main_program.rollback()
+            if exc_type is None:
+                self.w._complete()
+
+    def block(self):
+        return While._Block(self)
+
+    def _complete(self):
+        sub = self.sub_block
+        outer = self.outer_block
+        # Carried vars: body-written names resolvable in the OUTER scope.
+        written = []
+        for op in sub.ops:
+            for n in op.output_names():
+                if outer.has_var(n) and n not in written:
+                    written.append(n)
+        if self.cond.name not in written:
+            raise ValueError(
+                "While body must reassign the condition variable "
+                f"{self.cond.name!r} (layers.assign(new_cond, output=cond))")
+        carried = written
+        body_ops, params = _collect_body(sub, carried)
+        ins = {
+            "Carried": [outer.var(n) for n in carried],
+            "Param": [outer.var(n) if outer.has_var(n) else n
+                      for n in params],
+        }
+        attrs = {
+            "body_ops": body_ops,
+            "carried_names": carried,
+            "param_names": params,
+            "cond_name": self.cond.name,
+        }
+        # Outputs write back to the SAME outer variables (final loop state).
+        outputs = {"Out": [outer.var(n) for n in carried]}
+        self.helper.append_op("while", ins, outputs, attrs)
+
+
+def create_array(element_shape, max_len, dtype="float32", main_program=None,
+                 startup_program=None):
+    """A [max_len, ...] zero buffer: the functional LoDTensorArray."""
+    from . import tensor as tensor_layers
+
+    return tensor_layers.fill_constant(
+        shape=[max_len] + list(element_shape), dtype=dtype, value=0.0,
+        main_program=main_program, startup_program=startup_program)
+
+
+def array_write(x, i, array, main_program=None, startup_program=None):
+    helper = LayerHelper("array_write", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("array_write",
+                            {"X": [x], "I": [i], "Array": [array]})
+
+
+def array_read(array, i, main_program=None, startup_program=None):
+    helper = LayerHelper("array_read", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("array_read", {"Array": [array], "I": [i]})
+
+
+def increment(x, value=1.0, main_program=None, startup_program=None):
+    helper = LayerHelper("increment", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("increment", {"X": [x]}, {"step": value})
+
+
+def beam_search_decoder(init_state, embedding_param, cell_params, out_params,
+                        beam_size=4, max_len=32, bos_id=0, eos_id=1,
+                        cell="gru", init_cell=None, main_program=None,
+                        startup_program=None):
+    """Fused beam-search generation (see ops/control_flow_ops.py
+    beam_search_decoder for semantics and reference citations).
+
+    cell_params = (weight_x, weight_h, bias_or_None);
+    out_params = (weight_out, bias_or_None).
+    Returns (ids [b, beam, max_len], scores [b, beam], lengths [b, beam]).
+    """
+    helper = LayerHelper("beam_search_decoder", main_program=main_program,
+                         startup_program=startup_program)
+    wx, wh, bias = cell_params
+    w_out, b_out = out_params
+    ins = {"InitState": [init_state], "Embedding": [embedding_param],
+           "WeightX": [wx], "WeightH": [wh], "WeightOut": [w_out]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if b_out is not None:
+        ins["OutBias"] = [b_out]
+    if init_cell is not None:
+        ins["InitCell"] = [init_cell]
+    outs, _ = helper.append_op(
+        "beam_search_decoder", ins, ["Ids", "SeqScores", "SeqLen"],
+        {"beam_size": beam_size, "max_len": max_len, "bos_id": bos_id,
+         "eos_id": eos_id, "cell": cell})
+    return outs["Ids"][0], outs["SeqScores"][0], outs["SeqLen"][0]

@@ -93,3 +93,17 @@ def one_hot(input, depth, main_program=None, startup_program=None):
 def argmax(x, axis=-1, main_program=None, startup_program=None):
     h = _helper("argmax", main_program, startup_program)
     return h.simple_op("argmax", {"X": [x]}, {"axis": axis})
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  main_program=None, startup_program=None):
+    """Constant tensor whose batch dim copies ``input``'s
+    (fill_constant_batch_size_like_op.cc) — the standard way to make
+    batch-shaped initial RNN states."""
+    helper = _helper("fill_constant_batch_size_like", main_program,
+                     startup_program)
+    return helper.simple_op(
+        "fill_constant_batch_size_like", {"Input": [input]},
+        {"shape": list(shape), "dtype": str(dtype), "value": value,
+         "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx})

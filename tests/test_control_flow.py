@@ -1,0 +1,254 @@
+"""Control-flow tests: StaticRNN (trainable scan), While, arrays, and the
+fused beam-search decoder — mirroring the reference's recurrent_op/while_op
+tests and the machine-translation decode path
+(/root/reference/python/paddle/v2/fluid/tests/test_recurrent_op.py,
+test_while_op.py, book/test_machine_translation.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+
+def run_op(op_type, ins, attrs=None):
+    import jax.numpy as jnp
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins.items()}
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+class TestStaticRNN:
+    def test_simple_recurrence_matches_numpy(self):
+        """h_t = tanh(x_t W + h_{t-1} U): StaticRNN output == numpy loop."""
+        b, T, d, h = 3, 5, 4, 6
+        rng = np.random.RandomState(0)
+        x_np = rng.randn(b, T, d).astype(np.float32) * 0.5
+        h0_np = rng.randn(b, h).astype(np.float32) * 0.2
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[T, d])
+            h0 = layers.data("h0", shape=[h])
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                mem = rnn.memory(init=h0)
+                nh = layers.fc([xt, mem], size=h, bias_attr=False, act="tanh")
+                rnn.update_memory(mem, nh)
+                rnn.step_output(nh)
+            outv = rnn()
+
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        (got,) = exe.run(main, feed={"x": x_np, "h0": h0_np},
+                         fetch_list=[outv], scope=scope)
+
+        # weights: fc over [xt, mem] makes two params W [d,h], U [h,h]
+        w_names = [n for n in scope.keys() if n.startswith("fc")]
+        ws = {n: np.asarray(scope.get(n)) for n in w_names}
+        W = next(v for v in ws.values() if v.shape == (d, h))
+        U = next(v for v in ws.values() if v.shape == (h, h))
+        hh = h0_np
+        ref = np.zeros((b, T, h), np.float32)
+        for t in range(T):
+            hh = np.tanh(x_np[:, t] @ W + hh @ U)
+            ref[:, t] = hh
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_static_rnn_trains(self):
+        """Gradients flow through the scan: fit y = sum_t x_t w."""
+        b, T, d = 8, 6, 3
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[T, d])
+            y = layers.data("y", shape=[1])
+            acc0 = layers.fill_constant_batch_size_like(
+                y, shape=[-1, 1], dtype="float32", value=0.0)
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                acc = rnn.memory(init=acc0)
+                contrib = layers.fc(xt, size=1, bias_attr=False)
+                new_acc = layers.elementwise_add(acc, contrib)
+                rnn.update_memory(acc, new_acc)
+                rnn.step_output(new_acc)
+            seq_out = rnn()
+            pred = layers.sequence_last_step(seq_out)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        w_true = np.array([[0.5], [-1.0], [2.0]], np.float32)
+        losses = []
+        for _ in range(60):
+            xb = rng.randn(b, T, d).astype(np.float32)
+            yb = (xb @ w_true).sum(1)
+            (lo,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(lo))
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+    def test_masked_by_length(self):
+        """With Length, memories freeze and outputs zero past each row's end."""
+        b, T, d = 2, 4, 3
+        x_np = np.ones((b, T, d), np.float32)
+        lengths = np.array([4, 2], np.int32)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[d], lod_level=1)
+            init = layers.fill_constant_batch_size_like(
+                x, shape=[-1, d], dtype="float32", value=0.0)
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                acc = rnn.memory(init=init)
+                new_acc = layers.elementwise_add(acc, xt)
+                rnn.update_memory(acc, new_acc)
+                rnn.step_output(new_acc)
+            seq_out = rnn()
+            last = layers.sequence_last_step(seq_out)
+
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        got_seq, got_last = exe.run(
+            main, feed={"x": x_np, "x@len": lengths},
+            fetch_list=[seq_out, last], scope=scope)
+        # row 0: cumsum of ones -> last = 4; row 1: frozen after t=2 -> 2
+        np.testing.assert_allclose(got_last[0], [4, 4, 4])
+        np.testing.assert_allclose(got_last[1], [2, 2, 2])
+        assert np.all(got_seq[1, 2:] == 0)
+
+
+class TestWhile:
+    def test_sum_of_squares(self):
+        """while i < n: acc += i^2; i += 1 — runs in-graph."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant(shape=[], value=0.0, dtype="float32")
+            n = layers.fill_constant(shape=[], value=5.0, dtype="float32")
+            acc = layers.fill_constant(shape=[], value=0.0, dtype="float32")
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                sq = layers.elementwise_mul(i, i)
+                layers.assign(layers.elementwise_add(acc, sq), output=acc)
+                layers.assign(layers.increment(i, 1.0), output=i)
+                layers.assign(layers.less_than(i, n), output=cond)
+
+        exe = pt.Executor(pt.TPUPlace())
+        scope = pt.Scope()
+        (got,) = exe.run(main, fetch_list=[acc], scope=scope)
+        assert float(got) == sum(k * k for k in range(5))
+
+    def test_array_write_read_in_while(self):
+        """Collect i^2 into a tensor array inside the loop."""
+        N = 4
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant(shape=[], value=0.0, dtype="float32")
+            n = layers.fill_constant(shape=[], value=float(N), dtype="float32")
+            arr = layers.create_array([], max_len=N)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                sq = layers.elementwise_mul(i, i)
+                layers.assign(layers.array_write(sq, i, arr), output=arr)
+                layers.assign(layers.increment(i, 1.0), output=i)
+                layers.assign(layers.less_than(i, n), output=cond)
+
+        exe = pt.Executor(pt.TPUPlace())
+        (got,) = exe.run(main, fetch_list=[arr], scope=pt.Scope())
+        np.testing.assert_allclose(got, [0.0, 1.0, 4.0, 9.0])
+
+
+class TestBeamSearchDecoder:
+    def _greedy_ref(self, emb, wx, wh, bias, w_out, b_out, h0, bos, eos,
+                    max_len):
+        """Greedy (beam=1) numpy GRU decode for one batch row."""
+        h = h0.copy()
+        tok = bos
+        ids, score = [], 0.0
+        hdim = h.shape[-1]
+        for _ in range(max_len):
+            x = emb[tok]
+            gx = x @ wx + bias[0]
+            g = 1 / (1 + np.exp(-(gx[: 2 * hdim] + h @ wh[:, : 2 * hdim])))
+            u, r = g[:hdim], g[hdim:]
+            cand = np.tanh(gx[2 * hdim:] + (r * h) @ wh[:, 2 * hdim:])
+            h = (1 - u) * h + u * cand
+            logits = h @ w_out + b_out
+            logp = logits - np.log(np.exp(logits - logits.max()).sum()) \
+                - logits.max()
+            tok = int(np.argmax(logp))
+            score += float(logp[tok])
+            if tok == eos:
+                break
+            ids.append(tok)
+        return ids, score
+
+    def test_beam1_equals_greedy(self):
+        rng = np.random.RandomState(0)
+        V, e, h, b = 12, 5, 6, 2
+        emb = rng.randn(V, e).astype(np.float32)
+        wx = rng.randn(e, 3 * h).astype(np.float32) * 0.5
+        wh = rng.randn(h, 3 * h).astype(np.float32) * 0.5
+        bias = rng.randn(1, 3 * h).astype(np.float32) * 0.1
+        w_out = rng.randn(h, V).astype(np.float32)
+        b_out = rng.randn(V).astype(np.float32)
+        h0 = rng.randn(b, h).astype(np.float32)
+        outs = run_op(
+            "beam_search_decoder",
+            {"InitState": [h0], "Embedding": [emb], "WeightX": [wx],
+             "WeightH": [wh], "Bias": [bias], "WeightOut": [w_out],
+             "OutBias": [b_out]},
+            {"beam_size": 1, "max_len": 8, "bos_id": 0, "eos_id": 1,
+             "cell": "gru"})
+        ids = np.asarray(outs["Ids"][0])
+        lens = np.asarray(outs["SeqLen"][0])
+        for row in range(b):
+            ref_ids, _ = self._greedy_ref(emb, wx, wh, bias, w_out, b_out,
+                                          h0[row], 0, 1, 8)
+            got = list(ids[row, 0, : lens[row, 0]])
+            assert got == ref_ids, (got, ref_ids)
+
+    def test_beam_scores_sorted_and_eos_terminates(self):
+        rng = np.random.RandomState(1)
+        V, e, h, b, beam = 10, 4, 5, 3, 4
+        outs = run_op(
+            "beam_search_decoder",
+            {"InitState": [rng.randn(b, h).astype(np.float32)],
+             "Embedding": [rng.randn(V, e).astype(np.float32)],
+             "WeightX": [rng.randn(e, 3 * h).astype(np.float32) * 0.3],
+             "WeightH": [rng.randn(h, 3 * h).astype(np.float32) * 0.3],
+             "WeightOut": [rng.randn(h, V).astype(np.float32)]},
+            {"beam_size": beam, "max_len": 6, "bos_id": 0, "eos_id": 1,
+             "cell": "gru"})
+        scores = np.asarray(outs["SeqScores"][0])
+        ids = np.asarray(outs["Ids"][0])
+        lens = np.asarray(outs["SeqLen"][0])
+        assert np.all(np.diff(scores, axis=1) <= 1e-6)  # best-first
+        # everything past the generated length is eos padding
+        for row in range(b):
+            for k in range(beam):
+                assert np.all(ids[row, k, lens[row, k]:] == 1)
+
+    def test_lstm_cell_decode_runs(self):
+        rng = np.random.RandomState(2)
+        V, e, h, b = 9, 4, 5, 2
+        outs = run_op(
+            "beam_search_decoder",
+            {"InitState": [rng.randn(b, h).astype(np.float32)],
+             "InitCell": [rng.randn(b, h).astype(np.float32)],
+             "Embedding": [rng.randn(V, e).astype(np.float32)],
+             "WeightX": [rng.randn(e, 4 * h).astype(np.float32) * 0.3],
+             "WeightH": [rng.randn(h, 4 * h).astype(np.float32) * 0.3],
+             "WeightOut": [rng.randn(h, V).astype(np.float32)]},
+            {"beam_size": 3, "max_len": 5, "bos_id": 0, "eos_id": 1,
+             "cell": "lstm"})
+        assert np.asarray(outs["Ids"][0]).shape == (b, 3, 5)

@@ -1,0 +1,187 @@
+"""CRF tests: log-likelihood and viterbi vs brute-force path enumeration,
+chunk_eval vs hand-counted chunks, and a sequence-tagging training smoke
+(label_semantic_roles analogue,
+/root/reference/python/paddle/v2/fluid/tests/book/
+test_label_semantic_roles.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+
+def run_op(op_type, ins, attrs=None):
+    import jax.numpy as jnp
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins.items()}
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+def brute_force(emission, trans, length):
+    """All-paths enumeration for one row: returns (log_z, best_path)."""
+    n = emission.shape[-1]
+    start_w, end_w, w = trans[0], trans[1], trans[2:]
+    scores = {}
+    for path in itertools.product(range(n), repeat=length):
+        s = start_w[path[0]] + end_w[path[-1]]
+        s += sum(emission[t, path[t]] for t in range(length))
+        s += sum(w[path[t], path[t + 1]] for t in range(length - 1))
+        scores[path] = s
+    vals = np.array(list(scores.values()))
+    m = vals.max()
+    log_z = m + np.log(np.exp(vals - m).sum())
+    best = max(scores, key=scores.get)
+    return log_z, list(best), scores[best]
+
+
+class TestLinearChainCRF:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.b, self.T, self.n = 3, 4, 3
+        self.em = rng.randn(self.b, self.T, self.n).astype(np.float32)
+        self.trans = rng.randn(self.n + 2, self.n).astype(np.float32) * 0.5
+        self.lengths = np.array([4, 2, 3], np.int32)
+        self.labels = rng.randint(0, self.n,
+                                  size=(self.b, self.T)).astype(np.int64)
+
+    def test_nll_matches_brute_force(self):
+        outs = run_op("linear_chain_crf",
+                      {"Emission": [self.em], "Transition": [self.trans],
+                       "Label": [self.labels], "Length": [self.lengths]})
+        nll = np.asarray(outs["LogLikelihood"][0])
+        for r in range(self.b):
+            L = self.lengths[r]
+            log_z, _, _ = brute_force(self.em[r], self.trans, L)
+            path = self.labels[r, :L]
+            ps = (self.trans[0, path[0]] + self.trans[1, path[-1]]
+                  + sum(self.em[r, t, path[t]] for t in range(L))
+                  + sum(self.trans[2 + path[t], path[t + 1]]
+                        for t in range(L - 1)))
+            np.testing.assert_allclose(nll[r, 0], log_z - ps, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_viterbi_matches_brute_force(self):
+        outs = run_op("crf_decoding",
+                      {"Emission": [self.em], "Transition": [self.trans],
+                       "Length": [self.lengths]})
+        path = np.asarray(outs["ViterbiPath"][0])
+        for r in range(self.b):
+            L = self.lengths[r]
+            _, best, _ = brute_force(self.em[r], self.trans, L)
+            assert list(path[r, :L]) == best, (r, path[r, :L], best)
+            assert np.all(path[r, L:] == 0)
+
+    def test_decoding_with_label_gives_correctness_mask(self):
+        outs = run_op("crf_decoding",
+                      {"Emission": [self.em], "Transition": [self.trans],
+                       "Length": [self.lengths], "Label": [self.labels]})
+        correct = np.asarray(outs["ViterbiPath"][0])
+        plain = np.asarray(run_op(
+            "crf_decoding",
+            {"Emission": [self.em], "Transition": [self.trans],
+             "Length": [self.lengths]})["ViterbiPath"][0])
+        for r in range(self.b):
+            L = self.lengths[r]
+            np.testing.assert_array_equal(
+                correct[r, :L], (plain[r, :L] == self.labels[r, :L]))
+
+
+class TestChunkEval:
+    def test_exact_counts_iob(self):
+        # 2 chunk types; tags: 0=B-0, 1=I-0, 2=B-1, 3=I-1, 4=O
+        label = np.array([
+            [0, 1, 4, 2, 3, 3],   # chunks: [0-1]:t0, [3-5]:t1
+            [2, 0, 1, 1, 4, 4],   # chunks: [0]:t1, [1-3]:t0
+        ], np.int64)
+        infer = np.array([
+            [0, 1, 4, 2, 3, 4],   # [0-1]:t0 match; [3-4]:t1 shorter -> miss
+            [2, 0, 1, 1, 0, 4],   # [0]:t1 match, [1-3]:t0 match, extra [4]
+        ], np.int64)
+        lengths = np.array([6, 6], np.int32)
+        outs = run_op("chunk_eval",
+                      {"Inference": [infer], "Label": [label],
+                       "Length": [lengths]},
+                      {"num_chunk_types": 2})
+        n_inf = int(np.asarray(outs["NumInferChunks"][0])[0])
+        n_lab = int(np.asarray(outs["NumLabelChunks"][0])[0])
+        n_cor = int(np.asarray(outs["NumCorrectChunks"][0])[0])
+        assert n_lab == 4
+        assert n_inf == 5
+        assert n_cor == 3
+        p = float(np.asarray(outs["Precision"][0])[0])
+        r = float(np.asarray(outs["Recall"][0])[0])
+        np.testing.assert_allclose(p, 3 / 5, rtol=1e-6)
+        np.testing.assert_allclose(r, 3 / 4, rtol=1e-6)
+
+    def test_overlong_inference_chunk_is_not_a_match(self):
+        # label: B I B I (two chunks); infer: B I I I (one long chunk)
+        label = np.array([[0, 1, 0, 1]], np.int64)
+        infer = np.array([[0, 1, 1, 1]], np.int64)
+        outs = run_op("chunk_eval",
+                      {"Inference": [infer], "Label": [label],
+                       "Length": [np.array([4], np.int32)]},
+                      {"num_chunk_types": 1})
+        assert int(np.asarray(outs["NumCorrectChunks"][0])[0]) == 0
+
+
+class TestSequenceTaggingTraining:
+    def test_crf_tagger_learns(self):
+        """Tag = (word id mod n_tags) is learnable; CRF NLL must drop and
+        viterbi accuracy must rise — the label_semantic_roles pattern."""
+        vocab, emb_dim, n_tags = 20, 8, 3
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
+            tags = layers.data("tags", shape=[1], dtype="int64", lod_level=1)
+            emb = layers.embedding(words, size=[vocab, emb_dim])
+            emb.seq_len = words.seq_len
+            feat = layers.fc(emb, size=n_tags, num_flatten_dims=2)
+            crf_cost = layers.linear_chain_crf(feat, tags)
+            avg = layers.mean(crf_cost)
+            decoded = layers.crf_decoding(feat,
+                                          transition=crf_cost.transition)
+            pt.optimizer.AdamOptimizer(learning_rate=0.1).minimize(
+                avg, startup_program=startup)
+
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        b, T = 8, 6
+        losses = []
+        for _ in range(40):
+            lengths = rng.randint(2, T + 1, size=b).astype(np.int32)
+            ids = rng.randint(0, vocab, size=(b, T)).astype(np.int64)
+            y = (ids % n_tags).astype(np.int64)
+            lo, = exe.run(main, feed={"words": ids, "words@len": lengths,
+                                      "tags": y, "tags@len": lengths},
+                          fetch_list=[avg], scope=scope)
+            losses.append(float(lo))
+        assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+        # viterbi decode should now mostly agree with the rule
+        lengths = rng.randint(2, T + 1, size=b).astype(np.int32)
+        ids = rng.randint(0, vocab, size=(b, T)).astype(np.int64)
+        y = ids % n_tags
+        (path,) = exe.run(main, feed={"words": ids, "words@len": lengths,
+                                      "tags": y.astype(np.int64),
+                                      "tags@len": lengths},
+                          fetch_list=[decoded], scope=scope)
+        mask = np.arange(T)[None, :] < lengths[:, None]
+        acc = (path == y)[mask].mean()
+        assert acc > 0.9, acc
+
+
+class TestChunkEvalTypeMatching:
+    def test_i_initiated_chunk_matches_by_span_and_type(self):
+        """Matching is (begin, end, type) — chunk_eval_op.h Segment equality —
+        so an inference chunk starting with I- still matches."""
+        label = np.array([[0, 1, 2]], np.int64)   # B-0 I-0 O
+        infer = np.array([[1, 1, 2]], np.int64)   # I-0 I-0 O (same span/type)
+        outs = run_op("chunk_eval",
+                      {"Inference": [infer], "Label": [label],
+                       "Length": [np.array([3], np.int32)]},
+                      {"num_chunk_types": 1})
+        assert int(np.asarray(outs["NumCorrectChunks"][0])[0]) == 1
+        assert int(np.asarray(outs["NumInferChunks"][0])[0]) == 1
