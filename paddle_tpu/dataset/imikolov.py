@@ -1,0 +1,72 @@
+"""PTB language-model n-grams (reference python/paddle/v2/dataset/imikolov.py).
+
+``build_dict()`` -> {word: idx}; ``train(word_idx, n)`` yields n-gram tuples
+of ids (the word2vec book-test interface, imikolov.py reader_creator).
+Synthetic fallback: a Markov-chain corpus with a deterministic transition
+structure, so n-gram models (word2vec) have real signal to fit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "train", "test"]
+
+VOCAB_SIZE = 256
+TRAIN_SENTENCES = 2048
+TEST_SENTENCES = 256
+
+
+def build_dict(min_word_freq=50):
+    d = {f"w{i}": i for i in range(VOCAB_SIZE - 2)}
+    d["<s>"] = VOCAB_SIZE - 2
+    d["<e>"] = VOCAB_SIZE - 1
+    return d
+
+
+def _transition(seed="imikolov-chain"):
+    rng = common.synthetic_rng(seed)
+    # each word strongly prefers 4 successors
+    succ = rng.randint(0, VOCAB_SIZE - 2, size=(VOCAB_SIZE, 4))
+    return succ
+
+
+def _sentences(n, seed_name):
+    succ = _transition()
+
+    def gen():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            length = int(rng.randint(5, 20))
+            w = int(rng.randint(0, VOCAB_SIZE - 2))
+            sent = [w]
+            for _ in range(length - 1):
+                w = int(succ[w, rng.randint(0, 4)])
+                sent.append(w)
+            yield sent
+
+    return gen
+
+
+def _ngram_reader(n_sents, seed_name, word_idx, n):
+    sents = _sentences(n_sents, seed_name)
+    bos = len(word_idx) - 2
+    eos = len(word_idx) - 1
+
+    def reader():
+        for sent in sents():
+            # <s>*(n-1) + words + <e>, like the reference reader_creator
+            padded = [bos] * (n - 1) + sent + [eos]
+            for i in range(n - 1, len(padded)):
+                yield tuple(padded[i - n + 1: i + 1])
+
+    return reader
+
+
+def train(word_idx, n):
+    return _ngram_reader(TRAIN_SENTENCES, "imikolov-train", word_idx, n)
+
+
+def test(word_idx, n):
+    return _ngram_reader(TEST_SENTENCES, "imikolov-test", word_idx, n)

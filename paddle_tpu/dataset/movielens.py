@@ -1,0 +1,91 @@
+"""MovieLens-1M recommender data (reference v2/dataset/movielens.py API).
+
+Samples are ``(user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, score)`` — the recommender book-test feature tuple. Synthetic
+fallback: a low-rank latent-factor model generates consistent ratings, so
+matrix-factorisation models can actually fit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table", "movie_categories", "get_movie_title_dict"]
+
+N_USERS = 512
+N_MOVIES = 256
+N_JOBS = 21
+N_CATEGORIES = 18
+TITLE_VOCAB = 512
+RANK = 6
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return N_USERS
+
+
+def max_movie_id():
+    return N_MOVIES
+
+
+def max_job_id():
+    return N_JOBS - 1
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(TITLE_VOCAB)}
+
+
+def _factors():
+    rng = common.synthetic_rng("movielens-factors")
+    u = rng.normal(0, 1, (N_USERS + 1, RANK))
+    m = rng.normal(0, 1, (N_MOVIES + 1, RANK))
+    return u, m
+
+
+def _movie_meta():
+    rng = common.synthetic_rng("movielens-meta")
+    cats = [rng.randint(0, N_CATEGORIES,
+                        size=rng.randint(1, 4)).tolist()
+            for _ in range(N_MOVIES + 1)]
+    titles = [rng.randint(0, TITLE_VOCAB,
+                          size=rng.randint(2, 6)).tolist()
+              for _ in range(N_MOVIES + 1)]
+    return cats, titles
+
+
+def _reader(n, seed_name):
+    u_f, m_f = _factors()
+    cats, titles = _movie_meta()
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            uid = int(rng.randint(1, N_USERS + 1))
+            mid = int(rng.randint(1, N_MOVIES + 1))
+            raw = float(u_f[uid] @ m_f[mid]) / RANK ** 0.5
+            score = float(np.clip(np.round(3.0 + 1.5 * raw), 1, 5))
+            gender = uid % 2
+            age = int(rng.randint(0, len(age_table)))
+            job = uid % N_JOBS
+            yield (uid, gender, age, job, mid, cats[mid], titles[mid], score)
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "movielens-train")
+
+
+def test():
+    return _reader(TEST_SIZE, "movielens-test")

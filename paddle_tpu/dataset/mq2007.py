@@ -1,0 +1,70 @@
+"""MQ2007 learning-to-rank (reference v2/dataset/mq2007.py API).
+
+``train_reader(format=...)``/``test_reader`` with formats "pointwise"
+(feature, relevance), "pairwise" ((f_hi, f_lo) preference pairs) and
+"listwise" (query group lists) — mq2007.py Query/QueryList. Synthetic
+fallback: relevance is a noisy linear function of the 46-dim feature vector.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train_reader", "test_reader", "FEATURE_DIM"]
+
+FEATURE_DIM = 46
+N_QUERIES_TRAIN = 256
+N_QUERIES_TEST = 32
+DOCS_PER_QUERY = 8
+
+
+def _true_weights():
+    rng = common.synthetic_rng("mq2007-w")
+    return rng.normal(0, 1, FEATURE_DIM)
+
+
+def _queries(n_queries, seed_name):
+    w = _true_weights()
+
+    def gen():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n_queries):
+            feats = rng.normal(0, 1, (DOCS_PER_QUERY, FEATURE_DIM)) \
+                .astype(np.float32)
+            scores = feats @ w + rng.normal(0, 0.5, DOCS_PER_QUERY)
+            rel = np.digitize(scores, np.quantile(scores, [0.5, 0.8]))
+            yield feats, rel.astype(np.int64)
+
+    return gen
+
+
+def _reader(n_queries, seed_name, format):
+    queries = _queries(n_queries, seed_name)
+
+    def pointwise():
+        for feats, rel in queries():
+            for f, r in zip(feats, rel):
+                yield f, int(r)
+
+    def pairwise():
+        for feats, rel in queries():
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for feats, rel in queries():
+            yield feats, rel
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train_reader(format="pointwise"):
+    return _reader(N_QUERIES_TRAIN, "mq2007-train", format)
+
+
+def test_reader(format="pointwise"):
+    return _reader(N_QUERIES_TEST, "mq2007-test", format)

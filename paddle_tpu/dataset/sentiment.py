@@ -1,0 +1,46 @@
+"""Movie-review sentiment polarity (reference v2/dataset/sentiment.py API —
+the NLTK movie_reviews corpus). ``get_word_dict()`` then ``train()``/
+``test()`` yield ``(ids, 0|1)``. Synthetic fallback shares the IMDB topic
+construction with a distinct seed/vocab."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+VOCAB_SIZE = 1024
+TRAIN_SIZE = 1024
+TEST_SIZE = 128
+
+
+def get_word_dict():
+    return {f"s{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _reader(n, seed_name):
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        pos = np.arange(0, VOCAB_SIZE // 4)
+        neg = np.arange(VOCAB_SIZE // 4, VOCAB_SIZE // 2)
+        neutral = np.arange(VOCAB_SIZE // 2, VOCAB_SIZE)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, 50))
+            topic = pos if label else neg
+            k = max(1, length // 3)
+            ids = np.concatenate([rng.choice(topic, size=k),
+                                  rng.choice(neutral, size=length - k)])
+            rng.shuffle(ids)
+            yield ids.astype(np.int64).tolist(), label
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "sentiment-train")
+
+
+def test():
+    return _reader(TEST_SIZE, "sentiment-test")

@@ -1,0 +1,49 @@
+"""PASCAL VOC2012 segmentation (reference v2/dataset/voc2012.py API).
+
+``train()``/``test()``/``val()`` yield ``(image, label_mask)``: image
+float32[3, H, W], mask int64[H, W] with 21 classes — the reference's
+(image, label) segmentation pairs. Synthetic fallback: rectangle objects of
+class-coloured texture on background, masks exactly consistent with images.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+N_CLASSES = 21
+SIZE = 64
+TRAIN_SIZE = 256
+TEST_SIZE = 32
+
+
+def _reader(n, seed_name):
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            img = rng.rand(3, SIZE, SIZE).astype(np.float32) * 0.2
+            mask = np.zeros((SIZE, SIZE), np.int64)
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, N_CLASSES))
+                y0, x0 = rng.randint(0, SIZE - 16, size=2)
+                h, w = rng.randint(8, 16, size=2)
+                colour = common.synthetic_rng(f"voc-c{cls}").rand(3, 1, 1)
+                img[:, y0:y0 + h, x0:x0 + w] = colour + 0.05 * rng.rand(3, h, w)
+                mask[y0:y0 + h, x0:x0 + w] = cls
+            yield np.clip(img, 0, 1), mask
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "voc2012-train")
+
+
+def test():
+    return _reader(TEST_SIZE, "voc2012-test")
+
+
+def val():
+    return _reader(TEST_SIZE, "voc2012-val")

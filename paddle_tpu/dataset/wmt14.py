@@ -1,0 +1,60 @@
+"""WMT-14 FR->EN translation (reference v2/dataset/wmt14.py API).
+
+``train(dict_size)``/``test(dict_size)`` yield ``(src_ids, trg_ids,
+trg_next_ids)`` with <s>/<e>/<unk> at ids 0/1/2 (wmt14.py START/END/UNK).
+Synthetic fallback: the "translation" is a deterministic word-for-word map
+with local reordering — a seq2seq model can genuinely learn it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+START = 0  # <s>
+END = 1    # <e>
+UNK = 2    # <unk>
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def _word_map(dict_size):
+    rng = common.synthetic_rng("wmt14-map")
+    # bijective map over the content vocabulary [3, dict_size)
+    content = np.arange(3, dict_size)
+    perm = content.copy()
+    rng.shuffle(perm)
+    table = np.arange(dict_size)
+    table[content] = perm
+    return table
+
+
+def _reader(n, seed_name, dict_size):
+    table = _word_map(dict_size)
+
+    def reader():
+        rng = common.synthetic_rng(seed_name)
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, size=length)
+            trg = table[src]
+            # local reordering: swap adjacent pairs deterministically
+            for i in range(0, length - 1, 2):
+                if src[i] % 2 == 0:
+                    trg[i], trg[i + 1] = trg[i + 1], trg[i]
+            src_ids = src.astype(np.int64).tolist()
+            trg_in = [START] + trg.astype(np.int64).tolist()
+            trg_next = trg.astype(np.int64).tolist() + [END]
+            yield src_ids, trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size):
+    return _reader(TRAIN_SIZE, "wmt14-train", dict_size)
+
+
+def test(dict_size):
+    return _reader(TEST_SIZE, "wmt14-test", dict_size)

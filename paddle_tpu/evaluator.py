@@ -1,0 +1,224 @@
+"""Evaluators: metric accumulation across batches, built into the program.
+
+TPU-native parity with both evaluator stacks of the reference:
+- fluid evaluators (/root/reference/python/paddle/v2/fluid/evaluator.py):
+  state variables live in the program's scope, update ops run with every
+  batch, ``eval()`` computes the aggregate, ``reset()`` zeroes state.
+- legacy gserver evaluators
+  (/root/reference/paddle/gserver/evaluators/Evaluator.cpp:172-1357:
+  classification_error, precision_recall, rankauc/auc, chunk, ctc_error).
+
+States are persistable scope variables updated in-graph (the same
+state-threading the optimizer and batch_norm running stats use), so metric
+accumulation is fused into the training step — no extra host round-trips.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.program import default_main_program, default_startup_program
+from .initializer import ConstantInitializer
+from .layers.layer_helper import LayerHelper
+from .layers.sequence import get_seq_len
+
+
+class Evaluator:
+    """Base: manages state vars (created in both programs) + reset/eval.
+
+    Mirrors fluid evaluator.Evaluator (evaluator.py): ``states`` are
+    persistable variables zero-initialised by the startup program; update
+    ops appended to the main program accumulate into them; ``eval(exe,
+    scope)`` fetches and combines; ``reset(exe, scope)`` re-zeroes.
+    """
+
+    def __init__(self, name, main_program=None, startup_program=None):
+        self.helper = LayerHelper(name, main_program=main_program,
+                                  startup_program=startup_program)
+        self.states = []
+
+    def _create_state(self, suffix, shape, dtype="int64"):
+        main = self.helper.main_program
+        name = main.unique_name(f"{self.helper.layer_type}.{suffix}")
+        v = main.global_block.create_var(
+            name=name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True)
+        sb = self.helper.startup_program.global_block
+        sv = sb.create_var(name=name, shape=shape, dtype=dtype,
+                           persistable=True)
+        ConstantInitializer(0)(sv, sb)
+        self.states.append(v)
+        return v
+
+    def reset(self, executor, scope=None):
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        for v in self.states:
+            scope.set(v.name, np.zeros(
+                tuple(d for d in v.shape if d != -1) or (),
+                dtype=v.dtype.name if hasattr(v.dtype, "name") else v.dtype))
+
+    def eval(self, executor, scope=None):
+        raise NotImplementedError
+
+    def _fetch_states(self, scope):
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        return [np.asarray(scope.get(v.name)) for v in self.states]
+
+    def _accumulate(self, state_var, increment):
+        """state += increment, written back to the same scope name."""
+        self.helper.append_op(
+            "elementwise_add", {"X": [state_var], "Y": [increment]},
+            {"Out": [state_var]}, {})
+
+
+class Accuracy(Evaluator):
+    """Streaming top-k accuracy (fluid evaluator.Accuracy; legacy
+    classification_error_evaluator)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy_eval", **kwargs)
+        self.total = self._create_state("total", [], "int64")
+        self.correct = self._create_state("correct", [], "int64")
+        from . import layers
+
+        main = self.helper.main_program
+        startup = self.helper.startup_program
+        topk_out, topk_idx = layers.topk(input, k=k, main_program=main,
+                                         startup_program=startup)
+        outs, _ = self.helper.append_op(
+            "accuracy",
+            {"Out": [topk_out], "Indices": [topk_idx], "Label": [label]},
+            ["Accuracy", "Correct", "Total"])
+        self.batch_acc = outs["Accuracy"][0]
+        corr64 = self.helper.simple_op(
+            "cast", {"X": [outs["Correct"][0]]}, {"dtype": "int64"})
+        tot64 = self.helper.simple_op(
+            "cast", {"X": [outs["Total"][0]]}, {"dtype": "int64"})
+        self._accumulate(self.correct, corr64)
+        self._accumulate(self.total, tot64)
+
+    def eval(self, executor, scope=None):
+        total, correct = self._fetch_states(scope)
+        return float(correct) / max(float(total), 1.0)
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (fluid ChunkEvaluator / legacy chunk evaluator).
+    eval() returns (precision, recall, f1)."""
+
+    def __init__(self, input, label, chunk_scheme="IOB", num_chunk_types=1,
+                 **kwargs):
+        super().__init__("chunk_eval_streaming", **kwargs)
+        self.n_infer = self._create_state("num_infer", [1], "int64")
+        self.n_label = self._create_state("num_label", [1], "int64")
+        self.n_correct = self._create_state("num_correct", [1], "int64")
+        from . import layers
+
+        main = self.helper.main_program
+        startup = self.helper.startup_program
+        _, _, _, ni, nl, nc = layers.chunk_eval(
+            input, label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types, main_program=main,
+            startup_program=startup)
+        self._accumulate(self.n_infer, ni)
+        self._accumulate(self.n_label, nl)
+        self._accumulate(self.n_correct, nc)
+
+    def eval(self, executor, scope=None):
+        ni, nl, nc = self._fetch_states(scope)
+        ni, nl, nc = float(ni[0]), float(nl[0]), float(nc[0])
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class PrecisionRecall(Evaluator):
+    """Multi-class streaming precision/recall/F1 from confusion counts
+    (legacy precision_recall_evaluator, Evaluator.cpp). eval() returns
+    (macro_p, macro_r, macro_f1) plus per-class arrays."""
+
+    def __init__(self, input, label, num_classes, **kwargs):
+        super().__init__("precision_recall", **kwargs)
+        self.num_classes = num_classes
+        self.tp = self._create_state("tp", [num_classes], "int64")
+        self.fp = self._create_state("fp", [num_classes], "int64")
+        self.fn = self._create_state("fn", [num_classes], "int64")
+        outs, _ = self.helper.append_op(
+            "confusion_counts", {"Pred": [input], "Label": [label]},
+            ["TP", "FP", "FN"], {"num_classes": num_classes})
+        self._accumulate(self.tp, outs["TP"][0])
+        self._accumulate(self.fp, outs["FP"][0])
+        self._accumulate(self.fn, outs["FN"][0])
+
+    def eval(self, executor, scope=None):
+        tp, fp, fn = [a.astype(np.float64) for a in
+                      self._fetch_states(scope)]
+        p = tp / np.maximum(tp + fp, 1)
+        r = tp / np.maximum(tp + fn, 1)
+        f1 = 2 * p * r / np.maximum(p + r, 1e-10)
+        return float(p.mean()), float(r.mean()), float(f1.mean())
+
+
+class Auc(Evaluator):
+    """Streaming AUC via score histograms (legacy rankauc / AucEvaluator,
+    Evaluator.cpp; fluid auc_op.cc). Positive-class scores bucketed into
+    ``num_thresholds`` bins; AUC computed by trapezoidal rule on eval()."""
+
+    def __init__(self, input, label, num_thresholds=200, **kwargs):
+        super().__init__("auc", **kwargs)
+        self.num_thresholds = num_thresholds
+        self.pos = self._create_state("pos_hist", [num_thresholds], "int64")
+        self.neg = self._create_state("neg_hist", [num_thresholds], "int64")
+        outs, _ = self.helper.append_op(
+            "auc_histogram", {"Score": [input], "Label": [label]},
+            ["Pos", "Neg"], {"num_thresholds": num_thresholds})
+        self._accumulate(self.pos, outs["Pos"][0])
+        self._accumulate(self.neg, outs["Neg"][0])
+
+    def eval(self, executor, scope=None):
+        pos, neg = self._fetch_states(scope)
+        pos, neg = pos.astype(np.float64), neg.astype(np.float64)
+        # cum from highest threshold down: TPR/FPR curve
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class EditDistance(Evaluator):
+    """Streaming average edit distance (legacy ctc_error_evaluator;
+    fluid edit_distance_op.cc)."""
+
+    def __init__(self, input, label, normalized=False, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        self.total_dist = self._create_state("total_dist", [], "float32")
+        self.total_seqs = self._create_state("total_seqs", [], "float32")
+        ins = {"Hyps": [input], "Refs": [label]}
+        hl, rl = get_seq_len(input), get_seq_len(label)
+        if hl is not None:
+            ins["HypsLength"] = [hl]
+        if rl is not None:
+            ins["RefsLength"] = [rl]
+        outs, _ = self.helper.append_op(
+            "edit_distance", ins, ["Out", "SequenceNum"],
+            {"normalized": normalized})
+        self.batch_dist = outs["Out"][0]
+        dist_sum = self.helper.simple_op(
+            "reduce_sum", {"X": [self.batch_dist]}, {"keep_dim": False})
+        n = self.helper.simple_op(
+            "cast", {"X": [outs["SequenceNum"][0]]}, {"dtype": "float32"})
+        self._accumulate(self.total_dist, dist_sum)
+        self._accumulate(self.total_seqs, n)
+
+    def eval(self, executor, scope=None):
+        dist, n = self._fetch_states(scope)
+        return float(dist) / max(float(n), 1.0)

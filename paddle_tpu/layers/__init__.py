@@ -14,8 +14,8 @@ from .sequence import (dynamic_gru, dynamic_lstm, gru_unit, lstm_unit,
                        sequence_last_step, sequence_pool, sequence_reverse,
                        sequence_softmax)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
-                     fill_constant, fill_constant_batch_size_like, mean,
-                     one_hot, reshape, scale, split, sums, transpose)
+                     fill_constant, fill_constant_batch_size_like, matmul,
+                     mean, one_hot, reshape, scale, split, sums, transpose)
 
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
@@ -23,7 +23,7 @@ __all__ = (
      "square_error_cost", "accuracy", "topk",
      "linear_chain_crf", "crf_decoding", "chunk_eval",
      "fill_constant", "fill_constant_batch_size_like", "create_global_var", "cast", "concat", "sums", "assign",
-     "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax",
+     "matmul", "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax",
      "sequence_pool", "sequence_first_step", "sequence_last_step",
      "sequence_softmax", "sequence_expand", "sequence_reverse",
      "sequence_conv", "sequence_concat", "row_conv",

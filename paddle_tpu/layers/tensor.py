@@ -107,3 +107,14 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
         "fill_constant_batch_size_like", {"Input": [input]},
         {"shape": list(shape), "dtype": str(dtype), "value": value,
          "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           main_program=None, startup_program=None):
+    """Batched matmul (matmul_op.cc): used for attention score/context
+    products over [b, T, d] sequence tensors."""
+    helper = _helper("matmul", main_program, startup_program)
+    return helper.simple_op(
+        "matmul", {"X": [x], "Y": [y]},
+        {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+         "alpha": alpha})

@@ -17,9 +17,11 @@ from .common import broadcast_to_x, maybe, out, single
 
 
 def _take_label_prob(x, label):
-    """Pick per-row probability at integer label; label [N,1] or [N]."""
-    lab = label.reshape(-1)
-    return jnp.take_along_axis(x, lab[:, None].astype(jnp.int32), axis=1)
+    """Pick per-row probability at integer label along the last (class)
+    axis; works for [N, D] logits with [N]/[N,1] labels and rank-3
+    [b, T, D] sequence logits with [b, T]/[b, T, 1] labels alike."""
+    lab = label.reshape(x.shape[:-1])[..., None].astype(jnp.int32)
+    return jnp.take_along_axis(x, lab, axis=-1)
 
 
 @register_op("cross_entropy")
@@ -28,7 +30,7 @@ def cross_entropy(attrs, ins):
     label = single(ins, "Label")
     eps = 1e-12
     if attrs.get("soft_label", False):
-        y = -jnp.sum(label * jnp.log(x + eps), axis=1, keepdims=True)
+        y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
     else:
         y = -jnp.log(_take_label_prob(x, label) + eps)
     return out(Y=y)
@@ -42,7 +44,8 @@ def _softmax_with_ce_grad(attrs, ins, outs, ogs):
     if attrs.get("soft_label", False):
         grad = sm - label
     else:
-        onehot = jax.nn.one_hot(label.reshape(-1), logits.shape[-1], dtype=sm.dtype)
+        onehot = jax.nn.one_hot(label.reshape(logits.shape[:-1]),
+                                logits.shape[-1], dtype=sm.dtype)
         grad = sm - onehot
     dy = ogs["Loss"][0]
     return {"Logits": [grad * dy], "Label": [None]}

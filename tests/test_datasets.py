@@ -1,0 +1,102 @@
+"""Dataset smoke tests: every reader yields well-formed, deterministic
+samples with the reference's shapes/dtypes (mirroring
+/root/reference/python/paddle/v2/dataset/tests/*_test.py)."""
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def first_n(reader, n=5):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+def test_cifar():
+    for r, nc in ((dataset.cifar.train10(), 10),
+                  (dataset.cifar.test10(), 10),
+                  (dataset.cifar.train100(), 100)):
+        img, label = first_n(r, 1)[0]
+        assert img.shape == (3072,) and img.dtype == np.float32
+        assert 0 <= label < nc
+
+
+def test_imdb():
+    wd = dataset.imdb.word_dict()
+    samples = first_n(dataset.imdb.train(wd), 10)
+    for ids, label in samples:
+        assert label in (0, 1)
+        assert all(0 <= i < len(wd) for i in ids)
+    # deterministic
+    again = first_n(dataset.imdb.train(wd), 10)
+    assert samples[0][0] == again[0][0]
+
+
+def test_imikolov():
+    wd = dataset.imikolov.build_dict()
+    grams = first_n(dataset.imikolov.train(wd, 5), 20)
+    for g in grams:
+        assert len(g) == 5
+        assert all(0 <= i < len(wd) for i in g)
+
+
+def test_movielens():
+    s = first_n(dataset.movielens.train(), 5)
+    uid, gender, age, job, mid, cats, titles, score = s[0]
+    assert 1 <= uid <= dataset.movielens.max_user_id()
+    assert 1 <= mid <= dataset.movielens.max_movie_id()
+    assert 1.0 <= score <= 5.0
+    assert isinstance(cats, list) and isinstance(titles, list)
+
+
+def test_conll05():
+    word_d, verb_d, label_d = dataset.conll05.get_dict()
+    assert len(label_d) == 9
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[1] == 32
+    for sample in first_n(dataset.conll05.test(), 5):
+        assert len(sample) == 9
+        words, preds = sample[0], sample[1]
+        labels = sample[8]
+        assert len(words) == len(labels) == len(preds)
+        assert all(0 <= l < 9 for l in labels)
+
+
+def test_wmt14():
+    for src, trg_in, trg_next in first_n(dataset.wmt14.train(100), 5):
+        assert trg_in[0] == 0           # <s>
+        assert trg_next[-1] == 1        # <e>
+        assert len(trg_in) == len(trg_next)
+        # learnable: same length mapping
+        assert len(src) == len(trg_in) - 1
+
+
+def test_sentiment():
+    for ids, label in first_n(dataset.sentiment.train(), 5):
+        assert label in (0, 1) and len(ids) > 0
+
+
+def test_mq2007():
+    f, r = first_n(dataset.mq2007.train_reader("pointwise"), 1)[0]
+    assert f.shape == (46,) and r in (0, 1, 2)
+    hi, lo = first_n(dataset.mq2007.train_reader("pairwise"), 1)[0]
+    assert hi.shape == lo.shape == (46,)
+    feats, rels = first_n(dataset.mq2007.train_reader("listwise"), 1)[0]
+    assert feats.shape[0] == rels.shape[0]
+
+
+def test_flowers():
+    img, label = first_n(dataset.flowers.train(), 1)[0]
+    assert img.shape == (3 * 224 * 224,)
+    assert 0 <= label < 102
+
+
+def test_voc2012():
+    img, mask = first_n(dataset.voc2012.train(), 1)[0]
+    assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+    assert mask.max() < 21
+    # mask consistent with painted rectangles: object pixels differ from bg
+    assert (mask > 0).sum() > 0

@@ -1,0 +1,141 @@
+"""Evaluator tests: streaming metric state vs sklearn-free numpy references
+(mirrors the reference's evaluator unit checks,
+/root/reference/paddle/gserver/tests/test_Evaluator.cpp and fluid
+tests/test_accuracy_op.py, test_edit_distance_op.py, test_auc_op.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+
+def run_op(op_type, ins, attrs=None):
+    import jax.numpy as jnp
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins.items()}
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+def np_edit_distance(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((n + 1, m + 1), np.int32)
+    d[0, :] = np.arange(m + 1)
+    d[:, 0] = np.arange(n + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[j - 1] != b[i - 1]))
+    return d[n, m]
+
+
+class TestEditDistanceOp:
+    def test_matches_numpy_dp(self):
+        rng = np.random.RandomState(0)
+        b, Th, Tr, V = 5, 7, 6, 4
+        hyp = rng.randint(0, V, size=(b, Th)).astype(np.int64)
+        ref = rng.randint(0, V, size=(b, Tr)).astype(np.int64)
+        hlen = rng.randint(1, Th + 1, size=b).astype(np.int32)
+        rlen = rng.randint(1, Tr + 1, size=b).astype(np.int32)
+        outs = run_op("edit_distance",
+                      {"Hyps": [hyp], "Refs": [ref],
+                       "HypsLength": [hlen], "RefsLength": [rlen]})
+        got = np.asarray(outs["Out"][0])[:, 0]
+        for r in range(b):
+            ref_d = np_edit_distance(hyp[r, : hlen[r]], ref[r, : rlen[r]])
+            assert got[r] == ref_d, (r, got[r], ref_d)
+
+    def test_identical_is_zero(self):
+        seq = np.array([[1, 2, 3]], np.int64)
+        outs = run_op("edit_distance", {"Hyps": [seq], "Refs": [seq]})
+        assert float(np.asarray(outs["Out"][0])) == 0.0
+
+
+class TestConfusionCounts:
+    def test_counts(self):
+        pred = np.array([0, 1, 1, 2, 2, 2], np.int64)
+        label = np.array([0, 1, 2, 2, 2, 0], np.int64)
+        outs = run_op("confusion_counts", {"Pred": [pred], "Label": [label]},
+                      {"num_classes": 3})
+        np.testing.assert_array_equal(np.asarray(outs["TP"][0]), [1, 1, 2])
+        np.testing.assert_array_equal(np.asarray(outs["FP"][0]), [0, 1, 1])
+        np.testing.assert_array_equal(np.asarray(outs["FN"][0]), [1, 0, 1])
+
+
+class TestStreamingEvaluators:
+    def test_accuracy_streams_across_batches(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            scores = layers.data("scores", shape=[4])
+            label = layers.data("label", shape=[1], dtype="int64")
+            acc_eval = pt.evaluator.Accuracy(scores, label)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        acc_eval.reset(exe, scope)
+        rng = np.random.RandomState(0)
+        hits = total = 0
+        for _ in range(3):
+            s = rng.randn(8, 4).astype(np.float32)
+            y = rng.randint(0, 4, size=(8, 1)).astype(np.int64)
+            exe.run(main, feed={"scores": s, "label": y},
+                    fetch_list=[acc_eval.batch_acc], scope=scope)
+            hits += (s.argmax(1) == y[:, 0]).sum()
+            total += 8
+        np.testing.assert_allclose(acc_eval.eval(exe, scope), hits / total,
+                                   rtol=1e-6)
+
+    def test_auc_reasonable(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            score = layers.data("score", shape=[1])
+            label = layers.data("label", shape=[1], dtype="int64")
+            auc_eval = pt.evaluator.Auc(score, label)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        auc_eval.reset(exe, scope)
+        rng = np.random.RandomState(0)
+        # scores correlated with labels -> AUC well above 0.5
+        y = rng.randint(0, 2, size=(256, 1)).astype(np.int64)
+        s = (0.6 * y + 0.4 * rng.rand(256, 1)).astype(np.float32)
+        exe.run(main, feed={"score": s, "label": y}, fetch_list=[],
+                scope=scope)
+        auc = auc_eval.eval(exe, scope)
+        assert auc > 0.9, auc
+
+    def test_precision_recall(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            scores = layers.data("scores", shape=[3])
+            label = layers.data("label", shape=[1], dtype="int64")
+            pr_eval = pt.evaluator.PrecisionRecall(scores, label,
+                                                   num_classes=3)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        pr_eval.reset(exe, scope)
+        # perfect predictions -> all ones
+        y = np.array([[0], [1], [2], [1]], np.int64)
+        s = np.eye(3, dtype=np.float32)[y[:, 0]] * 5
+        exe.run(main, feed={"scores": s, "label": y}, fetch_list=[],
+                scope=scope)
+        p, r, f1 = pr_eval.eval(exe, scope)
+        assert p == r == f1 == 1.0
+
+    def test_chunk_evaluator_streams(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            pred = layers.data("pred", shape=[1], dtype="int64", lod_level=1)
+            lab = layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+            ch = pt.evaluator.ChunkEvaluator(pred, lab, num_chunk_types=1)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        ch.reset(exe, scope)
+        # batch: label B I O B(2 chunks), pred identical -> perfect
+        seq = np.array([[0, 1, 2, 0]], np.int64)
+        lens = np.array([4], np.int32)
+        exe.run(main, feed={"pred": seq, "pred@len": lens,
+                            "lab": seq, "lab@len": lens},
+                fetch_list=[], scope=scope)
+        p, r, f1 = ch.eval(exe, scope)
+        assert (p, r, f1) == (1.0, 1.0, 1.0)
