@@ -1,0 +1,4 @@
+"""Hand-written Pallas TPU kernels for ops where XLA fusion is not enough
+(SURVEY.md §7: the fused-kernel tier replacing paddle/cuda's hl_* CUDA
+kernels)."""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,191 @@
+"""Flash attention: Pallas TPU kernel with online softmax.
+
+The long-context workhorse. The reference framework predates Transformers
+(SURVEY.md §5.7) — its closest analogues are the fused CUDA cell kernels
+(/root/reference/paddle/cuda/src/hl_cuda_lstm.cu) whose role (keep the hot
+loop's working set on-chip instead of round-tripping HBM) this kernel plays
+for attention: O(T^2) scores never materialise in HBM; each (batch*head,
+q-block) grid cell streams K/V blocks through VMEM, maintaining the running
+max/denominator of the softmax (the standard online-softmax recurrence), so
+HBM traffic is O(T*d) instead of O(T^2).
+
+On non-TPU backends (the CPU test mesh) ``flash_attention`` falls back to a
+pure-jnp reference — same semantics, XLA-fused. The backward pass always
+uses the recompute-based jnp formulation via ``jax.custom_vjp``: XLA fuses
+it well, and it keeps the Pallas surface forward-only.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _pick_block(t, preferred):
+    b = min(preferred, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
+
+
+def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
+    """Pure-jnp attention over [B, H, T, D]; the semantic ground truth."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    T = q.shape[2], k.shape[2]
+    if causal:
+        qi = jnp.arange(T[0])[:, None]
+        kj = jnp.arange(T[1])[None, :]
+        s = jnp.where(qi >= kj, s, -jnp.inf)
+    if lengths is not None:
+        kj = jnp.arange(T[1])[None, None, None, :]
+        s = jnp.where(kj < lengths[:, None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (padding queries) produce NaN-free zeros
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
+                  sm_scale, kv_len):
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(1)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
+    # lengths arrive via scalar prefetch (rank-1 SMEM blocks of size 1 do
+    # not lower on Mosaic); index by the batch*head grid position
+    length = len_ref[pl.program_id(0)]
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    n_blocks = kv_len // block_k
+    if causal:
+        # blocks fully above the diagonal contribute nothing — skip them
+        last = (qb + 1) * block_q  # exclusive bound on visible columns
+        n_live = (last + block_k - 1) // block_k
+        ub = jnp.minimum(n_blocks, n_live)
+    else:
+        ub = n_blocks
+
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < length
+        if causal:
+            mask &= q_pos >= k_pos
+        s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # keep -inf rows stable (fully masked so far)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, ub, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, lengths, causal, sm_scale, block_q, block_k,
+                   interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    q3 = q.reshape(BH, Tq, D)
+    k3 = k.reshape(BH, Tk, D)
+    v3 = v.reshape(BH, Tk, D)
+    if lengths is None:
+        lens = jnp.full((B,), Tk, jnp.int32)
+    else:
+        lens = lengths.astype(jnp.int32)
+    lens_bh = jnp.repeat(lens, H)  # [BH]
+
+    block_q = _pick_block(Tq, block_q)
+    block_k = _pick_block(Tk, block_k)
+    grid = (BH, Tq // block_q)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               sm_scale=sm_scale, kv_len=Tk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # lens_bh, available before the body runs
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, lens: (b, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda b, i, lens: (b, i, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        interpret=interpret,
+    )(lens_bh, q3, k3, v3)
+    return out.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _attention(q, k, v, lengths, causal, sm_scale):
+    if jax.default_backend() == "tpu":
+        return _flash_forward(q, k, v, lengths, causal, sm_scale,
+                              DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                              interpret=False)
+    return reference_attention(q, k, v, lengths, causal, sm_scale)
+
+
+def _attention_fwd(q, k, v, lengths, causal, sm_scale):
+    return _attention(q, k, v, lengths, causal, sm_scale), (q, k, v, lengths)
+
+
+def _attention_bwd(causal, sm_scale, res, g):
+    q, k, v, lengths = res
+
+    def f(q, k, v):
+        return reference_attention(q, k, v, lengths, causal, sm_scale)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_attention.defvjp(_attention_fwd, _attention_bwd)
+
+
+def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
+    """Scaled-dot-product attention over [B, H, T, D] tensors.
+
+    Pallas flash kernel on TPU, jnp reference elsewhere; differentiable via
+    recompute. ``lengths`` [B] masks K/V padding columns.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _attention(q, k, v, lengths, causal, float(sm_scale))

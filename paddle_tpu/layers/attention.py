@@ -1,0 +1,103 @@
+"""Attention & transformer layers (capability extension beyond the
+reference — SURVEY.md §5.7; the reference's sequence modelling tops out at
+LSTM/GRU + RecurrentGradientMachine).
+
+TPU-first design notes: weights are fused (one qkv projection = one MXU
+matmul), heads live in a [B, H, T, D] layout whose last dim maps to lanes,
+attention is the flash kernel, and everything between the matmuls fuses
+under the whole-block XLA compile.
+"""
+from __future__ import annotations
+
+from ..initializer import NormalInitializer, XavierInitializer
+from .layer_helper import LayerHelper
+from .sequence import get_seq_len
+
+
+def multi_head_attention(queries, keys=None, values=None, d_model=None,
+                         num_heads=8, causal=False, param_attr=None,
+                         main_program=None, startup_program=None):
+    """Multi-head attention over [b, T, d_model] sequences; self-attention
+    when keys/values are omitted. Returns [b, T, d_model]."""
+    from . import tensor as T
+
+    helper = LayerHelper("multi_head_attention", main_program=main_program,
+                         startup_program=startup_program)
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+    d_model = d_model or queries.shape[-1]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} not divisible by heads "
+                         f"{num_heads}")
+    head_d = d_model // num_heads
+    self_attn = keys is queries
+
+    def proj(x, width, name):
+        # each projection gets its own parameter: suffix a user-provided
+        # name so qkv/out never collapse onto one shared weight
+        from ..param_attr import ParamAttr
+
+        attr = ParamAttr.to_attr(param_attr)
+        if attr is not None and attr.name:
+            import copy
+
+            attr = copy.copy(attr)
+            attr.name = f"{attr.name}.{name}"
+        w = helper.create_parameter(
+            attr, shape=[x.shape[-1], width], dtype=x.dtype,
+            default_initializer=XavierInitializer())
+        return helper.simple_op("mul", {"X": [x], "Y": [w]},
+                                {"x_num_col_dims": 2})
+
+    mp, sp = helper.main_program, helper.startup_program
+    if self_attn:
+        qkv = proj(queries, 3 * d_model, "qkv")  # ONE fused MXU matmul
+        q, k, v = T.split(qkv, 3, dim=2, main_program=mp, startup_program=sp)
+    else:
+        q = proj(queries, d_model, "q")
+        k = proj(keys, d_model, "k")
+        v = proj(values, d_model, "v")
+
+    def heads(x, Tlen):
+        x = T.reshape(x, [-1, Tlen, num_heads, head_d], main_program=mp,
+                      startup_program=sp)
+        return T.transpose(x, [0, 2, 1, 3], main_program=mp,
+                           startup_program=sp)
+
+    tq, tk = queries.shape[1], keys.shape[1]
+    qh, kh, vh = heads(q, tq), heads(k, tk), heads(v, tk)
+    ins = {"Q": [qh], "K": [kh], "V": [vh]}
+    sl = get_seq_len(keys)
+    if sl is not None:
+        ins["Length"] = [sl]
+    ctx = helper.simple_op("scaled_dot_product_attention", ins,
+                           {"causal": causal})
+    ctx = T.transpose(ctx, [0, 2, 1, 3], main_program=mp, startup_program=sp)
+    ctx = T.reshape(ctx, [-1, tq, d_model], main_program=mp,
+                    startup_program=sp)
+    o = proj(ctx, d_model, "out")
+    o.seq_len = get_seq_len(queries)
+    return o
+
+
+def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
+                              dropout_prob=0.0, main_program=None,
+                              startup_program=None):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    from . import nn as N
+
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    d_model = x.shape[-1]
+    h = N.layer_norm(x, begin_norm_axis=2, **kw)
+    h.seq_len = get_seq_len(x)
+    attn = multi_head_attention(h, num_heads=num_heads, causal=causal, **kw)
+    helper = LayerHelper("transformer", **kw)
+    x = helper.simple_op("elementwise_add", {"X": [x], "Y": [attn]})
+    h2 = N.layer_norm(x, begin_norm_axis=2, **kw)
+    ff = N.fc(h2, size=d_ff, num_flatten_dims=2, act="gelu", **kw)
+    if dropout_prob:
+        ff = N.dropout(ff, dropout_prob, **kw)
+    ff = N.fc(ff, size=d_model, num_flatten_dims=2, **kw)
+    o = helper.simple_op("elementwise_add", {"X": [x], "Y": [ff]})
+    o.seq_len = get_seq_len(x)
+    return o

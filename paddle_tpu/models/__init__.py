@@ -16,8 +16,10 @@ from .vgg import vgg
 from .resnet import resnet_imagenet, resnet_cifar10
 from .googlenet import googlenet
 from .smallnet import smallnet_mnist_cifar
+from .transformer import transformer_lm
 
 __all__ = [
+    "transformer_lm",
     "lenet5", "alexnet", "vgg", "resnet_imagenet", "resnet_cifar10",
     "googlenet", "smallnet_mnist_cifar",
 ]

@@ -1,0 +1,42 @@
+"""Transformer language model (decoder-only, causal).
+
+Capability extension beyond the reference (which predates Transformers);
+the flagship long-context model: flash attention on one chip,
+ring-attention sequence parallelism across chips
+(parallel/ring_attention.py) when T outgrows a single device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..layers.layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
+                   d_ff=None, max_len=2048, main_program=None,
+                   startup_program=None):
+    """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
+    learned positional embedding, weight-tied-free output head."""
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    d_ff = d_ff or 4 * d_model
+    tok = layers.embedding(ids, size=[vocab_size, d_model],
+                           param_attr=ParamAttr(name="tok_emb"), **kw)
+    tok.seq_len = getattr(ids, "seq_len", None)
+    T = ids.shape[1]
+    helper = LayerHelper("transformer_lm", **kw)
+    pos_table = helper.create_parameter(
+        ParamAttr(name="pos_emb"), shape=[max_len, d_model], dtype="float32")
+    # slice the first T rows; T is static under the whole-block compile
+    pos = helper.simple_op("slice", {"X": [pos_table]},
+                           {"axes": [0], "starts": [0], "ends": [T]})
+    x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
+    x.seq_len = tok.seq_len
+    for _ in range(n_layers):
+        x = layers.transformer_encoder_layer(x, num_heads=num_heads,
+                                             d_ff=d_ff, causal=True, **kw)
+    x = layers.layer_norm(x, begin_norm_axis=2, **kw)
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                       bias_attr=False, **kw)
+    return logits

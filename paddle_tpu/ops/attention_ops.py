@@ -1,0 +1,28 @@
+"""Attention ops: fused scaled-dot-product attention.
+
+No counterpart exists in the reference (it predates Transformers —
+SURVEY.md §5.7); this is the capability-extension tier. The kernel routes to
+the Pallas flash-attention kernel on TPU (kernels/flash_attention.py) and a
+fused-by-XLA jnp reference elsewhere; gradients come from the op's
+custom_vjp (recompute), so the generic backward works unchanged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..kernels.flash_attention import flash_attention
+from .common import maybe, out, single
+
+
+@register_op("scaled_dot_product_attention", optional_inputs=("Length",))
+def scaled_dot_product_attention(attrs, ins):
+    """Q/K/V [B, H, T, D] -> [B, H, T, D]. attrs: causal, sm_scale."""
+    q = single(ins, "Q")
+    k = single(ins, "K")
+    v = single(ins, "V")
+    lengths = maybe(ins, "Length")
+    y = flash_attention(q, k, v, lengths=lengths,
+                        causal=attrs.get("causal", False),
+                        sm_scale=attrs.get("sm_scale"))
+    return out(Out=y)

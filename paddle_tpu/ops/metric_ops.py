@@ -26,6 +26,9 @@ def confusion_counts(attrs, ins):
     n = int(attrs["num_classes"])
     if pred.ndim == 2 and pred.shape[-1] > 1:
         pred = jnp.argmax(pred, axis=-1)
+    elif jnp.issubdtype(pred.dtype, jnp.floating):
+        # single-column probability scores: threshold, don't truncate
+        pred = (pred.reshape(-1) > 0.5)
     pred = pred.reshape(-1).astype(jnp.int32)
     hit = pred == label
     tp = jax.ops.segment_sum(hit.astype(jnp.int64), label, num_segments=n)

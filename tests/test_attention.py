@@ -1,0 +1,169 @@
+"""Attention stack tests: flash kernel semantics (pallas interpret on CPU),
+ring attention vs full attention on the 8-device mesh, transformer layers
+and LM training."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.kernels import flash_attention as fa
+from paddle_tpu.parallel import make_mesh, ring_attention
+
+
+def naive_attention(q, k, v, lengths=None, causal=False):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((Tq, Tk), bool))
+        s = np.where(mask, s, -np.inf)
+    if lengths is not None:
+        kj = np.arange(Tk)[None, None, None, :]
+        s = np.where(kj < lengths[:, None, None, None], s, -np.inf)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p = p / np.maximum(p.sum(-1, keepdims=True), 1e-30)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+class TestFlashAttention:
+    def _rand(self, B=2, H=3, T=16, D=8, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: rng.randn(B, H, T, D).astype(np.float32)
+        return mk(), mk(), mk()
+
+    def test_matches_naive(self):
+        q, k, v = self._rand()
+        got = np.asarray(fa.flash_attention(q, k, v))
+        np.testing.assert_allclose(got, naive_attention(q, k, v),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal(self):
+        q, k, v = self._rand(seed=1)
+        got = np.asarray(fa.flash_attention(q, k, v, causal=True))
+        np.testing.assert_allclose(got, naive_attention(q, k, v, causal=True),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_lengths_mask(self):
+        q, k, v = self._rand(seed=2)
+        lengths = np.array([16, 7], np.int32)
+        got = np.asarray(fa.flash_attention(q, k, v, lengths=lengths))
+        ref = naive_attention(q, k, v, lengths=lengths)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_pallas_kernel_interpret_matches(self):
+        """Run the actual Pallas kernel in interpret mode on CPU."""
+        q, k, v = self._rand(B=1, H=2, T=32, D=8, seed=3)
+        lengths = np.array([25], np.int32)
+        got = np.asarray(fa._flash_forward(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(lengths), True, 1.0 / math.sqrt(8),
+            block_q=16, block_k=8, interpret=True))
+        ref = naive_attention(q, k, v, lengths=lengths, causal=True)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_gradients_flow(self):
+        q, k, v = self._rand(B=1, H=1, T=8, D=4, seed=4)
+
+        def loss(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(jnp.asarray(q), jnp.asarray(k),
+                                              jnp.asarray(v))
+        ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa.reference_attention(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(g, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        """Sequence sharded over 8 devices == single-device full attention."""
+        mesh = make_mesh({"sp": 8})
+        rng = np.random.RandomState(0)
+        B, H, T, D = 2, 2, 64, 8
+        q = rng.randn(B, H, T, D).astype(np.float32)
+        k = rng.randn(B, H, T, D).astype(np.float32)
+        v = rng.randn(B, H, T, D).astype(np.float32)
+        got = np.asarray(ring_attention(q, k, v, mesh, seq_axis="sp",
+                                        causal=causal))
+        ref = naive_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_grad_through_ring(self):
+        mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 1, 16, 4).astype(np.float32)
+
+        def f(x):
+            return jnp.sum(ring_attention(x, x, x, mesh, seq_axis="sp",
+                                          causal=True))
+
+        def f_ref(x):
+            return jnp.sum(fa.reference_attention(x, x, x, causal=True))
+
+        g = jax.grad(f)(jnp.asarray(x))
+        g_ref = jax.grad(f_ref)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTransformer:
+    def test_mha_shapes_and_grads(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[12, 32])  # [b, T, d]
+            y = layers.multi_head_attention(x, num_heads=4, causal=True)
+            loss = layers.mean(layers.square(y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        xb = np.random.RandomState(0).randn(2, 12, 32).astype(np.float32)
+        (lo,) = exe.run(main, feed={"x": xb}, fetch_list=[loss], scope=scope)
+        assert np.isfinite(lo)
+
+    def test_tiny_lm_learns_induction_task(self):
+        """Causal LM on the induction/copy task: the sequence's second half
+        repeats its first half, so next-token prediction there requires
+        attention to position t-half — only the attention path can solve it.
+        Random first-half targets bound the loss from below at ~ln(V)/2."""
+        from paddle_tpu import models
+
+        V, T = 16, 16
+        half = T // 2
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            nxt = layers.data("nxt", shape=[T], dtype="int64")
+            logits = models.transformer_lm(ids, V, d_model=48, n_layers=2,
+                                           num_heads=4, max_len=T)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, nxt))
+            pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(150):
+            p = rng.randint(0, V, size=(16, half)).astype(np.int64)
+            x = np.concatenate([p, p], axis=1)
+            y = np.roll(x, -1, axis=1)
+            y[:, -1] = x[:, 0]
+            (lo,) = exe.run(main, feed={"ids": x, "nxt": y},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(lo))
+        # full-entropy baseline is ln(16)=2.77; solving the predictable half
+        # must drive mean loss well below it
+        assert losses[-1] < 0.62 * losses[0], (losses[0], losses[-1])
