@@ -9,8 +9,8 @@ jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
 instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
-from . import (evaluator, event, initializer, layers, models, nets, optimizer,
-               parallel, regularizer, trainer)
+from . import (checkpoint, evaluator, event, initializer, layers, master,
+               models, nets, optimizer, parallel, regularizer, trainer)
 from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,

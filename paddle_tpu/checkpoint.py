@@ -1,0 +1,130 @@
+"""Checkpoint/resume: atomic, self-describing training snapshots.
+
+Mirrors the reference's checkpoint designs:
+- Go pserver: UUID-named payload + md5/timestamp meta, atomic replace, old
+  checkpoint removal (/root/reference/go/pserver/service.go:346-420,
+  doc/design/cluster_train/checkpointing.md).
+- Legacy trainer: per-pass param dirs (--save_dir, trainer/ParamUtil.h:58)
+  with --init_model_path/--start_pass resume (TrainerMain.cpp:25-27).
+
+A checkpoint captures EVERYTHING persistable in the scope — parameters,
+optimizer slots (momentum/adam moments live in the scope like any state),
+batch-norm running stats, evaluator accumulators, the RNG key — so resume
+is bit-exact. Written as one .npz + a JSON meta with md5, then atomically
+renamed; ``max_keep`` old checkpoints are pruned. In multi-trainer runs
+only one process should save (the reference elects via master
+RequestSaveModel, go/master/service.go:474-481 — here: save when
+``trainer_id == 0``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .core.program import RNG_VAR
+from .core.scope import global_scope
+
+META_NAME = "checkpoint.meta"
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def save_checkpoint(dirname: str, scope=None, step: int = 0,
+                    max_keep: int = 3, extra: Optional[dict] = None) -> str:
+    """Snapshot the whole scope into ``dirname``; returns the payload path."""
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays, dtypes = {}, {}
+    for name in scope.keys():
+        arr = np.asarray(scope.get(name))
+        dtypes[name] = str(arr.dtype)
+        if arr.dtype.kind == "V":
+            # extension dtypes (bfloat16, fp8): store raw bits; the dtype
+            # map restores the view on load
+            arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+        arrays[name] = arr
+    arrays["__dtypes__"] = np.frombuffer(
+        json.dumps(dtypes).encode(), dtype=np.uint8)
+    payload = os.path.join(dirname, f"ckpt-{step}.npz")
+    tmp = payload + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, payload)  # atomic
+
+    meta = {
+        "latest": os.path.basename(payload),
+        "step": step,
+        "md5": _md5(payload),
+        "timestamp": time.time(),
+        "extra": extra or {},
+    }
+    meta_tmp = os.path.join(dirname, META_NAME + f".tmp{os.getpid()}")
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(meta_tmp, os.path.join(dirname, META_NAME))
+
+    # prune old checkpoints (keep the newest max_keep; the one just written
+    # always survives)
+    cks = sorted(
+        (p for p in os.listdir(dirname)
+         if p.startswith("ckpt-") and p.endswith(".npz")),
+        key=lambda p: int(p[5:-4]))
+    keep = max(int(max_keep), 1)
+    for old in cks[:len(cks) - keep]:
+        os.remove(os.path.join(dirname, old))
+    return payload
+
+
+def load_checkpoint(dirname: str, scope=None, verify: bool = True) -> dict:
+    """Restore the latest checkpoint into the scope. Returns the meta dict.
+    Raises FileNotFoundError if none exists; ValueError on md5 mismatch
+    (torn/corrupt file — the reference's ErrCheckpointNotFound path)."""
+    scope = scope or global_scope()
+    meta_path = os.path.join(dirname, META_NAME)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint meta in {dirname}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    payload = os.path.join(dirname, meta["latest"])
+    if verify and _md5(payload) != meta["md5"]:
+        raise ValueError(f"checkpoint {payload} md5 mismatch (corrupt)")
+    with np.load(payload) as data:
+        dtypes = {}
+        if "__dtypes__" in data.files:
+            dtypes = json.loads(bytes(data["__dtypes__"]).decode())
+        for key in data.files:
+            if key == "__dtypes__":
+                continue
+            arr = data[key]
+            want = dtypes.get(key)
+            if want and str(arr.dtype) != want:
+                import ml_dtypes  # noqa: F401 — registers bfloat16/fp8
+
+                arr = arr.view(np.dtype(want))
+            if key == RNG_VAR:
+                import jax
+
+                scope.set(key, jax.numpy.asarray(arr))
+            else:
+                scope.set(key, arr)
+    return meta
+
+
+def latest_step(dirname: str) -> Optional[int]:
+    """The step of the latest checkpoint, or None."""
+    try:
+        with open(os.path.join(dirname, META_NAME)) as f:
+            return json.load(f)["step"]
+    except (FileNotFoundError, KeyError, json.JSONDecodeError):
+        return None

@@ -1,0 +1,264 @@
+"""Fault-tolerant data-sharding master: C++ engine + TCP service + client.
+
+The control plane replacing the reference's Go master
+(/root/reference/go/master/service.go + client
+/root/reference/python/paddle/v2/master/client.py, which loads the Go C
+library via ctypes — the exact loading pattern used here for our C++
+engine, paddle_tpu/native/master.cc).
+
+Roles:
+- ``Master``       — in-process engine handle (ctypes over libptmaster).
+- ``MasterServer`` — one-process TCP front-end (JSON lines) so trainers on
+                     other hosts share the queue; etcd discovery is replaced
+                     by passing the (host, port) — on TPU pods the trainer
+                     set is static (JAX coordinator), so dynamic discovery
+                     buys nothing.
+- ``MasterClient`` — trainer-side API: ``set_dataset``, ``get_task``,
+                     ``task_finished``/``task_failed``, and
+                     ``task_reader(make_reader)`` which turns the task queue
+                     into an ordinary record iterator
+                     (client.py:244 next_record flow).
+
+Fault tolerance semantics match the reference: tasks time out and re-queue,
+K-strikes discard (service.go:313-366), finished passes recycle, snapshots
+go to a file with atomic replace and can be recovered after a master
+restart (service.go:166-230).
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from ..native import load_library
+
+PASS_DONE = -2
+NO_TASK = -1
+_DESC_BUF = 65536
+
+
+class Master:
+    """In-process task-queue engine (C++; thread-safe)."""
+
+    def __init__(self, timeout_s: int = 60, max_failures: int = 3):
+        self._lib = load_library("master")
+        if self._lib is None:
+            raise RuntimeError("no C++ toolchain; cannot build master engine")
+        lib = self._lib
+        lib.ptmaster_create.restype = ctypes.c_void_p
+        lib.ptmaster_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ptmaster_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptmaster_set_dataset.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+        lib.ptmaster_get_task.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_int]
+        for fn in ("task_finished", "task_failed"):
+            getattr(lib, f"ptmaster_{fn}").argtypes = [ctypes.c_void_p,
+                                                       ctypes.c_int]
+        lib.ptmaster_pass.argtypes = [ctypes.c_void_p]
+        lib.ptmaster_new_pass.argtypes = [ctypes.c_void_p]
+        lib.ptmaster_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptmaster_recover.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptmaster_counts.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_int)] * 4
+        self._h = lib.ptmaster_create(timeout_s, max_failures)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ptmaster_destroy(h)
+            self._h = None
+
+    def set_dataset(self, task_descs: Sequence[str]):
+        arr = (ctypes.c_char_p * len(task_descs))(
+            *[d.encode() for d in task_descs])
+        self._lib.ptmaster_set_dataset(self._h, arr, len(task_descs))
+
+    def get_task(self):
+        """-> (task_id, desc) | NO_TASK | PASS_DONE."""
+        buf = ctypes.create_string_buffer(_DESC_BUF)
+        tid = self._lib.ptmaster_get_task(self._h, buf, _DESC_BUF)
+        if tid < 0:
+            return tid
+        return tid, buf.value.decode()
+
+    def task_finished(self, task_id: int) -> bool:
+        return self._lib.ptmaster_task_finished(self._h, task_id) == 0
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._lib.ptmaster_task_failed(self._h, task_id) == 0
+
+    def new_pass(self) -> int:
+        """Recycle done tasks for the next epoch; -1 while tasks pending."""
+        return self._lib.ptmaster_new_pass(self._h)
+
+    def snapshot(self, path: str) -> bool:
+        return self._lib.ptmaster_snapshot(self._h, path.encode()) == 0
+
+    def recover(self, path: str) -> bool:
+        return self._lib.ptmaster_recover(self._h, path.encode()) == 0
+
+    @property
+    def pass_id(self) -> int:
+        return self._lib.ptmaster_pass(self._h)
+
+    def counts(self):
+        vals = [ctypes.c_int() for _ in range(4)]
+        self._lib.ptmaster_counts(self._h, *[ctypes.byref(v) for v in vals])
+        return {"todo": vals[0].value, "pending": vals[1].value,
+                "done": vals[2].value, "discarded": vals[3].value}
+
+
+# ---------------------------------------------------------------------------
+# TCP service: JSON-lines request/response over the engine.
+# ---------------------------------------------------------------------------
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: Master = self.server.master  # type: ignore[attr-defined]
+        snapshot_path = self.server.snapshot_path  # type: ignore
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                op = req["op"]
+                mutated = False
+                if op == "set_dataset":
+                    master.set_dataset(req["tasks"])
+                    resp = {"ok": True}
+                    mutated = True
+                elif op == "get_task":
+                    r = master.get_task()
+                    if isinstance(r, tuple):
+                        resp = {"ok": True, "task_id": r[0], "desc": r[1]}
+                    else:
+                        resp = {"ok": True, "task_id": r}
+                elif op == "task_finished":
+                    resp = {"ok": master.task_finished(req["task_id"])}
+                    mutated = True
+                elif op == "task_failed":
+                    resp = {"ok": master.task_failed(req["task_id"])}
+                    mutated = True
+                elif op == "new_pass":
+                    resp = {"ok": True, "pass": master.new_pass()}
+                    mutated = True
+                elif op == "counts":
+                    resp = {"ok": True, **master.counts(),
+                            "pass": master.pass_id}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except Exception as e:  # noqa: BLE001 — service must not die
+                resp = {"ok": False, "error": str(e)}
+                mutated = False
+            if mutated and snapshot_path:
+                master.snapshot(snapshot_path)
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    """Threaded TCP front-end. ``with MasterServer(...) as addr:`` or
+    ``.start()``/``.stop()``."""
+
+    def __init__(self, timeout_s=60, max_failures=3, host="127.0.0.1",
+                 port=0, snapshot_path: Optional[str] = None):
+        self.master = Master(timeout_s, max_failures)
+        if snapshot_path and os.path.exists(snapshot_path):
+            self.master.recover(snapshot_path)  # master fault tolerance
+        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self._srv.master = self.master  # type: ignore[attr-defined]
+        self._srv.snapshot_path = snapshot_path  # type: ignore
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self._srv.server_address
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class MasterClient:
+    """Trainer-side client (reference client.py API shape)."""
+
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)
+        self._f = self._sock.makefile("rwb")
+
+    def _call(self, **req):
+        self._f.write((json.dumps(req) + "\n").encode())
+        self._f.flush()
+        resp = json.loads(self._f.readline())
+        if not resp.get("ok", False) and "error" in resp:
+            raise RuntimeError(f"master error: {resp['error']}")
+        return resp
+
+    def set_dataset(self, tasks: Sequence[str]):
+        self._call(op="set_dataset", tasks=list(tasks))
+
+    def get_task(self):
+        resp = self._call(op="get_task")
+        tid = resp["task_id"]
+        if tid < 0:
+            return tid
+        return tid, resp["desc"]
+
+    def task_finished(self, task_id: int):
+        self._call(op="task_finished", task_id=task_id)
+
+    def task_failed(self, task_id: int):
+        self._call(op="task_failed", task_id=task_id)
+
+    def new_pass(self) -> int:
+        return self._call(op="new_pass")["pass"]
+
+    def counts(self):
+        return self._call(op="counts")
+
+    def close(self):
+        self._f.close()
+        self._sock.close()
+
+    def task_reader(self, make_reader: Callable[[str], Iterable],
+                    stop_after_pass: bool = True):
+        """Records iterator over master-assigned tasks: pull a task, stream
+        its records (``make_reader(desc)``), report finished; report failed
+        and continue if the reader raises. Ends when the pass completes."""
+
+        def reader():
+            while True:
+                t = self.get_task()
+                if t == PASS_DONE:
+                    return  # epoch complete; caller may new_pass() + re-iter
+                if t == NO_TASK:
+                    # other trainers still hold pending tasks
+                    import time as _t
+
+                    _t.sleep(0.05)
+                    continue
+                tid, desc = t
+                try:
+                    for rec in make_reader(desc):
+                        yield rec
+                except Exception:  # noqa: BLE001 — task retry semantics
+                    self.task_failed(tid)
+                    continue
+                self.task_finished(tid)
+
+        return reader

@@ -1,0 +1,2 @@
+"""Native (C++) runtime components, compiled on demand (see build.py)."""
+from .build import load_library  # noqa: F401

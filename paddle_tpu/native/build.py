@@ -1,0 +1,59 @@
+"""Compile-on-demand for the native C++ components.
+
+The reference ships its native plane through CMake
+(/root/reference/CMakeLists.txt); here the runtime C++ pieces are small
+single-TU libraries compiled at first import with g++ and cached by source
+hash, so the package needs no install step. A missing compiler degrades to
+the pure-Python fallbacks where the caller provides one.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_CACHE: dict = {}
+
+NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+BUILD_DIR = os.environ.get(
+    "PADDLE_TPU_NATIVE_BUILD",
+    os.path.join(tempfile.gettempdir(), "paddle_tpu_native"))
+
+
+def load_library(source_name: str):
+    """Compile ``<source_name>.cc`` (if needed) and dlopen it. Returns the
+    ctypes.CDLL, or None when no toolchain is available. A compile ERROR
+    (toolchain present, bad source) raises — and keeps raising with the
+    same diagnostics on every retry, never degrading to the None path."""
+    if source_name in _CACHE:
+        cached = _CACHE[source_name]
+        if isinstance(cached, RuntimeError):
+            raise cached
+        return cached
+    src = os.path.join(NATIVE_DIR, source_name + ".cc")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(BUILD_DIR, f"{source_name}-{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+                 "-o", tmp],
+                check=True, capture_output=True)
+            os.replace(tmp, so_path)
+        except FileNotFoundError:
+            _CACHE[source_name] = None  # genuinely no toolchain
+            return None
+        except subprocess.CalledProcessError as e:
+            err = RuntimeError(
+                f"native build of {source_name}.cc failed:\n"
+                + e.stderr.decode())
+            _CACHE[source_name] = err
+            raise err
+    lib = ctypes.CDLL(so_path)
+    _CACHE[source_name] = lib
+    return lib

@@ -1,0 +1,254 @@
+// Task-queue master engine: the C++ core of the fault-tolerant data-sharding
+// control plane.
+//
+// Native rebuild of the reference's Go master service
+// (/root/reference/go/master/service.go): todo/pending/done task queues
+// (service.go:106), per-task deadlines with lazy timeout re-queueing
+// (checkTimeoutFunc service.go:341), failure counting with
+// discard-after-K-failures (processFailedTask service.go:313), pass
+// (epoch) semantics, and state snapshot/recovery (snapshot service.go:207,
+// recover :166) — with a plain file replacing the etcd store (the TPU-native
+// deployment runs one master; replication is a file on durable storage).
+//
+// C ABI only (loaded via ctypes from paddle_tpu/master). Thread-safe: all
+// entry points take the engine mutex, so one master can serve many trainer
+// threads or a socket front-end.
+//
+// Build: g++ -O2 -shared -fPIC master.cc -o libptmaster.so   (see build.py)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Task {
+  int id = -1;
+  std::string desc;     // opaque payload (e.g. "file.rec:chunk-3")
+  int failures = 0;
+  int64_t deadline = 0; // epoch seconds; only meaningful while pending
+};
+
+int64_t now_s() { return static_cast<int64_t>(time(nullptr)); }
+
+struct Master {
+  std::mutex mu;
+  int timeout_s;
+  int max_failures;
+  int next_id = 0;
+  int pass = 0;
+  std::deque<Task> todo;
+  std::unordered_map<int, Task> pending;
+  std::vector<Task> done;
+  std::vector<Task> discarded;
+
+  Master(int t, int f) : timeout_s(t), max_failures(f) {}
+
+  void set_dataset(const char **descs, int n) {
+    std::lock_guard<std::mutex> g(mu);
+    todo.clear();
+    pending.clear();
+    done.clear();
+    discarded.clear();
+    pass = 0;
+    for (int i = 0; i < n; ++i) {
+      Task t;
+      t.id = next_id++;
+      t.desc = descs[i];
+      todo.push_back(std::move(t));
+    }
+  }
+
+  // Re-queue pending tasks whose deadline passed (lazy: called from
+  // get_task, mirroring the periodic checkTimeoutFunc).
+  void check_timeouts_locked() {
+    int64_t now = now_s();
+    std::vector<int> expired;
+    for (auto &kv : pending) {
+      if (kv.second.deadline <= now) expired.push_back(kv.first);
+    }
+    for (int id : expired) {
+      Task t = pending[id];
+      pending.erase(id);
+      fail_locked(std::move(t));
+    }
+  }
+
+  void fail_locked(Task t) {
+    t.failures += 1;
+    if (t.failures >= max_failures) {
+      discarded.push_back(std::move(t)); // drop poisonous tasks
+    } else {
+      todo.push_back(std::move(t));
+    }
+  }
+
+  // Returns task id >= 0 and copies desc into buf; -1 if nothing runnable
+  // right now; -2 if the pass is complete (todo and pending both empty).
+  int get_task(char *buf, int buflen) {
+    std::lock_guard<std::mutex> g(mu);
+    check_timeouts_locked();
+    if (todo.empty()) {
+      return pending.empty() ? -2 : -1;
+    }
+    Task t = todo.front();
+    todo.pop_front();
+    t.deadline = now_s() + timeout_s;
+    int id = t.id;
+    snprintf(buf, buflen, "%s", t.desc.c_str());
+    pending[id] = std::move(t);
+    return id;
+  }
+
+  int task_finished(int id) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return -1; // unknown/late (already timed out)
+    done.push_back(it->second);
+    pending.erase(it);
+    return 0;
+  }
+
+  // Explicit pass recycling: done tasks go back to todo. Callers decide
+  // when a new epoch starts (the reference client drives passes the same
+  // way — one start_get_records per pass).
+  int new_pass() {
+    std::lock_guard<std::mutex> g(mu);
+    if (!pending.empty()) return -1; // a pass must fully drain first
+    start_new_pass_locked();
+    return pass;
+  }
+
+  int task_failed(int id) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return -1;
+    Task t = it->second;
+    pending.erase(it);
+    fail_locked(std::move(t));
+    return 0;
+  }
+
+  void start_new_pass_locked() {
+    // all tasks done -> recycle into todo for the next pass
+    pass += 1;
+    for (auto &t : done) {
+      Task nt;
+      nt.id = t.id;
+      nt.desc = t.desc;
+      todo.push_back(std::move(nt));
+    }
+    done.clear();
+  }
+
+  // ---- snapshot: single-line-per-task text format ------------------------
+  int snapshot(const char *path) {
+    std::lock_guard<std::mutex> g(mu);
+    std::string tmp = std::string(path) + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "w");
+    if (!f) return -1;
+    fprintf(f, "ptmaster1 %d %d %d %d\n", next_id, pass, timeout_s,
+            max_failures);
+    auto dump = [&](const char tag, const Task &t) {
+      fprintf(f, "%c %d %d %zu %s\n", tag, t.id, t.failures, t.desc.size(),
+              t.desc.c_str());
+    };
+    for (const auto &t : todo) dump('T', t);
+    for (const auto &kv : pending) dump('T', kv.second); // re-queue on recover
+    for (const auto &t : done) dump('D', t);
+    for (const auto &t : discarded) dump('X', t);
+    fclose(f);
+    return rename(tmp.c_str(), path); // atomic replace
+  }
+
+  int recover(const char *path) {
+    std::lock_guard<std::mutex> g(mu);
+    FILE *f = fopen(path, "r");
+    if (!f) return -1;
+    char magic[32];
+    if (fscanf(f, "%31s %d %d %d %d\n", magic, &next_id, &pass, &timeout_s,
+               &max_failures) != 5 ||
+        strcmp(magic, "ptmaster1") != 0) {
+      fclose(f);
+      return -2;
+    }
+    todo.clear();
+    pending.clear();
+    done.clear();
+    discarded.clear();
+    char tag;
+    int id, failures;
+    size_t len;
+    // NOTE: no trailing whitespace directive — it would eat the desc's own
+    // leading whitespace; consume exactly the single separator space, read
+    // exactly len bytes, then the record's newline.
+    while (fscanf(f, " %c %d %d %zu", &tag, &id, &failures, &len) == 4) {
+      if (fgetc(f) != ' ') break;
+      std::string desc(len, '\0');
+      if (fread(&desc[0], 1, len, f) != len) break;
+      fgetc(f); // trailing '\n'
+      Task t;
+      t.id = id;
+      t.desc = std::move(desc);
+      t.failures = failures;
+      if (tag == 'T') todo.push_back(std::move(t));
+      else if (tag == 'D') done.push_back(std::move(t));
+      else discarded.push_back(std::move(t));
+    }
+    fclose(f);
+    return 0;
+  }
+};
+
+} // namespace
+
+extern "C" {
+
+void *ptmaster_create(int timeout_s, int max_failures) {
+  return new Master(timeout_s, max_failures);
+}
+void ptmaster_destroy(void *m) { delete static_cast<Master *>(m); }
+void ptmaster_set_dataset(void *m, const char **descs, int n) {
+  static_cast<Master *>(m)->set_dataset(descs, n);
+}
+int ptmaster_get_task(void *m, char *buf, int buflen) {
+  return static_cast<Master *>(m)->get_task(buf, buflen);
+}
+int ptmaster_task_finished(void *m, int id) {
+  return static_cast<Master *>(m)->task_finished(id);
+}
+int ptmaster_task_failed(void *m, int id) {
+  return static_cast<Master *>(m)->task_failed(id);
+}
+int ptmaster_snapshot(void *m, const char *path) {
+  return static_cast<Master *>(m)->snapshot(path);
+}
+int ptmaster_recover(void *m, const char *path) {
+  return static_cast<Master *>(m)->recover(path);
+}
+int ptmaster_new_pass(void *m) {
+  return static_cast<Master *>(m)->new_pass();
+}
+int ptmaster_pass(void *m) {
+  Master *mm = static_cast<Master *>(m);
+  std::lock_guard<std::mutex> g(mm->mu);
+  return mm->pass;
+}
+int ptmaster_counts(void *m, int *todo, int *pending, int *done,
+                    int *discarded) {
+  Master *mm = static_cast<Master *>(m);
+  std::lock_guard<std::mutex> g(mm->mu);
+  *todo = static_cast<int>(mm->todo.size());
+  *pending = static_cast<int>(mm->pending.size());
+  *done = static_cast<int>(mm->done.size());
+  *discarded = static_cast<int>(mm->discarded.size());
+  return 0;
+}
+
+} // extern "C"

@@ -1,0 +1,249 @@
+"""Control-plane tests: C++ master engine semantics (mirroring
+/root/reference/go/master/service_internal_test.go), the TCP service with
+multiple clients in one process (the reference's localhost-cluster test
+strategy, SURVEY.md §4.5), and checkpoint save/resume equivalence."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.checkpoint import load_checkpoint, latest_step, save_checkpoint
+from paddle_tpu.master import NO_TASK, PASS_DONE, Master, MasterClient, \
+    MasterServer
+
+
+class TestMasterEngine:
+    def test_task_lifecycle_and_pass_recycle(self):
+        m = Master(timeout_s=60, max_failures=3)
+        m.set_dataset(["a", "b", "c"])
+        got = {}
+        for _ in range(3):
+            tid, desc = m.get_task()
+            got[tid] = desc
+        assert sorted(got.values()) == ["a", "b", "c"]
+        assert m.get_task() == NO_TASK  # all pending
+        for tid in got:
+            assert m.task_finished(tid)
+        assert m.get_task() == PASS_DONE
+        # explicit recycle starts the next pass
+        assert m.new_pass() == 1
+        assert m.counts()["todo"] == 3
+
+    def test_timeout_requeues(self):
+        m = Master(timeout_s=1, max_failures=5)
+        m.set_dataset(["x"])
+        tid, _ = m.get_task()
+        assert m.get_task() == NO_TASK
+        time.sleep(1.1)
+        tid2, desc = m.get_task()  # lazy timeout check re-queued it
+        assert desc == "x"
+        # the original claim is now stale
+        assert not m.task_finished(tid) or tid == tid2
+
+    def test_k_strikes_discard(self):
+        m = Master(timeout_s=60, max_failures=2)
+        m.set_dataset(["poison", "good"])
+        seen_poison = 0
+        done = set()
+        for _ in range(10):
+            t = m.get_task()
+            if t in (NO_TASK, PASS_DONE):
+                break
+            tid, desc = t
+            if desc == "poison":
+                seen_poison += 1
+                m.task_failed(tid)
+            else:
+                m.task_finished(tid)
+                done.add(desc)
+        assert seen_poison == 2  # discarded after max_failures
+        assert m.counts()["discarded"] == 1
+
+    def test_snapshot_recover(self, tmp_path):
+        snap = str(tmp_path / "master.snap")
+        m = Master(timeout_s=60, max_failures=3)
+        m.set_dataset(["a", "b", "c"])
+        tid, _ = m.get_task()
+        m.task_finished(tid)
+        assert m.snapshot(snap)
+        m2 = Master(timeout_s=60, max_failures=3)
+        assert m2.recover(snap)
+        c = m2.counts()
+        # pending tasks re-queue on recover (a dead master loses claims)
+        assert c["todo"] == 2 and c["done"] == 1
+
+
+class TestMasterService:
+    def test_multi_client_sharding(self):
+        """N worker threads drain the queue exactly once per task."""
+        with MasterServer(timeout_s=60) as addr:
+            boss = MasterClient(addr)
+            tasks = [f"chunk-{i}" for i in range(20)]
+            boss.set_dataset(tasks)
+            seen, lock = [], threading.Lock()
+
+            def worker():
+                c = MasterClient(addr)
+                while True:
+                    t = c.get_task()
+                    if t == PASS_DONE:
+                        break
+                    if t == NO_TASK:
+                        time.sleep(0.01)
+                        continue
+                    tid, desc = t
+                    with lock:
+                        seen.append(desc)
+                    c.task_finished(tid)
+                c.close()
+
+            threads = [threading.Thread(target=worker) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert sorted(seen) == sorted(tasks)  # each task exactly once
+            boss.close()
+
+    def test_task_reader_streams_records(self):
+        with MasterServer(timeout_s=60) as addr:
+            c = MasterClient(addr)
+            c.set_dataset([f"{i}" for i in range(5)])
+
+            def make_reader(desc):
+                base = int(desc) * 10
+                return (base + j for j in range(10))
+
+            records = list(c.task_reader(make_reader)())
+            assert sorted(records) == list(range(50))
+            c.close()
+
+    def test_task_reader_retries_failed_task(self):
+        with MasterServer(timeout_s=60, max_failures=3) as addr:
+            c = MasterClient(addr)
+            c.set_dataset(["flaky", "ok"])
+            attempts = {"flaky": 0}
+
+            def make_reader(desc):
+                if desc == "flaky":
+                    attempts["flaky"] += 1
+                    if attempts["flaky"] == 1:
+                        raise IOError("transient")
+                return iter([desc])
+
+            records = list(c.task_reader(make_reader)())
+            assert sorted(records) == ["flaky", "ok"]
+            assert attempts["flaky"] == 2
+            c.close()
+
+
+class TestCheckpoint:
+    def _build(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.AdamOptimizer(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+        return main, startup, loss
+
+    def test_save_resume_bit_exact(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        rng = np.random.RandomState(0)
+        batches = [(rng.randn(8, 4).astype(np.float32),
+                    rng.randn(8, 1).astype(np.float32)) for _ in range(8)]
+
+        main, startup, loss = self._build()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        for x, y in batches[:4]:
+            exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                    scope=scope)
+        save_checkpoint(ckdir, scope=scope, step=4)
+        # continue training uninterrupted
+        ref = []
+        for x, y in batches[4:]:
+            (lo,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                            scope=scope)
+            ref.append(float(lo))
+
+        # fresh process-equivalent: new scope, resume, same batches
+        scope2 = pt.Scope()
+        exe2 = pt.Executor(pt.TPUPlace())
+        exe2.run(startup, scope=scope2)
+        meta = load_checkpoint(ckdir, scope=scope2)
+        assert meta["step"] == 4 == latest_step(ckdir)
+        got = []
+        for x, y in batches[4:]:
+            (lo,) = exe2.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                             scope=scope2)
+            got.append(float(lo))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_corrupt_checkpoint_detected(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        scope = pt.Scope()
+        scope.set("w", np.ones(4, np.float32))
+        payload = save_checkpoint(ckdir, scope=scope, step=1)
+        with open(payload, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xff\xff")
+        with pytest.raises(ValueError, match="md5 mismatch"):
+            load_checkpoint(ckdir, scope=pt.Scope())
+
+    def test_max_keep_prunes(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        scope = pt.Scope()
+        scope.set("w", np.ones(2, np.float32))
+        for step in range(5):
+            save_checkpoint(ckdir, scope=scope, step=step, max_keep=2)
+        files = [p for p in os.listdir(ckdir) if p.endswith(".npz")]
+        assert sorted(files) == ["ckpt-3.npz", "ckpt-4.npz"]
+
+
+class TestReviewRegressions:
+    def test_snapshot_whitespace_descs(self, tmp_path):
+        """Descs with leading whitespace / JSON payloads survive recover."""
+        snap = str(tmp_path / "m.snap")
+        m = Master()
+        descs = [" lead-space", "\ttab", '{"file": "a.rec", "chunk": 3}']
+        m.set_dataset(descs)
+        assert m.snapshot(snap)
+        m2 = Master()
+        assert m2.recover(snap)
+        got = []
+        while True:
+            t = m2.get_task()
+            if not isinstance(t, tuple):
+                break
+            got.append(t[1])
+            m2.task_finished(t[0])
+        assert sorted(got) == sorted(descs)
+
+    def test_checkpoint_slash_names_and_bf16(self, tmp_path):
+        """'/'-containing names and bfloat16 arrays round-trip exactly."""
+        import jax.numpy as jnp
+
+        ckdir = str(tmp_path / "ck")
+        scope = pt.Scope()
+        scope.set("fc/w", np.arange(4, dtype=np.float32))
+        scope.set("fc/b", np.arange(3, dtype=np.float32) + 10)
+        scope.set("bf", jnp.asarray([1.5, 2.5], jnp.bfloat16))
+        save_checkpoint(ckdir, scope=scope, step=0)
+        s2 = pt.Scope()
+        load_checkpoint(ckdir, scope=s2)
+        np.testing.assert_array_equal(np.asarray(s2.get("fc/w")),
+                                      [0, 1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(s2.get("fc/b")),
+                                      [10, 11, 12])
+        restored = s2.get("bf")
+        assert str(np.asarray(restored).dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(restored, dtype=np.float32), [1.5, 2.5])
