@@ -10,7 +10,9 @@ instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
 from . import (checkpoint, evaluator, event, initializer, layers, master,
-               models, nets, optimizer, parallel, regularizer, trainer)
+               models, nets, optimizer, parallel, profiler, regularizer,
+               trainer)
+from .checkgrad import check_gradients
 from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,

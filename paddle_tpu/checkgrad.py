@@ -1,0 +1,111 @@
+"""Gradient checking as a first-class job.
+
+Mirrors the reference's ``--job=checkgrad`` trainer mode
+(/root/reference/paddle/trainer/TrainerMain.cpp:54, Trainer.cpp checkGradient)
+and the OpTest numeric-gradient harness
+(/root/reference/python/paddle/v2/fluid/tests/op_test.py:80
+get_numeric_gradient): compare the program-built backward pass against
+central finite differences for every trainable parameter.
+
+TPU dtype policy (SURVEY.md §7 'matching the test harness'): the check
+forces 'highest' MXU precision (true f32 contractions) for the duration —
+the default bf16-multiply fast path has ~1e-2 noise that would swamp a
+1e-4 finite-difference comparison.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core.backward import append_backward
+from .core.program import GRAD_SUFFIX, grad_var_name
+from .ops import common as ops_common
+
+
+def check_gradients(program, feed: Dict[str, np.ndarray], loss,
+                    scope=None, params: Optional[List[str]] = None,
+                    delta: float = 1e-3, rtol: float = 1e-2,
+                    atol: float = 1e-4, max_elements: int = 64,
+                    startup_program=None,
+                    executor=None) -> List[Tuple[str, float]]:
+    """Run the checkgrad job. Returns [(param_name, max_rel_error)] and
+    raises AssertionError on the first parameter exceeding tolerance.
+
+    ``program`` must already contain the loss; backward ops are appended to
+    a CLONE so the caller's program is untouched. At most ``max_elements``
+    randomly-chosen elements per parameter are perturbed (the reference
+    sweeps all; sampling keeps TPU round-trips bounded).
+    """
+    import paddle_tpu as pt
+
+    scope = scope if scope is not None else pt.global_scope()
+    exe = executor or pt.Executor(pt.TPUPlace())
+
+    prog = program.clone()
+    block = prog.global_block
+    # Truncate everything after the op producing the loss: a program built
+    # via Optimizer.minimize carries backward + update ops, and running
+    # those during a finite-difference probe would mutate the very weights
+    # being measured (the reference's checkgrad job likewise runs forward
+    # only, TrainerInternal checkGradient path).
+    loss_idx = max(i for i, op in enumerate(block.ops)
+                   if loss.name in op.output_names())
+    del block.ops[loss_idx + 1:]
+    # drop stale @GRAD vars inherited from the original minimize() backward;
+    # otherwise append_backward renames its fresh grads to avoid them
+    for name in [n for n in block.vars if GRAD_SUFFIX in n]:
+        del block.vars[name]
+    with pt.program_guard(prog, startup_program or pt.Program()):
+        loss_var = block.var(loss.name)
+        param_grads = append_backward(loss_var)
+    if params is None:
+        params = [p.name for p, _ in param_grads]
+    grad_names = {p.name: g.name for p, g in param_grads}
+
+    old_precision = ops_common._MXU_PRECISION
+    ops_common.set_mxu_precision("highest")
+    try:
+        fetch = [loss.name] + [grad_names[p] for p in params]
+        outs = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
+        analytic = dict(zip(params, outs[1:]))
+
+        def loss_at() -> float:
+            (lo,) = exe.run(prog, feed=feed, fetch_list=[loss.name],
+                            scope=scope)
+            return float(np.asarray(lo).sum())
+
+        results = []
+        rng = np.random.RandomState(0)
+        for pname in params:
+            base = np.array(scope.get(pname), copy=True)
+            flat = base.reshape(-1)
+            n = flat.size
+            idxs = (np.arange(n) if n <= max_elements
+                    else rng.choice(n, size=max_elements, replace=False))
+            worst = 0.0
+            a = np.asarray(analytic[pname]).reshape(-1)
+            for i in idxs:
+                for sign, store in ((+1, "hi"), (-1, "lo")):
+                    pert = flat.copy()
+                    pert[i] += sign * delta
+                    scope.set(pname, pert.reshape(base.shape))
+                    if sign > 0:
+                        hi = loss_at()
+                    else:
+                        lo = loss_at()
+                numeric = (hi - lo) / (2 * delta)
+                err = abs(numeric - a[i]) / max(
+                    max(abs(numeric), abs(a[i])), atol / rtol)
+                worst = max(worst, err)
+                if err > rtol:
+                    scope.set(pname, base)
+                    raise AssertionError(
+                        f"gradient check FAILED for {pname}[{i}]: "
+                        f"numeric={numeric:.6g} analytic={a[i]:.6g} "
+                        f"rel_err={err:.3g} > {rtol}")
+            scope.set(pname, base)
+            results.append((pname, worst))
+        return results
+    finally:
+        ops_common._MXU_PRECISION = old_precision

@@ -74,15 +74,18 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
         json.dump(meta, f)
     os.replace(meta_tmp, os.path.join(dirname, META_NAME))
 
-    # prune old checkpoints (keep the newest max_keep; the one just written
-    # always survives)
+    # prune old checkpoints: keep the newest max_keep by step, but the one
+    # just written (what meta['latest'] points to) always survives even if
+    # its step is lower than leftovers from an abandoned longer run
     cks = sorted(
         (p for p in os.listdir(dirname)
          if p.startswith("ckpt-") and p.endswith(".npz")),
         key=lambda p: int(p[5:-4]))
     keep = max(int(max_keep), 1)
-    for old in cks[:len(cks) - keep]:
-        os.remove(os.path.join(dirname, old))
+    keep_set = set(cks[len(cks) - keep:]) | {os.path.basename(payload)}
+    for old in cks:
+        if old not in keep_set:
+            os.remove(os.path.join(dirname, old))
     return payload
 
 

@@ -55,9 +55,11 @@ class Master:
         lib.ptmaster_set_dataset.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
         lib.ptmaster_get_task.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                          ctypes.c_int]
+                                          ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int)]
         for fn in ("task_finished", "task_failed"):
             getattr(lib, f"ptmaster_{fn}").argtypes = [ctypes.c_void_p,
+                                                       ctypes.c_int,
                                                        ctypes.c_int]
         lib.ptmaster_pass.argtypes = [ctypes.c_void_p]
         lib.ptmaster_new_pass.argtypes = [ctypes.c_void_p]
@@ -79,18 +81,25 @@ class Master:
         self._lib.ptmaster_set_dataset(self._h, arr, len(task_descs))
 
     def get_task(self):
-        """-> (task_id, desc) | NO_TASK | PASS_DONE."""
+        """-> (task_id, desc, epoch) | NO_TASK | PASS_DONE. The epoch must
+        be echoed back to task_finished/task_failed — stale reports from a
+        timed-out claim are rejected."""
         buf = ctypes.create_string_buffer(_DESC_BUF)
-        tid = self._lib.ptmaster_get_task(self._h, buf, _DESC_BUF)
+        epoch = ctypes.c_int()
+        tid = self._lib.ptmaster_get_task(self._h, buf, _DESC_BUF,
+                                          ctypes.byref(epoch))
+        if tid == -3:
+            raise ValueError(f"task desc exceeds {_DESC_BUF} bytes")
         if tid < 0:
             return tid
-        return tid, buf.value.decode()
+        return tid, buf.value.decode(), epoch.value
 
-    def task_finished(self, task_id: int) -> bool:
-        return self._lib.ptmaster_task_finished(self._h, task_id) == 0
+    def task_finished(self, task_id: int, epoch: int) -> bool:
+        return self._lib.ptmaster_task_finished(self._h, task_id,
+                                                epoch) == 0
 
-    def task_failed(self, task_id: int) -> bool:
-        return self._lib.ptmaster_task_failed(self._h, task_id) == 0
+    def task_failed(self, task_id: int, epoch: int) -> bool:
+        return self._lib.ptmaster_task_failed(self._h, task_id, epoch) == 0
 
     def new_pass(self) -> int:
         """Recycle done tasks for the next epoch; -1 while tasks pending."""
@@ -100,6 +109,8 @@ class Master:
         return self._lib.ptmaster_snapshot(self._h, path.encode()) == 0
 
     def recover(self, path: str) -> bool:
+        """False on missing/corrupt/truncated snapshot (state left empty
+        rather than partially loaded)."""
         return self._lib.ptmaster_recover(self._h, path.encode()) == 0
 
     @property
@@ -132,14 +143,17 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif op == "get_task":
                     r = master.get_task()
                     if isinstance(r, tuple):
-                        resp = {"ok": True, "task_id": r[0], "desc": r[1]}
+                        resp = {"ok": True, "task_id": r[0], "desc": r[1],
+                                "epoch": r[2]}
                     else:
                         resp = {"ok": True, "task_id": r}
                 elif op == "task_finished":
-                    resp = {"ok": master.task_finished(req["task_id"])}
+                    resp = {"ok": master.task_finished(req["task_id"],
+                                                       req.get("epoch", 0))}
                     mutated = True
                 elif op == "task_failed":
-                    resp = {"ok": master.task_failed(req["task_id"])}
+                    resp = {"ok": master.task_failed(req["task_id"],
+                                                     req.get("epoch", 0))}
                     mutated = True
                 elif op == "new_pass":
                     resp = {"ok": True, "pass": master.new_pass()}
@@ -153,7 +167,20 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"ok": False, "error": str(e)}
                 mutated = False
             if mutated and snapshot_path:
-                master.snapshot(snapshot_path)
+                # Throttle: set_dataset/new_pass snapshot immediately (rare,
+                # high-value); per-task mutations batch every
+                # snapshot_every ops — a crash replays at most that many
+                # task completions, vs O(n^2) file writes per pass.
+                srv = self.server
+                if op in ("set_dataset", "new_pass"):
+                    master.snapshot(snapshot_path)
+                    srv.mutations_since_snapshot = 0
+                else:
+                    srv.mutations_since_snapshot += 1
+                    if (srv.mutations_since_snapshot
+                            >= srv.snapshot_every):
+                        master.snapshot(snapshot_path)
+                        srv.mutations_since_snapshot = 0
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
 
@@ -163,7 +190,8 @@ class MasterServer:
     ``.start()``/``.stop()``."""
 
     def __init__(self, timeout_s=60, max_failures=3, host="127.0.0.1",
-                 port=0, snapshot_path: Optional[str] = None):
+                 port=0, snapshot_path: Optional[str] = None,
+                 snapshot_every: int = 32):
         self.master = Master(timeout_s, max_failures)
         if snapshot_path and os.path.exists(snapshot_path):
             self.master.recover(snapshot_path)  # master fault tolerance
@@ -171,6 +199,8 @@ class MasterServer:
         self._srv.daemon_threads = True
         self._srv.master = self.master  # type: ignore[attr-defined]
         self._srv.snapshot_path = snapshot_path  # type: ignore
+        self._srv.snapshot_every = snapshot_every  # type: ignore
+        self._srv.mutations_since_snapshot = 0  # type: ignore
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -217,13 +247,15 @@ class MasterClient:
         tid = resp["task_id"]
         if tid < 0:
             return tid
-        return tid, resp["desc"]
+        return tid, resp["desc"], resp.get("epoch", 0)
 
-    def task_finished(self, task_id: int):
-        self._call(op="task_finished", task_id=task_id)
+    def task_finished(self, task_id: int, epoch: int = 0) -> bool:
+        return bool(self._call(op="task_finished", task_id=task_id,
+                               epoch=epoch)["ok"])
 
-    def task_failed(self, task_id: int):
-        self._call(op="task_failed", task_id=task_id)
+    def task_failed(self, task_id: int, epoch: int = 0) -> bool:
+        return bool(self._call(op="task_failed", task_id=task_id,
+                               epoch=epoch)["ok"])
 
     def new_pass(self) -> int:
         return self._call(op="new_pass")["pass"]
@@ -252,13 +284,13 @@ class MasterClient:
 
                     _t.sleep(0.05)
                     continue
-                tid, desc = t
+                tid, desc, epoch = t
                 try:
                     for rec in make_reader(desc):
                         yield rec
                 except Exception:  # noqa: BLE001 — task retry semantics
-                    self.task_failed(tid)
+                    self.task_failed(tid, epoch)
                     continue
-                self.task_finished(tid)
+                self.task_finished(tid, epoch)
 
         return reader

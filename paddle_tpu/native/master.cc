@@ -33,6 +33,8 @@ struct Task {
   std::string desc;     // opaque payload (e.g. "file.rec:chunk-3")
   int failures = 0;
   int64_t deadline = 0; // epoch seconds; only meaningful while pending
+  int epoch = 0;        // bumped per assignment; stale reports are rejected
+                        // (the Go reference's Task.Epoch, service.go)
 };
 
 int64_t now_s() { return static_cast<int64_t>(time(nullptr)); }
@@ -89,27 +91,33 @@ struct Master {
     }
   }
 
-  // Returns task id >= 0 and copies desc into buf; -1 if nothing runnable
-  // right now; -2 if the pass is complete (todo and pending both empty).
-  int get_task(char *buf, int buflen) {
+  // Returns task id >= 0, copies desc into buf, writes the claim epoch to
+  // *epoch_out; -1 if nothing runnable right now; -2 if the pass is
+  // complete (todo and pending both empty); -3 if buf is too small for the
+  // desc (task stays queued).
+  int get_task(char *buf, int buflen, int *epoch_out) {
     std::lock_guard<std::mutex> g(mu);
     check_timeouts_locked();
     if (todo.empty()) {
       return pending.empty() ? -2 : -1;
     }
+    if (static_cast<int>(todo.front().desc.size()) + 1 > buflen) return -3;
     Task t = todo.front();
     todo.pop_front();
     t.deadline = now_s() + timeout_s;
+    t.epoch += 1;
     int id = t.id;
+    if (epoch_out) *epoch_out = t.epoch;
     snprintf(buf, buflen, "%s", t.desc.c_str());
     pending[id] = std::move(t);
     return id;
   }
 
-  int task_finished(int id) {
+  int task_finished(int id, int epoch) {
     std::lock_guard<std::mutex> g(mu);
     auto it = pending.find(id);
     if (it == pending.end()) return -1; // unknown/late (already timed out)
+    if (it->second.epoch != epoch) return -1; // stale claim's report
     done.push_back(it->second);
     pending.erase(it);
     return 0;
@@ -125,10 +133,11 @@ struct Master {
     return pass;
   }
 
-  int task_failed(int id) {
+  int task_failed(int id, int epoch) {
     std::lock_guard<std::mutex> g(mu);
     auto it = pending.find(id);
     if (it == pending.end()) return -1;
+    if (it->second.epoch != epoch) return -1; // stale claim's report
     Task t = it->second;
     pending.erase(it);
     fail_locked(std::move(t));
@@ -159,10 +168,12 @@ struct Master {
       fprintf(f, "%c %d %d %zu %s\n", tag, t.id, t.failures, t.desc.size(),
               t.desc.c_str());
     };
-    for (const auto &t : todo) dump('T', t);
-    for (const auto &kv : pending) dump('T', kv.second); // re-queue on recover
-    for (const auto &t : done) dump('D', t);
-    for (const auto &t : discarded) dump('X', t);
+    size_t n = 0;
+    for (const auto &t : todo) { dump('T', t); ++n; }
+    for (const auto &kv : pending) { dump('T', kv.second); ++n; }
+    for (const auto &t : done) { dump('D', t); ++n; }
+    for (const auto &t : discarded) { dump('X', t); ++n; }
+    fprintf(f, "end %zu\n", n); // truncation sentinel
     fclose(f);
     return rename(tmp.c_str(), path); // atomic replace
   }
@@ -172,8 +183,11 @@ struct Master {
     FILE *f = fopen(path, "r");
     if (!f) return -1;
     char magic[32];
-    if (fscanf(f, "%31s %d %d %d %d\n", magic, &next_id, &pass, &timeout_s,
-               &max_failures) != 5 ||
+    // runtime knobs (timeout/max_failures) stay as the operator configured
+    // this instance; only queue state is restored from the snapshot.
+    int snap_timeout, snap_failures;
+    if (fscanf(f, "%31s %d %d %d %d\n", magic, &next_id, &pass,
+               &snap_timeout, &snap_failures) != 5 ||
         strcmp(magic, "ptmaster1") != 0) {
       fclose(f);
       return -2;
@@ -184,14 +198,25 @@ struct Master {
     discarded.clear();
     char tag;
     int id, failures;
-    size_t len;
+    size_t len, n = 0;
+    bool bad = false;
     // NOTE: no trailing whitespace directive — it would eat the desc's own
     // leading whitespace; consume exactly the single separator space, read
     // exactly len bytes, then the record's newline.
-    while (fscanf(f, " %c %d %d %zu", &tag, &id, &failures, &len) == 4) {
-      if (fgetc(f) != ' ') break;
+    for (;;) {
+      long rec_start = ftell(f);
+      char word[8];
+      if (fscanf(f, " %7s", word) != 1) { bad = true; break; }
+      if (strcmp(word, "end") == 0) {
+        size_t expect;
+        if (fscanf(f, " %zu", &expect) != 1 || expect != n) bad = true;
+        break;
+      }
+      fseek(f, rec_start, SEEK_SET);
+      if (fscanf(f, " %c %d %d %zu", &tag, &id, &failures, &len) != 4 ||
+          fgetc(f) != ' ') { bad = true; break; }
       std::string desc(len, '\0');
-      if (fread(&desc[0], 1, len, f) != len) break;
+      if (fread(&desc[0], 1, len, f) != len) { bad = true; break; }
       fgetc(f); // trailing '\n'
       Task t;
       t.id = id;
@@ -200,8 +225,16 @@ struct Master {
       if (tag == 'T') todo.push_back(std::move(t));
       else if (tag == 'D') done.push_back(std::move(t));
       else discarded.push_back(std::move(t));
+      ++n;
     }
     fclose(f);
+    if (bad) { // truncated/corrupt: refuse the partial state
+      todo.clear();
+      pending.clear();
+      done.clear();
+      discarded.clear();
+      return -3;
+    }
     return 0;
   }
 };
@@ -217,14 +250,14 @@ void ptmaster_destroy(void *m) { delete static_cast<Master *>(m); }
 void ptmaster_set_dataset(void *m, const char **descs, int n) {
   static_cast<Master *>(m)->set_dataset(descs, n);
 }
-int ptmaster_get_task(void *m, char *buf, int buflen) {
-  return static_cast<Master *>(m)->get_task(buf, buflen);
+int ptmaster_get_task(void *m, char *buf, int buflen, int *epoch_out) {
+  return static_cast<Master *>(m)->get_task(buf, buflen, epoch_out);
 }
-int ptmaster_task_finished(void *m, int id) {
-  return static_cast<Master *>(m)->task_finished(id);
+int ptmaster_task_finished(void *m, int id, int epoch) {
+  return static_cast<Master *>(m)->task_finished(id, epoch);
 }
-int ptmaster_task_failed(void *m, int id) {
-  return static_cast<Master *>(m)->task_failed(id);
+int ptmaster_task_failed(void *m, int id, int epoch) {
+  return static_cast<Master *>(m)->task_failed(id, epoch);
 }
 int ptmaster_snapshot(void *m, const char *path) {
   return static_cast<Master *>(m)->snapshot(path);

@@ -1,0 +1,202 @@
+// RecordIO-style record file + threaded prefetching reader.
+//
+// Native rebuild of two reference components:
+// - the RecordIO chunk files the Go master shards into tasks
+//   (/root/reference/go/master/service.go:106 partition; the cloud data
+//   plane's on-disk format)
+// - the DoubleBuffer async prefetch of the legacy DataProvider
+//   (/root/reference/paddle/gserver/dataproviders/DataProvider.h:249-271):
+//   a background thread keeps a bounded queue of decoded records ahead of
+//   the consumer.
+//
+// File format (little-endian):
+//   per record: u32 MAGIC | u32 len | u32 checksum(payload) | payload bytes
+// Records are self-delimiting; a (offset, count) byte-range identifies a
+// chunk, which is what master task descriptors carry ("path:offset:count").
+//
+// The prefetcher is pure C++ IO on a detached thread — it runs while Python
+// holds or releases the GIL (ctypes releases it during calls), overlapping
+// disk reads with host-side decode and device compute.
+//
+// C ABI only; built by native/build.py, wrapped by paddle_tpu/recordio.py.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545243; // "PTRC"
+
+uint32_t checksum(const uint8_t *data, size_t n) {
+  // FNV-1a: cheap, good enough to catch torn writes (the reference uses
+  // CRC32 via the recordio library; the property needed is corruption
+  // detection, not cryptographic strength).
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+struct Writer {
+  FILE *f;
+  int64_t count = 0;
+};
+
+struct Reader {
+  FILE *f;
+};
+
+struct Prefetcher {
+  FILE *f = nullptr;
+  int64_t remaining; // records left to read (-1 = until EOF)
+  size_t cap;
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable cv_pop, cv_push;
+  bool eof = false;
+  bool error = false;
+  bool stop = false;
+  std::thread worker;
+
+  void run() {
+    for (;;) {
+      if (remaining == 0) break;
+      uint32_t head[3];
+      if (fread(head, 4, 3, f) != 3) break; // EOF
+      if (head[0] != kMagic) { error = true; break; }
+      std::vector<uint8_t> payload(head[1]);
+      if (fread(payload.data(), 1, payload.size(), f) != payload.size()) {
+        error = true;
+        break;
+      }
+      if (checksum(payload.data(), payload.size()) != head[2]) {
+        error = true;
+        break;
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] { return queue.size() < cap || stop; });
+      if (stop) return;
+      queue.push_back(std::move(payload));
+      cv_pop.notify_one();
+      if (remaining > 0) --remaining;
+    }
+    std::lock_guard<std::mutex> g(mu);
+    eof = true;
+    cv_pop.notify_all();
+  }
+};
+
+} // namespace
+
+extern "C" {
+
+// ---- writer ---------------------------------------------------------------
+void *ptrec_writer_open(const char *path, int append) {
+  FILE *f = fopen(path, append ? "ab" : "wb");
+  if (!f) return nullptr;
+  Writer *w = new Writer{f};
+  return w;
+}
+
+// Returns the record's byte offset, or -1 on error.
+int64_t ptrec_write(void *wp, const uint8_t *data, uint32_t len) {
+  Writer *w = static_cast<Writer *>(wp);
+  int64_t off = ftell(w->f);
+  uint32_t head[3] = {kMagic, len, checksum(data, len)};
+  if (fwrite(head, 4, 3, w->f) != 3) return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  w->count++;
+  return off;
+}
+
+int64_t ptrec_writer_close(void *wp) {
+  Writer *w = static_cast<Writer *>(wp);
+  int64_t n = w->count;
+  fclose(w->f);
+  delete w;
+  return n;
+}
+
+// ---- sequential reader ----------------------------------------------------
+void *ptrec_reader_open(const char *path, int64_t offset) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (offset > 0 && fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  return new Reader{f};
+}
+
+// Reads the next record into buf (cap bytes). Returns payload length,
+// -1 at EOF, -2 on corruption, -3 if buf too small (record skipped: rewind
+// and retry with a bigger buffer is not supported — size buffers to data).
+int64_t ptrec_read(void *rp, uint8_t *buf, uint32_t cap) {
+  Reader *r = static_cast<Reader *>(rp);
+  uint32_t head[3];
+  if (fread(head, 4, 3, r->f) != 3) return -1;
+  if (head[0] != kMagic) return -2;
+  if (head[1] > cap) return -3;
+  if (fread(buf, 1, head[1], r->f) != head[1]) return -2;
+  if (checksum(buf, head[1]) != head[2]) return -2;
+  return head[1];
+}
+
+void ptrec_reader_close(void *rp) {
+  Reader *r = static_cast<Reader *>(rp);
+  fclose(r->f);
+  delete r;
+}
+
+// ---- prefetcher (DoubleBuffer) -------------------------------------------
+void *ptrec_prefetch_open(const char *path, int64_t offset, int64_t count,
+                          int queue_cap) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (offset > 0) fseek(f, static_cast<long>(offset), SEEK_SET);
+  Prefetcher *p = new Prefetcher;
+  p->f = f;
+  p->remaining = count;
+  p->cap = queue_cap > 0 ? queue_cap : 64;
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Pops the next record (blocking). Returns length, -1 on end-of-stream,
+// -2 on file corruption, -3 if buf too small (record stays queued).
+int64_t ptrec_prefetch_next(void *pp, uint8_t *buf, uint32_t cap) {
+  Prefetcher *p = static_cast<Prefetcher *>(pp);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_pop.wait(lk, [&] { return !p->queue.empty() || p->eof; });
+  if (p->queue.empty()) return p->error ? -2 : -1;
+  if (p->queue.front().size() > cap) return -3;
+  std::vector<uint8_t> rec = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_push.notify_one();
+  lk.unlock();
+  memcpy(buf, rec.data(), rec.size());
+  return static_cast<int64_t>(rec.size());
+}
+
+void ptrec_prefetch_close(void *pp) {
+  Prefetcher *p = static_cast<Prefetcher *>(pp);
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->stop = true;
+    p->cv_push.notify_all();
+  }
+  p->worker.join();
+  fclose(p->f);
+  delete p;
+}
+
+} // extern "C"

@@ -1,0 +1,165 @@
+"""Profiling & scoped-timer observability.
+
+Three reference subsystems in one TPU-native module (SURVEY.md §5.1):
+- fluid profiler (/root/reference/paddle/platform/profiler.h:25-107,
+  python/paddle/v2/fluid/profiler.py): ``profiler()`` context +
+  ``RecordEvent``-style scoped events, reported as a per-name table
+  (calls/total/min/max/avg ms).
+- legacy Stat timers (/root/reference/paddle/utils/Stat.h:63-242
+  REGISTER_TIMER + globalStat.printAllStatus): ``timer()`` accumulates into
+  a process-global StatSet, dumped by ``print_all_status()`` — the trainer
+  calls it at pass end like Trainer.cpp:449.
+- nvprof hook (/root/reference/paddle/platform/cuda_profiler.h,
+  fluid/profiler.py:19 cuda_profiler): ``xprof_trace`` wraps
+  ``jax.profiler.trace`` — the TPU-native equivalent writes an xplane
+  trace viewable in TensorBoard/XProf.
+
+Timing on an async accelerator: events optionally block on device work
+(``sync=True``) the way the reference's CUDA-event timing synchronises
+streams; default is host wall-time of the dispatch (cheap, right for
+spotting python-side overhead).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+_local = threading.local()
+
+
+class _Stat:
+    __slots__ = ("calls", "total", "min", "max")
+
+    def __init__(self):
+        self.calls = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, dt):
+        self.calls += 1
+        self.total += dt
+        self.min = min(self.min, dt)
+        self.max = max(self.max, dt)
+
+
+class StatSet:
+    """Named wall-time accumulators (the legacy globalStat)."""
+
+    def __init__(self):
+        self._stats = defaultdict(_Stat)
+        self._lock = threading.Lock()
+
+    def add(self, name, dt):
+        with self._lock:
+            self._stats[name].add(dt)
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def table(self):
+        with self._lock:
+            rows = [
+                (name, s.calls, s.total * 1e3, s.min * 1e3, s.max * 1e3,
+                 s.total / s.calls * 1e3)
+                for name, s in sorted(self._stats.items(),
+                                      key=lambda kv: -kv[1].total)
+            ]
+        return rows
+
+    def format(self):
+        rows = self.table()
+        if not rows:
+            return "(no timers recorded)"
+        head = f"{'name':<40}{'calls':>8}{'total ms':>12}{'min ms':>10}" \
+               f"{'max ms':>10}{'avg ms':>10}"
+        lines = [head, "-" * len(head)]
+        for name, calls, total, mn, mx, avg in rows:
+            lines.append(f"{name:<40}{calls:>8}{total:>12.3f}{mn:>10.3f}"
+                         f"{mx:>10.3f}{avg:>10.3f}")
+        return "\n".join(lines)
+
+
+global_stat = StatSet()
+
+
+@contextlib.contextmanager
+def timer(name: str, stat_set: Optional[StatSet] = None, sync: bool = False):
+    """Scoped timer accumulating into the global StatSet (REGISTER_TIMER)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync:
+            import jax
+
+            jax.effects_barrier()
+        (stat_set or global_stat).add(name, time.perf_counter() - t0)
+
+
+def print_all_status(stat_set: Optional[StatSet] = None):
+    print((stat_set or global_stat).format())
+
+
+# ---------------------------------------------------------------------------
+# Event profiler (fluid profiler parity)
+# ---------------------------------------------------------------------------
+class _Profile:
+    def __init__(self, sync):
+        self.stats = StatSet()
+        self.sync = sync
+
+
+def _active() -> Optional[_Profile]:
+    return getattr(_local, "profile", None)
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII event (platform/profiler.h:97 RecordEvent): no-op unless inside
+    a ``profiler()`` context."""
+    p = _active()
+    if p is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if p.sync:
+            import jax
+
+            jax.effects_barrier()
+        p.stats.add(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             sync: bool = False, print_report: bool = True):
+    """Collect record_event timings and print the table on exit (mirrors
+    fluid.profiler.profiler / EnableProfiler+DisableProfiler)."""
+    p = _Profile(sync)
+    _local.profile = p
+    try:
+        yield p
+    finally:
+        _local.profile = None
+        if print_report:
+            print(p.stats.format())
+
+
+@contextlib.contextmanager
+def xprof_trace(logdir: str):
+    """TPU hardware trace via jax.profiler (the nvprof/cuda_profiler
+    analogue): writes an XProf/TensorBoard trace to ``logdir``."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,197 @@
+"""RecordIO files + native prefetching readers (ctypes over
+native/recordio.cc — see its header for format & reference citations).
+
+Records are arbitrary byte strings; the convenience layer (de)serialises
+numpy sample tuples with pickle, giving readers interchangeable with the
+pure-Python reader decorators. Chunk descriptors ("path:offset:count")
+plug straight into the master's task queue, reproducing the go/master
+RecordIO-sharding data plane end to end:
+
+    write_records("train.rec", sample_iter)
+    tasks = chunk_tasks("train.rec", records_per_chunk=512)
+    client.set_dataset(tasks)
+    reader = client.task_reader(chunk_reader)   # native prefetch per chunk
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from .native import load_library
+
+_MAX_RECORD = 64 << 20  # refuse records over 64 MiB
+
+
+def _lib():
+    lib = load_library("recordio")
+    if lib is None:
+        raise RuntimeError("no C++ toolchain; recordio unavailable")
+    if not getattr(lib, "_configured", False):
+        lib.ptrec_writer_open.restype = ctypes.c_void_p
+        lib.ptrec_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ptrec_write.restype = ctypes.c_int64
+        lib.ptrec_write.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint8),
+                                    ctypes.c_uint32]
+        lib.ptrec_writer_close.restype = ctypes.c_int64
+        lib.ptrec_writer_close.argtypes = [ctypes.c_void_p]
+        lib.ptrec_reader_open.restype = ctypes.c_void_p
+        lib.ptrec_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.ptrec_read.restype = ctypes.c_int64
+        lib.ptrec_read.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_uint32]
+        lib.ptrec_reader_close.argtypes = [ctypes.c_void_p]
+        lib.ptrec_prefetch_open.restype = ctypes.c_void_p
+        lib.ptrec_prefetch_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                            ctypes.c_int64, ctypes.c_int]
+        lib.ptrec_prefetch_next.restype = ctypes.c_int64
+        lib.ptrec_prefetch_next.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_uint8),
+                                            ctypes.c_uint32]
+        lib.ptrec_prefetch_close.argtypes = [ctypes.c_void_p]
+        lib._configured = True
+    return lib
+
+
+class RecordWriter:
+    """Append raw byte records; .write returns each record's offset."""
+
+    def __init__(self, path: str, append: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.ptrec_writer_open(path.encode(),
+                                              1 if append else 0)
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, data: bytes) -> int:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        off = self._lib.ptrec_write(self._h, buf, len(data))
+        if off < 0:
+            raise IOError("record write failed")
+        return off
+
+    def close(self) -> int:
+        if self._h:
+            n = self._lib.ptrec_writer_close(self._h)
+            self._h = None
+            return n
+        return 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_records(path: str, offset: int = 0,
+                 count: int = -1) -> Iterator[bytes]:
+    """Sequential raw-record iterator (no prefetch thread)."""
+    lib = _lib()
+    h = lib.ptrec_reader_open(path.encode(), offset)
+    if not h:
+        raise IOError(f"cannot open {path}")
+    buf = (ctypes.c_uint8 * (1 << 20))()
+    cap = len(buf)
+    try:
+        n = 0
+        while count < 0 or n < count:
+            ln = lib.ptrec_read(h, buf, cap)
+            if ln == -1:
+                return
+            if ln == -3:
+                cap = min(cap * 4, _MAX_RECORD)
+                raise IOError("record larger than buffer")
+            if ln < 0:
+                raise IOError(f"corrupt record in {path} (code {ln})")
+            yield bytes(bytearray(buf[: ln]))
+            n += 1
+    finally:
+        lib.ptrec_reader_close(h)
+
+
+def prefetch_records(path: str, offset: int = 0, count: int = -1,
+                     queue_cap: int = 64,
+                     buf_size: int = 1 << 20) -> Iterator[bytes]:
+    """Raw records via the native background-thread prefetcher
+    (DoubleBuffer semantics: IO runs ahead of the consumer)."""
+    lib = _lib()
+    h = lib.ptrec_prefetch_open(path.encode(), offset, count, queue_cap)
+    if not h:
+        raise IOError(f"cannot open {path}")
+    buf = (ctypes.c_uint8 * buf_size)()
+    try:
+        while True:
+            ln = lib.ptrec_prefetch_next(h, buf, buf_size)
+            if ln == -1:
+                return
+            if ln < 0:
+                raise IOError(f"prefetch error in {path} (code {ln})")
+            yield bytes(bytearray(buf[: ln]))
+    finally:
+        lib.ptrec_prefetch_close(h)
+
+
+# ---------------------------------------------------------------------------
+# Sample-level conveniences (pickle payloads) + master integration
+# ---------------------------------------------------------------------------
+def write_records(path: str, samples: Iterable) -> List[int]:
+    """Pickle each sample into a record. Returns record offsets."""
+    offsets = []
+    with RecordWriter(path) as w:
+        for s in samples:
+            offsets.append(w.write(pickle.dumps(s, protocol=4)))
+    return offsets
+
+
+def sample_reader(path: str, offset: int = 0, count: int = -1,
+                  prefetch: bool = True):
+    """A reader() callable yielding unpickled samples."""
+
+    def reader():
+        it = (prefetch_records(path, offset, count) if prefetch
+              else read_records(path, offset, count))
+        for raw in it:
+            yield pickle.loads(raw)
+
+    return reader
+
+
+def chunk_tasks(path: str, records_per_chunk: int = 1024) -> List[str]:
+    """Partition a record file into master task descriptors
+    ("path:offset:count"), the go/master RecordIO sharding."""
+    lib = _lib()
+    h = lib.ptrec_reader_open(path.encode(), 0)
+    if not h:
+        raise IOError(f"cannot open {path}")
+    # walk record headers to find chunk offsets
+    tasks = []
+    buf = (ctypes.c_uint8 * _MAX_RECORD)()
+    try:
+        pos = 0
+        n_in_chunk = 0
+        chunk_start = 0
+        while True:
+            ln = lib.ptrec_read(h, buf, _MAX_RECORD)
+            if ln < 0:
+                break
+            n_in_chunk += 1
+            pos += 12 + ln
+            if n_in_chunk == records_per_chunk:
+                tasks.append(f"{path}:{chunk_start}:{n_in_chunk}")
+                chunk_start = pos
+                n_in_chunk = 0
+        if n_in_chunk:
+            tasks.append(f"{path}:{chunk_start}:{n_in_chunk}")
+    finally:
+        lib.ptrec_reader_close(h)
+    return tasks
+
+
+def chunk_reader(desc: str):
+    """make_reader for MasterClient.task_reader over chunk descriptors."""
+    path, offset, count = desc.rsplit(":", 2)
+    return sample_reader(path, int(offset), int(count))()

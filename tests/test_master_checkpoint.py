@@ -22,12 +22,12 @@ class TestMasterEngine:
         m.set_dataset(["a", "b", "c"])
         got = {}
         for _ in range(3):
-            tid, desc = m.get_task()
-            got[tid] = desc
-        assert sorted(got.values()) == ["a", "b", "c"]
+            tid, desc, epoch = m.get_task()
+            got[tid] = (desc, epoch)
+        assert sorted(d for d, _ in got.values()) == ["a", "b", "c"]
         assert m.get_task() == NO_TASK  # all pending
-        for tid in got:
-            assert m.task_finished(tid)
+        for tid, (_, epoch) in got.items():
+            assert m.task_finished(tid, epoch)
         assert m.get_task() == PASS_DONE
         # explicit recycle starts the next pass
         assert m.new_pass() == 1
@@ -36,13 +36,15 @@ class TestMasterEngine:
     def test_timeout_requeues(self):
         m = Master(timeout_s=1, max_failures=5)
         m.set_dataset(["x"])
-        tid, _ = m.get_task()
+        tid, _, epoch1 = m.get_task()
         assert m.get_task() == NO_TASK
         time.sleep(1.1)
-        tid2, desc = m.get_task()  # lazy timeout check re-queued it
-        assert desc == "x"
-        # the original claim is now stale
-        assert not m.task_finished(tid) or tid == tid2
+        tid2, desc, epoch2 = m.get_task()  # lazy timeout re-queued it
+        assert desc == "x" and epoch2 > epoch1
+        # the original (stale-epoch) claim's report is rejected...
+        assert not m.task_finished(tid, epoch1)
+        # ...while the fresh claim's succeeds
+        assert m.task_finished(tid2, epoch2)
 
     def test_k_strikes_discard(self):
         m = Master(timeout_s=60, max_failures=2)
@@ -53,12 +55,12 @@ class TestMasterEngine:
             t = m.get_task()
             if t in (NO_TASK, PASS_DONE):
                 break
-            tid, desc = t
+            tid, desc, epoch = t
             if desc == "poison":
                 seen_poison += 1
-                m.task_failed(tid)
+                m.task_failed(tid, epoch)
             else:
-                m.task_finished(tid)
+                m.task_finished(tid, epoch)
                 done.add(desc)
         assert seen_poison == 2  # discarded after max_failures
         assert m.counts()["discarded"] == 1
@@ -67,8 +69,8 @@ class TestMasterEngine:
         snap = str(tmp_path / "master.snap")
         m = Master(timeout_s=60, max_failures=3)
         m.set_dataset(["a", "b", "c"])
-        tid, _ = m.get_task()
-        m.task_finished(tid)
+        tid, _, epoch = m.get_task()
+        m.task_finished(tid, epoch)
         assert m.snapshot(snap)
         m2 = Master(timeout_s=60, max_failures=3)
         assert m2.recover(snap)
@@ -95,10 +97,10 @@ class TestMasterService:
                     if t == NO_TASK:
                         time.sleep(0.01)
                         continue
-                    tid, desc = t
+                    tid, desc, epoch = t
                     with lock:
                         seen.append(desc)
-                    c.task_finished(tid)
+                    c.task_finished(tid, epoch)
                 c.close()
 
             threads = [threading.Thread(target=worker) for _ in range(4)]
@@ -224,7 +226,7 @@ class TestReviewRegressions:
             if not isinstance(t, tuple):
                 break
             got.append(t[1])
-            m2.task_finished(t[0])
+            m2.task_finished(t[0], t[2])
         assert sorted(got) == sorted(descs)
 
     def test_checkpoint_slash_names_and_bf16(self, tmp_path):
@@ -247,3 +249,52 @@ class TestReviewRegressions:
         assert str(np.asarray(restored).dtype) == "bfloat16"
         np.testing.assert_array_equal(
             np.asarray(restored, dtype=np.float32), [1.5, 2.5])
+
+
+class TestReviewRegressions2:
+    def test_stale_epoch_report_rejected(self):
+        """Timed-out claimant's late report must not disturb the new
+        claimant (Go reference Task.Epoch semantics)."""
+        m = Master(timeout_s=1, max_failures=10)
+        m.set_dataset(["t"])
+        tid_a, _, ep_a = m.get_task()
+        time.sleep(1.1)
+        tid_b, _, ep_b = m.get_task()  # reassigned after timeout
+        assert not m.task_failed(tid_a, ep_a)  # stale failure ignored
+        assert m.counts()["pending"] == 1  # B's claim untouched
+        assert m.task_finished(tid_b, ep_b)
+
+    def test_truncated_snapshot_rejected(self, tmp_path):
+        snap = str(tmp_path / "m.snap")
+        m = Master()
+        m.set_dataset([f"task-{i}" for i in range(10)])
+        assert m.snapshot(snap)
+        with open(snap, "rb") as f:
+            data = f.read()
+        with open(snap, "wb") as f:
+            f.write(data[: len(data) // 2])  # torn write
+        m2 = Master()
+        assert not m2.recover(snap)
+        assert m2.counts()["todo"] == 0  # no partial state accepted
+
+    def test_recover_keeps_operator_timeout(self, tmp_path):
+        snap = str(tmp_path / "m.snap")
+        m = Master(timeout_s=1, max_failures=3)
+        m.set_dataset(["x"])
+        m.snapshot(snap)
+        m2 = Master(timeout_s=3600, max_failures=3)
+        assert m2.recover(snap)
+        tid, _, ep = m2.get_task()
+        time.sleep(1.2)  # old timeout would expire the claim here
+        assert m2.get_task() == NO_TASK  # still pending under new timeout
+        assert m2.task_finished(tid, ep)
+
+    def test_checkpoint_lower_step_survives_prune(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        scope = pt.Scope()
+        scope.set("w", np.ones(2, np.float32))
+        save_checkpoint(ckdir, scope=scope, step=10, max_keep=1)
+        save_checkpoint(ckdir, scope=scope, step=5, max_keep=1)
+        # meta points at step 5; it must still load
+        meta = load_checkpoint(ckdir, scope=pt.Scope())
+        assert meta["step"] == 5

@@ -1,0 +1,98 @@
+"""Profiler / Stat-timer / checkgrad / check_nan_inf tests (SURVEY.md §5.1,
+§5.2: Stat.h timers, fluid profiler, --job=checkgrad, --check_nan_inf)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler
+from paddle_tpu.checkgrad import check_gradients
+
+
+class TestTimers:
+    def test_stat_accumulation_and_table(self):
+        s = profiler.StatSet()
+        with profiler.timer("step", stat_set=s):
+            pass
+        with profiler.timer("step", stat_set=s):
+            pass
+        rows = s.table()
+        assert len(rows) == 1
+        name, calls, total, mn, mx, avg = rows[0]
+        assert name == "step" and calls == 2
+        assert "step" in s.format()
+
+    def test_record_event_requires_context(self, capsys):
+        with profiler.record_event("outside"):
+            pass  # no-op, must not crash
+        with profiler.profiler(print_report=True) as p:
+            with profiler.record_event("inner"):
+                pass
+            with profiler.record_event("inner"):
+                pass
+        out = capsys.readouterr().out
+        assert "inner" in out
+        assert p.stats.table()[0][1] == 2
+
+
+class TestCheckNanInf:
+    def test_executor_flags_nan(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            y = layers.log(x)  # log of negative -> nan
+        exe = pt.Executor(pt.TPUPlace(), check_nan_inf=True)
+        scope = pt.Scope()
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            exe.run(main, feed={"x": np.array([[-1.0, 1.0]], np.float32)},
+                    fetch_list=[y], scope=scope)
+
+
+class TestCheckGrad:
+    def test_passes_on_correct_gradients(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            label = layers.data("label", shape=[1], dtype="int64")
+            h = layers.fc(x, size=8, act="tanh")
+            logits = layers.fc(h, size=3)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(6, 4).astype(np.float32),
+                "label": rng.randint(0, 3, size=(6, 1)).astype(np.int64)}
+        results = check_gradients(main, feed, loss, scope=scope,
+                                  max_elements=8)
+        assert len(results) == 4  # two weights + two biases
+        for name, err in results:
+            assert err < 1e-2, (name, err)
+
+    def test_detects_wrong_gradient(self):
+        """A corrupted analytic gradient must be caught: perturb the param
+        between the analytic fetch and the numeric probes by registering a
+        broken grad for one op type."""
+        from paddle_tpu.core import registry
+
+        opdef = registry.get_op("tanh")
+        orig = opdef.grad_fn
+        # wrong-by-2x custom grad
+        opdef.grad_fn = lambda attrs, ins, outs, ogs: {
+            "X": [2.0 * ogs["Out"][0] * (1 - outs["Out"][0] ** 2)]}
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[3])
+                h = layers.tanh(layers.fc(x, size=4, bias_attr=False))
+                loss = layers.mean(layers.square(h))
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            feed = {"x": np.random.RandomState(0)
+                    .randn(4, 3).astype(np.float32)}
+            with pytest.raises(AssertionError, match="gradient check FAILED"):
+                check_gradients(main, feed, loss, scope=scope,
+                                max_elements=4)
+        finally:
+            opdef.grad_fn = orig
