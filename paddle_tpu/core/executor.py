@@ -292,6 +292,12 @@ class Executor:
         ops = list(block.ops)
 
         def run_traced(feed_args, ro_args, rw_args, rng=None):
+            from ..parallel.context import mesh_context
+
+            with mesh_context(self.mesh):
+                return _run_body(feed_args, ro_args, rw_args, rng)
+
+        def _run_body(feed_args, ro_args, rw_args, rng=None):
             env: Dict[str, jax.Array] = {}
             env.update(zip(feed_names, feed_args))
             env.update(zip(ro_state, ro_args))

@@ -4,7 +4,8 @@ from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
                  cross_entropy, dropout, embedding, fc, layer_norm,
                  linear_chain_crf, lrn, pool2d, square_error_cost,
                  softmax_with_cross_entropy, topk)
-from .attention import (multi_head_attention, transformer_encoder_layer)
+from .attention import (multi_head_attention, switch_moe,
+                        transformer_encoder_layer)
 from .control_flow import (StaticRNN, While, array_read, array_write,
                            beam_search_decoder, create_array, increment)
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
@@ -31,6 +32,6 @@ __all__ = (
      "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
      "StaticRNN", "While", "create_array", "array_write", "array_read",
      "increment", "beam_search_decoder",
-     "multi_head_attention", "transformer_encoder_layer"]
+     "multi_head_attention", "transformer_encoder_layer", "switch_moe"]
     + list(_ops_all)
 )

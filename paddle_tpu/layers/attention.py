@@ -15,7 +15,8 @@ from .sequence import get_seq_len
 
 
 def multi_head_attention(queries, keys=None, values=None, d_model=None,
-                         num_heads=8, causal=False, param_attr=None,
+                         num_heads=8, causal=False, sequence_parallel=False,
+                         param_attr=None,
                          main_program=None, startup_program=None):
     """Multi-head attention over [b, T, d_model] sequences; self-attention
     when keys/values are omitted. Returns [b, T, d_model]."""
@@ -71,7 +72,8 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
     if sl is not None:
         ins["Length"] = [sl]
     ctx = helper.simple_op("scaled_dot_product_attention", ins,
-                           {"causal": causal})
+                           {"causal": causal,
+                            "sequence_parallel": sequence_parallel})
     ctx = T.transpose(ctx, [0, 2, 1, 3], main_program=mp, startup_program=sp)
     ctx = T.reshape(ctx, [-1, tq, d_model], main_program=mp,
                     startup_program=sp)
@@ -81,19 +83,29 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
 
 
 def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
-                              dropout_prob=0.0, main_program=None,
+                              dropout_prob=0.0, sequence_parallel=False,
+                              moe_experts=0, main_program=None,
                               startup_program=None):
-    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x))."""
+    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x)).
+    ``sequence_parallel`` routes attention through the ring kernel when the
+    executor mesh has an 'sp' axis; ``moe_experts`` > 0 swaps the dense FFN
+    for a Switch MoE (returns (out, aux_loss) in that case)."""
     from . import nn as N
 
     kw = dict(main_program=main_program, startup_program=startup_program)
     d_model = x.shape[-1]
     h = N.layer_norm(x, begin_norm_axis=2, **kw)
     h.seq_len = get_seq_len(x)
-    attn = multi_head_attention(h, num_heads=num_heads, causal=causal, **kw)
+    attn = multi_head_attention(h, num_heads=num_heads, causal=causal,
+                                sequence_parallel=sequence_parallel, **kw)
     helper = LayerHelper("transformer", **kw)
     x = helper.simple_op("elementwise_add", {"X": [x], "Y": [attn]})
     h2 = N.layer_norm(x, begin_norm_axis=2, **kw)
+    if moe_experts:
+        ff, aux = switch_moe(h2, num_experts=moe_experts, d_ff=d_ff, **kw)
+        o = helper.simple_op("elementwise_add", {"X": [x], "Y": [ff]})
+        o.seq_len = get_seq_len(x)
+        return o, aux
     ff = N.fc(h2, size=d_ff, num_flatten_dims=2, act="gelu", **kw)
     if dropout_prob:
         ff = N.dropout(ff, dropout_prob, **kw)
@@ -101,3 +113,46 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
     o = helper.simple_op("elementwise_add", {"X": [x], "Y": [ff]})
     o.seq_len = get_seq_len(x)
     return o
+
+
+def switch_moe(x, num_experts, d_ff=None, capacity_factor=1.25,
+               param_attr=None, main_program=None, startup_program=None):
+    """Switch-Transformer MoE FFN (top-1 routing, capacity-dropped tokens).
+    Expert weights are [E, ...]-major so an 'ep' mesh axis shards experts
+    (see ops/moe_ops.py). Returns (out, aux_loss) — add
+    ``alpha * aux_loss`` to the training objective for load balance."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("switch_moe", main_program=main_program,
+                         startup_program=startup_program)
+    d_model = x.shape[-1]
+    d_ff = d_ff or 4 * d_model
+    E = num_experts
+    base = helper.main_program.unique_name("moe")
+
+    def mk(suffix, shape, bias=False):
+        # explicit names: ".expert_" marks [E, ...]-major tensors so
+        # expert_parallel_plan can shard dim 0 on the 'ep' mesh axis
+        attr = (ParamAttr.to_attr(param_attr) if param_attr is not None
+                else ParamAttr())
+        import copy
+
+        attr = copy.copy(attr)
+        attr.name = f"{base}.{suffix}"
+        return helper.create_parameter(
+            attr, shape=shape, dtype=x.dtype, is_bias=bias,
+            default_initializer=None if bias else XavierInitializer())
+
+    wg = mk("gate", [d_model, E])
+    w1 = mk("expert_w1", [E, d_model, d_ff])
+    b1 = mk("expert_b1", [E, d_ff], bias=True)
+    w2 = mk("expert_w2", [E, d_ff, d_model])
+    b2 = mk("expert_b2", [E, d_model], bias=True)
+    outs, _ = helper.append_op(
+        "switch_moe",
+        {"X": [x], "Gate": [wg], "W1": [w1], "B1": [b1], "W2": [w2],
+         "B2": [b2]},
+        ["Out", "AuxLoss"], {"capacity_factor": capacity_factor})
+    y = outs["Out"][0]
+    y.seq_len = get_seq_len(x)
+    return y, outs["AuxLoss"][0]

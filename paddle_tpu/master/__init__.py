@@ -76,9 +76,15 @@ class Master:
             self._h = None
 
     def set_dataset(self, task_descs: Sequence[str]):
-        arr = (ctypes.c_char_p * len(task_descs))(
-            *[d.encode() for d in task_descs])
-        self._lib.ptmaster_set_dataset(self._h, arr, len(task_descs))
+        encoded = [d.encode() for d in task_descs]
+        for i, e in enumerate(encoded):
+            if len(e) >= _DESC_BUF:
+                # an oversized desc at the queue head would wedge get_task
+                raise ValueError(
+                    f"task desc {i} is {len(e)} bytes; limit is "
+                    f"{_DESC_BUF - 1}")
+        arr = (ctypes.c_char_p * len(encoded))(*encoded)
+        self._lib.ptmaster_set_dataset(self._h, arr, len(encoded))
 
     def get_task(self):
         """-> (task_id, desc, epoch) | NO_TASK | PASS_DONE. The epoch must
@@ -171,16 +177,18 @@ class _Handler(socketserver.StreamRequestHandler):
                 # high-value); per-task mutations batch every
                 # snapshot_every ops — a crash replays at most that many
                 # task completions, vs O(n^2) file writes per pass.
+                # (stop() flushes a final snapshot for graceful shutdown.)
                 srv = self.server
-                if op in ("set_dataset", "new_pass"):
-                    master.snapshot(snapshot_path)
-                    srv.mutations_since_snapshot = 0
-                else:
-                    srv.mutations_since_snapshot += 1
-                    if (srv.mutations_since_snapshot
-                            >= srv.snapshot_every):
+                with srv.snapshot_lock:
+                    if op in ("set_dataset", "new_pass"):
                         master.snapshot(snapshot_path)
                         srv.mutations_since_snapshot = 0
+                    else:
+                        srv.mutations_since_snapshot += 1
+                        if (srv.mutations_since_snapshot
+                                >= srv.snapshot_every):
+                            master.snapshot(snapshot_path)
+                            srv.mutations_since_snapshot = 0
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
 
@@ -201,6 +209,8 @@ class MasterServer:
         self._srv.snapshot_path = snapshot_path  # type: ignore
         self._srv.snapshot_every = snapshot_every  # type: ignore
         self._srv.mutations_since_snapshot = 0  # type: ignore
+        self._srv.snapshot_lock = threading.Lock()  # type: ignore
+        self._snapshot_path = snapshot_path
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -216,6 +226,8 @@ class MasterServer:
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        if self._snapshot_path:
+            self.master.snapshot(self._snapshot_path)  # flush batched ops
 
     def __enter__(self):
         return self.start()

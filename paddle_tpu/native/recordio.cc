@@ -103,6 +103,9 @@ extern "C" {
 void *ptrec_writer_open(const char *path, int append) {
   FILE *f = fopen(path, append ? "ab" : "wb");
   if (!f) return nullptr;
+  // "ab" leaves the stdio position at 0 until the first write on glibc;
+  // seek explicitly so ptrec_write's ftell reports true offsets.
+  if (append) fseek(f, 0, SEEK_END);
   Writer *w = new Writer{f};
   return w;
 }
@@ -138,14 +141,18 @@ void *ptrec_reader_open(const char *path, int64_t offset) {
 }
 
 // Reads the next record into buf (cap bytes). Returns payload length,
-// -1 at EOF, -2 on corruption, -3 if buf too small (record skipped: rewind
-// and retry with a bigger buffer is not supported — size buffers to data).
+// -1 at EOF, -2 on corruption, -3 if buf too small (the stream rewinds to
+// the record start so the caller can retry with a bigger buffer).
 int64_t ptrec_read(void *rp, uint8_t *buf, uint32_t cap) {
   Reader *r = static_cast<Reader *>(rp);
   uint32_t head[3];
   if (fread(head, 4, 3, r->f) != 3) return -1;
   if (head[0] != kMagic) return -2;
-  if (head[1] > cap) return -3;
+  if (head[1] > cap) {
+    // rewind past the header so the caller can retry with a bigger buffer
+    fseek(r->f, -12, SEEK_CUR);
+    return -3;
+  }
   if (fread(buf, 1, head[1], r->f) != head[1]) return -2;
   if (checksum(buf, head[1]) != head[2]) return -2;
   return head[1];

@@ -17,12 +17,26 @@ from .common import maybe, out, single
 
 @register_op("scaled_dot_product_attention", optional_inputs=("Length",))
 def scaled_dot_product_attention(attrs, ins):
-    """Q/K/V [B, H, T, D] -> [B, H, T, D]. attrs: causal, sm_scale."""
+    """Q/K/V [B, H, T, D] -> [B, H, T, D]. attrs: causal, sm_scale,
+    sequence_parallel (use ring attention over the mesh's 'sp' axis when the
+    executor compiles with a mesh that has one — the long-context path)."""
+    from ..parallel.context import current_mesh, mesh_axis
+
     q = single(ins, "Q")
     k = single(ins, "K")
     v = single(ins, "V")
     lengths = maybe(ins, "Length")
-    y = flash_attention(q, k, v, lengths=lengths,
-                        causal=attrs.get("causal", False),
+    causal = attrs.get("causal", False)
+    if attrs.get("sequence_parallel", False) and mesh_axis("sp") > 1:
+        if lengths is not None:
+            raise NotImplementedError(
+                "ring attention path assumes full-length sequences; pad-free "
+                "batches should use the single-chip flash path")
+        from ..parallel.ring_attention import ring_attention
+
+        y = ring_attention(q, k, v, current_mesh(), seq_axis="sp",
+                           causal=causal, sm_scale=attrs.get("sm_scale"))
+        return out(Out=y)
+    y = flash_attention(q, k, v, lengths=lengths, causal=causal,
                         sm_scale=attrs.get("sm_scale"))
     return out(Out=y)

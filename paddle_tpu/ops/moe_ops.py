@@ -1,0 +1,76 @@
+"""Mixture-of-Experts op: Switch-style top-1 routing with capacity.
+
+Capability extension beyond the reference (no MoE exists there; the closest
+analogue is the sparse-parameter pserver path this replaces — SelectedRows
+updates touching only some rows, /root/reference/paddle/framework/
+selected_rows.h). Expert-parallel scaling: the expert-major weight tensors
+[E, ...] shard their leading dim over the mesh's 'ep' axis, so each device
+holds E/n experts and the dispatch/combine einsums become all-to-alls that
+XLA GSPMD inserts — the TPU-native version of what a CUDA framework builds
+from NCCL all-to-all.
+
+Formulation (Switch Transformer): token -> top-1 expert via gate softmax;
+per-expert capacity C = ceil(tokens/E * capacity_factor); tokens beyond an
+expert's capacity are dropped (pass through the residual); dispatch and
+combine are one-hot einsums, keeping everything dense/static for XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import amp_cast, mxu_precision, out, single
+
+
+@register_op("switch_moe", optional_inputs=("GateBias",))
+def switch_moe(attrs, ins):
+    """X [b, T, d]; Gate [d, E]; W1 [E, d, ff]; B1 [E, ff]; W2 [E, ff, d];
+    B2 [E, d] -> Out [b, T, d] plus AuxLoss [1] (load-balance loss)."""
+    x = single(ins, "X")
+    wg = single(ins, "Gate")
+    w1 = single(ins, "W1")
+    b1 = single(ins, "B1")
+    w2 = single(ins, "W2")
+    b2 = single(ins, "B2")
+    capacity_factor = attrs.get("capacity_factor", 1.25)
+    b, T, d = x.shape
+    E = wg.shape[1]
+    n_tok = b * T
+    cap = int(max(1, round(n_tok / E * capacity_factor)))
+
+    xt = x.reshape(n_tok, d)
+    logits = jnp.dot(xt, wg, precision=mxu_precision()).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.max(probs, axis=-1)  # [N] routing weight
+
+    # position of each token within its expert's queue (0-based)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [N, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based at slot
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1  # [N]
+    keep = pos < cap
+
+    # dispatch one-hot [N, E, C]
+    dispatch = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.where(keep, pos, cap), cap + 1,
+                                 dtype=x.dtype)[:, None, :cap])
+    xe = jnp.einsum("nec,nd->ecd", dispatch, xt)  # [E, C, d]
+    xe_c, w1_c = amp_cast(xe, w1)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", xe_c, w1_c,
+                   precision=mxu_precision()).astype(xe.dtype)
+        + b1[:, None, :])
+    h_c, w2_c = amp_cast(h, w2)
+    ye = jnp.einsum("ecf,efd->ecd", h_c, w2_c,
+                    precision=mxu_precision()).astype(xe.dtype) \
+        + b2[:, None, :]
+    combine = dispatch * gate[:, None, None].astype(x.dtype)
+    y = jnp.einsum("nec,ecd->nd", combine, ye)  # dropped tokens -> 0
+
+    # Switch load-balance auxiliary loss: E * sum_e f_e * p_e
+    frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return out(Out=y.reshape(b, T, d).astype(x.dtype),
+               AuxLoss=aux.reshape(1))

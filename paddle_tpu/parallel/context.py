@@ -1,0 +1,38 @@
+"""Current-mesh context: lets op kernels opt into mesh-aware lowering.
+
+Op kernels are pure functions; they cannot take a Mesh argument through the
+Program IR. The executor publishes its mesh here while tracing/compiling a
+block, so ops with a distributed formulation (sequence-parallel attention,
+expert-parallel MoE) can pick it up — the analogue of the reference's
+global DeviceContextPool (/root/reference/paddle/platform/
+device_context.h:161) giving kernels their device handles.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+_CURRENT_MESH = None
+
+
+@contextlib.contextmanager
+def mesh_context(mesh):
+    global _CURRENT_MESH
+    prev = _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    try:
+        yield
+    finally:
+        _CURRENT_MESH = prev
+
+
+def current_mesh():
+    return _CURRENT_MESH
+
+
+def mesh_axis(name: str) -> int:
+    """Size of axis ``name`` on the current mesh (1 if absent/no mesh)."""
+    m = _CURRENT_MESH
+    if m is None or name not in m.axis_names:
+        return 1
+    return m.shape[name]

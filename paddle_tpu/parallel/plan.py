@@ -125,3 +125,36 @@ def zero_plan(mesh: Mesh, data_axis: str = "dp") -> ShardingPlan:
         rules=[(r"_acc$", acc_spec)],
         data_axis=data_axis,
     )
+
+
+def expert_parallel_plan(mesh: Mesh, data_axis: str = "dp",
+                         expert_axis: str = "ep",
+                         model_axis: Optional[str] = None) -> ShardingPlan:
+    """Expert parallelism (+ optional tensor parallelism).
+
+    MoE expert-major tensors (named ``*.expert_*`` by layers.switch_moe,
+    shaped [E, ...]) shard dim 0 over ``expert_axis`` — each device holds
+    E/n experts and GSPMD turns the dispatch/combine einsums into
+    all-to-alls. Gates stay replicated. With ``model_axis`` set, dense fc
+    weights also shard Megatron-style.
+    """
+    def expert_spec(name: str, ndim: int) -> P:
+        # rank >= 2 only: expert tensors are [E, ...]; rank-1 matches are
+        # optimizer scalars (beta-pow accumulators etc.), not expert-major
+        if ndim >= 2:
+            return P(expert_axis, *([None] * (ndim - 1)))
+        return P()
+
+    rules: List[Tuple[str, SpecLike]] = [
+        (r"\.expert_", expert_spec),
+        (r"\.gate", P()),
+    ]
+    if model_axis:
+        def fc_w(name: str, ndim: int) -> P:
+            if ndim >= 2:
+                return P(*([None] * (ndim - 1)), model_axis)
+            return P(model_axis)
+
+        rules += [(r"\.w", fc_w), (r"\.b", P(model_axis))]
+    return ShardingPlan(mesh, rules=[(p, s) for p, s in rules],
+                        data_axis=data_axis)

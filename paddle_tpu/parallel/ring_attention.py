@@ -99,6 +99,10 @@ def ring_attention(q, k, v, mesh, seq_axis="sp", causal=False, sm_scale=None):
         out = acc / jnp.maximum(l, 1e-30)
         return out.astype(ql.dtype)
 
+    if isinstance(q, jax.core.Tracer):
+        # inside a jit trace (the executor's whole-block compile): shard_map
+        # in_specs tell GSPMD how to reshard; no explicit placement possible
+        return ring(q, k, v)
     qs = jax.device_put(q, NamedSharding(mesh, spec)) \
         if not _is_sharded(q) else q
     ks = jax.device_put(k, NamedSharding(mesh, spec)) \

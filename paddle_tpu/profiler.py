@@ -14,10 +14,11 @@ Three reference subsystems in one TPU-native module (SURVEY.md §5.1):
   ``jax.profiler.trace`` — the TPU-native equivalent writes an xplane
   trace viewable in TensorBoard/XProf.
 
-Timing on an async accelerator: events optionally block on device work
-(``sync=True``) the way the reference's CUDA-event timing synchronises
-streams; default is host wall-time of the dispatch (cheap, right for
-spotting python-side overhead).
+Timing on an async accelerator: default is host wall-time of the dispatch
+(cheap; right for spotting python-side overhead). For device-inclusive
+times pass the step's outputs as ``block_on`` — they are
+block_until_ready'd before the clock stops, playing the role of the
+reference's CUDA-event stream synchronisation.
 """
 from __future__ import annotations
 
@@ -87,17 +88,34 @@ class StatSet:
 global_stat = StatSet()
 
 
+def _device_sync(block_on):
+    """Wait for device work: block on the given arrays (the reliable way —
+    jit dispatch is async and there is no global device barrier for pure
+    computations)."""
+    import jax
+
+    if block_on is not None:
+        jax.block_until_ready(block_on)
+    else:
+        jax.effects_barrier()  # awaits effectful ops only
+
+
 @contextlib.contextmanager
-def timer(name: str, stat_set: Optional[StatSet] = None, sync: bool = False):
-    """Scoped timer accumulating into the global StatSet (REGISTER_TIMER)."""
+def timer(name: str, stat_set: Optional[StatSet] = None, sync: bool = False,
+          block_on=None):
+    """Scoped timer accumulating into the global StatSet (REGISTER_TIMER).
+
+    Async-dispatch caveat: by default this measures host wall-time of the
+    dispatch. To include device time, pass the step's output arrays as
+    ``block_on`` (they are block_until_ready'd before the clock stops);
+    ``sync=True`` without ``block_on`` only awaits effectful computations.
+    """
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        if sync:
-            import jax
-
-            jax.effects_barrier()
+        if sync or block_on is not None:
+            _device_sync(block_on)
         (stat_set or global_stat).add(name, time.perf_counter() - t0)
 
 
@@ -119,9 +137,10 @@ def _active() -> Optional[_Profile]:
 
 
 @contextlib.contextmanager
-def record_event(name: str):
+def record_event(name: str, block_on=None):
     """RAII event (platform/profiler.h:97 RecordEvent): no-op unless inside
-    a ``profiler()`` context."""
+    a ``profiler()`` context. Pass the step's outputs as ``block_on`` to
+    include device time (see ``timer``)."""
     p = _active()
     if p is None:
         yield
@@ -130,10 +149,8 @@ def record_event(name: str):
     try:
         yield
     finally:
-        if p.sync:
-            import jax
-
-            jax.effects_barrier()
+        if p.sync or block_on is not None:
+            _device_sync(block_on)
         p.stats.add(name, time.perf_counter() - t0)
 
 
@@ -143,11 +160,12 @@ def profiler(state: str = "All", sorted_key: str = "total",
     """Collect record_event timings and print the table on exit (mirrors
     fluid.profiler.profiler / EnableProfiler+DisableProfiler)."""
     p = _Profile(sync)
+    prev = _active()
     _local.profile = p
     try:
         yield p
     finally:
-        _local.profile = None
+        _local.profile = prev  # restore outer profiler when nested
         if print_report:
             print(p.stats.format())
 

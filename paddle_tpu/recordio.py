@@ -67,6 +67,10 @@ class RecordWriter:
             raise IOError(f"cannot open {path} for writing")
 
     def write(self, data: bytes) -> int:
+        if len(data) > _MAX_RECORD:
+            raise ValueError(
+                f"record of {len(data)} bytes exceeds _MAX_RECORD "
+                f"({_MAX_RECORD}); readers could never consume it")
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
         off = self._lib.ptrec_write(self._h, buf, len(data))
         if off < 0:
@@ -89,22 +93,27 @@ class RecordWriter:
 
 def read_records(path: str, offset: int = 0,
                  count: int = -1) -> Iterator[bytes]:
-    """Sequential raw-record iterator (no prefetch thread)."""
+    """Sequential raw-record iterator (no prefetch thread). Buffers grow on
+    demand up to _MAX_RECORD (the native reader rewinds past the header on
+    a too-small buffer, so retry is clean)."""
     lib = _lib()
     h = lib.ptrec_reader_open(path.encode(), offset)
     if not h:
         raise IOError(f"cannot open {path}")
-    buf = (ctypes.c_uint8 * (1 << 20))()
-    cap = len(buf)
+    cap = 1 << 20
+    buf = (ctypes.c_uint8 * cap)()
     try:
         n = 0
         while count < 0 or n < count:
             ln = lib.ptrec_read(h, buf, cap)
+            if ln == -3:
+                if cap >= _MAX_RECORD:
+                    raise IOError(f"record exceeds {_MAX_RECORD} bytes")
+                cap = min(cap * 4, _MAX_RECORD)
+                buf = (ctypes.c_uint8 * cap)()
+                continue
             if ln == -1:
                 return
-            if ln == -3:
-                cap = min(cap * 4, _MAX_RECORD)
-                raise IOError("record larger than buffer")
             if ln < 0:
                 raise IOError(f"corrupt record in {path} (code {ln})")
             yield bytes(bytearray(buf[: ln]))
@@ -122,10 +131,17 @@ def prefetch_records(path: str, offset: int = 0, count: int = -1,
     h = lib.ptrec_prefetch_open(path.encode(), offset, count, queue_cap)
     if not h:
         raise IOError(f"cannot open {path}")
-    buf = (ctypes.c_uint8 * buf_size)()
+    cap = buf_size
+    buf = (ctypes.c_uint8 * cap)()
     try:
         while True:
-            ln = lib.ptrec_prefetch_next(h, buf, buf_size)
+            ln = lib.ptrec_prefetch_next(h, buf, cap)
+            if ln == -3:  # record stays queued; grow and retry
+                if cap >= _MAX_RECORD:
+                    raise IOError(f"record exceeds {_MAX_RECORD} bytes")
+                cap = min(cap * 4, _MAX_RECORD)
+                buf = (ctypes.c_uint8 * cap)()
+                continue
             if ln == -1:
                 return
             if ln < 0:
@@ -176,8 +192,10 @@ def chunk_tasks(path: str, records_per_chunk: int = 1024) -> List[str]:
         chunk_start = 0
         while True:
             ln = lib.ptrec_read(h, buf, _MAX_RECORD)
-            if ln < 0:
+            if ln == -1:
                 break
+            if ln < 0:  # corruption is an error, not a short task list
+                raise IOError(f"corrupt record in {path} (code {ln})")
             n_in_chunk += 1
             pos += 12 + ln
             if n_in_chunk == records_per_chunk:
