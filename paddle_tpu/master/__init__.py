@@ -227,7 +227,10 @@ class MasterServer:
         self._srv.shutdown()
         self._srv.server_close()
         if self._snapshot_path:
-            self.master.snapshot(self._snapshot_path)  # flush batched ops
+            # daemon handler threads may still be mid-request: take the same
+            # lock they use so the final flush cannot interleave with theirs
+            with self._srv.snapshot_lock:  # type: ignore[attr-defined]
+                self.master.snapshot(self._snapshot_path)
 
     def __enter__(self):
         return self.start()

@@ -282,3 +282,27 @@ def beam_search_decoder(attrs, ins):
     finished, scores, _, _, ids, lens, _ = jax.lax.while_loop(
         cond, step, state0)
     return out(Ids=ids, SeqScores=scores, SeqLen=lens)
+
+
+@register_op("cond", optional_inputs=("Param",))
+def cond_op(attrs, ins):
+    """Functional two-branch conditional (cond_op.cc / if_else design doc):
+    scalar Cond picks which serialized branch runs under lax.cond. Both
+    branches must write the same output names (attrs out_names); inputs are
+    the union of branch reads (Param slot)."""
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    params = ins.get("Param", [])
+    param_names = attrs["param_names"]
+    out_names = attrs["out_names"]
+    base_env = dict(zip(param_names, params))
+
+    def branch(body_ops):
+        def fn(env):
+            env = dict(env)
+            env = run_body(body_ops, env)
+            return tuple(env[n] for n in out_names)
+        return fn
+
+    outs = jax.lax.cond(pred, branch(attrs["true_ops"]),
+                        branch(attrs["false_ops"]), base_env)
+    return {"Out": list(outs)}

@@ -1,0 +1,520 @@
+"""Remaining op-zoo parity: the reference ops not covered by the core
+families (audited against REGISTER_OP in /root/reference/paddle/operators).
+
+Notes on deliberate non-ports:
+- *_cudnn variants are aliases here: there is no per-library kernel choice
+  (operator.cc:482-540 kKernelPriority) — XLA picks the TPU lowering.
+- ncclAllReduce/ncclBcast/ncclReduce have no op-level equivalent BY DESIGN:
+  all communication is GSPMD-inserted collectives (SURVEY.md §5.8);
+  user programs never contain communication ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import get_op, register_op
+from .common import maybe, mxu_precision, out, single
+from .sequence_ops import time_mask
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / small math
+# ---------------------------------------------------------------------------
+@register_op("fill_zeros_like")
+def fill_zeros_like(attrs, ins):
+    return out(Y=jnp.zeros_like(single(ins, "X")))
+
+
+@register_op("is_empty")
+def is_empty(attrs, ins):
+    x = single(ins, "X")
+    return out(Out=jnp.asarray(x.size == 0))
+
+
+@register_op("l1_norm")
+def l1_norm(attrs, ins):
+    return out(Out=jnp.sum(jnp.abs(single(ins, "X"))).reshape(()))
+
+
+@register_op("norm")
+def norm(attrs, ins):
+    """L2 (Frobenius) norm, norm_op.cc."""
+    x = single(ins, "X")
+    return out(Out=jnp.sqrt(jnp.sum(x * x)).reshape(()))
+
+
+@register_op("soft_relu")
+def soft_relu(attrs, ins):
+    t = attrs.get("threshold", 40.0)
+    x = jnp.clip(single(ins, "X"), -t, t)
+    return out(Out=jnp.log1p(jnp.exp(x)))
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(attrs, ins):
+    """modified_huber_loss_op.cc: binary classification with y in {0,1};
+    z = 2y-1 margin loss."""
+    x = single(ins, "X").reshape(-1)
+    y = single(ins, "Y").reshape(-1).astype(x.dtype)
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return out(Out=loss[:, None], IntermediateVal=z[:, None])
+
+
+@register_op("scatter")
+def scatter(attrs, ins):
+    """scatter_op.cc: Out = X with rows at Ids replaced by (or accumulated
+    with) Updates."""
+    x = single(ins, "X")
+    ids = single(ins, "Ids").reshape(-1).astype(jnp.int32)
+    upd = single(ins, "Updates")
+    if attrs.get("overwrite", True):
+        return out(Out=x.at[ids].set(upd))
+    return out(Out=x.at[ids].add(upd))
+
+
+@register_op("bilinear_tensor_product", optional_inputs=("Bias",))
+def bilinear_tensor_product(attrs, ins):
+    """out[:, k] = x W_k y^T + b (bilinear_tensor_product_op.cc);
+    Weight [K, dx, dy]."""
+    x = single(ins, "X")  # [b, dx]
+    y = single(ins, "Y")  # [b, dy]
+    w = single(ins, "Weight")  # [K, dx, dy]
+    bias = maybe(ins, "Bias")
+    o = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        o = o + bias
+    return out(Out=o)
+
+
+@register_op("conv_shift")
+def conv_shift(attrs, ins):
+    """Circular correlation (conv_shift_op.cc): Y's width is odd (2m+1);
+    out[i, j] = sum_k x[i, (j + k - m) mod W] * y[i, k]."""
+    x = single(ins, "X")  # [b, W]
+    y = single(ins, "Y")  # [b, 2m+1]
+    W = x.shape[1]
+    m = y.shape[1] // 2
+    cols = [jnp.roll(x, m - k, axis=1) * y[:, k: k + 1]
+            for k in range(y.shape[1])]
+    return out(Out=sum(cols))
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv/pool family + index pooling + unpool + spp
+# ---------------------------------------------------------------------------
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_op("conv3d")
+def conv3d(attrs, ins):
+    x = single(ins, "Input")  # NCDHW (reference layout)
+    w = single(ins, "Filter")  # [out_c, in_c/g, kd, kh, kw]
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    dil = _triple(attrs.get("dilations", 1))
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1),
+        precision=mxu_precision())
+    return out(Output=y)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(attrs, ins):
+    x = single(ins, "Input")
+    w = single(ins, "Filter")  # [in_c, out_c, kd, kh, kw]
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    dil = _triple(attrs.get("dilations", 1))
+    k = w.shape[2:]
+    pad = [(d * (kk - 1) - p, d * (kk - 1) - p)
+           for kk, p, d in zip(k, pads, dil)]
+    # transpose conv = fractionally-strided conv with the spatially-flipped
+    # kernel ("IODHW" handles the in/out channel swap)
+    w_flip = w[:, :, ::-1, ::-1, ::-1]
+    y = jax.lax.conv_general_dilated(
+        x, w_flip, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1))
+    return out(Output=y)
+
+
+@register_op("pool3d")
+def pool3d(attrs, ins):
+    x = single(ins, "X")  # NCDHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _triple(attrs.get("ksize", 2))
+    strides = _triple(attrs.get("strides", 1))
+    pads = _triple(attrs.get("paddings", 0))
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    padding = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if attrs.get("global_pooling", False):
+        window = (1, 1) + x.shape[2:]
+        stride = (1,) * 5
+        padding = [(0, 0)] * 5
+    if ptype == "max":
+        y = jax.lax.reduce_window(x, -np.inf, jax.lax.max, window, stride,
+                                  padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                  padding)
+        y = s / float(np.prod(window))  # actual window volume, not ksize
+    return out(Out=y)
+
+
+def _max_pool_with_index(x, ksize, strides, pads, spatial_dims):
+    """Max pooling that also returns flat spatial argmax indices (the
+    reference's max_pool{2,3}d_with_index, consumed by unpool)."""
+    spatial = x.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
+        (1, 1) + spatial)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    padding = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init = (jnp.asarray(-np.inf, x.dtype), jnp.asarray(-1, jnp.int32))
+    y, idx = jax.lax.reduce_window((x, flat_idx), init, reducer, window,
+                                   stride, padding)
+    return y, idx
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(attrs, ins):
+    x = single(ins, "X")  # NCHW
+    k = attrs.get("ksize", [2, 2])
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0])
+    y, idx = _max_pool_with_index(x, tuple(k), tuple(s), tuple(p), 2)
+    return out(Out=y, Mask=idx)
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(attrs, ins):
+    x = single(ins, "X")  # NCDHW
+    k = _triple(attrs.get("ksize", 2))
+    s = _triple(attrs.get("strides", 1))
+    p = _triple(attrs.get("paddings", 0))
+    y, idx = _max_pool_with_index(x, k, s, p, 3)
+    return out(Out=y, Mask=idx)
+
+
+@register_op("unpool")
+def unpool(attrs, ins):
+    """unpool_op.cc: scatter pooled values back to the argmax positions
+    recorded by max_pool2d_with_index."""
+    x = single(ins, "X")  # [n, c, ph, pw]
+    idx = single(ins, "Indices").astype(jnp.int32)
+    oh, ow = attrs["unpooled_height"], attrs["unpooled_width"]
+    n, c = x.shape[:2]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    xi = x.reshape(n, c, -1)
+    ii = idx.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(lambda f, v, i: f.at[i].add(v)))(flat, xi, ii)
+    return out(Out=flat.reshape(n, c, oh, ow))
+
+
+@register_op("spp")
+def spp(attrs, ins):
+    """Spatial pyramid pooling (spp_op.cc): concat flattened max pools at
+    pyramid levels 0..L-1 (level l = 2^l x 2^l bins)."""
+    x = single(ins, "X")  # NCHW
+    levels = attrs.get("pyramid_height", 3)
+    n, c, h, w = x.shape
+    feats = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        ph, pw = kh * bins - h, kw * bins - w
+        y = jax.lax.reduce_window(
+            x, -np.inf, jax.lax.max, (1, 1, kh, kw), (1, 1, kh, kw),
+            [(0, 0), (0, 0), (0, ph), (0, pw)])
+        # a window can fall entirely in padding (ceil rounding): zero it
+        y = jnp.where(jnp.isfinite(y), y, 0.0)
+        feats.append(y.reshape(n, -1))
+    return out(Out=jnp.concatenate(feats, axis=1))
+
+
+@register_op("roi_pool")
+def roi_pool(attrs, ins):
+    """roi_pool_op.cc: max-pool each ROI into a fixed [ph, pw] grid.
+    ROIs [R, 5] = (batch_idx, x1, y1, x2, y2) in spatial_scale units."""
+    x = single(ins, "X")  # [N, C, H, W]
+    rois = single(ins, "ROIs")
+    ph = attrs.get("pooled_height", 2)
+    pw = attrs.get("pooled_width", 2)
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = jnp.round(roi[1:] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = x[b]  # [C, H, W]
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                hs = y1 + jnp.floor(i * rh / ph)
+                he = y1 + jnp.ceil((i + 1) * rh / ph)
+                ws = x1 + jnp.floor(j * rw / pw)
+                we = x1 + jnp.ceil((j + 1) * rw / pw)
+                m = ((ys >= hs) & (ys < he))[None, :, None] & \
+                    ((xs >= ws) & (xs < we))[None, None, :]
+                cell = jnp.where(m, img, -jnp.inf).max(axis=(1, 2))
+                outs.append(jnp.where(jnp.isfinite(cell), cell, 0.0))
+        return jnp.stack(outs, axis=1).reshape(C, ph, pw)
+
+    return out(Out=jax.vmap(one_roi)(rois.astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# Sequence / LoD leftovers
+# ---------------------------------------------------------------------------
+@register_op("lod_reset", optional_inputs=("Length", "TargetLength"))
+def lod_reset(attrs, ins):
+    """lod_reset_op.cc: data unchanged, lengths replaced (dense+mask form:
+    pass-through X with the new Length vector as OutLength)."""
+    x = single(ins, "X")
+    tgt = maybe(ins, "TargetLength")
+    if tgt is None:
+        tgt = jnp.asarray(attrs["target_lengths"], jnp.int32)
+    return out(Out=x, OutLength=tgt.astype(jnp.int32))
+
+
+@register_op("sequence_slice", optional_inputs=("Length",))
+def sequence_slice(attrs, ins):
+    """sequence_slice_op.cc: per-row [offset, offset+length) window; rows
+    shift to the front, remainder zeroed."""
+    x = single(ins, "X")  # [b, T, ...]
+    offset = single(ins, "Offset").reshape(-1).astype(jnp.int32)  # [b]
+    length = single(ins, "SliceLength").reshape(-1).astype(jnp.int32)  # [b]
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    src = jnp.clip(offset[:, None] + t, 0, T - 1)
+    src = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    y = jnp.take_along_axis(x, src, axis=1)
+    mask = (t < length[:, None]).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return out(Out=y * mask.astype(x.dtype), OutLength=length)
+
+
+@register_op("beam_search")
+def beam_search(attrs, ins):
+    """One beam-search step (beam_search_op.cc): prune beam*V candidates to
+    the top beam_size. Inputs: PreIds [b, beam], PreScores [b, beam],
+    Scores [b, beam, V] (log-probs of next token). Outputs SelectedIds,
+    SelectedScores, ParentIdx [b, beam]."""
+    pre_scores = single(ins, "PreScores")
+    scores = single(ins, "Scores")
+    beam = int(attrs.get("beam_size", scores.shape[1]))
+    eos = int(attrs.get("end_id", 1))
+    pre_ids = single(ins, "PreIds")
+    b, cur_beam, V = scores.shape
+    finished = pre_ids == eos
+    eos_only = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+    cand = jnp.where(finished[..., None], eos_only[None, None, :], scores)
+    total = pre_scores[..., None] + cand
+    top, idx = jax.lax.top_k(total.reshape(b, cur_beam * V), beam)
+    return out(SelectedIds=(idx % V).astype(jnp.int64),
+               SelectedScores=top,
+               ParentIdx=(idx // V).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# Losses / sampling / metrics
+# ---------------------------------------------------------------------------
+def _nce_grad(attrs, ins, outs, ogs):
+    """Deterministic NCE gradient given the sampled ids recorded in the
+    forward outputs (so the same noise samples are differentiated —
+    nce_op.h grad kernel)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    logits = outs["SampleLogits"][0]
+    ids = outs["SampleLabels"][0].astype(jnp.int32)
+    dcost = ogs["Cost"][0]  # [b, 1]
+    k1 = logits.shape[1]
+    targets = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    dlogits = (jax.nn.sigmoid(logits) - targets) / k1 * dcost  # [b, 1+k]
+    dx = jnp.einsum("bk,bkd->bd", dlogits, w[ids])
+    dw = jnp.zeros_like(w).at[ids].add(
+        jnp.einsum("bk,bd->bkd", dlogits, x))
+    result = {"Input": [dx], "Weight": [dw], "Label": [None]}
+    if bias is not None:
+        db = jnp.zeros_like(bias.reshape(-1)).at[ids.reshape(-1)].add(
+            dlogits.reshape(-1))
+        result["Bias"] = [db.reshape(bias.shape)]
+    return result
+
+
+@register_op("nce", needs_rng=True, grad_fn=_nce_grad,
+             optional_inputs=("Bias", "SampleWeight"))
+def nce(attrs, ins, rng):
+    """Noise-contrastive estimation loss (nce_op.cc): binary logistic over
+    the true class + k uniform negative samples — the sampled-softmax
+    training path for huge output vocabularies (the dense-softmax
+    alternative the sparse pserver served in the reference)."""
+    x = single(ins, "Input")  # [b, d]
+    label = single(ins, "Label").reshape(-1).astype(jnp.int32)  # [b]
+    w = single(ins, "Weight")  # [V, d]
+    bias = maybe(ins, "Bias")
+    k = int(attrs.get("num_neg_samples", 10))
+    V = w.shape[0]
+    b = x.shape[0]
+    neg = jax.random.randint(rng, (b, k), 0, V)  # uniform sampler
+    ids = jnp.concatenate([label[:, None], neg], axis=1)  # [b, 1+k]
+    wsel = w[ids]  # [b, 1+k, d]
+    logits = jnp.einsum("bkd,bd->bk", wsel, x)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[ids]
+    # logistic: true sample label 1, noise 0; subtract log(k/V) prior
+    logits = logits - jnp.log(k / V)
+    targets = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    loss = jnp.mean(
+        jnp.maximum(logits, 0) - logits * targets
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=1, keepdims=True)
+    return out(Cost=loss, SampleLogits=logits,
+               SampleLabels=ids.astype(jnp.int64))
+
+
+@register_op("precision_recall")
+def precision_recall(attrs, ins):
+    """Batch precision/recall/F1 per class + macro avg
+    (precision_recall_op.cc / legacy PrecisionRecallEvaluator)."""
+    cc = get_op("confusion_counts")
+    counts = cc.fn({"num_classes": attrs["num_classes"]},
+                   {"Pred": ins["Pred"], "Label": ins["Label"]})
+    tp = counts["TP"][0].astype(jnp.float32)
+    fp = counts["FP"][0].astype(jnp.float32)
+    fn = counts["FN"][0].astype(jnp.float32)
+    p = tp / jnp.maximum(tp + fp, 1.0)
+    r = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-10)
+    macro = jnp.stack([p.mean(), r.mean(), f1.mean()])
+    return out(BatchMetrics=macro, ClassPrecision=p, ClassRecall=r)
+
+
+@register_op("auc")
+def auc(attrs, ins):
+    """One-shot AUC over a batch (auc_op.cc; streaming version =
+    evaluator.Auc over auc_histogram)."""
+    score = single(ins, "Out")
+    label = single(ins, "Label").reshape(-1)
+    if score.ndim == 2:
+        score = score[:, -1]
+    score = score.reshape(-1)
+    k = int(attrs.get("num_thresholds", 200))
+    bucket = jnp.clip((score * k).astype(jnp.int32), 0, k - 1)
+    pos_h = jax.ops.segment_sum((label > 0).astype(jnp.float64), bucket, k)
+    neg_h = jax.ops.segment_sum((label <= 0).astype(jnp.float64), bucket, k)
+    tp = jnp.cumsum(pos_h[::-1])
+    fp = jnp.cumsum(neg_h[::-1])
+    tpr = jnp.concatenate([jnp.zeros(1), tp / jnp.maximum(tp[-1], 1)])
+    fpr = jnp.concatenate([jnp.zeros(1), fp / jnp.maximum(fp[-1], 1)])
+    a = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2)
+    return out(AUC=a.astype(jnp.float32).reshape(()))
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(attrs, ins):
+    """PN-pair ranking metric (positive_negative_pair_op.cc / legacy
+    pnpair evaluator): among same-query item pairs with different labels,
+    count score-ordering agreement."""
+    score = single(ins, "Score").reshape(-1)
+    label = single(ins, "Label").reshape(-1)
+    query = single(ins, "QueryID").reshape(-1)
+    same_q = query[:, None] == query[None, :]
+    lab_gt = label[:, None] > label[None, :]
+    valid = same_q & lab_gt
+    s_diff = score[:, None] - score[None, :]
+    pos = jnp.sum(valid & (s_diff > 0))
+    neg = jnp.sum(valid & (s_diff < 0))
+    neu = jnp.sum(valid & (s_diff == 0))
+    one = lambda v: v.astype(jnp.float32).reshape(1)
+    return {"PositivePair": [one(pos)], "NegativePair": [one(neg)],
+            "NeutralPair": [one(neu)]}
+
+
+@register_op("detection_output")
+def detection_output(attrs, ins):
+    """Minimal SSD-style detection head (detection_output_op.cc): per class,
+    keep score >= threshold, greedy IoU NMS, top_k results.
+    Scores [b, n_box, n_cls]; Boxes [b, n_box, 4] (x1 y1 x2 y2)."""
+    scores = single(ins, "Scores")
+    boxes = single(ins, "Boxes")
+    thresh = attrs.get("score_threshold", 0.01)
+    nms_iou = attrs.get("nms_threshold", 0.45)
+    keep_k = int(attrs.get("nms_top_k", 16))
+    b, n, _ = boxes.shape
+
+    def iou(box, others):
+        x1 = jnp.maximum(box[0], others[:, 0])
+        y1 = jnp.maximum(box[1], others[:, 1])
+        x2 = jnp.minimum(box[2], others[:, 2])
+        y2 = jnp.minimum(box[3], others[:, 3])
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (others[:, 2] - others[:, 0]) * (others[:, 3] - others[:, 1])
+        return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+    def nms_one(cls_scores, bx):
+        order_scores, order = jax.lax.top_k(cls_scores,
+                                            min(keep_k, cls_scores.shape[0]))
+        obx = bx[order]
+        kept = jnp.zeros(order.shape[0], bool)
+
+        def body(i, kept):
+            overlaps = iou(obx[i], obx)
+            sup = kept & (overlaps > nms_iou) & \
+                (jnp.arange(order.shape[0]) < i)
+            ok = (order_scores[i] >= thresh) & ~jnp.any(sup)
+            return kept.at[i].set(ok)
+
+        kept = jax.lax.fori_loop(0, order.shape[0], body, kept)
+        return order, order_scores, kept
+
+    n_cls = scores.shape[-1]
+    all_out = []
+    for c in range(n_cls):
+        order, s, kept = jax.vmap(nms_one)(scores[:, :, c], boxes)
+        all_out.append((order, s, kept))
+    # pack: [b, n_cls*keep_k, 6] = (class, score_or_-1, x1, y1, x2, y2)
+    rows = []
+    for c, (order, s, kept) in enumerate(all_out):
+        sel = jnp.take_along_axis(boxes, order[..., None], axis=1)
+        score_out = jnp.where(kept, s, -1.0)
+        cls_col = jnp.full(score_out.shape, float(c))
+        rows.append(jnp.concatenate(
+            [cls_col[..., None], score_out[..., None], sel], axis=-1))
+    return out(Out=jnp.concatenate(rows, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# cudnn-name aliases (kernel choice is XLA's, not the program's)
+# ---------------------------------------------------------------------------
+for _alias, _base in [("conv2d_cudnn", "conv2d"),
+                      ("conv2d_transpose_cudnn", "conv2d_transpose"),
+                      ("conv3d_cudnn", "conv3d"),
+                      ("conv3d_transpose_cudnn", "conv3d_transpose"),
+                      ("pool2d_cudnn", "pool2d"),
+                      ("pool3d_cudnn", "pool3d")]:
+    register_op(_alias, get_op(_base).fn,
+                optional_inputs=get_op(_base).optional_inputs)

@@ -74,20 +74,24 @@ def conv2d_transpose(attrs, ins):
     if fmt == "NHWC":
         dn = ("NHWC", "HWIO", "NHWC")
         kh, kw = w.shape[0], w.shape[1]
+        w_flip = w[::-1, ::-1]
     else:
         dn = ("NCHW", "IOHW", "NCHW")
         kh, kw = w.shape[2], w.shape[3]
+        w_flip = w[:, :, ::-1, ::-1]
     pad_h = dilations[0] * (kh - 1) - pads[0]
     pad_w = dilations[1] * (kw - 1) - pads[1]
+    # transpose conv = fractionally-strided conv with the spatially-flipped
+    # kernel; the IOHW/HWIO dimension spec handles the channel swap
+    # (conv_general_dilated has no transpose_kernel arg in this JAX)
     y = jax.lax.conv_general_dilated(
         x,
-        w,
+        w_flip,
         window_strides=(1, 1),
         padding=[(pad_h, pad_h), (pad_w, pad_w)],
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=dn,
-        transpose_kernel=True,
     )
     return out(Output=y)
 

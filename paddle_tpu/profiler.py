@@ -91,9 +91,16 @@ global_stat = StatSet()
 def _device_sync(block_on):
     """Wait for device work: block on the given arrays (the reliable way —
     jit dispatch is async and there is no global device barrier for pure
-    computations)."""
+    computations). ``block_on`` may be a zero-arg callable resolved at exit
+    time, so a with-block can reference outputs it assigns inside:
+
+        with timer("step", block_on=lambda: outs):
+            outs = train_step()
+    """
     import jax
 
+    if callable(block_on):
+        block_on = block_on()
     if block_on is not None:
         jax.block_until_ready(block_on)
     else:
@@ -106,9 +113,11 @@ def timer(name: str, stat_set: Optional[StatSet] = None, sync: bool = False,
     """Scoped timer accumulating into the global StatSet (REGISTER_TIMER).
 
     Async-dispatch caveat: by default this measures host wall-time of the
-    dispatch. To include device time, pass the step's output arrays as
-    ``block_on`` (they are block_until_ready'd before the clock stops);
-    ``sync=True`` without ``block_on`` only awaits effectful computations.
+    dispatch. To include device time, pass the step's output arrays — or a
+    zero-arg callable returning them, e.g. ``block_on=lambda: outs`` where
+    the with-block assigns ``outs`` — they are block_until_ready'd before
+    the clock stops. ``sync=True`` without ``block_on`` only awaits
+    effectful computations.
     """
     t0 = time.perf_counter()
     try:
