@@ -82,7 +82,7 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
          if p.startswith("ckpt-") and p.endswith(".npz")),
         key=lambda p: int(p[5:-4]))
     keep = max(int(max_keep), 1)
-    keep_set = set(cks[len(cks) - keep:]) | {os.path.basename(payload)}
+    keep_set = set(cks[max(len(cks) - keep, 0):]) | {os.path.basename(payload)}
     for old in cks:
         if old not in keep_set:
             os.remove(os.path.join(dirname, old))

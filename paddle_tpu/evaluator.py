@@ -36,7 +36,11 @@ class Evaluator:
                                   startup_program=startup_program)
         self.states = []
 
-    def _create_state(self, suffix, shape, dtype="int64"):
+    # Device-side count accumulators are int32 by policy: without
+    # jax_enable_x64, jnp silently narrows int64 to int32 anyway, so the
+    # declaration is made explicit. 2^31 events per eval pass is beyond any
+    # realistic pass; eval() widens on the host (float64) for the aggregate.
+    def _create_state(self, suffix, shape, dtype="int32"):
         main = self.helper.main_program
         name = main.unique_name(f"{self.helper.layer_type}.{suffix}")
         v = main.global_block.create_var(
@@ -80,8 +84,8 @@ class Accuracy(Evaluator):
 
     def __init__(self, input, label, k=1, **kwargs):
         super().__init__("accuracy_eval", **kwargs)
-        self.total = self._create_state("total", [], "int64")
-        self.correct = self._create_state("correct", [], "int64")
+        self.total = self._create_state("total", [], "int32")
+        self.correct = self._create_state("correct", [], "int32")
         from . import layers
 
         main = self.helper.main_program
@@ -93,12 +97,12 @@ class Accuracy(Evaluator):
             {"Out": [topk_out], "Indices": [topk_idx], "Label": [label]},
             ["Accuracy", "Correct", "Total"])
         self.batch_acc = outs["Accuracy"][0]
-        corr64 = self.helper.simple_op(
-            "cast", {"X": [outs["Correct"][0]]}, {"dtype": "int64"})
-        tot64 = self.helper.simple_op(
-            "cast", {"X": [outs["Total"][0]]}, {"dtype": "int64"})
-        self._accumulate(self.correct, corr64)
-        self._accumulate(self.total, tot64)
+        corr = self.helper.simple_op(
+            "cast", {"X": [outs["Correct"][0]]}, {"dtype": "int32"})
+        tot = self.helper.simple_op(
+            "cast", {"X": [outs["Total"][0]]}, {"dtype": "int32"})
+        self._accumulate(self.correct, corr)
+        self._accumulate(self.total, tot)
 
     def eval(self, executor, scope=None):
         total, correct = self._fetch_states(scope)
@@ -112,9 +116,9 @@ class ChunkEvaluator(Evaluator):
     def __init__(self, input, label, chunk_scheme="IOB", num_chunk_types=1,
                  **kwargs):
         super().__init__("chunk_eval_streaming", **kwargs)
-        self.n_infer = self._create_state("num_infer", [1], "int64")
-        self.n_label = self._create_state("num_label", [1], "int64")
-        self.n_correct = self._create_state("num_correct", [1], "int64")
+        self.n_infer = self._create_state("num_infer", [1], "int32")
+        self.n_label = self._create_state("num_label", [1], "int32")
+        self.n_correct = self._create_state("num_correct", [1], "int32")
         from . import layers
 
         main = self.helper.main_program
@@ -145,9 +149,9 @@ class PrecisionRecall(Evaluator):
     def __init__(self, input, label, num_classes, **kwargs):
         super().__init__("precision_recall", **kwargs)
         self.num_classes = num_classes
-        self.tp = self._create_state("tp", [num_classes], "int64")
-        self.fp = self._create_state("fp", [num_classes], "int64")
-        self.fn = self._create_state("fn", [num_classes], "int64")
+        self.tp = self._create_state("tp", [num_classes], "int32")
+        self.fp = self._create_state("fp", [num_classes], "int32")
+        self.fn = self._create_state("fn", [num_classes], "int32")
         outs, _ = self.helper.append_op(
             "confusion_counts", {"Pred": [input], "Label": [label]},
             ["TP", "FP", "FN"], {"num_classes": num_classes})
@@ -172,8 +176,8 @@ class Auc(Evaluator):
     def __init__(self, input, label, num_thresholds=200, **kwargs):
         super().__init__("auc", **kwargs)
         self.num_thresholds = num_thresholds
-        self.pos = self._create_state("pos_hist", [num_thresholds], "int64")
-        self.neg = self._create_state("neg_hist", [num_thresholds], "int64")
+        self.pos = self._create_state("pos_hist", [num_thresholds], "int32")
+        self.neg = self._create_state("neg_hist", [num_thresholds], "int32")
         outs, _ = self.helper.append_op(
             "auc_histogram", {"Score": [input], "Label": [label]},
             ["Pos", "Neg"], {"num_thresholds": num_thresholds})

@@ -215,6 +215,10 @@ struct Master {
       fseek(f, rec_start, SEEK_SET);
       if (fscanf(f, " %c %d %d %zu", &tag, &id, &failures, &len) != 4 ||
           fgetc(f) != ' ') { bad = true; break; }
+      // A corrupt snapshot could carry an absurd length; allocating it would
+      // throw bad_alloc across the C ABI. Treat oversize as corruption.
+      const size_t kMaxDescLen = 1 << 20; // 1 MiB
+      if (len > kMaxDescLen) { bad = true; break; }
       std::string desc(len, '\0');
       if (fread(&desc[0], 1, len, f) != len) { bad = true; break; }
       fgetc(f); // trailing '\n'

@@ -31,10 +31,10 @@ def confusion_counts(attrs, ins):
         pred = (pred.reshape(-1) > 0.5)
     pred = pred.reshape(-1).astype(jnp.int32)
     hit = pred == label
-    tp = jax.ops.segment_sum(hit.astype(jnp.int64), label, num_segments=n)
-    pred_cnt = jax.ops.segment_sum(jnp.ones_like(label, jnp.int64), pred,
+    tp = jax.ops.segment_sum(hit.astype(jnp.int32), label, num_segments=n)
+    pred_cnt = jax.ops.segment_sum(jnp.ones_like(label, jnp.int32), pred,
                                    num_segments=n)
-    label_cnt = jax.ops.segment_sum(jnp.ones_like(label, jnp.int64), label,
+    label_cnt = jax.ops.segment_sum(jnp.ones_like(label, jnp.int32), label,
                                     num_segments=n)
     return {"TP": [tp], "FP": [pred_cnt - tp], "FN": [label_cnt - tp]}
 
@@ -52,7 +52,7 @@ def auc_histogram(attrs, ins):
     score = score.reshape(-1)
     bucket = jnp.clip((score * k).astype(jnp.int32), 0, k - 1)
     is_pos = label.astype(jnp.int32) > 0
-    ones = jnp.ones_like(bucket, jnp.int64)
+    ones = jnp.ones_like(bucket, jnp.int32)
     pos = jax.ops.segment_sum(jnp.where(is_pos, ones, 0), bucket,
                               num_segments=k)
     neg = jax.ops.segment_sum(jnp.where(is_pos, 0, ones), bucket,
@@ -116,4 +116,4 @@ def edit_distance(attrs, ins):
     if normalized:
         dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
     return out(Out=dist[:, None],
-               SequenceNum=jnp.asarray(b, jnp.int64))
+               SequenceNum=jnp.asarray(b, jnp.int32))

@@ -185,7 +185,8 @@ def lstm_unit(attrs, ins):
     hdim = c_prev.shape[-1]
     if bias is not None:
         gates = gates + bias
-    gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+    # gate layout (i, f, o, g) matches lstm_unit_op.h:63-66
+    gi, gf, go, gc = jnp.split(gates, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)
     c = f * c_prev + i * jnp.tanh(gc)

@@ -1,0 +1,83 @@
+"""Helpers for steering which XLA backend a process (or child) uses.
+
+The dev environment pins JAX at a single real TPU chip through a tunnel
+plugin that intercepts backend initialization; multi-device work runs on a
+virtual CPU mesh instead (``--xla_force_host_platform_device_count``).
+These helpers centralize the env surgery so scripts (bench.py,
+__graft_entry__.py) and tests agree on it.
+"""
+from __future__ import annotations
+
+import os
+
+_FORCE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def strip_host_device_flag(flags: str) -> str:
+    """Remove any existing host-device-count flag (either '--flag=value' or
+    '--flag value' spelling) from an XLA_FLAGS string."""
+    toks = flags.split()
+    kept, skip_next = [], False
+    for i, t in enumerate(toks):
+        if skip_next:
+            skip_next = False
+            continue
+        if t.startswith(_FORCE_FLAG):
+            # '--flag value' spelling: the bare flag followed by an integer
+            if t == _FORCE_FLAG and i + 1 < len(toks) and toks[i + 1].isdigit():
+                skip_next = True
+            continue
+        kept.append(t)
+    return " ".join(kept)
+
+
+def cpu_mesh_env(base_env: dict, n_devices: int) -> dict:
+    """Child-process env for an n-device virtual CPU mesh."""
+    env = dict(base_env)
+    flags = strip_host_device_flag(env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" {_FORCE_FLAG}={n_devices}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def cpu_env(base_env: dict) -> dict:
+    """Child-process env pinned to the (single-device) CPU backend."""
+    env = dict(base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = strip_host_device_flag(env.get("XLA_FLAGS", ""))
+    return env
+
+
+def tpu_env(base_env: dict) -> dict:
+    """Child-process env cleaned for real-TPU use: drop any CPU pin or
+    virtual-device-count leakage so the platform plugin can claim the chip."""
+    env = dict(base_env)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = strip_host_device_flag(env.get("XLA_FLAGS", ""))
+    return env
+
+
+def claim_cpu_mesh(n_devices: int) -> None:
+    """Commit THIS process's (not-yet-initialized) JAX backend to an
+    n-device virtual CPU mesh. Must run before any backend initialization;
+    sets both the env vars and the live config (the tunnel plugin only
+    respects the latter once jax is imported)."""
+    os.environ.update(
+        {k: v for k, v in cpu_mesh_env(os.environ, n_devices).items()
+         if k in ("XLA_FLAGS", "JAX_PLATFORMS")})
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def backend_initialized():
+    """Whether a JAX backend has already been committed in this process:
+    True / False, or None when it cannot be determined (the private
+    registry moved in a jax upgrade). Callers pick their own safe side
+    for None."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return None

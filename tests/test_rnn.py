@@ -99,7 +99,8 @@ class TestLSTMOp:
         gates = rng.randn(2, 4 * self.h).astype(np.float32)
         c_prev = rng.randn(2, self.h).astype(np.float32)
         outs = run_op("lstm_unit", {"X": [gates], "C_prev": [c_prev]})
-        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        # reference gate layout (i, f, o, g): lstm_unit_op.h:63-66
+        gi, gf, go, gc = np.split(gates, 4, axis=-1)
         c = sigmoid(gf) * c_prev + sigmoid(gi) * np.tanh(gc)
         h = sigmoid(go) * np.tanh(c)
         np.testing.assert_allclose(np.asarray(outs["C"][0]), c, rtol=1e-5)
