@@ -18,6 +18,7 @@ from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,
                    program_guard)
 from .core.backward import append_backward
+from .core.selected_rows import SelectedRows
 from .param_attr import ParamAttr
 from .ops.common import amp_enabled, set_amp, set_mxu_precision
 

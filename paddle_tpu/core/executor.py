@@ -28,6 +28,7 @@ import numpy as np
 from . import program as prog_mod
 from .program import Program, RNG_VAR
 from .registry import get_op
+from .selected_rows import SelectedRows, densify
 from .scope import Scope, global_scope
 
 logger = logging.getLogger("paddle_tpu")
@@ -58,6 +59,8 @@ class CPUPlace(TPUPlace):
 
 
 def _check_nan_inf(name: str, value) -> None:
+    if isinstance(value, SelectedRows):
+        value = value.values
     arr = np.asarray(value)
     if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
         raise FloatingPointError(f"variable {name!r} contains NaN/Inf")
@@ -164,7 +167,7 @@ class Executor:
             for name, val in zip(fetch_names, fetches):
                 _check_nan_inf(name, val)
         if return_numpy:
-            return [np.asarray(v) for v in fetches]
+            return [np.asarray(densify(v)) for v in fetches]
         return list(fetches)
 
     # ------------------------------------------------------------------

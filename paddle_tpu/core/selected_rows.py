@@ -1,0 +1,124 @@
+"""SelectedRows: row-sparse gradient values for large embeddings.
+
+TPU-native redesign of the reference's SelectedRows
+(/root/reference/paddle/framework/selected_rows.h) and the row-sparse
+parameter machinery (/root/reference/paddle/math/SparseRowMatrix.h). The
+reference's lookup_table emits its gradient as SelectedRows
+(/root/reference/paddle/operators/lookup_table_op.cc:59) so the optimizer /
+pserver applies a row-granular update instead of a dense [V, D] one.
+
+Here SelectedRows is a registered pytree that flows through the executor's
+single-XLA-computation trace like any array: ``rows`` ([n] int32 row ids,
+possibly with duplicates and with the sentinel ``height`` marking padding)
+plus ``values`` ([n, D]). All shapes are static — n is the number of looked-
+up ids in the batch — so nothing here fights the compiler. Optimizer ops
+consume it with gather + scatter (mode='drop' ignores sentinel rows), which
+XLA lowers to dynamic-slice/dynamic-update-slice traffic proportional to
+n*D, never to a [V, D] buffer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """A row-sparse value: ``dense[rows[i]] += values[i]`` semantics.
+
+    ``height`` (static) is the dense leading-dim size; a row id equal to
+    ``height`` is padding and must be ignored by consumers (scatter
+    mode='drop' does this for free).
+    """
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    # -- array-ish surface -------------------------------------------------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def scale(self, s):
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+    def to_dense(self):
+        """Materialize the dense [height, D] tensor (scatter-add).
+
+        Only for small vocabularies / test comparison / explicit user
+        densification — the optimizer paths never call this.
+        """
+        base = jnp.zeros(self.dense_shape, self.values.dtype)
+        return base.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self) -> "SelectedRows":
+        """Deduplicate rows: sort ids and segment-sum duplicate rows'
+        values (the reference's merge_dups before sparse optimizer updates).
+        Output keeps the static length n; slots past the unique count carry
+        the ``height`` sentinel and zero values.
+        """
+        n = self.rows.shape[0]
+        if n <= 1:
+            return self
+        order = jnp.argsort(self.rows)
+        rows = jnp.take(self.rows, order)
+        vals = jnp.take(self.values, order, axis=0)
+        is_new = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (rows[1:] != rows[:-1]).astype(jnp.int32)])
+        seg = jnp.cumsum(is_new)
+        merged_vals = jax.ops.segment_sum(vals, seg, num_segments=n)
+        merged_rows = jnp.full((n,), self.height, dtype=rows.dtype)
+        merged_rows = merged_rows.at[seg].set(rows)
+        return SelectedRows(merged_rows, merged_vals, self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError(
+                    f"SelectedRows height mismatch: {self.height} vs "
+                    f"{other.height}")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values], axis=0),
+                self.height)
+        # dense + sparse: densify (fan-out through a dense consumer)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def __mul__(self, s):
+        return self.scale(s)
+
+    __rmul__ = __mul__
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape}, dtype={self.dtype})")
+
+
+def is_selected_rows(x) -> bool:
+    return isinstance(x, SelectedRows)
+
+
+def densify(x):
+    """Dense view of either a SelectedRows or a dense array."""
+    return x.to_dense() if isinstance(x, SelectedRows) else x

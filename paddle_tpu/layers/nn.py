@@ -47,16 +47,23 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
               dtype="float32", main_program=None, startup_program=None):
     """Embedding lookup (reference nn.py embedding / lookup_table_op.cc).
-    ``is_sparse`` is accepted for API parity; the TPU grad is a scatter-add
-    (SelectedRows-equivalent segment sum) either way."""
+
+    With ``is_sparse`` the gradient is a SelectedRows (row ids + row grads,
+    no [V, D] buffer — lookup_table_op.cc:59) and the optimizer applies a
+    lazy row-granular update; required for large vocabularies (CTR).
+    Regularization on a sparse embedding densifies the grad and defeats the
+    point — leave param_attr.regularizer unset for is_sparse weights."""
     helper = LayerHelper("embedding", main_program=main_program,
                          startup_program=startup_program)
     w = helper.create_parameter(
         param_attr, shape=list(size), dtype=dtype,
         default_initializer=XavierInitializer())
+    if padding_idx is not None and padding_idx < 0:
+        # fluid semantics: negative padding_idx counts from the vocab end
+        padding_idx = int(size[0]) + int(padding_idx)
     return helper.simple_op(
         "lookup_table", {"W": [w], "Ids": [input]},
-        {"padding_idx": padding_idx})
+        {"padding_idx": padding_idx, "is_sparse": bool(is_sparse)})
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,

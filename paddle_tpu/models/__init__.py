@@ -17,9 +17,10 @@ from .resnet import resnet_imagenet, resnet_cifar10
 from .googlenet import googlenet
 from .smallnet import smallnet_mnist_cifar
 from .transformer import transformer_lm
+from .wide_deep import wide_deep, wide_deep_loss
 
 __all__ = [
-    "transformer_lm",
+    "transformer_lm", "wide_deep", "wide_deep_loss",
     "lenet5", "alexnet", "vgg", "resnet_imagenet", "resnet_cifar10",
     "googlenet", "smallnet_mnist_cifar",
 ]

@@ -1,0 +1,71 @@
+"""Wide&Deep CTR model with high-dimensional sparse embeddings.
+
+The BASELINE.json flagship config #5 ("Wide&Deep CTR with high-dim sparse
+embeddings, distributed pserver -> ICI all-reduce"). The reference served
+this workload with the sparse parameter-server path — row-sparse gradients
+(/root/reference/paddle/operators/lookup_table_op.cc:59 SelectedRows grad,
+/root/reference/paddle/math/SparseRowMatrix.h) shipped to pservers sharded
+by parameter block (/root/reference/paddle/pserver/ParameterServer2.h:94).
+
+TPU-native redesign: ``is_sparse=True`` embeddings produce SelectedRows
+gradients consumed by lazy row-granular optimizer updates (never a [V, D]
+buffer), and the vocabulary dimension shards over the model axis of the
+device mesh (parallel.vocab_sharded_plan) so the embedding table scales
+with the slice — GSPMD turns lookups and row updates into ICI traffic.
+"""
+from __future__ import annotations
+
+from ..layers.layer_helper import LayerHelper
+from .. import layers
+
+
+def wide_deep(sparse_ids, dense_input, vocab_size, embed_dim=16,
+              hidden_sizes=(64, 32), is_sparse=True,
+              main_program=None, startup_program=None):
+    """Build the Wide&Deep CTR tower; returns the [b, 1] logit.
+
+    sparse_ids:  int [b, S] — S categorical slots, ids pre-offset into a
+                 shared vocabulary of ``vocab_size`` (the usual CTR layout).
+    dense_input: float [b, Dd] continuous features (may be None).
+
+    wide  = sum over slots of a per-id scalar weight (an embedding of dim 1
+            — the linear-over-one-hot part) [+ linear in dense features]
+    deep  = MLP over the concatenated [b, S*embed_dim] slot embeddings
+            [+ dense features]
+    logit = wide + deep head
+    """
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    b_s = sparse_ids.shape
+    num_slots = b_s[-1]
+
+    # -- wide: linear part over sparse ids ------------------------------
+    wide_emb = layers.embedding(
+        sparse_ids, size=[vocab_size, 1], is_sparse=is_sparse, **kw)
+    helper = LayerHelper("wide_deep", **kw)
+    wide = helper.simple_op(
+        "reduce_sum", {"X": [wide_emb]}, {"dim": [1], "keep_dim": False})
+
+    # -- deep: embeddings + MLP ----------------------------------------
+    deep_emb = layers.embedding(
+        sparse_ids, size=[vocab_size, embed_dim], is_sparse=is_sparse, **kw)
+    deep = layers.reshape(deep_emb, [-1, num_slots * embed_dim], **kw)
+    if dense_input is not None:
+        deep = layers.concat([deep, dense_input], axis=1, **kw)
+        wide = layers.elementwise_add(
+            wide, layers.fc(dense_input, size=1, **kw), **kw)
+    for size in hidden_sizes:
+        deep = layers.fc(deep, size=size, act="relu", **kw)
+    deep_logit = layers.fc(deep, size=1, **kw)
+
+    return layers.elementwise_add(wide, deep_logit, **kw)
+
+
+def wide_deep_loss(logit, label, main_program=None, startup_program=None):
+    """Mean sigmoid cross-entropy CTR loss; returns (loss, probability)."""
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    helper = LayerHelper("wide_deep", **kw)
+    ce = helper.simple_op(
+        "sigmoid_cross_entropy_with_logits",
+        {"X": [logit], "Label": [label]}, {})
+    prob = layers.sigmoid(logit, **kw)
+    return layers.mean(ce, **kw), prob

@@ -18,7 +18,15 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 from .common import maybe, out, single
+
+
+def _densify_grad(g):
+    """Fallback for optimizers with no row-sparse update rule (matches the
+    reference, where only sgd/momentum/adagrad/adam have SelectedRows
+    kernels): materialize the dense gradient."""
+    return g.to_dense() if isinstance(g, SelectedRows) else g
 
 
 @register_op("sgd")
@@ -26,18 +34,36 @@ def sgd(attrs, ins):
     p = single(ins, "Param")
     g = single(ins, "Grad")
     lr = single(ins, "LearningRate").astype(p.dtype).reshape(())
+    if isinstance(g, SelectedRows):
+        # Row-sparse update (sgd_op.cc SelectedRows kernel): duplicates in
+        # rows accumulate in the scatter-add, so no merge pass is needed.
+        return out(ParamOut=p.at[g.rows].add(
+            -lr * g.values.astype(p.dtype), mode="drop"))
     return out(ParamOut=p - lr * g.astype(p.dtype))
 
 
 @register_op("momentum")
 def momentum(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(p.dtype)
+    g = single(ins, "Grad")
     v = single(ins, "Velocity")
     lr = single(ins, "LearningRate").astype(p.dtype).reshape(())
     mu = attrs.get("mu", 0.9)
+    nesterov = attrs.get("use_nesterov", False)
+    if isinstance(g, SelectedRows):
+        # Lazy momentum: only touched rows' velocity decays this step (the
+        # sparse-updater semantics of the reference's legacy sparse
+        # momentum, SgdSparseCpuTraining path).
+        m = g.merged()
+        gv = m.values.astype(p.dtype)
+        v_rows = mu * v[m.rows] + gv
+        v_out = v.at[m.rows].set(v_rows, mode="drop")
+        step = (gv + mu * v_rows) * lr if nesterov else lr * v_rows
+        return {"ParamOut": [p.at[m.rows].add(-step, mode="drop")],
+                "VelocityOut": [v_out]}
+    g = g.astype(p.dtype)
     v_out = mu * v + g
-    if attrs.get("use_nesterov", False):
+    if nesterov:
         p_out = p - (g + mu * v_out) * lr
     else:
         p_out = p - lr * v_out
@@ -47,7 +73,7 @@ def momentum(attrs, ins):
 @register_op("adam")
 def adam(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = single(ins, "Grad")
     m1 = single(ins, "Moment1")
     m2 = single(ins, "Moment2")
     b1p = single(ins, "Beta1Pow").reshape(())
@@ -56,9 +82,25 @@ def adam(attrs, ins):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if isinstance(g, SelectedRows):
+        # Lazy Adam (the reference adam_op's SelectedRows kernel semantics):
+        # moments of untouched rows are left alone instead of decaying.
+        m = g.merged()
+        gv = m.values.astype(jnp.float32)
+        m1_rows = b1 * m1[m.rows] + (1 - b1) * gv
+        m2_rows = b2 * m2[m.rows] + (1 - b2) * jnp.square(gv)
+        step = (lr_t * m1_rows / (jnp.sqrt(m2_rows) + eps)).astype(p.dtype)
+        return {
+            "ParamOut": [p.at[m.rows].add(-step, mode="drop")],
+            "Moment1Out": [m1.at[m.rows].set(m1_rows, mode="drop")],
+            "Moment2Out": [m2.at[m.rows].set(m2_rows, mode="drop")],
+            "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2],
+        }
+    g = g.astype(jnp.float32)
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_out = p - (lr_t * m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
     return {
         "ParamOut": [p_out],
@@ -72,7 +114,7 @@ def adam(attrs, ins):
 @register_op("adamax")
 def adamax(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     m = single(ins, "Moment")
     inf_norm = single(ins, "InfNorm")
     b1p = single(ins, "Beta1Pow").reshape(())
@@ -91,10 +133,19 @@ def adamax(attrs, ins):
 @register_op("adagrad")
 def adagrad(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = single(ins, "Grad")
     mom = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        # Row-sparse adagrad (adagrad_op.cc SelectedRows kernel).
+        m = g.merged()
+        gv = m.values.astype(jnp.float32)
+        mom_rows = mom[m.rows] + jnp.square(gv)
+        step = (lr * gv / (jnp.sqrt(mom_rows) + eps)).astype(p.dtype)
+        return {"ParamOut": [p.at[m.rows].add(-step, mode="drop")],
+                "MomentOut": [mom.at[m.rows].set(mom_rows, mode="drop")]}
+    g = g.astype(jnp.float32)
     mom_out = mom + jnp.square(g)
     p_out = p - (lr * g / (jnp.sqrt(mom_out) + eps)).astype(p.dtype)
     return {"ParamOut": [p_out], "MomentOut": [mom_out]}
@@ -103,7 +154,7 @@ def adagrad(attrs, ins):
 @register_op("decayed_adagrad")
 def decayed_adagrad(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     mom = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
     decay = attrs.get("decay", 0.95)
@@ -116,7 +167,7 @@ def decayed_adagrad(attrs, ins):
 @register_op("adadelta")
 def adadelta(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     avg_sq_grad = single(ins, "AvgSquaredGrad")
     avg_sq_upd = single(ins, "AvgSquaredUpdate")
     rho = attrs.get("rho", 0.95)
@@ -132,7 +183,7 @@ def adadelta(attrs, ins):
 @register_op("rmsprop")
 def rmsprop(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     ms = single(ins, "MeanSquare")
     mom = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
@@ -148,7 +199,7 @@ def rmsprop(attrs, ins):
 @register_op("ftrl")
 def ftrl(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     sq_acc = single(ins, "SquaredAccumulator")
     lin_acc = single(ins, "LinearAccumulator")
     lr = single(ins, "LearningRate").reshape(())
@@ -168,7 +219,7 @@ def ftrl(attrs, ins):
 @register_op("proximal_gd")
 def proximal_gd(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     lr = single(ins, "LearningRate").reshape(())
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -181,7 +232,7 @@ def proximal_gd(attrs, ins):
 @register_op("proximal_adagrad")
 def proximal_adagrad(attrs, ins):
     p = single(ins, "Param")
-    g = single(ins, "Grad").astype(jnp.float32)
+    g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     mom = single(ins, "Moment")
     lr = single(ins, "LearningRate").reshape(())
     l1 = attrs.get("l1", 0.0)

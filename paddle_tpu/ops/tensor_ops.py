@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 from ..core.types import to_dtype
 from .common import broadcast_to_x, maybe, out, single
 
@@ -198,15 +199,30 @@ register_op("elementwise_pow", _elementwise(jnp.power))
 @register_op("sum")
 def sum_op(attrs, ins):
     xs = ins["X"]
-    acc = xs[0]
-    for x in xs[1:]:
-        acc = acc + x
+    # SelectedRows-aware accumulation (grad fan-out of a sparse embedding):
+    # sparse+sparse stays sparse (row concat); any dense operand densifies.
+    sparse = [x for x in xs if isinstance(x, SelectedRows)]
+    dense = [x for x in xs if not isinstance(x, SelectedRows)]
+    acc = None
+    if sparse:
+        acc = sparse[0]
+        for x in sparse[1:]:
+            acc = acc + x
+        if dense:
+            acc = acc.to_dense()
+    for x in dense:
+        acc = x if acc is None else acc + x
     return out(Out=acc)
 
 
 @register_op("scale")
 def scale(attrs, ins):
     x = single(ins, "X")
+    if isinstance(x, SelectedRows):
+        if attrs.get("bias", 0.0):
+            raise ValueError("scale with bias is not defined on SelectedRows")
+        return out(Out=x.scale(jnp.asarray(attrs.get("scale", 1.0),
+                                           dtype=x.dtype)))
     s = jnp.asarray(attrs.get("scale", 1.0), dtype=x.dtype)
     b = jnp.asarray(attrs.get("bias", 0.0), dtype=x.dtype)
     if attrs.get("bias_after_scale", True):
@@ -294,16 +310,27 @@ def logical_not(attrs, ins):
 
 # --- indexing ---------------------------------------------------------------
 def _lookup_table_grad(attrs, ins, outs, ogs):
-    """Sparse-style embedding gradient: scatter-add of output grads.
+    """Embedding gradient, sparse or dense.
 
-    The reference emits a SelectedRows gradient (lookup_table_op.cc) so the
-    pserver applies a row-sparse update; on TPU we produce the dense
-    equivalent via a segment-sum scatter, which XLA lowers efficiently.
+    With ``is_sparse`` the gradient is a SelectedRows — (ids, row grads)
+    with NO [V, D] buffer — exactly the reference's design
+    (lookup_table_op.cc:59 emits SelectedRows; selected_rows.h), consumed
+    row-granularly by the optimizer ops. Without it, the dense equivalent
+    via scatter-add (fine for small vocabularies).
     """
     w = single(ins, "W")
     ids = single(ins, "Ids").reshape(-1)
     og = ogs["Out"][0].reshape(ids.shape[0], w.shape[-1])
-    dw = jnp.zeros_like(w).at[ids].add(og.astype(w.dtype))
+    pad = attrs.get("padding_idx")
+    if pad is not None and pad >= 0:
+        # the forward zeroes the padding row's output, so its grad is 0:
+        # point padding lookups at the out-of-range sentinel so scatters
+        # drop them (both paths)
+        ids = jnp.where(ids == pad, w.shape[0], ids)
+    if attrs.get("is_sparse", False):
+        return {"W": [SelectedRows(ids, og.astype(w.dtype), w.shape[0])],
+                "Ids": [None]}
+    dw = jnp.zeros_like(w).at[ids].add(og.astype(w.dtype), mode="drop")
     return {"W": [dw], "Ids": [None]}
 
 

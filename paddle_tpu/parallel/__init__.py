@@ -17,10 +17,10 @@ process, no gradient RPC, and no explicit communication op in user programs.
 from .mesh import make_mesh, mesh_axis_size
 from .ring_attention import ring_attention
 from .plan import (ShardingPlan, data_parallel_plan, expert_parallel_plan,
-                   megatron_plan, zero_plan)
+                   megatron_plan, vocab_sharded_plan, zero_plan)
 
 __all__ = [
     "make_mesh", "mesh_axis_size", "ring_attention",
     "ShardingPlan", "data_parallel_plan", "expert_parallel_plan",
-    "megatron_plan", "zero_plan",
+    "megatron_plan", "vocab_sharded_plan", "zero_plan",
 ]

@@ -127,6 +127,33 @@ def zero_plan(mesh: Mesh, data_axis: str = "dp") -> ShardingPlan:
     )
 
 
+def vocab_sharded_plan(mesh: Mesh, data_axis: str = "dp",
+                       vocab_axis: str = "mp") -> ShardingPlan:
+    """Vocabulary-sharded large embeddings (the CTR / Wide&Deep plan).
+
+    Embedding tables ([V, D], named ``embedding*.w*`` by layers.embedding)
+    shard their vocab dim over ``vocab_axis`` — the in-graph ICI analogue of
+    the reference's sparse parameter server, which sharded embedding rows
+    across pservers by parameter block
+    (/root/reference/paddle/pserver/ParameterServer2.h:94-100,
+    /root/reference/paddle/math/SparseRowMatrix.h). GSPMD partitions the
+    lookup gather and the row-sparse optimizer scatter across the axis; the
+    optimizer's row accumulators inherit the spec by the ``_acc`` naming
+    convention. Dense-tower parameters stay replicated; batch shards on
+    ``data_axis``.
+    """
+    def emb_spec(name: str, ndim: int) -> P:
+        if ndim >= 2:
+            return P(vocab_axis, *([None] * (ndim - 1)))
+        return P()
+
+    return ShardingPlan(
+        mesh,
+        rules=[(r"embedding.*\.w", emb_spec)],
+        data_axis=data_axis,
+    )
+
+
 def expert_parallel_plan(mesh: Mesh, data_axis: str = "dp",
                          expert_axis: str = "ep",
                          model_axis: Optional[str] = None) -> ShardingPlan:
