@@ -9,9 +9,9 @@ jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
 instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
-from . import (checkpoint, evaluator, event, initializer, layers, master,
-               models, nets, optimizer, parallel, profiler, regularizer,
-               trainer)
+from . import (checkpoint, clip, evaluator, event, initializer, layers,
+               learning_rate_decay, master, models, nets, optimizer, parallel,
+               profiler, regularizer, trainer)
 from .checkgrad import check_gradients
 from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,

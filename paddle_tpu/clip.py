@@ -1,0 +1,151 @@
+"""Gradient clipping, built into the training program as ops.
+
+Parity with /root/reference/python/paddle/v2/fluid/clip.py:23
+(GradientClipByValue, append_gradient_clip_ops) plus the legacy engine's
+global-norm clipping knob (gradient_clipping_threshold in
+/root/reference/proto/ParameterConfig.proto, applied by the trainer's
+updaters) — expressed TPU-natively: per-grad clips append ``clip`` ops and
+the global-norm clip is ONE fused ``clip_by_global_norm`` op over every
+gradient at once, so the norm reduction and all the rescales compile into
+the same XLA computation as the backward pass (no per-parameter host loop).
+
+SelectedRows gradients (sparse embeddings) clip on their row values —
+by-value clips elementwise, norm clips on the deduplicated rows — so
+clipping never densifies a sparse gradient.
+"""
+from __future__ import annotations
+
+import functools
+
+from .layers.layer_helper import LayerHelper
+
+__all__ = [
+    "BaseGradientClipAttr", "NullGradientClipAttr", "GradientClipByValue",
+    "GradientClipByNorm", "GradientClipByGlobalNorm", "ClipByValue",
+    "append_gradient_clip_ops", "set_gradient_clip",
+]
+
+
+class BaseGradientClipAttr:
+    def process_context(self, context, p_g):
+        raise NotImplementedError()
+
+    def create_operators(self, param, grad):
+        raise NotImplementedError()
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def process_context(self, context, p_g):
+        pass
+
+    def create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    """Elementwise clip to [min, max] (fluid clip.py:23 GradientClipByValue)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = -self.max if min is None else float(min)
+
+    def process_context(self, context, p_g):
+        pass
+
+    def create_operators(self, param, grad):
+        helper = LayerHelper("gradient_clip",
+                             main_program=param.block.program)
+        new_grad = helper.simple_op(
+            "clip", {"X": [grad]}, {"min": self.min, "max": self.max})
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    """Rescale a single gradient to L2 norm <= clip_norm."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def process_context(self, context, p_g):
+        pass
+
+    def create_operators(self, param, grad):
+        helper = LayerHelper("gradient_clip",
+                             main_program=param.block.program)
+        new_grad = helper.simple_op(
+            "clip_by_norm", {"X": [grad]}, {"max_norm": self.clip_norm})
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Jointly rescale ALL participating gradients so the global L2 norm of
+    the set is <= clip_norm. All (param, grad) pairs sharing one instance
+    are clipped together by a single fused op."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+        self._group = []
+        self._clipped = None
+
+    def process_context(self, context, p_g):
+        # collect this instance's group once per append_gradient_clip_ops run
+        self._group = [(p, g) for p, g in p_g
+                       if getattr(p, "gradient_clip", None) is self]
+        self._clipped = None
+
+    def create_operators(self, param, grad):
+        if self._clipped is None:
+            helper = LayerHelper("gradient_clip",
+                                 main_program=param.block.program)
+            block = param.block
+            grads = [g for _, g in self._group]
+            out_vars = [
+                block.create_var(
+                    name=block.program.unique_name(g.name + "@CLIP"),
+                    shape=g.shape, dtype=g.dtype, stop_gradient=True)
+                for g in grads
+            ]
+            helper.append_op("clip_by_global_norm", {"X": grads},
+                             {"Out": out_vars}, {"max_norm": self.clip_norm})
+            self._clipped = {g.name: v for g, v in zip(grads, out_vars)}
+        return param, self._clipped[grad.name]
+
+
+ClipByValue = GradientClipByValue
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Attach ``clip`` to every parameter in ``param_list`` (default: all
+    parameters of ``program``)."""
+    from .core.program import default_main_program
+
+    if not isinstance(clip, BaseGradientClipAttr):
+        raise TypeError(
+            "clip should be an instance of BaseGradientClipAttr")
+    program = program or default_main_program()
+    if param_list is None:
+        params = program.global_block.all_parameters()
+    else:
+        params = [program.global_block.var(p) if isinstance(p, str) else p
+                  for p in param_list]
+    for p in params:
+        p.gradient_clip = clip
+
+
+def append_gradient_clip_ops(param_grad):
+    """Append clip ops per the parameters' ``gradient_clip`` attrs; returns
+    the new [(param, grad)] list (fluid clip.py append_gradient_clip_ops)."""
+    context = {}
+    callbacks = []
+    seen = set()
+    for p, g in param_grad:
+        clip_attr = getattr(p, "gradient_clip", None) or NullGradientClipAttr()
+        if not isinstance(clip_attr, BaseGradientClipAttr):
+            raise TypeError(
+                "gradient_clip should be an instance of BaseGradientClipAttr")
+        if id(clip_attr) not in seen:
+            seen.add(id(clip_attr))
+            clip_attr.process_context(context=context, p_g=param_grad)
+        callbacks.append(functools.partial(
+            clip_attr.create_operators, param=p, grad=g))
+    return [cb() for cb in callbacks]

@@ -243,3 +243,70 @@ def proximal_adagrad(attrs, ins):
     p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
              / (1.0 + lr_t * l2))
     return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [mom_out]}
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules as ops
+# ---------------------------------------------------------------------------
+@register_op("lr_schedule")
+def lr_schedule(attrs, ins):
+    """Compute the step's learning rate from GlobalStep — one op per policy.
+
+    TPU-native form of the reference's LR schedulers
+    (/root/reference/paddle/parameter/LearningRateScheduler.cpp: poly, exp,
+    discrete/piecewise, linear policies; fluid drives decay from a
+    global_step counter). Computing the LR in-graph keeps the whole
+    schedule inside the single compiled train step — no host round-trip
+    per step and no recompilation when the LR changes.
+    """
+    step = single(ins, "GlobalStep").reshape(()).astype(jnp.float32)
+    policy = attrs["policy"]
+    lr0 = attrs.get("learning_rate", 0.1)
+    decay_steps = attrs.get("decay_steps", 1)
+    decay_rate = attrs.get("decay_rate", 0.1)
+    t = step / decay_steps
+    if attrs.get("staircase", False):
+        t = jnp.floor(t)
+    if policy == "exponential":  # ExpLRS / fluid exponential_decay
+        lr = lr0 * jnp.power(decay_rate, t)
+    elif policy == "natural_exp":
+        lr = lr0 * jnp.exp(-decay_rate * t)
+    elif policy == "inverse_time":
+        lr = lr0 / (1.0 + decay_rate * t)
+    elif policy == "polynomial":  # PolyLRS
+        end_lr = attrs.get("end_learning_rate", 1e-4)
+        power = attrs.get("power", 1.0)
+        if attrs.get("cycle", False):
+            ds = decay_steps * jnp.maximum(
+                1.0, jnp.ceil(step / decay_steps))
+        else:
+            ds = jnp.asarray(float(decay_steps), jnp.float32)
+        frac = jnp.minimum(step, ds) / ds
+        lr = (lr0 - end_lr) * jnp.power(1.0 - frac, power) + end_lr
+    elif policy == "piecewise":  # DiscreteExpLRS / ManualLRS-style
+        boundaries = jnp.asarray(attrs["boundaries"], jnp.float32)
+        values = jnp.asarray(attrs["values"], jnp.float32)
+        idx = jnp.sum((step >= boundaries).astype(jnp.int32))
+        lr = jnp.take(values, idx)
+    elif policy == "noam":  # transformer LR (d_model^-0.5 * min(...))
+        warmup = attrs.get("warmup_steps", 4000)
+        d_model = attrs.get("d_model", 512)
+        s = jnp.maximum(step, 1.0)
+        lr = (d_model ** -0.5) * jnp.minimum(s ** -0.5,
+                                             s * warmup ** -1.5)
+    else:
+        raise ValueError(f"unknown lr_schedule policy {policy!r}")
+    return out(Out=lr.reshape(1))
+
+
+@register_op("lr_warmup")
+def lr_warmup(attrs, ins):
+    """Linear warmup wrapping another LR variable: ramp start->end over
+    warmup_steps, then follow the wrapped schedule."""
+    lr = single(ins, "LearningRate").reshape(())
+    step = single(ins, "GlobalStep").reshape(()).astype(jnp.float32)
+    warmup = float(attrs["warmup_steps"])
+    start = attrs.get("start_lr", 0.0)
+    end = attrs.get("end_lr", 1.0)
+    ramp = start + (end - start) * (step / warmup)
+    return out(Out=jnp.where(step < warmup, ramp, lr).reshape(1))

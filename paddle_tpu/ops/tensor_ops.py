@@ -232,22 +232,60 @@ def scale(attrs, ins):
 
 @register_op("clip")
 def clip(attrs, ins):
-    return out(Out=jnp.clip(single(ins, "X"), attrs["min"], attrs["max"]))
+    x = single(ins, "X")
+    if isinstance(x, SelectedRows):
+        # merge duplicate rows FIRST: the bound applies to the effective
+        # (dense-equivalent) per-row gradient, not each occurrence
+        m = x.merged()
+        return out(Out=SelectedRows(
+            m.rows, jnp.clip(m.values, attrs["min"], attrs["max"]), m.height))
+    return out(Out=jnp.clip(x, attrs["min"], attrs["max"]))
+
+
+def _sq_l2(g):
+    """Squared L2 norm of a gradient; SelectedRows are deduplicated first so
+    repeated rows contribute their summed (dense-equivalent) value."""
+    if isinstance(g, SelectedRows):
+        return jnp.sum(jnp.square(g.merged().values.astype(jnp.float32)))
+    return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+
+def _rescale(g, factor):
+    if isinstance(g, SelectedRows):
+        return SelectedRows(g.rows,
+                            g.values * factor.astype(g.values.dtype),
+                            g.height)
+    return g * factor.astype(g.dtype)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(attrs, ins):
+    """Rescale X so its L2 norm is at most max_norm (clip_by_norm_op)."""
+    x = single(ins, "X")
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.maximum(_sq_l2(x), 1e-12))
+    factor = jnp.minimum(1.0, max_norm / norm)
+    return out(Out=_rescale(x, factor))
+
+
+@register_op("clip_by_global_norm")
+def clip_by_global_norm(attrs, ins):
+    """Jointly rescale every gradient in X so the global L2 norm of the set
+    is at most max_norm — one fused kernel over all grads (the TPU-native
+    form of the legacy trainer's gradient_clipping_threshold, applied
+    per-parameter-update in ParameterConfig.proto)."""
+    xs = ins["X"]
+    max_norm = attrs["max_norm"]
+    gnorm = jnp.sqrt(jnp.maximum(
+        sum(_sq_l2(g) for g in xs), 1e-12))
+    factor = jnp.minimum(1.0, max_norm / gnorm)
+    return {"Out": [_rescale(g, factor) for g in xs]}
 
 
 @register_op("l1_decay_sign")
 def l1_decay_sign(attrs, ins):
     x = single(ins, "X")
     return out(Out=jnp.sign(x) * jnp.asarray(attrs["coeff"], dtype=x.dtype))
-
-
-@register_op("clip_by_norm")
-def clip_by_norm(attrs, ins):
-    x = single(ins, "X")
-    max_norm = attrs["max_norm"]
-    norm = jnp.sqrt(jnp.sum(x * x))
-    scale_f = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
-    return out(Out=x * scale_f.astype(x.dtype))
 
 
 # --- reductions -------------------------------------------------------------

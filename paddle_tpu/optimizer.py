@@ -34,6 +34,10 @@ class Optimizer:
     def _create_lr_var(self, program: Program, startup: Program) -> Variable:
         if self._lr_var is not None:
             return self._lr_var
+        if hasattr(self.learning_rate, "name"):
+            # a program-computed LR Variable (learning_rate_decay schedule)
+            self._lr_var = self.learning_rate
+            return self._lr_var
         name = program.unique_name("learning_rate")
         block = program.global_block
         v = block.create_var(name=name, shape=[1], dtype="float32",
@@ -77,8 +81,14 @@ class Optimizer:
     def minimize(self, loss: Variable, startup_program: Optional[Program] = None,
                  parameter_list=None, no_grad_set=None
                  ) -> List[Tuple[Variable, Variable]]:
+        from .clip import append_gradient_clip_ops
+
         startup = startup_program or default_startup_program()
         params_grads = append_backward(loss, parameter_list, no_grad_set)
+        # clip BEFORE regularization — fluid's order
+        # (reference optimizer.py runs append_gradient_clip_ops first, then
+        # append_regularization_ops)
+        params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         block = loss.block

@@ -16,12 +16,14 @@ class ParamAttr:
         learning_rate: float = 1.0,
         regularizer=None,
         trainable: bool = True,
+        gradient_clip=None,
     ):
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate
         self.regularizer = regularizer
         self.trainable = trainable
+        self.gradient_clip = gradient_clip
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":

@@ -1,0 +1,229 @@
+"""Gradient clipping + learning-rate schedules.
+
+Reference surfaces: /root/reference/python/paddle/v2/fluid/clip.py:23
+(GradientClipByValue, append_gradient_clip_ops) and
+/root/reference/paddle/parameter/LearningRateScheduler.cpp (poly/exp/
+discrete/linear policies), tested in the OpTest style of
+fluid/tests/test_clip_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+from paddle_tpu.core.selected_rows import SelectedRows
+
+import jax.numpy as jnp
+
+
+def run_op(op_type, ins, attrs=None):
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+# ---------------------------------------------------------------------------
+# op-level
+# ---------------------------------------------------------------------------
+class TestClipOps:
+    def test_clip_by_norm(self):
+        x = jnp.array([[3.0, 4.0]])  # norm 5
+        o = run_op("clip_by_norm", {"X": [x]}, {"max_norm": 1.0})["Out"][0]
+        np.testing.assert_allclose(np.asarray(o), [[0.6, 0.8]], rtol=1e-5)
+        # under the threshold: unchanged
+        o = run_op("clip_by_norm", {"X": [x]}, {"max_norm": 10.0})["Out"][0]
+        np.testing.assert_allclose(np.asarray(o), [[3.0, 4.0]], rtol=1e-6)
+
+    def test_clip_by_global_norm(self):
+        a, b = jnp.array([3.0]), jnp.array([4.0])  # global norm 5
+        outs = run_op("clip_by_global_norm", {"X": [a, b]},
+                      {"max_norm": 2.5})["Out"]
+        np.testing.assert_allclose(np.asarray(outs[0]), [1.5], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[1]), [2.0], rtol=1e-5)
+
+    def test_clip_by_global_norm_sparse_counts_duplicates(self):
+        # duplicate row ids must contribute their SUMMED value to the norm
+        sr = SelectedRows(jnp.array([2, 2], jnp.int32),
+                          jnp.array([[1.5], [1.5]], jnp.float32), 5)
+        outs = run_op("clip_by_global_norm", {"X": [sr]},
+                      {"max_norm": 1.0})["Out"]
+        o = outs[0]
+        assert isinstance(o, SelectedRows)
+        # dense grad is 3.0 at row 2 -> norm 3 -> factor 1/3
+        np.testing.assert_allclose(np.asarray(o.to_dense())[2], [1.0],
+                                   rtol=1e-5)
+
+    def test_clip_value_sparse(self):
+        sr = SelectedRows(jnp.array([0], jnp.int32),
+                          jnp.array([[-5.0, 5.0]], jnp.float32), 3)
+        o = run_op("clip", {"X": [sr]}, {"min": -1.0, "max": 1.0})["Out"][0]
+        assert isinstance(o, SelectedRows)
+        np.testing.assert_allclose(np.asarray(o.values), [[-1.0, 1.0]])
+
+
+class TestLRScheduleOps:
+    step = jnp.array([10.0])
+
+    def _lr(self, policy, **attrs):
+        o = run_op("lr_schedule", {"GlobalStep": [self.step]},
+                   dict(attrs, policy=policy))["Out"][0]
+        return float(np.asarray(o)[0])
+
+    def test_exponential(self):
+        got = self._lr("exponential", learning_rate=0.1, decay_steps=5,
+                       decay_rate=0.5)
+        assert np.isclose(got, 0.1 * 0.5 ** 2.0)
+        stair = self._lr("exponential", learning_rate=0.1, decay_steps=4,
+                         decay_rate=0.5, staircase=True)
+        assert np.isclose(stair, 0.1 * 0.5 ** 2.0)  # floor(10/4) = 2
+
+    def test_natural_exp_and_inverse_time(self):
+        assert np.isclose(
+            self._lr("natural_exp", learning_rate=0.1, decay_steps=10,
+                     decay_rate=0.5), 0.1 * np.exp(-0.5))
+        assert np.isclose(
+            self._lr("inverse_time", learning_rate=0.1, decay_steps=10,
+                     decay_rate=1.0), 0.05)
+
+    def test_polynomial(self):
+        got = self._lr("polynomial", learning_rate=0.1, decay_steps=20,
+                       end_learning_rate=0.01, power=1.0)
+        assert np.isclose(got, (0.1 - 0.01) * 0.5 + 0.01)
+
+    def test_piecewise(self):
+        for step, expect in [(0.0, 0.1), (10.0, 0.05), (25.0, 0.01)]:
+            o = run_op("lr_schedule", {"GlobalStep": [jnp.array([step])]},
+                       {"policy": "piecewise", "boundaries": [10.0, 20.0],
+                        "values": [0.1, 0.05, 0.01]})["Out"][0]
+            assert np.isclose(float(np.asarray(o)[0]), expect), step
+
+    def test_noam_and_warmup(self):
+        warm = run_op("lr_warmup", {"LearningRate": [jnp.array([0.1])],
+                                    "GlobalStep": [jnp.array([5.0])]},
+                      {"warmup_steps": 10, "start_lr": 0.0,
+                       "end_lr": 0.1})["Out"][0]
+        assert np.isclose(float(np.asarray(warm)[0]), 0.05)
+        after = run_op("lr_warmup", {"LearningRate": [jnp.array([0.07])],
+                                     "GlobalStep": [jnp.array([15.0])]},
+                       {"warmup_steps": 10, "start_lr": 0.0,
+                        "end_lr": 0.1})["Out"][0]
+        assert np.isclose(float(np.asarray(after)[0]), 0.07)
+        noam = self._lr("noam", d_model=512, warmup_steps=4000)
+        assert np.isclose(noam, 512 ** -0.5 * 10 * 4000 ** -1.5)
+
+
+# ---------------------------------------------------------------------------
+# program-level integration
+# ---------------------------------------------------------------------------
+def _one_step(clip_attr=None, lr=1.0, feed_scale=100.0):
+    """One SGD step on a linear model with a huge gradient; returns the
+    parameter delta."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pa = pt.ParamAttr(gradient_clip=clip_attr) if clip_attr else None
+        pred = layers.fc(x, size=1, param_attr=pa, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    wname = [k for k in scope.keys() if k.startswith("fc")][0]
+    w0 = np.asarray(scope.get(wname)).copy()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32) * feed_scale,
+            "y": rng.rand(8, 1).astype(np.float32)}
+    exe.run(main, feed=feed, scope=scope)
+    return np.asarray(scope.get(wname)) - w0
+
+
+def test_gradient_clip_by_value_bounds_update():
+    delta = _one_step(pt.clip.GradientClipByValue(max=0.01), lr=1.0)
+    assert np.abs(delta).max() <= 0.01 + 1e-6
+    unclipped = _one_step(None, lr=1.0)
+    assert np.abs(unclipped).max() > 0.01  # sanity: clip actually did work
+
+
+def test_gradient_clip_by_global_norm_bounds_update():
+    delta = _one_step(pt.clip.GradientClipByGlobalNorm(clip_norm=0.1), lr=1.0)
+    assert np.linalg.norm(delta) <= 0.1 + 1e-5
+
+
+def test_set_gradient_clip_applies_to_all_params():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        pt.clip.set_gradient_clip(
+            pt.clip.GradientClipByGlobalNorm(clip_norm=0.05), program=main)
+        pt.optimizer.SGDOptimizer(learning_rate=1.0).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    names = [k for k in scope.keys() if k.startswith("fc")]
+    before = {n: np.asarray(scope.get(n)).copy() for n in names}
+    rng = np.random.RandomState(0)
+    exe.run(main, feed={"x": rng.rand(8, 4).astype(np.float32) * 100,
+                        "y": rng.rand(8, 1).astype(np.float32)}, scope=scope)
+    total = np.sqrt(sum(
+        ((np.asarray(scope.get(n)) - before[n]) ** 2).sum() for n in names))
+    assert total <= 0.05 + 1e-5
+
+
+def test_training_with_decay_and_clip():
+    """The book-style test: a net trains with piecewise decay + global-norm
+    clip enabled; the LR variable follows the schedule step by step."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = pt.learning_rate_decay.piecewise_decay(
+            boundaries=[3, 6], values=[0.1, 0.05, 0.01])
+        pt.clip.set_gradient_clip(
+            pt.clip.GradientClipByGlobalNorm(clip_norm=1.0), program=main)
+        pt.optimizer.MomentumOptimizer(learning_rate=lr,
+                                       momentum=0.9).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    losses, lrs = [], []
+    for _ in range(8):
+        out_loss, out_lr = exe.run(main, feed={"x": xs, "y": ys},
+                                   fetch_list=[loss, lr], scope=scope)
+        losses.append(float(out_loss))
+        lrs.append(float(np.asarray(out_lr)[0]))
+    # counter increments before the lr op: steps 1..8
+    np.testing.assert_allclose(
+        lrs, [0.1, 0.1, 0.05, 0.05, 0.05, 0.01, 0.01, 0.01], rtol=1e-6)
+    assert losses[-1] < losses[0]
+
+
+def test_exponential_decay_in_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        loss = layers.mean(layers.fc(x, size=1, bias_attr=False))
+        lr = pt.learning_rate_decay.exponential_decay(
+            learning_rate=0.1, decay_steps=1, decay_rate=0.5)
+        pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((2, 2), np.float32)}
+    got = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[lr],
+                                    scope=scope)[0])[0])
+           for _ in range(3)]
+    np.testing.assert_allclose(got, [0.05, 0.025, 0.0125], rtol=1e-6)
