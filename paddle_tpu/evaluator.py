@@ -198,6 +198,83 @@ class Auc(Evaluator):
         return float(np.trapezoid(tpr, fpr))
 
 
+class CTCError(Evaluator):
+    """Streaming CTC error (legacy ctc_error_evaluator,
+    /root/reference/paddle/gserver/evaluators/CTCErrorEvaluator.cpp:162-192):
+    per sequence, the edit distance between the greedy-decoded best path
+    and the label, normalized by max(len(decoded), len(label)); ``eval()``
+    returns the average over sequences. ``seq_error`` additionally tracks
+    the fraction of sequences with any error (seqClassficationError_)."""
+
+    def __init__(self, input, label, blank=0, **kwargs):
+        super().__init__("ctc_error", **kwargs)
+        self.total_norm_dist = self._create_state("norm_dist", [], "float32")
+        self.total_seqs = self._create_state("seqs", [], "float32")
+        self.total_wrong = self._create_state("wrong", [], "float32")
+        from . import layers
+
+        main = self.helper.main_program
+        startup = self.helper.startup_program
+        dec, dec_len = layers.ctc_greedy_decoder(
+            input, blank=blank, main_program=main, startup_program=startup)
+        ins = {"Hyps": [dec], "Refs": [label], "HypsLength": [dec_len]}
+        tl = get_seq_len(label)
+        if tl is not None:
+            ins["RefsLength"] = [tl]
+        outs, _ = self.helper.append_op(
+            "edit_distance", ins, ["Out", "SequenceNum"], {})
+        dist = outs["Out"][0]  # [b, 1]
+        # normalize by max(len(hyp), len(ref)) per sequence
+        ref_len = (tl if tl is not None else
+                   self.helper.simple_op(
+                       "fill_constant_batch_size_like",
+                       {"Input": [dist]},
+                       {"shape": [-1, 1], "dtype": "float32",
+                        "value": float(label.shape[-1])}))
+        hyp_f = self.helper.simple_op("cast", {"X": [dec_len]},
+                                      {"dtype": "float32"})
+        ref_f = self.helper.simple_op("cast", {"X": [ref_len]},
+                                      {"dtype": "float32"})
+        # lengths from lod data layers are [b]; align to dist's [b, 1] so
+        # the elementwise ops below never cross-broadcast to [b, b]
+        ref_f = self.helper.simple_op("reshape", {"X": [ref_f]},
+                                      {"shape": [-1, 1]})
+        hyp_f = self.helper.simple_op("reshape", {"X": [hyp_f]},
+                                      {"shape": [-1, 1]})
+        max_len = self.helper.simple_op(
+            "elementwise_max", {"X": [hyp_f], "Y": [ref_f]}, {})
+        one = self.helper.simple_op(
+            "fill_constant_batch_size_like", {"Input": [dist]},
+            {"shape": [-1, 1], "dtype": "float32", "value": 1.0})
+        denom = self.helper.simple_op(
+            "elementwise_max", {"X": [max_len], "Y": [one]}, {})
+        norm = self.helper.simple_op(
+            "elementwise_div", {"X": [dist], "Y": [denom]}, {})
+        nsum = self.helper.simple_op("reduce_sum", {"X": [norm]},
+                                     {"keep_dim": False})
+        # dist > 0 <=> the sequence has at least one error
+        zero = self.helper.simple_op("scale", {"X": [one]}, {"scale": 0.0})
+        wrong = self.helper.simple_op(
+            "greater_than", {"X": [dist], "Y": [zero]}, {})
+        wrong_f = self.helper.simple_op("cast", {"X": [wrong]},
+                                       {"dtype": "float32"})
+        wsum = self.helper.simple_op("reduce_sum", {"X": [wrong_f]},
+                                     {"keep_dim": False})
+        n = self.helper.simple_op("cast", {"X": [outs["SequenceNum"][0]]},
+                                  {"dtype": "float32"})
+        self._accumulate(self.total_norm_dist, nsum)
+        self._accumulate(self.total_seqs, n)
+        self._accumulate(self.total_wrong, wsum)
+
+    def eval(self, executor, scope=None):
+        nd, n, _ = self._fetch_states(scope)
+        return float(nd) / max(float(n), 1.0)
+
+    def seq_error(self, scope=None):
+        _, n, w = self._fetch_states(scope)
+        return float(w) / max(float(n), 1.0)
+
+
 class EditDistance(Evaluator):
     """Streaming average edit distance (legacy ctc_error_evaluator;
     fluid edit_distance_op.cc)."""

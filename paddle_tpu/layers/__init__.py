@@ -10,11 +10,11 @@ from .control_flow import (StaticRNN, While, array_read, array_write,
                            beam_search_decoder, create_array, increment)
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
 from .ops import __all__ as _ops_all
-from .sequence import (dynamic_gru, dynamic_lstm, gru_unit, lstm_unit,
-                       row_conv, sequence_concat, sequence_conv,
-                       sequence_expand, sequence_first_step,
+from .sequence import (ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
+                       gru_unit, lstm_unit, row_conv, sequence_concat,
+                       sequence_conv, sequence_expand, sequence_first_step,
                        sequence_last_step, sequence_pool, sequence_reverse,
-                       sequence_softmax)
+                       sequence_softmax, warpctc)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
                      fill_constant, fill_constant_batch_size_like, matmul,
                      mean, one_hot, reshape, scale, split, sums, transpose)
@@ -30,6 +30,7 @@ __all__ = (
      "sequence_softmax", "sequence_expand", "sequence_reverse",
      "sequence_conv", "sequence_concat", "row_conv",
      "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
+     "warpctc", "ctc_greedy_decoder",
      "StaticRNN", "While", "create_array", "array_write", "array_read",
      "increment", "beam_search_decoder",
      "multi_head_attention", "transformer_encoder_layer", "switch_moe"]

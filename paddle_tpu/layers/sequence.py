@@ -238,3 +238,43 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         "gru_unit", ins, ["Hidden", "Gate", "ResetHiddenPrev"],
         {"activation": activation, "gate_activation": gate_activation})
     return outs["Hidden"][0], outs["Gate"][0], outs["ResetHiddenPrev"][0]
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            main_program=None, startup_program=None):
+    """CTC loss layer (reference WarpCTCLayer.cpp / hl_warpctc_wrap.cc).
+
+    ``input``: [b, T, C] unnormalized logits; ``label``: [b, L] int ids.
+    Sequence lengths attached to either variable (data(..., lod_level=1) /
+    upstream sequence ops) are used automatically. Returns Loss [b, 1].
+    """
+    helper = LayerHelper("warpctc", main_program=main_program,
+                         startup_program=startup_program)
+    ins = {"Logits": [input], "Label": [label]}
+    ll = get_seq_len(input)
+    tl = get_seq_len(label)
+    if ll is not None:
+        ins["LogitsLength"] = [ll]
+    if tl is not None:
+        ins["LabelLength"] = [tl]
+    outs, _ = helper.append_op(
+        "warpctc", ins, ["Loss"],
+        {"blank": blank, "norm_by_times": norm_by_times})
+    return outs["Loss"][0]
+
+
+def ctc_greedy_decoder(input, blank=0, main_program=None,
+                       startup_program=None):
+    """Best-path CTC decoding (collapse repeats, drop blanks); returns
+    (decoded [b, T] padded with blank, lengths [b, 1])."""
+    helper = LayerHelper("ctc_greedy_decoder", main_program=main_program,
+                         startup_program=startup_program)
+    ins = {"Logits": [input]}
+    ll = get_seq_len(input)
+    if ll is not None:
+        ins["LogitsLength"] = [ll]
+    outs, _ = helper.append_op("ctc_greedy_decode", ins,
+                               ["Out", "OutLength"], {"blank": blank})
+    dec, n = outs["Out"][0], outs["OutLength"][0]
+    dec.seq_len = n
+    return dec, n

@@ -38,11 +38,18 @@ def step_counter(main_program=None, startup_program=None, begin=0):
     main = helper.main_program
     cached = getattr(main, "_lr_step_counter", None)
     if cached is not None:
+        if int(begin) != cached._begin:
+            raise ValueError(
+                f"this program's shared LR step counter already starts at "
+                f"{cached._begin}; cannot re-create it with begin="
+                f"{int(begin)}. Pass the counter explicitly as global_step "
+                f"to use a different origin.")
         return cached
     counter = tensor_layers.create_global_var(
         shape=[1], value=int(begin), dtype="int32",
         name=main.unique_name("lr_global_step"),
         main_program=main, startup_program=helper.startup_program)
+    counter._begin = int(begin)
     helper.block.append_op("increment", inputs={"X": [counter.name]},
                            outputs={"Out": [counter.name]},
                            attrs={"step": 1})

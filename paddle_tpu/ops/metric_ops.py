@@ -84,6 +84,8 @@ def edit_distance(attrs, ins):
         hlen = jnp.full((b,), Th, jnp.int32)
     if rlen is None:
         rlen = jnp.full((b,), Tr, jnp.int32)
+    hlen = hlen.reshape(-1).astype(jnp.int32)
+    rlen = rlen.reshape(-1).astype(jnp.int32)
     normalized = attrs.get("normalized", False)
 
     j_idx = jnp.arange(Th + 1, dtype=jnp.int32)  # [Th+1]

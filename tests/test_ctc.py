@@ -1,0 +1,214 @@
+"""CTC loss, greedy decoding, and the streaming CTC-error evaluator.
+
+References: /root/reference/paddle/cuda/src/hl_warpctc_wrap.cc (loss),
+/root/reference/paddle/gserver/layers/WarpCTCLayer.cpp (layer),
+/root/reference/paddle/gserver/evaluators/CTCErrorEvaluator.cpp (error
+metric, incl. max-length normalization at :162).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+import jax
+import jax.numpy as jnp
+
+
+def run_op(op_type, ins, attrs=None):
+    return get_op(op_type).fn(attrs or {}, ins)
+
+
+def brute_force_ctc(logp, label, blank=0):
+    """Sum over ALL T-length paths collapsing to `label` (exponential —
+    only for tiny shapes)."""
+    T, C = logp.shape
+
+    def collapse(path):
+        toks, prev = [], -1
+        for c in path:
+            if c != prev and c != blank:
+                toks.append(c)
+            prev = c
+        return tuple(toks)
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            total += np.exp(sum(logp[t, c] for t, c in enumerate(path)))
+    return -np.log(total)
+
+
+class TestWarpCTCOp:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        T, C = 4, 3
+        logits = rng.randn(1, T, C).astype(np.float32)
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits[0])))
+        for label in ([1], [1, 2], [2, 2], [1, 2, 1]):
+            o = run_op("warpctc",
+                       {"Logits": [jnp.asarray(logits)],
+                        "Label": [jnp.asarray([label], jnp.int32)]})
+            got = float(np.asarray(o["Loss"][0])[0, 0])
+            expect = brute_force_ctc(logp, label)
+            np.testing.assert_allclose(got, expect, rtol=1e-4), label
+
+    def test_variable_lengths(self):
+        rng = np.random.RandomState(1)
+        b, T, C, L = 3, 6, 4, 3
+        logits = rng.randn(b, T, C).astype(np.float32)
+        label = rng.randint(1, C, size=(b, L)).astype(np.int32)
+        tlen = np.array([6, 4, 5], np.int32)
+        llen = np.array([3, 1, 2], np.int32)
+        o = run_op("warpctc", {
+            "Logits": [jnp.asarray(logits)],
+            "Label": [jnp.asarray(label)],
+            "LogitsLength": [jnp.asarray(tlen)],
+            "LabelLength": [jnp.asarray(llen)]})
+        losses = np.asarray(o["Loss"][0])[:, 0]
+        # each loss equals the brute force on its truncated slice
+        for i in range(b):
+            lp = np.asarray(jax.nn.log_softmax(
+                jnp.asarray(logits[i, :tlen[i]])))
+            expect = brute_force_ctc(lp, label[i, :llen[i]].tolist())
+            np.testing.assert_allclose(losses[i], expect, rtol=1e-4)
+
+    def test_gradient_matches_finite_difference(self):
+        rng = np.random.RandomState(2)
+        T, C = 5, 3
+        logits = rng.randn(1, T, C).astype(np.float64)
+        label = jnp.asarray([[1, 2]], jnp.int32)
+
+        def f(x):
+            return run_op("warpctc", {"Logits": [x], "Label": [label]}
+                          )["Loss"][0].sum()
+
+        g = np.asarray(jax.grad(f)(jnp.asarray(logits, jnp.float32)))
+        eps = 1e-3
+        for t in range(T):
+            for c in range(C):
+                xp = logits.copy()
+                xp[0, t, c] += eps
+                xm = logits.copy()
+                xm[0, t, c] -= eps
+                fd = (float(f(jnp.asarray(xp, jnp.float32)))
+                      - float(f(jnp.asarray(xm, jnp.float32)))) / (2 * eps)
+                np.testing.assert_allclose(g[0, t, c], fd, rtol=2e-2,
+                                           atol=2e-3)
+
+    def test_norm_by_times(self):
+        rng = np.random.RandomState(3)
+        logits = rng.randn(1, 4, 3).astype(np.float32)
+        label = jnp.asarray([[1]], jnp.int32)
+        a = float(np.asarray(run_op("warpctc", {
+            "Logits": [jnp.asarray(logits)],
+            "Label": [label]})["Loss"][0])[0, 0])
+        b = float(np.asarray(run_op("warpctc", {
+            "Logits": [jnp.asarray(logits)], "Label": [label]},
+            {"norm_by_times": True})["Loss"][0])[0, 0])
+        np.testing.assert_allclose(b, a / 4.0, rtol=1e-6)
+
+
+class TestCTCGreedyDecode:
+    def test_collapse_and_blank_removal(self):
+        # frames argmax: [1, 1, 0, 2, 2, 0] -> collapse -> [1, 2]
+        path = [1, 1, 0, 2, 2, 0]
+        C = 3
+        logits = np.full((1, len(path), C), -5.0, np.float32)
+        for t, c in enumerate(path):
+            logits[0, t, c] = 5.0
+        o = run_op("ctc_greedy_decode", {"Logits": [jnp.asarray(logits)]})
+        dec = np.asarray(o["Out"][0])[0]
+        n = int(np.asarray(o["OutLength"][0])[0, 0])
+        assert n == 2
+        assert dec[:2].tolist() == [1, 2]
+        assert (dec[2:] == 0).all()
+
+    def test_repeat_after_blank_kept(self):
+        path = [1, 0, 1]  # 1, blank, 1 -> [1, 1]
+        logits = np.full((1, 3, 2), -5.0, np.float32)
+        for t, c in enumerate(path):
+            logits[0, t, c] = 5.0
+        o = run_op("ctc_greedy_decode", {"Logits": [jnp.asarray(logits)]})
+        assert int(np.asarray(o["OutLength"][0])[0, 0]) == 2
+        assert np.asarray(o["Out"][0])[0, :2].tolist() == [1, 1]
+
+
+def test_ctc_training_and_error_evaluator():
+    """Book-style: train a tiny speech-ish model on fixed alignments until
+    the CTC error evaluator reports improvement."""
+    rng = np.random.RandomState(0)
+    b, T, C, L = 8, 10, 5, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        feats = layers.data("feats", shape=[T, 6])
+        label = layers.data("label", shape=[L], dtype="int64")
+        h = layers.fc(feats, size=16, act="relu", num_flatten_dims=2)
+        logits = layers.fc(h, size=C, num_flatten_dims=2)
+        loss = layers.mean(layers.warpctc(logits, label, blank=0))
+        err = pt.evaluator.CTCError(logits, label, blank=0,
+                                    main_program=main,
+                                    startup_program=startup)
+        pt.optimizer.AdamOptimizer(learning_rate=0.02).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+
+    # synthetic task: feature frame t encodes the target token to emit
+    labels = rng.randint(1, C, size=(b, L)).astype(np.int64)
+    feats_np = np.zeros((b, T, 6), np.float32)
+    for i in range(b):
+        for j in range(L):  # stretch each token over ~3 frames
+            feats_np[i, 3 * j:3 * j + 3, labels[i, j]] = 1.0
+    feats_np += rng.randn(b, T, 6).astype(np.float32) * 0.05
+
+    first = last = None
+    for step in range(150):
+        if step == 120:
+            err.reset(exe, scope)
+        out, = exe.run(main, feed={"feats": feats_np, "label": labels},
+                       fetch_list=[loss], scope=scope)
+        if first is None:
+            first = float(out)
+        last = float(out)
+    assert last < first * 0.5, (first, last)
+    assert err.eval(exe, scope) < 0.35
+    assert 0.0 <= err.seq_error(scope) <= 1.0
+
+
+def test_ctc_error_evaluator_variable_length_labels():
+    """CTCError with lod-level labels ([b] companion lengths): the metric
+    must stay per-sequence (no [b, b] cross-broadcast)."""
+    b, T, C, L = 4, 6, 4, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        logits_in = layers.data("logits", shape=[T, C])
+        label = layers.data("label", shape=[1], dtype="int64", lod_level=1)
+        err = pt.evaluator.CTCError(logits_in, label, blank=0,
+                                    main_program=main,
+                                    startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+
+    # craft logits whose greedy decode is exactly [1, 2] for every sequence
+    logits_np = np.full((b, T, C), -5.0, np.float32)
+    for i in range(b):
+        logits_np[i, 0, 1] = 5.0
+        logits_np[i, 1, 0] = 5.0
+        logits_np[i, 2, 2] = 5.0
+        logits_np[i, 3:, 0] = 5.0
+    labels = np.zeros((b, L), np.int64)
+    labels[:, 0], labels[:, 1] = 1, 2
+    labels[0, :1] = [1]  # seq 0 label is just [1] (length 1)
+    lengths = np.array([1, 2, 2, 2], np.int32)
+    exe.run(main, feed={"logits": logits_np, "label": labels,
+                        "label@len": lengths}, scope=scope)
+    # seqs 1..3 decode exactly; seq 0: dist([1,2],[1]) = 1, maxlen 2
+    got = err.eval(exe, scope)
+    np.testing.assert_allclose(got, (1 / 2) / b, rtol=1e-6)
+    np.testing.assert_allclose(err.seq_error(scope), 1 / b, rtol=1e-6)
