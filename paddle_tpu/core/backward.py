@@ -35,9 +35,12 @@ NON_DIFFERENTIABLE = {
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "argmax", "one_hot", "truncated_gaussian_random",
-    # lax.while_loop is not reverse-differentiable; these are decode-side.
-    "while", "beam_search_decoder",
+    # decode-side: generation is not trained through
+    "beam_search_decoder",
 }
+# NOTE: "while" IS differentiable when built with max_iters (fixed-trip
+# scan lowering); unbounded whiles on a loss path raise jax's
+# while_loop-not-differentiable error at compile time.
 
 
 # --------------------------------------------------------------------------

@@ -176,11 +176,16 @@ class While:
     enclosing block.
     """
 
-    def __init__(self, cond: Variable, main_program=None,
+    def __init__(self, cond: Variable, max_iters=None, main_program=None,
                  startup_program=None):
+        """``max_iters``: static trip-count bound. Setting it lowers the
+        loop to a fixed-length masked scan, which makes the while
+        reverse-differentiable (trainable) — see ops/control_flow_ops.py
+        while_op. Leave None for decode-side loops needing early exit."""
         self.helper = LayerHelper("while", main_program=main_program,
                                   startup_program=startup_program)
         self.cond = cond
+        self.max_iters = max_iters
         self.sub_block = None
 
     class _Block:
@@ -225,6 +230,7 @@ class While:
             "carried_names": carried,
             "param_names": params,
             "cond_name": self.cond.name,
+            "max_iters": self.max_iters,
         }
         # Outputs write back to the SAME outer variables (final loop state).
         outputs = {"Out": [outer.var(n) for n in carried]}

@@ -118,28 +118,52 @@ def static_rnn(attrs, ins):
 
 @register_op("while", optional_inputs=("Param",))
 def while_op(attrs, ins):
-    """Bounded functional while (while_op.cc): body runs until the carried
-    cond var is false. Carried vars are the loop state; the body must
-    reassign each (typically via ``assign``/arithmetic writing the same
-    name). Not reverse-differentiable (lax.while_loop limitation) — use
-    static_rnn for trainable recurrences, as the reference uses
-    recurrent_op for training and while for decode."""
+    """Functional while (while_op.cc): body runs until the carried cond var
+    is false. Carried vars are the loop state; the body must reassign each
+    (typically via ``assign``/arithmetic writing the same name).
+
+    Two lowerings:
+    - ``max_iters`` set -> a fixed-trip ``lax.scan`` where steps whose cond
+      has gone false pass the carry through unchanged. This is
+      reverse-differentiable, so while-graphs TRAIN — the TPU answer to the
+      reference differentiating while sub-blocks
+      (/root/reference/paddle/framework/backward.cc:415 MakeBlockBackward).
+      The trip count is static (compiler-friendly); inactive tail steps are
+      masked no-ops.
+    - otherwise -> ``lax.while_loop`` with true early exit (decode-side
+      loops: beam search, generation). Not reverse-differentiable; pass
+      max_iters if the loop must be trained through.
+    """
     carried_in = ins["Carried"]
     params = ins.get("Param", [])
     body_ops = attrs["body_ops"]
     carried_names = attrs["carried_names"]
     param_names = attrs["param_names"]
     cond_name = attrs["cond_name"]
+    max_iters = attrs.get("max_iters")
     base_env = dict(zip(param_names, params))
-
-    def cond_fn(carry):
-        return jnp.reshape(carry[carried_names.index(cond_name)], ())
+    cond_pos = carried_names.index(cond_name)
 
     def body_fn(carry):
         env = dict(base_env)
         env.update(zip(carried_names, carry))
         env = run_body(body_ops, env)
         return tuple(env[n] for n in carried_names)
+
+    if max_iters is not None:
+        def step(carry, _):
+            active = jnp.reshape(carry[cond_pos], ()).astype(bool)
+            new = body_fn(carry)
+            merged = tuple(
+                jnp.where(active, n, o) for n, o in zip(new, carry))
+            return merged, None
+
+        final, _ = jax.lax.scan(step, tuple(carried_in), None,
+                                length=int(max_iters))
+        return {"Out": list(final)}
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_pos], ())
 
     final = jax.lax.while_loop(cond_fn, body_fn, tuple(carried_in))
     return {"Out": list(final)}

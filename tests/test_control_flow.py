@@ -252,3 +252,78 @@ class TestBeamSearchDecoder:
             {"beam_size": 3, "max_len": 5, "bos_id": 0, "eos_id": 1,
              "cell": "lstm"})
         assert np.asarray(outs["Ids"][0]).shape == (b, 3, 5)
+
+
+class TestWhileBackward:
+    """Backward through while (max_iters bound): the TPU analogue of the
+    reference differentiating while sub-blocks
+    (/root/reference/paddle/framework/backward.cc:415 MakeBlockBackward)."""
+
+    def _build(self, n_val, w0=None):
+        """loss = mean((w * x) applied n times to ones) — dynamic depth."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            n = layers.data("n", shape=[], dtype="float32",
+                            append_batch_size=False)
+            w_attr = pt.ParamAttr(
+                name="while_w",
+                initializer=pt.initializer.ConstantInitializer(
+                    0.8 if w0 is None else w0))
+            state = layers.fc(x, size=4, param_attr=w_attr, bias_attr=False)
+            i = layers.fill_constant(shape=[], value=0.0, dtype="float32")
+            cond = layers.less_than(i, n)
+            w = layers.While(cond, max_iters=6)
+            with w.block():
+                nxt = layers.scale(layers.tanh(state), 0.9)
+                layers.assign(nxt, output=state)
+                layers.assign(layers.increment(i, 1.0), output=i)
+                layers.assign(layers.less_than(i, n), output=cond)
+            loss = layers.mean(state)
+        return main, startup, loss
+
+    def test_gradient_matches_finite_difference(self):
+        rng = np.random.RandomState(0)
+        x_np = rng.rand(3, 4).astype(np.float32)
+
+        def loss_at(w0, n_val):
+            main, startup, loss = self._build(n_val, w0=w0)
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            out, = exe.run(main, feed={"x": x_np, "n": np.float32(n_val)},
+                           fetch_list=[loss], scope=scope)
+            return float(out)
+
+        def grad_at(n_val):
+            main, startup, loss = self._build(n_val)
+            pt.append_backward(loss)
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            g, = exe.run(main, feed={"x": x_np, "n": np.float32(n_val)},
+                         fetch_list=["while_w@GRAD"], scope=scope)
+            return np.asarray(g)
+
+        eps = 1e-3
+        for n_val in (0.0, 2.0, 4.0):  # including the no-iteration edge
+            g = grad_at(n_val)
+            fd = (loss_at(0.8 + eps, n_val) - loss_at(0.8 - eps, n_val)) \
+                / (2 * eps)
+            np.testing.assert_allclose(g.sum(), fd, rtol=5e-3, atol=1e-5),\
+                n_val
+
+    def test_dynamic_depth_model_trains(self):
+        rng = np.random.RandomState(0)
+        main, startup, loss = self._build(3.0)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        x_np = rng.rand(8, 4).astype(np.float32)
+        losses = []
+        for depth in (1.0, 3.0, 2.0, 3.0, 1.0, 2.0) * 4:
+            out, = exe.run(main, feed={"x": x_np, "n": np.float32(depth)},
+                           fetch_list=[loss], scope=scope)
+            losses.append(float(out))
+        assert losses[-1] < losses[0]
