@@ -227,11 +227,44 @@ def append_backward(
     def add_contribution(name: str, gname: str):
         contributions.setdefault(name, []).append(gname)
 
+    # The program is not SSA: in-place patterns (assign-into, the while
+    # op's carried write-back) re-write existing names. Two consequences
+    # for the reverse walk (the reference sidesteps both by renaming in
+    # AppendBackward, /root/reference/paddle/framework/backward.cc:523):
+    #
+    # (a) gradient accounting is per-VERSION: once the writing op's output
+    #     grads are taken, the name reverts to its previous definition, so
+    #     its contribution/finalize state must be cleared (kill_versions);
+    # (b) grad ops execute after ALL forward ops, so any primal value a
+    #     grad op reads must be snapshotted before the overwrite if some
+    #     op at/after the forward op's position re-writes that name
+    #     (last_write + @PRE snapshots below).
+    last_write: Dict[str, int] = {}
+    for pos in range(n_fwd):
+        for names in block.ops[pos].outputs.values():
+            for name in names:
+                last_write[name] = pos
+
+    canonical_first: Dict[str, str] = {}
+
+    def kill_versions(op):
+        for names in op.outputs.values():
+            for name in names:
+                # Keep the latest version's grad for the canonical
+                # ``<var>@GRAD`` alias (step 5): in the reverse walk the
+                # first kill of a name belongs to its last write.
+                g = finalized.get(name)
+                if g is not None and name not in canonical_first:
+                    canonical_first[name] = g
+                contributions.pop(name, None)
+                finalized.pop(name, None)
+
     # 4. Walk forward ops in reverse, emitting grad ops.
     for i in range(n_fwd - 1, -1, -1):
-        if not op_needed[i]:
-            continue
         op = block.ops[i]
+        if not op_needed[i]:
+            kill_versions(op)
+            continue
         opdef = get_op(op.type)
 
         out_slots = sorted(op.outputs)
@@ -250,6 +283,7 @@ def append_backward(
             og_mask[slot] = mask
             if arrs:
                 og_inputs["OG:" + slot] = arrs
+        kill_versions(op)
         if not any_og:
             continue
 
@@ -285,12 +319,40 @@ def append_backward(
                 f"op {op.type!r} uses randomness and has no custom grad_fn"
             )
 
-        grad_inputs = {("I:" + slot): list(names) for slot, names in op.inputs.items()
-                       if names}
+        # (b) above: snapshot primal INPUTS whose name is re-written by
+        # this or any later op (the grad op would otherwise read the
+        # post-overwrite value), and — for custom grads that take O: slots
+        # — primal OUTPUTS overwritten strictly later. Snapshots are
+        # assigns inserted at the op's position (inputs) / right after it
+        # (outputs); XLA elides the copies.
+        in_names = {n for names in op.inputs.values() for n in names}
+        snap = {}
+        for name in sorted(in_names):
+            if last_write.get(name, -1) >= i:
+                sname = program.unique_name(name + "@PRE")
+                block.create_var(name=sname, stop_gradient=True)
+                block.insert_op(i, "assign", inputs={"X": [name]},
+                                outputs={"Out": [sname]})
+                snap[name] = sname
+        osnap = {}
+        if use_custom:
+            out_names = {n for names in op.outputs.values() for n in names}
+            for name in sorted(out_names):
+                if last_write.get(name, -1) > i:
+                    sname = program.unique_name(name + "@POST")
+                    block.create_var(name=sname, stop_gradient=True)
+                    block.insert_op(i + 1 + len(snap), "assign",
+                                    inputs={"X": [name]},
+                                    outputs={"Out": [sname]})
+                    osnap[name] = sname
+
+        grad_inputs = {("I:" + slot): [snap.get(n, n) for n in names]
+                       for slot, names in op.inputs.items() if names}
         if use_custom:
             for slot, names in op.outputs.items():
                 if names:
-                    grad_inputs["O:" + slot] = list(names)
+                    grad_inputs["O:" + slot] = [osnap.get(n, n)
+                                                for n in names]
         grad_inputs.update(og_inputs)
 
         grad_outputs = {}
@@ -322,6 +384,10 @@ def append_backward(
     # XLA, so unused aliases cost nothing.
     for name in list(contributions):
         g = finalize_grad(name)
+        canonical_first.setdefault(name, g)
+    # Multi-version names resolve to the LATEST version's grad (recorded at
+    # its first kill in the reverse walk) — the value the loss consumed.
+    for name, g in canonical_first.items():
         canonical = grad_var_name(name)
         if g is not None and g != canonical and not block.has_var(canonical):
             src = block.var(name) if block.has_var(name) else None

@@ -187,8 +187,12 @@ class Block:
         return op
 
     def prepend_op(self, op_type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        return self.insert_op(0, op_type, inputs, outputs, attrs)
+
+    def insert_op(self, index: int, op_type: str, inputs=None, outputs=None,
+                  attrs=None) -> Operator:
         op = Operator(self, op_type, inputs or {}, outputs or {}, attrs)
-        self.ops.insert(0, op)
+        self.ops.insert(index, op)
         self.program._bump()
         return op
 

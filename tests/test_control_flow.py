@@ -316,7 +316,8 @@ class TestWhileBackward:
     def test_dynamic_depth_model_trains(self):
         rng = np.random.RandomState(0)
         main, startup, loss = self._build(3.0)
-        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+            loss, startup_program=startup)
         scope = pt.Scope()
         exe = pt.Executor(pt.TPUPlace())
         exe.run(startup, scope=scope)
@@ -327,3 +328,79 @@ class TestWhileBackward:
                            fetch_list=[loss], scope=scope)
             losses.append(float(out))
         assert losses[-1] < losses[0]
+
+    def test_grad_of_op_whose_input_is_later_overwritten(self):
+        """A grad op reads its primal inputs at the END of the block; if a
+        later in-place op (here: the while carry write-back) overwrites the
+        name, the value must be snapshotted at the consuming op's position
+        or the vjp evaluates at the wrong point."""
+        rng = np.random.RandomState(3)
+        x_np = rng.rand(3, 4).astype(np.float32)
+
+        def build(w0):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[4])
+                w_attr = pt.ParamAttr(
+                    name="clobber_w",
+                    initializer=pt.initializer.ConstantInitializer(w0))
+                h = layers.fc(x, size=4, param_attr=w_attr, bias_attr=False)
+                # t consumes the PRE-loop h; its grad op must see that value
+                t = layers.tanh(h)
+                i = layers.fill_constant(shape=[], value=0.0,
+                                         dtype="float32")
+                n = layers.fill_constant(shape=[], value=2.0,
+                                         dtype="float32")
+                cond = layers.less_than(i, n)
+                w = layers.While(cond, max_iters=3)
+                with w.block():
+                    layers.assign(layers.scale(layers.sigmoid(h), 0.9),
+                                  output=h)
+                    layers.assign(layers.increment(i, 1.0), output=i)
+                    layers.assign(layers.less_than(i, n), output=cond)
+                # loss mixes the post-loop h and the pre-loop tanh branch
+                loss = layers.mean(layers.elementwise_add(h, t))
+            return main, startup, loss
+
+        def loss_at(w0):
+            main, startup, loss = build(w0)
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            out, = exe.run(main, feed={"x": x_np}, fetch_list=[loss],
+                           scope=scope)
+            return float(out)
+
+        main, startup, loss = build(0.6)
+        pt.append_backward(loss)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        g, = exe.run(main, feed={"x": x_np}, fetch_list=["clobber_w@GRAD"],
+                     scope=scope)
+        eps = 1e-3
+        fd = (loss_at(0.6 + eps) - loss_at(0.6 - eps)) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g).sum(), fd, rtol=5e-3,
+                                   atol=1e-5)
+
+    def test_intermediate_grad_fetchable_by_canonical_name(self):
+        """fetch_list=['<var>@GRAD'] works for intermediates, including
+        multi-version (overwritten) names, which resolve to the latest
+        version's gradient."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            h = layers.fc(x, size=4,
+                          param_attr=pt.ParamAttr(name="cg_w"),
+                          bias_attr=False)
+            loss = layers.mean(h)
+        pt.append_backward(loss)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        x_np = np.ones((2, 4), np.float32)
+        g, = exe.run(main, feed={"x": x_np},
+                     fetch_list=[h.name + "@GRAD"], scope=scope)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.full((2, 4), 1.0 / 8, np.float32),
+                                   rtol=1e-6)
