@@ -275,6 +275,287 @@ class CTCError(Evaluator):
         return float(w) / max(float(n), 1.0)
 
 
+class RankAuc(Evaluator):
+    """Streaming per-query ranking AUC (legacy rankauc evaluator,
+    /root/reference/paddle/gserver/evaluators/Evaluator.cpp:514-592).
+
+    ``score``/``click``/``pv`` are dense padded [b, L] per-query rows with
+    optional ``length`` [b] (the TPU layout for the reference's
+    sequence-start-position segments). eval() returns the mean per-query
+    AUC, exactly the reference's batchAuc / numSamples_.
+    """
+
+    def __init__(self, score, click, pv=None, length=None, **kwargs):
+        super().__init__("rank_auc_eval", **kwargs)
+        self.auc_sum = self._create_state("auc_sum", [], "float32")
+        self.queries = self._create_state("queries", [], "float32")
+        ins = {"Score": [score], "Click": [click]}
+        if pv is not None:
+            ins["Pv"] = [pv]
+        if length is not None:
+            ins["Length"] = [length]
+        outs, _ = self.helper.append_op(
+            "rank_auc", ins, ["AucSum", "QueryCount"], {})
+        self._accumulate(self.auc_sum, outs["AucSum"][0])
+        self._accumulate(self.queries, outs["QueryCount"][0])
+
+    def eval(self, executor, scope=None):
+        s, n = self._fetch_states(scope)
+        return float(s) / max(float(n), 1.0)
+
+
+class Pnpair(Evaluator):
+    """Streaming positive/negative pair counts for ranking
+    (legacy pnpair evaluator, /root/reference/paddle/gserver/evaluators/
+    Evaluator.cpp:873-1000). eval() returns pos/neg ratio; ``counts()``
+    gives (pos, neg, special)."""
+
+    def __init__(self, score, label, weight=None, length=None, **kwargs):
+        super().__init__("pnpair_eval", **kwargs)
+        self.pos = self._create_state("pos", [], "float32")
+        self.neg = self._create_state("neg", [], "float32")
+        self.spe = self._create_state("spe", [], "float32")
+        ins = {"Score": [score], "Label": [label]}
+        if weight is not None:
+            ins["Weight"] = [weight]
+        if length is not None:
+            ins["Length"] = [length]
+        outs, _ = self.helper.append_op(
+            "pnpair_counts", ins, ["Pos", "Neg", "Spe"], {})
+        self._accumulate(self.pos, outs["Pos"][0])
+        self._accumulate(self.neg, outs["Neg"][0])
+        self._accumulate(self.spe, outs["Spe"][0])
+
+    def counts(self, scope=None):
+        p, n, s = self._fetch_states(scope)
+        return float(p), float(n), float(s)
+
+    def eval(self, executor, scope=None):
+        p, n, _ = self._fetch_states(scope)
+        return float(p) / max(float(n), 1e-10)
+
+
+class DetectionMAP(Evaluator):
+    """Streaming detection mean-average-precision (legacy detection_map
+    evaluator, /root/reference/paddle/gserver/evaluators/
+    DetectionMAPEvaluator.cpp).
+
+    Detections and ground truth are dense padded per-image rows (boxes
+    [b, M, 4] xyxy, scores [b, M], int classes [b, M]; gt [b, G, 4]/[b, G])
+    with valid counts ``det_length``/``gt_length``. The in-graph update op
+    greedily matches score-sorted detections to unmatched same-class gt at
+    ``overlap_threshold`` and buckets TP/FP by score into a fixed [C, K]
+    histogram state; eval() recovers the PR curve per class from bin
+    cumsums and integrates AP (``ap_version``: '11point' like the
+    reference's default, or 'integral'), averaging over classes with gt.
+    """
+
+    def __init__(self, det_boxes, det_scores, det_classes, gt_boxes,
+                 gt_classes, num_classes, det_length=None, gt_length=None,
+                 overlap_threshold=0.5, num_buckets=200,
+                 ap_version="11point", **kwargs):
+        super().__init__("detection_map_eval", **kwargs)
+        self.num_classes, self.num_buckets = num_classes, num_buckets
+        self.ap_version = ap_version
+        self.tp = self._create_state("tp", [num_classes, num_buckets],
+                                     "int32")
+        self.fp = self._create_state("fp", [num_classes, num_buckets],
+                                     "int32")
+        self.gt = self._create_state("gt", [num_classes], "int32")
+        ins = {"DetBoxes": [det_boxes], "DetScores": [det_scores],
+               "DetClasses": [det_classes], "GtBoxes": [gt_boxes],
+               "GtClasses": [gt_classes]}
+        if det_length is not None:
+            ins["DetLength"] = [det_length]
+        if gt_length is not None:
+            ins["GtLength"] = [gt_length]
+        outs, _ = self.helper.append_op(
+            "detection_map_counts", ins, ["TP", "FP", "GtCount"],
+            {"num_classes": num_classes, "num_buckets": num_buckets,
+             "overlap_threshold": overlap_threshold})
+        self._accumulate(self.tp, outs["TP"][0])
+        self._accumulate(self.fp, outs["FP"][0])
+        self._accumulate(self.gt, outs["GtCount"][0])
+
+    def eval(self, executor, scope=None):
+        tp, fp, gt = self._fetch_states(scope)
+        tp = tp.astype(np.float64)[:, ::-1]  # high-score bins first
+        fp = fp.astype(np.float64)[:, ::-1]
+        ctp, cfp = np.cumsum(tp, axis=1), np.cumsum(fp, axis=1)
+        gt = gt.astype(np.float64)
+        aps = []
+        for c in range(self.num_classes):
+            if gt[c] <= 0:
+                continue
+            recall = ctp[c] / gt[c]
+            precision = ctp[c] / np.maximum(ctp[c] + cfp[c], 1e-10)
+            if self.ap_version == "11point":
+                ap = np.mean([precision[recall >= t].max()
+                              if (recall >= t).any() else 0.0
+                              for t in np.linspace(0, 1, 11)])
+            else:  # integral over recall increments
+                d_recall = np.diff(np.concatenate([[0.0], recall]))
+                ap = float((precision * d_recall).sum())
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+
+class Sum(Evaluator):
+    """Streaming sum of a variable (legacy sum / ColumnSumEvaluator,
+    /root/reference/paddle/gserver/evaluators/Evaluator.cpp:1007-1011).
+    ``column`` selects one column (-1 = last, 'last-column-sum');
+    None sums everything. eval() returns (sum, mean-per-instance)."""
+
+    def __init__(self, input, column=None, **kwargs):
+        super().__init__("sum_eval", **kwargs)
+        self.total = self._create_state("total", [], "float32")
+        self.insts = self._create_state("insts", [], "float32")
+        x = input
+        if column is not None and len(input.shape) < 2:
+            raise ValueError(
+                f"Sum(column={column}) needs a rank>=2 input, got shape "
+                f"{tuple(input.shape)}")
+        if column is not None:
+            x = self.helper.simple_op(
+                "slice", {"X": [input]},
+                {"axes": [len(input.shape) - 1],
+                 "starts": [column if column >= 0
+                            else input.shape[-1] + column],
+                 "ends": [(column if column >= 0
+                           else input.shape[-1] + column) + 1]})
+        xs = self.helper.simple_op("reduce_sum", {"X": [x]},
+                                   {"keep_dim": False})
+        xs = self.helper.simple_op("cast", {"X": [xs]}, {"dtype": "float32"})
+        n = self.helper.simple_op(
+            "fill_constant_batch_size_like", {"Input": [input]},
+            {"shape": [-1, 1], "dtype": "float32", "value": 1.0})
+        n = self.helper.simple_op("reduce_sum", {"X": [n]},
+                                  {"keep_dim": False})
+        self._accumulate(self.total, xs)
+        self._accumulate(self.insts, n)
+
+    def eval(self, executor, scope=None):
+        t, n = self._fetch_states(scope)
+        return float(t), float(t) / max(float(n), 1.0)
+
+
+# --------------------------------------------------------------------------
+# Printer evaluators (legacy value_printer / gradient_printer /
+# max_id_printer / seq_text_printer / classification_error_printer,
+# /root/reference/paddle/gserver/evaluators/Evaluator.cpp:1033-1357).
+#
+# TPU-native stance: printers are host-side observers. They register the
+# variables to observe; ``fetches()`` exposes them for the caller's
+# fetch_list and ``update(values)`` (called with the fetched arrays each
+# batch) formats them to ``stream`` (stdout by default). Unlike states,
+# printing never syncs the device unless the caller actually fetches.
+# --------------------------------------------------------------------------
+class Printer:
+    """Base printer: observe ``vars``, print each batch on update()."""
+
+    def __init__(self, vars, name="printer", stream=None, formatter=None):
+        import sys
+
+        self.vars = list(vars)
+        self.name = name
+        self.stream = stream or sys.stdout
+        self._formatter = formatter
+
+    def fetches(self):
+        return list(self.vars)
+
+    def _format(self, var, value):
+        v = np.asarray(value)
+        body = np.array2string(v, threshold=64, precision=6)
+        return f"[{self.name}] {var.name} shape={tuple(v.shape)} {body}"
+
+    def update(self, values):
+        for var, value in zip(self.vars, values):
+            fmt = self._formatter or self._format
+            print(fmt(var, value), file=self.stream)
+
+
+class ValuePrinter(Printer):
+    """Print variable values per batch (value_printer)."""
+
+    def __init__(self, *vars, **kw):
+        super().__init__(vars, name=kw.pop("name", "value_printer"), **kw)
+
+
+class GradientPrinter(Printer):
+    """Print parameter gradients per batch (gradient_printer): observes
+    the ``<var>@GRAD`` companions of the given vars (requires
+    append_backward to have run)."""
+
+    def __init__(self, *vars, **kw):
+        from .core.program import grad_var_name
+
+        gvars = []
+        for v in vars:
+            gname = grad_var_name(v.name)
+            if not v.block.has_var(gname):
+                raise ValueError(
+                    f"no gradient variable {gname!r} for {v.name!r}: run "
+                    "append_backward (or Optimizer.minimize) first")
+            gvars.append(v.block.var(gname))
+        super().__init__(gvars, name=kw.pop("name", "gradient_printer"),
+                         **kw)
+
+
+class MaxIdPrinter(Printer):
+    """Print the argmax id per row of a score matrix (max_id_printer)."""
+
+    def __init__(self, input, **kw):
+        super().__init__([input], name=kw.pop("name", "max_id_printer"),
+                         **kw)
+
+    def _format(self, var, value):
+        ids = np.argmax(np.asarray(value), axis=-1).reshape(-1)
+        return f"[{self.name}] {var.name} max_id=" + \
+            np.array2string(ids, threshold=64)
+
+
+class SeqTextPrinter(Printer):
+    """Print int id sequences, optionally mapped through a vocab
+    (seq_text_printer)."""
+
+    def __init__(self, input, id_to_word=None, **kw):
+        super().__init__([input], name=kw.pop("name", "seq_text_printer"),
+                         **kw)
+        self.id_to_word = id_to_word
+
+    def _format(self, var, value):
+        rows = np.asarray(value).astype(np.int64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        rows = rows.reshape(rows.shape[0], -1)
+        lines = []
+        for r in rows:
+            if self.id_to_word:
+                lines.append(" ".join(self.id_to_word.get(int(i), "<unk>")
+                                      for i in r))
+            else:
+                lines.append(" ".join(str(int(i)) for i in r))
+        return f"[{self.name}] {var.name}\n  " + "\n  ".join(lines)
+
+
+class ClassificationErrorPrinter(Printer):
+    """Print per-batch classification error (classification_error_printer):
+    observes (scores, label) and prints the error rate."""
+
+    def __init__(self, input, label, **kw):
+        super().__init__([input, label],
+                         name=kw.pop("name", "classification_error_printer"),
+                         **kw)
+
+    def update(self, values):
+        scores, label = (np.asarray(v) for v in values)
+        pred = (np.argmax(scores, -1) if scores.ndim > 1 and
+                scores.shape[-1] > 1 else (scores.reshape(-1) > 0.5))
+        err = float((pred.reshape(-1) != label.reshape(-1)).mean())
+        print(f"[{self.name}] error={err:.6f}", file=self.stream)
+
+
 class EditDistance(Evaluator):
     """Streaming average edit distance (legacy ctc_error_evaluator;
     fluid edit_distance_op.cc)."""

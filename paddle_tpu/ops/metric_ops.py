@@ -60,6 +60,178 @@ def auc_histogram(attrs, ins):
     return {"Pos": [pos], "Neg": [neg]}
 
 
+def _length_mask(lengths, b, L):
+    if lengths is None:
+        return jnp.ones((b, L), jnp.float32)
+    lengths = lengths.reshape(-1).astype(jnp.int32)
+    return (jnp.arange(L, dtype=jnp.int32)[None, :]
+            < lengths[:, None]).astype(jnp.float32)
+
+
+@register_op("rank_auc", optional_inputs=("Pv", "Length"))
+def rank_auc(attrs, ins):
+    """Per-query click-through AUC (RankAucEvaluator,
+    /root/reference/paddle/gserver/evaluators/Evaluator.cpp:514-592).
+
+    Queries are dense padded rows: Score/Click/Pv are [b, L] with optional
+    Length [b]. Each position i carries click_i positive events and
+    (pv_i - click_i) negative events at score s_i; the reference's
+    sort-and-trapezoid per query is equivalent to the pairwise form
+
+        auc = sum_ij pos_i * neg_j * (1[s_i > s_j] + .5 * 1[s_i == s_j])
+              / (sum pos * sum neg)
+
+    (same-score pairs count half — the trapezoid's tie handling), which
+    vectorizes as one [b, L, L] comparison instead of a host sort. Queries
+    with no positive or no negative events score 0, as in the reference.
+    Outputs AucSum (sum of per-query aucs) and QueryCount for streaming
+    averaging.
+    """
+    score = single(ins, "Score")
+    click = single(ins, "Click")
+    if score.ndim == 3:
+        score = score[..., -1]
+    if score.ndim == 1:
+        score, click = score[None, :], click[None, :]
+    click = click.reshape(score.shape).astype(jnp.float32)
+    pv = maybe(ins, "Pv")
+    pv = (jnp.ones_like(click) if pv is None
+          else pv.reshape(score.shape).astype(jnp.float32))
+    b, L = score.shape
+    m = _length_mask(maybe(ins, "Length"), b, L)
+    pos = click * m
+    neg = (pv - click) * m
+    s = score.astype(jnp.float32)
+    gt = (s[:, :, None] > s[:, None, :]).astype(jnp.float32)
+    eq = (s[:, :, None] == s[:, None, :]).astype(jnp.float32)
+    conc = gt + 0.5 * eq  # [b, L, L]
+    num = jnp.einsum("bi,bij,bj->b", pos, conc, neg)
+    denom = pos.sum(-1) * neg.sum(-1)
+    auc = jnp.where(denom > 0, num / jnp.maximum(denom, 1e-30), 0.0)
+    return out(AucSum=auc.sum(), QueryCount=jnp.asarray(b, jnp.float32))
+
+
+@register_op("pnpair_counts", optional_inputs=("Weight", "Length"))
+def pnpair_counts(attrs, ins):
+    """Positive/negative/special pair counts within each query
+    (PnpairEvaluator, /root/reference/paddle/gserver/evaluators/
+    Evaluator.cpp:873-1000).
+
+    Score/Label/[Weight] are dense padded [b, L] per-query rows (the
+    reference instead buffers the whole pass on host and groups by a
+    query-id column; the padded layout keeps the count update in-graph).
+    For each unordered in-query pair with label_i != label_j:
+    concordant (score and label order agree) -> Pos, discordant -> Neg,
+    score tie -> Spe; pair weight is the mean of the two sample weights.
+    """
+    score = single(ins, "Score")
+    label = single(ins, "Label")
+    if score.ndim == 3:
+        score = score[..., -1]
+    if score.ndim == 1:
+        score, label = score[None, :], label[None, :]
+    label = label.reshape(score.shape).astype(jnp.float32)
+    w = maybe(ins, "Weight")
+    w = (jnp.ones_like(label) if w is None
+         else w.reshape(score.shape).astype(jnp.float32))
+    b, L = score.shape
+    m = _length_mask(maybe(ins, "Length"), b, L)
+    s = score.astype(jnp.float32)
+    pair_m = m[:, :, None] * m[:, None, :]
+    # unordered pairs: strict upper triangle
+    iu = jnp.triu(jnp.ones((L, L), jnp.float32), k=1)[None]
+    valid = pair_m * iu * (label[:, :, None] != label[:, None, :])
+    pw = 0.5 * (w[:, :, None] + w[:, None, :])
+    s_gt = s[:, :, None] > s[:, None, :]
+    s_lt = s[:, :, None] < s[:, None, :]
+    l_gt = label[:, :, None] > label[:, None, :]
+    l_lt = label[:, :, None] < label[:, None, :]
+    conc = (s_gt & l_gt) | (s_lt & l_lt)
+    disc = (s_gt & l_lt) | (s_lt & l_gt)
+    tie = ~(s_gt | s_lt)
+    pos = (valid * pw * conc).sum()
+    negc = (valid * pw * disc).sum()
+    spe = (valid * pw * tie).sum()
+    return out(Pos=pos, Neg=negc, Spe=spe)
+
+
+@register_op("detection_map_counts",
+             optional_inputs=("DetLength", "GtLength"))
+def detection_map_counts(attrs, ins):
+    """Streaming detection-mAP state update (DetectionMAPEvaluator,
+    /root/reference/paddle/gserver/evaluators/DetectionMAPEvaluator.cpp).
+
+    Inputs per image row: DetBoxes [b, M, 4] (x1,y1,x2,y2), DetScores
+    [b, M], DetClasses [b, M] int, GtBoxes [b, G, 4], GtClasses [b, G] int,
+    with valid counts DetLength/GtLength [b]. Greedy high-score-first
+    matching (lax.scan over the M sorted detections, carry = matched-gt
+    mask) marks each detection TP (IoU >= overlap_threshold with an
+    unmatched same-class gt) or FP. Instead of the reference's host-side
+    score-sorted map of per-class TP/FP lists, counts are bucketed by score
+    into num_buckets bins per class — the same histogram-state trick as
+    auc_histogram — so the evaluator state is a fixed [C, K] tensor and the
+    PR curve/AP integral is recovered at eval() from the bin cumsums.
+    Outputs TP [C, K], FP [C, K], GtCount [C].
+    """
+    dbox = single(ins, "DetBoxes").astype(jnp.float32)
+    dscore = single(ins, "DetScores").astype(jnp.float32)
+    dcls = single(ins, "DetClasses").reshape(dscore.shape).astype(jnp.int32)
+    gbox = single(ins, "GtBoxes").astype(jnp.float32)
+    gcls = single(ins, "GtClasses")
+    b, M = dscore.shape
+    G = gbox.shape[1]
+    gcls = gcls.reshape((b, G)).astype(jnp.int32)
+    C = int(attrs["num_classes"])
+    K = int(attrs.get("num_buckets", 200))
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    dmask = _length_mask(maybe(ins, "DetLength"), b, M) > 0
+    gmask = _length_mask(maybe(ins, "GtLength"), b, G) > 0
+
+    def iou(a, bx):  # a [M, 4], bx [G, 4] -> [M, G]
+        lt = jnp.maximum(a[:, None, :2], bx[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], bx[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None]
+        area_b = ((bx[:, 2] - bx[:, 0]) * (bx[:, 3] - bx[:, 1]))[None, :]
+        return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+    def match_one(db, ds, dc, dm, gb, gc, gm):
+        order = jnp.argsort(-jnp.where(dm, ds, -jnp.inf))
+        overlaps = iou(db, gb)  # [M, G]
+        same = (dc[:, None] == gc[None, :]) & gm[None, :]
+        cand = jnp.where(same, overlaps, -1.0)  # [M, G]
+
+        def step(matched, i):
+            ious_i = jnp.where(matched, -1.0, cand[i])
+            j = jnp.argmax(ious_i)
+            hit = (ious_i[j] >= thresh) & dm[i]
+            matched = matched.at[j].set(matched[j] | hit)
+            return matched, hit
+
+        _, tp_sorted = jax.lax.scan(step, jnp.zeros((G,), bool), order)
+        # unsort back to input order
+        tp = jnp.zeros((M,), bool).at[order].set(tp_sorted)
+        return tp
+
+    tp = jax.vmap(match_one)(dbox, dscore, dcls, dmask, gbox, gcls, gmask)
+    fp = dmask & ~tp
+    # bucket (class, score-bin) counts; invalid detections -> segment C*K
+    bins = jnp.clip((dscore * K).astype(jnp.int32), 0, K - 1)
+    seg = jnp.where(dmask, jnp.clip(dcls, 0, C - 1) * K + bins, C * K)
+    tp_hist = jax.ops.segment_sum(
+        tp.reshape(-1).astype(jnp.int32), seg.reshape(-1),
+        num_segments=C * K + 1)[:-1].reshape(C, K)
+    fp_hist = jax.ops.segment_sum(
+        fp.reshape(-1).astype(jnp.int32), seg.reshape(-1),
+        num_segments=C * K + 1)[:-1].reshape(C, K)
+    gseg = jnp.where(gmask, jnp.clip(gcls, 0, C - 1), C)
+    gt_cnt = jax.ops.segment_sum(
+        jnp.ones((b * G,), jnp.int32), gseg.reshape(-1),
+        num_segments=C + 1)[:-1]
+    return out(TP=tp_hist, FP=fp_hist, GtCount=gt_cnt)
+
+
 @register_op("edit_distance", optional_inputs=("HypsLength", "RefsLength"))
 def edit_distance(attrs, ins):
     """Batched Levenshtein distance (edit_distance_op.h) between padded int

@@ -139,3 +139,226 @@ class TestStreamingEvaluators:
                 fetch_list=[], scope=scope)
         p, r, f1 = ch.eval(exe, scope)
         assert (p, r, f1) == (1.0, 1.0, 1.0)
+
+
+def np_rank_auc(score, click, pv):
+    """Brute-force pairwise rank AUC for one query (Evaluator.cpp:554-590)."""
+    pos = click
+    neg = pv - click
+    num = 0.0
+    for i in range(len(score)):
+        for j in range(len(score)):
+            if score[i] > score[j]:
+                num += pos[i] * neg[j]
+            elif score[i] == score[j]:
+                num += 0.5 * pos[i] * neg[j]
+    denom = pos.sum() * neg.sum()
+    return num / denom if denom > 0 else 0.0
+
+
+class TestRankAucOp:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(1)
+        b, L = 4, 6
+        score = rng.rand(b, L).astype(np.float32)
+        click = rng.randint(0, 3, size=(b, L)).astype(np.float32)
+        pv = click + rng.randint(1, 4, size=(b, L)).astype(np.float32)
+        length = np.array([6, 4, 5, 2], np.int32)
+        outs = run_op("rank_auc", {"Score": [score], "Click": [click],
+                                   "Pv": [pv], "Length": [length]})
+        want = sum(np_rank_auc(score[q, :length[q]], click[q, :length[q]],
+                               pv[q, :length[q]]) for q in range(b))
+        np.testing.assert_allclose(float(np.asarray(outs["AucSum"][0])),
+                                   want, rtol=1e-5)
+        assert float(np.asarray(outs["QueryCount"][0])) == b
+
+    def test_perfect_ranking_is_one(self):
+        # clicks concentrated at the highest scores -> AUC 1
+        score = np.array([[0.9, 0.7, 0.5, 0.3]], np.float32)
+        click = np.array([[3, 2, 0, 0]], np.float32)
+        pv = np.array([[3, 2, 4, 5]], np.float32)
+        outs = run_op("rank_auc", {"Score": [score], "Click": [click],
+                                   "Pv": [pv]})
+        np.testing.assert_allclose(float(np.asarray(outs["AucSum"][0])), 1.0,
+                                   rtol=1e-6)
+
+
+class TestPnpairOp:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(2)
+        b, L = 3, 5
+        score = rng.rand(b, L).astype(np.float32)
+        score[0, 1] = score[0, 2]  # force a special (tied-score) pair
+        label = rng.randint(0, 3, size=(b, L)).astype(np.int64)
+        w = rng.rand(b, L).astype(np.float32)
+        length = np.array([5, 3, 4], np.int32)
+        pos = neg = spe = 0.0
+        for q in range(b):
+            for i in range(length[q]):
+                for j in range(i + 1, length[q]):
+                    if label[q, i] == label[q, j]:
+                        continue
+                    pw = (w[q, i] + w[q, j]) / 2
+                    ds = score[q, i] - score[q, j]
+                    dl = label[q, i] - label[q, j]
+                    if ds == 0:
+                        spe += pw
+                    elif (ds > 0) == (dl > 0):
+                        pos += pw
+                    else:
+                        neg += pw
+        outs = run_op("pnpair_counts",
+                      {"Score": [score], "Label": [label], "Weight": [w],
+                       "Length": [length]})
+        np.testing.assert_allclose(float(np.asarray(outs["Pos"][0])), pos,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(outs["Neg"][0])), neg,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(outs["Spe"][0])), spe,
+                                   rtol=1e-5)
+
+
+class TestDetectionMAP:
+    def _boxes(self):
+        # image 0: 2 gt of class 0; det: one good match (high score), one
+        # duplicate (lower score -> FP), one off-position FP class 1 (no gt)
+        det_boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                               [40, 40, 50, 50]]], np.float32)
+        det_scores = np.array([[0.9, 0.6, 0.8]], np.float32)
+        det_classes = np.array([[0, 0, 1]], np.int64)
+        gt_boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+        gt_classes = np.array([[0, 0]], np.int64)
+        return det_boxes, det_scores, det_classes, gt_boxes, gt_classes
+
+    def test_counts(self):
+        db, ds, dc, gb, gc = self._boxes()
+        outs = run_op("detection_map_counts",
+                      {"DetBoxes": [db], "DetScores": [ds],
+                       "DetClasses": [dc], "GtBoxes": [gb],
+                       "GtClasses": [gc]},
+                      {"num_classes": 2, "num_buckets": 10,
+                       "overlap_threshold": 0.5})
+        tp = np.asarray(outs["TP"][0])
+        fp = np.asarray(outs["FP"][0])
+        gt = np.asarray(outs["GtCount"][0])
+        assert tp.sum() == 1 and tp[0, 9] == 1  # 0.9 -> top bucket, class 0
+        assert fp.sum() == 2  # duplicate match + class-1 box
+        assert fp[0, 6] == 1 and fp[1, 8] == 1
+        np.testing.assert_array_equal(gt, [2, 0])
+
+    def test_streaming_map(self):
+        db, ds, dc, gb, gc = self._boxes()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            v_db = layers.data("db", shape=[3, 4])
+            v_ds = layers.data("ds", shape=[3])
+            v_dc = layers.data("dc", shape=[3], dtype="int64")
+            v_gb = layers.data("gb", shape=[2, 4])
+            v_gc = layers.data("gc", shape=[2], dtype="int64")
+            m_eval = pt.evaluator.DetectionMAP(
+                v_db, v_ds, v_dc, v_gb, v_gc, num_classes=2,
+                ap_version="11point")
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        m_eval.reset(exe, scope)
+        for _ in range(2):
+            exe.run(main, feed={"db": db, "ds": ds, "dc": dc,
+                                "gb": gb, "gc": gc},
+                    fetch_list=[], scope=scope)
+        # class 0: det0 TP@0.9, det1 FP@0.6 -> precision 1.0 up to
+        # recall 0.5, then never improves; 11-point AP = 6/11. class 1 has
+        # no gt -> excluded. mAP = 6/11.
+        np.testing.assert_allclose(m_eval.eval(exe, scope), 6 / 11.0,
+                                   rtol=1e-6)
+
+
+class TestRankingEvaluators:
+    def test_rank_auc_streams(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            score = layers.data("score", shape=[4])
+            click = layers.data("click", shape=[4])
+            ra = pt.evaluator.RankAuc(score, click)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        ra.reset(exe, scope)
+        s = np.array([[0.9, 0.7, 0.5, 0.3]], np.float32)
+        c = np.array([[1, 1, 0, 0]], np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"score": s, "click": c}, fetch_list=[],
+                    scope=scope)
+        np.testing.assert_allclose(ra.eval(exe, scope), 1.0, rtol=1e-6)
+
+    def test_pnpair_streams(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            score = layers.data("score", shape=[4])
+            label = layers.data("label", shape=[4], dtype="int64")
+            pn = pt.evaluator.Pnpair(score, label)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        pn.reset(exe, scope)
+        s = np.array([[0.9, 0.7, 0.5, 0.3]], np.float32)
+        y = np.array([[1, 0, 1, 0]], np.int64)
+        exe.run(main, feed={"score": s, "label": y}, fetch_list=[],
+                scope=scope)
+        p, n, spe = pn.counts(scope)
+        assert (p, n, spe) == (3.0, 1.0, 0.0)
+        assert pn.eval(exe, scope) == 3.0
+
+    def test_sum_evaluator(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[3])
+            se = pt.evaluator.Sum(x, column=-1)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        se.reset(exe, scope)
+        data = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+        for _ in range(2):
+            exe.run(main, feed={"x": data}, fetch_list=[], scope=scope)
+        total, per_inst = se.eval(exe, scope)
+        assert total == 18.0 and per_inst == 4.5
+
+
+class TestPrinters:
+    def test_printers_format(self):
+        import io
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[3])
+            scores = layers.softmax(x)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        buf = io.StringIO()
+        vp = pt.evaluator.ValuePrinter(scores, stream=buf)
+        mp = pt.evaluator.MaxIdPrinter(scores, stream=buf)
+        sp = pt.evaluator.SeqTextPrinter(scores, id_to_word={0: "a"},
+                                         stream=buf)
+        data = np.array([[0.1, 3.0, 0.2]], np.float32)
+        vals = exe.run(main, feed={"x": data},
+                       fetch_list=vp.fetches() + mp.fetches(), scope=scope)
+        vp.update(vals[:1])
+        mp.update(vals[1:])
+        text = buf.getvalue()
+        assert "value_printer" in text and "max_id=" in text
+        assert "[1]" in text or "1" in text
+
+    def test_classification_error_printer(self):
+        import io
+        buf = io.StringIO()
+
+        class FakeVar:
+            name = "v"
+
+        p = pt.evaluator.ClassificationErrorPrinter(FakeVar(), FakeVar(),
+                                                    stream=buf)
+        scores = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        label = np.array([[1], [1]], np.int64)
+        p.update([scores, label])
+        assert "error=0.5" in buf.getvalue()
