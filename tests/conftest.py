@@ -37,3 +37,9 @@ def fresh_programs():
     scope_mod._global_scope = scope_mod.Scope()
     np.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: real-chip tier (runs in a child process owning "
+        "the TPU; skips when no chip is reachable)")
