@@ -12,7 +12,12 @@ mapping onto the reference.
 from . import (checkpoint, clip, evaluator, event, initializer, layers,
                learning_rate_decay, master, models, nets, optimizer, parallel,
                profiler, regularizer, trainer)
+from . import flags
 from .checkgrad import check_gradients
+from .core.enforce import (EnforceError, enforce, enforce_eq, enforce_ge,
+                           enforce_gt, enforce_le, enforce_lt, enforce_ne,
+                           enforce_not_none)
+from .flags import FLAGS, parse_flags, set_flags
 from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
                    default_main_program, default_startup_program, global_scope,

@@ -1,0 +1,101 @@
+"""Structured error plane: PADDLE_ENFORCE + the op-level crash stack.
+
+The reference throws EnforceNotMet with a captured C++ stack on any
+violated precondition (/root/reference/paddle/platform/enforce.h:195-228)
+and prints the layer/op call path on a crash via CustomStackTrace
+(/root/reference/paddle/utils/CustomStackTrace.h). The TPU-native
+equivalents:
+
+- ``enforce*`` helpers raise ``EnforceError`` with a formatted message —
+  used by kernels and framework code for argument/shape checks;
+- every Operator records the USER call site that appended it (the graph is
+  built in Python, so the interesting stack is the model-definition line,
+  not the C++ frames); the executor wraps per-op lowering so a kernel
+  failure reports the op, its input shapes, and where the user created it.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class EnforceError(RuntimeError):
+    """EnforceNotMet analogue."""
+
+
+def enforce(cond: Any, msg: str = "enforce failed", *args: Any) -> None:
+    if not cond:
+        raise EnforceError(msg % args if args else msg)
+
+
+def _cmp(name, op, a, b, msg):
+    if not op(a, b):
+        detail = f"enforce_{name} failed: {a!r} {name} {b!r}"
+        raise EnforceError(f"{detail}: {msg}" if msg else detail)
+
+
+def enforce_eq(a, b, msg=""):
+    _cmp("eq", lambda x, y: x == y, a, b, msg)
+
+
+def enforce_ne(a, b, msg=""):
+    _cmp("ne", lambda x, y: x != y, a, b, msg)
+
+
+def enforce_lt(a, b, msg=""):
+    _cmp("lt", lambda x, y: x < y, a, b, msg)
+
+
+def enforce_le(a, b, msg=""):
+    _cmp("le", lambda x, y: x <= y, a, b, msg)
+
+
+def enforce_gt(a, b, msg=""):
+    _cmp("gt", lambda x, y: x > y, a, b, msg)
+
+
+def enforce_ge(a, b, msg=""):
+    _cmp("ge", lambda x, y: x >= y, a, b, msg)
+
+
+def enforce_not_none(v, msg=""):
+    if v is None:
+        raise EnforceError(f"enforce_not_none failed: {msg}" if msg
+                           else "enforce_not_none failed")
+
+
+def user_callsite() -> Optional[str]:
+    """file:line of the innermost frame NOT inside paddle_tpu — the model
+    definition line that appended the current op. Walks raw frames (no
+    FrameSummary/linecache work: this runs for every op appended)."""
+    import sys
+
+    frame = sys._getframe(1)
+    while frame is not None:
+        fn = frame.f_code.co_filename.replace("\\", "/")
+        if "/paddle_tpu/" not in fn:
+            return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return None
+
+
+def format_input_sigs(ins) -> dict:
+    """{slot: ['dtype[shape]', ...]} for arrays or ShapeDtypeStructs."""
+    return {
+        slot: [f"{getattr(a, 'dtype', type(a).__name__)}"
+               f"{list(getattr(a, 'shape', ()))}" for a in arrs]
+        for slot, arrs in ins.items()
+    }
+
+
+def op_error(op, index: int, ins, exc: BaseException) -> EnforceError:
+    """Wrap a kernel failure with the op-level context CustomStackTrace
+    would have printed: op type/position, input shapes+dtypes, and the
+    user's model-definition call site."""
+    shapes = format_input_sigs(ins)
+    where = op.attrs.get("_callsite") or "<unknown call site>"
+    msg = (f"op {op.type!r} (#{index} of the block) failed during "
+           f"lowering\n  inputs: {shapes}\n  defined at: {where}\n"
+           f"  cause: {type(exc).__name__}: {exc}")
+    err = EnforceError(msg)
+    err.__cause__ = exc
+    return err

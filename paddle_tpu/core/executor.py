@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import program as prog_mod
+from .enforce import EnforceError, op_error
 from .program import Program, RNG_VAR
 from .registry import get_op
 from .selected_rows import SelectedRows, densify
@@ -97,15 +98,18 @@ class Executor:
     """
 
     def __init__(self, place: Optional[TPUPlace] = None,
-                 check_nan_inf: bool = False, mesh=None, plan=None):
+                 check_nan_inf: Optional[bool] = None, mesh=None, plan=None):
         """``mesh``/``plan`` enable SPMD execution: the whole block is jitted
         with jax.sharding annotations from the parallel.ShardingPlan and XLA
         GSPMD inserts the collectives — the in-graph replacement for the
         reference's pserver / NCCL / MultiGradientMachine paths (SURVEY.md
         §5.8). With a mesh and no plan, a pure data-parallel plan is used.
         """
+        from ..flags import FLAGS
+
         self.place = place or TPUPlace(0)
-        self.check_nan_inf = check_nan_inf
+        self.check_nan_inf = (FLAGS.check_nan_inf if check_nan_inf is None
+                              else check_nan_inf)
         self.mesh = mesh
         if mesh is not None and plan is None:
             from ..parallel import data_parallel_plan
@@ -238,7 +242,10 @@ class Executor:
 
     def _rng_state(self, program: Program, scope: Scope):
         if not scope.has(RNG_VAR):
-            seed = program.random_seed if program.random_seed is not None else 0
+            from ..flags import FLAGS
+
+            seed = (program.random_seed if program.random_seed is not None
+                    else FLAGS.seed)
             scope.set(RNG_VAR, jax.random.PRNGKey(seed))
         return scope.get(RNG_VAR)
 
@@ -305,21 +312,28 @@ class Executor:
             env.update(zip(feed_names, feed_args))
             env.update(zip(ro_state, ro_args))
             env.update(zip(rw_state, rw_args))
-            for op in ops:
+            for op_index, op in enumerate(ops):
                 opdef = get_op(op.type)
                 ins = {
                     slot: [env[n] for n in names]
                     for slot, names in op.inputs.items()
                     if names
                 }
-                if opdef.special:
-                    outs = opdef.fn(op.attrs, ins, executor=self, env=env, op=op,
-                                    program=program, scope=scope)
-                elif opdef.needs_rng:
-                    rng, sub = jax.random.split(rng)
-                    outs = opdef.fn(op.attrs, ins, rng=sub)
-                else:
-                    outs = opdef.fn(op.attrs, ins)
+                try:
+                    if opdef.special:
+                        outs = opdef.fn(op.attrs, ins, executor=self, env=env,
+                                        op=op, program=program, scope=scope)
+                    elif opdef.needs_rng:
+                        rng, sub = jax.random.split(rng)
+                        outs = opdef.fn(op.attrs, ins, rng=sub)
+                    else:
+                        outs = opdef.fn(op.attrs, ins)
+                except EnforceError:
+                    raise  # already carries op context (nested blocks)
+                except Exception as exc:
+                    # CustomStackTrace analogue: report the failing op, its
+                    # input signature, and the user line that created it.
+                    raise op_error(op, op_index, ins, exc) from exc
                 if outs:
                     for slot, names in op.outputs.items():
                         if slot not in outs:

@@ -111,6 +111,15 @@ class Operator:
         self.inputs = {k: list(v) for k, v in inputs.items()}
         self.outputs = {k: list(v) for k, v in outputs.items()}
         self.attrs = dict(attrs or {})
+        if "_callsite" not in self.attrs:
+            from ..flags import FLAGS
+
+            if FLAGS.op_callsite:
+                from .enforce import user_callsite
+
+                site = user_callsite()
+                if site:
+                    self.attrs["_callsite"] = site
 
     def input_names(self) -> List[str]:
         return [n for vs in self.inputs.values() for n in vs]

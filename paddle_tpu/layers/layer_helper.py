@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from ..core.enforce import EnforceError, format_input_sigs
 from ..core.program import (BATCH_DIM_SENTINEL, Program, default_main_program,
                             default_startup_program)
 from ..core.registry import get_op, infer_outputs
@@ -110,7 +111,23 @@ class LayerHelper:
                 slot: [_abstract(self.block.var(n)) for n in names]
                 for slot, names in in_names.items()
             }
-            inferred = infer_outputs(op_type, attrs, abstract_ins)
+            try:
+                inferred = infer_outputs(op_type, attrs, abstract_ins)
+            except EnforceError:
+                raise
+            except Exception as exc:
+                # Build-time InferShape failure: report like the
+                # reference's PADDLE_ENFORCE in an op's InferShape, with
+                # the declared (-1 = batch) input shapes.
+                shapes = format_input_sigs({
+                    slot: [jax.ShapeDtypeStruct(
+                        _concrete_to_build_shape(a.shape), a.dtype)
+                        for a in arrs]
+                    for slot, arrs in abstract_ins.items()})
+                raise EnforceError(
+                    f"op {op_type!r} shape inference failed\n"
+                    f"  inputs: {shapes}\n"
+                    f"  cause: {type(exc).__name__}: {exc}") from exc
             outputs = {}
             for slot in out_slots:
                 vars_for_slot = []

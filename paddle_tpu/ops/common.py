@@ -13,23 +13,34 @@ import numpy as np
 # tight-tolerance numeric tests switch to it, mirroring the reference's
 # --job=checkgrad mode (/root/reference/paddle/trainer/TrainerMain.cpp:54).
 # ---------------------------------------------------------------------------
-_MXU_PRECISION = None
+# Tri-state: _UNSET defers to --mxu_precision / --use_amp (flags.py) so a
+# flag flip (env var, parse_flags, set_flags) takes effect immediately;
+# an explicit set_mxu_precision()/set_amp() call wins over the flag.
+_UNSET = object()
+_MXU_PRECISION = _UNSET
+
+
+def _precision_table():
+    import jax
+
+    return {
+        None: None, "default": None,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST,
+    }
 
 
 def set_mxu_precision(p):
     """Set contraction precision globally: None/'default' | 'high' | 'highest'."""
     global _MXU_PRECISION
-    import jax
-
-    table = {
-        None: None, "default": None,
-        "high": jax.lax.Precision.HIGH,
-        "highest": jax.lax.Precision.HIGHEST,
-    }
-    _MXU_PRECISION = table[p]
+    _MXU_PRECISION = _precision_table()[p]
 
 
 def mxu_precision(*_arrays):
+    if _MXU_PRECISION is _UNSET:
+        from ..flags import FLAGS
+
+        return _precision_table()[FLAGS.mxu_precision]
     return _MXU_PRECISION
 
 
@@ -45,7 +56,7 @@ def mxu_precision(*_arrays):
 # (/root/reference/paddle/math/float16.h) never reached its training path;
 # on TPU bf16 is the idiomatic default for the hot ops.
 # ---------------------------------------------------------------------------
-_AMP = False
+_AMP = _UNSET
 
 
 def set_amp(enabled: bool):
@@ -54,12 +65,16 @@ def set_amp(enabled: bool):
 
 
 def amp_enabled() -> bool:
+    if _AMP is _UNSET:
+        from ..flags import FLAGS
+
+        return FLAGS.use_amp
     return _AMP
 
 
 def amp_cast(*arrays):
     """Under AMP, cast f32 arrays to bf16 (others pass through)."""
-    if not _AMP:
+    if not amp_enabled():
         return arrays if len(arrays) > 1 else arrays[0]
     cast = tuple(a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
                  for a in arrays)

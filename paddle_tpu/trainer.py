@@ -35,7 +35,8 @@ class SGD:
     def __init__(self, cost: Variable, optimizer, feed_list: Sequence[Variable],
                  place: Optional[TPUPlace] = None, mesh=None, plan=None,
                  metrics: Optional[Dict[str, Variable]] = None,
-                 scope: Optional[Scope] = None, check_nan_inf: bool = False):
+                 scope: Optional[Scope] = None,
+                 check_nan_inf: Optional[bool] = None):
         self.cost = cost
         self.metrics = dict(metrics or {})
         self.main_program: Program = cost.block.program
@@ -71,8 +72,12 @@ class SGD:
               event_handler: Optional[Callable] = None,
               test_reader: Optional[Callable] = None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
-        minibatches of rows ordered like ``feed_list``)."""
-        event_handler = event_handler or (lambda e: None)
+        minibatches of rows ordered like ``feed_list``).
+
+        Without an ``event_handler``, batch cost is logged every
+        ``--log_period`` batches (flags.py), the reference trainer's
+        default output (TrainerInternal.cpp log_period path)."""
+        event_handler = event_handler or _default_log_handler()
         self._init_params()
         for pass_id in range(num_passes):
             event_handler(evt.BeginPass(pass_id))
@@ -119,6 +124,25 @@ class SGD:
         self._init_params()
         io_mod.load_params(self.exe, dirname, self.main_program,
                            scope=self.scope)
+
+
+def _default_log_handler():
+    from .flags import FLAGS
+
+    period = max(int(FLAGS.log_period), 1)
+
+    def handler(e):
+        if isinstance(e, evt.EndIteration) and e.batch_id % period == 0:
+            extra = "".join(f" {k}={v:.4f}" for k, v in
+                            (e.metrics or {}).items())
+            print(f"pass {e.pass_id} batch {e.batch_id} "
+                  f"cost={e.cost:.6f}{extra}", flush=True)
+        elif isinstance(e, evt.EndPass):
+            print(f"pass {e.pass_id} done: "
+                  + " ".join(f"{k}={v:.6f}" for k, v in
+                             (e.metrics or {}).items()), flush=True)
+
+    return handler
 
 
 def _mean_metrics(per_batch):

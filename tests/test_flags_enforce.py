@@ -1,0 +1,146 @@
+"""Flag registry + enforce/error-context tests (reference
+/root/reference/paddle/utils/Flags.h, platform/enforce.h:195-228,
+utils/CustomStackTrace.h)."""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags, layers
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    flags.reset_flags()
+
+
+class TestFlags:
+    def test_defaults_and_set(self):
+        assert pt.FLAGS.check_nan_inf is False
+        pt.FLAGS.check_nan_inf = True
+        assert pt.FLAGS.check_nan_inf is True
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(AttributeError):
+            pt.FLAGS.no_such_flag
+        with pytest.raises(flags.FlagError):
+            pt.FLAGS.another_missing = 1
+
+    def test_type_parsing(self):
+        pt.set_flags({"log_period": "25", "check_nan_inf": "true",
+                      "mxu_precision": "highest"})
+        assert pt.FLAGS.log_period == 25
+        assert pt.FLAGS.check_nan_inf is True
+        with pytest.raises(flags.FlagError):
+            pt.set_flags({"check_nan_inf": "maybe"})
+
+    def test_parse_argv(self):
+        rest = pt.parse_flags(
+            ["prog.py", "--check_nan_inf", "--log_period=7", "--seed", "3",
+             "--unrelated=x", "pos"])
+        assert pt.FLAGS.check_nan_inf is True
+        assert pt.FLAGS.log_period == 7
+        assert pt.FLAGS.seed == 3
+        assert rest == ["prog.py", "--unrelated=x", "pos"]
+        pt.parse_flags(["--nocheck_nan_inf"])
+        assert pt.FLAGS.check_nan_inf is False
+
+    def test_env_override(self):
+        """PADDLE_TPU_<NAME> env vars set flag values at import."""
+        code = ("import paddle_tpu as pt; "
+                "assert pt.FLAGS.log_period == 42, pt.FLAGS.log_period; "
+                "assert pt.FLAGS.check_nan_inf is True; "
+                "from paddle_tpu.ops import common; "
+                "assert common.amp_enabled(); print('ok')")
+        import os
+        env = dict(os.environ, PADDLE_TPU_LOG_PERIOD="42",
+                   PADDLE_TPU_CHECK_NAN_INF="1", PADDLE_TPU_USE_AMP="true",
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, cwd="/root/repo")
+        assert out.returncode == 0 and "ok" in out.stdout, out.stderr[-500:]
+
+    def test_executor_reads_check_nan_inf_flag(self):
+        pt.FLAGS.check_nan_inf = True
+        exe = pt.Executor(pt.TPUPlace())
+        assert exe.check_nan_inf is True
+        assert pt.Executor(pt.TPUPlace(),
+                           check_nan_inf=False).check_nan_inf is False
+
+    def test_print_flags_lists_everything(self):
+        text = flags.print_flags()
+        for name in flags.flags_registered():
+            assert f"--{name}=" in text
+
+
+class TestEnforce:
+    def test_enforce_helpers(self):
+        pt.enforce(True)
+        with pytest.raises(pt.EnforceError, match="batch must be 4"):
+            pt.enforce(False, "batch must be %d", 4)
+        pt.enforce_eq(2, 2)
+        with pytest.raises(pt.EnforceError, match="enforce_lt"):
+            pt.enforce_lt(3, 3)
+        with pytest.raises(pt.EnforceError, match="shape rank"):
+            pt.enforce_ge(1, 2, "shape rank")
+        with pytest.raises(pt.EnforceError):
+            pt.enforce_not_none(None, "weights")
+
+    def test_build_time_infershape_error_has_context(self):
+        """An InferShape failure at graph build reports the op type and
+        the declared input shapes (PADDLE_ENFORCE-in-InferShape style)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            a = layers.data("a", shape=[4])
+            b = layers.data("b", shape=[5])
+            with pytest.raises(pt.EnforceError) as ei:
+                layers.elementwise_add(a, b)  # incompatible [4] vs [5]
+        msg = str(ei.value)
+        assert "elementwise_add" in msg
+        assert "float32[-1, 4]" in msg and "float32[-1, 5]" in msg
+
+    def test_run_time_kernel_failure_reports_op_context(self):
+        """A lowering failure surfaces the op, its concrete input shapes,
+        and the USER line that built the op (CustomStackTrace analogue).
+        Mismatched feed batches pass build-time inference (both are the
+        dynamic batch dim) and only fail when the block is traced."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            a = layers.data("a", shape=[4])
+            b = layers.data("b", shape=[4])
+            bad = layers.elementwise_add(a, b)
+        exe = pt.Executor(pt.TPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        with pytest.raises(pt.EnforceError) as ei:
+            exe.run(main, feed={"a": np.ones((2, 4), np.float32),
+                                "b": np.ones((3, 4), np.float32)},
+                    fetch_list=[bad], scope=scope)
+        msg = str(ei.value)
+        assert "elementwise_add" in msg
+        assert "float32[2, 4]" in msg and "float32[3, 4]" in msg
+        assert "test_flags_enforce.py" in msg  # the user call site
+
+
+class TestFlagWiring:
+    def test_parse_flags_controls_amp_and_precision(self):
+        """--use_amp / --mxu_precision set AFTER import still take effect
+        (lazy flag read), unless set_amp/set_mxu_precision pinned them."""
+        import jax
+        from paddle_tpu.ops import common
+        assert common.amp_enabled() is False
+        pt.parse_flags(["--use_amp", "--mxu_precision=highest"])
+        assert common.amp_enabled() is True
+        assert common.mxu_precision() == jax.lax.Precision.HIGHEST
+        flags.reset_flags()
+        assert common.amp_enabled() is False
+        # explicit call wins over the flag
+        pt.set_amp(True)
+        try:
+            pt.FLAGS.use_amp = False
+            assert common.amp_enabled() is True
+        finally:
+            common._AMP = common._UNSET  # restore tri-state for other tests
