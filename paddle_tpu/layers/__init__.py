@@ -15,6 +15,11 @@ from .sequence import (ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
                        sequence_conv, sequence_expand, sequence_first_step,
                        sequence_last_step, sequence_pool, sequence_reverse,
                        sequence_softmax, warpctc)
+from .legacy import (addto, dot_prod, factorization_machine, gated_unit,
+                     interpolation, kmax_seq_score, l2_distance, linear_comb,
+                     multiplex, out_prod, power, repeat, resize, rotate,
+                     row_l2_norm, sampling_id, scale_shift, scaling,
+                     sequence_reshape, slope_intercept, sum_to_one_norm)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
                      fill_constant, fill_constant_batch_size_like, matmul,
                      mean, one_hot, reshape, scale, split, sums, transpose)
@@ -33,6 +38,11 @@ __all__ = (
      "warpctc", "ctc_greedy_decoder",
      "StaticRNN", "While", "create_array", "array_write", "array_read",
      "increment", "beam_search_decoder",
-     "multi_head_attention", "transformer_encoder_layer", "switch_moe"]
+     "multi_head_attention", "transformer_encoder_layer", "switch_moe",
+     "interpolation", "scaling", "power", "slope_intercept", "addto",
+     "sum_to_one_norm", "row_l2_norm", "scale_shift", "linear_comb",
+     "dot_prod", "out_prod", "l2_distance", "repeat", "resize", "rotate",
+     "multiplex", "kmax_seq_score", "sequence_reshape", "sampling_id",
+     "factorization_machine", "gated_unit"]
     + list(_ops_all)
 )

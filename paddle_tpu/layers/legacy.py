@@ -1,0 +1,166 @@
+"""Layer builders for the legacy gserver layer-type tail (ops/legacy_ops.py).
+
+Completes the v1 trainer_config_helpers DSL surface
+(/root/reference/python/paddle/trainer_config_helpers/layers.py) in fluid
+style: combinator layers take Variables, parameterized ones (scale_shift,
+factorization_machine, gated_unit) create their weights via LayerHelper.
+"""
+from __future__ import annotations
+
+from ..param_attr import ParamAttr
+from .layer_helper import LayerHelper
+
+
+def _h(name, kw):
+    return LayerHelper(name, main_program=kw.get("main_program"),
+                       startup_program=kw.get("startup_program"))
+
+
+def interpolation(x, y, weight, **kw):
+    """w*x + (1-w)*y, per-row scalar weight (interpolation_layer)."""
+    h = _h("interpolation", kw)
+    return h.simple_op("interpolation",
+                       {"X": [x], "Y": [y], "W": [weight]}, {})
+
+
+def scaling(x, weight, **kw):
+    """Per-row scalar times row (scaling_layer)."""
+    h = _h("scaling", kw)
+    return h.simple_op("scaling", {"X": [x], "W": [weight]}, {})
+
+
+def power(x, weight, **kw):
+    """x ** w with per-row scalar exponent (power_layer)."""
+    h = _h("power", kw)
+    return h.simple_op("power", {"X": [x], "W": [weight]}, {})
+
+
+def slope_intercept(x, slope=1.0, intercept=0.0, **kw):
+    h = _h("slope_intercept", kw)
+    return h.simple_op("slope_intercept", {"X": [x]},
+                       {"slope": slope, "intercept": intercept})
+
+
+def addto(inputs, bias=None, act=None, **kw):
+    """Elementwise sum of same-shaped layers (addto_layer)."""
+    h = _h("addto", kw)
+    ins = {"X": list(inputs)}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    y = h.simple_op("addto", ins, {})
+    return h.append_activation(y, act) if act else y
+
+
+def sum_to_one_norm(x, **kw):
+    h = _h("sum_to_one_norm", kw)
+    return h.simple_op("sum_to_one_norm", {"X": [x]}, {})
+
+
+def row_l2_norm(x, **kw):
+    h = _h("row_l2_norm", kw)
+    return h.simple_op("row_l2_norm", {"X": [x]}, {})
+
+
+def scale_shift(x, param_attr=None, bias_attr=None, **kw):
+    """y = w*x + b with learned SCALAR w, b (scale_shift_layer)."""
+    h = _h("scale_shift", kw)
+    w = h.create_parameter(param_attr or ParamAttr(), [1], x.dtype)
+    ins = {"X": [x], "Scale": [w]}
+    if bias_attr is not False:
+        b = h.create_parameter(bias_attr or ParamAttr(), [1], x.dtype,
+                               is_bias=True)
+        ins["Bias"] = [b]
+    return h.simple_op("scale_shift", ins, {})
+
+
+def linear_comb(weights, vectors, **kw):
+    """Weighted sum of m d-dim sub-vectors (linear_comb_layer)."""
+    h = _h("linear_comb", kw)
+    return h.simple_op("linear_comb", {"W": [weights], "X": [vectors]}, {})
+
+
+def dot_prod(x, y, **kw):
+    h = _h("dot_prod", kw)
+    return h.simple_op("dot_prod", {"X": [x], "Y": [y]}, {})
+
+
+def out_prod(x, y, **kw):
+    h = _h("out_prod", kw)
+    return h.simple_op("out_prod", {"X": [x], "Y": [y]}, {})
+
+
+def l2_distance(x, y, **kw):
+    h = _h("l2_distance", kw)
+    return h.simple_op("l2_distance", {"X": [x], "Y": [y]}, {})
+
+
+def repeat(x, num_repeats, as_row_vector=True, **kw):
+    h = _h("repeat", kw)
+    return h.simple_op("repeat", {"X": [x]},
+                       {"num_repeats": num_repeats,
+                        "as_row_vector": as_row_vector})
+
+
+def resize(x, size, **kw):
+    h = _h("resize", kw)
+    # The kernel folds the batch dim ([b, d] -> [b*d/size, size]), which
+    # abstract shape inference cannot evaluate against the symbolic batch
+    # sentinel — declare the [-1, size] output shape directly instead.
+    out_var = h.create_tmp_variable(x.dtype, shape=[-1, size])
+    h.append_op("resize", {"X": [x]}, {"Out": [out_var]}, {"size": size})
+    return out_var
+
+
+def rotate(x, height, width, **kw):
+    h = _h("rotate", kw)
+    return h.simple_op("rotate", {"X": [x]},
+                       {"height": height, "width": width})
+
+
+def multiplex(inputs, index, **kw):
+    """Row-wise select among candidate tensors (multiplex_op.cc)."""
+    h = _h("multiplex", kw)
+    return h.simple_op("multiplex", {"X": list(inputs), "Ids": [index]}, {})
+
+
+def kmax_seq_score(scores, beam_size=1, **kw):
+    h = _h("kmax_seq_score", kw)
+    from .sequence import get_seq_len
+
+    ins = {"X": [scores]}
+    sl = get_seq_len(scores)
+    if sl is not None:
+        ins["Length"] = [sl]
+    return h.simple_op("kmax_seq_score", ins, {"beam_size": beam_size})
+
+
+def sequence_reshape(x, new_dim, **kw):
+    h = _h("sequence_reshape", kw)
+    return h.simple_op("sequence_reshape", {"X": [x]}, {"new_dim": new_dim})
+
+
+def sampling_id(probs, **kw):
+    h = _h("sampling_id", kw)
+    return h.simple_op("sampling_id", {"X": [probs]}, {})
+
+
+def factorization_machine(x, factor_size, param_attr=None, **kw):
+    """FM second-order interaction term (factorization_machine layer)."""
+    h = _h("factorization_machine", kw)
+    v = h.create_parameter(param_attr or ParamAttr(),
+                           [int(x.shape[-1]), factor_size], x.dtype)
+    return h.simple_op("factorization_machine", {"X": [x], "V": [v]}, {})
+
+
+def gated_unit(x, size, act="tanh", param_attr=None, gate_attr=None, **kw):
+    """out = act(x Wp) * sigmoid(x Wg) (gated_unit_layer)."""
+    from .nn import fc
+
+    p = fc(x, size=size, param_attr=param_attr, bias_attr=None,
+           main_program=kw.get("main_program"),
+           startup_program=kw.get("startup_program"))
+    g = fc(x, size=size, param_attr=gate_attr, bias_attr=None,
+           main_program=kw.get("main_program"),
+           startup_program=kw.get("startup_program"))
+    h = _h("gated_unit", kw)
+    return h.simple_op("gated_unit", {"P": [p], "G": [g]}, {"act": act})

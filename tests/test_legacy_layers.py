@@ -1,0 +1,198 @@
+"""Legacy gserver layer-tail ops vs numpy references
+(/root/reference/paddle/gserver/layers/: InterpolationLayer, ScalingLayer,
+PowerLayer, AddtoLayer, SumToOneNormLayer, RowL2NormLayer, ScaleShiftLayer,
+LinearCombLayer, DotProdLayer, OuterProdLayer, L2DistanceLayer,
+FeatureMapExpandLayer, ResizeLayer, RotateLayer, FactorizationMachineLayer;
+operators/multiplex_op.cc, sequence_reshape_op.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op
+
+
+def run_op(op_type, ins, attrs=None, seed=0):
+    import jax
+    import jax.numpy as jnp
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins.items()}
+    opdef = get_op(op_type)
+    if opdef.needs_rng:
+        return opdef.fn(attrs or {}, ins, rng=jax.random.PRNGKey(seed))
+    return opdef.fn(attrs or {}, ins)
+
+
+rng = np.random.RandomState(7)
+X = rng.randn(4, 6).astype(np.float32)
+Y = rng.randn(4, 6).astype(np.float32)
+W = rng.rand(4).astype(np.float32)
+
+
+class TestRowCombinators:
+    def test_interpolation(self):
+        o = np.asarray(run_op("interpolation",
+                              {"X": [X], "Y": [Y], "W": [W]})["Out"][0])
+        np.testing.assert_allclose(
+            o, W[:, None] * X + (1 - W[:, None]) * Y, rtol=1e-6)
+
+    def test_scaling_and_power(self):
+        o = np.asarray(run_op("scaling", {"X": [X], "W": [W]})["Out"][0])
+        np.testing.assert_allclose(o, W[:, None] * X, rtol=1e-6)
+        xp = np.abs(X) + 0.5
+        o = np.asarray(run_op("power", {"X": [xp], "W": [W]})["Out"][0])
+        np.testing.assert_allclose(o, xp ** W[:, None], rtol=1e-5)
+
+    def test_slope_intercept_addto(self):
+        o = np.asarray(run_op("slope_intercept", {"X": [X]},
+                              {"slope": 2.0, "intercept": -1.0})["Out"][0])
+        np.testing.assert_allclose(o, 2 * X - 1, rtol=1e-6)
+        b = np.ones((6,), np.float32)
+        o = np.asarray(run_op("addto", {"X": [X, Y, X], "Bias": [b]})
+                       ["Out"][0])
+        np.testing.assert_allclose(o, X + Y + X + 1, rtol=1e-6)
+
+    def test_norms(self):
+        xp = np.abs(X) + 0.1
+        o = np.asarray(run_op("sum_to_one_norm", {"X": [xp]})["Out"][0])
+        np.testing.assert_allclose(o.sum(-1), np.ones(4), rtol=1e-6)
+        o = np.asarray(run_op("row_l2_norm", {"X": [X]})["Out"][0])
+        np.testing.assert_allclose(np.linalg.norm(o, axis=-1), np.ones(4),
+                                   rtol=1e-5)
+
+    def test_products_and_distance(self):
+        o = np.asarray(run_op("dot_prod", {"X": [X], "Y": [Y]})["Out"][0])
+        np.testing.assert_allclose(o[:, 0], (X * Y).sum(-1), rtol=1e-5)
+        o = np.asarray(run_op("out_prod", {"X": [X], "Y": [Y]})["Out"][0])
+        np.testing.assert_allclose(o.reshape(4, 6, 6),
+                                   np.einsum("bi,bj->bij", X, Y), rtol=1e-5)
+        o = np.asarray(run_op("l2_distance", {"X": [X], "Y": [Y]})["Out"][0])
+        np.testing.assert_allclose(o[:, 0], np.linalg.norm(X - Y, axis=-1),
+                                   rtol=1e-5)
+
+    def test_linear_comb(self):
+        w = rng.randn(4, 3).astype(np.float32)
+        x = rng.randn(4, 12).astype(np.float32)
+        o = np.asarray(run_op("linear_comb", {"W": [w], "X": [x]})["Out"][0])
+        ref = np.einsum("bm,bmd->bd", w, x.reshape(4, 3, 4))
+        np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+class TestShapeOps:
+    def test_repeat_both_modes(self):
+        x = np.array([[1.0, 2.0]], np.float32)
+        o = np.asarray(run_op("repeat", {"X": [x]},
+                              {"num_repeats": 2})["Out"][0])
+        np.testing.assert_allclose(o, [[1, 2, 1, 2]])
+        o = np.asarray(run_op("repeat", {"X": [x]},
+                              {"num_repeats": 2,
+                               "as_row_vector": False})["Out"][0])
+        np.testing.assert_allclose(o, [[1, 1, 2, 2]])
+
+    def test_resize_rotate(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        o = np.asarray(run_op("resize", {"X": [x]}, {"size": 3})["Out"][0])
+        assert o.shape == (4, 3)
+        g = np.arange(6, dtype=np.float32).reshape(1, 6)
+        o = np.asarray(run_op("rotate", {"X": [g]},
+                              {"height": 2, "width": 3})["Out"][0])
+        ref = np.rot90(g.reshape(2, 3), 1).reshape(1, 6)
+        np.testing.assert_allclose(o, ref)
+
+    def test_sequence_reshape(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        o = np.asarray(run_op("sequence_reshape", {"X": [x]},
+                              {"new_dim": 6})["Out"][0])
+        np.testing.assert_allclose(o, x.reshape(2, 2, 6))
+
+    def test_multiplex(self):
+        a = np.zeros((3, 2), np.float32)
+        b = np.ones((3, 2), np.float32)
+        ids = np.array([1, 0, 1], np.int64)
+        o = np.asarray(run_op("multiplex", {"X": [a, b], "Ids": [ids]})
+                       ["Out"][0])
+        np.testing.assert_allclose(o, [[1, 1], [0, 0], [1, 1]])
+
+    def test_kmax_seq_score(self):
+        s = np.array([[0.1, 0.9, 0.5, 0.3]], np.float32)
+        o = np.asarray(run_op("kmax_seq_score", {"X": [s]},
+                              {"beam_size": 2})["Out"][0])
+        np.testing.assert_array_equal(o, [[1, 2]])
+        length = np.array([2], np.int32)
+        o = np.asarray(run_op("kmax_seq_score",
+                              {"X": [s], "Length": [length]},
+                              {"beam_size": 2})["Out"][0])
+        np.testing.assert_array_equal(o, [[1, 0]])
+
+
+class TestParameterized:
+    def test_factorization_machine_matches_numpy(self):
+        x = rng.randn(5, 8).astype(np.float32)
+        v = rng.randn(8, 3).astype(np.float32)
+        o = np.asarray(run_op("factorization_machine",
+                              {"X": [x], "V": [v]})["Out"][0])
+        ref = 0.5 * ((x @ v) ** 2 - (x ** 2) @ (v ** 2)).sum(-1,
+                                                             keepdims=True)
+        np.testing.assert_allclose(o, ref, rtol=1e-4)
+
+    def test_sampling_id_distribution(self):
+        p = np.array([[0.0, 1.0, 0.0]] * 8, np.float32)
+        o = np.asarray(run_op("sampling_id", {"X": [p]})["Out"][0])
+        np.testing.assert_array_equal(o, np.ones(8, np.int64))
+
+    def test_scale_shift_trains(self):
+        """scale_shift recovers y = 3x - 2."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.data("y", shape=[4])
+            pred = layers.scale_shift(x)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.2).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        for _ in range(100):
+            xb = rng.randn(16, 4).astype(np.float32)
+            yb = 3 * xb - 2
+            lo, = exe.run(main, feed={"x": xb, "y": yb},
+                          fetch_list=[loss], scope=scope)
+        assert float(lo) < 1e-3, float(lo)
+
+    def test_gated_unit_forward(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[6])
+            g = layers.gated_unit(x, size=5)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        o, = exe.run(main, feed={"x": X}, fetch_list=[g], scope=scope)
+        assert np.asarray(o).shape == (4, 5)
+
+    def test_fm_layer_in_program(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[8])
+            fm = layers.factorization_machine(x, factor_size=3)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        o, = exe.run(main, feed={"x": rng.randn(5, 8).astype(np.float32)},
+                     fetch_list=[fm], scope=scope)
+        assert np.asarray(o).shape == (5, 1)
+
+    def test_resize_layer_dynamic_batch(self):
+        """resize folds the batch dim; must build with symbolic batch and
+        run for any divisible concrete batch."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[6])
+            r = layers.resize(x, size=3)
+        assert tuple(r.shape) == (-1, 3)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        o, = exe.run(main, feed={"x": np.ones((4, 6), np.float32)},
+                     fetch_list=[r], scope=scope)
+        assert np.asarray(o).shape == (8, 3)
