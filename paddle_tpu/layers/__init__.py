@@ -15,6 +15,8 @@ from .sequence import (ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
                        sequence_conv, sequence_expand, sequence_first_step,
                        sequence_last_step, sequence_pool, sequence_reverse,
                        sequence_softmax, warpctc)
+from .detection import (bilinear_interp, box_coder, hsigmoid,
+                        iou_similarity, multibox_loss, prior_box)
 from .legacy import (addto, dot_prod, factorization_machine, gated_unit,
                      interpolation, kmax_seq_score, l2_distance, linear_comb,
                      multiplex, out_prod, power, repeat, resize, rotate,
@@ -43,6 +45,8 @@ __all__ = (
      "sum_to_one_norm", "row_l2_norm", "scale_shift", "linear_comb",
      "dot_prod", "out_prod", "l2_distance", "repeat", "resize", "rotate",
      "multiplex", "kmax_seq_score", "sequence_reshape", "sampling_id",
-     "factorization_machine", "gated_unit"]
+     "factorization_machine", "gated_unit",
+     "prior_box", "iou_similarity", "box_coder", "multibox_loss",
+     "bilinear_interp", "hsigmoid"]
     + list(_ops_all)
 )

@@ -1,0 +1,74 @@
+"""Detection layer builders: the SSD stack + hierarchical sigmoid
+(v1 DSL: priorbox_layer, multibox_loss_layer, detection_output_layer,
+bilinear_interp_layer, hsigmoid — trainer_config_helpers/layers.py)."""
+from __future__ import annotations
+
+from ..param_attr import ParamAttr
+from .layer_helper import kw_helper as _h
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variances=(0.1, 0.1, 0.2, 0.2), clip=False, **kw):
+    """SSD anchors for one feature map (priorbox_layer). Returns
+    (boxes, variances), each [H, W, num_priors, 4]."""
+    h = _h("prior_box", kw)
+    outs, _ = h.append_op(
+        "prior_box", {"Input": [input], "Image": [image]},
+        ["Boxes", "Variances"],
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios or []),
+         "variances": list(variances), "clip": clip})
+    return outs["Boxes"][0], outs["Variances"][0]
+
+
+def iou_similarity(x, y, **kw):
+    h = _h("iou_similarity", kw)
+    return h.simple_op("iou_similarity", {"X": [x], "Y": [y]}, {})
+
+
+def box_coder(prior_box, target_box, prior_variance=None,
+              code_type="encode_center_size", **kw):
+    h = _h("box_coder", kw)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_variance is not None:
+        ins["Variance"] = [prior_variance]
+    return h.simple_op("box_coder", ins, {"code_type": code_type},
+                       out_slot="OutputBox")
+
+
+def multibox_loss(prior_boxes, prior_variances, loc_pred, conf_pred,
+                  gt_boxes, gt_classes, gt_length=None,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0, **kw):
+    """SSD training loss (multibox_loss_layer): per-image loss [b, 1]."""
+    h = _h("multibox_loss", kw)
+    ins = {"PriorBoxes": [prior_boxes], "PriorVariances": [prior_variances],
+           "LocPred": [loc_pred], "ConfPred": [conf_pred],
+           "GtBoxes": [gt_boxes], "GtClasses": [gt_classes]}
+    if gt_length is not None:
+        ins["GtLength"] = [gt_length]
+    return h.simple_op("multibox_loss", ins,
+                       {"overlap_threshold": overlap_threshold,
+                        "neg_pos_ratio": neg_pos_ratio}, out_slot="Loss")
+
+
+def bilinear_interp(input, out_h, out_w, **kw):
+    """Bilinear upsampling of NHWC maps (bilinear_interp_layer)."""
+    h = _h("bilinear_interp", kw)
+    return h.simple_op("bilinear_interp", {"X": [input]},
+                       {"out_h": out_h, "out_w": out_w})
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             **kw):
+    """Hierarchical sigmoid loss [b, 1] over a complete binary class tree
+    (hsigmoid, HierarchicalSigmoidLayer.cpp)."""
+    h = _h("hsigmoid", kw)
+    w = h.create_parameter(param_attr or ParamAttr(),
+                           [num_classes - 1, int(input.shape[-1])],
+                           input.dtype)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = h.create_parameter(bias_attr or ParamAttr(), [num_classes - 1],
+                               input.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    return h.simple_op("hsigmoid", ins, {"num_classes": num_classes})

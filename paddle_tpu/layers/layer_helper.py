@@ -187,3 +187,10 @@ class LayerHelper:
         b = self.create_parameter(attr, shape=[size], dtype=var.dtype, is_bias=True)
         return self.simple_op("elementwise_add", {"X": [var], "Y": [b]},
                               {"axis": dim_start})
+
+
+def kw_helper(layer_type: str, kw: dict) -> "LayerHelper":
+    """Helper for builders taking **kw with optional main_program/
+    startup_program (legacy.py, detection.py)."""
+    return LayerHelper(layer_type, main_program=kw.get("main_program"),
+                       startup_program=kw.get("startup_program"))

@@ -8,12 +8,7 @@ factorization_machine, gated_unit) create their weights via LayerHelper.
 from __future__ import annotations
 
 from ..param_attr import ParamAttr
-from .layer_helper import LayerHelper
-
-
-def _h(name, kw):
-    return LayerHelper(name, main_program=kw.get("main_program"),
-                       startup_program=kw.get("startup_program"))
+from .layer_helper import kw_helper as _h
 
 
 def interpolation(x, y, weight, **kw):

@@ -1,0 +1,305 @@
+"""SSD detection stack + hierarchical sigmoid.
+
+TPU-native equivalents of the reference's detection layers
+(/root/reference/paddle/gserver/layers/PriorBox.cpp, MultiBoxLossLayer.cpp
++ DetectionUtil.cpp, DetectionOutputLayer.cpp — the last already exists as
+the ``detection_output`` op) and HierarchicalSigmoidLayer.cpp. All dense,
+batch-padded, loop-free formulations: matching/mining become argmax/top_k
+over [P, G] IoU tables instead of per-box host loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import maybe, out, single
+
+
+@register_op("prior_box")
+def prior_box(attrs, ins):
+    """SSD anchor generation (PriorBox.cpp:79-131): for every feature-map
+    cell, emit one box per min_size, one sqrt(min*max) box per max_size,
+    and one box per extra aspect ratio (input ratios are flipped r, 1/r as
+    in init :68-74), all center-aligned on the cell, normalized by image
+    size, optionally clipped. Outputs Boxes [H, W, num_priors, 4]
+    (xmin, ymin, xmax, ymax) and Variances broadcast to the same shape.
+
+    Inputs are the feature map [b, H, W, C] and image [b, h, w, 3] (only
+    shapes are read — matching the reference, which reads frame sizes).
+    """
+    feat = single(ins, "Input")
+    image = single(ins, "Image")
+    fh, fw = feat.shape[1], feat.shape[2]
+    ih, iw = image.shape[1], image.shape[2]
+    from ..core.enforce import enforce, enforce_eq
+
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    if max_sizes:
+        enforce_eq(len(min_sizes), len(max_sizes),
+                   "prior_box: min_sizes and max_sizes lengths")
+    variance = [float(v) for v in attrs.get("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    # ratio 1 is the min-size box itself; the reference skips it in the
+    # generation loop (PriorBox.cpp forward: fabs(ar - 1.) < 1e-6 continue)
+    flip_ratios = []
+    for r in attrs.get("aspect_ratios", []):
+        if abs(float(r) - 1.0) < 1e-6:
+            continue
+        flip_ratios += [float(r), 1.0 / float(r)]
+    enforce(min_sizes, "prior_box: min_sizes must be non-empty")
+    clip = attrs.get("clip", False)
+
+    step_w, step_h = iw / fw, ih / fh
+    cx = (jnp.arange(fw, dtype=jnp.float32) + 0.5) * step_w  # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + 0.5) * step_h  # [H]
+    cx = jnp.broadcast_to(cx[None, :], (fh, fw))
+    cy = jnp.broadcast_to(cy[:, None], (fh, fw))
+
+    widths, heights = [], []
+    for i, ms in enumerate(min_sizes):
+        widths.append(ms)
+        heights.append(ms)
+        if max_sizes:
+            s = (ms * max_sizes[i]) ** 0.5
+            widths.append(s)
+            heights.append(s)
+        for r in flip_ratios:
+            widths.append(ms * (r ** 0.5))
+            heights.append(ms / (r ** 0.5))
+    w_arr = jnp.asarray(widths, jnp.float32)   # [np]
+    h_arr = jnp.asarray(heights, jnp.float32)
+
+    xmin = (cx[..., None] - w_arr / 2) / iw
+    ymin = (cy[..., None] - h_arr / 2) / ih
+    xmax = (cx[..., None] + w_arr / 2) / iw
+    ymax = (cy[..., None] + h_arr / 2) / ih
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [H, W, np, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return out(Boxes=boxes, Variances=var)
+
+
+def _iou_table(a, b):
+    """[N, 4] x [M, 4] -> [N, M] IoU (DetectionUtil.cpp jaccardOverlap)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None]
+    area_b = ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))[None, :]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+@register_op("iou_similarity")
+def iou_similarity(attrs, ins):
+    """Pairwise IoU table; batched X [b, N, 4] or flat [N, 4]."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    if x.ndim == 3:
+        return out(Out=jax.vmap(_iou_table)(x, jnp.broadcast_to(
+            y if y.ndim == 3 else y[None], (x.shape[0],) + tuple(y.shape[-2:]))))
+    return out(Out=_iou_table(x, y))
+
+
+def _encode(gt, prior, var):
+    """SSD box encoding (DetectionUtil.cpp encodeBBoxWithVar)."""
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-10)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-10)
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    return jnp.stack([
+        (gcx - pcx) / pw / var[..., 0],
+        (gcy - pcy) / ph / var[..., 1],
+        jnp.log(gw / pw) / var[..., 2],
+        jnp.log(gh / ph) / var[..., 3],
+    ], axis=-1)
+
+
+def _decode(code, prior, var):
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    cx = code[..., 0] * var[..., 0] * pw + pcx
+    cy = code[..., 1] * var[..., 1] * ph + pcy
+    w = jnp.exp(code[..., 2] * var[..., 2]) * pw
+    h = jnp.exp(code[..., 3] * var[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register_op("box_coder", optional_inputs=("Variance",))
+def box_coder(attrs, ins):
+    """Encode target boxes against priors, or decode predicted offsets
+    (DetectionUtil encode/decodeBBoxWithVar). ``code_type``:
+    'encode_center_size' | 'decode_center_size'."""
+    target = single(ins, "TargetBox")
+    prior = single(ins, "PriorBox")
+    var = maybe(ins, "Variance")
+    if var is None:
+        var = jnp.ones_like(prior)
+    code_type = attrs.get("code_type", "encode_center_size")
+    if prior.ndim < target.ndim:  # broadcast priors over the batch
+        prior = jnp.broadcast_to(prior[None], target.shape)
+        var = jnp.broadcast_to(var[None] if var.ndim < target.ndim else var,
+                               target.shape)
+    if code_type == "encode_center_size":
+        return out(OutputBox=_encode(target, prior, var))
+    return out(OutputBox=_decode(target, prior, var))
+
+
+@register_op("multibox_loss", optional_inputs=("GtLength",))
+def multibox_loss(attrs, ins):
+    """SSD training loss (MultiBoxLossLayer.cpp): smooth-L1 location loss
+    on matched priors + softmax confidence loss with hard negative mining.
+
+    Dense formulation: per image, the [P, G] IoU table gives per-prior
+    best-gt matches (IoU >= overlap_threshold) plus the bipartite
+    per-gt-best-prior overrides (DetectionUtil matchBBox); negatives are
+    the neg_pos_ratio * num_pos highest-confidence-loss unmatched priors,
+    selected with top_k instead of the reference's sort (:FindMatches /
+    :MineHardExamples).
+
+    Normalization matches the reference's cost contract
+    (MultiBoxLossLayer.cpp:206,258 — batch-summed loss / BATCH-WIDE match
+    count): Loss is [b, 1] with out[i] = raw_i * b / total_matches, so
+    ``mean(Loss)`` equals the reference's scalar cost and every matched
+    prior carries equal gradient weight regardless of which image it
+    belongs to.
+
+    Inputs: PriorBoxes [P, 4], PriorVariances [P, 4], LocPred [b, P, 4],
+    ConfPred [b, P, C] (class 0 = background), GtBoxes [b, G, 4],
+    GtClasses [b, G] (1..C-1), GtLength [b].
+    """
+    priors = single(ins, "PriorBoxes")
+    pvar = single(ins, "PriorVariances")
+    loc = single(ins, "LocPred")
+    conf = single(ins, "ConfPred")
+    gt_boxes = single(ins, "GtBoxes")
+    gt_cls = single(ins, "GtClasses")
+    b, P = loc.shape[0], loc.shape[1]
+    G = gt_boxes.shape[1]
+    gt_cls = gt_cls.reshape(b, G).astype(jnp.int32)
+    glen = maybe(ins, "GtLength")
+    if glen is None:
+        glen = jnp.full((b,), G, jnp.int32)
+    glen = glen.reshape(-1).astype(jnp.int32)
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+
+    def one_image(loc_p, conf_p, gtb, gtc, n_gt):
+        gmask = jnp.arange(G) < n_gt                     # [G]
+        iou = _iou_table(priors, gtb)                    # [P, G]
+        iou = jnp.where(gmask[None, :], iou, -1.0)
+        # per-prior best gt
+        best_gt = jnp.argmax(iou, axis=1)                # [P]
+        best_iou = jnp.take_along_axis(iou, best_gt[:, None],
+                                       axis=1)[:, 0]
+        matched = best_iou >= thresh
+        # bipartite overrides: each gt claims its best prior
+        best_prior = jnp.argmax(iou, axis=0)             # [G]
+        matched = matched.at[best_prior].set(
+            jnp.where(gmask, True, matched[best_prior]))
+        best_gt = best_gt.at[best_prior].set(
+            jnp.where(gmask, jnp.arange(G), best_gt[best_prior]))
+        n_pos = jnp.sum(matched)
+
+        # location loss: smooth L1 on matched priors
+        target = _encode(gtb[best_gt], priors, pvar)     # [P, 4]
+        d = loc_p - target
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        loc_loss = jnp.where(matched, sl1, 0.0).sum()
+
+        # confidence loss: softmax CE against matched class / background
+        tgt_cls = jnp.where(matched, gtc[best_gt], 0)    # [P]
+        logp = jax.nn.log_softmax(conf_p, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=1)[:, 0]
+        pos_conf = jnp.where(matched, ce, 0.0).sum()
+        # hard negative mining: top (neg_ratio * n_pos) bg-loss priors
+        bg_ce = -logp[:, 0]
+        neg_cand = jnp.where(matched, -jnp.inf, bg_ce)
+        order = jnp.argsort(-neg_cand)                   # desc
+        rank = jnp.zeros((P,), jnp.int32).at[order].set(jnp.arange(P))
+        n_neg = jnp.minimum((neg_ratio * n_pos).astype(jnp.int32),
+                            P - n_pos)
+        neg_sel = (~matched) & (rank < n_neg)
+        neg_conf = jnp.where(neg_sel, ce, 0.0).sum()
+
+        return loc_loss + pos_conf + neg_conf, n_pos
+
+    raw, n_pos = jax.vmap(one_image)(loc, conf, gt_boxes, gt_cls, glen)
+    total = jnp.maximum(jnp.sum(n_pos).astype(raw.dtype), 1.0)
+    loss = raw * (b / total)
+    return out(Loss=loss[:, None])
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(attrs, ins):
+    """Bilinear resize of NHWC feature maps (BilinearInterpLayer.cpp):
+    ALIGN-CORNERS convention — ratio = (in-1)/(out-1) when out > 1 —
+    exactly the gserver layer's sampling grid."""
+    x = single(ins, "X")
+    oh = int(attrs["out_h"])
+    ow = int(attrs["out_w"])
+    b, ih, iw, c = x.shape
+    ry = (ih - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rx = (iw - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    yy = jnp.arange(oh, dtype=jnp.float32) * ry
+    xx = jnp.arange(ow, dtype=jnp.float32) * rx
+    y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, ih - 1)
+    x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, iw - 1)
+    y1 = jnp.minimum(y0 + 1, ih - 1)
+    x1 = jnp.minimum(x0 + 1, iw - 1)
+    wy = (yy - y0.astype(jnp.float32))[None, :, None, None]
+    wx = (xx - x0.astype(jnp.float32))[None, None, :, None]
+    p00 = x[:, y0][:, :, x0]
+    p01 = x[:, y0][:, :, x1]
+    p10 = x[:, y1][:, :, x0]
+    p11 = x[:, y1][:, :, x1]
+    top = p00 * (1 - wx) + p01 * wx
+    bot = p10 * (1 - wx) + p11 * wx
+    return out(Out=top * (1 - wy) + bot * wy)
+
+
+@register_op("hsigmoid", optional_inputs=("Bias",))
+def hsigmoid(attrs, ins):
+    """Hierarchical sigmoid loss over a complete binary tree of classes
+    (HierarchicalSigmoidLayer.cpp; paddle/math MatrixBits codes): for a
+    sample with label c, walk the implicit tree node sequence of
+    ``code = c + num_classes`` from the bit below the leading 1 downward;
+    at depth j the internal node index is code >> (j+1) minus 1... —
+    equivalently, the reference's SimpleCode: node_j = (code >> (j+1)) - 1
+    with bit_j = (code >> j) & 1. Loss = sum_j softplus(-(sign_j) * (x .
+    w_node_j + b_node_j)) with sign_j = 2*bit_j - 1, i.e. the standard
+    log-sigmoid path loss. W is [num_classes-1, d]; Out is [b, 1].
+    """
+    x = single(ins, "X")                  # [b, d]
+    w = single(ins, "W")                  # [num_classes-1, d]
+    label = single(ins, "Label").reshape(-1).astype(jnp.int32)
+    bias = maybe(ins, "Bias")
+    num_classes = int(attrs["num_classes"])
+    max_depth = max(1, (num_classes - 1).bit_length())
+
+    code = label + num_classes            # [b]
+    js = jnp.arange(max_depth, dtype=jnp.int32)          # [D]
+    node = (code[:, None] >> (js[None, :] + 1)) - 1      # [b, D]
+    # level j is on the path iff its node index exists (bits below the
+    # leading 1): code >> (j+1) >= 1 <=> j <= bit_length(code) - 2
+    active = node >= 0                                   # [b, D]
+    bit = (code[:, None] >> js[None, :]) & 1             # [b, D]
+    node_c = jnp.clip(node, 0, num_classes - 2)
+    wj = w[node_c]                                       # [b, D, d]
+    logits = jnp.einsum("bd,bjd->bj", x, wj)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[node_c]
+    sign = 2.0 * bit.astype(logits.dtype) - 1.0
+    losses = jax.nn.softplus(-sign * logits)             # [b, D]
+    return out(Out=jnp.where(active, losses, 0.0).sum(-1, keepdims=True))
