@@ -1,0 +1,119 @@
+"""ctypes binding for the native C inference ABI (native/capi.cc).
+
+Mirrors the reference's paddle/capi usage pattern
+(/root/reference/paddle/capi/capi.h, examples/model_inference/dense):
+create a machine from a saved model, feed inputs, forward, read outputs —
+no Python framework (and no JAX) in the serving process. This module is
+only the test/convenience binding; C/C++ applications link the compiled
+shared library directly.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+import numpy as np
+
+from .native.build import load_library
+
+
+def _lib():
+    lib = load_library("capi")
+    if lib is None:
+        raise RuntimeError("no C++ toolchain available for the capi "
+                           "inference machine")
+    lib.pdtpu_load.restype = ctypes.c_void_p
+    lib.pdtpu_load.argtypes = [ctypes.c_char_p]
+    lib.pdtpu_last_error.restype = ctypes.c_char_p
+    lib.pdtpu_free.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_num_feeds.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_feed_name.restype = ctypes.c_char_p
+    lib.pdtpu_feed_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pdtpu_num_fetches.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_fetch_name.restype = ctypes.c_char_p
+    lib.pdtpu_fetch_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pdtpu_set_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.pdtpu_run.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_output_rank.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pdtpu_output_shape.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.pdtpu_output_numel.restype = ctypes.c_int64
+    lib.pdtpu_output_numel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pdtpu_output_data.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    return lib
+
+
+class InferenceMachine:
+    """C-side forward-only machine over a save_inference_model directory
+    (the paddle_gradient_machine analogue)."""
+
+    def __init__(self, model_dir: str):
+        self._lib = _lib()
+        self._h = self._lib.pdtpu_load(model_dir.encode())
+        if not self._h:
+            raise RuntimeError(
+                "pdtpu_load failed: "
+                + self._lib.pdtpu_last_error().decode())
+
+    @property
+    def feed_names(self) -> List[str]:
+        n = self._lib.pdtpu_num_feeds(self._h)
+        return [self._lib.pdtpu_feed_name(self._h, i).decode()
+                for i in range(n)]
+
+    @property
+    def fetch_names(self) -> List[str]:
+        n = self._lib.pdtpu_num_fetches(self._h)
+        return [self._lib.pdtpu_fetch_name(self._h, i).decode()
+                for i in range(n)]
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        for name, arr in feed.items():
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            rc = self._lib.pdtpu_set_input(
+                self._h, name.encode(),
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                shape, arr.ndim)
+            if rc != 0:
+                raise RuntimeError(self._lib.pdtpu_last_error().decode())
+        if self._lib.pdtpu_run(self._h) != 0:
+            raise RuntimeError(self._lib.pdtpu_last_error().decode())
+        outs = []
+        for name in self.fetch_names:
+            rank = self._lib.pdtpu_output_rank(self._h, name.encode())
+            if rank < 0:
+                raise RuntimeError(self._lib.pdtpu_last_error().decode())
+            shape = (ctypes.c_int64 * max(rank, 1))()
+            self._lib.pdtpu_output_shape(self._h, name.encode(), shape)
+            numel = self._lib.pdtpu_output_numel(self._h, name.encode())
+            buf = np.empty(int(numel), np.float32)
+            rc = self._lib.pdtpu_output_data(
+                self._h, name.encode(),
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), numel)
+            if rc != 0:
+                raise RuntimeError(self._lib.pdtpu_last_error().decode())
+            outs.append(buf.reshape(tuple(shape[:rank])))
+        return outs
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pdtpu_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

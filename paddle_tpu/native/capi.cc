@@ -1,0 +1,976 @@
+// Pure-C inference ABI over saved inference models — the TPU-native
+// analogue of the reference's paddle/capi
+// (/root/reference/paddle/capi/capi.h, gradient_machine.h: create a
+// machine from a merged model, forward only, no Python) for embedded /
+// host-side deployment. Loads the __model__.json + params/*.npy layout
+// written by paddle_tpu.io.save_inference_model and interprets the pruned
+// program with small CPU kernels (this is the deployment path; the TPU
+// path compiles the same program through XLA).
+//
+// Exposed C surface (see paddle_tpu/capi.py for the ctypes binding):
+//   pdtpu_load / pdtpu_free / pdtpu_last_error
+//   pdtpu_num_feeds / pdtpu_feed_name / pdtpu_num_fetches / pdtpu_fetch_name
+//   pdtpu_set_input(name, data, shape, rank)
+//   pdtpu_run()
+//   pdtpu_output_rank / pdtpu_output_shape / pdtpu_output_numel /
+//   pdtpu_output_data
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// Minimal JSON (the subset python json.dump emits).
+// ---------------------------------------------------------------------
+struct JValue {
+  enum Type { kNull, kBool, kNum, kStr, kArr, kObj } type = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  bool has(const std::string& k) const { return obj.count(k) > 0; }
+  const JValue& at(const std::string& k) const { return obj.at(k); }
+  double as_num(double dflt) const { return type == kNum ? num : dflt; }
+  bool as_bool(bool dflt) const {
+    if (type == kBool) return b;
+    if (type == kNum) return num != 0;
+    return dflt;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool fail(const std::string& m) {
+    if (err.empty()) err = m;
+    return false;
+  }
+  bool parse(JValue* v) {
+    skip();
+    if (p >= end) return fail("unexpected end of json");
+    switch (*p) {
+      case '{': return parse_obj(v);
+      case '[': return parse_arr(v);
+      case '"': v->type = JValue::kStr; return parse_str(&v->str);
+      case 't':
+        if (end - p >= 4 && !strncmp(p, "true", 4)) {
+          v->type = JValue::kBool; v->b = true; p += 4; return true;
+        }
+        return fail("bad literal");
+      case 'f':
+        if (end - p >= 5 && !strncmp(p, "false", 5)) {
+          v->type = JValue::kBool; v->b = false; p += 5; return true;
+        }
+        return fail("bad literal");
+      case 'n':
+        if (end - p >= 4 && !strncmp(p, "null", 4)) {
+          v->type = JValue::kNull; p += 4; return true;
+        }
+        return fail("bad literal");
+      case 'N':  // json.dump(..., allow_nan=True) emits NaN/Infinity
+        if (end - p >= 3 && !strncmp(p, "NaN", 3)) {
+          v->type = JValue::kNum; v->num = NAN; p += 3; return true;
+        }
+        return fail("bad literal");
+      case 'I':
+        if (end - p >= 8 && !strncmp(p, "Infinity", 8)) {
+          v->type = JValue::kNum; v->num = INFINITY; p += 8; return true;
+        }
+        return fail("bad literal");
+      default: return parse_num(v);
+    }
+  }
+  bool parse_num(JValue* v) {
+    char* q = nullptr;
+    if (end - p >= 9 && !strncmp(p, "-Infinity", 9)) {
+      v->type = JValue::kNum; v->num = -INFINITY; p += 9; return true;
+    }
+    double d = strtod(p, &q);
+    if (q == p) return fail("bad number");
+    v->type = JValue::kNum;
+    v->num = d;
+    p = q;
+    return true;
+  }
+  bool parse_str(std::string* s) {
+    ++p;  // opening quote
+    s->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        if (++p >= end) return fail("bad escape");
+        switch (*p) {
+          case 'n': s->push_back('\n'); break;
+          case 't': s->push_back('\t'); break;
+          case 'r': s->push_back('\r'); break;
+          case 'b': s->push_back('\b'); break;
+          case 'f': s->push_back('\f'); break;
+          case '"': s->push_back('"'); break;
+          case '\\': s->push_back('\\'); break;
+          case '/': s->push_back('/'); break;
+          case 'u': {
+            if (end - p < 5) return fail("bad \\u escape");
+            unsigned code = strtoul(std::string(p + 1, p + 5).c_str(),
+                                    nullptr, 16);
+            p += 4;
+            // UTF-8 encode (no surrogate-pair handling: var names are ascii)
+            if (code < 0x80) s->push_back(static_cast<char>(code));
+            else if (code < 0x800) {
+              s->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              s->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              s->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              s->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              s->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: return fail("bad escape");
+        }
+        ++p;
+      } else {
+        s->push_back(*p++);
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+  bool parse_arr(JValue* v) {
+    v->type = JValue::kArr;
+    ++p;
+    skip();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      v->arr.emplace_back();
+      if (!parse(&v->arr.back())) return false;
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return true; }
+      return fail("bad array");
+    }
+  }
+  bool parse_obj(JValue* v) {
+    v->type = JValue::kObj;
+    ++p;
+    skip();
+    if (p < end && *p == '}') { ++p; return true; }
+    while (true) {
+      skip();
+      if (p >= end || *p != '"') return fail("bad object key");
+      std::string key;
+      if (!parse_str(&key)) return false;
+      skip();
+      if (p >= end || *p != ':') return fail("missing ':'");
+      ++p;
+      if (!parse(&v->obj[key])) return false;
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return true; }
+      return fail("bad object");
+    }
+  }
+};
+
+// ---------------------------------------------------------------------
+// Tensor (float compute; inference path)
+// ---------------------------------------------------------------------
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+// .npy loader (format spec: magic, version, header dict, raw data).
+bool load_npy(const std::string& path, Tensor* t, std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) { *err = "cannot open " + path; return false; }
+  char magic[6];
+  f.read(magic, 6);
+  if (memcmp(magic, "\x93NUMPY", 6) != 0) {
+    *err = "bad npy magic in " + path;
+    return false;
+  }
+  unsigned char ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t hlen = 0;
+  if (ver[0] == 1) {
+    unsigned char b[2];
+    f.read(reinterpret_cast<char*>(b), 2);
+    hlen = b[0] | (b[1] << 8);
+  } else {
+    unsigned char b[4];
+    f.read(reinterpret_cast<char*>(b), 4);
+    hlen = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24);
+  }
+  std::string header(hlen, '\0');
+  f.read(&header[0], hlen);
+  auto find_val = [&](const std::string& key) -> std::string {
+    auto k = header.find("'" + key + "'");
+    if (k == std::string::npos) return "";
+    auto c = header.find(':', k);
+    auto e = header.find_first_of(",}", c);
+    // shape tuples contain commas: extend to the closing paren
+    auto par = header.find('(', c);
+    if (par != std::string::npos && par < e) e = header.find(')', par) + 1;
+    return header.substr(c + 1, e - c - 1);
+  };
+  std::string descr = find_val("descr");
+  std::string shape_s = find_val("shape");
+  std::string order = find_val("fortran_order");
+  if (order.find("True") != std::string::npos) {
+    *err = "fortran_order npy not supported: " + path;
+    return false;
+  }
+  t->shape.clear();
+  for (size_t i = 0; i < shape_s.size();) {
+    if (isdigit(shape_s[i])) {
+      size_t j = i;
+      while (j < shape_s.size() && isdigit(shape_s[j])) ++j;
+      t->shape.push_back(std::stoll(shape_s.substr(i, j - i)));
+      i = j;
+    } else {
+      ++i;
+    }
+  }
+  int64_t n = t->numel();
+  t->data.resize(n);
+  auto read_as = [&](auto sample, int width) {
+    using T = decltype(sample);
+    std::vector<T> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()),
+           static_cast<std::streamsize>(n) * width);
+    for (int64_t i = 0; i < n; ++i)
+      t->data[static_cast<size_t>(i)] = static_cast<float>(buf[static_cast<size_t>(i)]);
+  };
+  if (descr.find("<f4") != std::string::npos) read_as(float{}, 4);
+  else if (descr.find("<f8") != std::string::npos) read_as(double{}, 8);
+  else if (descr.find("<i8") != std::string::npos) read_as(int64_t{}, 8);
+  else if (descr.find("<i4") != std::string::npos) read_as(int32_t{}, 4);
+  else if (descr.find("|b1") != std::string::npos) read_as(int8_t{}, 1);
+  else {
+    *err = "unsupported npy dtype " + descr + " in " + path;
+    return false;
+  }
+  if (!f) { *err = "short read in " + path; return false; }
+  return true;
+}
+
+// ---------------------------------------------------------------------
+// Program model
+// ---------------------------------------------------------------------
+struct OpDesc {
+  std::string type;
+  std::map<std::string, std::vector<std::string>> ins, outs;
+  JValue attrs;  // kObj
+
+  const JValue* attr(const std::string& name) const {
+    auto it = attrs.obj.find(name);
+    return it == attrs.obj.end() ? nullptr : &it->second;
+  }
+  double attr_num(const std::string& name, double dflt) const {
+    auto* a = attr(name);
+    return a ? a->as_num(dflt) : dflt;
+  }
+  bool attr_bool(const std::string& name, bool dflt) const {
+    auto* a = attr(name);
+    return a ? a->as_bool(dflt) : dflt;
+  }
+  std::string attr_str(const std::string& name,
+                       const std::string& dflt) const {
+    auto* a = attr(name);
+    return a && a->type == JValue::kStr ? a->str : dflt;
+  }
+  // int-or-[int, int] attrs (strides/paddings/ksize)
+  void attr_pair(const std::string& name, int dflt, int* a_, int* b_) const {
+    const JValue* a = attr(name);
+    *a_ = *b_ = dflt;
+    if (!a) return;
+    if (a->type == JValue::kNum) { *a_ = *b_ = static_cast<int>(a->num); }
+    else if (a->type == JValue::kArr && a->arr.size() >= 2) {
+      *a_ = static_cast<int>(a->arr[0].num);
+      *b_ = static_cast<int>(a->arr[1].num);
+    } else if (a->type == JValue::kArr && a->arr.size() == 1) {
+      *a_ = *b_ = static_cast<int>(a->arr[0].num);
+    }
+  }
+};
+
+struct Machine {
+  std::vector<OpDesc> ops;
+  std::vector<std::string> feeds, fetches;
+  std::map<std::string, Tensor> params;  // persistables from params/
+  std::map<std::string, Tensor> env;     // per-run values
+  std::string error;
+};
+
+thread_local std::string g_last_error;
+
+// ---------------------------------------------------------------------
+// Kernels
+// ---------------------------------------------------------------------
+using Kernel = bool (*)(Machine&, const OpDesc&);
+
+Tensor* lookup(Machine& m, const std::string& name) {
+  auto it = m.env.find(name);
+  if (it != m.env.end()) return &it->second;
+  auto p = m.params.find(name);
+  if (p != m.params.end()) return &p->second;
+  return nullptr;
+}
+
+bool need(Machine& m, const OpDesc& op, const std::string& slot, Tensor** t,
+          int idx = 0) {
+  auto it = op.ins.find(slot);
+  if (it == op.ins.end() || static_cast<int>(it->second.size()) <= idx) {
+    m.error = "op '" + op.type + "': missing input slot " + slot;
+    return false;
+  }
+  *t = lookup(m, it->second[static_cast<size_t>(idx)]);
+  if (!*t) {
+    m.error = "op '" + op.type + "': input '" + it->second[static_cast<size_t>(idx)] +
+              "' has no value (feed it or run startup/save params)";
+    return false;
+  }
+  return true;
+}
+
+Tensor& set_out(Machine& m, const OpDesc& op, const std::string& slot) {
+  return m.env[op.outs.at(slot).at(0)];
+}
+
+bool k_mul(Machine& m, const OpDesc& op) {
+  Tensor *x, *y;
+  if (!need(m, op, "X", &x) || !need(m, op, "Y", &y)) return false;
+  int xd = static_cast<int>(op.attr_num("x_num_col_dims", 1));
+  int yd = static_cast<int>(op.attr_num("y_num_col_dims", 1));
+  int64_t M = 1, K = 1, K2 = 1, N = 1;
+  for (int i = 0; i < xd; ++i) M *= x->shape[static_cast<size_t>(i)];
+  for (size_t i = static_cast<size_t>(xd); i < x->shape.size(); ++i) K *= x->shape[i];
+  for (int i = 0; i < yd; ++i) K2 *= y->shape[static_cast<size_t>(i)];
+  for (size_t i = static_cast<size_t>(yd); i < y->shape.size(); ++i) N *= y->shape[i];
+  if (K != K2) {
+    m.error = "mul: contraction mismatch " + std::to_string(K) + " vs " +
+              std::to_string(K2);
+    return false;
+  }
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.assign(x->shape.begin(), x->shape.begin() + xd);
+  o.shape.insert(o.shape.end(), y->shape.begin() + yd, y->shape.end());
+  o.data.assign(static_cast<size_t>(M * N), 0.f);
+  const float* A = x->data.data();
+  const float* B = y->data.data();
+  float* C = o.data.data();
+  for (int64_t i = 0; i < M; ++i)
+    for (int64_t k = 0; k < K; ++k) {
+      float a = A[i * K + k];
+      if (a == 0.f) continue;
+      const float* brow = B + k * N;
+      float* crow = C + i * N;
+      for (int64_t j = 0; j < N; ++j) crow[j] += a * brow[j];
+    }
+  return true;
+}
+
+// reference elementwise broadcast: y aligns to x at `axis`
+// (ops/common.py broadcast_to_x).
+template <typename F>
+bool k_elementwise(Machine& m, const OpDesc& op, F f) {
+  Tensor *x, *y;
+  if (!need(m, op, "X", &x) || !need(m, op, "Y", &y)) return false;
+  int axis = static_cast<int>(op.attr_num("axis", -1));
+  int xr = static_cast<int>(x->shape.size());
+  int yr = static_cast<int>(y->shape.size());
+  if (axis < 0) axis = xr - yr;
+  if (axis < 0 || axis + yr > xr) {
+    m.error = "elementwise: y rank/axis does not fit x (axis=" +
+              std::to_string(axis) + ", rank(y)=" + std::to_string(yr) +
+              ", rank(x)=" + std::to_string(xr) + ")";
+    return false;
+  }
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  // strides for y broadcast: pre (dims before axis) x ymid x post
+  int64_t pre = 1, mid = 1, post = 1;
+  for (int i = 0; i < axis; ++i) pre *= x->shape[static_cast<size_t>(i)];
+  for (int i = 0; i < yr; ++i) mid *= x->shape[static_cast<size_t>(axis + i)];
+  for (int i = axis + yr; i < xr; ++i) post *= x->shape[static_cast<size_t>(i)];
+  if (mid != y->numel()) {
+    m.error = "elementwise: y shape does not align with x at axis " +
+              std::to_string(axis);
+    return false;
+  }
+  for (int64_t a = 0; a < pre; ++a)
+    for (int64_t b = 0; b < mid; ++b) {
+      float yv = y->data[static_cast<size_t>(b)];
+      const float* xs = x->data.data() + (a * mid + b) * post;
+      float* os = o.data.data() + (a * mid + b) * post;
+      for (int64_t c = 0; c < post; ++c) os[c] = f(xs[c], yv);
+    }
+  return true;
+}
+
+template <typename F>
+bool k_unary(Machine& m, const OpDesc& op, F f) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  for (size_t i = 0; i < x->data.size(); ++i) o.data[i] = f(x->data[i]);
+  return true;
+}
+
+bool k_softmax(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  int64_t cols = x->shape.empty() ? 1 : x->shape.back();
+  int64_t rows = x->numel() / cols;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x->data.data() + r * cols;
+    float* oi = o.data.data() + r * cols;
+    float mx = xi[0];
+    for (int64_t c = 1; c < cols; ++c) mx = std::max(mx, xi[c]);
+    float sum = 0;
+    for (int64_t c = 0; c < cols; ++c) {
+      oi[c] = std::exp(xi[c] - mx);
+      sum += oi[c];
+    }
+    for (int64_t c = 0; c < cols; ++c) oi[c] /= sum;
+  }
+  return true;
+}
+
+bool k_conv2d(Machine& m, const OpDesc& op) {
+  Tensor *x, *w;
+  if (!need(m, op, "Input", &x) || !need(m, op, "Filter", &w)) return false;
+  std::string fmt = op.attr_str("data_format", "NCHW");
+  int sh, sw, ph, pw, dh, dw;
+  op.attr_pair("strides", 1, &sh, &sw);
+  op.attr_pair("paddings", 0, &ph, &pw);
+  op.attr_pair("dilations", 1, &dh, &dw);
+  int groups = static_cast<int>(op.attr_num("groups", 1));
+  int64_t N, H, W, Ci, kh, kw, Co;
+  bool nhwc = (fmt == "NHWC");
+  if (nhwc) {  // filter HWIO
+    N = x->shape[0]; H = x->shape[1]; W = x->shape[2]; Ci = x->shape[3];
+    kh = w->shape[0]; kw = w->shape[1]; Co = w->shape[3];
+  } else {     // filter OIHW
+    N = x->shape[0]; Ci = x->shape[1]; H = x->shape[2]; W = x->shape[3];
+    Co = w->shape[0]; kh = w->shape[2]; kw = w->shape[3];
+  }
+  int64_t cig = Ci / groups, cog = Co / groups;
+  int64_t OH = (H + 2 * ph - dh * (kh - 1) - 1) / sh + 1;
+  int64_t OW = (W + 2 * pw - dw * (kw - 1) - 1) / sw + 1;
+  Tensor& o = set_out(m, op, "Output");
+  o.shape = nhwc ? std::vector<int64_t>{N, OH, OW, Co}
+                 : std::vector<int64_t>{N, Co, OH, OW};
+  o.data.assign(static_cast<size_t>(N * OH * OW * Co), 0.f);
+  auto xat = [&](int64_t n, int64_t h, int64_t ww, int64_t c) -> float {
+    if (h < 0 || h >= H || ww < 0 || ww >= W) return 0.f;
+    return nhwc ? x->data[static_cast<size_t>(((n * H + h) * W + ww) * Ci + c)]
+                : x->data[static_cast<size_t>(((n * Ci + c) * H + h) * W + ww)];
+  };
+  auto wat = [&](int64_t fh, int64_t fw, int64_t ci, int64_t co) -> float {
+    return nhwc ? w->data[static_cast<size_t>(((fh * kw + fw) * cig + ci) * Co + co)]
+                : w->data[static_cast<size_t>(((co * cig + ci) * kh + fh) * kw + fw)];
+  };
+  auto oat = [&](int64_t n, int64_t h, int64_t ww, int64_t c) -> float& {
+    return nhwc ? o.data[static_cast<size_t>(((n * OH + h) * OW + ww) * Co + c)]
+                : o.data[static_cast<size_t>(((n * Co + c) * OH + h) * OW + ww)];
+  };
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t g = 0; g < groups; ++g)
+      for (int64_t co = g * cog; co < (g + 1) * cog; ++co)
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float acc = 0.f;
+            for (int64_t fh = 0; fh < kh; ++fh)
+              for (int64_t fw = 0; fw < kw; ++fw) {
+                int64_t ih = oh * sh - ph + fh * dh;
+                int64_t iw = ow * sw - pw + fw * dw;
+                if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                for (int64_t ci = 0; ci < cig; ++ci)
+                  acc += xat(n, ih, iw, g * cig + ci) * wat(fh, fw, ci, co);
+              }
+            oat(n, oh, ow, co) = acc;
+          }
+  return true;
+}
+
+bool k_pool2d(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  std::string fmt = op.attr_str("data_format", "NCHW");
+  bool nhwc = (fmt == "NHWC");
+  std::string ptype = op.attr_str("pooling_type", "max");
+  int kh, kw, sh, sw, ph, pw;
+  op.attr_pair("ksize", 2, &kh, &kw);
+  op.attr_pair("strides", 1, &sh, &sw);
+  op.attr_pair("paddings", 0, &ph, &pw);
+  int64_t N, H, W, C;
+  if (nhwc) { N = x->shape[0]; H = x->shape[1]; W = x->shape[2]; C = x->shape[3]; }
+  else { N = x->shape[0]; C = x->shape[1]; H = x->shape[2]; W = x->shape[3]; }
+  if (op.attr_bool("global_pooling", false)) {
+    kh = static_cast<int>(H); kw = static_cast<int>(W);
+    ph = pw = 0; sh = sw = 1;
+  }
+  int64_t OH = (H + 2 * ph - kh) / sh + 1;
+  int64_t OW = (W + 2 * pw - kw) / sw + 1;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = nhwc ? std::vector<int64_t>{N, OH, OW, C}
+                 : std::vector<int64_t>{N, C, OH, OW};
+  o.data.resize(static_cast<size_t>(N * OH * OW * C));
+  auto xat = [&](int64_t n, int64_t h, int64_t ww, int64_t c) -> float {
+    return nhwc ? x->data[static_cast<size_t>(((n * H + h) * W + ww) * C + c)]
+                : x->data[static_cast<size_t>(((n * C + c) * H + h) * W + ww)];
+  };
+  bool is_max = (ptype == "max");
+  // avg divisor: exclusive (default) counts only in-bounds cells; the
+  // non-exclusive mode divides border windows by the full kh*kw
+  // (ops/nn_ops.py pool2d).
+  bool exclusive = op.attr_bool("exclusive", true);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          float acc = is_max ? -INFINITY : 0.f;
+          int cnt = 0;
+          for (int fh = 0; fh < kh; ++fh)
+            for (int fw = 0; fw < kw; ++fw) {
+              int64_t ih = oh * sh - ph + fh;
+              int64_t iw = ow * sw - pw + fw;
+              if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+              float v = xat(n, ih, iw, c);
+              if (is_max) acc = std::max(acc, v);
+              else acc += v;
+              ++cnt;
+            }
+          if (!is_max) {
+            int div = exclusive ? cnt : kh * kw;
+            if (div > 0) acc /= static_cast<float>(div);
+          }
+          size_t oi = nhwc
+              ? static_cast<size_t>(((n * OH + oh) * OW + ow) * C + c)
+              : static_cast<size_t>(((n * C + c) * OH + oh) * OW + ow);
+          o.data[oi] = acc;
+        }
+  return true;
+}
+
+bool k_batch_norm(Machine& m, const OpDesc& op) {
+  Tensor *x, *scale, *bias, *mean, *var;
+  if (!need(m, op, "X", &x) || !need(m, op, "Scale", &scale) ||
+      !need(m, op, "Bias", &bias) || !need(m, op, "Mean", &mean) ||
+      !need(m, op, "Variance", &var))
+    return false;
+  std::string fmt = op.attr_str("data_layout", op.attr_str("data_format",
+                                                           "NCHW"));
+  double eps = op.attr_num("epsilon", 1e-5);
+  Tensor& o = set_out(m, op, "Y");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  int64_t C = mean->numel();
+  int64_t n = x->numel();
+  bool channels_last = (fmt != "NCHW") || x->shape.size() == 2;
+  int64_t inner = 1;
+  if (!channels_last)
+    for (size_t i = 2; i < x->shape.size(); ++i) inner *= x->shape[i];
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t c = channels_last ? (i % C) : ((i / inner) % C);
+    float inv = 1.0f / std::sqrt(var->data[static_cast<size_t>(c)] +
+                                 static_cast<float>(eps));
+    o.data[static_cast<size_t>(i)] =
+        (x->data[static_cast<size_t>(i)] - mean->data[static_cast<size_t>(c)]) * inv *
+            scale->data[static_cast<size_t>(c)] +
+        bias->data[static_cast<size_t>(c)];
+  }
+  return true;
+}
+
+bool k_reshape(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  const JValue* sh = op.attr("shape");
+  if (!sh || sh->type != JValue::kArr) {
+    m.error = "reshape: missing shape attr";
+    return false;
+  }
+  std::vector<int64_t> ns;
+  int64_t known = 1, minus1 = -1;
+  for (size_t i = 0; i < sh->arr.size(); ++i) {
+    int64_t d = static_cast<int64_t>(sh->arr[i].num);
+    if (d == 0) {  // reference: 0 copies the input dim
+      if (i >= x->shape.size()) {
+        m.error = "reshape: 0 at position " + std::to_string(i) +
+                  " exceeds input rank";
+        return false;
+      }
+      d = x->shape[i];
+    }
+    if (d == -1) minus1 = static_cast<int64_t>(i);
+    else known *= d;
+    ns.push_back(d);
+  }
+  if (minus1 >= 0) ns[static_cast<size_t>(minus1)] = x->numel() / known;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = ns;
+  o.data = x->data;
+  return true;
+}
+
+bool k_concat(Machine& m, const OpDesc& op) {
+  const auto& names = op.ins.at("X");
+  std::vector<Tensor*> xs;
+  for (const auto& nm : names) {
+    Tensor* t = lookup(m, nm);
+    if (!t) { m.error = "concat: missing input " + nm; return false; }
+    xs.push_back(t);
+  }
+  int axis = static_cast<int>(op.attr_num("axis", 0));
+  int rank = static_cast<int>(xs[0]->shape.size());
+  if (axis < 0) axis += rank;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = xs[0]->shape;
+  int64_t cat = 0;
+  for (auto* t : xs) cat += t->shape[static_cast<size_t>(axis)];
+  o.shape[static_cast<size_t>(axis)] = cat;
+  int64_t outer = 1, inner = 1;
+  for (int i = 0; i < axis; ++i) outer *= xs[0]->shape[static_cast<size_t>(i)];
+  for (int i = axis + 1; i < rank; ++i) inner *= xs[0]->shape[static_cast<size_t>(i)];
+  o.data.resize(static_cast<size_t>(outer * cat * inner));
+  int64_t off = 0;
+  for (auto* t : xs) {
+    int64_t tc = t->shape[static_cast<size_t>(axis)];
+    for (int64_t a = 0; a < outer; ++a)
+      memcpy(o.data.data() + (a * cat + off) * inner,
+             t->data.data() + a * tc * inner,
+             static_cast<size_t>(tc * inner) * sizeof(float));
+    off += tc;
+  }
+  return true;
+}
+
+bool k_scale(Machine& m, const OpDesc& op) {
+  float s = static_cast<float>(op.attr_num("scale", 1.0));
+  float b = static_cast<float>(op.attr_num("bias", 0.0));
+  return k_unary(m, op, [s, b](float v) { return s * v + b; });
+}
+
+bool k_dropout(Machine& m, const OpDesc& op) {
+  // inference path only (downscale-in-infer, ops/nn_ops.py dropout)
+  float p = static_cast<float>(op.attr_num("dropout_prob", 0.5));
+  if (!op.attr_bool("is_test", false)) {
+    m.error = "dropout: capi machine runs inference programs only "
+              "(is_test=false)";
+    return false;
+  }
+  return k_unary(m, op, [p](float v) { return v * (1.0f - p); });
+}
+
+bool k_mean(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.clear();  // rank-0
+  double acc = 0;
+  for (float v : x->data) acc += v;
+  o.data.assign(1, static_cast<float>(acc / std::max<int64_t>(x->numel(), 1)));
+  return true;
+}
+
+bool k_transpose(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  const JValue* ax = op.attr("axis");
+  if (!ax || ax->type != JValue::kArr) {
+    m.error = "transpose: missing axis attr";
+    return false;
+  }
+  int rank = static_cast<int>(x->shape.size());
+  std::vector<int> perm;
+  for (auto& v : ax->arr) perm.push_back(static_cast<int>(v.num));
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.resize(static_cast<size_t>(rank));
+  for (int i = 0; i < rank; ++i)
+    o.shape[static_cast<size_t>(i)] = x->shape[static_cast<size_t>(perm[static_cast<size_t>(i)])];
+  o.data.resize(x->data.size());
+  std::vector<int64_t> xstr(static_cast<size_t>(rank), 1), ostr(static_cast<size_t>(rank), 1);
+  for (int i = rank - 2; i >= 0; --i)
+    xstr[static_cast<size_t>(i)] = xstr[static_cast<size_t>(i + 1)] * x->shape[static_cast<size_t>(i + 1)];
+  for (int i = rank - 2; i >= 0; --i)
+    ostr[static_cast<size_t>(i)] = ostr[static_cast<size_t>(i + 1)] * o.shape[static_cast<size_t>(i + 1)];
+  int64_t n = x->numel();
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t rem = i, xi = 0;
+    for (int d = 0; d < rank; ++d) {
+      int64_t coord = rem / ostr[static_cast<size_t>(d)];
+      rem %= ostr[static_cast<size_t>(d)];
+      xi += coord * xstr[static_cast<size_t>(perm[static_cast<size_t>(d)])];
+    }
+    o.data[static_cast<size_t>(i)] = x->data[static_cast<size_t>(xi)];
+  }
+  return true;
+}
+
+bool k_assign(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor& o = set_out(m, op, "Out");
+  o = *x;
+  return true;
+}
+
+bool run_op(Machine& m, const OpDesc& op) {
+  const std::string& t = op.type;
+  if (t == "mul") return k_mul(m, op);
+  if (t == "elementwise_add")
+    return k_elementwise(m, op, [](float a, float b) { return a + b; });
+  if (t == "elementwise_sub")
+    return k_elementwise(m, op, [](float a, float b) { return a - b; });
+  if (t == "elementwise_mul")
+    return k_elementwise(m, op, [](float a, float b) { return a * b; });
+  if (t == "elementwise_div")
+    return k_elementwise(m, op, [](float a, float b) { return a / b; });
+  if (t == "relu") return k_unary(m, op, [](float v) { return v > 0 ? v : 0; });
+  if (t == "sigmoid")
+    return k_unary(m, op, [](float v) { return 1.f / (1.f + std::exp(-v)); });
+  if (t == "tanh") return k_unary(m, op, [](float v) { return std::tanh(v); });
+  if (t == "exp") return k_unary(m, op, [](float v) { return std::exp(v); });
+  if (t == "sqrt") return k_unary(m, op, [](float v) { return std::sqrt(v); });
+  if (t == "abs") return k_unary(m, op, [](float v) { return std::fabs(v); });
+  if (t == "square") return k_unary(m, op, [](float v) { return v * v; });
+  if (t == "softmax") return k_softmax(m, op);
+  if (t == "conv2d") return k_conv2d(m, op);
+  if (t == "pool2d") return k_pool2d(m, op);
+  if (t == "batch_norm") return k_batch_norm(m, op);
+  if (t == "reshape") return k_reshape(m, op);
+  if (t == "concat") return k_concat(m, op);
+  if (t == "scale") return k_scale(m, op);
+  if (t == "dropout") return k_dropout(m, op);
+  if (t == "mean") return k_mean(m, op);
+  if (t == "transpose") return k_transpose(m, op);
+  if (t == "assign") return k_assign(m, op);
+  m.error = "unsupported op in capi inference machine: '" + t +
+            "' (supported: mul, elementwise_*, relu/sigmoid/tanh/exp/sqrt/"
+            "abs/square, softmax, conv2d, pool2d, batch_norm, reshape, "
+            "concat, scale, dropout, mean, transpose, assign)";
+  return false;
+}
+
+// impl bodies (may throw on malformed models; the extern "C" wrappers
+// below convert that into g_last_error + failure codes)
+void* load_impl(const char* model_dir) {
+  auto m = std::make_unique<Machine>();
+  std::string dir(model_dir);
+  std::ifstream f(dir + "/__model__.json");
+  if (!f) {
+    g_last_error = "cannot open " + dir + "/__model__.json";
+    return nullptr;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string text = ss.str();
+  JValue root;
+  JParser parser(text);
+  if (!parser.parse(&root)) {
+    g_last_error = "json parse error: " + parser.err;
+    return nullptr;
+  }
+  for (auto& v : root.at("feed_names").arr) m->feeds.push_back(v.str);
+  for (auto& v : root.at("fetch_names").arr) m->fetches.push_back(v.str);
+  const JValue& block0 = root.at("program").at("blocks").arr.at(0);
+  for (auto& od : block0.at("ops").arr) {
+    OpDesc op;
+    op.type = od.at("type").str;
+    for (auto& kv : od.at("inputs").obj)
+      for (auto& n : kv.second.arr) op.ins[kv.first].push_back(n.str);
+    for (auto& kv : od.at("outputs").obj)
+      for (auto& n : kv.second.arr) op.outs[kv.first].push_back(n.str);
+    op.attrs = od.at("attrs");
+    m->ops.push_back(std::move(op));
+  }
+  // persistables ship as params/*.npy indexed by params/MANIFEST.json
+  // (io.py save_vars)
+  std::ifstream mf(dir + "/params/MANIFEST.json");
+  if (!mf) {
+    g_last_error = "cannot open " + dir + "/params/MANIFEST.json";
+    return nullptr;
+  }
+  std::stringstream ms;
+  ms << mf.rdbuf();
+  const std::string mtext = ms.str();
+  JValue manifest;
+  JParser mp(mtext);
+  if (!mp.parse(&manifest)) {
+    g_last_error = "manifest parse error: " + mp.err;
+    return nullptr;
+  }
+  for (auto& entry : manifest.arr) {
+    Tensor t;
+    std::string err;
+    if (!load_npy(dir + "/params/" + entry.at("file").str, &t, &err)) {
+      g_last_error = err;
+      return nullptr;
+    }
+    m->params[entry.at("name").str] = std::move(t);
+  }
+  return m.release();
+}
+
+int run_impl(Machine* m) {
+  // keep the feed values; drop stale intermediates from the previous run
+  std::map<std::string, Tensor> kept;
+  for (const auto& f : m->feeds) {
+    auto it = m->env.find(f);
+    if (it == m->env.end()) {
+      g_last_error = "pdtpu_run: input '" + f + "' not set";
+      return 1;
+    }
+    kept[f] = std::move(it->second);
+  }
+  m->env = std::move(kept);
+  for (size_t i = 0; i < m->ops.size(); ++i) {
+    if (!run_op(*m, m->ops[i])) {
+      g_last_error = "op #" + std::to_string(i) + ": " + m->error;
+      return 2;
+    }
+  }
+  return 0;
+}
+
+// No C++ exception may cross the C ABI (it would std::terminate the
+// embedding application): every exported body runs under this barrier,
+// converting throws (map::at on malformed models, bad_alloc on corrupt
+// npy headers) into g_last_error + the function's failure value.
+template <typename R, typename F>
+R guarded(R fail_value, F body) {
+  try {
+    return body();
+  } catch (const std::exception& e) {
+    g_last_error = std::string("internal error: ") + e.what();
+    return fail_value;
+  } catch (...) {
+    g_last_error = "internal error (unknown exception)";
+    return fail_value;
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------
+extern "C" {
+
+const char* pdtpu_last_error() { return g_last_error.c_str(); }
+
+void* pdtpu_load(const char* model_dir) {
+  return guarded<void*>(nullptr,
+                        [&]() -> void* { return load_impl(model_dir); });
+}
+
+void pdtpu_free(void* h) { delete static_cast<Machine*>(h); }
+
+int pdtpu_num_feeds(void* h) {
+  return static_cast<int>(static_cast<Machine*>(h)->feeds.size());
+}
+const char* pdtpu_feed_name(void* h, int i) {
+  return guarded<const char*>("", [&] {
+    return static_cast<Machine*>(h)->feeds.at(static_cast<size_t>(i)).c_str();
+  });
+}
+int pdtpu_num_fetches(void* h) {
+  return static_cast<int>(static_cast<Machine*>(h)->fetches.size());
+}
+const char* pdtpu_fetch_name(void* h, int i) {
+  return guarded<const char*>("", [&] {
+    return static_cast<Machine*>(h)->fetches.at(static_cast<size_t>(i)).c_str();
+  });
+}
+
+int pdtpu_set_input(void* h, const char* name, const float* data,
+                    const int64_t* shape, int rank) {
+  return guarded<int>(3, [&] {
+    Machine* m = static_cast<Machine*>(h);
+    Tensor t;
+    t.shape.assign(shape, shape + rank);
+    t.data.assign(data, data + t.numel());
+    m->env[name] = std::move(t);
+    return 0;
+  });
+}
+
+int pdtpu_run(void* h) {
+  return guarded<int>(3, [&] { return run_impl(static_cast<Machine*>(h)); });
+}
+
+int pdtpu_output_rank(void* h, const char* name) {
+  return guarded<int>(-1, [&]() -> int {
+    Machine* m = static_cast<Machine*>(h);
+    Tensor* t = lookup(*m, name);
+    if (!t) { g_last_error = std::string("no output ") + name; return -1; }
+    return static_cast<int>(t->shape.size());
+  });
+}
+
+int pdtpu_output_shape(void* h, const char* name, int64_t* out) {
+  return guarded<int>(3, [&]() -> int {
+    Machine* m = static_cast<Machine*>(h);
+    Tensor* t = lookup(*m, name);
+    if (!t) { g_last_error = std::string("no output ") + name; return 1; }
+    for (size_t i = 0; i < t->shape.size(); ++i) out[i] = t->shape[i];
+    return 0;
+  });
+}
+
+int64_t pdtpu_output_numel(void* h, const char* name) {
+  return guarded<int64_t>(-1, [&]() -> int64_t {
+    Machine* m = static_cast<Machine*>(h);
+    Tensor* t = lookup(*m, name);
+    if (!t) { g_last_error = std::string("no output ") + name; return -1; }
+    return t->numel();
+  });
+}
+
+int pdtpu_output_data(void* h, const char* name, float* buf, int64_t cap) {
+  return guarded<int>(3, [&]() -> int {
+    Machine* m = static_cast<Machine*>(h);
+    Tensor* t = lookup(*m, name);
+    if (!t) { g_last_error = std::string("no output ") + name; return 1; }
+    if (cap < t->numel()) { g_last_error = "buffer too small"; return 2; }
+    memcpy(buf, t->data.data(),
+           static_cast<size_t>(t->numel()) * sizeof(float));
+    return 0;
+  });
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+"""C inference ABI tests: the native machine (native/capi.cc) must
+reproduce the executor's outputs on saved inference models
+(reference /root/reference/paddle/capi/tests/test_GradientMachine.cpp and
+capi/examples/model_inference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+import shutil
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def _save_model(tmp_path, build):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        feeds, targets = build()
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, [f.name for f in feeds], targets, exe,
+                               main_program=main, scope=scope)
+    return d, main, scope, exe, feeds, targets
+
+
+class TestCapiLenet:
+    def test_matches_executor(self, tmp_path):
+        def build():
+            img = layers.data("img", shape=[28, 28, 1])
+            logits = models.lenet5(img)
+            return [img], [layers.softmax(logits)]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        x = np.random.RandomState(0).rand(3, 28, 28, 1).astype(np.float32)
+        ref, = exe.run(main, feed={"img": x}, fetch_list=targets,
+                       scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            assert machine.feed_names == ["img"]
+            got, = machine.run({"img": x})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+
+class TestCapiMlp:
+    def test_bn_dropout_concat_path(self, tmp_path):
+        def build():
+            x = layers.data("x", shape=[8])
+            h1 = layers.fc(x, size=16, act="relu")
+            h1 = layers.batch_norm(h1, is_test=True)
+            h1 = layers.dropout(h1, dropout_prob=0.3, is_test=True)
+            h2 = layers.fc(x, size=16, act="tanh")
+            h = layers.concat([h1, h2], axis=1)
+            out = layers.fc(h, size=4)
+            return [x], [layers.softmax(out)]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        x = np.random.RandomState(1).randn(5, 8).astype(np.float32)
+        ref, = exe.run(main, feed={"x": x}, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run({"x": x})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+    def test_multiple_outputs_and_reruns(self, tmp_path):
+        def build():
+            x = layers.data("x", shape=[4])
+            a = layers.fc(x, size=3, act="sigmoid")
+            b = layers.scale(a, scale=2.0)
+            return [x], [a, b]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        from paddle_tpu.capi import InferenceMachine
+
+        machine = InferenceMachine(d)
+        for seed in (0, 1):
+            x = np.random.RandomState(seed).randn(2, 4).astype(np.float32)
+            ref = exe.run(main, feed={"x": x}, fetch_list=targets,
+                          scope=scope)
+            got = machine.run({"x": x})
+            for g, r in zip(got, ref):
+                np.testing.assert_allclose(g, np.asarray(r), rtol=2e-3,
+                                           atol=1e-5)
+        machine.close()
+
+
+class TestCapiErrors:
+    def test_missing_dir(self):
+        from paddle_tpu.capi import InferenceMachine
+
+        with pytest.raises(RuntimeError, match="__model__"):
+            InferenceMachine("/nonexistent/model/dir")
+
+    def test_missing_input(self, tmp_path):
+        def build():
+            x = layers.data("x", shape=[4])
+            return [x], [layers.fc(x, size=2)]
+
+        d, *_ = _save_model(tmp_path, build)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            with pytest.raises(RuntimeError, match="not set"):
+                machine.run({})
