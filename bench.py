@@ -55,6 +55,58 @@ def _peak_flops(device_kind):
     return None
 
 
+LSTM_BASELINE_MS = 184.0  # 2xLSTM text classification, bs64 hidden512,
+#                           1x K40m (/root/reference/benchmark/README.md:119)
+
+
+def bench_lstm_step(jax, pt, layers):
+    """Secondary metric: stacked-LSTM text-classification train step
+    (reference benchmark/paddle/rnn/rnn.py config: bs64, hidden 512),
+    ms/batch. Exercises the scan-based recurrent path the way the
+    reference's RNN benchmark exercises its fused CUDA cells."""
+    import numpy as np
+
+    batch, seqlen, hidden, vocab = 64, 100, 512, 10000
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        words = layers.data("words", shape=[seqlen], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, hidden])
+        # dynamic_lstm takes the pre-projected [b, T, 4*hidden] input
+        # (reference rnn.py: fc + lstmemory per layer)
+        x1 = layers.fc(emb, size=4 * hidden, num_flatten_dims=2,
+                       bias_attr=False)
+        h1, _ = layers.dynamic_lstm(x1, 4 * hidden)
+        x2 = layers.fc(h1, size=4 * hidden, num_flatten_dims=2,
+                       bias_attr=False)
+        h2, _ = layers.dynamic_lstm(x2, 4 * hidden)
+        pooled = layers.sequence_pool(h2, "max")
+        logits = layers.fc(pooled, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {
+        "words": jax.device_put(
+            rng.randint(0, vocab, size=(batch, seqlen)).astype("int64")),
+        "label": jax.device_put(
+            rng.randint(0, 2, size=(batch, 1)).astype("int64")),
+    }
+    for _ in range(3):
+        exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                       scope=scope, return_numpy=False)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
 def run_bench(platform):
     """Child-mode entry: run the measurement and print the JSON line."""
     import jax
@@ -119,6 +171,7 @@ def run_bench(platform):
     flops_per_img = RESNET50_TRAIN_FLOPS_224 * (hw / 224.0) ** 2
     achieved_flops = img_per_sec * flops_per_img
     peak = _peak_flops(dev.device_kind) if on_tpu else None
+    lstm_ms = bench_lstm_step(jax, pt, layers) if on_tpu else None
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -133,6 +186,12 @@ def run_bench(platform):
             "mfu": round(achieved_flops / peak, 4) if peak else None,
             "baseline": "84.08 img/s ResNet-50 train, "
                         "IntelOptimizedPaddle.md:43-45",
+            "lstm_ms_per_batch": (round(lstm_ms, 2)
+                                  if lstm_ms is not None else None),
+            "lstm_vs_baseline": (round(LSTM_BASELINE_MS / lstm_ms, 2)
+                                 if lstm_ms else None),
+            "lstm_baseline": "184 ms/batch 2xLSTM bs64 hidden512, "
+                             "benchmark/README.md:119",
         },
     }), flush=True)
 
