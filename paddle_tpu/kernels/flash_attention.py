@@ -10,9 +10,13 @@ max/denominator of the softmax (the standard online-softmax recurrence), so
 HBM traffic is O(T*d) instead of O(T^2).
 
 On non-TPU backends (the CPU test mesh) ``flash_attention`` falls back to a
-pure-jnp reference — same semantics, XLA-fused. The backward pass always
-uses the recompute-based jnp formulation via ``jax.custom_vjp``: XLA fuses
-it well, and it keeps the Pallas surface forward-only.
+pure-jnp reference — same semantics, XLA-fused — for both passes. On TPU
+the BACKWARD is also Pallas (``_flash_dq_kernel`` / ``_flash_dkv_kernel``):
+p-tiles are recomputed from the forward's saved logsumexp per block, so the
+backward's HBM traffic stays O(T*d) like the forward's. (The earlier
+jnp-recompute backward materialised the [T, T] probabilities and made
+transformer training HBM-bound — 180 GB/step at d1024/L8/T2048 — see
+PERF.md.)
 """
 from __future__ import annotations
 
@@ -22,8 +26,11 @@ import math
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Large blocks amortise the per-iteration VPU work (masking, exp, online
+# rescale) over more MXU work — the d=64 head dim makes the matmuls thin,
+# so the block sizes carry the efficiency.
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
 
 
 def _pick_block(t, preferred):
@@ -52,13 +59,13 @@ def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
-                  sm_scale, kv_len):
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                  causal, sm_scale, kv_len):
     from jax.experimental import pallas as pl
 
     qb = pl.program_id(1)
     block_q, d = q_ref.shape[1], q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
+    q = q_ref[0]  # [bq, d] — native dtype (bf16 under AMP): MXU-fast dots
     # lengths arrive via scalar prefetch (rank-1 SMEM blocks of size 1 do
     # not lower on Mosaic); index by the batch*head grid position
     length = len_ref[pl.program_id(0)]
@@ -83,9 +90,8 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
-            q, k.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < length
@@ -101,13 +107,17 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
         alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v.astype(jnp.float32),
+            p.astype(v.dtype), v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, ub, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp residual for the flash backward; fully-masked rows get +inf
+    # so exp(s - lse) is exactly 0 for them in the backward recompute.
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), jnp.inf)
+    lse_ref[0, 0] = lse[:, 0]
 
 
 def _flash_forward(q, k, v, lengths, causal, sm_scale, block_q, block_k,
@@ -141,33 +151,221 @@ def _flash_forward(q, k, v, lengths, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D),
-                               lambda b, i, lens: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, lens: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, lens: (b, 0, i)),
+        ],
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, 1, Tq), jnp.float32)],
         interpret=interpret,
     )(lens_bh, q3, k3, v3)
-    return out.reshape(B, H, Tq, D)
+    return out.reshape(B, H, Tq, D), lse
+
+
+def _flash_dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                     dq_ref, *, block_k, causal, sm_scale, kv_len):
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(1)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0]                              # [bq, d] native dtype
+    do = do_ref[0]                            # [bq, d]
+    lse = lse_ref[0, 0][:, None]              # [bq, 1]
+    dd = dd_ref[0, 0][:, None]                # [bq, 1] rowsum(dO * O)
+    length = len_ref[pl.program_id(0)]
+
+    n_blocks = kv_len // block_k
+    if causal:
+        last = (qb + 1) * block_q
+        ub = jnp.minimum(n_blocks, (last + block_k - 1) // block_k)
+    else:
+        ub = n_blocks
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < length
+        if causal:
+            mask &= q_pos >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dd)
+        return acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    acc = jax.lax.fori_loop(
+        0, ub, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                      dk_ref, dv_ref, *, block_q, causal, sm_scale, q_len):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    k = k_ref[0]                              # [bk, d] native dtype
+    v = v_ref[0]                              # [bk, d]
+    length = len_ref[pl.program_id(0)]
+
+    n_blocks = q_len // block_q
+    lb = (kb * block_k) // block_q if causal else 0
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        dd = dd_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        mask = k_pos < length
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask &= q_pos >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)          # [bq, bk]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, bk]
+        ds = p * (dp - dd)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(lb, n_blocks, body, (z, z))
+    dk_ref[0] = (dk_acc * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, lengths, g, causal, sm_scale, block_q,
+                    block_k, interpret):
+    """Blockwise flash backward: recomputes p tiles from the saved
+    logsumexp instead of materialising [T, T] — HBM stays O(T*d), matching
+    the forward's memory story (the whole point of the kernel)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    BH = B * H
+    q3, k3, v3 = (t.reshape(BH, -1, D) for t in (q, k, v))
+    do3 = g.reshape(BH, Tq, D)
+    # D_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA
+    dd = jnp.sum(do3.astype(jnp.float32)
+                 * o.reshape(BH, Tq, D).astype(jnp.float32),
+                 axis=-1)[:, None, :]          # [BH, 1, Tq]
+    if lengths is None:
+        lens = jnp.full((B,), Tk, jnp.int32)
+    else:
+        lens = lengths.astype(jnp.int32)
+    lens_bh = jnp.repeat(lens, H)
+
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
+
+    dq_kernel = functools.partial(_flash_dq_kernel, block_k=bk,
+                                  causal=causal, sm_scale=sm_scale,
+                                  kv_len=Tk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, Tq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, lens: (b, i, 0)),
+                pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
+                pl.BlockSpec((1, Tk, D), lambda b, i, lens: (b, 0, 0)),
+                pl.BlockSpec((1, bq, D), lambda b, i, lens: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq), lambda b, i, lens: (b, 0, i)),
+                pl.BlockSpec((1, 1, bq), lambda b, i, lens: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, D), lambda b, i, lens: (b, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        interpret=interpret,
+    )(lens_bh, q3, k3, v3, do3, lse, dd)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=bq,
+                                   causal=causal, sm_scale=sm_scale,
+                                   q_len=Tq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, Tk // bk),
+            in_specs=[
+                pl.BlockSpec((1, Tq, D), lambda b, j, lens: (b, 0, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j, lens: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j, lens: (b, j, 0)),
+                pl.BlockSpec((1, Tq, D), lambda b, j, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, Tq), lambda b, j, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, Tq), lambda b, j, lens: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, D), lambda b, j, lens: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j, lens: (b, j, 0)),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, D), v.dtype)],
+        interpret=interpret,
+    )(lens_bh, q3, k3, v3, do3, lse, dd)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _attention(q, k, v, lengths, causal, sm_scale):
     if jax.default_backend() == "tpu":
-        return _flash_forward(q, k, v, lengths, causal, sm_scale,
-                              DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                              interpret=False)
+        out, _ = _flash_forward(q, k, v, lengths, causal, sm_scale,
+                                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                interpret=False)
+        return out
     return reference_attention(q, k, v, lengths, causal, sm_scale)
 
 
 def _attention_fwd(q, k, v, lengths, causal, sm_scale):
-    return _attention(q, k, v, lengths, causal, sm_scale), (q, k, v, lengths)
+    if jax.default_backend() == "tpu":
+        out, lse = _flash_forward(q, k, v, lengths, causal, sm_scale,
+                                  DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                  interpret=False)
+        return out, (q, k, v, out, lse, lengths)
+    return (reference_attention(q, k, v, lengths, causal, sm_scale),
+            (q, k, v, None, None, lengths))
 
 
 def _attention_bwd(causal, sm_scale, res, g):
-    q, k, v, lengths = res
+    q, k, v, o, lse, lengths = res
+    if lse is not None:
+        dq, dk, dv = _flash_backward(q, k, v, o, lse, lengths, g, causal,
+                                     sm_scale, DEFAULT_BLOCK_Q,
+                                     DEFAULT_BLOCK_K, interpret=False)
+        return dq, dk, dv, None
 
     def f(q, k, v):
         return reference_attention(q, k, v, lengths, causal, sm_scale)

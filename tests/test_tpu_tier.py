@@ -27,6 +27,7 @@ CHECK_NAMES = [
     "amp_conv_numerics",
     "executor_donation_reuses_buffers",
     "flash_attention_matches_reference",
+    "flash_attention_backward_matches_reference",
     "lenet_train_step_converges",
     "async_dispatch_overlaps",
     "profiler_reports_device_time",
