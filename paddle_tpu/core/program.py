@@ -259,8 +259,14 @@ class Program:
     def all_parameters(self) -> List[Parameter]:
         return [v for b in self.blocks for v in b.vars.values() if isinstance(v, Parameter)]
 
-    def clone(self) -> "Program":
-        """Deep-ish copy (vars and ops re-created; attrs shallow-copied)."""
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-ish copy (vars and ops re-created; attrs shallow-copied).
+
+        ``for_test=True`` flips every op's ``is_test`` attr to True (the
+        reference's Program.clone(for_test=True) / inference_optimize):
+        dropout becomes deterministic scaling and batch_norm reads its
+        running stats instead of batch stats.
+        """
         p = Program()
         p.random_seed = self.random_seed
         p.blocks = []
@@ -273,7 +279,11 @@ class Program:
                 nv.block = nb
                 nb.vars[name] = nv
             for op in b.ops:
-                nb.ops.append(Operator(nb, op.type, op.inputs, op.outputs, dict(op.attrs)))
+                attrs = dict(op.attrs)
+                if for_test and "is_test" in attrs:
+                    attrs["is_test"] = True
+                nb.ops.append(Operator(nb, op.type, op.inputs, op.outputs,
+                                       attrs))
             p.blocks.append(nb)
         p.current_block_idx = self.current_block_idx
         return p

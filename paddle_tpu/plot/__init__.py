@@ -1,0 +1,63 @@
+"""Training-curve plotting, v2 Ploter parity
+(/root/reference/python/paddle/v2/plot/ploter.py).
+
+The reference draws matplotlib curves in notebooks; here ``Ploter``
+accumulates (step, value) series per title and renders either a PNG (when
+matplotlib is importable and a path is given) or a terminal summary —
+training scripts call the same append/plot API either way.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class Ploter:
+    def __init__(self, *titles: str):
+        self.titles = list(titles)
+        self._data: Dict[str, List[Tuple[float, float]]] = {
+            t: [] for t in titles}
+
+    def append(self, title: str, step: float, value: float) -> None:
+        if title not in self._data:
+            raise KeyError(f"unknown series {title!r}; declared: "
+                           f"{self.titles}")
+        self._data[title].append((float(step), float(value)))
+
+    def reset(self) -> None:
+        for t in self._data:
+            self._data[t] = []
+
+    def series(self, title: str) -> List[Tuple[float, float]]:
+        return list(self._data[title])
+
+    def plot(self, path: Optional[str] = None) -> Optional[str]:
+        """Write a PNG to ``path`` (matplotlib), else return a terminal
+        summary string (also returned alongside the PNG)."""
+        if path is not None:
+            try:
+                import matplotlib
+                matplotlib.use("Agg")
+                import matplotlib.pyplot as plt
+
+                fig, ax = plt.subplots(figsize=(7, 4))
+                for t in self.titles:
+                    if self._data[t]:
+                        xs, ys = zip(*self._data[t])
+                        ax.plot(xs, ys, label=t)
+                ax.set_xlabel("step")
+                ax.legend()
+                fig.tight_layout()
+                fig.savefig(path)
+                plt.close(fig)
+            except ImportError:
+                path = None  # fall through to the text summary
+        parts = []
+        for t in self.titles:
+            pts = self._data[t]
+            if not pts:
+                parts.append(f"{t}: (empty)")
+                continue
+            ys = [y for _, y in pts]
+            parts.append(f"{t}: n={len(ys)} last={ys[-1]:.6g} "
+                         f"min={min(ys):.6g} max={max(ys):.6g}")
+        return " | ".join(parts)

@@ -41,9 +41,9 @@ class SGD:
         self.metrics = dict(metrics or {})
         self.main_program: Program = cost.block.program
         self.startup_program = default_startup_program()
-        # Inference/test clone is taken BEFORE optimizer ops are appended, the
-        # equivalent of fluid's Program.clone(for_test=True).
-        self.test_program = self.main_program.clone()
+        # Inference/test clone is taken BEFORE optimizer ops are appended
+        # and flips is_test (fluid's Program.clone(for_test=True)).
+        self.test_program = self.main_program.clone(for_test=True)
         optimizer.minimize(cost, startup_program=self.startup_program)
         self.feeder = DataFeeder(feed_list)
         self.scope = scope or global_scope()

@@ -1,0 +1,71 @@
+"""The v2 user API, served by the TPU engine.
+
+The reference's paddle.v2 surface (/root/reference/python/paddle/v2:
+layer.py, activation.py, pooling.py, attr.py, parameters.py, trainer.py,
+event.py, reader/, dataset/, minibatch.py) drove the legacy gserver engine
+through SWIG; here the SAME user-facing shapes build fluid-style programs
+and run through the XLA executor — the architecture stance SURVEY.md §7
+prescribes ("the v2 user API can be served by a Fluid-style engine").
+
+Usage mirrors the reference's book examples::
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(trainer_count=1)
+    images = paddle.layer.data("pixel",
+                               paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    h = paddle.layer.fc(input=images, size=128,
+                        act=paddle.activation.Relu())
+    cost = paddle.layer.classification_cost(
+        input=paddle.layer.fc(input=h, size=10,
+                              act=paddle.activation.Softmax()),
+        label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.01))
+    trainer.train(paddle.batch(reader, 64), num_passes=2,
+                  event_handler=handler)
+"""
+from __future__ import annotations
+
+from .. import dataset, event  # noqa: F401  (reference re-exports)
+from ..reader import decorator as reader  # noqa: F401
+from ..reader.minibatch import batch  # noqa: F401
+from . import activation, attr, data_type, layer, networks, optimizer, \
+    parameters, pooling, trainer  # noqa: F401
+
+__all__ = ["init", "infer", "batch", "reader", "dataset", "event", "layer",
+           "activation", "pooling", "attr", "data_type", "optimizer",
+           "parameters", "trainer", "networks"]
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1, seed: int = None,
+         **kwargs) -> None:
+    """paddle.init analogue: device/trainer knobs become flags. use_gpu is
+    accepted-and-ignored (the device is the TPU/XLA backend)."""
+    from ..flags import FLAGS
+
+    if seed is not None:
+        FLAGS.seed = int(seed)
+    del use_gpu, trainer_count, kwargs  # topology comes from the mesh
+
+
+def infer(output_layer, parameters, input, feeding=None):
+    """paddle.infer analogue: run the inference clone of output_layer's
+    program over ``input`` rows; returns the stacked outputs."""
+    import numpy as np
+
+    from ..data_feeder import DataFeeder
+
+    parameters.init()
+    prog = parameters.test_program_for(output_layer)
+    consumed = {n for op in prog.global_block.ops
+                for names in op.inputs.values() for n in names}
+    feed_vars = [v for v in parameters.data_vars(feeding, program=prog)
+                 if v.name in consumed]
+    feeder = DataFeeder(feed_vars)
+    out, = parameters.executor.run(
+        prog, feed=feeder.feed(input), fetch_list=[output_layer],
+        scope=parameters.scope)
+    return np.asarray(out)

@@ -1,0 +1,39 @@
+"""v2 activation objects (reference python/paddle/v2/activation.py →
+trainer_config_helpers/activations.py). Each carries the fluid-style act
+name the layer builders understand."""
+
+
+class BaseActivation:
+    name: str = ""
+
+    def __repr__(self):
+        return f"activation.{type(self).__name__}()"
+
+
+def _make(cls_name, act_name):
+    t = type(cls_name, (BaseActivation,), {"name": act_name})
+    return t
+
+
+Linear = _make("Linear", "")
+Relu = _make("Relu", "relu")
+BRelu = _make("BRelu", "brelu")
+SoftRelu = _make("SoftRelu", "softplus")
+Tanh = _make("Tanh", "tanh")
+STanh = _make("STanh", "stanh")
+Sigmoid = _make("Sigmoid", "sigmoid")
+Softmax = _make("Softmax", "softmax")
+Exp = _make("Exp", "exp")
+Log = _make("Log", "log")
+Abs = _make("Abs", "abs")
+Square = _make("Square", "square")
+SequenceSoftmax = _make("SequenceSoftmax", "softmax")
+
+
+def resolve(act):
+    """None | BaseActivation | str -> fluid act name (or None)."""
+    if act is None:
+        return None
+    if isinstance(act, str):
+        return act or None
+    return act.name or None

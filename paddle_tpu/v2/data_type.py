@@ -1,0 +1,36 @@
+"""v2 data-type declarations (reference v2/data_type.py →
+trainer/PyDataProvider2.py InputType): each describes one feed slot; the
+layer.data builder turns them into typed data variables."""
+
+
+class InputType:
+    def __init__(self, dim, seq_type, dtype):
+        self.dim = dim
+        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        self.dtype = dtype
+
+
+def dense_vector(dim):
+    return InputType(dim, 0, "float32")
+
+
+def dense_array(dim):
+    return InputType(dim, 0, "float32")
+
+
+def integer_value(value_range):
+    return InputType(value_range, 0, "int64")
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, 1, "float32")
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, 1, "int64")
+
+
+def sparse_binary_vector(dim):
+    # served densely (multi-hot rows); the SelectedRows path handles true
+    # sparsity at the embedding level
+    return InputType(dim, 0, "float32")

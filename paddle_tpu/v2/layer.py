@@ -1,0 +1,169 @@
+"""v2 layer namespace (reference python/paddle/v2/layer.py re-exporting
+trainer_config_helpers/layers.py): keyword-style builders (input=, size=,
+act=activation.Relu()) over the fluid-style layers package. Each function
+documents the v1 DSL name it serves."""
+from __future__ import annotations
+
+from .. import layers as L
+from . import activation as _act
+from . import pooling as _pool
+from .data_type import InputType
+
+
+def data(name, type: InputType, **kw):
+    """data_layer. ``type`` is a data_type.* declaration; sequence types
+    become padded+length feeds (lod_level=1). For integer types the dim is
+    the VALUE RANGE (vocab/class count) — the tensor itself is one id per
+    (sequence) position, exactly the reference's InputType contract."""
+    width = 1 if type.dtype == "int64" else type.dim
+    return L.data(name, shape=[width], dtype=type.dtype,
+                  lod_level=1 if type.seq_type else 0)
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
+    """fc_layer. ``input`` may be a list (each gets its own weight)."""
+    return L.fc(input, size=size, act=_act.resolve(act),
+                param_attr=param_attr, bias_attr=bias_attr)
+
+
+def embedding(input, size, param_attr=None, **kw):
+    """embedding_layer: size is the embedding dim."""
+    vocab = kw.get("vocab_size")
+    if vocab is None:
+        raise ValueError(
+            "embedding(input, size, vocab_size=...) — the v1 DSL reads the "
+            "vocab from the data layer's dim; pass it explicitly here")
+    return L.embedding(input, size=[vocab, size], param_attr=param_attr)
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, groups=1, act=None, param_attr=None, bias_attr=None,
+             data_format="NHWC", **kw):
+    """img_conv_layer."""
+    return L.conv2d(input, num_filters=num_filters, filter_size=filter_size,
+                    stride=stride, padding=padding, groups=groups,
+                    act=_act.resolve(act), param_attr=param_attr,
+                    bias_attr=bias_attr, data_format=data_format)
+
+
+def img_pool(input, pool_size, stride=1, padding=0, pool_type=None,
+             data_format="NHWC", **kw):
+    """img_pool_layer."""
+    return L.pool2d(input, pool_size=pool_size, pool_stride=stride,
+                    pool_padding=padding,
+                    pool_type=_pool.resolve(pool_type),
+                    data_format=data_format)
+
+
+def batch_norm(input, act=None, **kw):
+    """batch_norm_layer."""
+    return L.batch_norm(input, act=_act.resolve(act),
+                        data_layout=kw.get("data_format", "NHWC"),
+                        is_test=kw.get("is_test", False))
+
+
+def dropout(input, dropout_rate=0.5, **kw):
+    """dropout_layer."""
+    return L.dropout(input, dropout_prob=dropout_rate)
+
+
+def concat(input, **kw):
+    """concat_layer (feature axis)."""
+    return L.concat(list(input), axis=-1)
+
+
+def addto(input, act=None, bias_attr=None, **kw):
+    """addto_layer."""
+    return L.addto(list(input), act=_act.resolve(act))
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kw):
+    """lstmemory: input must be the 4x-projected sequence, as in the v1
+    DSL (pair with fc(size=4*hidden, act=Linear()) or use
+    networks.simple_lstm). ``size`` is the HIDDEN width (projected/4)."""
+    proj = int(input.shape[-1])
+    if size is not None and proj != 4 * size:
+        raise ValueError(
+            f"lstmemory(size={size}) expects a {4 * size}-wide projected "
+            f"input, got {proj} (v1 DSL contract)")
+    h, _ = L.dynamic_lstm(input, proj, is_reverse=reverse)
+    return h
+
+
+def grumemory(input, size=None, reverse=False, **kw):
+    """grumemory: input is the 3x-projected sequence."""
+    if size is None:
+        size = int(input.shape[-1]) // 3
+    return L.dynamic_gru(input, size, is_reverse=reverse)
+
+
+def pooling(input, pooling_type=None, **kw):
+    """pooling_layer over the sequence axis."""
+    return L.sequence_pool(input, _pool.resolve(pooling_type))
+
+
+def first_seq(input, **kw):
+    return L.sequence_first_step(input)
+
+
+def last_seq(input, **kw):
+    return L.sequence_last_step(input)
+
+
+def expand(input, expand_as, **kw):
+    """expand_layer."""
+    return L.sequence_expand(input, expand_as)
+
+
+def max_id(input, **kw):
+    """maxid_layer."""
+    return L.argmax(input, axis=-1)
+
+
+def crf(input, label, size=None, param_attr=None, **kw):
+    """crf_layer: returns the per-sequence negative log-likelihood."""
+    ll, _, _ = L.linear_chain_crf(input, label, param_attr=param_attr)
+    return ll
+
+
+def crf_decoding(input, size=None, param_attr=None, label=None, **kw):
+    """crf_decoding_layer."""
+    return L.crf_decoding(input, param_attr=param_attr, label=label)
+
+
+def ctc(input, label, blank=0, **kw):
+    """ctc_layer / warp_ctc_layer."""
+    return L.warpctc(input, label, blank=blank)
+
+
+# ---- cost layers (CostLayer.cpp family) --------------------------------
+def classification_cost(input, label, **kw):
+    """classification_cost: softmax cross-entropy over class scores."""
+    return L.mean(L.softmax_with_cross_entropy(input, label))
+
+
+def cross_entropy_cost(input, label, **kw):
+    return L.mean(L.cross_entropy(input, label))
+
+
+def square_error_cost(input, label, **kw):
+    """regression_cost."""
+    return L.mean(L.square_error_cost(input, label))
+
+
+def rank_cost(left, right, label, **kw):
+    """rank_cost (RankingCost): pairwise logistic loss."""
+    diff = L.elementwise_sub(left, right)
+    return L.mean(L.log(L.elementwise_add(
+        L.exp(L.elementwise_mul(L.scale(label, -2.0, bias=1.0), diff)),
+        L.fill_constant(shape=[1], value=1.0, dtype="float32"))))
+
+
+def huber_regression_cost(input, label, delta=1.0, **kw):
+    """huber_regression_cost (HuberRegressionLoss, CostLayer.cpp)."""
+    from ..layers.layer_helper import LayerHelper
+
+    h = LayerHelper("huber_cost")
+    outs, _ = h.append_op("huber_loss", {"X": [input], "Y": [label]},
+                          ["Out", "Residual"], {"delta": float(delta)})
+    return L.mean(outs["Out"][0])

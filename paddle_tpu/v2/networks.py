@@ -1,0 +1,45 @@
+"""v2 network composites (reference trainer_config_helpers/networks.py via
+v2): the load-bearing recipes built from the layer namespace."""
+from __future__ import annotations
+
+from .. import layers as L
+from . import activation as _act
+from . import layer as l2
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride=None, act=None, data_format="NHWC",
+                         **kw):
+    conv = l2.img_conv(input, filter_size=filter_size,
+                       num_filters=num_filters, act=act,
+                       padding=(filter_size - 1) // 2,
+                       data_format=data_format)
+    return l2.img_pool(conv, pool_size=pool_size,
+                       stride=pool_stride or pool_size,
+                       data_format=data_format)
+
+
+def simple_lstm(input, size, reverse=False, **kw):
+    """fc(4*size) + lstmemory — the v1 simple_lstm recipe."""
+    proj = L.fc(input, size=4 * size, num_flatten_dims=2, bias_attr=False)
+    return l2.lstmemory(proj, size=size, reverse=reverse)
+
+
+def bidirectional_lstm(input, size, return_concat=True, **kw):
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_concat:
+        return L.concat([fwd, bwd], axis=-1)
+    return fwd, bwd
+
+
+def simple_gru(input, size, reverse=False, **kw):
+    proj = L.fc(input, size=3 * size, num_flatten_dims=2, bias_attr=False)
+    return l2.grumemory(proj, size=size, reverse=reverse)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, pool_type=None,
+                       **kw):
+    conv = L.sequence_conv(input, num_filters=hidden_size,
+                           filter_size=context_len, act="relu")
+    return l2.pooling(conv, pooling_type=pool_type or "max")

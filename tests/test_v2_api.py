@@ -1,0 +1,159 @@
+"""v2 user-API facade tests: the reference's paddle.v2 programming model
+(init / layer / parameters.create / trainer.SGD / infer — reference
+python/paddle/v2, v1_api_demo/mnist/api_train.py) served by the XLA
+engine."""
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def _make_reader(rng, W, n=6, bs=16):
+    def reader():
+        for _ in range(n):
+            xb = rng.randn(bs, 8).astype(np.float32)
+            yb = np.argmax(xb @ W, axis=1).astype(np.int64)
+            yield [(x, int(y)) for x, y in zip(xb, yb)]
+    return reader
+
+
+class TestV2EndToEnd:
+    def test_train_test_infer_cycle(self):
+        paddle.init(use_gpu=False, trainer_count=1, seed=7)
+        images = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        label = paddle.layer.data("y", paddle.data_type.integer_value(3))
+        h = paddle.layer.fc(input=images, size=24,
+                            act=paddle.activation.Relu())
+        logits = paddle.layer.fc(input=h, size=3)
+        cost = paddle.layer.classification_cost(input=logits, label=label)
+
+        parameters = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 3)
+        seen = {"costs": [], "passes": 0}
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                seen["costs"].append(e.cost)
+            elif isinstance(e, paddle.event.EndPass):
+                seen["passes"] += 1
+
+        trainer.train(_make_reader(rng, W, n=8), num_passes=10,
+                      event_handler=handler)
+        assert seen["passes"] == 10
+        assert seen["costs"][-1] < 0.5 * seen["costs"][0], (
+            seen["costs"][0], seen["costs"][-1])
+
+        result = trainer.test(_make_reader(rng, W, n=2))
+        assert result.cost < 0.8 * seen["costs"][0]
+
+        # parameters facade: numpy round trip
+        names = parameters.names()
+        assert names and all(isinstance(parameters[n], np.ndarray)
+                             for n in names)
+        w0 = parameters[names[0]]
+        parameters[names[0]] = w0 * 1.0
+        # inference on the pre-optimizer clone
+        xb = rng.randn(4, 8).astype(np.float32)
+        probs = paddle.infer(output_layer=logits, parameters=parameters,
+                             input=[(x,) for x in xb])
+        assert probs.shape == (4, 3)
+        acc = (np.argmax(probs, 1) == np.argmax(xb @ W, 1)).mean()
+        assert acc >= 0.5, acc
+
+    def test_parameters_tar_roundtrip(self, tmp_path):
+        import paddle_tpu as pt
+        with pt.program_guard(pt.Program(), pt.Program()):
+            x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+            out = paddle.layer.fc(input=x, size=2)
+            cost = paddle.layer.square_error_cost(
+                input=out, label=paddle.layer.data(
+                    "y", paddle.data_type.dense_vector(2)))
+            params = paddle.parameters.create(cost).init()
+        f = str(tmp_path / "params.npz")
+        with open(f, "wb") as fh:
+            params.to_tar(fh)
+        loaded = paddle.parameters.Parameters.from_tar(f)
+        for n in params.names():
+            np.testing.assert_array_equal(loaded[n], params[n])
+
+
+class TestV2Networks:
+    def test_simple_lstm_runs(self):
+        import paddle_tpu as pt
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            seq = paddle.layer.data(
+                "seq", paddle.data_type.dense_vector_sequence(6))
+            h = paddle.networks.simple_lstm(seq, size=5)
+            pooled = paddle.layer.pooling(h, paddle.pooling.Max())
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        x = np.random.RandomState(0).randn(2, 7, 6).astype(np.float32)
+        lens = np.array([7, 4], np.int32)
+        out, = exe.run(main, feed={"seq": x, "seq@len": lens},
+                       fetch_list=[pooled], scope=scope)
+        assert np.asarray(out).shape == (2, 5)
+
+    def test_conv_pool_shape(self):
+        import paddle_tpu as pt
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = paddle.layer.data(
+                "img", paddle.data_type.dense_vector(16 * 16 * 3))
+            grid = paddle.layer.fc(input=img, size=16 * 16 * 3)
+            import paddle_tpu.layers as L
+            grid = L.reshape(grid, shape=[-1, 16, 16, 3])
+            out = paddle.networks.simple_img_conv_pool(
+                grid, filter_size=3, num_filters=4, pool_size=2,
+                act=paddle.activation.Relu())
+        assert tuple(out.shape)[1:] == (8, 8, 4)
+
+    def test_activation_and_pooling_objects(self):
+        assert paddle.activation.Relu().name == "relu"
+        assert paddle.activation.Linear().name == ""
+        assert paddle.pooling.Max().name == "max"
+        from paddle_tpu.v2.activation import resolve
+        assert resolve(paddle.activation.Softmax()) == "softmax"
+        assert resolve(None) is None
+
+
+class TestForTestClone:
+    def test_infer_is_deterministic_with_dropout(self):
+        """clone(for_test=True): dropout must be a deterministic scale at
+        inference (the reference's inference_optimize contract)."""
+        import paddle_tpu as pt
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = paddle.layer.data("x", paddle.data_type.dense_vector(6))
+            h = paddle.layer.fc(input=x, size=8,
+                                act=paddle.activation.Relu())
+            h = paddle.layer.dropout(h, dropout_rate=0.5)
+            out = paddle.layer.fc(input=h, size=2)
+            y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+            cost = paddle.layer.classification_cost(input=out, label=y)
+            params = paddle.parameters.create(cost)
+        xb = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        rows = [(r,) for r in xb]
+        a = paddle.infer(output_layer=out, parameters=params, input=rows)
+        b = paddle.infer(output_layer=out, parameters=params, input=rows)
+        np.testing.assert_array_equal(a, b)
+
+    def test_clone_for_test_flips_is_test(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers as L
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = L.data("x", shape=[4])
+            L.dropout(x, dropout_prob=0.3)
+        test_prog = main.clone(for_test=True)
+        (op,) = [o for o in test_prog.global_block.ops
+                 if o.type == "dropout"]
+        assert op.attrs["is_test"] is True
+        # the original program is untouched
+        (op0,) = [o for o in main.global_block.ops if o.type == "dropout"]
+        assert op0.attrs["is_test"] is False

@@ -1,0 +1,42 @@
+"""net_drawer + Ploter tests (reference fluid net_drawer.py, v2 plot)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.net_drawer import draw_graph
+from paddle_tpu.plot import Ploter
+
+
+def test_draw_graph_emits_dot(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, size=3, act="relu")
+        loss = layers.mean(h)
+    pt.append_backward(loss)
+    p = str(tmp_path / "g.dot")
+    dot = draw_graph(main, path=p)
+    assert dot.startswith("digraph Program {") and dot.endswith("}")
+    assert '"x"' in dot and "mul" in dot and "relu" in dot
+    assert "grad" in dot  # backward section present
+    assert open(p).read() == dot
+
+
+def test_ploter_png_and_summary(tmp_path):
+    pl = Ploter("train_cost", "test_cost")
+    for i in range(10):
+        pl.append("train_cost", i, 1.0 / (i + 1))
+    pl.append("test_cost", 0, 0.5)
+    png = str(tmp_path / "curve.png")
+    summary = pl.plot(png)
+    assert os.path.getsize(png) > 500
+    assert "train_cost: n=10" in summary and "test_cost: n=1" in summary
+    try:
+        pl.append("nope", 0, 0.0)
+        assert False
+    except KeyError:
+        pass
+    pl.reset()
+    assert pl.series("train_cost") == []
