@@ -59,6 +59,27 @@ LSTM_BASELINE_MS = 184.0  # 2xLSTM text classification, bs64 hidden512,
 #                           1x K40m (/root/reference/benchmark/README.md:119)
 
 
+def _time_train_steps(jax, pt, main_prog, startup, loss, feed_np,
+                      warmup=3, steps=20):
+    """Shared measurement scaffold for the secondary metrics: init, move
+    the synthetic batch on-device, warm up, then time ``steps`` async
+    dispatches closed by one blocking fetch. Returns seconds/step."""
+    import numpy as np
+
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {k: jax.device_put(v) for k, v in feed_np.items()}
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                       scope=scope, return_numpy=False)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / steps
+
+
 def bench_lstm_step(jax, pt, layers):
     """Secondary metric: stacked-LSTM text-classification train step
     (reference benchmark/paddle/rnn/rnn.py config: bs64, hidden 512),
@@ -86,25 +107,76 @@ def bench_lstm_step(jax, pt, layers):
             layers.softmax_with_cross_entropy(logits, label))
         pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(
             loss, startup_program=startup)
-    scope = pt.Scope()
-    exe = pt.Executor(pt.TPUPlace())
-    exe.run(startup, scope=scope)
     rng = np.random.RandomState(0)
     feed = {
-        "words": jax.device_put(
-            rng.randint(0, vocab, size=(batch, seqlen)).astype("int64")),
-        "label": jax.device_put(
-            rng.randint(0, 2, size=(batch, 1)).astype("int64")),
+        "words": rng.randint(0, vocab, size=(batch, seqlen)).astype("int64"),
+        "label": rng.randint(0, 2, size=(batch, 1)).astype("int64"),
     }
-    for _ in range(3):
-        exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
-    steps = 20
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                       scope=scope, return_numpy=False)
-    np.asarray(out)
-    return (time.perf_counter() - t0) / steps * 1e3
+    return _time_train_steps(jax, pt, main_prog, startup, loss, feed) * 1e3
+
+
+def bench_transformer_step(jax, pt, layers, models):
+    """Secondary metric: GPT-style LM train step (d1024, 8 layers, bs8,
+    T2048) in tokens/sec — the compute-dense path (flash attention fwd+bwd
+    in Pallas, PERF.md). No reference baseline exists (the reference
+    predates Transformers); reported for trend tracking."""
+    import numpy as np
+
+    bs, T, vocab = 8, 2048, 16384
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=vocab, d_model=1024,
+                                       n_layers=8, num_heads=16, max_len=T)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, vocab]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
+            loss, startup_program=startup)
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, vocab, size=(bs, T)).astype("int64"),
+            "tgt": rng.randint(0, vocab, size=(bs, T)).astype("int64")}
+    sec = _time_train_steps(jax, pt, main_prog, startup, loss, feed,
+                            steps=10)
+    return bs * T / sec
+
+
+# Reference 1x K40m training numbers (/root/reference/benchmark/README.md:
+# 37, 50; VGG has no GPU row so its CPU MKL-DNN number is used,
+# IntelOptimizedPaddle.md:35).
+IMAGE_MODEL_BASELINES = {
+    "alexnet": 128 / 0.334,     # 334 ms/batch bs128 -> 383 img/s
+    "googlenet": 128 / 1.149,   # 1149 ms/batch bs128 -> 111 img/s
+    "vgg16": 30.4,              # img/s, CPU MKL-DNN
+}
+
+
+def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
+                      steps=8):
+    """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
+    import numpy as np
+
+    build = {"alexnet": lambda img: models.alexnet(img, num_classes=1000),
+             "googlenet": lambda img: models.googlenet(img,
+                                                       num_classes=1000),
+             "vgg16": lambda img: models.vgg(img, num_classes=1000,
+                                             depth=16)}[name]
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        images = layers.data("images", shape=[hw, hw, 3])
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = build(images)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.01,
+                                       momentum=0.9).minimize(
+            loss, startup_program=startup)
+    rng = np.random.RandomState(0)
+    feed = {"images": rng.rand(batch, hw, hw, 3).astype("float32"),
+            "label": rng.randint(0, 1000, size=(batch, 1)).astype("int64")}
+    sec = _time_train_steps(jax, pt, main_prog, startup, loss, feed,
+                            warmup=2, steps=steps)
+    return batch / sec
 
 
 def run_bench(platform):
@@ -172,6 +244,16 @@ def run_bench(platform):
     achieved_flops = img_per_sec * flops_per_img
     peak = _peak_flops(dev.device_kind) if on_tpu else None
     lstm_ms = bench_lstm_step(jax, pt, layers) if on_tpu else None
+    lm_tok_s = (bench_transformer_step(jax, pt, layers, models)
+                if on_tpu else None)
+    zoo = {}
+    if on_tpu:
+        for name in ("alexnet", "googlenet", "vgg16"):
+            ips = bench_image_model(jax, pt, layers, models, name)
+            zoo[name] = {
+                "img_per_sec": round(ips, 1),
+                "vs_baseline": round(ips / IMAGE_MODEL_BASELINES[name], 1),
+            }
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -192,6 +274,10 @@ def run_bench(platform):
                                  if lstm_ms else None),
             "lstm_baseline": "184 ms/batch 2xLSTM bs64 hidden512, "
                              "benchmark/README.md:119",
+            "transformer_lm_tokens_per_sec": (round(lm_tok_s)
+                                              if lm_tok_s else None),
+            "transformer_lm_config": "d1024 L8 h16 bs8 T2048 V16k bf16",
+            "image_zoo_train_bs128": zoo or None,
         },
     }), flush=True)
 
