@@ -1,0 +1,62 @@
+"""Data-parallel + tensor-parallel training over a device mesh — the
+in-graph replacement for the reference's pserver/NCCL cluster recipes
+(/root/reference/doc/design/cluster_train/README.md). One process, one
+program: the ShardingPlan annotates params and batches, GSPMD inserts the
+collectives.
+
+Run on any host:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python demos/distributed_data_parallel.py
+On a real pod slice it uses the chips as-is; across hosts call
+pt.parallel.initialize_multihost() first (see parallel/multihost.py).
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import make_mesh, megatron_plan
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+
+def main():
+    import jax
+
+    n = len(jax.devices())
+    mp = 2 if n % 2 == 0 else 1
+    mesh = make_mesh({"dp": n // mp, "mp": mp})
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[64])
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=256, act="relu")
+        h = layers.fc(h, size=256, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.MomentumOptimizer(learning_rate=0.05,
+                                       momentum=0.9).minimize(
+            loss, startup_program=startup)
+
+    scope = pt.Scope()
+    exe = pt.Executor(mesh=mesh, plan=megatron_plan(mesh))
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(64, 10)
+    steps = 5 if FAST else 60
+    batch = 8 * n
+    for step in range(steps):
+        xb = rng.randn(batch, 64).astype(np.float32)
+        yb = np.argmax(xb @ W, axis=1)[:, None].astype(np.int64)
+        lo, = exe.run(main_prog, feed={"x": xb, "y": yb},
+                      fetch_list=[loss], scope=scope)
+        if step % 10 == 0 or step == steps - 1:
+            print(f"step {step}: loss {float(lo):.4f}")
+
+
+if __name__ == "__main__":
+    main()

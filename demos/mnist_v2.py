@@ -1,0 +1,62 @@
+"""MNIST with the v2 API — the reference's first demo
+(/root/reference/v1_api_demo/mnist/api_train.py), unchanged in shape:
+init -> layers -> parameters.create -> trainer.SGD -> train with an event
+handler -> infer.
+
+Run:  python demos/mnist_v2.py  (add PADDLE_TPU_DEMO_FAST=1 for a smoke run)
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu import dataset
+from paddle_tpu.reader import decorator
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+
+def main():
+    paddle.init(trainer_count=1, seed=42)
+
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    h1 = paddle.layer.fc(input=images, size=128,
+                         act=paddle.activation.Relu())
+    h2 = paddle.layer.fc(input=h1, size=64, act=paddle.activation.Relu())
+    logits = paddle.layer.fc(input=h2, size=10)
+    cost = paddle.layer.classification_cost(input=logits, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndIteration) \
+                and event.batch_id % 50 == 0:
+            print(f"pass {event.pass_id} batch {event.batch_id} "
+                  f"cost {event.cost:.4f}")
+        elif isinstance(event, paddle.event.EndPass):
+            print(f"pass {event.pass_id} done: {event.metrics}")
+
+    train_reader = dataset.mnist.train()
+    if FAST:
+        train_reader = decorator.firstn(train_reader, 512)
+    trainer.train(paddle.batch(train_reader, 64),
+                  num_passes=1 if FAST else 5,
+                  event_handler=event_handler)
+
+    # evaluate
+    result = trainer.test(paddle.batch(
+        decorator.firstn(dataset.mnist.test(), 256), 64))
+    print(f"test cost: {result.cost:.4f}")
+
+    rows = [(img,) for img, _ in list(dataset.mnist.test()())[:8]]
+    probs = paddle.infer(output_layer=logits, parameters=parameters,
+                         input=rows)
+    print("predicted digits:", np.argmax(probs, axis=1).tolist())
+
+
+if __name__ == "__main__":
+    main()

