@@ -75,6 +75,7 @@ class LayerHelper:
         param.optimize_attr = {"learning_rate": attr.learning_rate}
         param.regularizer = attr.regularizer
         param.gradient_clip = getattr(attr, "gradient_clip", None)
+        param.update_hooks = list(getattr(attr, "update_hooks", ()) or ())
         # Mirror into the startup program with its init op.
         sb = self.startup_program.global_block
         sv = sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True)

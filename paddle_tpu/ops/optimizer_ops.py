@@ -310,3 +310,48 @@ def lr_warmup(attrs, ins):
     end = attrs.get("end_lr", 1.0)
     ramp = start + (end - start) * (step / warmup)
     return out(Out=jnp.where(step < warmup, ramp, lr).reshape(1))
+
+
+@register_op("model_average_update")
+def model_average_update(attrs, ins):
+    """Windowed parameter-average accumulation (AverageOptimizer,
+    /root/reference/paddle/parameter/AverageOptimizer.h; fluid
+    optimizer.py ModelAverage): sum_1 accumulates the live parameter each
+    step; when the window fills (num_1 >= max_average_window) the buffers
+    rotate — sum_2/num_2 take over the history and sum_1 restarts — so the
+    average at apply() spans between one and two windows. Purely
+    functional where-rotation: no control flow under jit."""
+    p = single(ins, "Param")
+    s1 = single(ins, "Sum1")
+    s2 = single(ins, "Sum2")
+    n1 = single(ins, "Num1").reshape(())
+    n2 = single(ins, "Num2").reshape(())
+    max_w = float(attrs.get("max_average_window", 10000))
+    s1n = s1 + p
+    n1n = n1 + 1.0
+    roll = n1n >= max_w
+    return {
+        "Sum1Out": [jnp.where(roll, jnp.zeros_like(s1n), s1n)],
+        "Sum2Out": [jnp.where(roll, s1n, s2)],
+        "Num1Out": [jnp.where(roll, 0.0, n1n).reshape(1)],
+        "Num2Out": [jnp.where(roll, n1n, n2).reshape(1)],
+    }
+
+
+@register_op("static_prune_mask")
+def static_prune_mask(attrs, ins):
+    """Pruning mask from initialized weights (StaticPruningHook,
+    /root/reference/paddle/parameter/ParameterUpdaterHook.cpp:39): keep
+    the largest-|w| (1 - sparsity_ratio) fraction; the mask is fixed for
+    the rest of training and re-applied after every optimizer update."""
+    w = single(ins, "Param")
+    ratio = float(attrs.get("sparsity_ratio", 0.6))
+    flat = jnp.abs(w).reshape(-1)
+    n = flat.shape[0]
+    keep = max(1, int(round(n * (1.0 - ratio))))
+    # mask by sorted INDEX, not by threshold compare: ties at the boundary
+    # (e.g. constant-initialized weights) must still prune the exact count,
+    # as the reference's index-sorted masking does.
+    _, idx = jax.lax.top_k(flat, keep)
+    mask = jnp.zeros((n,), w.dtype).at[idx].set(1.0)
+    return out(Mask=mask.reshape(w.shape))

@@ -96,11 +96,43 @@ class Optimizer:
         self._create_accumulators(startup, [p for p, _ in params_grads])
         for pg in params_grads:
             self._append_optimize_op(block, pg, lr_var)
+        self._append_updater_hooks(block, startup,
+                                   [p for p, _ in params_grads])
         if self.global_step is not None:
             block.append_op("increment", inputs={"X": [self.global_step.name]},
                             outputs={"Out": [self.global_step.name]},
                             attrs={"step": 1.0})
         return params_grads
+
+    def _append_updater_hooks(self, block, startup, params):
+        """ParameterUpdaterHook plane (reference ParameterUpdaterHook.cpp):
+        for params carrying a StaticPruningHook, build the fixed mask from
+        the initialized weights in the startup program (pruning them
+        there too, matching the hook's init()) and re-apply the mask after
+        every update in the main program."""
+        from .param_attr import StaticPruningHook
+
+        for p in params:
+            for hook in getattr(p, "update_hooks", ()) or ():
+                if not isinstance(hook, StaticPruningHook):
+                    raise TypeError(f"unsupported updater hook {hook!r}")
+                mask_name = p.name + "@PRUNE_MASK"
+                sb = startup.global_block
+                sb.create_var(name=mask_name, shape=p.shape, dtype=p.dtype,
+                              persistable=True)
+                sb.append_op(
+                    "static_prune_mask", inputs={"Param": [p.name]},
+                    outputs={"Mask": [mask_name]},
+                    attrs={"sparsity_ratio": hook.sparsity_ratio})
+                sb.append_op("elementwise_mul",
+                             inputs={"X": [p.name], "Y": [mask_name]},
+                             outputs={"Out": [p.name]}, attrs={})
+                block.create_var(name=mask_name, shape=p.shape,
+                                 dtype=p.dtype, persistable=True,
+                                 stop_gradient=True)
+                block.append_op("elementwise_mul",
+                                inputs={"X": [p.name], "Y": [mask_name]},
+                                outputs={"Out": [p.name]}, attrs={})
 
 
 class SGDOptimizer(Optimizer):
@@ -337,3 +369,92 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ModelAverage:
+    """Windowed parameter averaging for evaluation (reference
+    parameter/AverageOptimizer.h; fluid optimizer.py ModelAverage).
+
+    Build AFTER Optimizer.minimize: appends a model_average_update op per
+    trainable parameter so the running window accumulates inside the
+    training step. ``apply(scope)`` (a context manager) swaps the averaged
+    values into the scope for eval and restores the live parameters on
+    exit — the PARAMETER_APPLY/restore dance of the reference.
+
+    Two-buffer rotation (sum_1 live, sum_2 last full window) instead of
+    the reference's three: the apply-time average spans one to two
+    windows of history. ``min_average_window`` gates apply: with fewer
+    accumulated steps the live parameters are kept.
+    """
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 min_average_window: int = 100,
+                 max_average_window: int = 10000,
+                 main_program: Optional[Program] = None,
+                 startup_program: Optional[Program] = None):
+        from .core.program import default_main_program
+
+        del average_window_rate  # window is bounded explicitly, as in fluid
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        main = main_program or default_main_program()
+        startup = startup_program or default_startup_program()
+        from .initializer import ConstantInitializer
+
+        block = main.global_block
+        self._slots: List[Tuple[str, Dict[str, str]]] = []
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            names = {}
+            for suffix, shape in (("sum_1", p.shape), ("sum_2", p.shape),
+                                  ("num_1", [1]), ("num_2", [1])):
+                name = f"{p.name}@MA_{suffix}"
+                names[suffix] = name
+                block.create_var(name=name, shape=shape, dtype="float32",
+                                 persistable=True, stop_gradient=True)
+                sv = startup.global_block.create_var(
+                    name=name, shape=shape, dtype="float32",
+                    persistable=True)
+                ConstantInitializer(0.0)(sv, startup.global_block)
+            block.append_op(
+                "model_average_update",
+                inputs={"Param": [p.name], "Sum1": [names["sum_1"]],
+                        "Sum2": [names["sum_2"]], "Num1": [names["num_1"]],
+                        "Num2": [names["num_2"]]},
+                outputs={"Sum1Out": [names["sum_1"]],
+                         "Sum2Out": [names["sum_2"]],
+                         "Num1Out": [names["num_1"]],
+                         "Num2Out": [names["num_2"]]},
+                attrs={"max_average_window": self.max_average_window})
+            self._slots.append((p.name, names))
+
+    def apply(self, scope=None):
+        """Context manager: scope holds averaged params inside, live
+        params are restored on exit."""
+        import contextlib
+
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+
+        @contextlib.contextmanager
+        def _ctx():
+            backup = {}
+            for pname, names in self._slots:
+                s1 = np.asarray(scope.get_numpy(names["sum_1"]))
+                s2 = np.asarray(scope.get_numpy(names["sum_2"]))
+                n = (float(np.asarray(scope.get_numpy(names["num_1"]))[0])
+                     + float(np.asarray(scope.get_numpy(names["num_2"]))[0]))
+                if n <= 0 or n < self.min_average_window:
+                    continue
+                backup[pname] = np.asarray(scope.get_numpy(pname))
+                avg = ((s1 + s2) / n).astype(backup[pname].dtype)
+                scope.set(pname, avg)
+            try:
+                yield self
+            finally:
+                for pname, val in backup.items():
+                    scope.set(pname, val)
+
+        return _ctx()

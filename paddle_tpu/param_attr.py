@@ -8,6 +8,26 @@ from __future__ import annotations
 from .initializer import Initializer
 
 
+class StaticPruningHook:
+    """Updater hook (reference ParameterUpdaterHook.cpp StaticPruningHook):
+    a fixed mask keeping the largest-|w| (1 - sparsity_ratio) fraction of
+    the INITIAL weights, re-applied after every optimizer update."""
+
+    def __init__(self, sparsity_ratio: float = 0.6):
+        if not 0.0 <= sparsity_ratio < 1.0:
+            raise ValueError(f"sparsity_ratio must be in [0, 1), got "
+                             f"{sparsity_ratio}")
+        self.sparsity_ratio = float(sparsity_ratio)
+
+
+def Hook(type: str, sparsity_ratio: float = 0.6):
+    """HookConfig-style factory (reference ParameterUpdaterHook.cpp
+    createImpl: 'pruning' is the only registered type)."""
+    if type != "pruning":
+        raise ValueError(f"unknown updater hook type {type!r}")
+    return StaticPruningHook(sparsity_ratio)
+
+
 class ParamAttr:
     def __init__(
         self,
@@ -17,6 +37,7 @@ class ParamAttr:
         regularizer=None,
         trainable: bool = True,
         gradient_clip=None,
+        update_hooks=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -24,6 +45,10 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.gradient_clip = gradient_clip
+        if update_hooks is not None and not isinstance(update_hooks,
+                                                       (list, tuple)):
+            update_hooks = [update_hooks]
+        self.update_hooks = list(update_hooks or [])
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":

@@ -1,0 +1,133 @@
+"""ModelAverage + StaticPruningHook tests (reference
+paddle/parameter/AverageOptimizer.h, ParameterUpdaterHook.cpp)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.param_attr import Hook, StaticPruningHook
+
+
+class TestModelAverage:
+    def test_apply_uses_window_average_and_restores(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1, bias_attr=False,
+                             param_attr=pt.ParamAttr(name="ma_w"))
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+            ma = pt.optimizer.ModelAverage(min_average_window=2,
+                                           max_average_window=1000)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        w_hist = []
+        for _ in range(10):
+            xb = rng.randn(16, 4).astype(np.float32)
+            yb = (xb @ np.array([[1.0], [2.0], [-1.0], [0.5]],
+                                np.float32))
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                    scope=scope)
+            w_hist.append(np.asarray(scope.get_numpy("ma_w")).copy())
+        live = np.asarray(scope.get_numpy("ma_w")).copy()
+        expected_avg = np.mean(w_hist, axis=0)
+        with ma.apply(scope):
+            applied = np.asarray(scope.get_numpy("ma_w"))
+            np.testing.assert_allclose(applied, expected_avg, rtol=1e-4)
+        restored = np.asarray(scope.get_numpy("ma_w"))
+        np.testing.assert_array_equal(restored, live)
+
+    def test_below_min_window_keeps_live_params(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[3])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1, bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+            ma = pt.optimizer.ModelAverage(min_average_window=100)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        xb = np.ones((4, 3), np.float32)
+        yb = np.ones((4, 1), np.float32)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                scope=scope)
+        names = [n for n in scope.keys() if n.endswith("@MA_sum_1")]
+        assert names  # accumulators exist
+        pname = names[0].replace("@MA_sum_1", "")
+        live = np.asarray(scope.get_numpy(pname)).copy()
+        with ma.apply(scope):
+            np.testing.assert_array_equal(
+                np.asarray(scope.get_numpy(pname)), live)
+
+    def test_window_rotation(self):
+        """After num_1 hits max_average_window, sum_2 takes the history."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1, bias_attr=False,
+                             param_attr=pt.ParamAttr(name="rot_w"))
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(
+                loss, startup_program=startup)
+            pt.optimizer.ModelAverage(min_average_window=1,
+                                      max_average_window=3)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        xb = np.ones((2, 2), np.float32)
+        yb = np.ones((2, 1), np.float32)
+        for _ in range(4):  # rotation fires at step 3
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                    scope=scope)
+        n1 = float(np.asarray(scope.get_numpy("rot_w@MA_num_1"))[0])
+        n2 = float(np.asarray(scope.get_numpy("rot_w@MA_num_2"))[0])
+        assert n2 == 3.0 and n1 == 1.0, (n1, n2)
+
+
+class TestStaticPruning:
+    def test_mask_sparsity_and_persistence(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[10])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(
+                x, size=10, bias_attr=False,
+                param_attr=pt.ParamAttr(
+                    name="prune_w",
+                    update_hooks=Hook("pruning", sparsity_ratio=0.7)))
+            out = layers.fc(pred, size=1, bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(out, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        w0 = np.asarray(scope.get_numpy("prune_w"))
+        sparsity0 = (w0 == 0).mean()
+        assert 0.65 <= sparsity0 <= 0.75, sparsity0  # pruned at init
+        zero_mask = w0 == 0
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            xb = rng.randn(8, 10).astype(np.float32)
+            yb = rng.randn(8, 1).astype(np.float32)
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                    scope=scope)
+        w5 = np.asarray(scope.get_numpy("prune_w"))
+        # pruned entries stay exactly zero through training; others move
+        assert (w5[zero_mask] == 0).all()
+        assert np.abs(w5[~zero_mask] - w0[~zero_mask]).max() > 0
+
+    def test_hook_factory_validates(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            Hook("unknown")
+        with pytest.raises(ValueError):
+            StaticPruningHook(1.5)

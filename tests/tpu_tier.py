@@ -247,8 +247,12 @@ def async_dispatch_overlaps():
     out, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
                    return_numpy=False)
     jax.block_until_ready(out)
-    # tunnel relay latency is bursty: accept the best of three windows
-    best = None
+    # The async signature: after the dispatch loop RETURNS, real device
+    # work must still be pending (block_until_ready waits measurably).
+    # Asserting on the dispatch:total ratio is flaky — host contention
+    # (e.g. a CPU test suite on the same box) inflates dispatch time —
+    # so assert on the residual wait, best of three windows.
+    best_wait, best = -1.0, (0.0, 0.0)
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(50):
@@ -257,13 +261,15 @@ def async_dispatch_overlaps():
         dispatch = time.perf_counter() - t0
         jax.block_until_ready(out)
         total = time.perf_counter() - t0
-        if best is None or dispatch / total < best[0] / best[1]:
-            best = (dispatch, total)
-        if dispatch < max(0.6 * total, 0.05):
+        wait = total - dispatch
+        if wait > best_wait:
+            best_wait, best = wait, (dispatch, total)
+        if wait > 0.02:
             break
     dispatch, total = best
-    assert dispatch < max(0.6 * total, 0.05), (dispatch, total)
-    return f"dispatch {dispatch*1e3:.1f} ms vs total {total*1e3:.1f} ms"
+    assert total - dispatch > 0.02, (dispatch, total)
+    return f"dispatch {dispatch*1e3:.1f} ms, device wait " \
+           f"{(total - dispatch)*1e3:.1f} ms after dispatch returned"
 
 
 @check
