@@ -20,6 +20,7 @@ from .core.enforce import (EnforceError, enforce, enforce_eq, enforce_ge,
 from .flags import FLAGS, parse_flags, set_flags
 from .data_feeder import DataFeeder
 from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
+                   recompute_guard,
                    default_main_program, default_startup_program, global_scope,
                    program_guard)
 from .core.backward import append_backward

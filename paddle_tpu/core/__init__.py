@@ -1,6 +1,6 @@
 from .executor import CPUPlace, Executor, TPUPlace
 from .program import (Block, Operator, Parameter, Program, Variable,
                       default_main_program, default_startup_program,
-                      program_guard)
+                      program_guard, recompute_guard)
 from .registry import get_op, has_op, register_op, registered_ops
 from .scope import Scope, global_scope

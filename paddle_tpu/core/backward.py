@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import jax
 import jax.numpy as jnp
 
-from .program import GRAD_SUFFIX, Block, Program, Variable, grad_var_name
+from .program import (GRAD_SUFFIX, Block, Operator, Program, Variable,
+                      grad_var_name)
 from .registry import get_op, register_op
 from .types import is_floating
 
@@ -116,6 +117,100 @@ def generic_grad(attrs, ins):
     for slot, arrs in gins.items():
         result["IG:" + slot] = list(arrs)
     return result
+
+
+# Outputs of these op types are saved across forward->backward inside a
+# recompute segment (program.recompute_guard); everything else — BN applies,
+# activations, residual adds — is rematerialized in the backward, where XLA
+# fuses the recompute into the consuming kernels instead of round-tripping
+# the intermediate through HBM. MXU ops are saved because recomputing them
+# costs real FLOPs; tiny (ndim<=1) tensors are saved because storing them is
+# free and recomputing them needs a full reduction over a big operand.
+SEGMENT_SAVE_OPS = {
+    "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "depthwise_conv2d", "mul", "matmul", "pool2d", "pool3d",
+    "max_pool2d_with_index", "max_pool3d_with_index", "sequence_conv",
+    "lstm", "gru",
+}
+
+_SEG_RESIDUAL = "seg_saved"
+_SEG_VJP_PREFIX = "@SEGVJP@"
+
+
+@register_op("seg_fwd", special=True)
+def segment_forward(attrs, ins, *, executor=None, env=None, op=None,
+                    program=None, scope=None):
+    """Forward of a whole recompute segment as ONE composite call.
+
+    Emitted by append_backward in place of the segment's individual forward
+    ops (program.recompute_guard). Runs ``jax.vjp`` of the composite under
+    ``jax.checkpoint`` with a save-only-named-residuals policy: matmul/conv
+    outputs and tiny (ndim<=1) stats are the only values that survive to the
+    backward; every other intermediate (BN applies, activations, residual
+    adds) dies as soon as the forward consumes it and is rematerialized —
+    fused into the consuming kernels — inside the paired ``grad_seg`` op.
+    The vjp closure is stashed in the trace environment under a key only the
+    paired grad op knows, so the forward is computed exactly once.
+
+    attrs:
+      seg_ops   — [{type, attrs, ins, outs}] the original forward ops
+      ext_in    — external input names, aligned with the I slot
+      diff      — bool per ext_in: which inputs receive gradients
+      all_outs  — every segment output name, aligned with the O slot
+      vjp_key   — env key for the vjp closure
+    """
+    from jax.ad_checkpoint import checkpoint_name
+
+    ext = attrs["ext_in"]
+    diff = attrs["diff"]
+    vals = ins["I"]
+    fixed = {n: v for n, v, d in zip(ext, vals, diff) if not d}
+    dvals = {n: v for n, v, d in zip(ext, vals, diff) if d}
+
+    def f(dins):
+        local = dict(fixed)
+        local.update(dins)
+        for sop in attrs["seg_ops"]:
+            opdef = get_op(sop["type"])
+            op_ins = {slot: [local[n] for n in names]
+                      for slot, names in sop["ins"].items() if names}
+            outs = opdef.fn(sop["attrs"], op_ins)
+            save_all = sop["type"] in SEGMENT_SAVE_OPS
+            for slot, names in sop["outs"].items():
+                for name, v in zip(names, outs.get(slot, [])):
+                    if save_all or getattr(v, "ndim", 2) <= 1:
+                        v = checkpoint_name(v, _SEG_RESIDUAL)
+                    local[name] = v
+        return [local[n] for n in attrs["all_outs"]]
+
+    f_ck = jax.checkpoint(
+        f, policy=jax.checkpoint_policies.save_only_these_names(_SEG_RESIDUAL))
+    outs, vjp_fn = jax.vjp(f_ck, dvals)
+    env[_SEG_VJP_PREFIX + attrs["vjp_key"]] = (vjp_fn, outs)
+    return {"O": outs}
+
+
+@register_op("grad_seg", special=True)
+def segment_grad(attrs, ins, *, executor=None, env=None, op=None,
+                 program=None, scope=None):
+    """Backward of a recompute segment: applies the vjp closure stashed by
+    the paired ``seg_fwd`` op.
+
+    attrs:
+      vjp_key   — env key of the closure
+      ext_in / diff — as in seg_fwd (IG slot order = diff'ed ext_in order)
+      og_outs   — names (subset of seg_fwd's all_outs) aligned with OG
+      all_outs  — seg_fwd's output order, to place cotangents
+    """
+    vjp_fn, outs = env[_SEG_VJP_PREFIX + attrs["vjp_key"]]
+    og_map = dict(zip(attrs["og_outs"], ins["OG"]))
+    cts = []
+    for name, o in zip(attrs["all_outs"], outs):
+        g = og_map.get(name)
+        cts.append(g.astype(o.dtype) if g is not None else jnp.zeros_like(o))
+    (gins,) = vjp_fn(cts)
+    dnames = [n for n, d in zip(attrs["ext_in"], attrs["diff"]) if d]
+    return {"IG": [gins[n] for n in dnames]}
 
 
 @register_op("grad_custom")
@@ -259,11 +354,118 @@ def append_backward(
                 contributions.pop(name, None)
                 finalized.pop(name, None)
 
-    # 4. Walk forward ops in reverse, emitting grad ops.
-    for i in range(n_fwd - 1, -1, -1):
+    def _seg_eligible(op) -> bool:
+        """May this op be folded into a composite recompute-segment grad?"""
+        if op.type in NON_DIFFERENTIABLE:
+            return False
+        opdef = get_op(op.type)
+        return not (opdef.special or opdef.needs_rng
+                    or opdef.grad_fn is not None)
+
+    def _diffable_input(name: str) -> bool:
+        ok = (name in relevant and _is_float_var(block, name)
+              and name not in no_grad)
+        if ok and block.has_var(name):
+            v = block.var(name)
+            if v.stop_gradient and not v.is_parameter:
+                ok = False
+        return ok
+
+    def _emit_segment_grad(j: int, i: int) -> None:
+        """Differentiate block.ops[j..i] (one recompute segment): replace the
+        forward run with one composite ``seg_fwd`` op and append the paired
+        ``grad_seg``. No primal snapshots are needed — the vjp closure
+        captures the segment inputs at their forward position, before any
+        later in-place overwrite."""
+        run = block.ops[j:i + 1]
+        seg_ops_desc = []
+        written: Set[str] = set()
+        ext_in: List[str] = []
+        ext_set: Set[str] = set()
+        all_outs: List[str] = []
+        for op2 in run:
+            for names in op2.inputs.values():
+                for name in names:
+                    if name not in written and name not in ext_set:
+                        ext_set.add(name)
+                        ext_in.append(name)
+            for name in op2.output_names():
+                written.add(name)
+                all_outs.append(name)
+            seg_ops_desc.append({
+                "type": op2.type,
+                "attrs": dict(op2.attrs),
+                "ins": {s: list(v) for s, v in op2.inputs.items()},
+                "outs": {s: list(v) for s, v in op2.outputs.items()},
+            })
+        # Keep only the final version of names written more than once: that
+        # is the version visible outside the segment.
+        seen: Set[str] = set()
+        dedup: List[str] = []
+        for name in reversed(all_outs):
+            if name not in seen:
+                seen.add(name)
+                dedup.append(name)
+        all_outs = list(reversed(dedup))
+        # OG for segment outputs (grads contributed by already-processed
+        # later ops).
+        og_outs, og_vars = [], []
+        for name in all_outs:
+            g = finalize_grad(name)
+            if g is not None:
+                og_outs.append(name)
+                og_vars.append(g)
+        for op2 in reversed(run):
+            kill_versions(op2)
+        diff = [_diffable_input(n) for n in ext_in]
+        vjp_key = program.unique_name("seg")
+        seg_attrs = {"seg_ops": seg_ops_desc, "ext_in": list(ext_in),
+                     "diff": list(diff), "all_outs": all_outs,
+                     "vjp_key": vjp_key}
+        fwd_op = Operator(block, "seg_fwd",
+                          inputs={"I": list(ext_in)},
+                          outputs={"O": list(all_outs)},
+                          attrs=seg_attrs)
+        block.ops[j:i + 1] = [fwd_op]
+        program._bump()
+        if not og_outs or not any(diff):
+            return
+        ig_vars = []
+        for name, d in zip(ext_in, diff):
+            if not d:
+                continue
+            gvar = program.unique_name(grad_var_name(name) + "@R")
+            block.create_var(name=gvar, stop_gradient=True)
+            add_contribution(name, gvar)
+            ig_vars.append(gvar)
+        block.append_op(
+            "grad_seg",
+            inputs={"OG": og_vars},
+            outputs={"IG": ig_vars},
+            attrs={"vjp_key": vjp_key, "ext_in": list(ext_in),
+                   "diff": list(diff), "og_outs": og_outs,
+                   "all_outs": all_outs},
+        )
+
+    # 4. Walk forward ops in reverse, emitting grad ops. Contiguous runs of
+    # ops tagged by program.recompute_guard collapse into one grad_seg op.
+    i = n_fwd - 1
+    while i >= 0:
         op = block.ops[i]
         if not op_needed[i]:
             kill_versions(op)
+            i -= 1
+            continue
+        seg = op.attrs.get("__recompute_seg__")
+        if seg is not None and _seg_eligible(op):
+            j = i
+            while j > 0 and (
+                    block.ops[j - 1].attrs.get("__recompute_seg__") == seg
+                    and op_needed[j - 1]
+                    and _seg_eligible(block.ops[j - 1])):
+                j -= 1
+            _emit_segment_grad(j, i)
+            i = j - 1
             continue
         opdef = get_op(op.type)
 
@@ -285,6 +487,7 @@ def append_backward(
                 og_inputs["OG:" + slot] = arrs
         kill_versions(op)
         if not any_og:
+            i -= 1
             continue
 
         diff_mask = {}
@@ -293,15 +496,7 @@ def append_backward(
             mask = []
             outs_for_slot = []
             for name in names:
-                ok = (
-                    name in relevant
-                    and _is_float_var(block, name)
-                    and name not in no_grad
-                )
-                if ok and block.has_var(name):
-                    v = block.var(name)
-                    if v.stop_gradient and not v.is_parameter:
-                        ok = False
+                ok = _diffable_input(name)
                 mask.append(ok)
                 if ok:
                     g = program.unique_name(grad_var_name(name) + "@R")
@@ -311,6 +506,7 @@ def append_backward(
             if outs_for_slot:
                 ig_outputs[slot] = outs_for_slot
         if not ig_outputs:
+            i -= 1
             continue
 
         use_custom = opdef.grad_fn is not None
@@ -377,6 +573,7 @@ def append_backward(
                 "diff": diff_mask,
             },
         )
+        i -= 1
 
     # 5. Finalize remaining contributions (producer-less vars: feeds/params)
     # and give every finalized grad its canonical ``<var>@GRAD`` alias so

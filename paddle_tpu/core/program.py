@@ -191,6 +191,9 @@ class Block:
     # -- ops ---------------------------------------------------------------
     def append_op(self, op_type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, op_type, inputs or {}, outputs or {}, attrs)
+        seg = self.program._recompute_seg
+        if seg is not None:
+            op.attrs.setdefault("__recompute_seg__", seg)
         self.ops.append(op)
         self.program._bump()
         return op
@@ -219,6 +222,7 @@ class Program:
         self.current_block_idx = 0
         self._version = 0  # bumped on every mutation; part of the compile key
         self.random_seed: Optional[int] = None
+        self._recompute_seg: Optional[int] = None  # active recompute_guard id
 
     # -- identity for executor caching ------------------------------------
     def _bump(self):
@@ -323,6 +327,37 @@ def switch_startup_program(p: Program) -> Program:
     global _startup_program
     old, _startup_program = _startup_program, p
     return old
+
+
+_recompute_seg_counter = 0
+
+
+@contextlib.contextmanager
+def recompute_guard(main_program: Optional[Program] = None):
+    """Mark the ops built inside this scope as one rematerialization segment.
+
+    The TPU-native activation-checkpointing plane (the capability the
+    reference later grew as RecomputeOptimizer): ops tagged with the same
+    segment id are differentiated as ONE composite ``grad_seg`` op whose vjp
+    runs under ``jax.checkpoint`` with a save-only-named-residuals policy —
+    matmul/conv outputs (and tiny stats) are kept, every elementwise
+    intermediate (BN apply, activations, residual adds) is recomputed in the
+    backward where XLA fuses it into the consuming kernels. This cuts the
+    HBM activation traffic between forward and backward roughly in half for
+    conv-BN-act stacks, which is what makes ResNet-class models exceed their
+    naive HBM roofline (PERF.md). Nested guards are not supported; segments
+    must not contain rng/special/custom-grad ops (backward falls back to
+    per-op gradients for those automatically).
+    """
+    p = main_program or default_main_program()
+    global _recompute_seg_counter
+    _recompute_seg_counter += 1
+    old = p._recompute_seg
+    p._recompute_seg = _recompute_seg_counter
+    try:
+        yield
+    finally:
+        p._recompute_seg = old
 
 
 @contextlib.contextmanager

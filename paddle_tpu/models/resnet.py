@@ -8,9 +8,23 @@ test_image_classification_train.py resnet_cifar10).
 TPU notes: NHWC layout so the channel dim lands on the MXU lanes; batch-norm
 in f32 with conv compute dtypes following the input (bf16 under the bench
 harness); identity shortcuts use projection convs only on shape change, as in
-the reference.
+the reference. With ``recompute=True`` each residual block becomes a
+recompute segment (core.program.recompute_guard): only conv outputs and BN
+stats survive to the backward, cutting peak activation memory ~2x for deep
+variants at large batch. It is off by default because on-chip measurement
+shows it trades ~45% step time for that memory (the barriered
+rematerialization adds HBM traffic rather than removing it — see PERF.md
+"recompute segments").
 """
+import contextlib
+
+from ..core.program import recompute_guard
+
 from .. import layers
+
+
+def _maybe_recompute(enabled):
+    return recompute_guard() if enabled else contextlib.nullcontext()
 
 
 def _conv_bn(x, num_filters, filter_size, stride=1, padding=0, act="relu",
@@ -30,31 +44,34 @@ def _shortcut(x, ch_out, stride, data_format, is_test):
     return x
 
 
-def _bottleneck(x, ch_mid, stride, data_format, is_test):
+def _bottleneck(x, ch_mid, stride, data_format, is_test, recompute=False):
     """1x1 → 3x3 → 1x1(×4) bottleneck (reference resnet.py bottleneck)."""
     ch_out = ch_mid * 4
-    short = _shortcut(x, ch_out, stride, data_format, is_test)
-    y = _conv_bn(x, ch_mid, 1, 1, 0, data_format=data_format, is_test=is_test)
-    y = _conv_bn(y, ch_mid, 3, stride, 1, data_format=data_format,
-                 is_test=is_test)
-    y = _conv_bn(y, ch_out, 1, 1, 0, act=None, data_format=data_format,
-                 is_test=is_test)
-    added = layers.elementwise_add(y, short)
-    return layers.relu(added)
+    with _maybe_recompute(recompute):
+        short = _shortcut(x, ch_out, stride, data_format, is_test)
+        y = _conv_bn(x, ch_mid, 1, 1, 0, data_format=data_format,
+                     is_test=is_test)
+        y = _conv_bn(y, ch_mid, 3, stride, 1, data_format=data_format,
+                     is_test=is_test)
+        y = _conv_bn(y, ch_out, 1, 1, 0, act=None, data_format=data_format,
+                     is_test=is_test)
+        added = layers.elementwise_add(y, short)
+        return layers.relu(added)
 
 
-def _basicblock(x, ch_out, stride, data_format, is_test):
-    short = _shortcut(x, ch_out, stride, data_format, is_test)
-    y = _conv_bn(x, ch_out, 3, stride, 1, data_format=data_format,
-                 is_test=is_test)
-    y = _conv_bn(y, ch_out, 3, 1, 1, act=None, data_format=data_format,
-                 is_test=is_test)
-    added = layers.elementwise_add(y, short)
-    return layers.relu(added)
+def _basicblock(x, ch_out, stride, data_format, is_test, recompute=False):
+    with _maybe_recompute(recompute):
+        short = _shortcut(x, ch_out, stride, data_format, is_test)
+        y = _conv_bn(x, ch_out, 3, stride, 1, data_format=data_format,
+                     is_test=is_test)
+        y = _conv_bn(y, ch_out, 3, 1, 1, act=None, data_format=data_format,
+                     is_test=is_test)
+        added = layers.elementwise_add(y, short)
+        return layers.relu(added)
 
 
 def resnet_imagenet(images, num_classes=1000, depth=50, data_format="NHWC",
-                    is_test=False):
+                    is_test=False, recompute=False):
     """ResNet-50/101/152 for 224x224 ImageNet (reference resnet.py:8)."""
     cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
     assert depth in cfg, f"resnet_imagenet depth must be one of {sorted(cfg)}, got {depth}"
@@ -66,14 +83,15 @@ def resnet_imagenet(images, num_classes=1000, depth=50, data_format="NHWC",
     for stage, (ch_mid, n) in enumerate(zip([64, 128, 256, 512], counts)):
         for block in range(n):
             stride = 2 if block == 0 and stage > 0 else 1
-            x = _bottleneck(x, ch_mid, stride, data_format, is_test)
+            x = _bottleneck(x, ch_mid, stride, data_format, is_test,
+                            recompute=recompute)
     x = layers.pool2d(x, pool_type="avg", global_pooling=True,
                       data_format=data_format)
     return layers.fc(x, size=num_classes)
 
 
 def resnet_cifar10(images, num_classes=10, depth=32, data_format="NHWC",
-                   is_test=False):
+                   is_test=False, recompute=False):
     """CIFAR ResNet with basic blocks, depth = 6n+2 (book test parity)."""
     assert (depth - 2) % 6 == 0
     n = (depth - 2) // 6
@@ -82,7 +100,8 @@ def resnet_cifar10(images, num_classes=10, depth=32, data_format="NHWC",
     for stage, ch in enumerate([16, 32, 64]):
         for block in range(n):
             stride = 2 if block == 0 and stage > 0 else 1
-            x = _basicblock(x, ch, stride, data_format, is_test)
+            x = _basicblock(x, ch, stride, data_format, is_test,
+                            recompute=recompute)
     x = layers.pool2d(x, pool_type="avg", global_pooling=True,
                       data_format=data_format)
     return layers.fc(x, size=num_classes)
