@@ -37,6 +37,30 @@ def conv2d(attrs, ins):
     pads = normalize_pair(attrs.get("paddings", [0, 0]))
     dilations = normalize_pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    # 1x1/stride-1 convs ARE matmuls: lower them as dot_general so XLA maps
+    # them straight onto the MXU and can fuse elementwise producers/consumers
+    # into the dot's operand/result reads (the conv emitter cannot). These are
+    # the low-arithmetic-intensity layers that bound ResNet-class training
+    # (PERF.md roofline), and the dot form also gives their vjp clean
+    # [BHW,Cin]x[BHW,Cout] weight-grad contractions instead of transposed
+    # convs.
+    k_hw = (w.shape[0], w.shape[1]) if fmt == "NHWC" else (w.shape[2],
+                                                           w.shape[3])
+    if (k_hw == (1, 1) and tuple(strides) == (1, 1)
+            and tuple(pads) == (0, 0) and groups == 1):
+        if fmt == "NHWC":
+            from ..kernels.linear_grad import linear2d
+
+            wm = w.reshape(w.shape[2], w.shape[3])  # HWIO -> [I, O]
+            B, H, W_, I = x.shape
+            y = linear2d(x.reshape(B * H * W_, I), wm,
+                         common.mxu_precision())
+            return out(Output=y.reshape(B, H, W_, -1).astype(x.dtype))
+        wm = w.reshape(w.shape[0], w.shape[1])  # OIHW -> [O, I]
+        y = jax.lax.dot_general(
+            x, wm, (((1,), (1,)), ((), ())),
+            precision=common.mxu_precision())  # [B,H,W,O]
+        return out(Output=jnp.moveaxis(y, -1, 1).astype(x.dtype))
     y = jax.lax.conv_general_dilated(
         x,
         w,
