@@ -47,6 +47,17 @@ class DataFeeder:
         for i, var in enumerate(self.feed_vars):
             col = [row[i] for row in data]
             dtype = var.dtype
+            sval = getattr(var, "sparse_values", None)
+            if sval is not None:
+                # sparse_float_vector rows: [(index, value), ...] — split
+                # into the padded id feed and its companion value feed
+                # (reference dataprovider_converter.py SparseFloatScanner).
+                ids_col = [[p[0] for p in row[i]] for row in data]
+                val_col = [[p[1] for p in row[i]] for row in data]
+                out.update(self._pad_sequences(var, ids_col))
+                vals = self._pad_sequences(sval, val_col)
+                out[sval.name] = vals[sval.name]
+                continue
             if var.lod_level > 0 or _is_ragged(col):
                 out.update(self._pad_sequences(var, col))
             else:

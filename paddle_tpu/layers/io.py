@@ -33,6 +33,9 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
             name=f"{name}@len", shape=[-1], dtype="int32",
             stop_gradient=True, is_data=True,
         )
+        # companion feeds are emitted by the DataFeeder alongside their
+        # owner column; they are not reader columns of their own
+        len_var.is_companion = True
         var.seq_len = len_var
         return var
     if append_batch_size:

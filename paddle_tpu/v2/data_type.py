@@ -1,13 +1,24 @@
 """v2 data-type declarations (reference v2/data_type.py →
 trainer/PyDataProvider2.py InputType): each describes one feed slot; the
-layer.data builder turns them into typed data variables."""
+layer.data builder turns them into typed data variables.
+
+Sparse types are served as PADDED ID-LIST feeds, not dense multi-hot rows:
+a ``sparse_binary_vector(dim)`` row is a list of active indices, fed as an
+int64 id array + length mask, and consumed through the embedding-sum path —
+so the gradient is a SelectedRows sparse update over the touched rows
+(core/selected_rows.py), the TPU-native equivalent of the reference's
+scipy-CSR → Arguments feed (/root/reference/paddle/py_paddle/
+dataprovider_converter.py SparseBinaryScanner/SparseFloatScanner). At CTR
+dims (1e5+) this is what keeps the feed and the update O(nnz), not O(dim).
+"""
 
 
 class InputType:
-    def __init__(self, dim, seq_type, dtype):
+    def __init__(self, dim, seq_type, dtype, sparse=None):
         self.dim = dim
         self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
         self.dtype = dtype
+        self.sparse = sparse  # None | "binary" | "float"
 
 
 def dense_vector(dim):
@@ -31,6 +42,10 @@ def integer_value_sequence(value_range):
 
 
 def sparse_binary_vector(dim):
-    # served densely (multi-hot rows); the SelectedRows path handles true
-    # sparsity at the embedding level
-    return InputType(dim, 0, "float32")
+    """Rows are lists of active indices (multi-hot positions)."""
+    return InputType(dim, 0, "int64", sparse="binary")
+
+
+def sparse_float_vector(dim):
+    """Rows are lists of (index, value) pairs."""
+    return InputType(dim, 0, "int64", sparse="float")

@@ -14,25 +14,87 @@ def data(name, type: InputType, **kw):
     """data_layer. ``type`` is a data_type.* declaration; sequence types
     become padded+length feeds (lod_level=1). For integer types the dim is
     the VALUE RANGE (vocab/class count) — the tensor itself is one id per
-    (sequence) position, exactly the reference's InputType contract."""
+    (sequence) position, exactly the reference's InputType contract. The
+    declaration rides on the returned handle (``.input_type``) so downstream
+    builders (embedding, fc-over-sparse) can read the vocab/width from the
+    graph, as the reference's config_parser propagates LayerConfig input
+    sizes (/root/reference/python/paddle/trainer/config_parser.py)."""
+    if type.sparse:
+        # padded active-id list + length mask (+ values for sparse_float)
+        var = L.data(name, shape=[1], dtype="int64", lod_level=1)
+        if type.sparse == "float":
+            val = L.data(f"{name}@val", shape=[-1], dtype="float32",
+                         append_batch_size=False)
+            val.is_companion = True
+            var.sparse_values = val
+        var.input_type = type
+        return var
     width = 1 if type.dtype == "int64" else type.dim
-    return L.data(name, shape=[width], dtype=type.dtype,
-                  lod_level=1 if type.seq_type else 0)
+    var = L.data(name, shape=[width], dtype=type.dtype,
+                 lod_level=1 if type.seq_type else 0)
+    var.input_type = type
+    return var
+
+
+def _sparse_fc_branch(inp, size, param_attr):
+    """One fc branch over a sparse id-list input: sum of weight rows for the
+    active ids (optionally value-weighted) == multi-hot row @ W, but fed
+    O(nnz) and backed by SelectedRows sparse gradients."""
+    t = inp.input_type
+    emb = L.embedding(inp, size=[t.dim, size], param_attr=param_attr)
+    emb.seq_len = inp.seq_len
+    values = getattr(inp, "sparse_values", None)
+    if values is not None:
+        vals3 = L.reshape(values, shape=[0, -1, 1])
+        emb = L.elementwise_mul(emb, vals3)
+        emb.seq_len = inp.seq_len
+    return L.sequence_pool(emb, "sum")
+
+
+def _is_sparse(v):
+    t = getattr(v, "input_type", None)
+    return t is not None and t.sparse
 
 
 def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
-    """fc_layer. ``input`` may be a list (each gets its own weight)."""
-    return L.fc(input, size=size, act=_act.resolve(act),
-                param_attr=param_attr, bias_attr=bias_attr)
+    """fc_layer. ``input`` may be a list (each gets its own weight); sparse
+    id-list inputs route through the embedding-sum path. The bias (one per
+    fc, reference fc_layer contract) is carried by the dense sub-fc when
+    one exists, else created here so sparse-only fcs keep their bias."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    sparse = [v for v in inputs if _is_sparse(v)]
+    dense = [v for v in inputs if not _is_sparse(v)]
+    if not sparse:
+        return L.fc(input, size=size, act=_act.resolve(act),
+                    param_attr=param_attr, bias_attr=bias_attr)
+    from ..layers.layer_helper import LayerHelper
+
+    branches = [_sparse_fc_branch(v, size, param_attr) for v in sparse]
+    if dense:
+        # the dense sub-fc owns the (single) bias
+        branches.append(L.fc(dense, size=size, act=None,
+                             param_attr=param_attr, bias_attr=bias_attr))
+        return L.addto(branches, act=_act.resolve(act))
+    summed = L.addto(branches, act=None)
+    helper = LayerHelper("fc")
+    if bias_attr is not False:
+        summed = helper.append_bias_op(summed, bias_attr, size, dim_start=1)
+    return helper.append_activation(summed, _act.resolve(act))
 
 
 def embedding(input, size, param_attr=None, **kw):
-    """embedding_layer: size is the embedding dim."""
+    """embedding_layer: size is the embedding dim; the vocab comes from the
+    upstream data layer's InputType.dim (v1 DSL contract), overridable with
+    an explicit ``vocab_size`` kwarg."""
     vocab = kw.get("vocab_size")
     if vocab is None:
+        t = getattr(input, "input_type", None)
+        if t is not None:
+            vocab = t.dim
+    if vocab is None:
         raise ValueError(
-            "embedding(input, size, vocab_size=...) — the v1 DSL reads the "
-            "vocab from the data layer's dim; pass it explicitly here")
+            "embedding(): the input does not carry an InputType to read the "
+            "vocab from (it is not a data layer); pass vocab_size=...")
     return L.embedding(input, size=[vocab, size], param_attr=param_attr)
 
 

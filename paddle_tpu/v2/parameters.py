@@ -82,7 +82,8 @@ class Parameters:
     def data_vars(self, feeding: Optional[Dict[str, int]] = None,
                   program: Optional[Program] = None):
         block = (program or self.main_program).global_block
-        data_vars = [v for v in block.vars.values() if v.is_data]
+        data_vars = [v for v in block.vars.values()
+                     if v.is_data and not getattr(v, "is_companion", False)]
         if feeding:
             order = sorted(feeding, key=feeding.get)
             by_name = {v.name: v for v in data_vars}

@@ -1,0 +1,104 @@
+"""v1 front-end contract fidelity (VERDICT r2 Missing #3 / Next #4):
+
+- ``v2.layer.embedding`` reads the vocab from the upstream data layer's
+  InputType.dim (reference config_parser input-size propagation) instead of
+  demanding a ``vocab_size`` kwarg;
+- ``sparse_binary_vector`` / ``sparse_float_vector`` feeds travel as padded
+  id-lists (O(nnz)) into the embedding-sum path, not dense multi-hot rows
+  (reference py_paddle/dataprovider_converter.py sparse scanners).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.data_feeder import DataFeeder
+
+DIM = 100_000  # CTR-scale feature space
+
+
+def _rows(rng, n, nnz=6):
+    rows = []
+    for _ in range(n):
+        ids = sorted(rng.choice(DIM, size=nnz, replace=False).tolist())
+        seq = rng.randint(0, DIM, size=4).tolist()
+        fv = [(int(i), float(rng.rand() + 0.5)) for i in
+              rng.choice(DIM, size=3, replace=False)]
+        # teacher signal: depends on whether any "low" id is active
+        label = int(any(i < DIM // 2 for i in ids))
+        rows.append((ids, seq, fv, label))
+    return rows
+
+
+class TestV1SparseContract:
+    def _build(self):
+        paddle.init(use_gpu=False, trainer_count=1, seed=11)
+        feats = paddle.layer.data(
+            "feats", paddle.data_type.sparse_binary_vector(DIM))
+        ids = paddle.layer.data(
+            "ids", paddle.data_type.integer_value_sequence(DIM))
+        fvals = paddle.layer.data(
+            "fvals", paddle.data_type.sparse_float_vector(DIM))
+        label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+
+        # wide: fc straight over the sparse inputs (embedding-sum path)
+        wide = paddle.layer.fc(input=[feats, fvals], size=8,
+                               act=paddle.activation.Relu())
+        # deep: embedding with vocab INFERRED from the ids data layer
+        emb = paddle.layer.embedding(input=ids, size=8)
+        deep = paddle.layer.pooling(emb,
+                                    pooling_type=paddle.pooling.Sum())
+        both = paddle.layer.fc(input=[wide, deep], size=2)
+        cost = paddle.layer.classification_cost(input=both, label=label)
+        return cost
+
+    def test_embedding_vocab_inferred_from_data_layer(self):
+        paddle.init(use_gpu=False, trainer_count=1, seed=3)
+        ids = paddle.layer.data(
+            "ids2", paddle.data_type.integer_value_sequence(1234))
+        emb = paddle.layer.embedding(input=ids, size=4)
+        # the embedding table's first dim is the data layer's dim
+        table = emb.block.program.global_block.all_parameters()[-1]
+        assert table.shape[0] == 1234
+
+    def test_embedding_without_input_type_still_errors_clearly(self):
+        paddle.init(use_gpu=False, trainer_count=1, seed=3)
+        ids = paddle.layer.data(
+            "ids3", paddle.data_type.integer_value_sequence(50))
+        emb = paddle.layer.embedding(input=ids, size=4)
+        with pytest.raises(ValueError, match="vocab"):
+            paddle.layer.embedding(input=emb, size=4)
+
+    def test_sparse_feed_is_id_list_not_multihot(self):
+        cost = self._build()
+        parameters = paddle.parameters.create(cost)
+        feeder = DataFeeder(parameters.data_vars())
+        rng = np.random.RandomState(0)
+        feed = feeder.feed(_rows(rng, 8))
+        # O(nnz) feeds: padded id lists, nowhere near DIM wide
+        assert feed["feats"].shape == (8, 6) and feed["feats"].dtype == np.int64
+        assert feed["feats@len"].tolist() == [6] * 8
+        assert feed["fvals"].shape == (8, 3)
+        assert feed["fvals@val"].shape == (8, 3)
+        assert feed["fvals@val"].dtype == np.float32
+
+    def test_ctr_trains_at_1e5_dim(self):
+        cost = self._build()
+        parameters = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle.optimizer.Adam(learning_rate=5e-2))
+        rng = np.random.RandomState(7)
+        rows = _rows(rng, 64)
+
+        def reader():
+            for k in range(0, 64, 16):
+                yield rows[k:k + 16]
+
+        costs = []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                costs.append(e.cost)
+
+        trainer.train(reader, num_passes=8, event_handler=handler)
+        assert costs[-1] < 0.6 * costs[0], (costs[0], costs[-1])
