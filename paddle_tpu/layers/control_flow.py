@@ -164,6 +164,96 @@ class StaticRNN:
         return outs[0] if len(outs) == 1 else outs
 
 
+def _static_scalar_value(blocks, name):
+    """The value of ``name`` if its producer is a static fill_constant."""
+    for blk in blocks:
+        producer = None
+        for op in blk.ops:
+            if name in op.output_names():
+                producer = op  # keep the LAST producer (current version)
+        if producer is not None:
+            if producer.type == "fill_constant":
+                return producer.attrs.get("value")
+            return None
+    return None
+
+
+# Refuse to unroll absurdly long loops into a masked scan — a sentinel
+# limit like less_than(i, 1e9) must keep the dynamic lowering.
+_MAX_INFERRED_TRIP = 10_000
+
+
+def _producer_through_assigns(sub, name):
+    """The body op producing ``name``'s final value, assign chains
+    followed."""
+    writer = None
+    for op in sub.ops:
+        if name in op.output_names():
+            writer = op
+    seen = 0
+    while writer is not None and writer.type == "assign" and seen < 16:
+        seen += 1
+        src = (writer.inputs.get("X") or [None])[0]
+        writer = None
+        for op in sub.ops:
+            if src in op.output_names():
+                writer = op
+    return writer
+
+
+def _counter_step(sub, name) -> Optional[float]:
+    """If ``name`` is a verified loop counter — the body reassigns it to
+    increment(name, step) with step >= 1 (possibly through assigns) —
+    return the step; else None."""
+    writer = _producer_through_assigns(sub, name)
+    if (writer is not None and writer.type == "increment"
+            and (writer.inputs.get("X") or [None])[0] == name):
+        step = float(writer.attrs.get("step", 1.0))
+        if step >= 1.0:
+            return step
+    return None
+
+
+def _infer_trip_bound(sub, outer, cond_name) -> Optional[int]:
+    """Derive a static trip-count bound for a while body, the analogue of
+    the reference reading extents off the lod_rank_table when it
+    differentiates a dynamic while sub-block
+    (/root/reference/paddle/framework/backward.cc:415 + lod_rank_table.h).
+
+    Inference is deliberately conservative: it only fires when the
+    condition is ``less_than/less_equal(i, n)`` where ``i`` is a VERIFIED
+    counter (the body reassigns it to ``increment(i, step>=1)``) with a
+    static, non-negative start AND ``n`` is a static fill_constant — then
+    the bound is exactly ceil((n - start) / step), so the masked scan runs
+    the same trips the dynamic loop would. Anything else (runtime limits,
+    non-counter conditions, sentinel limits past _MAX_INFERRED_TRIP) keeps
+    the dynamic ``lax.while_loop`` lowering: a merely plausible bound
+    (e.g. a tensor-array extent) could silently truncate a loop whose
+    runtime limit runs longer.
+    """
+    import math
+
+    cond_op = _producer_through_assigns(sub, cond_name)
+    if cond_op is None or cond_op.type not in ("less_than", "less_equal"):
+        return None
+    xname = (cond_op.inputs.get("X") or [None])[0]
+    yname = (cond_op.inputs.get("Y") or [None])[0]
+    if xname is None or yname is None:
+        return None
+    step = _counter_step(sub, xname)
+    if step is None:
+        return None
+    start = _static_scalar_value((outer,), xname)
+    if start is None or start < 0:
+        return None
+    limit = _static_scalar_value((sub, outer), yname)
+    if limit is None:
+        return None
+    extra = 1 if cond_op.type == "less_equal" else 0
+    trips = max(int(math.ceil((float(limit) - start) / step)) + extra, 0)
+    return trips if trips <= _MAX_INFERRED_TRIP else None
+
+
 class While:
     """Functional while loop (fluid layers.While / while_op.cc).
 
@@ -181,7 +271,11 @@ class While:
         """``max_iters``: static trip-count bound. Setting it lowers the
         loop to a fixed-length masked scan, which makes the while
         reverse-differentiable (trainable) — see ops/control_flow_ops.py
-        while_op. Leave None for decode-side loops needing early exit."""
+        while_op. When left None, a bound is INFERRED from the loop
+        structure (static `less_than` limits or tensor-array extents —
+        _infer_trip_bound) so NMT-style decode-train loops differentiate
+        without hand-passing one; pass ``max_iters=0`` to force the dynamic
+        ``lax.while_loop`` lowering (true early exit, not trainable)."""
         self.helper = LayerHelper("while", main_program=main_program,
                                   startup_program=startup_program)
         self.cond = cond
@@ -220,6 +314,11 @@ class While:
                 f"{self.cond.name!r} (layers.assign(new_cond, output=cond))")
         carried = written
         body_ops, params = _collect_body(sub, carried)
+        max_iters = self.max_iters
+        if max_iters is None:
+            max_iters = _infer_trip_bound(sub, outer, self.cond.name)
+        elif max_iters == 0:
+            max_iters = None  # explicit request for the dynamic lowering
         ins = {
             "Carried": [outer.var(n) for n in carried],
             "Param": [outer.var(n) if outer.has_var(n) else n
@@ -230,7 +329,7 @@ class While:
             "carried_names": carried,
             "param_names": params,
             "cond_name": self.cond.name,
-            "max_iters": self.max_iters,
+            "max_iters": max_iters,
         }
         # Outputs write back to the SAME outer variables (final loop state).
         outputs = {"Out": [outer.var(n) for n in carried]}
