@@ -149,12 +149,17 @@ class Executor:
         if self.mesh is not None:
             # device_put is a no-op when the array already has the target
             # sharding; otherwise it reshards (e.g. state initialised by a
-            # single-device startup run).
-            feed_args = [jax.device_put(a, s)
+            # single-device startup run). On a multi-process mesh (DCN
+            # plane, parallel/multihost.py) host data destined for
+            # non-addressable devices goes through make_array_from_callback
+            # — every process provides the full array and keeps only its
+            # local shards, the analogue of each reference trainer feeding
+            # its slice of the global batch.
+            feed_args = [self._put(a, s)
                          for a, s in zip(feed_args, compiled.feed_shardings)]
-            ro_args = [jax.device_put(a, s)
+            ro_args = [self._put(a, s)
                        for a, s in zip(ro_args, compiled.ro_shardings)]
-            rw_args = [jax.device_put(a, s)
+            rw_args = [self._put(a, s)
                        for a, s in zip(rw_args, compiled.rw_shardings)]
         if compiled.uses_rng:
             rng = self._rng_state(program, scope)
@@ -171,8 +176,26 @@ class Executor:
             for name, val in zip(fetch_names, fetches):
                 _check_nan_inf(name, val)
         if return_numpy:
-            return [np.asarray(densify(v)) for v in fetches]
+            return [self._fetch_numpy(densify(v)) for v in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _fetch_numpy(v):
+        """np.asarray that also handles multi-process global arrays whose
+        local shards cover the full value (replicated or intra-process
+        sharded axes — the fetch contract on the DCN plane)."""
+        if not isinstance(v, jax.Array) or v.is_fully_addressable:
+            return np.asarray(v)
+        out = np.zeros(v.shape, v.dtype)
+        seen = np.zeros(v.shape, bool)
+        for sh in v.addressable_shards:
+            out[sh.index] = np.asarray(sh.data)
+            seen[sh.index] = True
+        if not seen.all():
+            raise ValueError(
+                "fetched value is not fully recoverable on this process; "
+                "fetch replicated values or gather explicitly")
+        return out
 
     # ------------------------------------------------------------------
     def as_function(self, program: Program, feed: Dict[str, Any],
@@ -201,6 +224,19 @@ class Executor:
         if compiled.uses_rng:
             args = args + (self._rng_state(program, scope),)
         return compiled.raw_fn, args
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _put(a, sharding):
+        if isinstance(a, jax.Array):
+            # device_put reshards device arrays, including global->global
+            # on a multi-process mesh (no-op when already right).
+            return jax.device_put(a, sharding)
+        if sharding.is_fully_addressable:
+            return jax.device_put(a, sharding)
+        arr = np.asarray(a)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
 
     # ------------------------------------------------------------------
     @staticmethod

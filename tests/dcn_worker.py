@@ -1,0 +1,91 @@
+"""Worker process for the two-process DCN rendezvous test.
+
+Each worker joins a localhost jax.distributed rendezvous (CPU backend, 4
+virtual devices per process), builds the SAME SPMD training step over a
+dp(across processes) x mp(within process) hybrid mesh, trains, and dumps its
+view of the losses and final parameters. The parent test
+(test_parallel.py::TestTwoProcessDCN) compares both workers against a
+fresh single-process 8-device run of the identical script — the analogue of
+the reference faking a multi-endpoint pserver fleet in one test binary
+(/root/reference/paddle/pserver/test/test_ParameterServer2.cpp:555-560),
+except the fleet here is real OS processes over a real rendezvous.
+
+Usage:
+  python dcn_worker.py single <out.npz>
+  python dcn_worker.py worker <coordinator> <pid> <nproc> <out.npz>
+"""
+import os
+import sys
+
+
+def run_training():
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.parallel import megatron_plan
+    from paddle_tpu.parallel.multihost import make_hybrid_mesh
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[16])
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=32, act="relu")
+        logits = layers.fc(h, size=8)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    startup.random_seed = 5
+    main_prog.random_seed = 5
+
+    mesh = make_hybrid_mesh({"dp": 2}, {"mp": 4})
+    exe = pt.Executor(mesh=mesh, plan=megatron_plan(mesh))
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 16).astype("float32")
+    ys = rng.randint(0, 8, size=(16, 1)).astype("int64")
+    losses = []
+    for _ in range(4):
+        out, = exe.run(main_prog, feed={"x": xs, "y": ys},
+                       fetch_list=[loss], scope=scope)
+        losses.append(np.asarray(out))
+
+    result = {"losses": np.asarray(losses, np.float64)}
+    for p in main_prog.global_block.all_parameters():
+        result["param:" + p.name] = exe._fetch_numpy(scope.get(p.name))
+    return result
+
+
+def main():
+    mode = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    n_local = 8 if mode == "single" else 4
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local}")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    if mode == "single":
+        outpath = sys.argv[2]
+    else:
+        coord, pid, nproc, outpath = (sys.argv[2], int(sys.argv[3]),
+                                      int(sys.argv[4]), sys.argv[5])
+        from paddle_tpu.parallel import multihost
+
+        multihost.initialize(coordinator_address=coord,
+                             num_processes=nproc, process_id=pid)
+        info = multihost.process_info()
+        assert info["process_count"] == nproc, info
+        assert info["global_devices"] == 8, info
+        assert info["local_devices"] == 4, info
+    res = run_training()
+    np.savez(outpath, **res)
+    print("OK", mode)
+
+
+if __name__ == "__main__":
+    main()

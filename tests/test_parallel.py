@@ -6,6 +6,8 @@ N gradient servers in one process): here N devices are faked by
 --xla_force_host_platform_device_count=8 (conftest.py) and the same GSPMD
 partitioner used on real TPUs runs the collectives.
 """
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -172,3 +174,63 @@ class TestMultihost:
 
         initialize_multihost()  # no coordinator env: must be a no-op
         initialize_multihost()
+
+
+class TestTwoProcessDCN:
+    """The multi-process branch of the DCN plane, actually executed
+    (VERDICT r2 Next #3): two OS processes, 4 virtual CPU devices each,
+    rendezvous over a localhost coordinator, one SPMD train step over a
+    dp=2-ACROSS-processes x mp=4 hybrid mesh. Losses and updated parameters
+    must match a fresh single-process 8-device run of the identical script
+    (to f32-ulp tolerance: the cross-process partitioner schedules the same
+    all-reduces with a different reduction order)."""
+
+    def test_two_process_training_matches_single_process(self, tmp_path):
+        import subprocess
+        import socket
+        import sys as _sys
+
+        worker = os.path.join(os.path.dirname(__file__), "dcn_worker.py")
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                            "COORDINATOR_ADDRESS", "NUM_PROCESSES",
+                            "PROCESS_ID")}
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(worker))]
+            + [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+               if p and "axon" not in p])
+
+        ref_out = str(tmp_path / "single.npz")
+        proc = subprocess.run([_sys.executable, worker, "single", ref_out],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+        assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-800:])
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        coord = f"127.0.0.1:{port}"
+        outs = [str(tmp_path / f"proc{i}.npz") for i in range(2)]
+        procs = [subprocess.Popen(
+            [_sys.executable, worker, "worker", coord, str(i), "2", outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)]
+        logs = [p.communicate(timeout=600) for p in procs]
+        for p, (so, se) in zip(procs, logs):
+            assert p.returncode == 0, (so[-800:], se[-800:])
+
+        ref = np.load(ref_out)
+        for i in range(2):
+            got = np.load(outs[i])
+            assert set(got.files) == set(ref.files)
+            for k in ref.files:
+                np.testing.assert_allclose(
+                    got[k], ref[k], rtol=2e-6, atol=1e-7,
+                    err_msg=f"proc{i} key {k}")
+        # and the two workers' views of the replicated state must be
+        # IDENTICAL to each other — they executed one shared program
+        got0, got1 = np.load(outs[0]), np.load(outs[1])
+        for k in got0.files:
+            np.testing.assert_array_equal(got0[k], got1[k],
+                                          err_msg=f"cross-worker {k}")
+
