@@ -2,7 +2,8 @@
 from .io import data
 from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
                  cross_entropy, dropout, embedding, fc, layer_norm,
-                 linear_chain_crf, lrn, pool2d, square_error_cost,
+                 linear_chain_crf, lrn, pool2d,
+                 sigmoid_cross_entropy_with_logits, square_error_cost,
                  softmax_with_cross_entropy, topk)
 from .attention import (multi_head_attention, switch_moe,
                         transformer_encoder_layer)
@@ -29,6 +30,7 @@ from .tensor import (argmax, assign, cast, concat, create_global_var,
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
      "dropout", "lrn", "cross_entropy", "softmax_with_cross_entropy",
+     "sigmoid_cross_entropy_with_logits",
      "square_error_cost", "accuracy", "topk",
      "linear_chain_crf", "crf_decoding", "chunk_eval",
      "fill_constant", "fill_constant_batch_size_like", "create_global_var", "cast", "concat", "sums", "assign",

@@ -198,6 +198,16 @@ def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, data_format="NCHW",
 
 
 # --- losses -----------------------------------------------------------------
+def sigmoid_cross_entropy_with_logits(x, label, main_program=None,
+                                      startup_program=None):
+    """Elementwise binary cross-entropy on logits (fluid layers.nn parity)."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits",
+                         main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("sigmoid_cross_entropy_with_logits",
+                            {"X": [x], "Label": [label]}, {})
+
+
 def cross_entropy(input, label, soft_label=False, main_program=None,
                   startup_program=None):
     helper = LayerHelper("cross_entropy", main_program=main_program,
