@@ -749,6 +749,256 @@ bool k_assign(Machine& m, const OpDesc& op) {
   return true;
 }
 
+// --- recurrent kernels -------------------------------------------------
+// The reference's C API serves gserver RNN gradient machines for
+// deployment (/root/reference/paddle/capi/gradient_machine.h); the
+// equivalents here are the scan kernels that ops/rnn_ops.py runs on TPU,
+// re-expressed as plain loops: lookup_table -> mul -> lstm/gru ->
+// sequence_pool -> mul is the classic saved text-classifier graph.
+
+Tensor* opt_in(Machine& m, const OpDesc& op, const std::string& slot) {
+  auto it = op.ins.find(slot);
+  if (it == op.ins.end() || it->second.empty()) return nullptr;
+  return lookup(m, it->second[0]);
+}
+
+bool has_out(const OpDesc& op, const std::string& slot) {
+  auto it = op.outs.find(slot);
+  return it != op.outs.end() && !it->second.empty();
+}
+
+float apply_act(const std::string& kind, float v) {
+  if (kind == "sigmoid") return 1.f / (1.f + std::exp(-v));
+  if (kind == "tanh") return std::tanh(v);
+  if (kind == "relu") return v > 0.f ? v : 0.f;
+  return v;  // identity
+}
+
+bool k_lookup_table(Machine& m, const OpDesc& op) {
+  Tensor *w, *ids;
+  if (!need(m, op, "W", &w) || !need(m, op, "Ids", &ids)) return false;
+  int64_t V = w->shape[0], D = w->shape[1];
+  bool squeeze = ids->shape.size() > 1 && ids->shape.back() == 1;
+  int64_t n = ids->numel();
+  double pad = op.attr_num("padding_idx", -1);
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = ids->shape;
+  if (squeeze) o.shape.pop_back();
+  o.shape.push_back(D);
+  o.data.resize(static_cast<size_t>(n * D));
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t id = static_cast<int64_t>(ids->data[static_cast<size_t>(i)]);
+    if (id < 0 || id >= V) {
+      m.error = "lookup_table: id " + std::to_string(id) +
+                " out of range [0, " + std::to_string(V) + ")";
+      return false;
+    }
+    float* row = o.data.data() + i * D;
+    if (pad >= 0 && id == static_cast<int64_t>(pad)) {
+      for (int64_t d = 0; d < D; ++d) row[d] = 0.f;
+    } else {
+      const float* src = w->data.data() + id * D;
+      for (int64_t d = 0; d < D; ++d) row[d] = src[d];
+    }
+  }
+  return true;
+}
+
+bool k_lstm(Machine& m, const OpDesc& op) {
+  Tensor *x, *w;  // x: [b, T, 4h] pre-projected; w: [h, 4h]
+  if (!need(m, op, "Input", &x) || !need(m, op, "Weight", &w)) return false;
+  Tensor* bias = opt_in(m, op, "Bias");   // [1, 4h] or [1, 7h] w/ peepholes
+  Tensor* len = opt_in(m, op, "Length");  // [b]
+  Tensor* h0 = opt_in(m, op, "H0");
+  Tensor* c0 = opt_in(m, op, "C0");
+  int64_t B = x->shape[0], T = x->shape[1], H4 = x->shape[2], H = H4 / 4;
+  bool peep = op.attr_bool("use_peepholes", false);
+  bool rev = op.attr_bool("is_reverse", false);
+  std::string ag = op.attr_str("gate_activation", "sigmoid");
+  std::string ac = op.attr_str("candidate_activation", "tanh");
+  std::string ah = op.attr_str("cell_activation", "tanh");
+
+  Tensor& hid = set_out(m, op, "Hidden");
+  hid.shape = {B, T, H};
+  hid.data.assign(static_cast<size_t>(B * T * H), 0.f);
+  std::vector<float> hbuf(static_cast<size_t>(B * H), 0.f);
+  std::vector<float> cbuf(static_cast<size_t>(B * H), 0.f);
+  if (h0) hbuf.assign(h0->data.begin(), h0->data.end());
+  if (c0) cbuf.assign(c0->data.begin(), c0->data.end());
+  std::vector<float> cell_seq;
+  if (has_out(op, "Cell"))
+    cell_seq.assign(static_cast<size_t>(B * T * H), 0.f);
+
+  std::vector<float> gates(static_cast<size_t>(H4));
+  for (int64_t step = 0; step < T; ++step) {
+    int64_t t = rev ? T - 1 - step : step;
+    for (int64_t n = 0; n < B; ++n) {
+      bool active = !len ||
+          t < static_cast<int64_t>(len->data[static_cast<size_t>(n)]);
+      float* hrow = hbuf.data() + n * H;
+      float* crow = cbuf.data() + n * H;
+      if (!active) continue;  // frozen state, zero output row (mask calc)
+      const float* xrow = x->data.data() + (n * T + t) * H4;
+      // gates = x_t + h @ W (+ bias); gate order (c, i, f, o)
+      for (int64_t j = 0; j < H4; ++j)
+        gates[static_cast<size_t>(j)] =
+            xrow[j] + (bias ? bias->data[static_cast<size_t>(j)] : 0.f);
+      for (int64_t k = 0; k < H; ++k) {
+        float hv = hrow[k];
+        if (hv == 0.f) continue;
+        const float* wrow = w->data.data() + k * H4;
+        for (int64_t j = 0; j < H4; ++j)
+          gates[static_cast<size_t>(j)] += hv * wrow[j];
+      }
+      const float* pw = (peep && bias) ? bias->data.data() + 4 * H : nullptr;
+      for (int64_t k = 0; k < H; ++k) {
+        float gc = gates[static_cast<size_t>(k)];
+        float gi = gates[static_cast<size_t>(H + k)];
+        float gf = gates[static_cast<size_t>(2 * H + k)];
+        float go = gates[static_cast<size_t>(3 * H + k)];
+        if (pw) {
+          gi += pw[k] * crow[k];          // W_ic
+          gf += pw[H + k] * crow[k];      // W_fc
+        }
+        float i = apply_act(ag, gi);
+        float f = apply_act(ag, gf);
+        float cn = f * crow[k] + i * apply_act(ac, gc);
+        if (pw) go += pw[2 * H + k] * cn;  // W_oc on NEW cell
+        float o = apply_act(ag, go);
+        float hn = o * apply_act(ah, cn);
+        crow[k] = cn;
+        hrow[k] = hn;
+        hid.data[static_cast<size_t>((n * T + t) * H + k)] = hn;
+        if (!cell_seq.empty())
+          cell_seq[static_cast<size_t>((n * T + t) * H + k)] = cn;
+      }
+    }
+  }
+  if (has_out(op, "Cell")) {
+    Tensor& c = set_out(m, op, "Cell");
+    c.shape = {B, T, H};
+    c.data = std::move(cell_seq);
+  }
+  if (has_out(op, "LastH")) {
+    Tensor& lh = set_out(m, op, "LastH");
+    lh.shape = {B, H};
+    lh.data = hbuf;
+  }
+  if (has_out(op, "LastC")) {
+    Tensor& lc = set_out(m, op, "LastC");
+    lc.shape = {B, H};
+    lc.data = cbuf;
+  }
+  return true;
+}
+
+bool k_gru(Machine& m, const OpDesc& op) {
+  Tensor *x, *w;  // x: [b, T, 3h] pre-projected; w: [h, 3h]
+  if (!need(m, op, "Input", &x) || !need(m, op, "Weight", &w)) return false;
+  Tensor* bias = opt_in(m, op, "Bias");   // [1, 3h], added to x upfront
+  Tensor* len = opt_in(m, op, "Length");
+  Tensor* h0 = opt_in(m, op, "H0");
+  int64_t B = x->shape[0], T = x->shape[1], H3 = x->shape[2], H = H3 / 3;
+  bool rev = op.attr_bool("is_reverse", false);
+  std::string ag = op.attr_str("gate_activation", "sigmoid");
+  std::string ac = op.attr_str("activation", "tanh");
+
+  Tensor& hid = set_out(m, op, "Hidden");
+  hid.shape = {B, T, H};
+  hid.data.assign(static_cast<size_t>(B * T * H), 0.f);
+  std::vector<float> hbuf(static_cast<size_t>(B * H), 0.f);
+  if (h0) hbuf.assign(h0->data.begin(), h0->data.end());
+
+  std::vector<float> g(static_cast<size_t>(2 * H)), cand(static_cast<size_t>(H));
+  for (int64_t step = 0; step < T; ++step) {
+    int64_t t = rev ? T - 1 - step : step;
+    for (int64_t n = 0; n < B; ++n) {
+      bool active = !len ||
+          t < static_cast<int64_t>(len->data[static_cast<size_t>(n)]);
+      if (!active) continue;
+      float* hrow = hbuf.data() + n * H;
+      const float* xrow = x->data.data() + (n * T + t) * H3;
+      // u|r gates: act(x_g + h @ W[:, :2h])
+      for (int64_t j = 0; j < 2 * H; ++j)
+        g[static_cast<size_t>(j)] =
+            xrow[j] + (bias ? bias->data[static_cast<size_t>(j)] : 0.f);
+      for (int64_t k = 0; k < H; ++k) {
+        float hv = hrow[k];
+        if (hv == 0.f) continue;
+        const float* wrow = w->data.data() + k * H3;
+        for (int64_t j = 0; j < 2 * H; ++j)
+          g[static_cast<size_t>(j)] += hv * wrow[j];
+      }
+      for (int64_t j = 0; j < 2 * H; ++j)
+        g[static_cast<size_t>(j)] = apply_act(ag, g[static_cast<size_t>(j)]);
+      // candidate: act(x_c + (r . h) @ W[:, 2h:])
+      for (int64_t k = 0; k < H; ++k)
+        cand[static_cast<size_t>(k)] = xrow[2 * H + k] +
+            (bias ? bias->data[static_cast<size_t>(2 * H + k)] : 0.f);
+      for (int64_t k = 0; k < H; ++k) {
+        float rh = g[static_cast<size_t>(H + k)] * hrow[k];
+        if (rh == 0.f) continue;
+        const float* wrow = w->data.data() + k * H3 + 2 * H;
+        for (int64_t j = 0; j < H; ++j)
+          cand[static_cast<size_t>(j)] += rh * wrow[j];
+      }
+      for (int64_t k = 0; k < H; ++k) {
+        float u = g[static_cast<size_t>(k)];
+        float hn = (1.f - u) * hrow[k] + u * apply_act(ac, cand[static_cast<size_t>(k)]);
+        hrow[k] = hn;
+        hid.data[static_cast<size_t>((n * T + t) * H + k)] = hn;
+      }
+    }
+  }
+  if (has_out(op, "LastH")) {
+    Tensor& lh = set_out(m, op, "LastH");
+    lh.shape = {B, H};
+    lh.data = hbuf;
+  }
+  return true;
+}
+
+bool k_sequence_pool(Machine& m, const OpDesc& op) {
+  Tensor* x;  // [b, T, F...]
+  if (!need(m, op, "X", &x)) return false;
+  Tensor* len = opt_in(m, op, "Length");
+  std::string ptype = op.attr_str("pool_type", "average");
+  for (auto& ch : ptype) ch = static_cast<char>(tolower(ch));
+  int64_t B = x->shape[0], T = x->shape[1];
+  int64_t F = 1;
+  for (size_t i = 2; i < x->shape.size(); ++i) F *= x->shape[i];
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.assign(1, B);
+  for (size_t i = 2; i < x->shape.size(); ++i) o.shape.push_back(x->shape[i]);
+  o.data.assign(static_cast<size_t>(B * F), 0.f);
+  for (int64_t n = 0; n < B; ++n) {
+    int64_t L = len ? static_cast<int64_t>(len->data[static_cast<size_t>(n)]) : T;
+    if (L > T) L = T;
+    float* orow = o.data.data() + n * F;
+    if (L <= 0) continue;  // empty sequences pool to 0
+    const float* base = x->data.data() + n * T * F;
+    if (ptype == "first") {
+      for (int64_t f = 0; f < F; ++f) orow[f] = base[f];
+    } else if (ptype == "last") {
+      for (int64_t f = 0; f < F; ++f) orow[f] = base[(L - 1) * F + f];
+    } else if (ptype == "max") {
+      for (int64_t f = 0; f < F; ++f) orow[f] = base[f];
+      for (int64_t t = 1; t < L; ++t)
+        for (int64_t f = 0; f < F; ++f)
+          orow[f] = std::max(orow[f], base[t * F + f]);
+    } else {  // sum / average / sqrt
+      for (int64_t t = 0; t < L; ++t)
+        for (int64_t f = 0; f < F; ++f) orow[f] += base[t * F + f];
+      if (ptype == "average")
+        for (int64_t f = 0; f < F; ++f) orow[f] /= static_cast<float>(L);
+      else if (ptype == "sqrt")
+        for (int64_t f = 0; f < F; ++f)
+          orow[f] /= std::sqrt(static_cast<float>(L));
+    }
+  }
+  return true;
+}
+
 bool run_op(Machine& m, const OpDesc& op) {
   const std::string& t = op.type;
   if (t == "mul") return k_mul(m, op);
@@ -779,10 +1029,15 @@ bool run_op(Machine& m, const OpDesc& op) {
   if (t == "mean") return k_mean(m, op);
   if (t == "transpose") return k_transpose(m, op);
   if (t == "assign") return k_assign(m, op);
+  if (t == "lookup_table") return k_lookup_table(m, op);
+  if (t == "lstm") return k_lstm(m, op);
+  if (t == "gru") return k_gru(m, op);
+  if (t == "sequence_pool") return k_sequence_pool(m, op);
   m.error = "unsupported op in capi inference machine: '" + t +
             "' (supported: mul, elementwise_*, relu/sigmoid/tanh/exp/sqrt/"
             "abs/square, softmax, conv2d, pool2d, batch_norm, reshape, "
-            "concat, scale, dropout, mean, transpose, assign)";
+            "concat, scale, dropout, mean, transpose, assign, lookup_table, "
+            "lstm, gru, sequence_pool)";
   return false;
 }
 

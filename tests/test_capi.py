@@ -109,3 +109,66 @@ class TestCapiErrors:
         with InferenceMachine(d) as machine:
             with pytest.raises(RuntimeError, match="not set"):
                 machine.run({})
+
+
+class TestCapiRnn:
+    """Saved RNN models deploy through the C machine — the reference capi's
+    gserver-RNN serving surface (/root/reference/paddle/capi/
+    gradient_machine.h) re-expressed over the scan kernels."""
+
+    def test_lstm_classifier_matches_executor(self, tmp_path):
+        vocab, hidden = 50, 16
+
+        def build():
+            words = layers.data("words", shape=[1], dtype="int64",
+                                lod_level=1)
+            emb = layers.embedding(words, size=[vocab, hidden])
+            emb.seq_len = words.seq_len
+            x1 = layers.fc(emb, size=4 * hidden, num_flatten_dims=2,
+                           bias_attr=False)
+            x1.seq_len = words.seq_len
+            h, _ = layers.dynamic_lstm(x1, 4 * hidden)
+            pooled = layers.sequence_pool(h, "max")
+            logits = layers.fc(pooled, size=3)
+            return [words, words.seq_len], [layers.softmax(logits)]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, vocab, size=(4, 7)).astype(np.int64)
+        lens = np.array([7, 3, 5, 1], np.int32)
+        feed = {"words": ids, "words@len": lens}
+        ref, = exe.run(main, feed=feed, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run(feed)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+    def test_gru_tagger_matches_executor(self, tmp_path):
+        vocab, hidden = 30, 8
+
+        def build():
+            words = layers.data("words", shape=[1], dtype="int64",
+                                lod_level=1)
+            emb = layers.embedding(words, size=[vocab, hidden])
+            emb.seq_len = words.seq_len
+            x1 = layers.fc(emb, size=3 * hidden, num_flatten_dims=2,
+                           bias_attr=False)
+            x1.seq_len = words.seq_len
+            h = layers.dynamic_gru(x1, hidden, is_reverse=True)
+            last = layers.sequence_pool(h, "first")  # reverse: first = last
+            return [words, words.seq_len], [layers.fc(last, size=2)]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, vocab, size=(3, 5)).astype(np.int64)
+        lens = np.array([5, 2, 4], np.int32)
+        feed = {"words": ids, "words@len": lens}
+        ref, = exe.run(main, feed=feed, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run(feed)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
