@@ -174,6 +174,10 @@ define_bool("use_amp", False,
             "programs (TPU analogue of the float16 plane)")
 define_string("mxu_precision", "default",
               "MXU contraction precision: default | high | highest")
+define_bool("fused_linear_grad", True,
+            "use the fused Pallas dX+dW backward for linear/1x1-conv "
+            "layers on TPU (kernels/linear_grad.py); disable to fall "
+            "back to XLA's separate gradient dots")
 define_int32("seed", 0,
              "global graph RNG seed used when a program sets no "
              "random_seed of its own (ThreadLocalRand analogue); runs "

@@ -80,11 +80,14 @@ def linear_bwd(x, dy, w, precision=None):
     or the weight-resident VMEM footprint doesn't fit (e.g. vocab-sized
     heads, where XLA's own tiling over O is the right schedule anyway).
     """
+    from ..flags import FLAGS
+
     R, I = x.shape
     O = w.shape[1]
+    use_pallas = FLAGS.fused_linear_grad and jax.default_backend() == "tpu"
     block_r = (_pick_block(R, I, O, x.dtype.itemsize, dy.dtype.itemsize,
                            w.dtype.itemsize)
-               if jax.default_backend() == "tpu" else 0)
+               if use_pallas else 0)
     if block_r == 0:
         dx = jax.lax.dot_general(dy, w, (((1,), (1,)), ((), ())),
                                  precision=precision)
