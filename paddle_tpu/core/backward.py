@@ -36,6 +36,7 @@ NON_DIFFERENTIABLE = {
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "argmax", "one_hot", "truncated_gaussian_random",
+    "gaussian_random_batch_size_like",
     # decode-side: generation is not trained through
     "beam_search_decoder",
 }

@@ -24,8 +24,10 @@ from .legacy import (addto, dot_prod, factorization_machine, gated_unit,
                      row_l2_norm, sampling_id, scale_shift, scaling,
                      sequence_reshape, slope_intercept, sum_to_one_norm)
 from .tensor import (argmax, assign, cast, concat, create_global_var,
-                     fill_constant, fill_constant_batch_size_like, matmul,
-                     mean, one_hot, reshape, scale, split, sums, transpose)
+                     fill_constant, fill_constant_batch_size_like,
+                     gaussian_random_batch_size_like, matmul,
+                     mean, one_hot, reduce_max, reduce_mean, reduce_min,
+                     reduce_sum, reshape, scale, split, sums, transpose)
 
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
@@ -33,7 +35,10 @@ __all__ = (
      "sigmoid_cross_entropy_with_logits",
      "square_error_cost", "accuracy", "topk",
      "linear_chain_crf", "crf_decoding", "chunk_eval",
-     "fill_constant", "fill_constant_batch_size_like", "create_global_var", "cast", "concat", "sums", "assign",
+     "fill_constant", "fill_constant_batch_size_like",
+     "gaussian_random_batch_size_like",
+     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+     "create_global_var", "cast", "concat", "sums", "assign",
      "matmul", "mean", "scale", "reshape", "transpose", "split", "one_hot", "argmax",
      "sequence_pool", "sequence_first_step", "sequence_last_step",
      "sequence_softmax", "sequence_expand", "sequence_reverse",

@@ -109,6 +109,40 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
          "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx})
 
 
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    dtype="float32", input_dim_idx=0,
+                                    output_dim_idx=0, main_program=None,
+                                    startup_program=None):
+    """Gaussian noise with a batch dim copied from ``input``
+    (gaussian_random_batch_size_like_op.cc); gradients do not flow into
+    it — the reparameterization-trick noise leaf."""
+    helper = _helper("gaussian_random_batch_size_like", main_program,
+                     startup_program)
+    return helper.simple_op(
+        "gaussian_random_batch_size_like", {"Input": [input]},
+        {"shape": list(shape), "dtype": str(dtype), "mean": mean,
+         "std": std, "input_dim_idx": input_dim_idx,
+         "output_dim_idx": output_dim_idx})
+
+
+def _reduce_layer(op_type):
+    def layer(x, dim=None, keep_dim=False, main_program=None,
+              startup_program=None):
+        h = _helper(op_type, main_program, startup_program)
+        return h.simple_op(op_type, {"X": [x]},
+                           {"dim": dim, "keep_dim": keep_dim,
+                            "reduce_all": dim is None})
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
            main_program=None, startup_program=None):
     """Batched matmul (matmul_op.cc): used for attention score/context

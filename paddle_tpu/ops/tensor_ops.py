@@ -29,15 +29,21 @@ def fill_constant(attrs, ins):
     return out(Out=jnp.full(shape, attrs.get("value", 0.0), dtype=dtype))
 
 
+def _batch_size_like_shape(attrs, ref):
+    """Declared shape with the output batch dim copied from ``ref``'s
+    (the *_batch_size_like op family contract)."""
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)]
+    return tuple(shape)
+
+
 @register_op("fill_constant_batch_size_like")
 def fill_constant_batch_size_like(attrs, ins):
     ref = single(ins, "Input")
-    shape = list(attrs["shape"])
-    in_idx = attrs.get("input_dim_idx", 0)
-    out_idx = attrs.get("output_dim_idx", 0)
-    shape[out_idx] = ref.shape[in_idx]
     dtype = to_dtype(attrs.get("dtype", "float32"))
-    return out(Out=jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dtype))
+    return out(Out=jnp.full(_batch_size_like_shape(attrs, ref),
+                            attrs.get("value", 0.0), dtype=dtype))
 
 
 @register_op("gaussian_random", needs_rng=True)
@@ -47,6 +53,18 @@ def gaussian_random(attrs, ins, rng):
     mean = attrs.get("mean", 0.0)
     std = attrs.get("std", 1.0)
     return out(Out=mean + std * jax.random.normal(rng, shape, dtype=dtype))
+
+
+@register_op("gaussian_random_batch_size_like", needs_rng=True)
+def gaussian_random_batch_size_like(attrs, ins, rng):
+    """Gaussian noise whose batch dim copies Input's
+    (gaussian_random_batch_size_like_op.cc) — the reparameterization-trick
+    noise source: an rng LEAF, so grads flow only through mu/sigma."""
+    ref = single(ins, "Input")
+    dtype = to_dtype(attrs.get("dtype", "float32"))
+    noise = jax.random.normal(rng, _batch_size_like_shape(attrs, ref),
+                              dtype=dtype)
+    return out(Out=attrs.get("mean", 0.0) + attrs.get("std", 1.0) * noise)
 
 
 @register_op("uniform_random", needs_rng=True)
