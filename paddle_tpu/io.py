@@ -153,11 +153,36 @@ def load_persistables(executor, dirname, main_program=None, scope=None):
 # Inference model: program pruning + save
 # --------------------------------------------------------------------------
 def prune_program(program: Program, feed_names: List[str],
-                  fetch_names: List[str]) -> Program:
+                  fetch_names: List[str], for_test: bool = True) -> Program:
     """Slice the program to the subgraph producing ``fetch_names`` from
-    ``feed_names`` (the reference's prune.cc / inference_optimize)."""
+    ``feed_names`` (the reference's prune.cc / inference_optimize).
+
+    ``for_test`` flips every op's ``is_test`` attr like the reference's
+    inference_optimize — a saved inference model must read running BN
+    stats and use deterministic dropout even when pruned straight from a
+    training program. Composite ``seg_fwd`` ops (recompute segments,
+    core/backward.py) are expanded back into their plain forward ops
+    first: checkpointing only matters when training, and a flat op list
+    keeps the saved artifact consumable by every backend (including the
+    native C machine)."""
     pruned = program.clone()
     block = pruned.global_block
+    flat = []
+    for op in block.ops:
+        if op.type == "seg_fwd":
+            from .core.program import Operator
+
+            for sop in op.attrs["seg_ops"]:
+                flat.append(Operator(block, sop["type"], sop["ins"],
+                                     sop["outs"], sop["attrs"]))
+        else:
+            flat.append(op)
+    if for_test:
+        for op in flat:
+            if "is_test" in op.attrs:
+                op.attrs = dict(op.attrs)
+                op.attrs["is_test"] = True
+    block.ops = flat
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):

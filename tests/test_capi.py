@@ -172,3 +172,44 @@ class TestCapiRnn:
             got, = machine.run(feed)
         np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
                                    atol=1e-5)
+
+
+class TestCapiRecomputeTrainedModel:
+    def test_segments_expand_into_plain_ops_on_save(self, tmp_path):
+        """A model TRAINED with recompute segments saves as a flat op list
+        (no seg_fwd composites) and serves through the C machine."""
+        import paddle_tpu.models as models
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[8, 8, 3])
+            label = layers.data("label", shape=[1], dtype="int64")
+            logits = models.resnet_cifar10(img, num_classes=4, depth=8,
+                                           recompute=True)
+            probs = layers.softmax(logits)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 8, 8, 3).astype(np.float32)
+        exe.run(main, feed={"img": x,
+                            "label": np.zeros((2, 1), np.int64)},
+                fetch_list=[loss], scope=scope)
+        assert any(op.type == "seg_fwd" for op in main.global_block.ops)
+        d = str(tmp_path / "m")
+        pt.io.save_inference_model(d, ["img"], [probs],
+                                   exe, main_program=main, scope=scope)
+        prog, _, fetches = pt.io.load_inference_model(d, exe)
+        assert not any("seg" in op.type for op in prog.global_block.ops)
+        ref, = exe.run(prog, feed={"img": x}, fetch_list=fetches,
+                       scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run({"img": x})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
