@@ -350,6 +350,115 @@ def int_label_pipeline():
     return "one_hot ok"
 
 
+@check
+def fused_linear_backward_matches_xla():
+    """The Pallas fused dX+dW kernel (kernels/linear_grad.py) vs XLA's
+    separate gradient dots, bf16 operands, shapes covering every ResNet
+    1x1-conv stage plus a transformer FFN block."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.linear_grad import linear_bwd
+
+    rng = np.random.RandomState(7)
+    errs = []
+    for (R, I, O) in [(1024, 256, 64), (12544, 2048, 512),
+                      (2048, 64, 256), (4096, 1024, 4096)]:
+        x = jnp.asarray(rng.randn(R, I), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(I, O), jnp.bfloat16)
+        dy = jnp.asarray(rng.randn(R, O), jnp.bfloat16)
+        dx, dw = jax.jit(linear_bwd)(x, dy, w)
+        dxr = (dy.astype(jnp.float32)
+               @ w.astype(jnp.float32).T).astype(jnp.bfloat16)
+        dwr = (x.astype(jnp.float32).T
+               @ dy.astype(jnp.float32)).astype(jnp.bfloat16)
+        e1 = float(jnp.max(jnp.abs(dx.astype(jnp.float32)
+                                   - dxr.astype(jnp.float32))))
+        s1 = max(float(jnp.max(jnp.abs(dxr.astype(jnp.float32)))), 1.0)
+        e2 = float(jnp.max(jnp.abs(dw.astype(jnp.float32)
+                                   - dwr.astype(jnp.float32))))
+        s2 = max(float(jnp.max(jnp.abs(dwr.astype(jnp.float32)))), 1.0)
+        assert e1 < 2e-2 * s1, (R, I, O, "dx", e1, s1)
+        assert e2 < 2e-2 * s2, (R, I, O, "dw", e2, s2)
+        errs.append(f"{R}x{I}x{O}: {e1/s1:.1e}/{e2/s2:.1e}")
+    return "; ".join(errs)
+
+
+@check
+def fused_linear_backward_trains_through_mul():
+    """End-to-end: the mul op's custom vjp (fused backward) gives the same
+    training trajectory as the XLA-dot fallback (--fused_linear_grad=0)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    def run(flag):
+        pt.flags.FLAGS.fused_linear_grad = flag
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[128])
+                y = layers.data("y", shape=[1], dtype="int64")
+                h = layers.fc(x, size=256, act="relu")
+                logits = layers.fc(h, size=8)
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, y))
+                pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+                    loss, startup_program=startup)
+            main.random_seed = startup.random_seed = 3
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(0)
+            xs = rng.rand(256, 128).astype(np.float32)
+            ys = rng.randint(0, 8, size=(256, 1)).astype(np.int64)
+            return [float(exe.run(main, feed={"x": xs, "y": ys},
+                                  fetch_list=[loss], scope=scope)[0])
+                    for _ in range(5)]
+        finally:
+            pt.flags.FLAGS.fused_linear_grad = True
+
+    fused = run(True)
+    plain = run(False)
+    for a, b in zip(fused, plain):
+        assert abs(a - b) < 5e-3 * max(abs(b), 1.0), (fused, plain)
+    assert fused[-1] < fused[0]
+    return f"loss {fused[0]:.3f}->{fused[-1]:.3f}, matches fallback"
+
+
+@check
+def flash_attention_d128_matches_reference():
+    """d_head=128 (the bench transformer's head width) through the flash
+    kernel fwd+bwd vs the jnp reference."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.flash_attention import (flash_attention,
+                                                    reference_attention)
+
+    rng = np.random.RandomState(11)
+    B, H, T, D = 1, 2, 512, 128
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32) * 0.2)
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32) * 0.2)
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+
+    def loss(attn, q, k, v):
+        o = attn(q, k, v, causal=True)
+        return jnp.sum(o * jnp.sin(o))
+
+    got = np.asarray(flash_attention(q, k, v, causal=True))
+    ref = np.asarray(reference_attention(q, k, v, None, True, None))
+    err_f = np.abs(got - ref).max()
+    assert err_f < 2e-2, err_f
+    gf = jax.jit(jax.grad(lambda q, k, v: loss(flash_attention, q, k, v),
+                          argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(
+        lambda q, k, v: loss(reference_attention, q, k, v),
+        argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        err = float(jnp.abs(a - b).max())
+        scale = max(float(jnp.abs(b).max()), 1.0)
+        assert err < 2e-2 * scale, (name, err, scale)
+    return f"fwd err {err_f:.1e}"
+
+
 def main():
     failures = 0
     for fn in CHECKS:
