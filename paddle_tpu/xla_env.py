@@ -31,12 +31,28 @@ def strip_host_device_flag(flags: str) -> str:
     return " ".join(kept)
 
 
+def _strip_tunnel_shim(env: dict) -> None:
+    """Drop the dev-tunnel site shim from PYTHONPATH for CPU children.
+
+    JAX_PLATFORMS=cpu alone does not stop the tunnel plugin from
+    initializing during backend discovery, and when the tunnel is down
+    that initialization can HANG rather than fail — observed wedging even
+    pure-CPU children for hours. CPU children must not load it at all."""
+    pp = env.get("PYTHONPATH", "")
+    kept = [p for p in pp.split(os.pathsep) if p and "axon" not in p]
+    if kept:
+        env["PYTHONPATH"] = os.pathsep.join(kept)
+    else:
+        env.pop("PYTHONPATH", None)
+
+
 def cpu_mesh_env(base_env: dict, n_devices: int) -> dict:
     """Child-process env for an n-device virtual CPU mesh."""
     env = dict(base_env)
     flags = strip_host_device_flag(env.get("XLA_FLAGS", ""))
     env["XLA_FLAGS"] = (flags + f" {_FORCE_FLAG}={n_devices}").strip()
     env["JAX_PLATFORMS"] = "cpu"
+    _strip_tunnel_shim(env)
     return env
 
 
@@ -45,6 +61,7 @@ def cpu_env(base_env: dict) -> dict:
     env = dict(base_env)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = strip_host_device_flag(env.get("XLA_FLAGS", ""))
+    _strip_tunnel_shim(env)
     return env
 
 
