@@ -108,10 +108,38 @@ class LayerHelper:
         }
         if isinstance(outputs, (list, tuple)):
             out_slots = list(outputs)
-            abstract_ins = {
-                slot: [_abstract(self.block.var(n)) for n in names]
-                for slot, names in in_names.items()
-            }
+            try:
+                abstract_ins = {
+                    slot: [_abstract(self.block.var(n)) for n in names]
+                    for slot, names in in_names.items()
+                }
+            except KeyError as exc:
+                # The classic build mistake: a handle from program A fed to
+                # a layer built while program B is current (e.g. a layer
+                # call on a `return` line after `with program_guard(...)`
+                # exited). Name the likely cause instead of a bare KeyError.
+                for slot, names in in_names.items():
+                    for n in names:
+                        if not self.block.has_var(n):
+                            for v in inputs.get(slot, []):
+                                if (getattr(v, "name", None) == n
+                                        and getattr(v, "block", None)
+                                        is not None
+                                        and v.block.program
+                                        is not self.main_program):
+                                    raise EnforceError(
+                                        f"layer {self.layer_type!r}: input "
+                                        f"{n!r} belongs to a DIFFERENT "
+                                        "Program than the one currently "
+                                        "being built — layers must be "
+                                        "called inside the program_guard "
+                                        "that owns their inputs"
+                                    ) from exc
+                            raise EnforceError(
+                                f"layer {self.layer_type!r}: input {n!r} is "
+                                "not defined in the current program"
+                            ) from exc
+                raise
             try:
                 inferred = infer_outputs(op_type, attrs, abstract_ins)
             except EnforceError:

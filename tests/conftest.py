@@ -36,6 +36,10 @@ def fresh_programs():
     prog_mod._startup_program = prog_mod.Program()
     scope_mod._global_scope = scope_mod.Scope()
     np.random.seed(0)
+    # flags leak across tests otherwise (e.g. paddle.v2.init(seed=...) sets
+    # FLAGS.seed, changing a LATER test's parameter init and its
+    # convergence) — every test starts from registered defaults
+    pt.flags.reset_flags()
     yield
 
 
