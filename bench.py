@@ -44,6 +44,7 @@ TPU_PEAK_FLOPS = [
 
 TPU_ATTEMPTS = 2
 TPU_TIMEOUT_S = 1500
+TPU_PROBE_TIMEOUT_S = 150
 CPU_TIMEOUT_S = 900
 
 
@@ -246,6 +247,21 @@ def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
     return batch / sec
 
 
+def run_probe():
+    """Child-mode entry: prove the TPU backend is alive with one tiny
+    computation. A downed tunnel HANGS backend init rather than failing,
+    so the parent gives this child a short leash before committing to the
+    full-length TPU attempts."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", dev
+    assert float(jnp.sum(jnp.ones((8, 128)))) == 1024.0
+    print(json.dumps({"probe": "ok", "device_kind": dev.device_kind}),
+          flush=True)
+
+
 def run_bench(platform):
     """Child-mode entry: run the measurement and print the JSON line."""
     import jax
@@ -382,13 +398,18 @@ def _spawn(platform, timeout):
 
 def main():
     notes = []
-    for attempt in range(TPU_ATTEMPTS):
+    probe, pnote = _spawn("tpu-probe", TPU_PROBE_TIMEOUT_S)
+    attempts = TPU_ATTEMPTS if probe is not None else 0
+    if probe is None:
+        notes.append(f"tpu probe failed (skipping TPU attempts): {pnote}")
+        print(f"# {notes[-1]}", file=sys.stderr, flush=True)
+    for attempt in range(attempts):
         result, note = _spawn("tpu", TPU_TIMEOUT_S)
         if result is not None:
             print(json.dumps(result), flush=True)
             return 0
         notes.append(note)
-        print(f"# tpu attempt {attempt + 1}/{TPU_ATTEMPTS} failed: {note}",
+        print(f"# tpu attempt {attempt + 1}/{attempts} failed: {note}",
               file=sys.stderr, flush=True)
     result, note = _spawn("cpu", CPU_TIMEOUT_S)
     if result is not None:
@@ -409,6 +430,9 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
-        run_bench(sys.argv[2])
+        if sys.argv[2] == "tpu-probe":
+            run_probe()
+        else:
+            run_bench(sys.argv[2])
         sys.exit(0)
     sys.exit(main())
