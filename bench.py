@@ -129,15 +129,17 @@ def transformer_train_flops(bs, T, d, n_layers, vocab, d_ff=None):
     return 3 * (dense + attn + head)
 
 
-def bench_transformer_step(jax, pt, layers, models):
+def bench_transformer_step(jax, pt, layers, models,
+                           bs=8, T=2048, vocab=16384, d=1024, L=8, H=8,
+                           steps=10):
     """Secondary metric: GPT-style LM train step in tokens/sec AND MFU —
     the compute-dense path where the >=50% MFU target lives (flash
     attention fwd+bwd in Pallas, fused qkv, fused matmul backward;
     PERF.md). d_head=128 (d1024 / 8 heads): the MXU-native head width.
-    No reference baseline exists (the reference predates Transformers)."""
+    No reference baseline exists (the reference predates Transformers).
+    Size parameters exist so the CPU test tier can smoke the build/measure
+    path at toy shapes (tests/test_bench_paths.py)."""
     import numpy as np
-
-    bs, T, vocab, d, L, H = 8, 2048, 16384, 1024, 8, 8
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         ids = layers.data("ids", shape=[T], dtype="int64")
@@ -153,12 +155,13 @@ def bench_transformer_step(jax, pt, layers, models):
     feed = {"ids": rng.randint(0, vocab, size=(bs, T)).astype("int64"),
             "tgt": rng.randint(0, vocab, size=(bs, T)).astype("int64")}
     sec = _time_train_steps(jax, pt, main_prog, startup, loss, feed,
-                            steps=10)
+                            steps=steps)
     flops = transformer_train_flops(bs, T, d, L, vocab)
     return bs * T / sec, flops / sec
 
 
-def bench_lstm_varlen(jax, pt, layers):
+def bench_lstm_varlen(jax, pt, layers, batch=64, hidden=512, vocab=10000,
+                      mean_len=80, cap=200, steps=20):
     """Variable-length 2xLSTM text classification (the reference RNN
     benchmark's real semantics — /root/reference/benchmark/paddle/rnn/
     rnn.py runs ragged IMDB batches, not fixed-T synthetic ones). Batches
@@ -166,8 +169,6 @@ def bench_lstm_varlen(jax, pt, layers):
     Reports true-token throughput and the padded-FLOP waste the dense+mask
     design pays for ragged data."""
     import numpy as np
-
-    batch, hidden, vocab = 64, 512, 10000
     main_prog, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_prog, startup):
         words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
@@ -189,18 +190,19 @@ def bench_lstm_varlen(jax, pt, layers):
         pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(
             loss, startup_program=startup)
 
-    # IMDB-like ragged lengths (geometric-ish spread, capped at 200);
+    # IMDB-like ragged lengths (geometric-ish spread, capped);
     # bucketed into one padded batch per step like the reference reader.
     rng = np.random.RandomState(0)
-    lengths = np.clip(rng.geometric(1.0 / 80.0, size=batch), 8,
-                      200).astype(np.int32)
+    lengths = np.clip(rng.geometric(1.0 / mean_len, size=batch), 8,
+                      cap).astype(np.int32)
     T = int(lengths.max())
     ids = rng.randint(0, vocab, size=(batch, T)).astype("int64")
     feed_np = {
         "words": ids, "words@len": lengths,
         "label": rng.randint(0, 2, size=(batch, 1)).astype("int64"),
     }
-    sec = _time_train_steps(jax, pt, main_prog, startup, loss, feed_np)
+    sec = _time_train_steps(jax, pt, main_prog, startup, loss, feed_np,
+                            steps=steps)
     true_tokens = int(lengths.sum())
     return {
         "tokens_per_sec": round(true_tokens / sec),
