@@ -1,0 +1,167 @@
+"""Image preprocessing utilities (reference python/paddle/v2/image.py API).
+
+The reference implements these over cv2; here they are numpy-first (PIL
+only decodes files/bytes), because on TPU systems the input pipeline runs
+on plain host CPUs and the arrays feed straight into NHWC device batches.
+Images are HWC uint8 (or HW for grayscale) throughout, matching the
+reference's convention; ``to_chw`` converts at the very end for callers
+that want the reference's CHW layout.
+
+API parity (image.py): load_image / load_image_bytes, resize_short,
+center_crop, random_crop, left_right_flip, to_chw, simple_transform,
+load_and_transform, batch_images_from_tar.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "load_image", "load_image_bytes", "resize_short", "center_crop",
+    "random_crop", "left_right_flip", "to_chw", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def _to_array(pil_img, is_color: bool) -> np.ndarray:
+    pil_img = pil_img.convert("RGB" if is_color else "L")
+    return np.asarray(pil_img)
+
+
+def load_image(file: str, is_color: bool = True) -> np.ndarray:
+    """Decode an image file to HWC (color) / HW (gray) uint8."""
+    from PIL import Image
+
+    with Image.open(file) as im:
+        return _to_array(im, is_color)
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode an in-memory encoded image (the tar/record path)."""
+    import io
+
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as im:
+        return _to_array(im, is_color)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORTER edge equals ``size``, keeping aspect ratio."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    if h <= w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    if (nh, nw) == (h, w):
+        return im
+    pil = Image.fromarray(im)
+    return np.asarray(pil.resize((nw, nh), Image.BILINEAR))
+
+
+def to_chw(im: np.ndarray, order: Sequence[int] = (2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (or any axis order); grayscale HW gains a 1-channel."""
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def _crop(im: np.ndarray, h0: int, w0: int, size: int) -> np.ndarray:
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def center_crop(im: np.ndarray, size: int,
+                is_color: bool = True) -> np.ndarray:
+    h, w = im.shape[:2]
+    if h < size or w < size:
+        raise ValueError(f"image {h}x{w} smaller than crop {size}")
+    return _crop(im, (h - size) // 2, (w - size) // 2, size)
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    h, w = im.shape[:2]
+    if h < size or w < size:
+        raise ValueError(f"image {h}x{w} smaller than crop {size}")
+    rng = rng or np.random
+    return _crop(im, rng.randint(0, h - size + 1),
+                 rng.randint(0, w - size + 1), size)
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean: Optional[np.ndarray] = None,
+                     rng: Optional[np.random.RandomState] = None
+                     ) -> np.ndarray:
+    """The standard train/eval pipeline: resize-short, then random crop +
+    coin-flip mirror (train) or center crop (eval), CHW float32, optional
+    mean subtraction (scalar, per-channel [C], or full [C,H,W])."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color, rng=rng)
+        if rng.randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:
+            mean = mean.reshape(-1, 1, 1)
+        im = im - mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True,
+                       mean: Optional[np.ndarray] = None) -> np.ndarray:
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file: str, dataset_name: str,
+                          img2label: dict, num_per_batch: int = 1024
+                          ) -> str:
+    """Pre-batch a tar of images into pickled numpy batches
+    (reference image.py batch_images_from_tar): each output batch file
+    holds {'data': [encoded bytes], 'label': [int]}; returns the path of
+    the batch directory, with a 'batch_names.txt' manifest."""
+    out_dir = data_file + "_" + dataset_name + "_batch"
+    os.makedirs(out_dir, exist_ok=True)
+    names, data, labels, batch_id = [], [], [], 0
+
+    def _flush():
+        nonlocal data, labels, batch_id
+        if not data:
+            return
+        path = os.path.join(out_dir, f"batch_{batch_id:05d}")
+        with open(path, "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        names.append(os.path.basename(path))
+        data, labels = [], []
+        batch_id += 1
+
+    with tarfile.open(data_file) as tf:
+        for member in tf.getmembers():
+            if not member.isfile() or member.name not in img2label:
+                continue
+            data.append(tf.extractfile(member).read())
+            labels.append(int(img2label[member.name]))
+            if len(data) >= num_per_batch:
+                _flush()
+    _flush()
+    with open(os.path.join(out_dir, "batch_names.txt"), "w") as f:
+        f.write("\n".join(names))
+    return out_dir

@@ -30,6 +30,7 @@ Usage mirrors the reference's book examples::
 from __future__ import annotations
 
 from .. import dataset, event  # noqa: F401  (reference re-exports)
+from .. import image  # noqa: F401
 from ..reader import decorator as reader  # noqa: F401
 from ..reader.minibatch import batch  # noqa: F401
 from . import activation, attr, data_type, layer, networks, optimizer, \
@@ -37,7 +38,7 @@ from . import activation, attr, data_type, layer, networks, optimizer, \
 
 __all__ = ["init", "infer", "batch", "reader", "dataset", "event", "layer",
            "activation", "pooling", "attr", "data_type", "optimizer",
-           "parameters", "trainer", "networks"]
+           "parameters", "trainer", "networks", "image"]
 
 
 def init(use_gpu: bool = False, trainer_count: int = 1, seed: int = None,

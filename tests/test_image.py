@@ -1,0 +1,91 @@
+"""paddle_tpu.image preprocessing utilities (reference v2/image.py API)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu import image
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def img():
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 255, size=(48, 64, 3), dtype=np.uint8)
+
+
+def test_load_roundtrip(tmp_path, img):
+    p = str(tmp_path / "x.png")
+    with open(p, "wb") as f:
+        f.write(_png_bytes(img))
+    got = image.load_image(p)
+    np.testing.assert_array_equal(got, img)
+    gray = image.load_image(p, is_color=False)
+    assert gray.ndim == 2 and gray.shape == (48, 64)
+    np.testing.assert_array_equal(image.load_image_bytes(_png_bytes(img)),
+                                  img)
+
+
+def test_resize_short_keeps_aspect(img):
+    out = image.resize_short(img, 24)  # shorter edge 48 -> 24
+    assert out.shape[:2] == (24, 32)
+    tall = image.resize_short(img.transpose(1, 0, 2), 24)
+    assert tall.shape[:2] == (32, 24)
+
+
+def test_crops_and_flip(img):
+    c = image.center_crop(img, 32)
+    assert c.shape == (32, 32, 3)
+    np.testing.assert_array_equal(c, img[8:40, 16:48])
+    r = image.random_crop(img, 32, rng=np.random.RandomState(3))
+    assert r.shape == (32, 32, 3)
+    np.testing.assert_array_equal(image.left_right_flip(img),
+                                  img[:, ::-1])
+    with pytest.raises(ValueError):
+        image.center_crop(img, 100)
+
+
+def test_to_chw(img):
+    chw = image.to_chw(img)
+    assert chw.shape == (3, 48, 64)
+    gray = image.to_chw(img[:, :, 0])
+    assert gray.shape == (1, 48, 64)
+
+
+def test_simple_transform_train_eval(img):
+    ev = image.simple_transform(img, 32, 24, is_train=False,
+                                mean=np.array([1.0, 2.0, 3.0]))
+    assert ev.shape == (3, 24, 24) and ev.dtype == np.float32
+    tr = image.simple_transform(img, 32, 24, is_train=True,
+                                rng=np.random.RandomState(0))
+    assert tr.shape == (3, 24, 24)
+
+
+def test_batch_images_from_tar(tmp_path, img):
+    tar_path = str(tmp_path / "imgs.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(5):
+            b = _png_bytes(img)
+            info = tarfile.TarInfo(name=f"img_{i}.png")
+            info.size = len(b)
+            tf.addfile(info, io.BytesIO(b))
+    labels = {f"img_{i}.png": i % 3 for i in range(5)}
+    out = image.batch_images_from_tar(tar_path, "t", labels,
+                                      num_per_batch=2)
+    names = open(os.path.join(out, "batch_names.txt")).read().split()
+    assert len(names) == 3  # 2 + 2 + 1
+    import pickle
+
+    first = pickle.load(open(os.path.join(out, names[0]), "rb"))
+    assert len(first["data"]) == 2 and first["label"] == [0, 1]
+    got = image.load_image_bytes(first["data"][0])
+    np.testing.assert_array_equal(got, img)
