@@ -15,6 +15,7 @@ from .alexnet import alexnet
 from .vgg import vgg
 from .resnet import resnet_imagenet, resnet_cifar10
 from .googlenet import googlenet
+from .mobilenet import mobilenet
 from .smallnet import smallnet_mnist_cifar
 from .transformer import transformer_lm
 from .wide_deep import wide_deep, wide_deep_loss
@@ -22,5 +23,5 @@ from .wide_deep import wide_deep, wide_deep_loss
 __all__ = [
     "transformer_lm", "wide_deep", "wide_deep_loss",
     "lenet5", "alexnet", "vgg", "resnet_imagenet", "resnet_cifar10",
-    "googlenet", "smallnet_mnist_cifar",
+    "googlenet", "mobilenet", "smallnet_mnist_cifar",
 ]

@@ -1,0 +1,47 @@
+"""MobileNet v1 — depthwise-separable convolution family.
+
+The era-matching mobile deployment model (models-repo mobilenet config;
+the reference tree carries the building block as depthwise conv support in
+conv_op.cc groups==channels). Depthwise 3x3 + pointwise 1x1 stacks; the
+pointwise convs take the 1x1-as-dot fast path (ops/nn_ops.py) with the
+fused Pallas backward, and the depthwise stages exercise
+``depthwise_conv2d``'s grouped lowering.
+
+TPU note: depthwise convs are VPU-bound (no contraction feeds the MXU), so
+this family trades MXU utilisation for parameter count exactly as it does
+on mobile silicon — it is in the zoo for capability parity, not as an MFU
+flagship.
+"""
+from .. import layers
+
+
+def _conv_bn(x, num_filters, filter_size, stride, padding, data_format,
+             is_test, groups=1):
+    conv = layers.conv2d(x, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, groups=groups, bias_attr=False,
+                         data_format=data_format)
+    return layers.batch_norm(conv, act="relu", is_test=is_test,
+                             data_layout=data_format)
+
+
+def _separable(x, ch_out, stride, data_format, is_test):
+    ch_in = x.shape[3 if data_format == "NHWC" else 1]
+    x = _conv_bn(x, ch_in, 3, stride, 1, data_format, is_test,
+                 groups=ch_in)  # depthwise
+    return _conv_bn(x, ch_out, 1, 1, 0, data_format, is_test)  # pointwise
+
+
+def mobilenet(images, num_classes=1000, scale=1.0, data_format="NHWC",
+              is_test=False):
+    """MobileNet v1 for 224x224 inputs. ``scale`` is the width multiplier."""
+    def c(ch):
+        return max(8, int(ch * scale))
+
+    x = _conv_bn(images, c(32), 3, 2, 1, data_format, is_test)
+    for ch, stride in [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+                       (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]:
+        x = _separable(x, c(ch), stride, data_format, is_test)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True,
+                      data_format=data_format)
+    return layers.fc(x, size=num_classes)

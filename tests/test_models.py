@@ -49,6 +49,11 @@ def test_googlenet_forward():
                  (224, 224, 3), batch=1)
 
 
+def test_mobilenet_forward():
+    _run_forward(lambda im: models.mobilenet(im, num_classes=10, scale=0.25),
+                 (64, 64, 3), batch=1)
+
+
 def test_resnet50_imagenet_forward():
     _run_forward(lambda im: models.resnet_imagenet(im, num_classes=10,
                                                    depth=50),
