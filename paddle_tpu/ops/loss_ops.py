@@ -37,18 +37,21 @@ def cross_entropy(attrs, ins):
 
 
 def _softmax_with_ce_grad(attrs, ins, outs, ogs):
-    """Fused, numerically-exact gradient: d_logits = (softmax - onehot) * dY."""
+    """Fused, numerically-exact gradient: d_logits = (softmax - onehot) * dY,
+    emitted in the LOGITS dtype — at LM-head scale ([tokens, vocab]) an f32
+    gradient tensor would double the dominant HBM stream of the whole loss
+    (the one_hot itself is an iota-compare XLA folds into the subtract)."""
     logits = single(ins, "Logits")
     label = single(ins, "Label")
-    sm = jax.nn.softmax(logits, axis=-1)
+    sm = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     if attrs.get("soft_label", False):
-        grad = sm - label
+        grad = sm - label.astype(jnp.float32)
     else:
         onehot = jax.nn.one_hot(label.reshape(logits.shape[:-1]),
                                 logits.shape[-1], dtype=sm.dtype)
         grad = sm - onehot
-    dy = ogs["Loss"][0]
-    return {"Logits": [grad * dy], "Label": [None]}
+    dy = ogs["Loss"][0].astype(jnp.float32)
+    return {"Logits": [(grad * dy).astype(logits.dtype)], "Label": [None]}
 
 
 @register_op("softmax_with_cross_entropy", grad_fn=_softmax_with_ce_grad)
@@ -56,12 +59,19 @@ def softmax_with_cross_entropy(attrs, ins):
     logits = single(ins, "Logits")
     label = single(ins, "Label")
     # Loss reductions always run in f32 (stable under bf16 AMP activations).
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # Hard labels go through the logsumexp form — loss rows need only the
+    # two reductions and one gathered logit, so no [N, vocab] log-softmax
+    # tensor has to materialise between kernels at LM-head scale. The
+    # Softmax output is derived lazily and DCE'd when nothing consumes it.
+    x = logits.astype(jnp.float32)
+    mx = jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
+    lse = mx + jnp.log(jnp.sum(jnp.exp(x - mx), axis=-1, keepdims=True))
     if attrs.get("soft_label", False):
+        logp = x - lse
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        loss = -_take_label_prob(logp, label)
-    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+        loss = lse - _take_label_prob(x, label)
+    return {"Softmax": [jnp.exp(x - lse)], "Loss": [loss]}
 
 
 @register_op("square_error_cost")
