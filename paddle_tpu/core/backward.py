@@ -457,6 +457,13 @@ def append_backward(
             kill_versions(op)
             i -= 1
             continue
+        if op.type == "seg_fwd":
+            raise NotImplementedError(
+                "append_backward over a program that already contains a "
+                "compiled recompute segment (seg_fwd): differentiate each "
+                "loss from its own program build (clone before the first "
+                "minimize), or disable recompute_guard for multi-loss "
+                "programs")
         seg = op.attrs.get("__recompute_seg__")
         if seg is not None and _seg_eligible(op):
             j = i
