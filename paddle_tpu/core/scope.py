@@ -75,3 +75,50 @@ _global_scope = Scope()
 
 def global_scope() -> Scope:
     return _global_scope
+
+
+# --- default-scope helpers (fluid default_scope_funcs.py parity) ----------
+# A thread-current scope stack over the global scope: code inside
+# scoped_function/enter_local_scope sees (and pollutes) only a child scope
+# that is dropped on exit — the reference uses this to keep temporary state
+# out of the long-lived training scope.
+_scope_stack = [_global_scope]
+
+
+def get_cur_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+def enter_local_scope() -> Scope:
+    s = get_cur_scope().new_scope()
+    _scope_stack.append(s)
+    return s
+
+
+def leave_local_scope() -> None:
+    if len(_scope_stack) == 1:
+        raise RuntimeError("cannot leave the global scope")
+    s = _scope_stack.pop()
+    if s.parent is not None and s in s.parent.kids:
+        s.parent.kids.remove(s)
+
+
+def scoped_function(fn, *args, **kwargs):
+    """Run ``fn`` inside a fresh local scope, always restoring on exit."""
+    enter_local_scope()
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        leave_local_scope()
+
+
+def find_var(name: str):
+    return get_cur_scope().get(name)
+
+
+def var(name: str, value=None):
+    """Ensure ``name`` exists in the current scope (optionally set it)."""
+    cur = get_cur_scope()
+    if value is not None or not cur.has(name):
+        cur.set(name, value)
+    return cur.get(name)

@@ -35,6 +35,7 @@ def fresh_programs():
     prog_mod._main_program = prog_mod.Program()
     prog_mod._startup_program = prog_mod.Program()
     scope_mod._global_scope = scope_mod.Scope()
+    scope_mod._scope_stack[:] = [scope_mod._global_scope]
     np.random.seed(0)
     # flags leak across tests otherwise (e.g. paddle.v2.init(seed=...) sets
     # FLAGS.seed, changing a LATER test's parameter init and its

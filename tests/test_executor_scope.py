@@ -1,0 +1,40 @@
+"""Scope-stack helpers (fluid default_scope_funcs.py parity)."""
+class TestDefaultScopeFuncs:
+    """fluid default_scope_funcs parity: a thread-current scope stack whose
+    local scopes drop their temporaries on exit."""
+
+    def test_scoped_function_isolates_writes(self):
+        from paddle_tpu.core import scope as sc
+
+        base = sc.get_cur_scope()
+
+        def body():
+            sc.var("tmp_x", 41)
+            assert sc.find_var("tmp_x") == 41
+            return sc.get_cur_scope()
+
+        inner = sc.scoped_function(body)
+        assert sc.get_cur_scope() is base
+        assert not base.has("tmp_x")
+        assert inner not in base.kids  # dropped, not leaked
+
+    def test_local_scope_reads_through_to_parent(self):
+        from paddle_tpu.core import scope as sc
+
+        sc.var("shared_y", 7)
+        sc.enter_local_scope()
+        try:
+            assert sc.find_var("shared_y") == 7
+            sc.var("local_z", 1)
+        finally:
+            sc.leave_local_scope()
+        assert not sc.get_cur_scope().has("local_z")
+        sc.get_cur_scope().delete("shared_y")
+
+    def test_cannot_leave_global(self):
+        import pytest
+
+        from paddle_tpu.core import scope as sc
+
+        with pytest.raises(RuntimeError):
+            sc.leave_local_scope()
