@@ -1,0 +1,156 @@
+"""OpTest-style single-op harness (SURVEY §4.3: the reference's
+fluid/tests/op_test.py pattern — build a one-op program, check outputs
+against a reference function, check gradients against finite differences).
+
+Here the harness runs on the engine's own machinery: inputs become
+parameters initialised from the given arrays (so checkgrad can perturb
+them), the op is appended through the registry, and pt.check_gradients
+compares the symbolic backward against central differences at 'highest'
+MXU precision.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+class OpHarness:
+    def __init__(self, op_type, inputs, attrs=None, out_slot=None):
+        self.op_type = op_type
+        self.attrs = dict(attrs or {})
+        self.main, self.startup = pt.Program(), pt.Program()
+        self.scope = pt.Scope()
+        self.exe = pt.Executor(pt.TPUPlace())
+        with pt.program_guard(self.main, self.startup):
+            from paddle_tpu.layers.layer_helper import LayerHelper
+            from paddle_tpu.param_attr import ParamAttr
+            from paddle_tpu.initializer import ConstantInitializer
+
+            helper = LayerHelper("op_harness")
+            in_slots = {}
+            self._param_names = []
+            for slot, arrs in inputs.items():
+                vs = []
+                for i, a in enumerate(arrs):
+                    a = np.asarray(a)
+                    name = f"oph_{op_type}_{slot}_{i}"
+                    if np.issubdtype(a.dtype, np.floating):
+                        v = helper.create_parameter(
+                            ParamAttr(name=name,
+                                      initializer=ConstantInitializer(0.0)),
+                            shape=list(a.shape), dtype=str(a.dtype))
+                        self._param_names.append(name)
+                    else:
+                        v = self.main.global_block.create_var(
+                            name=name, shape=list(a.shape),
+                            dtype=str(a.dtype), persistable=True)
+                    vs.append(v)
+                in_slots[slot] = vs
+            from paddle_tpu.core.registry import get_op
+
+            slots = out_slot or "Out"
+            outs, _ = helper.append_op(op_type, in_slots, [slots],
+                                       self.attrs)
+            self.out = outs[slots][0]
+        self.exe.run(self.startup, scope=self.scope)
+        for slot, arrs in inputs.items():
+            for i, a in enumerate(arrs):
+                self.scope.set(f"oph_{op_type}_{slot}_{i}",
+                               np.asarray(a))
+
+    def check_output(self, ref_fn, rtol=1e-5, atol=1e-6):
+        got, = self.exe.run(self.main, fetch_list=[self.out],
+                            scope=self.scope)
+        np.testing.assert_allclose(np.asarray(got), ref_fn(), rtol=rtol,
+                                   atol=atol)
+        return np.asarray(got)
+
+    def check_grad(self, **kw):
+        with pt.program_guard(self.main, self.startup):
+            loss = layers.mean(self.out)
+        return pt.check_gradients(self.main, {}, loss, scope=self.scope,
+                                  params=self._param_names,
+                                  executor=self.exe, **kw)
+
+
+def test_conv2d_output_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)  # HWIO
+    h = OpHarness("conv2d", {"Input": [x], "Filter": [w]},
+                  {"strides": [1, 1], "paddings": [1, 1],
+                   "data_format": "NHWC"}, out_slot="Output")
+
+    def ref():
+        import jax
+        return np.asarray(jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+
+    h.check_output(ref, rtol=1e-4, atol=1e-4)
+    h.check_grad()
+
+
+def test_layer_norm_output_and_grad():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype(np.float32)
+    scale = rng.rand(8).astype(np.float32) + 0.5
+    bias = rng.randn(8).astype(np.float32)
+    h = OpHarness("layer_norm", {"X": [x], "Scale": [scale],
+                                 "Bias": [bias]},
+                  {"begin_norm_axis": 1, "epsilon": 1e-5}, out_slot="Y")
+
+    def ref():
+        mu = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+
+    h.check_output(ref, rtol=1e-4, atol=1e-4)
+    h.check_grad()
+
+
+def test_elementwise_mul_broadcast_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    y = rng.randn(4).astype(np.float32)
+    h = OpHarness("elementwise_mul", {"X": [x], "Y": [y]}, {"axis": 1})
+    h.check_output(lambda: x * y[None, :, None], rtol=1e-5, atol=1e-6)
+    h.check_grad()
+
+
+def test_sequence_pool_sqrt_grad():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 5, 4).astype(np.float32)
+    lengths = np.array([5, 2, 4], np.int32)
+    h = OpHarness("sequence_pool", {"X": [x], "Length": [lengths]},
+                  {"pool_type": "sqrt"})
+
+    def ref():
+        out = np.zeros((3, 4), np.float32)
+        for i, L in enumerate(lengths):
+            out[i] = x[i, :L].sum(0) / np.sqrt(float(L))
+        return out
+
+    h.check_output(ref, rtol=1e-5, atol=1e-6)
+    h.check_grad()
+
+
+def test_lrn_output_matches_definition():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 4, 4, 8).astype(np.float32)
+    n, alpha, beta, k = 5, 1e-3, 0.75, 1.0
+    h = OpHarness("lrn", {"X": [x]},
+                  {"n": n, "alpha": alpha, "beta": beta, "k": k,
+                   "data_format": "NHWC"})
+
+    def ref():
+        sq = np.zeros_like(x)
+        C = x.shape[-1]
+        half = n // 2
+        for c in range(C):
+            lo, hi = max(0, c - half), min(C, c + half + 1)
+            sq[..., c] = (x[..., lo:hi] ** 2).sum(-1)
+        return x / (k + alpha * sq) ** beta
+
+    h.check_output(ref, rtol=1e-4, atol=1e-5)
