@@ -190,3 +190,60 @@ def xprof_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def framework_op_stats(logdir: str, top: Optional[int] = None):
+    """Parse an ``xprof_trace`` capture into per-op rows (the tooling
+    behind PERF.md's breakdowns, made first-class): returns a list of
+    dicts with name/type/occurrences/total_self_us/flop_rate/
+    memory_bw_gbs/operational_intensity/bound_by, sorted by self time.
+
+    Uses the XProf converter when present; raises a clear error
+    otherwise (the trace itself is still viewable in TensorBoard).
+    """
+    import glob
+    import json
+    import os
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                          "python")
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except Exception as exc:  # pragma: no cover - env-dependent
+        raise RuntimeError(
+            "framework_op_stats needs the xprof converter "
+            "(pip package 'xprof'); the raw trace in "
+            f"{logdir!r} is still viewable in TensorBoard") from exc
+    planes = sorted(glob.glob(
+        os.path.join(logdir, "plugins/profile/*/*.xplane.pb")))
+    if not planes:
+        raise FileNotFoundError(f"no xplane capture under {logdir!r}")
+    data, _ = rtd.xspace_to_tool_data([planes[-1]], "framework_op_stats",
+                                      {})
+    table = json.loads(data)
+    table = table[1] if isinstance(table, list) and len(table) > 1 else table
+    cols = [c["label"] for c in table["cols"]]
+
+    def col(row, label, default=None):
+        try:
+            return row[cols.index(label)]
+        except (ValueError, IndexError):
+            return default
+
+    rows = []
+    for r in table["rows"]:
+        vals = [c.get("v") for c in r["c"]]
+        rows.append({
+            "name": col(vals, "Operation Name"),
+            "type": col(vals, "Operation Type"),
+            "occurrences": col(vals, "#Occurrences"),
+            "total_self_us": col(vals, "Total self-time (us)"),
+            "flop_rate_gflops": col(vals, "Model FLOP Rate (GFLOP/s)"),
+            "memory_bw_gbs": col(vals, "Measured Memory BW (GBytes/Sec)"),
+            "operational_intensity": col(vals,
+                                         "Operational Intensity "
+                                         "(FLOPs/Byte)"),
+            "bound_by": col(vals, "Bound by"),
+        })
+    rows.sort(key=lambda d: -(d["total_self_us"] or 0.0))
+    return rows[:top] if top else rows

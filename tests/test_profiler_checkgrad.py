@@ -96,3 +96,30 @@ class TestCheckGrad:
                                 max_elements=4)
         finally:
             opdef.grad_fn = orig
+
+
+def test_framework_op_stats_contract(tmp_path):
+    """The xprof-trace parser returns a list of op rows (possibly empty on
+    CPU traces, where the device plane has no framework ops) and raises
+    cleanly on a missing capture."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import profiler
+
+    d = str(tmp_path / "trace")
+    f = jax.jit(lambda a: jnp.tanh(a @ a).sum())
+    a = jnp.ones((64, 64), jnp.float32)
+    f(a)
+    with profiler.xprof_trace(d):
+        f(a).block_until_ready()
+    try:
+        rows = profiler.framework_op_stats(d)
+    except RuntimeError:
+        pytest.skip("xprof converter unavailable")
+    assert isinstance(rows, list)
+    for r in rows:
+        assert {"name", "type", "total_self_us", "bound_by"} <= set(r)
+
+    with pytest.raises(FileNotFoundError):
+        profiler.framework_op_stats(str(tmp_path / "nope"))
