@@ -114,6 +114,48 @@ def buffered(reader, size: int):
     return buffered_reader
 
 
+def device_prefetch(feed_reader, depth: int = 2, device=None):
+    """Overlap host->device transfer with compute: yields feed dicts whose
+    arrays are ALREADY device-resident, staying ``depth`` batches ahead on
+    a background thread while the executor runs the current step
+    (transfers are async; the queue provides the lookahead). The executor
+    passes jax.Array feeds through without a host round-trip
+    (core/executor.py _normalize_feeds), so this is the TPU-native
+    replacement for the reference's double-buffered data providers feeding
+    pinned host memory to cudaMemcpyAsync.
+
+    ``feed_reader()`` must yield {name: np.ndarray} dicts (e.g. a
+    DataFeeder.feed applied to batches).
+    """
+    import jax
+
+    class _End:
+        pass
+
+    def prefetched():
+        dev = device or jax.devices()[0]
+        q: queue.Queue = queue.Queue(maxsize=depth)
+
+        def fill():
+            try:
+                for feed in feed_reader():
+                    q.put({k: (jax.device_put(v, dev)
+                               if not isinstance(v, jax.Array) else v)
+                           for k, v in feed.items()})
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return prefetched
+
+
 def firstn(reader, n: int):
     def reader_n():
         return itertools.islice(reader(), n)
