@@ -132,6 +132,41 @@ def test_understand_sentiment_conv():
     assert final_acc > 0.85, final_acc
 
 
+def test_understand_sentiment_stacked_lstm():
+    """Stacked-LSTM sentiment classifier on imdb
+    (book/test_understand_sentiment_dynamic_lstm.py): the recurrent
+    variant of the sentiment book test — fc+LSTM stack, last+max pooled."""
+    word_dict = dataset.imdb.word_dict()
+    V = len(word_dict)
+    hid = 32
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
+        label = layers.data("label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[V, 16])
+        emb.seq_len = words.seq_len
+        x1 = layers.fc(emb, size=4 * hid, num_flatten_dims=2,
+                       bias_attr=False)
+        x1.seq_len = words.seq_len
+        h1, _ = layers.dynamic_lstm(x1, 4 * hid)
+        x2 = layers.fc(h1, size=4 * hid, num_flatten_dims=2,
+                       bias_attr=False)
+        x2.seq_len = words.seq_len
+        h2, _ = layers.dynamic_lstm(x2, 4 * hid, is_reverse=True)
+        feat = layers.concat([layers.sequence_pool(h1, "max"),
+                              layers.sequence_pool(h2, "max")], axis=1)
+        logits = layers.fc(feat, size=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        pt.optimizer.AdamOptimizer(learning_rate=2e-2).minimize(
+            loss, startup_program=startup)
+    reader = decorator.firstn(dataset.imdb.train(word_dict), 384)
+    vals, _, _ = train_loop(main, startup, [words, label], [loss, acc],
+                            reader, 32, epochs=3)
+    final_acc = np.mean([v[1] for v in vals[-5:]])
+    assert final_acc > 0.8, final_acc
+
+
 def test_label_semantic_roles():
     """SRL tagging with CRF on conll05 (book/test_label_semantic_roles.py):
     word+context+mark features -> fc -> CRF; chunk F1 must become strong."""
