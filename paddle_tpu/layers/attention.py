@@ -15,11 +15,17 @@ from .sequence import get_seq_len
 
 
 def multi_head_attention(queries, keys=None, values=None, d_model=None,
-                         num_heads=8, causal=False, sequence_parallel=False,
+                         num_heads=8, num_kv_heads=None, causal=False,
+                         use_rope=False, sequence_parallel=False,
                          param_attr=None,
                          main_program=None, startup_program=None):
     """Multi-head attention over [b, T, d_model] sequences; self-attention
-    when keys/values are omitted. Returns [b, T, d_model]."""
+    when keys/values are omitted. Returns [b, T, d_model].
+
+    ``num_kv_heads`` < num_heads gives grouped-query / multi-query
+    attention (smaller KV projections and caches — the long-context
+    serving trade); ``use_rope`` applies rotary position embedding to
+    q/k heads in place of learned positions."""
     from . import tensor as T
 
     helper = LayerHelper("multi_head_attention", main_program=main_program,
@@ -30,7 +36,12 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
     if d_model % num_heads:
         raise ValueError(f"d_model {d_model} not divisible by heads "
                          f"{num_heads}")
+    num_kv_heads = num_kv_heads or num_heads
+    if num_heads % num_kv_heads:
+        raise ValueError(f"num_heads {num_heads} not a multiple of "
+                         f"num_kv_heads {num_kv_heads}")
     head_d = d_model // num_heads
+    d_kv = head_d * num_kv_heads
     self_attn = keys is queries
 
     def proj(x, width, name):
@@ -52,21 +63,27 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
 
     mp, sp = helper.main_program, helper.startup_program
     if self_attn:
-        qkv = proj(queries, 3 * d_model, "qkv")  # ONE fused MXU matmul
-        q, k, v = T.split(qkv, 3, dim=2, main_program=mp, startup_program=sp)
+        qkv = proj(queries, d_model + 2 * d_kv, "qkv")  # ONE fused matmul
+        q, k, v = T.split(qkv, [d_model, d_kv, d_kv], dim=2,
+                          main_program=mp, startup_program=sp)
     else:
         q = proj(queries, d_model, "q")
-        k = proj(keys, d_model, "k")
-        v = proj(values, d_model, "v")
+        k = proj(keys, d_kv, "k")
+        v = proj(values, d_kv, "v")
 
-    def heads(x, Tlen):
-        x = T.reshape(x, [-1, Tlen, num_heads, head_d], main_program=mp,
+    def heads(x, Tlen, n):
+        x = T.reshape(x, [-1, Tlen, n, head_d], main_program=mp,
                       startup_program=sp)
         return T.transpose(x, [0, 2, 1, 3], main_program=mp,
                            startup_program=sp)
 
     tq, tk = queries.shape[1], keys.shape[1]
-    qh, kh, vh = heads(q, tq), heads(k, tk), heads(v, tk)
+    qh = heads(q, tq, num_heads)
+    kh = heads(k, tk, num_kv_heads)
+    vh = heads(v, tk, num_kv_heads)
+    if use_rope:
+        qh = helper.simple_op("rotary_embed", {"X": [qh]})
+        kh = helper.simple_op("rotary_embed", {"X": [kh]})
     ins = {"Q": [qh], "K": [kh], "V": [vh]}
     sl = get_seq_len(keys)
     if sl is not None:
@@ -83,6 +100,7 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
 
 
 def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
+                              num_kv_heads=None, use_rope=False,
                               dropout_prob=0.0, sequence_parallel=False,
                               moe_experts=0, main_program=None,
                               startup_program=None):
@@ -97,6 +115,8 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
     h = N.layer_norm(x, begin_norm_axis=2, **kw)
     h.seq_len = get_seq_len(x)
     attn = multi_head_attention(h, num_heads=num_heads, causal=causal,
+                                num_kv_heads=num_kv_heads,
+                                use_rope=use_rope,
                                 sequence_parallel=sequence_parallel, **kw)
     helper = LayerHelper("transformer", **kw)
     x = helper.simple_op("elementwise_add", {"X": [x], "Y": [attn]})

@@ -15,16 +15,49 @@ from ..kernels.flash_attention import flash_attention
 from .common import maybe, out, single
 
 
+@register_op("rotary_embed")
+def rotary_embed(attrs, ins):
+    """Rotary position embedding over [B, H, T, D] heads (RoFormer; the
+    modern relative-position scheme for long-context LMs). Pairs
+    (x[2i], x[2i+1]) rotate by theta = pos * base^(-2i/D); purely a
+    function of position, so it lives in-graph with no table parameter."""
+    x = single(ins, "X")
+    base = attrs.get("base", 10000.0)
+    D = x.shape[-1]
+    T = x.shape[2]
+    half = D // 2
+    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * inv[None, :]  # [T,h]
+    cos = jnp.cos(ang)[None, None, :, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, None, :, :].astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    y = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out(Out=y)
+
+
 @register_op("scaled_dot_product_attention", optional_inputs=("Length",))
 def scaled_dot_product_attention(attrs, ins):
-    """Q/K/V [B, H, T, D] -> [B, H, T, D]. attrs: causal, sm_scale,
-    sequence_parallel (use ring attention over the mesh's 'sp' axis when the
-    executor compiles with a mesh that has one — the long-context path)."""
+    """Q [B, H, T, D], K/V [B, Hkv, T, D] -> [B, H, T, D]. attrs: causal,
+    sm_scale, sequence_parallel (use ring attention over the mesh's 'sp'
+    axis when the executor compiles with a mesh that has one — the
+    long-context path). Hkv may divide H (grouped-query / multi-query
+    attention): K/V heads are broadcast to their query groups."""
     from ..parallel.context import current_mesh, mesh_axis
 
     q = single(ins, "Q")
     k = single(ins, "K")
     v = single(ins, "V")
+    if k.shape[1] != q.shape[1]:
+        if q.shape[1] % k.shape[1]:
+            raise ValueError(
+                f"query heads {q.shape[1]} not a multiple of kv heads "
+                f"{k.shape[1]}")
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     lengths = maybe(ins, "Length")
     causal = attrs.get("causal", False)
     if attrs.get("sequence_parallel", False) and mesh_axis("sp") > 1:

@@ -194,3 +194,80 @@ class TestTransformer:
         # full-entropy baseline is ln(16)=2.77; solving the predictable half
         # must drive mean loss well below it
         assert losses[-1] < 0.62 * losses[0], (losses[0], losses[-1])
+
+
+class TestRopeAndGQA:
+    def test_rotary_embed_matches_reference_formula(self):
+        from paddle_tpu.core.registry import get_op
+
+        rng = np.random.RandomState(0)
+        B, H, T, D = 2, 2, 6, 8
+        x = rng.randn(B, H, T, D).astype(np.float32)
+        y = np.asarray(get_op("rotary_embed").fn(
+            {"base": 10000.0}, {"X": [jnp.asarray(x)]})["Out"][0])
+        half = D // 2
+        inv = 10000.0 ** (-np.arange(half) / half)
+        ang = np.arange(T)[:, None] * inv[None, :]
+        cos, sin = np.cos(ang), np.sin(ang)
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        ref = np.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                       axis=-1).reshape(x.shape)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_rotary_preserves_inner_product_shift_invariance(self):
+        """RoPE's defining property: <rot(q,t1), rot(k,t2)> depends only on
+        t1 - t2."""
+        from paddle_tpu.core.registry import get_op
+
+        rng = np.random.RandomState(1)
+        D, T = 8, 10
+        q = np.tile(rng.randn(1, 1, 1, D).astype(np.float32), (1, 1, T, 1))
+        k = np.tile(rng.randn(1, 1, 1, D).astype(np.float32), (1, 1, T, 1))
+        rq = np.asarray(get_op("rotary_embed").fn(
+            {}, {"X": [jnp.asarray(q)]})["Out"][0])[0, 0]
+        rk = np.asarray(get_op("rotary_embed").fn(
+            {}, {"X": [jnp.asarray(k)]})["Out"][0])[0, 0]
+        d1 = float(rq[3] @ rk[1])  # offset 2
+        d2 = float(rq[7] @ rk[5])  # offset 2
+        np.testing.assert_allclose(d1, d2, rtol=1e-4)
+
+    def test_gqa_matches_mha_with_repeated_kv(self):
+        """Grouped-query attention == full MHA with KV heads repeated."""
+        from paddle_tpu.core.registry import get_op
+
+        rng = np.random.RandomState(2)
+        B, H, Hkv, T, D = 1, 4, 2, 16, 8
+        q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, Hkv, T, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, Hkv, T, D).astype(np.float32))
+        op = get_op("scaled_dot_product_attention").fn
+        got = np.asarray(op({"causal": True},
+                            {"Q": [q], "K": [k], "V": [v]})["Out"][0])
+        kf = jnp.repeat(k, 2, axis=1)
+        vf = jnp.repeat(v, 2, axis=1)
+        ref = np.asarray(op({"causal": True},
+                            {"Q": [q], "K": [kf], "V": [vf]})["Out"][0])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_gqa_rope_transformer_layer_trains(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[8, 32])
+            y = layers.data("y", shape=[1], dtype="int64")
+            h = layers.transformer_encoder_layer(
+                x, num_heads=4, num_kv_heads=2, use_rope=True, d_ff=64,
+                causal=True)
+            pooled = layers.sequence_pool(h, "average")
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.fc(pooled, size=4), y))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(3)
+        feed = {"x": rng.randn(4, 8, 32).astype(np.float32),
+                "y": rng.randint(0, 4, size=(4, 1)).astype(np.int64)}
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                                scope=scope)[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
