@@ -178,6 +178,10 @@ define_bool("fused_linear_grad", True,
             "use the fused Pallas dX+dW backward for linear/1x1-conv "
             "layers on TPU (kernels/linear_grad.py); disable to fall "
             "back to XLA's separate gradient dots")
+define_string("compilation_cache_dir", "",
+              "persist XLA compilations here (jax persistent cache): "
+              "repeat runs of the same program skip the 20-40s "
+              "first-compile; empty = in-memory only")
 define_int32("seed", 0,
              "global graph RNG seed used when a program sets no "
              "random_seed of its own (ThreadLocalRand analogue); runs "

@@ -144,3 +144,31 @@ class TestFlagWiring:
             assert common.amp_enabled() is True
         finally:
             common._AMP = common._UNSET  # restore tri-state for other tests
+
+
+def test_compilation_cache_flag_persists_compiles(tmp_path):
+    """--compilation_cache_dir wires the jax persistent cache: compiled
+    programs land on disk for later processes to reuse."""
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    d = str(tmp_path / "cc")
+    pt.set_flags({"compilation_cache_dir": d})
+    import paddle_tpu.core.executor as ex
+
+    ex._cache_enabled = False  # fresh wiring for this test's dir
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[16])
+        loss = layers.mean(layers.fc(x, size=8))
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    exe.run(main, feed={"x": np.zeros((2, 16), np.float32)},
+            fetch_list=[loss], scope=scope)
+    n = sum(len(f) for _, _, f in os.walk(d))
+    assert n > 0
