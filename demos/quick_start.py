@@ -1,0 +1,108 @@
+"""Quick start: sentiment classification three ways, with the v2 API.
+
+The reference's flagship first-contact demo
+(/root/reference/v1_api_demo/quick_start/): the same text-classification
+pipeline configured as logistic regression over a sparse bag of words
+(trainer_config.lr.py), a sequence-conv-pool CNN (trainer_config.cnn.py),
+or a max-pooled LSTM (trainer_config.lstm.py), trained through
+api_train.py's trainer loop and served through api_predict.py's infer.
+
+The LR config exercises the sparse feed contract: each example is a
+``sparse_binary_vector`` row (a list of active word ids) that travels to
+the device as an O(nnz) id-list into an embedding-sum, never a dense
+multi-hot row.
+
+Run:  python demos/quick_start.py [lr|cnn|lstm]
+      (add PADDLE_TPU_DEMO_FAST=1 for a smoke run)
+"""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu import dataset
+from paddle_tpu.reader import decorator
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+
+def bow_reader(reader, dim):
+    """ids-sequence -> (sorted unique ids, label): the bag-of-words view
+    the reference's dataprovider_bow.py produces."""
+    def wrapped():
+        for ids, label in reader():
+            yield sorted(set(i for i in ids if i < dim)), label
+    return wrapped
+
+
+def build(config, word_dim):
+    """The three trainer_config.*.py topologies over one data plane."""
+    if config == "lr":
+        words = paddle.layer.data(
+            "words", paddle.data_type.sparse_binary_vector(word_dim))
+        output = paddle.layer.fc(input=words, size=2,
+                                 act=paddle.activation.Softmax())
+    else:
+        words = paddle.layer.data(
+            "words", paddle.data_type.integer_value_sequence(word_dim))
+        emb = paddle.layer.embedding(input=words, size=128)
+        if config == "cnn":
+            hidden = paddle.networks.sequence_conv_pool(
+                input=emb, context_len=3, hidden_size=128)
+        else:  # lstm
+            lstm = paddle.networks.simple_lstm(input=emb, size=128)
+            hidden = paddle.layer.pooling(
+                input=lstm, pooling_type=paddle.pooling.Max())
+        output = paddle.layer.fc(input=hidden, size=2,
+                                 act=paddle.activation.Softmax())
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=output, label=label)
+    return cost, output
+
+
+def main():
+    config = sys.argv[1] if len(sys.argv) > 1 else "lstm"
+    assert config in ("lr", "cnn", "lstm"), config
+    paddle.init(trainer_count=1, seed=7)
+
+    word_idx = dataset.imdb.word_dict()
+    dim = len(word_idx)
+    cost, output = build(config, dim)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=2e-3))
+
+    train = dataset.imdb.train(word_idx)
+    test = dataset.imdb.test(word_idx)
+    if config == "lr":
+        train, test = bow_reader(train, dim), bow_reader(test, dim)
+    if FAST:
+        train = decorator.firstn(train, 256)
+        test = decorator.firstn(test, 64)
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndIteration) \
+                and event.batch_id % 16 == 0:
+            print(f"pass {event.pass_id} batch {event.batch_id} "
+                  f"cost {event.cost:.4f}")
+
+    trainer.train(paddle.batch(decorator.shuffle(train, 512), 64),
+                  num_passes=1 if FAST else 4,
+                  event_handler=event_handler)
+
+    result = trainer.test(paddle.batch(test, 64))
+    print(f"[{config}] test cost: {result.cost:.4f}")
+
+    rows = [(x,) for x, _ in decorator.firstn(test, 8)()]
+    probs = paddle.infer(output_layer=output, parameters=parameters,
+                         input=rows)
+    print(f"[{config}] predicted labels:",
+          np.argmax(probs, axis=1).tolist())
+    return result.cost
+
+
+if __name__ == "__main__":
+    main()

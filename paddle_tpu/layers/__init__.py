@@ -23,6 +23,7 @@ from .legacy import (addto, dot_prod, factorization_machine, gated_unit,
                      multiplex, out_prod, power, repeat, resize, rotate,
                      row_l2_norm, sampling_id, scale_shift, scaling,
                      sequence_reshape, slope_intercept, sum_to_one_norm)
+from . import math_op_patch  # noqa: F401 - patches +,-,*,/ onto Variable
 from .tensor import (argmax, assign, cast, concat, create_global_var,
                      fill_constant, fill_constant_batch_size_like,
                      gaussian_random_batch_size_like, matmul,

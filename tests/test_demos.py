@@ -11,9 +11,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _DEMOS = sorted(glob.glob(os.path.join(_REPO, "demos", "*.py")))
 
 
-@pytest.mark.parametrize("path", _DEMOS, ids=[os.path.basename(p)
-                                              for p in _DEMOS])
-def test_demo_runs(path):
+def _run_demo(path, *argv):
     # Plain-CPU child, as a user without TPU tooling would run it: the dev
     # tunnel's site shims (axon) are stripped so JAX_PLATFORMS=cpu holds.
     extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
@@ -22,10 +20,23 @@ def test_demo_runs(path):
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                PYTHONPATH=os.pathsep.join([_REPO] + extra))
-    proc = subprocess.run([sys.executable, path], env=env, cwd=_REPO,
+    proc = subprocess.run([sys.executable, path, *argv], env=env, cwd=_REPO,
                           capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, (proc.stdout[-800:], proc.stderr[-800:])
     assert proc.stdout.strip(), "demo produced no output"
+
+
+@pytest.mark.parametrize("path", _DEMOS, ids=[os.path.basename(p)
+                                              for p in _DEMOS])
+def test_demo_runs(path):
+    _run_demo(path)
+
+
+@pytest.mark.parametrize("config", ["lr", "cnn"])
+def test_quick_start_configs(config):
+    """The non-default quick_start topologies; 'lr' is the demo that
+    exercises the sparse_binary_vector O(nnz) feed contract."""
+    _run_demo(os.path.join(_REPO, "demos", "quick_start.py"), config)
 
 
 def test_demos_exist():

@@ -1,0 +1,77 @@
+"""Arithmetic operators on Variables (reference layer_math.py:73-90)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[4])
+        out = build(x, y)
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup)
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4) + 1.0
+    yv = np.full((2, 4), 2.0, dtype=np.float32)
+    res, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])
+    return np.asarray(res), xv, yv
+
+
+def test_variable_variable_ops():
+    res, xv, yv = _run(lambda x, y: (x + y) * (x - y) / y)
+    np.testing.assert_allclose(res, (xv + yv) * (xv - yv) / yv, rtol=1e-6)
+
+
+def test_scalar_folding_to_scale():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        out = 2.0 * (1.0 - x) + x / 4.0 - (-x)
+    # scalar operands must fold into scale ops, never materialize constant
+    # tensors (the reference folds them into slope_intercept layers)
+    ops = [op.type for op in main.global_block.ops]
+    assert "fill_constant" not in ops and "elementwise_mul" not in ops, ops
+    assert ops.count("scale") >= 4, ops
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup)
+    xv = np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4)
+    res, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res),
+                               2.0 * (1.0 - xv) + xv / 4.0 + xv, rtol=1e-6)
+
+
+def test_rdiv_uses_reciprocal():
+    res, xv, _ = _run(lambda x, y: 3.0 / x)
+    np.testing.assert_allclose(res, 3.0 / xv, rtol=1e-5)
+
+
+def test_square_error_via_operators_trains():
+    """The verify-script shape: loss = mean(square(pred - y))."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(pred - y))
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 4).astype("float32")
+    yv = xv.sum(1, keepdims=True).astype("float32")
+    first, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    for _ in range(30):
+        last, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert float(last) < float(first)
+
+
+def test_variables_stay_hashable():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[4])
+    assert len({x, y}) == 2
+    assert x == x and x != y

@@ -1,0 +1,190 @@
+"""One-attach chip session: the round-3 measurement queue in ONE process.
+
+The dev tunnel tolerates a single attached process and drops without
+warning, so everything chip-side — the real-chip test tier, the ResNet
+fused-backward A/B, the transformer MFU grid, the varlen LSTM bench, and
+the per-op profile — runs sequentially here, each experiment wrapped in
+its own SIGALRM watchdog and appended as one JSON line to
+``CHIP_SESSION_r3.jsonl`` the moment it finishes. A tunnel drop costs the
+remaining experiments, never the finished ones.
+
+Usage:  PYTHONPATH=/root/repo:<tunnel-site> python tools/chip_session.py
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "CHIP_SESSION_r3.jsonl")
+
+
+def emit(record):
+    record["ts"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    print(json.dumps(record), flush=True)
+
+
+# BaseException so per-check `except Exception` guards inside experiments
+# cannot swallow the watchdog and leave the session unprotected.
+class Timeout(BaseException):
+    pass
+
+
+def _alarm(_sig, _frm):
+    raise Timeout()
+
+
+def experiment(name, fn, seconds=1200):
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(seconds)
+    t0 = time.time()
+    try:
+        result = fn()
+        emit({"experiment": name, "ok": True,
+              "seconds": round(time.time() - t0, 1), "result": result})
+        return result
+    except Timeout:
+        emit({"experiment": name, "ok": False,
+              "seconds": round(time.time() - t0, 1), "error": "timeout"})
+    except Exception as exc:  # noqa: BLE001 - keep the session alive
+        emit({"experiment": name, "ok": False,
+              "seconds": round(time.time() - t0, 1),
+              "error": repr(exc)[:500]})
+    finally:
+        signal.alarm(0)
+    return None
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    emit({"experiment": "probe", "ok": dev.platform != "cpu",
+          "result": {"platform": dev.platform, "kind": dev.device_kind}})
+    if dev.platform == "cpu":
+        return 1
+
+    import bench
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    peak = bench._peak_flops(dev.device_kind)
+
+    def mfu(flops_per_sec):
+        return round(flops_per_sec / peak, 4) if peak else None
+
+    pt.set_amp(True)
+
+    # 1. Real-chip tier (validates the fused kernels before we bench them).
+    def run_tier():
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        import tpu_tier
+
+        out = {}
+        for fn in tpu_tier.CHECKS:
+            try:
+                out[fn.__name__] = {"ok": True, "detail": str(fn() or "")}
+            except Exception as exc:  # noqa: BLE001
+                out[fn.__name__] = {"ok": False, "detail": repr(exc)[:300]}
+        return out
+
+    experiment("tpu_tier", run_tier, seconds=1500)
+
+    # 2. ResNet-50 bs256 A/B over the fused linear backward.
+    flops_img = bench.RESNET50_TRAIN_FLOPS_224
+
+    def resnet_step(fused, batch=256, steps=20):
+        pt.flags.FLAGS.fused_linear_grad = fused
+        import numpy as np
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            images = layers.data("images", shape=[224, 224, 3])
+            label = layers.data("label", shape=[1], dtype="int64")
+            logits = models.resnet_imagenet(images, num_classes=1000,
+                                            depth=50)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9).minimize(
+                loss, startup_program=startup)
+        rng = np.random.RandomState(0)
+        feed = {"images": rng.rand(batch, 224, 224, 3).astype("float32"),
+                "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
+                                      feed, warmup=3, steps=steps)
+        return {"img_per_sec": round(batch / sec, 1),
+                "ms_per_step": round(sec * 1e3, 2),
+                "mfu": mfu(flops_img * batch / sec),
+                "fused_linear_grad": fused}
+
+    experiment("resnet50_bs256_fused_off", lambda: resnet_step(False))
+    experiment("resnet50_bs256_fused_on", lambda: resnet_step(True))
+
+    # 3. Transformer MFU grid: d_head via heads (d1024: H8 -> 128, H16 -> 64),
+    #    fused backward on/off. H8+fused is the headline candidate.
+    def lm(heads, fused):
+        pt.flags.FLAGS.fused_linear_grad = fused
+        tok_s, flops_s = bench.bench_transformer_step(
+            jax, pt, layers, models, H=heads)
+        return {"tokens_per_sec": round(tok_s),
+                "mfu": mfu(flops_s),
+                "d_head": 1024 // heads, "fused_linear_grad": fused}
+
+    experiment("lm_h8_fused_on", lambda: lm(8, True))
+    experiment("lm_h8_fused_off", lambda: lm(8, False))
+    experiment("lm_h16_fused_on", lambda: lm(16, True))
+
+    # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
+    pt.flags.FLAGS.fused_linear_grad = True
+    experiment("lstm_varlen",
+               lambda: bench.bench_lstm_varlen(jax, pt, layers))
+    experiment("lstm_fixed",
+               lambda: {"ms_per_batch":
+                        round(bench.bench_lstm_step(jax, pt, layers), 2)})
+
+    # 5. Per-op profile of the winning ResNet config.
+    def profile_resnet():
+        from paddle_tpu import profiler
+        import numpy as np
+        pt.flags.FLAGS.fused_linear_grad = True
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            images = layers.data("images", shape=[224, 224, 3])
+            label = layers.data("label", shape=[1], dtype="int64")
+            logits = models.resnet_imagenet(images, num_classes=1000,
+                                            depth=50)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
+                "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
+        for _ in range(3):
+            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+        logdir = "/tmp/chip_session_trace"
+        with profiler.xprof_trace(logdir):
+            for _ in range(5):
+                o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                             scope=scope, return_numpy=False)
+            import numpy as _np
+            _np.asarray(o)
+        rows = profiler.framework_op_stats(logdir, top=12)
+        return rows
+
+    experiment("profile_resnet_fused", profile_resnet, seconds=1500)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
