@@ -221,6 +221,58 @@ IMAGE_MODEL_BASELINES = {
     "vgg16": 30.4,              # img/s, CPU MKL-DNN
 }
 
+# Reference bs16 MKL-DNN inference numbers
+# (/root/reference/benchmark/IntelOptimizedPaddle.md:77,85,94).
+INFER_BASELINES = {"vgg19": 96.75, "resnet50": 217.69, "googlenet": 600.94}
+
+
+def bench_inference(jax, pt, layers, models, name, batch=16, hw=224,
+                    steps=30):
+    """bs16 inference img/s through the deployment path: build with
+    is_test semantics, save_inference_model, load it back, serve. The
+    reference benchmarks exactly this surface (paddle/benchmark
+    IntelOptimizedPaddle.md "Infer Speed")."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    build = {
+        "resnet50": lambda img: models.resnet_imagenet(
+            img, num_classes=1000, depth=50),
+        "googlenet": lambda img: models.googlenet(img, num_classes=1000),
+        "vgg19": lambda img: models.vgg(img, num_classes=1000, depth=19),
+    }[name]
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        images = layers.data("images", shape=[hw, hw, 3])
+        logits = build(images)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    tmp = tempfile.mkdtemp(prefix=f"bench_infer_{name}_")
+    try:
+        pt.io.save_inference_model(tmp, ["images"], [logits], exe,
+                                   main_program=main_prog, scope=scope)
+        prog, feeds, fetches = pt.io.load_inference_model(tmp, exe,
+                                                          scope=scope)
+        rng = np.random.RandomState(0)
+        img = jax.device_put(rng.rand(batch, hw, hw, 3).astype("float32"))
+        for _ in range(3):
+            exe.run(prog, feed={feeds[0]: img}, fetch_list=fetches,
+                    scope=scope)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out, = exe.run(prog, feed={feeds[0]: img}, fetch_list=fetches,
+                           scope=scope, return_numpy=False)
+        np.asarray(out)
+        sec = (time.perf_counter() - t0) / steps
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"img_per_sec": round(batch / sec, 1),
+            "ms_per_batch": round(sec * 1e3, 3),
+            "vs_baseline": round(batch / sec / INFER_BASELINES[name], 1)}
+
 
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
@@ -359,6 +411,7 @@ def run_bench(platform):
                  models) if on_tpu else None
     lm_tok_s, lm_flops_s = lm if lm else (None, None)
     zoo = {}
+    infer_zoo = {}
     if on_tpu:
         for name in ("alexnet", "googlenet", "vgg16"):
             ips = attempt(name, bench_image_model, jax, pt, layers, models,
@@ -369,6 +422,11 @@ def run_bench(platform):
                     "vs_baseline": round(ips / IMAGE_MODEL_BASELINES[name],
                                          1),
                 }
+        for name in INFER_BASELINES:
+            r = attempt("infer_" + name, bench_inference, jax, pt, layers,
+                        models, name)
+            if r:
+                infer_zoo[name] = r
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -401,6 +459,7 @@ def run_bench(platform):
                 pt.flags.FLAGS.fused_linear_grad),
             "degraded": notes or None,
             "image_zoo_train_bs128": zoo or None,
+            "infer_bs16": infer_zoo or None,
         },
     }), flush=True)
 

@@ -54,19 +54,24 @@ def init(use_gpu: bool = False, trainer_count: int = 1, seed: int = None,
 
 def infer(output_layer, parameters, input, feeding=None):
     """paddle.infer analogue: run the inference clone of output_layer's
-    program over ``input`` rows; returns the stacked outputs."""
+    program over ``input`` rows; returns the stacked outputs. Accepts a
+    single layer or (like the reference's ``outputs([...])`` configs) a
+    list, returning one array per requested layer."""
     import numpy as np
 
     from ..data_feeder import DataFeeder
 
+    multi = isinstance(output_layer, (list, tuple))
+    outputs = list(output_layer) if multi else [output_layer]
     parameters.init()
-    prog = parameters.test_program_for(output_layer)
+    prog = parameters.test_program_for(outputs)
     consumed = {n for op in prog.global_block.ops
                 for names in op.inputs.values() for n in names}
     feed_vars = [v for v in parameters.data_vars(feeding, program=prog)
                  if v.name in consumed]
     feeder = DataFeeder(feed_vars)
-    out, = parameters.executor.run(
-        prog, feed=feeder.feed(input), fetch_list=[output_layer],
+    out = parameters.executor.run(
+        prog, feed=feeder.feed(input), fetch_list=outputs,
         scope=parameters.scope)
-    return np.asarray(out)
+    arrays = [np.asarray(o) for o in out]
+    return arrays if multi else arrays[0]

@@ -70,14 +70,17 @@ class Parameters:
             self.set(k, v)
 
     # -- plumbing for trainer/infer -----------------------------------
-    def test_program_for(self, output_var) -> Program:
-        """Inference clone pruned to ``output_var`` (reference
+    def test_program_for(self, output_vars) -> Program:
+        """Inference clone pruned to the output variable(s) (reference
         inference_optimize): drops the label branch so infer() only needs
         the actual input columns."""
         from ..io import prune_program
 
+        if not isinstance(output_vars, (list, tuple)):
+            output_vars = [output_vars]
         feeds = [v.name for v in self.data_vars()]
-        return prune_program(self._test_program, feeds, [output_var.name])
+        return prune_program(self._test_program, feeds,
+                             [v.name for v in output_vars])
 
     def data_vars(self, feeding: Optional[Dict[str, int]] = None,
                   program: Optional[Program] = None):

@@ -36,6 +36,17 @@ def test_lstm_varlen_bench_path_runs():
     assert res["max_len"] <= 12
 
 
+def test_inference_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    res = _bench().bench_inference(jax, pt, layers, models, "resnet50",
+                                   batch=2, hw=32, steps=2)
+    assert res["img_per_sec"] > 0 and res["ms_per_batch"] > 0
+
+
 def test_transformer_flop_model_is_sane():
     b = _bench()
     # 2 FLOPs/MAC, fwd x3: dense part alone for one layer

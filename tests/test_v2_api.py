@@ -157,3 +157,32 @@ class TestForTestClone:
         # the original program is untouched
         (op0,) = [o for o in main.global_block.ops if o.type == "dropout"]
         assert op0.attrs["is_test"] is False
+
+
+class TestMultiOutputInfer:
+    def test_infer_accepts_output_list(self):
+        """reference configs end with outputs([maxid, prob]) — infer must
+        serve several output layers from one pruned program."""
+        paddle.init(seed=3)
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(6))
+        shared = paddle.layer.fc(input=x, size=8,
+                                 act=paddle.activation.Tanh())
+        head_a = paddle.layer.fc(input=shared, size=3,
+                                 act=paddle.activation.Softmax())
+        head_b = paddle.layer.fc(input=shared, size=2,
+                                 act=paddle.activation.Softmax())
+        label = paddle.layer.data("y", paddle.data_type.integer_value(3))
+        cost = paddle.layer.classification_cost(input=head_a, label=label)
+        parameters = paddle.parameters.create(cost)
+
+        rows = [(np.arange(6, dtype=np.float32) / 6.0,),
+                (np.ones(6, dtype=np.float32),)]
+        a, b = paddle.infer(output_layer=[head_a, head_b],
+                            parameters=parameters, input=rows)
+        assert a.shape == (2, 3) and b.shape == (2, 2)
+        np.testing.assert_allclose(a.sum(axis=1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(b.sum(axis=1), 1.0, rtol=1e-5)
+        # single-layer form still returns a bare array
+        single = paddle.infer(output_layer=head_a, parameters=parameters,
+                              input=rows)
+        np.testing.assert_allclose(single, a, rtol=1e-6)

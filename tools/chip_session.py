@@ -148,7 +148,14 @@ def main():
                lambda: {"ms_per_batch":
                         round(bench.bench_lstm_step(jax, pt, layers), 2)})
 
-    # 5. Per-op profile of the winning ResNet config.
+    # 5. bs16 inference through the saved-model path (three BASELINE.md
+    #    "Infer Speed" rows).
+    for name in bench.INFER_BASELINES:
+        experiment(f"infer_{name}",
+                   lambda n=name: bench.bench_inference(jax, pt, layers,
+                                                        models, n))
+
+    # 6. Per-op profile of the winning ResNet config.
     def profile_resnet():
         from paddle_tpu import profiler
         import numpy as np
