@@ -5,8 +5,8 @@ from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
                  linear_chain_crf, lrn, pool2d,
                  sigmoid_cross_entropy_with_logits, square_error_cost,
                  softmax_with_cross_entropy, topk)
-from .attention import (multi_head_attention, switch_moe,
-                        transformer_encoder_layer)
+from .attention import (multi_head_attention, pipelined_transformer_stack,
+                        switch_moe, transformer_encoder_layer)
 from .control_flow import (StaticRNN, While, array_read, array_write,
                            beam_search_decoder, create_array, increment)
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
@@ -49,6 +49,7 @@ __all__ = (
      "StaticRNN", "While", "create_array", "array_write", "array_read",
      "increment", "beam_search_decoder",
      "multi_head_attention", "transformer_encoder_layer", "switch_moe",
+     "pipelined_transformer_stack",
      "interpolation", "scaling", "power", "slope_intercept", "addto",
      "sum_to_one_norm", "row_l2_norm", "scale_shift", "linear_comb",
      "dot_prod", "out_prod", "l2_distance", "repeat", "resize", "rotate",

@@ -135,6 +135,75 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
     return o
 
 
+def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
+                                causal=True, n_microbatches=None,
+                                pipe_axis="pp", data_axis="dp",
+                                param_attr=None, main_program=None,
+                                startup_program=None):
+    """L pre-LN transformer blocks with stacked [L, ...] weights — the
+    scan-over-layers form of ``transformer_encoder_layer``. One compiled
+    block body regardless of depth, and the layer axis doubles as the
+    pipeline-stage axis: under a mesh with a ``pp`` axis (see
+    ``parallel.pipeline_plan``) the stack runs the GPipe microbatch
+    schedule across stages. Names carry a ``.stack_`` marker so the plan
+    can shard every stacked tensor's leading dim on ``pp``."""
+    from ..param_attr import ParamAttr
+
+    if get_seq_len(x) is not None:
+        raise NotImplementedError(
+            "pipelined_transformer_stack assumes full-length sequences; "
+            "padded variable-length batches should use the per-layer "
+            "transformer_encoder_layer path (which masks via Length)")
+    helper = LayerHelper("pipelined_transformer_stack",
+                         main_program=main_program,
+                         startup_program=startup_program)
+    d_model = x.shape[-1]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} not divisible by heads "
+                         f"{num_heads}")
+    d_ff = d_ff or 4 * d_model
+    L = n_layers
+    base = helper.main_program.unique_name("pipe")
+
+    def mk(suffix, shape, bias=False, fan=None, init=None):
+        import copy
+
+        attr = (ParamAttr.to_attr(param_attr) if param_attr is not None
+                else ParamAttr())
+        attr = copy.copy(attr)
+        attr.name = f"{base}.stack_{suffix}"
+        if init is None and not bias:
+            init = XavierInitializer(fan_in=fan[0], fan_out=fan[1])
+        return helper.create_parameter(
+            attr, shape=shape, dtype=x.dtype, is_bias=bias,
+            default_initializer=init)
+
+    from ..initializer import ConstantInitializer
+
+    one = ConstantInitializer(1.0)
+    ins = {
+        "X": [x],
+        "Ln1S": [mk("ln1_s", [L, d_model], bias=True, init=one)],
+        "Ln1B": [mk("ln1_b", [L, d_model], bias=True)],
+        "QkvW": [mk("qkv_w", [L, d_model, 3 * d_model],
+                    fan=(d_model, 3 * d_model))],
+        "OutW": [mk("out_w", [L, d_model, d_model],
+                    fan=(d_model, d_model))],
+        "Ln2S": [mk("ln2_s", [L, d_model], bias=True, init=one)],
+        "Ln2B": [mk("ln2_b", [L, d_model], bias=True)],
+        "FfW1": [mk("ff_w1", [L, d_model, d_ff], fan=(d_model, d_ff))],
+        "FfB1": [mk("ff_b1", [L, d_ff], bias=True)],
+        "FfW2": [mk("ff_w2", [L, d_ff, d_model], fan=(d_ff, d_model))],
+        "FfB2": [mk("ff_b2", [L, d_model], bias=True)],
+    }
+    o = helper.simple_op(
+        "pipelined_transformer_stack", ins,
+        {"num_heads": num_heads, "causal": causal,
+         "n_microbatches": n_microbatches, "pipe_axis": pipe_axis,
+         "data_axis": data_axis})
+    return o
+
+
 def switch_moe(x, num_experts, d_ff=None, capacity_factor=1.25,
                param_attr=None, main_program=None, startup_program=None):
     """Switch-Transformer MoE FFN (top-1 routing, capacity-dropped tokens).

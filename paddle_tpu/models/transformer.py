@@ -15,10 +15,15 @@ from ..param_attr import ParamAttr
 
 
 def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
-                   d_ff=None, max_len=2048, main_program=None,
+                   d_ff=None, max_len=2048, pipeline_stack=False,
+                   n_microbatches=None, main_program=None,
                    startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
-    learned positional embedding, weight-tied-free output head."""
+    learned positional embedding, weight-tied-free output head.
+
+    ``pipeline_stack=True`` builds the blocks as one stacked-weight layer
+    (scan over layers; pipeline-parallel under a 'pp' mesh axis with
+    ``parallel.pipeline_plan`` — see layers.pipelined_transformer_stack)."""
     kw = dict(main_program=main_program, startup_program=startup_program)
     d_ff = d_ff or 4 * d_model
     tok = layers.embedding(ids, size=[vocab_size, d_model],
@@ -33,9 +38,15 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                            {"axes": [0], "starts": [0], "ends": [T]})
     x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
     x.seq_len = tok.seq_len
-    for _ in range(n_layers):
-        x = layers.transformer_encoder_layer(x, num_heads=num_heads,
-                                             d_ff=d_ff, causal=True, **kw)
+    if pipeline_stack:
+        x = layers.pipelined_transformer_stack(
+            x, n_layers=n_layers, num_heads=num_heads, d_ff=d_ff,
+            causal=True, n_microbatches=n_microbatches, **kw)
+    else:
+        for _ in range(n_layers):
+            x = layers.transformer_encoder_layer(x, num_heads=num_heads,
+                                                 d_ff=d_ff, causal=True,
+                                                 **kw)
     x = layers.layer_norm(x, begin_norm_axis=2, **kw)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
                        bias_attr=False, **kw)

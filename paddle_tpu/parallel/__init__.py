@@ -19,12 +19,13 @@ from .multihost import (initialize as initialize_multihost,
                         local_batch_slice, make_hybrid_mesh, process_info)
 from .ring_attention import ring_attention
 from .plan import (ShardingPlan, data_parallel_plan, expert_parallel_plan,
-                   megatron_plan, vocab_sharded_plan, zero_plan)
+                   megatron_plan, pipeline_plan, vocab_sharded_plan,
+                   zero_plan)
 
 __all__ = [
     "make_mesh", "mesh_axis_size", "ring_attention",
     "ShardingPlan", "data_parallel_plan", "expert_parallel_plan",
-    "megatron_plan", "vocab_sharded_plan", "zero_plan",
+    "megatron_plan", "pipeline_plan", "vocab_sharded_plan", "zero_plan",
     "initialize_multihost", "make_hybrid_mesh", "process_info",
     "local_batch_slice",
 ]

@@ -185,3 +185,28 @@ def expert_parallel_plan(mesh: Mesh, data_axis: str = "dp",
         rules += [(r"\.w", fc_w), (r"\.b", P(model_axis))]
     return ShardingPlan(mesh, rules=[(p, s) for p, s in rules],
                         data_axis=data_axis)
+
+
+def pipeline_plan(mesh: Mesh, data_axis: str = "dp",
+                  pipe_axis: str = "pp") -> ShardingPlan:
+    """Pipeline (+ data) parallelism for stacked layer stacks.
+
+    Tensors created by ``layers.pipelined_transformer_stack`` carry a
+    ``.stack_`` name marker and a leading [L, ...] layer axis; sharding
+    that axis over ``pipe_axis`` gives each device a contiguous block of
+    layers (its pipeline stage) — placement-by-spec where the reference's
+    ParallelNeuralNetwork placed layer ranges by config
+    (/root/reference/paddle/gserver/gradientmachines/
+    ParallelNeuralNetwork.cpp). Optimizer accumulators inherit the spec by
+    the usual name-substring rule. Everything else (embeddings, heads)
+    stays replicated; feeds shard on ``data_axis``.
+    """
+    def stage_spec(name: str, ndim: int) -> P:
+        # rank >= 2 only: every stacked tensor is [L, d, ...]; rank-1
+        # matches are optimizer scalars (beta-pow accumulators etc.)
+        if ndim >= 2:
+            return P(pipe_axis, *([None] * (ndim - 1)))
+        return P()
+
+    return ShardingPlan(mesh, rules=[(r"\.stack_", stage_spec)],
+                        data_axis=data_axis)

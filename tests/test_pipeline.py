@@ -1,0 +1,157 @@
+"""Pipeline parallelism: the GPipe schedule (parallel/pipeline.py) and the
+stacked-weight transformer layer that rides it. Reference analogue:
+ParallelNeuralNetwork's layer placement (SURVEY §2.3), rebuilt as a
+sharding spec + ppermute schedule."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import make_mesh, pipeline_plan
+from paddle_tpu.parallel.pipeline import gpipe
+
+
+def _mlp_stage(p, x):
+    import jax
+    import jax.numpy as jnp
+
+    def body(h, lw):
+        w, b = lw
+        return jnp.tanh(h @ w + b), None
+
+    h, _ = jax.lax.scan(body, x, (p["W"], p["b"]))
+    return h
+
+
+class TestGpipeFunctional:
+    def _setup(self, L=8, d=16, B=16):
+        rng = np.random.RandomState(0)
+        W = (rng.randn(L, d, d) * 0.2).astype(np.float32)
+        b = (rng.randn(L, d) * 0.1).astype(np.float32)
+        x = rng.randn(B, d).astype(np.float32)
+        ref = x
+        for i in range(L):
+            ref = np.tanh(ref @ W[i] + b[i])
+        return {"W": W, "b": b}, x, ref
+
+    def test_matches_sequential(self):
+        params, x, ref = self._setup()
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        y = gpipe(_mlp_stage, params, x, mesh, axis="pp", n_microbatches=4)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+    def test_composes_with_dp(self):
+        params, x, ref = self._setup()
+        mesh = make_mesh({"dp": 2, "pp": 4})
+        y = gpipe(_mlp_stage, params, x, mesh, axis="pp", n_microbatches=4,
+                  data_axis="dp")
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+    def test_more_microbatches_than_stages(self):
+        params, x, ref = self._setup()
+        mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+        y = gpipe(_mlp_stage, params, x, mesh, axis="pp", n_microbatches=8)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_sequential(self):
+        import jax
+        import jax.numpy as jnp
+
+        params, x, _ = self._setup()
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+
+        def loss_pipe(p):
+            return jnp.sum(gpipe(_mlp_stage, p, x, mesh, axis="pp",
+                                 n_microbatches=4) ** 2)
+
+        def loss_seq(p):
+            return jnp.sum(_mlp_stage(p, x) ** 2)
+
+        gp = jax.grad(loss_pipe)(params)
+        gs = jax.grad(loss_seq)(params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_indivisible_batch_raises(self):
+        params, x, _ = self._setup(B=10)
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="not divisible"):
+            gpipe(_mlp_stage, params, x, mesh, axis="pp", n_microbatches=4)
+
+
+def _build_lm(pipeline_stack, vocab=64, d=32, L=4, H=2, T=16):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T], dtype="int64")
+        from paddle_tpu import models
+
+        logits = models.transformer_lm(ids, vocab_size=vocab, d_model=d,
+                                       n_layers=L, num_heads=H, max_len=T,
+                                       pipeline_stack=pipeline_stack)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, vocab]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+class TestPipelinedStackLayer:
+    def _feed(self, bs=8, T=16, vocab=64):
+        rng = np.random.RandomState(0)
+        return {"ids": rng.randint(0, vocab, (bs, T)).astype("int64"),
+                "tgt": rng.randint(0, vocab, (bs, T)).astype("int64")}
+
+    def test_trains_single_device(self):
+        main, startup, loss = _build_lm(True)
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup)
+        feed = self._feed()
+        first, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(10):
+            last, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(last).all()
+        assert float(last) < float(first)
+
+    def test_trains_on_dp_pp_mesh(self):
+        mesh = make_mesh({"dp": 2, "pp": 4})
+        main, startup, loss = _build_lm(True)
+        scope = pt.Scope()
+        exe = pt.Executor(mesh=mesh, plan=pipeline_plan(mesh))
+        exe.run(startup, scope=scope)
+        feed = self._feed()
+        first, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        for _ in range(10):
+            last, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        assert np.isfinite(last).all()
+        assert float(last) < float(first)
+
+    def test_pp_matches_single_device(self):
+        """Same seed, same feed: the pipelined mesh run must track the
+        single-device stacked run step for step."""
+        feed = self._feed()
+
+        def run(mesh, plan, steps=3):
+            from paddle_tpu.core import program as prog_mod
+            prog_mod._main_program = prog_mod.Program()
+            prog_mod._startup_program = prog_mod.Program()
+            main, startup, loss = _build_lm(True)
+            scope = pt.Scope()
+            exe = (pt.Executor(mesh=mesh, plan=plan) if mesh
+                   else pt.Executor(pt.TPUPlace()))
+            exe.run(startup, scope=scope)
+            out = []
+            for _ in range(steps):
+                l, = exe.run(main, feed=feed, fetch_list=[loss],
+                             scope=scope)
+                out.append(float(np.asarray(l)))
+            return out
+
+        single = run(None, None)
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        piped = run(mesh, pipeline_plan(mesh))
+        np.testing.assert_allclose(piped, single, rtol=2e-4, atol=2e-4)
