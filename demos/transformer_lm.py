@@ -32,9 +32,13 @@ def main():
     with pt.program_guard(main_prog, startup):
         ids = layers.data("ids", shape=[T], dtype="int64")
         tgt = layers.data("tgt", shape=[T], dtype="int64")
+        # pipeline_stack: stacked [L, ...] weights (scan over layers; the
+        # same tensors pipeline over a 'pp' mesh) — also what the KV-cache
+        # generation program rejoins by name below
         logits = models.transformer_lm(ids, vocab_size=vocab,
                                        d_model=d_model, n_layers=n_layers,
-                                       num_heads=4, max_len=T)
+                                       num_heads=4, max_len=2 * T,
+                                       pipeline_stack=True)
         loss = layers.mean(layers.softmax_with_cross_entropy(
             layers.reshape(logits, shape=[-1, vocab]),
             layers.reshape(tgt, shape=[-1, 1])))
@@ -55,14 +59,28 @@ def main():
         if step % 20 == 0 or step == steps - 1:
             print(f"step {step}: loss {float(lo):.4f}")
 
-    # greedy sampling: feed back argmax next-token predictions
+    # greedy generation through the KV-cache decode path: a sibling
+    # program that rejoins the trained weights by name (startup never run)
+    n_new = 8
+    gen_prog, gen_startup = pt.Program(), pt.Program()
+    with pt.program_guard(gen_prog, gen_startup):
+        prompt = layers.data("prompt", shape=[T], dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            num_heads=4, max_len=2 * T, max_new_tokens=n_new)
     ctx = synthetic_corpus(rng, vocab, n=1, T=T)[:, :-1]
-    out, = exe.run(main_prog, feed={"ids": ctx, "tgt": ctx},
-                   fetch_list=[logits], scope=scope)
-    pred = np.argmax(np.asarray(out)[0, -8:], axis=-1)
-    truth = [(3 * t) % vocab for t in ctx[0, -8:]]
-    print("model next-token:", pred.tolist())
-    print("rule  next-token:", truth, "(modulo the +1 noise)")
+    gen, = exe.run(gen_prog, feed={"prompt": ctx}, fetch_list=[out_ids],
+                   scope=scope)
+    gen = np.asarray(gen)[0]
+    tail = gen[-(n_new + 1):]
+    # the language allows next in {3t, 3t+1} mod vocab: judge each
+    # generated step against the rule applied to ITS OWN predecessor
+    # (an independent chain would diverge at the first +1 branch)
+    ok = [int(tail[i + 1]) in {(3 * int(tail[i])) % vocab,
+                               (3 * int(tail[i]) + 1) % vocab}
+          for i in range(n_new)]
+    print("generated continuation:", gen[-n_new:].tolist())
+    print(f"rule-consistent steps: {sum(ok)}/{n_new}")
 
 
 if __name__ == "__main__":

@@ -135,6 +135,46 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
     return o
 
 
+def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
+                      param_attr=None):
+    """Create (or rejoin by name) the stacked [L, ...] block weights for
+    ``pipelined_transformer_stack`` / ``transformer_stack_generate``:
+    returns the op-input dict keyed by slot name. Names follow
+    ``{base}.stack_{suffix}`` so sharding plans and sibling programs
+    (training vs generation) address the same tensors."""
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
+
+    def mk(suffix, shape, bias=False, fan=None, init=None):
+        import copy
+
+        attr = (ParamAttr.to_attr(param_attr) if param_attr is not None
+                else ParamAttr())
+        attr = copy.copy(attr)
+        attr.name = f"{base}.stack_{suffix}"
+        if init is None and not bias:
+            init = XavierInitializer(fan_in=fan[0], fan_out=fan[1])
+        return helper.create_parameter(
+            attr, shape=shape, dtype=dtype, is_bias=bias,
+            default_initializer=init)
+
+    one = ConstantInitializer(1.0)
+    return {
+        "Ln1S": [mk("ln1_s", [L, d_model], bias=True, init=one)],
+        "Ln1B": [mk("ln1_b", [L, d_model], bias=True)],
+        "QkvW": [mk("qkv_w", [L, d_model, 3 * d_model],
+                    fan=(d_model, 3 * d_model))],
+        "OutW": [mk("out_w", [L, d_model, d_model],
+                    fan=(d_model, d_model))],
+        "Ln2S": [mk("ln2_s", [L, d_model], bias=True, init=one)],
+        "Ln2B": [mk("ln2_b", [L, d_model], bias=True)],
+        "FfW1": [mk("ff_w1", [L, d_model, d_ff], fan=(d_model, d_ff))],
+        "FfB1": [mk("ff_b1", [L, d_ff], bias=True)],
+        "FfW2": [mk("ff_w2", [L, d_ff, d_model], fan=(d_ff, d_model))],
+        "FfB2": [mk("ff_b2", [L, d_model], bias=True)],
+    }
+
+
 def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
                                 causal=True, n_microbatches=None,
                                 pipe_axis="pp", data_axis="dp",
@@ -163,39 +203,15 @@ def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
                          f"{num_heads}")
     d_ff = d_ff or 4 * d_model
     L = n_layers
-    base = helper.main_program.unique_name("pipe")
+    from ..param_attr import ParamAttr as _PA
 
-    def mk(suffix, shape, bias=False, fan=None, init=None):
-        import copy
+    _given = _PA.to_attr(param_attr)
+    base = (_given.name if _given is not None and _given.name
+            else helper.main_program.unique_name("pipe"))
 
-        attr = (ParamAttr.to_attr(param_attr) if param_attr is not None
-                else ParamAttr())
-        attr = copy.copy(attr)
-        attr.name = f"{base}.stack_{suffix}"
-        if init is None and not bias:
-            init = XavierInitializer(fan_in=fan[0], fan_out=fan[1])
-        return helper.create_parameter(
-            attr, shape=shape, dtype=x.dtype, is_bias=bias,
-            default_initializer=init)
-
-    from ..initializer import ConstantInitializer
-
-    one = ConstantInitializer(1.0)
-    ins = {
-        "X": [x],
-        "Ln1S": [mk("ln1_s", [L, d_model], bias=True, init=one)],
-        "Ln1B": [mk("ln1_b", [L, d_model], bias=True)],
-        "QkvW": [mk("qkv_w", [L, d_model, 3 * d_model],
-                    fan=(d_model, 3 * d_model))],
-        "OutW": [mk("out_w", [L, d_model, d_model],
-                    fan=(d_model, d_model))],
-        "Ln2S": [mk("ln2_s", [L, d_model], bias=True, init=one)],
-        "Ln2B": [mk("ln2_b", [L, d_model], bias=True)],
-        "FfW1": [mk("ff_w1", [L, d_model, d_ff], fan=(d_model, d_ff))],
-        "FfB1": [mk("ff_b1", [L, d_ff], bias=True)],
-        "FfW2": [mk("ff_w2", [L, d_ff, d_model], fan=(d_ff, d_model))],
-        "FfB2": [mk("ff_b2", [L, d_model], bias=True)],
-    }
+    ins = {"X": [x]}
+    ins.update(make_stack_params(helper, base, L, d_model, d_ff,
+                                 dtype=x.dtype, param_attr=param_attr))
     o = helper.simple_op(
         "pipelined_transformer_stack", ins,
         {"num_heads": num_heads, "causal": causal,

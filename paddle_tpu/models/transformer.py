@@ -38,16 +38,78 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                            {"axes": [0], "starts": [0], "ends": [T]})
     x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
     x.seq_len = tok.seq_len
+    ln_attr = ln_bias = head_attr = None
     if pipeline_stack:
+        # stable parameter names so a generation program (which rebuilds
+        # these layers) shares the trained weights by name; one stacked
+        # LM per program — the fixed names would otherwise silently alias
+        if "lm_stack.stack_qkv_w" in helper.main_program.global_block.vars:
+            raise ValueError(
+                "transformer_lm(pipeline_stack=True) may be built only "
+                "once per program: its parameter names (lm_stack.*, "
+                "final_ln.*, lm_head.w) are fixed so generation programs "
+                "can rejoin them, and a second stacked LM in the same "
+                "program would silently share weights")
         x = layers.pipelined_transformer_stack(
             x, n_layers=n_layers, num_heads=num_heads, d_ff=d_ff,
-            causal=True, n_microbatches=n_microbatches, **kw)
+            causal=True, n_microbatches=n_microbatches,
+            param_attr=ParamAttr(name="lm_stack"), **kw)
+        ln_attr = ParamAttr(name="final_ln.scale")
+        ln_bias = ParamAttr(name="final_ln.bias")
+        head_attr = ParamAttr(name="lm_head.w")
     else:
         for _ in range(n_layers):
             x = layers.transformer_encoder_layer(x, num_heads=num_heads,
                                                  d_ff=d_ff, causal=True,
                                                  **kw)
-    x = layers.layer_norm(x, begin_norm_axis=2, **kw)
+    x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
+                          bias_attr=ln_bias, **kw)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
-                       bias_attr=False, **kw)
+                       param_attr=head_attr, bias_attr=False, **kw)
     return logits
+
+
+def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
+                            num_heads=8, d_ff=None, max_len=2048,
+                            max_new_tokens=32, main_program=None,
+                            startup_program=None):
+    """Generation program for a ``transformer_lm(pipeline_stack=True)``
+    model: greedy KV-cache incremental decoding
+    (ops/pipeline_ops.transformer_stack_generate).
+
+    Rebuilds the SAME named parameters (tok_emb, pos_emb, lm_stack.*,
+    final_ln.*, lm_head.w) so running this program in the training scope
+    serves the trained weights — do not run its startup program (that
+    would re-initialize them; the pattern is the GAN demo's shared-weight
+    sibling programs). prompt: [b, Tp] int64 -> [b, Tp + max_new_tokens].
+    """
+    from ..layers.attention import make_stack_params
+
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    d_ff = d_ff or 4 * d_model
+    helper = LayerHelper("transformer_lm_generate", **kw)
+    tok = helper.create_parameter(ParamAttr(name="tok_emb"),
+                                  shape=[vocab_size, d_model],
+                                  dtype="float32")
+    pos = helper.create_parameter(ParamAttr(name="pos_emb"),
+                                  shape=[max_len, d_model], dtype="float32")
+    from ..initializer import ConstantInitializer
+
+    ln_s = helper.create_parameter(
+        ParamAttr(name="final_ln.scale"), shape=[d_model], dtype="float32",
+        default_initializer=ConstantInitializer(1.0))
+    ln_b = helper.create_parameter(ParamAttr(name="final_ln.bias"),
+                                   shape=[d_model], dtype="float32",
+                                   is_bias=True)
+    head_w = helper.create_parameter(ParamAttr(name="lm_head.w"),
+                                     shape=[d_model, vocab_size],
+                                     dtype="float32")
+    ins = {"Prompt": [prompt], "TokEmb": [tok], "PosEmb": [pos],
+           "FinalLnS": [ln_s], "FinalLnB": [ln_b], "HeadW": [head_w]}
+    ins.update(make_stack_params(helper, "lm_stack", n_layers, d_model,
+                                 d_ff))
+    o = helper.simple_op("transformer_stack_generate", ins,
+                         {"num_heads": num_heads,
+                          "max_new_tokens": max_new_tokens})
+    o.stop_gradient = True
+    return o

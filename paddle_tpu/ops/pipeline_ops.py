@@ -34,24 +34,34 @@ def _block(p, x, num_heads, causal):
     weights: ln1_s, ln1_b, qkv_w, out_w, ln2_s, ln2_b, ff_w1, ff_b1,
     ff_w2, ff_b2."""
     b, T, d = x.shape
-    head_d = d // num_heads
+    q, k, v = _attn_proj(p, x, num_heads)
+    ctx = flash_attention(q, k, v, causal=causal)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, T, d)
+    return _attn_out_ffn(p, x, ctx)
 
-    h = _ln(x, p["ln1_s"], p["ln1_b"])
-    h_c, qkv_c = amp_cast(h, p["qkv_w"])
-    qkv = jnp.einsum("btd,de->bte", h_c, qkv_c,
-                     precision=mxu_precision()).astype(x.dtype)
+
+def _attn_proj(p, h, num_heads):
+    """LN1 + qkv projection -> per-head q, k, v [b, H, t, dh]."""
+    b, t, d = h.shape
+    head_d = d // num_heads
+    hn = _ln(h, p["ln1_s"], p["ln1_b"])
+    hn_c, qkv_c = amp_cast(hn, p["qkv_w"])
+    qkv = jnp.einsum("btd,de->bte", hn_c, qkv_c,
+                     precision=mxu_precision()).astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
-    def heads(t):
-        return t.reshape(b, T, num_heads, head_d).transpose(0, 2, 1, 3)
+    def heads(a):
+        return a.reshape(b, t, num_heads, head_d).transpose(0, 2, 1, 3)
 
-    ctx = flash_attention(heads(q), heads(k), heads(v), causal=causal)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, T, d)
+    return heads(q), heads(k), heads(v)
+
+
+def _attn_out_ffn(p, x, ctx):
+    """Out-projection + residual + FFN half of a block; ctx [b, t, d]."""
     ctx_c, ow_c = amp_cast(ctx, p["out_w"])
     attn = jnp.einsum("btd,de->bte", ctx_c, ow_c,
                       precision=mxu_precision()).astype(x.dtype)
     x = x + attn
-
     h2 = _ln(x, p["ln2_s"], p["ln2_b"])
     h2_c, w1_c = amp_cast(h2, p["ff_w1"])
     ff = jax.nn.gelu(
@@ -111,3 +121,95 @@ def pipelined_transformer_stack(attrs, ins):
                   data_axis=data_axis)
         return out(Out=y)
     return out(Out=scan_layers(params, x))
+
+
+@register_op("transformer_stack_generate")
+def transformer_stack_generate(attrs, ins):
+    """Greedy incremental decoding with a per-layer KV cache.
+
+    Prompt [b, Tp] int + the stacked block weights + TokEmb [V, d],
+    PosEmb [maxlen, d], FinalLnS/FinalLnB [d], HeadW [d, V]
+    -> Out [b, Tp + max_new_tokens] int.
+
+    The serving path the training stack earns: prefill runs the blocks
+    once over the prompt while capturing every layer's K/V; the decode
+    loop is a lax.scan over steps — one token embeds, attends against the
+    cache (position-masked), appends its K/V, and argmax picks the next
+    id. O(T) work per token instead of O(T^2) re-forwarding; everything
+    static-shaped for XLA (the cache is preallocated at Tp + N).
+    """
+    prompt = single(ins, "Prompt")
+    tok_emb = single(ins, "TokEmb")
+    pos_emb = single(ins, "PosEmb")
+    ln_s = single(ins, "FinalLnS")
+    ln_b = single(ins, "FinalLnB")
+    head_w = single(ins, "HeadW")
+    params = {key: single(ins, slot)
+              for slot, key in _STACK_SLOTS.items()}
+    num_heads = attrs["num_heads"]
+    N = attrs["max_new_tokens"]
+    b, Tp = prompt.shape
+    L, d = params["ln1_s"].shape
+    head_d = d // num_heads
+    Ttot = Tp + N
+    if Ttot > pos_emb.shape[0]:
+        raise ValueError(
+            f"prompt {Tp} + {N} new tokens exceeds max_len "
+            f"{pos_emb.shape[0]}")
+
+    def embed(ids, pos0):
+        t = ids.shape[1]
+        return (tok_emb[ids]
+                + jax.lax.dynamic_slice_in_dim(pos_emb, pos0, t, 0)[None])
+
+    def logits_of(h_last):
+        hn = _ln(h_last, ln_s, ln_b)
+        hn_c, hw_c = amp_cast(hn, head_w)
+        return jnp.einsum("bd,dv->bv", hn_c, hw_c,
+                          precision=mxu_precision()).astype(jnp.float32)
+
+    # ---- prefill: run the stack over the prompt, capturing K/V -------
+    x = embed(prompt, 0)
+
+    def prefill_body(h, layer_p):
+        q, k, v = _attn_proj(layer_p, h, num_heads)
+        ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tp, d)
+        return _attn_out_ffn(layer_p, h, ctx), (k, v)
+
+    h, (ks, vs) = jax.lax.scan(prefill_body, x, params)
+    pad = [(0, 0)] * 5
+    pad[3] = (0, N)  # [L, b, H, Tp, dh] -> [L, b, H, Ttot, dh]
+    cache_k = jnp.pad(ks, pad)
+    cache_v = jnp.pad(vs, pad)
+    next_tok = jnp.argmax(logits_of(h[:, -1]), axis=-1)  # [b]
+
+    # ---- decode: one token at a time against the cache ---------------
+    def step(carry, n):
+        tok, ck, cv = carry
+        pos = Tp + n
+        x1 = embed(tok[:, None], pos)  # [b, 1, d]
+
+        def layer(h1, inp):
+            from ..kernels.flash_attention import reference_attention
+
+            layer_p, ck_l, cv_l = inp
+            q, k, v = _attn_proj(layer_p, h1, num_heads)
+            ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos, 2)
+            cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos, 2)
+            # one query against the cache prefix: the lengths mask of the
+            # reference kernel is exactly the <= pos predicate
+            ctx = reference_attention(
+                q, ck_l, cv_l, lengths=jnp.full((b,), pos + 1))
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, d)
+            return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
+
+        h1, (ck, cv) = jax.lax.scan(layer, x1, (params, ck, cv))
+        nxt = jnp.argmax(logits_of(h1[:, 0]), axis=-1)
+        return (nxt, ck, cv), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (next_tok, cache_k, cache_v), jnp.arange(N))
+    generated = jnp.moveaxis(toks, 0, 1)  # [b, N]
+    return out(Out=jnp.concatenate(
+        [prompt, generated.astype(prompt.dtype)], axis=1))

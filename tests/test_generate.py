@@ -1,0 +1,99 @@
+"""KV-cache incremental decoding (transformer_stack_generate): the decode
+loop must agree token-for-token with iterative full re-forwarding through
+the training graph — the O(T) cache path vs the O(T^2) naive path."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+VOCAB, D, L, H, MAXLEN = 32, 32, 2, 2, 32
+
+
+def _build_train(T):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=VOCAB, d_model=D,
+                                       n_layers=L, num_heads=H,
+                                       max_len=MAXLEN, pipeline_stack=True)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, VOCAB]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(
+            loss, startup_program=startup)
+    return main, startup, logits, loss
+
+
+def _build_full_forward(T):
+    """Plain forward at length T (for the naive re-forward baseline)."""
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        ids = layers.data("ids_fwd", shape=[T], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=VOCAB, d_model=D,
+                                       n_layers=L, num_heads=H,
+                                       max_len=MAXLEN, pipeline_stack=True)
+    return prog, logits
+
+
+def test_generate_matches_naive_reforwarding():
+    Tp, N = 8, 6
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    main, startup, _, loss = _build_train(Tp)
+    exe.run(startup, scope=scope)
+
+    # teach it something non-trivial: next token = (cur + 3) % VOCAB
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, VOCAB, (64, 1))
+    seq = (start + 3 * np.arange(Tp + 1)) % VOCAB
+    feed = {"ids": seq[:, :-1].astype("int64"),
+            "tgt": seq[:, 1:].astype("int64")}
+    for _ in range(60):
+        l, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+
+    # generation program shares the trained weights by name (its startup
+    # is never run)
+    gen_prog, gen_startup = pt.Program(), pt.Program()
+    with pt.program_guard(gen_prog, gen_startup):
+        prompt = layers.data("prompt", shape=[Tp], dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=N)
+    p = ((rng.randint(0, VOCAB, (4, 1)) + 3 * np.arange(Tp)) % VOCAB
+         ).astype("int64")
+    got, = exe.run(gen_prog, feed={"prompt": p}, fetch_list=[out_ids],
+                   scope=scope)
+    got = np.asarray(got)
+    assert got.shape == (4, Tp + N)
+    np.testing.assert_array_equal(got[:, :Tp], p)
+
+    # naive baseline: iteratively re-forward the whole sequence
+    cur = p
+    for t in range(N):
+        prog_t, logits_t = _build_full_forward(Tp + t)
+        lg, = exe.run(prog_t, feed={"ids_fwd": cur}, fetch_list=[logits_t],
+                      scope=scope)
+        nxt = np.argmax(np.asarray(lg)[:, -1], axis=-1)[:, None]
+        cur = np.concatenate([cur, nxt.astype("int64")], axis=1)
+    np.testing.assert_array_equal(got, cur)
+
+    # and the learned rule mostly holds on generated tokens (the exact
+    # decode==reforward equality above is the correctness property; this
+    # one just shows the tiny model learned something real)
+    expect = (p[:, -1:] + 3 * (1 + np.arange(N))) % VOCAB
+    assert np.mean(got[:, Tp:] == expect) >= 0.85
+
+
+def test_generate_rejects_overflow():
+    """Prompt + new tokens beyond the position table fails at BUILD time
+    (shape inference runs the lowering abstractly), not at step N."""
+    import pytest
+
+    prog, startup = pt.Program(), pt.Program()
+    with pytest.raises(Exception, match="exceeds max_len"):
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("p2", shape=[MAXLEN], dtype="int64")
+            models.transformer_lm_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=4)
