@@ -150,7 +150,6 @@ def transformer_stack_generate(attrs, ins):
     N = attrs["max_new_tokens"]
     b, Tp = prompt.shape
     L, d = params["ln1_s"].shape
-    head_d = d // num_heads
     Ttot = Tp + N
     if Ttot > pos_emb.shape[0]:
         raise ValueError(
@@ -206,10 +205,15 @@ def transformer_stack_generate(attrs, ins):
 
         h1, (ck, cv) = jax.lax.scan(layer, x1, (params, ck, cv))
         nxt = jnp.argmax(logits_of(h1[:, 0]), axis=-1)
-        return (nxt, ck, cv), tok
+        return (nxt, ck, cv), nxt
 
+    if N == 0:
+        return out(Out=prompt)
+    # prefill already produced token Tp; the scan decodes the remaining
+    # N - 1 (emitting each step's OWN result — no wasted final step)
     (_, _, _), toks = jax.lax.scan(
-        step, (next_tok, cache_k, cache_v), jnp.arange(N))
-    generated = jnp.moveaxis(toks, 0, 1)  # [b, N]
+        step, (next_tok, cache_k, cache_v), jnp.arange(N - 1))
+    generated = jnp.concatenate(
+        [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)  # [b, N]
     return out(Out=jnp.concatenate(
         [prompt, generated.astype(prompt.dtype)], axis=1))

@@ -62,6 +62,31 @@ def experiment(name, fn, seconds=1200):
 
 
 def main():
+    # A downed tunnel HANGS backend init in uninterruptible C code (the
+    # xla_env notes; SIGALRM cannot fire mid-call), so probe the backend
+    # in a disposable child first, with a hard subprocess timeout.
+    import subprocess
+
+    detail = ""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=180)
+        platform = (probe.stdout or "").strip().splitlines()[-1] \
+            if probe.returncode == 0 and probe.stdout.strip() else None
+        if platform is None:
+            tail = (probe.stderr or "").strip().splitlines()[-3:]
+            detail = f" rc={probe.returncode}: " + " | ".join(tail)
+    except subprocess.TimeoutExpired:
+        platform = None
+        detail = " (probe timed out after 180s)"
+    if platform is None or platform == "cpu":
+        emit({"experiment": "probe", "ok": False,
+              "error": f"no TPU backend (probe got {platform!r}; "
+                       f"tunnel down or hung){detail}"[:500]})
+        return 1
+
     import jax
 
     dev = jax.devices()[0]
@@ -139,6 +164,39 @@ def main():
     experiment("lm_h8_fused_on", lambda: lm(8, True))
     experiment("lm_h8_fused_off", lambda: lm(8, False))
     experiment("lm_h16_fused_on", lambda: lm(16, True))
+
+    # 3b. Stacked scan-over-layers variant (pipeline_stack=True on one
+    #     chip): same math, one compiled block body — measures the
+    #     compile-time and step-time cost/benefit of the stacked form.
+    def lm_stacked():
+        import numpy as np
+        pt.flags.FLAGS.fused_linear_grad = True
+        bs, T, vocab, d, Lh = 8, 2048, 16384, 1024, 8
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            tgt = layers.data("tgt", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=Lh, num_heads=8,
+                max_len=T, pipeline_stack=True)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, vocab]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
+                loss, startup_program=startup)
+        rng = np.random.RandomState(0)
+        feed = {"ids": rng.randint(0, vocab, (bs, T)).astype("int64"),
+                "tgt": rng.randint(0, vocab, (bs, T)).astype("int64")}
+        t0 = time.perf_counter()
+        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
+                                      feed, steps=10)
+        wall = time.perf_counter() - t0
+        flops = bench.transformer_train_flops(bs, T, d, Lh, vocab)
+        return {"tokens_per_sec": round(bs * T / sec),
+                "mfu": mfu(flops / sec),
+                "compile_plus_run_wall_s": round(wall, 1)}
+
+    experiment("lm_stacked_scan", lm_stacked)
 
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
     pt.flags.FLAGS.fused_linear_grad = True
