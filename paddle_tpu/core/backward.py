@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from .program import (GRAD_SUFFIX, Block, Operator, Program, Variable,
                       grad_var_name)
-from .registry import get_op, register_op
+from .registry import get_op, register_op, op_uses_rng
 from .types import is_floating
 
 # Ops after which there is nothing to differentiate.
@@ -518,7 +518,7 @@ def append_backward(
             continue
 
         use_custom = opdef.grad_fn is not None
-        if opdef.needs_rng and not use_custom:
+        if op_uses_rng(opdef, op.attrs) and not use_custom:
             raise NotImplementedError(
                 f"op {op.type!r} uses randomness and has no custom grad_fn"
             )

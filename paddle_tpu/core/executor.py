@@ -28,7 +28,7 @@ import numpy as np
 from . import program as prog_mod
 from .enforce import EnforceError, op_error
 from .program import Program, RNG_VAR
-from .registry import get_op
+from .registry import get_op, op_uses_rng
 from .selected_rows import SelectedRows, densify
 from .scope import Scope, global_scope
 
@@ -329,7 +329,7 @@ class Executor:
         uses_rng = False
         for op in block.ops:
             opdef = get_op(op.type)
-            if opdef.needs_rng:
+            if op_uses_rng(opdef, op.attrs):
                 uses_rng = True
             for slot, names in op.inputs.items():
                 for name in names:
@@ -389,9 +389,11 @@ class Executor:
                     if opdef.special:
                         outs = opdef.fn(op.attrs, ins, executor=self, env=env,
                                         op=op, program=program, scope=scope)
-                    elif opdef.needs_rng:
+                    elif op_uses_rng(opdef, op.attrs):
                         rng, sub = jax.random.split(rng)
                         outs = opdef.fn(op.attrs, ins, rng=sub)
+                    elif callable(opdef.needs_rng):
+                        outs = opdef.fn(op.attrs, ins, rng=None)
                     else:
                         outs = opdef.fn(op.attrs, ins)
                 except EnforceError:

@@ -32,7 +32,11 @@ Arrays = Dict[str, List[jax.Array]]  # slot -> list of arrays
 class OpDef:
     type: str
     fn: Callable  # fn(attrs, ins: Arrays, [rng]) -> Arrays
-    needs_rng: bool = False
+    # True, False, or a predicate over the op's attrs (for ops that only
+    # sometimes draw randomness, e.g. sampling vs greedy decode). When not
+    # strictly False the kernel fn must accept an ``rng`` kwarg (None when
+    # the predicate says this instance draws nothing).
+    needs_rng: object = False
     # Custom vjp: grad_fn(attrs, ins, outs, out_grads) -> dict varslot->grads
     grad_fn: Optional[Callable] = None
     # Ops whose semantics are stateful/structural and are handled specially by
@@ -84,6 +88,13 @@ def get_op(type: str) -> OpDef:
     return _REGISTRY[type]
 
 
+def op_uses_rng(opdef: OpDef, attrs) -> bool:
+    """Does THIS op instance consume randomness? Attr-dependent ops
+    declare needs_rng as a predicate; plain ops as a bool."""
+    nr = opdef.needs_rng
+    return bool(nr(attrs or {})) if callable(nr) else bool(nr)
+
+
 def has_op(type: str) -> bool:
     return type in _REGISTRY
 
@@ -99,12 +110,13 @@ def infer_outputs(op_type: str, attrs, in_shapes: Arrays) -> Dict[str, List[jax.
     reference's per-op InferShape implementations.
     """
     opdef = get_op(op_type)
-    kwargs = {}
-    if opdef.needs_rng:
-        kwargs["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
-
+    if op_uses_rng(opdef, attrs):
         def f(ins, rng):
             return opdef.fn(attrs, ins, rng=rng)
 
-        return jax.eval_shape(f, in_shapes, kwargs["rng"])
+        return jax.eval_shape(f, in_shapes,
+                              jax.ShapeDtypeStruct((2,), jnp.uint32))
+    if callable(opdef.needs_rng):
+        return jax.eval_shape(lambda ins: opdef.fn(attrs, ins, rng=None),
+                              in_shapes)
     return jax.eval_shape(lambda ins: opdef.fn(attrs, ins), in_shapes)

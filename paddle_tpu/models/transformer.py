@@ -71,11 +71,13 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
 
 def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
                             num_heads=8, d_ff=None, max_len=2048,
-                            max_new_tokens=32, main_program=None,
-                            startup_program=None):
+                            max_new_tokens=32, temperature=0.0, top_k=0,
+                            main_program=None, startup_program=None):
     """Generation program for a ``transformer_lm(pipeline_stack=True)``
-    model: greedy KV-cache incremental decoding
-    (ops/pipeline_ops.transformer_stack_generate).
+    model: KV-cache incremental decoding
+    (ops/pipeline_ops.transformer_stack_generate) — greedy by default,
+    temperature/top-k sampling through the RNG plane when
+    ``temperature`` > 0.
 
     Rebuilds the SAME named parameters (tok_emb, pos_emb, lm_stack.*,
     final_ln.*, lm_head.w) so running this program in the training scope
@@ -110,6 +112,8 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
                                  d_ff))
     o = helper.simple_op("transformer_stack_generate", ins,
                          {"num_heads": num_heads,
-                          "max_new_tokens": max_new_tokens})
+                          "max_new_tokens": max_new_tokens,
+                          "temperature": float(temperature),
+                          "top_k": int(top_k)})
     o.stop_gradient = True
     return o

@@ -123,9 +123,10 @@ def pipelined_transformer_stack(attrs, ins):
     return out(Out=scan_layers(params, x))
 
 
-@register_op("transformer_stack_generate")
-def transformer_stack_generate(attrs, ins):
-    """Greedy incremental decoding with a per-layer KV cache.
+@register_op("transformer_stack_generate",
+             needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
+def transformer_stack_generate(attrs, ins, rng):
+    """Incremental decoding with a per-layer KV cache.
 
     Prompt [b, Tp] int + the stacked block weights + TokEmb [V, d],
     PosEmb [maxlen, d], FinalLnS/FinalLnB [d], HeadW [d, V]
@@ -134,9 +135,11 @@ def transformer_stack_generate(attrs, ins):
     The serving path the training stack earns: prefill runs the blocks
     once over the prompt while capturing every layer's K/V; the decode
     loop is a lax.scan over steps — one token embeds, attends against the
-    cache (position-masked), appends its K/V, and argmax picks the next
-    id. O(T) work per token instead of O(T^2) re-forwarding; everything
-    static-shaped for XLA (the cache is preallocated at Tp + N).
+    cache (position-masked), appends its K/V, and the next id comes from
+    argmax (temperature attr == 0) or temperature/top-k sampling through
+    the executor's RNG plane. O(T) work per token instead of O(T^2)
+    re-forwarding; everything static-shaped for XLA (the cache is
+    preallocated at Tp + N).
     """
     prompt = single(ins, "Prompt")
     tok_emb = single(ins, "TokEmb")
@@ -148,6 +151,8 @@ def transformer_stack_generate(attrs, ins):
               for slot, key in _STACK_SLOTS.items()}
     num_heads = attrs["num_heads"]
     N = attrs["max_new_tokens"]
+    temperature = attrs.get("temperature") or 0.0
+    top_k = attrs.get("top_k") or 0
     b, Tp = prompt.shape
     L, d = params["ln1_s"].shape
     Ttot = Tp + N
@@ -167,6 +172,21 @@ def transformer_stack_generate(attrs, ins):
         return jnp.einsum("bd,dv->bv", hn_c, hw_c,
                           precision=mxu_precision()).astype(jnp.float32)
 
+    vocab = head_w.shape[1]
+    if top_k and not 0 < top_k <= vocab:
+        raise ValueError(f"top_k {top_k} outside [1, vocab {vocab}]")
+
+    def pick(logits, step):
+        if temperature == 0.0:
+            # greedy draws nothing: the op then declares needs_rng False
+            # (rng is None) and the run leaves the scope's RNG untouched
+            return jnp.argmax(logits, axis=-1)
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        return jax.random.categorical(jax.random.fold_in(rng, step),
+                                      logits / temperature, axis=-1)
+
     # ---- prefill: run the stack over the prompt, capturing K/V -------
     x = embed(prompt, 0)
 
@@ -181,7 +201,7 @@ def transformer_stack_generate(attrs, ins):
     pad[3] = (0, N)  # [L, b, H, Tp, dh] -> [L, b, H, Ttot, dh]
     cache_k = jnp.pad(ks, pad)
     cache_v = jnp.pad(vs, pad)
-    next_tok = jnp.argmax(logits_of(h[:, -1]), axis=-1)  # [b]
+    next_tok = pick(logits_of(h[:, -1]), 0)  # [b]
 
     # ---- decode: one token at a time against the cache ---------------
     def step(carry, n):
@@ -204,7 +224,7 @@ def transformer_stack_generate(attrs, ins):
             return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
 
         h1, (ck, cv) = jax.lax.scan(layer, x1, (params, ck, cv))
-        nxt = jnp.argmax(logits_of(h1[:, 0]), axis=-1)
+        nxt = pick(logits_of(h1[:, 0]), n + 1)
         return (nxt, ck, cv), nxt
 
     if N == 0:

@@ -97,3 +97,63 @@ def test_generate_rejects_overflow():
             models.transformer_lm_generate(
                 prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
                 num_heads=H, max_len=MAXLEN, max_new_tokens=4)
+
+
+def test_sampled_generation_varies_and_respects_topk():
+    """temperature>0 routes through the RNG plane: successive runs draw
+    different continuations, and top_k=1 collapses back to greedy."""
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data("p3", shape=[4], dtype="int64")
+        sampled = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=12, temperature=1.5)
+        greedy = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=12)
+        top1 = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=12, temperature=0.7, top_k=1)
+    exe = pt.Executor(pt.TPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    p = np.arange(8, dtype="int64").reshape(2, 4)
+    a, g1, t1 = exe.run(prog, feed={"p3": p},
+                        fetch_list=[sampled, greedy, top1], scope=scope)
+    b_, g2, t2 = exe.run(prog, feed={"p3": p},
+                         fetch_list=[sampled, greedy, top1], scope=scope)
+    a, b_ = np.asarray(a), np.asarray(b_)
+    assert (a >= 0).all() and (a < VOCAB).all()
+    # the RNG state advances between runs -> different draws
+    assert not np.array_equal(a[:, 4:], b_[:, 4:])
+    # greedy is deterministic run to run
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    # top_k=1 keeps only the argmax bucket: equals greedy regardless of
+    # temperature or RNG draws
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(g1))
+    np.testing.assert_array_equal(np.asarray(t2), np.asarray(g1))
+
+
+def test_greedy_generation_leaves_rng_untouched():
+    """Greedy decode must not consume the scope RNG stream: interleaving
+    eval-generation with training cannot perturb dropout draws or break
+    bit-exact resume (the op's needs_rng is an attr predicate)."""
+    from paddle_tpu.core.program import RNG_VAR
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data("p4", shape=[4], dtype="int64")
+        greedy = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=4)
+    exe = pt.Executor(pt.TPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    p = np.arange(8, dtype="int64").reshape(2, 4)
+    before = np.asarray(scope.get(RNG_VAR)) if scope.has(RNG_VAR) else None
+    exe.run(prog, feed={"p4": p}, fetch_list=[greedy], scope=scope)
+    after = np.asarray(scope.get(RNG_VAR)) if scope.has(RNG_VAR) else None
+    if before is None:
+        assert after is None
+    else:
+        np.testing.assert_array_equal(before, after)

@@ -198,6 +198,36 @@ def main():
 
     experiment("lm_stacked_scan", lm_stacked)
 
+    # 3c. Serving: KV-cache decode throughput (tokens/sec generated).
+    def lm_decode():
+        import numpy as np
+        bs, Tp, N, vocab, d, Lh = 8, 1024, 128, 16384, 1024, 8
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("prompt", shape=[Tp], dtype="int64")
+            out_ids = models.transformer_lm_generate(
+                prompt, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=8, max_len=Tp + N, max_new_tokens=N)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        feed = {"prompt": rng.randint(0, vocab, (bs, Tp)).astype("int64")}
+        o, = exe.run(prog, feed=feed, fetch_list=[out_ids], scope=scope)
+        np.asarray(o)  # close compile + warmup
+        t0 = time.perf_counter()
+        steps = 3
+        for _ in range(steps):
+            o, = exe.run(prog, feed=feed, fetch_list=[out_ids],
+                         scope=scope, return_numpy=False)
+        np.asarray(o)
+        sec = (time.perf_counter() - t0) / steps
+        return {"decode_tokens_per_sec": round(bs * N / sec),
+                "ms_per_token_batch": round(sec / N * 1e3, 3),
+                "config": f"bs{bs} prefill{Tp} decode{N}"}
+
+    experiment("lm_decode_throughput", lm_decode)
+
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
     pt.flags.FLAGS.fused_linear_grad = True
     experiment("lstm_varlen",
