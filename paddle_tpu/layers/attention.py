@@ -177,7 +177,7 @@ def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
 
 def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
                                 causal=True, n_microbatches=None,
-                                pipe_axis="pp", data_axis="dp",
+                                pipe_axis="pp", data_axis="dp", remat=False,
                                 param_attr=None, main_program=None,
                                 startup_program=None):
     """L pre-LN transformer blocks with stacked [L, ...] weights — the
@@ -216,7 +216,7 @@ def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
         "pipelined_transformer_stack", ins,
         {"num_heads": num_heads, "causal": causal,
          "n_microbatches": n_microbatches, "pipe_axis": pipe_axis,
-         "data_axis": data_axis})
+         "data_axis": data_axis, "remat": remat})
     return o
 
 

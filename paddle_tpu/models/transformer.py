@@ -16,7 +16,7 @@ from ..param_attr import ParamAttr
 
 def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                    d_ff=None, max_len=2048, pipeline_stack=False,
-                   n_microbatches=None, main_program=None,
+                   n_microbatches=None, remat=False, main_program=None,
                    startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
     learned positional embedding, weight-tied-free output head.
@@ -52,7 +52,7 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                 "program would silently share weights")
         x = layers.pipelined_transformer_stack(
             x, n_layers=n_layers, num_heads=num_heads, d_ff=d_ff,
-            causal=True, n_microbatches=n_microbatches,
+            causal=True, n_microbatches=n_microbatches, remat=remat,
             param_attr=ParamAttr(name="lm_stack"), **kw)
         ln_attr = ParamAttr(name="final_ln.scale")
         ln_bias = ParamAttr(name="final_ln.bias")

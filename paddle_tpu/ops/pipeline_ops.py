@@ -96,10 +96,14 @@ def pipelined_transformer_stack(attrs, ins):
     num_heads = attrs["num_heads"]
     causal = attrs.get("causal", True)
 
+    remat = attrs.get("remat", False)
+
     def scan_layers(p, h):
         def body(carry, layer_p):
             return _block(layer_p, carry, num_heads, causal), None
 
+        if remat:
+            body = jax.checkpoint(body)
         h, _ = jax.lax.scan(body, h, p)
         return h
 

@@ -16,8 +16,8 @@ import threading
 from typing import Any, Callable, Iterable, List
 
 __all__ = [
-    "map_readers", "buffered", "shuffle", "chain", "compose", "firstn",
-    "xmap_readers", "cache", "PipeReader",
+    "map_readers", "buffered", "bucket_by_length", "shuffle", "chain",
+    "compose", "firstn", "xmap_readers", "cache", "PipeReader",
 ]
 
 
@@ -84,6 +84,52 @@ def compose(*readers, check_alignment: bool = True):
                 yield sum((make_tuple(p) for p in parts), ())
 
     return reader
+
+
+def bucket_by_length(reader, batch_size: int, key=None, buf_size: int = 1024,
+                     shuffle_buckets: bool = True, seed: int = None):
+    """Batch variable-length samples with like-length neighbours.
+
+    Sorts a sliding ``buf_size`` window by ``key`` (default: len of the
+    sample's first column), slices it into batches, and yields the batches
+    in shuffled order so length doesn't correlate with training step. On a
+    TPU this is the padding-waste lever for the LoD/varlen path: a padded
+    batch costs max-length x batch FLOPs, so batching near-equal lengths
+    recovers most of what ragged data loses (the reference's RNN benchmark
+    relies on the same sorted-bucket trick in its IMDB reader).
+
+    Returns a reader of BATCHES (lists of samples), like ``paddle.batch``.
+    """
+    key = key or (lambda sample: len(sample[0]))
+    rng = random.Random(seed)
+
+    def bucketed():
+        buf: List[Any] = []
+
+        def flush(buf, final):
+            buf.sort(key=key)
+            n_full = len(buf) // batch_size * batch_size
+            batches = [buf[i:i + batch_size]
+                       for i in range(0, n_full, batch_size)]
+            if shuffle_buckets:
+                rng.shuffle(batches)
+            yield from batches
+            # mid-stream remainders carry into the next window so every
+            # batch but (at most) the epoch's last is full-sized — ragged
+            # batch shapes would each cost a fresh XLA compile
+            if final and n_full < len(buf):
+                yield buf[n_full:]
+            else:
+                buf[:n_full] = []
+
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                yield from flush(buf, final=False)
+        if buf:
+            yield from flush(buf, final=True)
+
+    return bucketed
 
 
 def buffered(reader, size: int):
