@@ -69,6 +69,36 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
     return logits
 
 
+def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
+                      n_layers):
+    """The weights-shared-by-name contract with transformer_lm
+    (pipeline_stack=True), in ONE place: rebuild tok_emb/pos_emb/
+    final_ln/lm_head/lm_stack.* so a generation-family program rejoins
+    the trained tensors. Returns the op-input dict (minus Prompt)."""
+    from ..initializer import ConstantInitializer
+    from ..layers.attention import make_stack_params
+
+    tok = helper.create_parameter(ParamAttr(name="tok_emb"),
+                                  shape=[vocab_size, d_model],
+                                  dtype="float32")
+    pos = helper.create_parameter(ParamAttr(name="pos_emb"),
+                                  shape=[max_len, d_model], dtype="float32")
+    ln_s = helper.create_parameter(
+        ParamAttr(name="final_ln.scale"), shape=[d_model], dtype="float32",
+        default_initializer=ConstantInitializer(1.0))
+    ln_b = helper.create_parameter(ParamAttr(name="final_ln.bias"),
+                                   shape=[d_model], dtype="float32",
+                                   is_bias=True)
+    head_w = helper.create_parameter(ParamAttr(name="lm_head.w"),
+                                     shape=[d_model, vocab_size],
+                                     dtype="float32")
+    ins = {"TokEmb": [tok], "PosEmb": [pos], "FinalLnS": [ln_s],
+           "FinalLnB": [ln_b], "HeadW": [head_w]}
+    ins.update(make_stack_params(helper, "lm_stack", n_layers, d_model,
+                                 d_ff))
+    return ins
+
+
 def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
                             num_heads=8, d_ff=None, max_len=2048,
                             max_new_tokens=32, temperature=0.0, top_k=0,
@@ -85,31 +115,12 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
     would re-initialize them; the pattern is the GAN demo's shared-weight
     sibling programs). prompt: [b, Tp] int64 -> [b, Tp + max_new_tokens].
     """
-    from ..layers.attention import make_stack_params
-
     kw = dict(main_program=main_program, startup_program=startup_program)
     d_ff = d_ff or 4 * d_model
     helper = LayerHelper("transformer_lm_generate", **kw)
-    tok = helper.create_parameter(ParamAttr(name="tok_emb"),
-                                  shape=[vocab_size, d_model],
-                                  dtype="float32")
-    pos = helper.create_parameter(ParamAttr(name="pos_emb"),
-                                  shape=[max_len, d_model], dtype="float32")
-    from ..initializer import ConstantInitializer
-
-    ln_s = helper.create_parameter(
-        ParamAttr(name="final_ln.scale"), shape=[d_model], dtype="float32",
-        default_initializer=ConstantInitializer(1.0))
-    ln_b = helper.create_parameter(ParamAttr(name="final_ln.bias"),
-                                   shape=[d_model], dtype="float32",
-                                   is_bias=True)
-    head_w = helper.create_parameter(ParamAttr(name="lm_head.w"),
-                                     shape=[d_model, vocab_size],
-                                     dtype="float32")
-    ins = {"Prompt": [prompt], "TokEmb": [tok], "PosEmb": [pos],
-           "FinalLnS": [ln_s], "FinalLnB": [ln_b], "HeadW": [head_w]}
-    ins.update(make_stack_params(helper, "lm_stack", n_layers, d_model,
-                                 d_ff))
+    ins = {"Prompt": [prompt]}
+    ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
+                                 max_len, n_layers))
     o = helper.simple_op("transformer_stack_generate", ins,
                          {"num_heads": num_heads,
                           "max_new_tokens": max_new_tokens,
@@ -117,3 +128,30 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
                           "top_k": int(top_k)})
     o.stop_gradient = True
     return o
+
+
+def transformer_lm_beam_search(prompt, vocab_size, d_model=256, n_layers=4,
+                               num_heads=8, d_ff=None, max_len=2048,
+                               max_new_tokens=32, beam_size=4,
+                               length_penalty=0.0, eos_id=None,
+                               main_program=None, startup_program=None):
+    """Beam-search generation for a ``transformer_lm(pipeline_stack=True)``
+    model (ops/pipeline_ops.transformer_stack_beam_search). Same
+    shared-parameter contract as ``transformer_lm_generate``. Returns
+    (ids [b, K, Tp+N] best-first, scores [b, K])."""
+    kw = dict(main_program=main_program, startup_program=startup_program)
+    d_ff = d_ff or 4 * d_model
+    helper = LayerHelper("transformer_lm_beam_search", **kw)
+    ins = {"Prompt": [prompt]}
+    ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
+                                 max_len, n_layers))
+    outs, _ = helper.append_op(
+        "transformer_stack_beam_search", ins, ["Out", "Scores"],
+        {"num_heads": num_heads, "max_new_tokens": max_new_tokens,
+         "beam_size": beam_size, "length_penalty": float(length_penalty),
+         "eos_id": -1 if eos_id is None else int(eos_id)})
+    ids = outs["Out"][0]
+    scores = outs["Scores"][0]
+    ids.stop_gradient = True
+    scores.stop_gradient = True
+    return ids, scores

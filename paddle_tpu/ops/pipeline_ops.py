@@ -127,6 +127,66 @@ def pipelined_transformer_stack(attrs, ins):
     return out(Out=scan_layers(params, x))
 
 
+
+def _unpack_lm_ins(ins):
+    """Shared input unpacking for the decode ops: (prompt, embeddings,
+    final-LN, head, stacked block params)."""
+    return (single(ins, "Prompt"), single(ins, "TokEmb"),
+            single(ins, "PosEmb"), single(ins, "FinalLnS"),
+            single(ins, "FinalLnB"), single(ins, "HeadW"),
+            {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()})
+
+
+def _embed_fn(tok_emb, pos_emb):
+    def embed(ids, pos0):
+        t = ids.shape[1]
+        return (tok_emb[ids]
+                + jax.lax.dynamic_slice_in_dim(pos_emb, pos0, t, 0)[None])
+
+    return embed
+
+
+def _logits_fn(ln_s, ln_b, head_w):
+    def logits_of(h_last):
+        hn = _ln(h_last, ln_s, ln_b)
+        hn_c, hw_c = amp_cast(hn, head_w)
+        return jnp.einsum("bd,dv->bv", hn_c, hw_c,
+                          precision=mxu_precision()).astype(jnp.float32)
+
+    return logits_of
+
+
+def _prefill(params, x, num_heads, b, Tp):
+    """Run the stack over the prompt capturing every layer's K/V:
+    returns (hidden [b, Tp, d], ks, vs [L, b, H, Tp, dh])."""
+    def prefill_body(h, layer_p):
+        q, k, v = _attn_proj(layer_p, h, num_heads)
+        ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tp, x.shape[-1])
+        return _attn_out_ffn(layer_p, h, ctx), (k, v)
+
+    return jax.lax.scan(prefill_body, x, params)
+
+
+def _decode_layer_fn(params, num_heads, d):
+    """One-token decode through all layers against the cache; returns a
+    fn(h1, (layer_p, ck_l, cv_l), pos) suitable for lax.scan over layers
+    (pos = the query's position; cache rows < pos+1 are visible)."""
+    from ..kernels.flash_attention import reference_attention
+
+    def layer(h1, inp, pos):
+        layer_p, ck_l, cv_l = inp
+        q, k, v = _attn_proj(layer_p, h1, num_heads)
+        ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos, 2)
+        cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos, 2)
+        ctx = reference_attention(
+            q, ck_l, cv_l, lengths=jnp.full((h1.shape[0],), pos + 1))
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(h1.shape[0], 1, d)
+        return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
+
+    return layer
+
+
 @register_op("transformer_stack_generate",
              needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
 def transformer_stack_generate(attrs, ins, rng):
@@ -145,14 +205,8 @@ def transformer_stack_generate(attrs, ins, rng):
     re-forwarding; everything static-shaped for XLA (the cache is
     preallocated at Tp + N).
     """
-    prompt = single(ins, "Prompt")
-    tok_emb = single(ins, "TokEmb")
-    pos_emb = single(ins, "PosEmb")
-    ln_s = single(ins, "FinalLnS")
-    ln_b = single(ins, "FinalLnB")
-    head_w = single(ins, "HeadW")
-    params = {key: single(ins, slot)
-              for slot, key in _STACK_SLOTS.items()}
+    (prompt, tok_emb, pos_emb, ln_s, ln_b, head_w,
+     params) = _unpack_lm_ins(ins)
     num_heads = attrs["num_heads"]
     N = attrs["max_new_tokens"]
     temperature = attrs.get("temperature") or 0.0
@@ -164,18 +218,8 @@ def transformer_stack_generate(attrs, ins, rng):
         raise ValueError(
             f"prompt {Tp} + {N} new tokens exceeds max_len "
             f"{pos_emb.shape[0]}")
-
-    def embed(ids, pos0):
-        t = ids.shape[1]
-        return (tok_emb[ids]
-                + jax.lax.dynamic_slice_in_dim(pos_emb, pos0, t, 0)[None])
-
-    def logits_of(h_last):
-        hn = _ln(h_last, ln_s, ln_b)
-        hn_c, hw_c = amp_cast(hn, head_w)
-        return jnp.einsum("bd,dv->bv", hn_c, hw_c,
-                          precision=mxu_precision()).astype(jnp.float32)
-
+    embed = _embed_fn(tok_emb, pos_emb)
+    logits_of = _logits_fn(ln_s, ln_b, head_w)
     vocab = head_w.shape[1]
     if top_k and not 0 < top_k <= vocab:
         raise ValueError(f"top_k {top_k} outside [1, vocab {vocab}]")
@@ -192,42 +236,22 @@ def transformer_stack_generate(attrs, ins, rng):
                                       logits / temperature, axis=-1)
 
     # ---- prefill: run the stack over the prompt, capturing K/V -------
-    x = embed(prompt, 0)
-
-    def prefill_body(h, layer_p):
-        q, k, v = _attn_proj(layer_p, h, num_heads)
-        ctx = flash_attention(q, k, v, causal=True)
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tp, d)
-        return _attn_out_ffn(layer_p, h, ctx), (k, v)
-
-    h, (ks, vs) = jax.lax.scan(prefill_body, x, params)
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp)
     pad = [(0, 0)] * 5
     pad[3] = (0, N)  # [L, b, H, Tp, dh] -> [L, b, H, Ttot, dh]
     cache_k = jnp.pad(ks, pad)
     cache_v = jnp.pad(vs, pad)
     next_tok = pick(logits_of(h[:, -1]), 0)  # [b]
+    decode_layer = _decode_layer_fn(params, num_heads, d)
 
     # ---- decode: one token at a time against the cache ---------------
     def step(carry, n):
         tok, ck, cv = carry
         pos = Tp + n
         x1 = embed(tok[:, None], pos)  # [b, 1, d]
-
-        def layer(h1, inp):
-            from ..kernels.flash_attention import reference_attention
-
-            layer_p, ck_l, cv_l = inp
-            q, k, v = _attn_proj(layer_p, h1, num_heads)
-            ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos, 2)
-            cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos, 2)
-            # one query against the cache prefix: the lengths mask of the
-            # reference kernel is exactly the <= pos predicate
-            ctx = reference_attention(
-                q, ck_l, cv_l, lengths=jnp.full((b,), pos + 1))
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, d)
-            return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
-
-        h1, (ck, cv) = jax.lax.scan(layer, x1, (params, ck, cv))
+        h1, (ck, cv) = jax.lax.scan(
+            lambda h1, inp: decode_layer(h1, inp, pos),
+            x1, (params, ck, cv))
         nxt = pick(logits_of(h1[:, 0]), n + 1)
         return (nxt, ck, cv), nxt
 
@@ -241,3 +265,119 @@ def transformer_stack_generate(attrs, ins, rng):
         [next_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)  # [b, N]
     return out(Out=jnp.concatenate(
         [prompt, generated.astype(prompt.dtype)], axis=1))
+
+
+@register_op("transformer_stack_beam_search")
+def transformer_stack_beam_search(attrs, ins):
+    """Beam search over the KV-cache decode path.
+
+    Same inputs as transformer_stack_generate; attrs: num_heads,
+    max_new_tokens, beam_size, length_penalty (GNMT-style
+    ((5+len)/6)^alpha score normalisation), eos_id (-1 = none).
+    Out [b, K, Tp + N] int (beams sorted best-first) and
+    Scores [b, K] f32 (length-normalised log-probs).
+
+    The beam dimension rides the batch axis (caches live at [L, b*K, ...])
+    and every step reorders each layer's cache by the surviving beams'
+    parent index — one gather per layer, the TPU-native equivalent of the
+    reference's beam_search op family shuffling LoD rows
+    (/root/reference/paddle/operators/beam_search_op.cc).
+    """
+    (prompt, tok_emb, pos_emb, ln_s, ln_b, head_w,
+     params) = _unpack_lm_ins(ins)
+    num_heads = attrs["num_heads"]
+    N = attrs["max_new_tokens"]
+    K = attrs.get("beam_size", 4)
+    alpha = attrs.get("length_penalty") or 0.0
+    eos_id = attrs.get("eos_id", -1)
+    if eos_id is None:
+        eos_id = -1
+    b, Tp = prompt.shape
+    L, d = params["ln1_s"].shape
+    V = head_w.shape[1]
+    Ttot = Tp + N
+    if Ttot > pos_emb.shape[0]:
+        raise ValueError(
+            f"prompt {Tp} + {N} new tokens exceeds max_len "
+            f"{pos_emb.shape[0]}")
+    if N < 1:
+        raise ValueError("beam search needs max_new_tokens >= 1")
+    if not 0 < K <= V:
+        raise ValueError(f"beam_size {K} outside [1, vocab {V}]")
+    embed = _embed_fn(tok_emb, pos_emb)
+    logits_of = _logits_fn(ln_s, ln_b, head_w)
+
+    # ---- prefill over the bare batch, then tile to beams --------------
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp)
+    pad = [(0, 0)] * 5
+    pad[3] = (0, N)
+    cache_k = jnp.repeat(jnp.pad(ks, pad), K, axis=1)  # [L, b*K, H, T, dh]
+    cache_v = jnp.repeat(jnp.pad(vs, pad), K, axis=1)
+
+    # first expansion: top-K tokens of the prompt's next-token distribution
+    logp0 = jax.nn.log_softmax(logits_of(h[:, -1]), axis=-1)  # [b, V]
+    scores, tok0 = jax.lax.top_k(logp0, K)  # [b, K] each
+    tokens = jnp.full((b, K, N), eos_id if eos_id >= 0 else 0,
+                      dtype=prompt.dtype)
+    tokens = tokens.at[:, :, 0].set(tok0.astype(prompt.dtype))
+    alive = (tok0 != eos_id) if eos_id >= 0 else jnp.ones((b, K), bool)
+    decode_layer = _decode_layer_fn(params, num_heads, d)
+
+    def step(carry, n):
+        tokens, scores, alive, ck, cv = carry
+        pos = Tp + 1 + n
+        cur = jax.lax.dynamic_index_in_dim(tokens, n, 2,
+                                           keepdims=False)  # [b, K]
+        x1 = embed(cur.reshape(b * K)[:, None], pos - 1)  # query at pos-1
+        h1, (ck, cv) = jax.lax.scan(
+            lambda h1, inp: decode_layer(h1, inp, pos - 1),
+            x1, (params, ck, cv))
+        logp = jax.nn.log_softmax(logits_of(h1[:, 0]),
+                                  axis=-1).reshape(b, K, V)
+        # finished beams: only the eos continuation keeps their score
+        if eos_id >= 0:
+            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(alive[:, :, None], logp, frozen[None, None])
+        cand = scores[:, :, None] + logp  # [b, K, V]
+        scores_new, flat_idx = jax.lax.top_k(cand.reshape(b, K * V), K)
+        parent = flat_idx // V  # [b, K]
+        tok = (flat_idx % V).astype(tokens.dtype)
+
+        # reorder beam state by parent
+        batch_ix = jnp.arange(b)[:, None]
+        tokens = tokens[batch_ix, parent]  # [b, K, N]
+        alive_p = alive[batch_ix, parent]
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, tok, n + 1, 2)
+        alive = alive_p & (tok != eos_id) if eos_id >= 0 \
+            else jnp.ones((b, K), bool)
+        # caches: [L, b*K, ...] gather along the beam-batch axis
+        flat_parent = (jnp.arange(b)[:, None] * K + parent).reshape(b * K)
+        ck = ck[:, flat_parent]
+        cv = cv[:, flat_parent]
+        return (tokens, scores_new, alive, ck, cv), None
+
+    # zero-length scan (N == 1) returns the carry unchanged
+    (tokens, scores, alive, _, _), _ = jax.lax.scan(
+        step, (tokens, scores, alive, cache_k, cache_v),
+        jnp.arange(N - 1))
+
+    if alpha:
+        # GNMT length normalisation over generated (non-frozen) length
+        if eos_id >= 0:
+            gen_len = jnp.minimum(
+                jnp.argmax(tokens == eos_id, axis=2) + 1, N).astype(
+                jnp.float32)
+            gen_len = jnp.where((tokens == eos_id).any(axis=2), gen_len,
+                                float(N))
+        else:
+            gen_len = jnp.full((b, K), float(N))
+        norm = ((5.0 + gen_len) / 6.0) ** alpha
+        scores = scores / norm
+    order = jnp.argsort(-scores, axis=1)
+    batch_ix = jnp.arange(b)[:, None]
+    tokens = tokens[batch_ix, order]
+    scores = scores[batch_ix, order]
+    prompts = jnp.repeat(prompt[:, None, :], K, axis=1)
+    return out(Out=jnp.concatenate([prompts, tokens], axis=2),
+               Scores=scores)

@@ -157,3 +157,135 @@ def test_greedy_generation_leaves_rng_untouched():
         assert after is None
     else:
         np.testing.assert_array_equal(before, after)
+
+
+class TestBeamSearch:
+    def _trained(self, Tp=8):
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        main, startup, _, loss = _build_train(Tp)
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        start = rng.randint(0, VOCAB, (64, 1))
+        seq = (start + 3 * np.arange(Tp + 1)) % VOCAB
+        feed = {"ids": seq[:, :-1].astype("int64"),
+                "tgt": seq[:, 1:].astype("int64")}
+        for _ in range(40):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        return exe, scope, rng
+
+    def test_beam1_equals_greedy(self):
+        Tp, N = 8, 5
+        exe, scope, rng = self._trained(Tp)
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("pb", shape=[Tp], dtype="int64")
+            greedy = models.transformer_lm_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N)
+            beams, scores = models.transformer_lm_beam_search(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N, beam_size=1)
+        p = ((rng.randint(0, VOCAB, (3, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+        g, bm = exe.run(prog, feed={"pb": p}, fetch_list=[greedy, beams],
+                        scope=scope)
+        np.testing.assert_array_equal(np.asarray(bm)[:, 0], np.asarray(g))
+
+    def test_scores_match_independent_forward(self):
+        """The reported beam scores must equal the sum of next-token
+        log-probs of the RETURNED sequences computed by a full forward —
+        the end-to-end check that per-step cache reordering is correct."""
+        Tp, N, K = 8, 4, 3
+        exe, scope, rng = self._trained(Tp)
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("pb2", shape=[Tp], dtype="int64")
+            beams, scores = models.transformer_lm_beam_search(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N, beam_size=K)
+        p = ((rng.randint(0, VOCAB, (2, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+        bm, sc = exe.run(prog, feed={"pb2": p}, fetch_list=[beams, scores],
+                         scope=scope)
+        bm, sc = np.asarray(bm), np.asarray(sc)
+        assert bm.shape == (2, K, Tp + N) and sc.shape == (2, K)
+        # scores sorted best-first
+        assert (np.diff(sc, axis=1) <= 1e-5).all()
+
+        # independent scoring: full forward over each returned sequence
+        full_prog, logits_full = _build_full_forward(Tp + N - 1)
+        for bi in range(2):
+            for ki in range(K):
+                seq = bm[bi, ki]
+                lg, = exe.run(full_prog,
+                              feed={"ids_fwd": seq[None, :-1]},
+                              fetch_list=[logits_full], scope=scope)
+                lp = np.asarray(lg)[0].astype(np.float64)
+                lp = lp - np.log(np.exp(lp - lp.max(-1, keepdims=True)
+                                        ).sum(-1, keepdims=True)) \
+                    - lp.max(-1, keepdims=True)
+                want = sum(lp[Tp - 1 + t, seq[Tp + t]] for t in range(N))
+                np.testing.assert_allclose(sc[bi, ki], want, rtol=2e-3,
+                                           atol=2e-3)
+
+    def test_eos_freezes_beams_and_length_penalty_normalises(self):
+        Tp, N, K = 8, 5, 2
+        exe, scope, rng = self._trained(Tp)
+        p = ((rng.randint(0, VOCAB, (1, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+
+        # find what greedy emits first, use THAT as eos: the best beam
+        # then finishes at length 1 and must stay frozen
+        prog0, startup0 = pt.Program(), pt.Program()
+        with pt.program_guard(prog0, startup0):
+            pr = layers.data("pe0", shape=[Tp], dtype="int64")
+            g = models.transformer_lm_generate(
+                pr, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+                max_len=MAXLEN, max_new_tokens=1)
+        gout, = exe.run(prog0, feed={"pe0": p}, fetch_list=[g], scope=scope)
+        eos = int(np.asarray(gout)[0, -1])
+
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            pr = layers.data("pe", shape=[Tp], dtype="int64")
+            beams, scores = models.transformer_lm_beam_search(
+                pr, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+                max_len=MAXLEN, max_new_tokens=N, beam_size=K, eos_id=eos,
+                length_penalty=1.0)
+        bm, sc = exe.run(prog, feed={"pe": p}, fetch_list=[beams, scores],
+                         scope=scope)
+        bm, sc = np.asarray(bm), np.asarray(sc)
+        # some beam ends with eos at step 0 and stays frozen: all-eos tail
+        done = [k for k in range(K) if bm[0, k, Tp] == eos]
+        assert done, bm[:, :, Tp:]
+        for k in done:
+            assert (bm[0, k, Tp:] == eos).all()
+        # its normalised score: logp(eos) / ((5+1)/6)^1 == logp(eos)
+        full_prog, logits_full = _build_full_forward(Tp)
+        lg, = exe.run(full_prog, feed={"ids_fwd": p},
+                      fetch_list=[logits_full], scope=scope)
+        lp = np.asarray(lg)[0, -1].astype(np.float64)
+        lp = lp - np.log(np.exp(lp - lp.max()).sum()) - lp.max()
+        np.testing.assert_allclose(sc[0, done[0]], lp[eos], rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_single_new_token_beams(self):
+        Tp, K = 8, 3
+        exe, scope, rng = self._trained(Tp)
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            pr = layers.data("p1t", shape=[Tp], dtype="int64")
+            beams, scores = models.transformer_lm_beam_search(
+                pr, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+                max_len=MAXLEN, max_new_tokens=1, beam_size=K)
+        p = ((rng.randint(0, VOCAB, (2, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+        bm, sc = exe.run(prog, feed={"p1t": p}, fetch_list=[beams, scores],
+                         scope=scope)
+        bm, sc = np.asarray(bm), np.asarray(sc)
+        assert bm.shape == (2, K, Tp + 1) and sc.shape == (2, K)
+        # K distinct top tokens, scores strictly ordered
+        for bi in range(2):
+            assert len(set(bm[bi, :, -1].tolist())) == K
+        assert (np.diff(sc, axis=1) <= 1e-6).all()
