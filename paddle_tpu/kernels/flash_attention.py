@@ -43,10 +43,25 @@ def _pick_block(t, preferred):
 
 
 def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
-    """Pure-jnp attention over [B, H, T, D]; the semantic ground truth."""
+    """Pure-jnp attention over [B, H, T, D]; the semantic ground truth.
+
+    K/V may carry Hkv < H head planes (grouped-query attention, query
+    head h reading kv head h // (H//Hkv)): the group structure stays in
+    the einsum — no [B, H, T, D] expansion is ever materialised, which is
+    the point of the smaller cache on the decode hot path."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    H, Hkv = q.shape[1], k.shape[1]
+    if H != Hkv:
+        if H % Hkv:
+            raise ValueError(f"query heads {H} not a multiple of kv heads "
+                             f"{Hkv}")
+        rep = H // Hkv
+        qg = q.reshape(q.shape[0], Hkv, rep, q.shape[2], q.shape[3])
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, k).astype(jnp.float32)             * sm_scale
+        s = s.reshape(q.shape[0], H, q.shape[2], k.shape[2])
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)             * sm_scale
     T = q.shape[2], k.shape[2]
     if causal:
         qi = jnp.arange(T[0])[:, None]
@@ -58,7 +73,12 @@ def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked rows (padding queries) produce NaN-free zeros
     p = jnp.where(jnp.isnan(p), 0.0, p)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    p = p.astype(v.dtype)
+    if H != Hkv:
+        pg = p.reshape(p.shape[0], Hkv, rep, p.shape[2], p.shape[3])
+        og = jnp.einsum("bgrqk,bgkd->bgrqd", pg, v)
+        return og.reshape(q.shape)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,

@@ -136,7 +136,7 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
 
 
 def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
-                      param_attr=None):
+                      num_heads=None, num_kv_heads=None, param_attr=None):
     """Create (or rejoin by name) the stacked [L, ...] block weights for
     ``pipelined_transformer_stack`` / ``transformer_stack_generate``:
     returns the op-input dict keyed by slot name. Names follow
@@ -159,11 +159,15 @@ def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
             default_initializer=init)
 
     one = ConstantInitializer(1.0)
+    # GQA: KV planes carry num_kv_heads < num_heads head groups
+    d_kv = (d_model if not (num_heads and num_kv_heads)
+            else d_model // num_heads * num_kv_heads)
+    qkv_width = d_model + 2 * d_kv
     return {
         "Ln1S": [mk("ln1_s", [L, d_model], bias=True, init=one)],
         "Ln1B": [mk("ln1_b", [L, d_model], bias=True)],
-        "QkvW": [mk("qkv_w", [L, d_model, 3 * d_model],
-                    fan=(d_model, 3 * d_model))],
+        "QkvW": [mk("qkv_w", [L, d_model, qkv_width],
+                    fan=(d_model, qkv_width))],
         "OutW": [mk("out_w", [L, d_model, d_model],
                     fan=(d_model, d_model))],
         "Ln2S": [mk("ln2_s", [L, d_model], bias=True, init=one)],
@@ -176,7 +180,8 @@ def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
 
 
 def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
-                                causal=True, n_microbatches=None,
+                                num_kv_heads=None, causal=True,
+                                n_microbatches=None,
                                 pipe_axis="pp", data_axis="dp", remat=False,
                                 param_attr=None, main_program=None,
                                 startup_program=None):
@@ -209,12 +214,18 @@ def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
     base = (_given.name if _given is not None and _given.name
             else helper.main_program.unique_name("pipe"))
 
+    if num_kv_heads and num_heads % num_kv_heads:
+        raise ValueError(f"num_heads {num_heads} not a multiple of "
+                         f"num_kv_heads {num_kv_heads}")
     ins = {"X": [x]}
     ins.update(make_stack_params(helper, base, L, d_model, d_ff,
-                                 dtype=x.dtype, param_attr=param_attr))
+                                 dtype=x.dtype, num_heads=num_heads,
+                                 num_kv_heads=num_kv_heads,
+                                 param_attr=param_attr))
     o = helper.simple_op(
         "pipelined_transformer_stack", ins,
-        {"num_heads": num_heads, "causal": causal,
+        {"num_heads": num_heads, "num_kv_heads": num_kv_heads,
+         "causal": causal,
          "n_microbatches": n_microbatches, "pipe_axis": pipe_axis,
          "data_axis": data_axis, "remat": remat})
     return o

@@ -15,9 +15,9 @@ from ..param_attr import ParamAttr
 
 
 def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
-                   d_ff=None, max_len=2048, pipeline_stack=False,
-                   n_microbatches=None, remat=False, main_program=None,
-                   startup_program=None):
+                   d_ff=None, num_kv_heads=None, max_len=2048,
+                   pipeline_stack=False, n_microbatches=None, remat=False,
+                   main_program=None, startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
     learned positional embedding, weight-tied-free output head.
 
@@ -52,16 +52,17 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                 "program would silently share weights")
         x = layers.pipelined_transformer_stack(
             x, n_layers=n_layers, num_heads=num_heads, d_ff=d_ff,
-            causal=True, n_microbatches=n_microbatches, remat=remat,
+            num_kv_heads=num_kv_heads, causal=True,
+            n_microbatches=n_microbatches, remat=remat,
             param_attr=ParamAttr(name="lm_stack"), **kw)
         ln_attr = ParamAttr(name="final_ln.scale")
         ln_bias = ParamAttr(name="final_ln.bias")
         head_attr = ParamAttr(name="lm_head.w")
     else:
         for _ in range(n_layers):
-            x = layers.transformer_encoder_layer(x, num_heads=num_heads,
-                                                 d_ff=d_ff, causal=True,
-                                                 **kw)
+            x = layers.transformer_encoder_layer(
+                x, num_heads=num_heads, d_ff=d_ff,
+                num_kv_heads=num_kv_heads, causal=True, **kw)
     x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
                           bias_attr=ln_bias, **kw)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
@@ -70,7 +71,7 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
 
 
 def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
-                      n_layers):
+                      n_layers, num_heads=None, num_kv_heads=None):
     """The weights-shared-by-name contract with transformer_lm
     (pipeline_stack=True), in ONE place: rebuild tok_emb/pos_emb/
     final_ln/lm_head/lm_stack.* so a generation-family program rejoins
@@ -78,6 +79,9 @@ def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
     from ..initializer import ConstantInitializer
     from ..layers.attention import make_stack_params
 
+    if num_heads and num_kv_heads and num_heads % num_kv_heads:
+        raise ValueError(f"num_heads {num_heads} not a multiple of "
+                         f"num_kv_heads {num_kv_heads}")
     tok = helper.create_parameter(ParamAttr(name="tok_emb"),
                                   shape=[vocab_size, d_model],
                                   dtype="float32")
@@ -95,12 +99,14 @@ def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
     ins = {"TokEmb": [tok], "PosEmb": [pos], "FinalLnS": [ln_s],
            "FinalLnB": [ln_b], "HeadW": [head_w]}
     ins.update(make_stack_params(helper, "lm_stack", n_layers, d_model,
-                                 d_ff))
+                                 d_ff, num_heads=num_heads,
+                                 num_kv_heads=num_kv_heads))
     return ins
 
 
 def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
-                            num_heads=8, d_ff=None, max_len=2048,
+                            num_heads=8, d_ff=None, num_kv_heads=None,
+                            max_len=2048,
                             max_new_tokens=32, temperature=0.0, top_k=0,
                             main_program=None, startup_program=None):
     """Generation program for a ``transformer_lm(pipeline_stack=True)``
@@ -120,9 +126,11 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
     helper = LayerHelper("transformer_lm_generate", **kw)
     ins = {"Prompt": [prompt]}
     ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
-                                 max_len, n_layers))
+                                 max_len, n_layers, num_heads,
+                                 num_kv_heads))
     o = helper.simple_op("transformer_stack_generate", ins,
                          {"num_heads": num_heads,
+                          "num_kv_heads": num_kv_heads,
                           "max_new_tokens": max_new_tokens,
                           "temperature": float(temperature),
                           "top_k": int(top_k)})
@@ -131,7 +139,8 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
 
 
 def transformer_lm_beam_search(prompt, vocab_size, d_model=256, n_layers=4,
-                               num_heads=8, d_ff=None, max_len=2048,
+                               num_heads=8, d_ff=None, num_kv_heads=None,
+                               max_len=2048,
                                max_new_tokens=32, beam_size=4,
                                length_penalty=0.0, eos_id=None,
                                main_program=None, startup_program=None):
@@ -144,10 +153,12 @@ def transformer_lm_beam_search(prompt, vocab_size, d_model=256, n_layers=4,
     helper = LayerHelper("transformer_lm_beam_search", **kw)
     ins = {"Prompt": [prompt]}
     ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
-                                 max_len, n_layers))
+                                 max_len, n_layers, num_heads,
+                                 num_kv_heads))
     outs, _ = helper.append_op(
         "transformer_stack_beam_search", ins, ["Out", "Scores"],
-        {"num_heads": num_heads, "max_new_tokens": max_new_tokens,
+        {"num_heads": num_heads, "num_kv_heads": num_kv_heads,
+         "max_new_tokens": max_new_tokens,
          "beam_size": beam_size, "length_penalty": float(length_penalty),
          "eos_id": -1 if eos_id is None else int(eos_id)})
     ids = outs["Out"][0]

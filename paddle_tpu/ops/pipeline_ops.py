@@ -29,31 +29,49 @@ def _ln(x, scale, bias):
     return (x - mu) * jax.lax.rsqrt(var + _EPS) * scale + bias
 
 
-def _block(p, x, num_heads, causal):
+def _block(p, x, num_heads, causal, num_kv_heads=None):
     """One pre-LN transformer block; p holds per-layer (no leading dim)
     weights: ln1_s, ln1_b, qkv_w, out_w, ln2_s, ln2_b, ff_w1, ff_b1,
     ff_w2, ff_b2."""
     b, T, d = x.shape
-    q, k, v = _attn_proj(p, x, num_heads)
+    q, k, v = _attn_proj(p, x, num_heads, num_kv_heads)
+    k, v = _expand_kv(k, v, num_heads)
     ctx = flash_attention(q, k, v, causal=causal)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, T, d)
     return _attn_out_ffn(p, x, ctx)
 
 
-def _attn_proj(p, h, num_heads):
-    """LN1 + qkv projection -> per-head q, k, v [b, H, t, dh]."""
+def _attn_proj(p, h, num_heads, num_kv_heads=None):
+    """LN1 + qkv projection -> q [b, H, t, dh], k/v [b, Hkv, t, dh].
+    Hkv < H is grouped-query attention: the stacked qkv weight is
+    [L, d, d + 2*Hkv*dh] and the KV planes (and decode caches) shrink by
+    H/Hkv."""
+    num_kv_heads = num_kv_heads or num_heads
     b, t, d = h.shape
     head_d = d // num_heads
+    d_kv = head_d * num_kv_heads
     hn = _ln(h, p["ln1_s"], p["ln1_b"])
     hn_c, qkv_c = amp_cast(hn, p["qkv_w"])
     qkv = jnp.einsum("btd,de->bte", hn_c, qkv_c,
                      precision=mxu_precision()).astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = qkv[..., :d]
+    k = qkv[..., d:d + d_kv]
+    v = qkv[..., d + d_kv:]
 
-    def heads(a):
-        return a.reshape(b, t, num_heads, head_d).transpose(0, 2, 1, 3)
+    def heads(a, n):
+        return a.reshape(b, t, n, head_d).transpose(0, 2, 1, 3)
 
-    return heads(q), heads(k), heads(v)
+    return heads(q, num_heads), heads(k, num_kv_heads), heads(v,
+                                                             num_kv_heads)
+
+
+def _expand_kv(k, v, num_heads):
+    """Broadcast Hkv heads to their H/Hkv query groups."""
+    rep = num_heads // k.shape[1]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
 
 
 def _attn_out_ffn(p, x, ctx):
@@ -94,13 +112,15 @@ def pipelined_transformer_stack(attrs, ins):
     params = {key: single(ins, slot)
               for slot, key in _STACK_SLOTS.items()}
     num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
     causal = attrs.get("causal", True)
 
     remat = attrs.get("remat", False)
 
     def scan_layers(p, h):
         def body(carry, layer_p):
-            return _block(layer_p, carry, num_heads, causal), None
+            return _block(layer_p, carry, num_heads, causal,
+                          num_kv_heads), None
 
         if remat:
             body = jax.checkpoint(body)
@@ -156,29 +176,34 @@ def _logits_fn(ln_s, ln_b, head_w):
     return logits_of
 
 
-def _prefill(params, x, num_heads, b, Tp):
+def _prefill(params, x, num_heads, b, Tp, num_kv_heads=None):
     """Run the stack over the prompt capturing every layer's K/V:
-    returns (hidden [b, Tp, d], ks, vs [L, b, H, Tp, dh])."""
+    returns (hidden [b, Tp, d], ks, vs [L, b, Hkv, Tp, dh]) — the caches
+    hold KV heads only (the GQA memory win)."""
     def prefill_body(h, layer_p):
-        q, k, v = _attn_proj(layer_p, h, num_heads)
-        ctx = flash_attention(q, k, v, causal=True)
+        q, k, v = _attn_proj(layer_p, h, num_heads, num_kv_heads)
+        kx, vx = _expand_kv(k, v, num_heads)
+        ctx = flash_attention(q, kx, vx, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tp, x.shape[-1])
         return _attn_out_ffn(layer_p, h, ctx), (k, v)
 
     return jax.lax.scan(prefill_body, x, params)
 
 
-def _decode_layer_fn(params, num_heads, d):
+def _decode_layer_fn(params, num_heads, d, num_kv_heads=None):
     """One-token decode through all layers against the cache; returns a
     fn(h1, (layer_p, ck_l, cv_l), pos) suitable for lax.scan over layers
-    (pos = the query's position; cache rows < pos+1 are visible)."""
+    (pos = the query's position; cache rows < pos+1 are visible). Caches
+    store Hkv heads; queries expand to their groups at attention time."""
     from ..kernels.flash_attention import reference_attention
 
     def layer(h1, inp, pos):
         layer_p, ck_l, cv_l = inp
-        q, k, v = _attn_proj(layer_p, h1, num_heads)
+        q, k, v = _attn_proj(layer_p, h1, num_heads, num_kv_heads)
         ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos, 2)
         cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos, 2)
+        # reference_attention reads the Hkv cache natively (grouped
+        # einsum) — no [b, H, T, dh] expansion on the decode hot path
         ctx = reference_attention(
             q, ck_l, cv_l, lengths=jnp.full((h1.shape[0],), pos + 1))
         ctx = ctx.transpose(0, 2, 1, 3).reshape(h1.shape[0], 1, d)
@@ -208,6 +233,7 @@ def transformer_stack_generate(attrs, ins, rng):
     (prompt, tok_emb, pos_emb, ln_s, ln_b, head_w,
      params) = _unpack_lm_ins(ins)
     num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
     N = attrs["max_new_tokens"]
     temperature = attrs.get("temperature") or 0.0
     top_k = attrs.get("top_k") or 0
@@ -236,13 +262,14 @@ def transformer_stack_generate(attrs, ins, rng):
                                       logits / temperature, axis=-1)
 
     # ---- prefill: run the stack over the prompt, capturing K/V -------
-    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp)
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
+                           num_kv_heads)
     pad = [(0, 0)] * 5
-    pad[3] = (0, N)  # [L, b, H, Tp, dh] -> [L, b, H, Ttot, dh]
+    pad[3] = (0, N)  # [L, b, Hkv, Tp, dh] -> [L, b, Hkv, Ttot, dh]
     cache_k = jnp.pad(ks, pad)
     cache_v = jnp.pad(vs, pad)
     next_tok = pick(logits_of(h[:, -1]), 0)  # [b]
-    decode_layer = _decode_layer_fn(params, num_heads, d)
+    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads)
 
     # ---- decode: one token at a time against the cache ---------------
     def step(carry, n):
@@ -286,6 +313,7 @@ def transformer_stack_beam_search(attrs, ins):
     (prompt, tok_emb, pos_emb, ln_s, ln_b, head_w,
      params) = _unpack_lm_ins(ins)
     num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
     N = attrs["max_new_tokens"]
     K = attrs.get("beam_size", 4)
     alpha = attrs.get("length_penalty") or 0.0
@@ -308,10 +336,11 @@ def transformer_stack_beam_search(attrs, ins):
     logits_of = _logits_fn(ln_s, ln_b, head_w)
 
     # ---- prefill over the bare batch, then tile to beams --------------
-    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp)
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
+                           num_kv_heads)
     pad = [(0, 0)] * 5
     pad[3] = (0, N)
-    cache_k = jnp.repeat(jnp.pad(ks, pad), K, axis=1)  # [L, b*K, H, T, dh]
+    cache_k = jnp.repeat(jnp.pad(ks, pad), K, axis=1)  # [L, b*K, Hkv, T, dh]
     cache_v = jnp.repeat(jnp.pad(vs, pad), K, axis=1)
 
     # first expansion: top-K tokens of the prompt's next-token distribution
@@ -321,7 +350,7 @@ def transformer_stack_beam_search(attrs, ins):
                       dtype=prompt.dtype)
     tokens = tokens.at[:, :, 0].set(tok0.astype(prompt.dtype))
     alive = (tok0 != eos_id) if eos_id >= 0 else jnp.ones((b, K), bool)
-    decode_layer = _decode_layer_fn(params, num_heads, d)
+    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads)
 
     def step(carry, n):
         tokens, scores, alive, ck, cv = carry

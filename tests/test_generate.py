@@ -289,3 +289,60 @@ class TestBeamSearch:
         for bi in range(2):
             assert len(set(bm[bi, :, -1].tolist())) == K
         assert (np.diff(sc, axis=1) <= 1e-6).all()
+
+
+def test_gqa_stack_decode_matches_reforwarding():
+    """Grouped-query attention (num_kv_heads < num_heads) through the
+    stacked train path AND the KV-cache decode: the cache holds Hkv head
+    planes, and decode must still equal naive re-forwarding."""
+    Tp, N, KV = 8, 4, 1  # multi-query: one shared KV head
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[Tp], dtype="int64")
+        tgt = layers.data("tgt", shape=[Tp], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=VOCAB, d_model=D,
+                                       n_layers=L, num_heads=H,
+                                       num_kv_heads=KV, max_len=MAXLEN,
+                                       pipeline_stack=True)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, VOCAB]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        pt.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(
+            loss, startup_program=startup)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    seq = (rng.randint(0, VOCAB, (32, 1)) + 3 * np.arange(Tp + 1)) % VOCAB
+    feed = {"ids": seq[:, :-1].astype("int64"),
+            "tgt": seq[:, 1:].astype("int64")}
+    for _ in range(30):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+
+    gen_prog, gen_startup = pt.Program(), pt.Program()
+    with pt.program_guard(gen_prog, gen_startup):
+        prompt = layers.data("pg", shape=[Tp], dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            num_kv_heads=KV, max_len=MAXLEN, max_new_tokens=N)
+    p = ((rng.randint(0, VOCAB, (3, 1)) + 3 * np.arange(Tp)) % VOCAB
+         ).astype("int64")
+    got, = exe.run(gen_prog, feed={"pg": p}, fetch_list=[out_ids],
+                   scope=scope)
+    got = np.asarray(got)
+
+    # naive re-forward with the same GQA geometry
+    cur = p
+    for t in range(N):
+        prog_t, s_t = pt.Program(), pt.Program()
+        with pt.program_guard(prog_t, s_t):
+            idf = layers.data("idf", shape=[Tp + t], dtype="int64")
+            lg_t = models.transformer_lm(idf, vocab_size=VOCAB, d_model=D,
+                                         n_layers=L, num_heads=H,
+                                         num_kv_heads=KV, max_len=MAXLEN,
+                                         pipeline_stack=True)
+        lg, = exe.run(prog_t, feed={"idf": cur}, fetch_list=[lg_t],
+                      scope=scope)
+        nxt = np.argmax(np.asarray(lg)[:, -1], axis=-1)[:, None]
+        cur = np.concatenate([cur, nxt.astype("int64")], axis=1)
+    np.testing.assert_array_equal(got, cur)
