@@ -42,6 +42,27 @@ def _pick_block(t, preferred):
     return max(b, 1)
 
 
+def rotary(x, pos0=0, base=10000.0):
+    """Rotary position embedding over [B, H, T, D] heads, positions
+    pos0..pos0+T-1 (RoFormer pairing: (x[2i], x[2i+1]) rotates by
+    pos * base^(-2i/D)). The single source of truth for RoPE math — the
+    per-layer encoder op and the stacked/decode path both call it; the
+    offset form serves incremental decode."""
+    D = x.shape[-1]
+    T = x.shape[2]
+    half = D // 2
+    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = pos0 + jnp.arange(T, dtype=jnp.float32)
+    ang = pos[:, None] * inv[None, :]  # [T, half]
+    cos = jnp.cos(ang)[None, None].astype(x.dtype)
+    sin = jnp.sin(ang)[None, None].astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+
 def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
     """Pure-jnp attention over [B, H, T, D]; the semantic ground truth.
 

@@ -180,8 +180,8 @@ def make_stack_params(helper, base, L, d_model, d_ff, dtype="float32",
 
 
 def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
-                                num_kv_heads=None, causal=True,
-                                n_microbatches=None,
+                                num_kv_heads=None, use_rope=False,
+                                causal=True, n_microbatches=None,
                                 pipe_axis="pp", data_axis="dp", remat=False,
                                 param_attr=None, main_program=None,
                                 startup_program=None):
@@ -225,7 +225,7 @@ def pipelined_transformer_stack(x, n_layers, num_heads, d_ff=None,
     o = helper.simple_op(
         "pipelined_transformer_stack", ins,
         {"num_heads": num_heads, "num_kv_heads": num_kv_heads,
-         "causal": causal,
+         "use_rope": use_rope, "causal": causal,
          "n_microbatches": n_microbatches, "pipe_axis": pipe_axis,
          "data_axis": data_axis, "remat": remat})
     return o

@@ -15,7 +15,8 @@ from ..param_attr import ParamAttr
 
 
 def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
-                   d_ff=None, num_kv_heads=None, max_len=2048,
+                   d_ff=None, num_kv_heads=None, use_rope=False,
+                   max_len=2048,
                    pipeline_stack=False, n_microbatches=None, remat=False,
                    main_program=None, startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
@@ -31,13 +32,18 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
     tok.seq_len = getattr(ids, "seq_len", None)
     T = ids.shape[1]
     helper = LayerHelper("transformer_lm", **kw)
-    pos_table = helper.create_parameter(
-        ParamAttr(name="pos_emb"), shape=[max_len, d_model], dtype="float32")
-    # slice the first T rows; T is static under the whole-block compile
-    pos = helper.simple_op("slice", {"X": [pos_table]},
-                           {"axes": [0], "starts": [0], "ends": [T]})
-    x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
-    x.seq_len = tok.seq_len
+    if use_rope:
+        # positions live in the attention rotation — no learned table
+        x = tok
+    else:
+        pos_table = helper.create_parameter(
+            ParamAttr(name="pos_emb"), shape=[max_len, d_model],
+            dtype="float32")
+        # slice the first T rows; T is static under the whole-block compile
+        pos = helper.simple_op("slice", {"X": [pos_table]},
+                               {"axes": [0], "starts": [0], "ends": [T]})
+        x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
+        x.seq_len = tok.seq_len
     ln_attr = ln_bias = head_attr = None
     if pipeline_stack:
         # stable parameter names so a generation program (which rebuilds
@@ -52,7 +58,7 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                 "program would silently share weights")
         x = layers.pipelined_transformer_stack(
             x, n_layers=n_layers, num_heads=num_heads, d_ff=d_ff,
-            num_kv_heads=num_kv_heads, causal=True,
+            num_kv_heads=num_kv_heads, use_rope=use_rope, causal=True,
             n_microbatches=n_microbatches, remat=remat,
             param_attr=ParamAttr(name="lm_stack"), **kw)
         ln_attr = ParamAttr(name="final_ln.scale")
@@ -62,7 +68,8 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
         for _ in range(n_layers):
             x = layers.transformer_encoder_layer(
                 x, num_heads=num_heads, d_ff=d_ff,
-                num_kv_heads=num_kv_heads, causal=True, **kw)
+                num_kv_heads=num_kv_heads, use_rope=use_rope, causal=True,
+                **kw)
     x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
                           bias_attr=ln_bias, **kw)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
@@ -71,7 +78,8 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
 
 
 def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
-                      n_layers, num_heads=None, num_kv_heads=None):
+                      n_layers, num_heads=None, num_kv_heads=None,
+                      use_rope=False):
     """The weights-shared-by-name contract with transformer_lm
     (pipeline_stack=True), in ONE place: rebuild tok_emb/pos_emb/
     final_ln/lm_head/lm_stack.* so a generation-family program rejoins
@@ -85,8 +93,9 @@ def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
     tok = helper.create_parameter(ParamAttr(name="tok_emb"),
                                   shape=[vocab_size, d_model],
                                   dtype="float32")
-    pos = helper.create_parameter(ParamAttr(name="pos_emb"),
-                                  shape=[max_len, d_model], dtype="float32")
+    pos = None if use_rope else helper.create_parameter(
+        ParamAttr(name="pos_emb"), shape=[max_len, d_model],
+        dtype="float32")
     ln_s = helper.create_parameter(
         ParamAttr(name="final_ln.scale"), shape=[d_model], dtype="float32",
         default_initializer=ConstantInitializer(1.0))
@@ -96,8 +105,10 @@ def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
     head_w = helper.create_parameter(ParamAttr(name="lm_head.w"),
                                      shape=[d_model, vocab_size],
                                      dtype="float32")
-    ins = {"TokEmb": [tok], "PosEmb": [pos], "FinalLnS": [ln_s],
+    ins = {"TokEmb": [tok], "FinalLnS": [ln_s],
            "FinalLnB": [ln_b], "HeadW": [head_w]}
+    if pos is not None:
+        ins["PosEmb"] = [pos]
     ins.update(make_stack_params(helper, "lm_stack", n_layers, d_model,
                                  d_ff, num_heads=num_heads,
                                  num_kv_heads=num_kv_heads))
@@ -106,7 +117,7 @@ def _shared_lm_params(helper, vocab_size, d_model, d_ff, max_len,
 
 def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
                             num_heads=8, d_ff=None, num_kv_heads=None,
-                            max_len=2048,
+                            use_rope=False, max_len=2048,
                             max_new_tokens=32, temperature=0.0, top_k=0,
                             main_program=None, startup_program=None):
     """Generation program for a ``transformer_lm(pipeline_stack=True)``
@@ -127,10 +138,11 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
     ins = {"Prompt": [prompt]}
     ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
                                  max_len, n_layers, num_heads,
-                                 num_kv_heads))
+                                 num_kv_heads, use_rope))
     o = helper.simple_op("transformer_stack_generate", ins,
                          {"num_heads": num_heads,
                           "num_kv_heads": num_kv_heads,
+                          "use_rope": use_rope,
                           "max_new_tokens": max_new_tokens,
                           "temperature": float(temperature),
                           "top_k": int(top_k)})
@@ -140,7 +152,7 @@ def transformer_lm_generate(prompt, vocab_size, d_model=256, n_layers=4,
 
 def transformer_lm_beam_search(prompt, vocab_size, d_model=256, n_layers=4,
                                num_heads=8, d_ff=None, num_kv_heads=None,
-                               max_len=2048,
+                               use_rope=False, max_len=2048,
                                max_new_tokens=32, beam_size=4,
                                length_penalty=0.0, eos_id=None,
                                main_program=None, startup_program=None):
@@ -154,10 +166,11 @@ def transformer_lm_beam_search(prompt, vocab_size, d_model=256, n_layers=4,
     ins = {"Prompt": [prompt]}
     ins.update(_shared_lm_params(helper, vocab_size, d_model, d_ff,
                                  max_len, n_layers, num_heads,
-                                 num_kv_heads))
+                                 num_kv_heads, use_rope))
     outs, _ = helper.append_op(
         "transformer_stack_beam_search", ins, ["Out", "Scores"],
         {"num_heads": num_heads, "num_kv_heads": num_kv_heads,
+         "use_rope": use_rope,
          "max_new_tokens": max_new_tokens,
          "beam_size": beam_size, "length_penalty": float(length_penalty),
          "eos_id": -1 if eos_id is None else int(eos_id)})

@@ -18,24 +18,14 @@ from .common import maybe, out, single
 @register_op("rotary_embed")
 def rotary_embed(attrs, ins):
     """Rotary position embedding over [B, H, T, D] heads (RoFormer; the
-    modern relative-position scheme for long-context LMs). Pairs
-    (x[2i], x[2i+1]) rotate by theta = pos * base^(-2i/D); purely a
-    function of position, so it lives in-graph with no table parameter."""
+    modern relative-position scheme for long-context LMs). Purely a
+    function of position, so it lives in-graph with no table parameter;
+    the math is kernels.flash_attention.rotary (shared with the stacked
+    stack and incremental decode)."""
+    from ..kernels.flash_attention import rotary
+
     x = single(ins, "X")
-    base = attrs.get("base", 10000.0)
-    D = x.shape[-1]
-    T = x.shape[2]
-    half = D // 2
-    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * inv[None, :]  # [T,h]
-    cos = jnp.cos(ang)[None, None, :, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, None, :, :].astype(x.dtype)
-    x1 = x[..., 0::2]
-    x2 = x[..., 1::2]
-    r1 = x1 * cos - x2 * sin
-    r2 = x1 * sin + x2 * cos
-    y = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
-    return out(Out=y)
+    return out(Out=rotary(x, base=attrs.get("base", 10000.0)))
 
 
 @register_op("scaled_dot_product_attention", optional_inputs=("Length",))

@@ -17,8 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
-from ..kernels.flash_attention import flash_attention
-from .common import amp_cast, mxu_precision, out, single
+from ..kernels.flash_attention import flash_attention, rotary
+from .common import amp_cast, maybe, mxu_precision, out, single
 
 _EPS = 1e-5
 
@@ -29,23 +29,25 @@ def _ln(x, scale, bias):
     return (x - mu) * jax.lax.rsqrt(var + _EPS) * scale + bias
 
 
-def _block(p, x, num_heads, causal, num_kv_heads=None):
+def _block(p, x, num_heads, causal, num_kv_heads=None, use_rope=False):
     """One pre-LN transformer block; p holds per-layer (no leading dim)
     weights: ln1_s, ln1_b, qkv_w, out_w, ln2_s, ln2_b, ff_w1, ff_b1,
     ff_w2, ff_b2."""
     b, T, d = x.shape
-    q, k, v = _attn_proj(p, x, num_heads, num_kv_heads)
+    q, k, v = _attn_proj(p, x, num_heads, num_kv_heads, use_rope)
     k, v = _expand_kv(k, v, num_heads)
     ctx = flash_attention(q, k, v, causal=causal)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, T, d)
     return _attn_out_ffn(p, x, ctx)
 
 
-def _attn_proj(p, h, num_heads, num_kv_heads=None):
+def _attn_proj(p, h, num_heads, num_kv_heads=None, use_rope=False,
+               pos0=0):
     """LN1 + qkv projection -> q [b, H, t, dh], k/v [b, Hkv, t, dh].
     Hkv < H is grouped-query attention: the stacked qkv weight is
     [L, d, d + 2*Hkv*dh] and the KV planes (and decode caches) shrink by
-    H/Hkv."""
+    H/Hkv. ``use_rope`` rotates q/k at absolute positions pos0..pos0+t-1
+    (rotated keys enter the decode cache, so cached rows never re-rotate)."""
     num_kv_heads = num_kv_heads or num_heads
     b, t, d = h.shape
     head_d = d // num_heads
@@ -61,8 +63,12 @@ def _attn_proj(p, h, num_heads, num_kv_heads=None):
     def heads(a, n):
         return a.reshape(b, t, n, head_d).transpose(0, 2, 1, 3)
 
-    return heads(q, num_heads), heads(k, num_kv_heads), heads(v,
-                                                             num_kv_heads)
+    q, k, v = (heads(q, num_heads), heads(k, num_kv_heads),
+               heads(v, num_kv_heads))
+    if use_rope:
+        q = rotary(q, pos0)
+        k = rotary(k, pos0)
+    return q, k, v
 
 
 def _expand_kv(k, v, num_heads):
@@ -113,6 +119,7 @@ def pipelined_transformer_stack(attrs, ins):
               for slot, key in _STACK_SLOTS.items()}
     num_heads = attrs["num_heads"]
     num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
     causal = attrs.get("causal", True)
 
     remat = attrs.get("remat", False)
@@ -120,7 +127,7 @@ def pipelined_transformer_stack(attrs, ins):
     def scan_layers(p, h):
         def body(carry, layer_p):
             return _block(layer_p, carry, num_heads, causal,
-                          num_kv_heads), None
+                          num_kv_heads, use_rope), None
 
         if remat:
             body = jax.checkpoint(body)
@@ -150,15 +157,18 @@ def pipelined_transformer_stack(attrs, ins):
 
 def _unpack_lm_ins(ins):
     """Shared input unpacking for the decode ops: (prompt, embeddings,
-    final-LN, head, stacked block params)."""
+    final-LN, head, stacked block params). PosEmb is absent under RoPE
+    (rotation replaces the learned table)."""
     return (single(ins, "Prompt"), single(ins, "TokEmb"),
-            single(ins, "PosEmb"), single(ins, "FinalLnS"),
+            maybe(ins, "PosEmb"), single(ins, "FinalLnS"),
             single(ins, "FinalLnB"), single(ins, "HeadW"),
             {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()})
 
 
 def _embed_fn(tok_emb, pos_emb):
     def embed(ids, pos0):
+        if pos_emb is None:  # RoPE: positions live in the attention rotation
+            return tok_emb[ids]
         t = ids.shape[1]
         return (tok_emb[ids]
                 + jax.lax.dynamic_slice_in_dim(pos_emb, pos0, t, 0)[None])
@@ -176,12 +186,15 @@ def _logits_fn(ln_s, ln_b, head_w):
     return logits_of
 
 
-def _prefill(params, x, num_heads, b, Tp, num_kv_heads=None):
+def _prefill(params, x, num_heads, b, Tp, num_kv_heads=None,
+             use_rope=False):
     """Run the stack over the prompt capturing every layer's K/V:
     returns (hidden [b, Tp, d], ks, vs [L, b, Hkv, Tp, dh]) — the caches
-    hold KV heads only (the GQA memory win)."""
+    hold KV heads only (the GQA memory win). Under RoPE the cached keys
+    are already rotated at their absolute positions."""
     def prefill_body(h, layer_p):
-        q, k, v = _attn_proj(layer_p, h, num_heads, num_kv_heads)
+        q, k, v = _attn_proj(layer_p, h, num_heads, num_kv_heads,
+                             use_rope)
         kx, vx = _expand_kv(k, v, num_heads)
         ctx = flash_attention(q, kx, vx, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tp, x.shape[-1])
@@ -190,7 +203,8 @@ def _prefill(params, x, num_heads, b, Tp, num_kv_heads=None):
     return jax.lax.scan(prefill_body, x, params)
 
 
-def _decode_layer_fn(params, num_heads, d, num_kv_heads=None):
+def _decode_layer_fn(params, num_heads, d, num_kv_heads=None,
+                     use_rope=False):
     """One-token decode through all layers against the cache; returns a
     fn(h1, (layer_p, ck_l, cv_l), pos) suitable for lax.scan over layers
     (pos = the query's position; cache rows < pos+1 are visible). Caches
@@ -199,7 +213,8 @@ def _decode_layer_fn(params, num_heads, d, num_kv_heads=None):
 
     def layer(h1, inp, pos):
         layer_p, ck_l, cv_l = inp
-        q, k, v = _attn_proj(layer_p, h1, num_heads, num_kv_heads)
+        q, k, v = _attn_proj(layer_p, h1, num_heads, num_kv_heads,
+                             use_rope, pos0=pos)
         ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos, 2)
         cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos, 2)
         # reference_attention reads the Hkv cache natively (grouped
@@ -212,7 +227,7 @@ def _decode_layer_fn(params, num_heads, d, num_kv_heads=None):
     return layer
 
 
-@register_op("transformer_stack_generate",
+@register_op("transformer_stack_generate", optional_inputs=("PosEmb",),
              needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
 def transformer_stack_generate(attrs, ins, rng):
     """Incremental decoding with a per-layer KV cache.
@@ -234,13 +249,14 @@ def transformer_stack_generate(attrs, ins, rng):
      params) = _unpack_lm_ins(ins)
     num_heads = attrs["num_heads"]
     num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
     N = attrs["max_new_tokens"]
     temperature = attrs.get("temperature") or 0.0
     top_k = attrs.get("top_k") or 0
     b, Tp = prompt.shape
     L, d = params["ln1_s"].shape
     Ttot = Tp + N
-    if Ttot > pos_emb.shape[0]:
+    if pos_emb is not None and Ttot > pos_emb.shape[0]:
         raise ValueError(
             f"prompt {Tp} + {N} new tokens exceeds max_len "
             f"{pos_emb.shape[0]}")
@@ -263,13 +279,14 @@ def transformer_stack_generate(attrs, ins, rng):
 
     # ---- prefill: run the stack over the prompt, capturing K/V -------
     h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
-                           num_kv_heads)
+                           num_kv_heads, use_rope)
     pad = [(0, 0)] * 5
     pad[3] = (0, N)  # [L, b, Hkv, Tp, dh] -> [L, b, Hkv, Ttot, dh]
     cache_k = jnp.pad(ks, pad)
     cache_v = jnp.pad(vs, pad)
     next_tok = pick(logits_of(h[:, -1]), 0)  # [b]
-    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads)
+    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads,
+                                    use_rope)
 
     # ---- decode: one token at a time against the cache ---------------
     def step(carry, n):
@@ -294,7 +311,7 @@ def transformer_stack_generate(attrs, ins, rng):
         [prompt, generated.astype(prompt.dtype)], axis=1))
 
 
-@register_op("transformer_stack_beam_search")
+@register_op("transformer_stack_beam_search", optional_inputs=("PosEmb",))
 def transformer_stack_beam_search(attrs, ins):
     """Beam search over the KV-cache decode path.
 
@@ -314,6 +331,7 @@ def transformer_stack_beam_search(attrs, ins):
      params) = _unpack_lm_ins(ins)
     num_heads = attrs["num_heads"]
     num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
     N = attrs["max_new_tokens"]
     K = attrs.get("beam_size", 4)
     alpha = attrs.get("length_penalty") or 0.0
@@ -324,7 +342,7 @@ def transformer_stack_beam_search(attrs, ins):
     L, d = params["ln1_s"].shape
     V = head_w.shape[1]
     Ttot = Tp + N
-    if Ttot > pos_emb.shape[0]:
+    if pos_emb is not None and Ttot > pos_emb.shape[0]:
         raise ValueError(
             f"prompt {Tp} + {N} new tokens exceeds max_len "
             f"{pos_emb.shape[0]}")
@@ -337,7 +355,7 @@ def transformer_stack_beam_search(attrs, ins):
 
     # ---- prefill over the bare batch, then tile to beams --------------
     h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
-                           num_kv_heads)
+                           num_kv_heads, use_rope)
     pad = [(0, 0)] * 5
     pad[3] = (0, N)
     cache_k = jnp.repeat(jnp.pad(ks, pad), K, axis=1)  # [L, b*K, Hkv, T, dh]
@@ -350,7 +368,8 @@ def transformer_stack_beam_search(attrs, ins):
                       dtype=prompt.dtype)
     tokens = tokens.at[:, :, 0].set(tok0.astype(prompt.dtype))
     alive = (tok0 != eos_id) if eos_id >= 0 else jnp.ones((b, K), bool)
-    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads)
+    decode_layer = _decode_layer_fn(params, num_heads, d, num_kv_heads,
+                                    use_rope)
 
     def step(carry, n):
         tokens, scores, alive, ck, cv = carry

@@ -291,21 +291,24 @@ class TestBeamSearch:
         assert (np.diff(sc, axis=1) <= 1e-6).all()
 
 
-def test_gqa_stack_decode_matches_reforwarding():
-    """Grouped-query attention (num_kv_heads < num_heads) through the
-    stacked train path AND the KV-cache decode: the cache holds Hkv head
-    planes, and decode must still equal naive re-forwarding."""
-    Tp, N, KV = 8, 4, 1  # multi-query: one shared KV head
+def _decode_vs_reforward(lm_kwargs):
+    """Shared harness: train a tiny stacked LM variant, decode N tokens
+    through the KV cache, and pin the result token-for-token against
+    iterative full re-forwarding with the same geometry."""
+    Tp, N = 8, 4
     scope = pt.Scope()
     exe = pt.Executor(pt.TPUPlace())
+
+    def build_lm(T, name):
+        ids = layers.data(name, shape=[T], dtype="int64")
+        return ids, models.transformer_lm(
+            ids, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, pipeline_stack=True, **lm_kwargs)
+
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
-        ids = layers.data("ids", shape=[Tp], dtype="int64")
+        _, logits = build_lm(Tp, "ids")
         tgt = layers.data("tgt", shape=[Tp], dtype="int64")
-        logits = models.transformer_lm(ids, vocab_size=VOCAB, d_model=D,
-                                       n_layers=L, num_heads=H,
-                                       num_kv_heads=KV, max_len=MAXLEN,
-                                       pipeline_stack=True)
         loss = layers.mean(layers.softmax_with_cross_entropy(
             layers.reshape(logits, shape=[-1, VOCAB]),
             layers.reshape(tgt, shape=[-1, 1])))
@@ -321,28 +324,40 @@ def test_gqa_stack_decode_matches_reforwarding():
 
     gen_prog, gen_startup = pt.Program(), pt.Program()
     with pt.program_guard(gen_prog, gen_startup):
-        prompt = layers.data("pg", shape=[Tp], dtype="int64")
+        prompt = layers.data("prompt_h", shape=[Tp], dtype="int64")
         out_ids = models.transformer_lm_generate(
             prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
-            num_kv_heads=KV, max_len=MAXLEN, max_new_tokens=N)
+            max_len=MAXLEN, max_new_tokens=N, **lm_kwargs)
     p = ((rng.randint(0, VOCAB, (3, 1)) + 3 * np.arange(Tp)) % VOCAB
          ).astype("int64")
-    got, = exe.run(gen_prog, feed={"pg": p}, fetch_list=[out_ids],
+    got, = exe.run(gen_prog, feed={"prompt_h": p}, fetch_list=[out_ids],
                    scope=scope)
     got = np.asarray(got)
 
-    # naive re-forward with the same GQA geometry
     cur = p
     for t in range(N):
         prog_t, s_t = pt.Program(), pt.Program()
         with pt.program_guard(prog_t, s_t):
-            idf = layers.data("idf", shape=[Tp + t], dtype="int64")
-            lg_t = models.transformer_lm(idf, vocab_size=VOCAB, d_model=D,
-                                         n_layers=L, num_heads=H,
-                                         num_kv_heads=KV, max_len=MAXLEN,
-                                         pipeline_stack=True)
+            _, lg_t = build_lm(Tp + t, "idf")
         lg, = exe.run(prog_t, feed={"idf": cur}, fetch_list=[lg_t],
                       scope=scope)
         nxt = np.argmax(np.asarray(lg)[:, -1], axis=-1)[:, None]
         cur = np.concatenate([cur, nxt.astype("int64")], axis=1)
     np.testing.assert_array_equal(got, cur)
+
+
+def test_gqa_stack_decode_matches_reforwarding():
+    """Grouped-query attention (multi-query extreme, Hkv=1): the cache
+    holds one KV head plane and decode must equal re-forwarding."""
+    _decode_vs_reforward({"num_kv_heads": 1})
+
+
+def test_rope_stack_decode_matches_reforwarding():
+    """RoPE: rotated keys enter the cache at their absolute positions,
+    so incremental decode must equal re-forwarding (which re-rotates
+    from scratch each step)."""
+    _decode_vs_reforward({"use_rope": True})
+
+
+def test_rope_gqa_combined_decode_matches_reforwarding():
+    _decode_vs_reforward({"use_rope": True, "num_kv_heads": 2})
