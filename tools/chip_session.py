@@ -198,8 +198,9 @@ def main():
 
     experiment("lm_stacked_scan", lm_stacked)
 
-    # 3c. Serving: KV-cache decode throughput (tokens/sec generated).
-    def lm_decode():
+    # 3c. Serving: KV-cache decode throughput (tokens/sec generated);
+    #     kv_heads < heads A/Bs the GQA cache-bandwidth win.
+    def lm_decode(kv_heads=None):
         import numpy as np
         bs, Tp, N, vocab, d, Lh = 8, 1024, 128, 16384, 1024, 8
         prog, startup = pt.Program(), pt.Program()
@@ -207,7 +208,8 @@ def main():
             prompt = layers.data("prompt", shape=[Tp], dtype="int64")
             out_ids = models.transformer_lm_generate(
                 prompt, vocab_size=vocab, d_model=d, n_layers=Lh,
-                num_heads=8, max_len=Tp + N, max_new_tokens=N)
+                num_heads=8, num_kv_heads=kv_heads, max_len=Tp + N,
+                max_new_tokens=N)
         scope = pt.Scope()
         exe = pt.Executor(pt.TPUPlace())
         exe.run(startup, scope=scope)
@@ -224,9 +226,11 @@ def main():
         sec = (time.perf_counter() - t0) / steps
         return {"decode_tokens_per_sec": round(bs * N / sec),
                 "ms_per_token_batch": round(sec / N * 1e3, 3),
-                "config": f"bs{bs} prefill{Tp} decode{N}"}
+                "config": f"bs{bs} prefill{Tp} decode{N} "
+                          f"kv{kv_heads or 8}"}
 
     experiment("lm_decode_throughput", lm_decode)
+    experiment("lm_decode_throughput_gqa2", lambda: lm_decode(2))
 
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
     pt.flags.FLAGS.fused_linear_grad = True
