@@ -1,0 +1,85 @@
+"""Summarise CHIP_SESSION_r3.jsonl into a PERF.md-ready markdown table.
+
+Usage:  python tools/analyze_chip_session.py [path]
+Reads the incremental chip-session journal (tools/chip_session.py) and
+prints per-experiment results plus the headline A/B deltas (fused linear
+backward on/off, d_head 64 vs 128, GQA decode), so a returning tunnel
+session turns into PERF.md prose in one read.
+"""
+import json
+import os
+import sys
+
+
+def load(path):
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def fmt(result):
+    if not isinstance(result, dict):
+        return str(result)
+    return ", ".join(f"{k}={v}" for k, v in result.items()
+                     if k != "config")
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "CHIP_SESSION_r3.jsonl")
+    recs = load(path)
+    by = {}
+    print("| experiment | ok | s | result |")
+    print("|---|---|---|---|")
+    for r in recs:
+        name = r.get("experiment", "?")
+        by[name] = r
+        if name == "tpu_tier" and r.get("ok") and isinstance(
+                r.get("result"), dict):
+            n_ok = sum(1 for v in r["result"].values() if v.get("ok"))
+            cell = f"{n_ok}/{len(r['result'])} checks pass"
+            bad = [k for k, v in r["result"].items() if not v.get("ok")]
+            if bad:
+                cell += " (FAIL: " + ", ".join(bad) + ")"
+        else:
+            cell = fmt(r.get("result")) if r.get("ok") \
+                else (r.get("error") or "")[:80]
+        print(f"| {name} | {'y' if r.get('ok') else 'N'} | "
+              f"{r.get('seconds', '')} | {cell} |")
+
+    def mfu(name):
+        r = by.get(name, {})
+        return (r.get("result") or {}).get("mfu") if r.get("ok") else None
+
+    def toks(name):
+        r = by.get(name, {})
+        return (r.get("result") or {}).get("decode_tokens_per_sec") \
+            if r.get("ok") else None
+
+    print()
+    pairs = [
+        ("ResNet-50 fused linear bwd", "resnet50_bs256_fused_off",
+         "resnet50_bs256_fused_on", mfu),
+        ("LM fused linear bwd (d128)", "lm_h8_fused_off",
+         "lm_h8_fused_on", mfu),
+        ("LM d_head 64 -> 128 (fused)", "lm_h16_fused_on",
+         "lm_h8_fused_on", mfu),
+        ("decode GQA kv8 -> kv2", "lm_decode_throughput",
+         "lm_decode_throughput_gqa2", toks),
+    ]
+    for label, a, b, metric in pairs:
+        va, vb = metric(a), metric(b)
+        if va is not None and vb is not None and va:
+            print(f"- {label}: {va} -> {vb} "
+                  f"({(vb - va) / va * 100:+.1f}%)")
+        else:
+            print(f"- {label}: incomplete ({a}={va}, {b}={vb})")
+
+
+if __name__ == "__main__":
+    main()
