@@ -11,10 +11,17 @@ A checkpoint captures EVERYTHING persistable in the scope — parameters,
 optimizer slots (momentum/adam moments live in the scope like any state),
 batch-norm running stats, evaluator accumulators, the RNG key — so resume
 is bit-exact. Written as one .npz + a JSON meta with md5, then atomically
-renamed; ``max_keep`` old checkpoints are pruned. In multi-trainer runs
-only one process should save (the reference elects via master
-RequestSaveModel, go/master/service.go:474-481 — here: save when
-``trainer_id == 0``).
+renamed; ``max_keep`` old checkpoints are pruned.
+
+Multi-process (DCN) runs are first-class: values whose shards this process
+can fully cover (replicated, or sharded only on intra-process axes) go in
+the main payload, written by process 0 alone; values sharded ACROSS
+processes (e.g. ZeRO accumulators on a cross-slice dp axis) are saved by
+EVERY process as its local shards + index metadata in a per-process
+``.shard{i}.npz`` sidecar, and load stitches them back on a shared
+filesystem — the analogue of the pserver fleet checkpointing its parameter
+blocks in parallel (/root/reference/go/pserver/service.go:346-420; each
+pserver saved ITS slice, exactly like a shard sidecar here).
 """
 from __future__ import annotations
 
@@ -32,6 +39,83 @@ from .core.scope import global_scope
 META_NAME = "checkpoint.meta"
 
 
+def _process_info():
+    """(process_index, process_count) without forcing a backend when jax
+    was never imported (plain single-process users)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return 0, 1
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:  # backend not initialized
+        return 0, 1
+
+
+def _sync_processes(nproc, tag):
+    """Barrier across the jax.distributed fleet: every process's files are
+    durably renamed before anyone proceeds past a save."""
+    if nproc <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def _covers_locally(v):
+    """Metadata-only: can this process's shards reconstruct the full
+    value? (No device->host transfer — shard indices suffice.)"""
+    import sys
+
+    if "jax" not in sys.modules:
+        return True
+    import jax
+
+    if not isinstance(v, jax.Array) or v.is_fully_addressable:
+        return True
+    seen = np.zeros(v.shape, bool)
+    for sh in v.addressable_shards:
+        seen[sh.index] = True
+    return bool(seen.all())
+
+
+def _local_cover(v):
+    """Full numpy value from this process's shards (caller must have
+    checked _covers_locally)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return np.asarray(v)
+    import jax
+
+    if not isinstance(v, jax.Array) or v.is_fully_addressable:
+        return np.asarray(v)
+    out = np.zeros(v.shape, v.dtype)
+    for sh in v.addressable_shards:
+        out[sh.index] = np.asarray(sh.data)
+    return out
+
+
+def _index_to_json(index, shape):
+    """A shard's tuple-of-slices index as [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _store(arrays, dtypes, name, arr):
+    """Record ``arr`` under ``name`` with the bf16/fp8 raw-bits trick."""
+    dtypes[name] = str(arr.dtype)
+    if arr.dtype.kind == "V":
+        arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+    arrays[name] = arr
+
+
 def _md5(path: str) -> str:
     h = hashlib.md5()
     with open(path, "rb") as f:
@@ -45,28 +129,69 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
     """Snapshot the whole scope into ``dirname``; returns the payload path."""
     scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)
+    pid, nproc = _process_info()
     arrays, dtypes = {}, {}
+    shard_arrays, shard_dtypes, shard_meta = {}, {}, {}
     for name in scope.keys():
-        arr = np.asarray(scope.get(name))
-        dtypes[name] = str(arr.dtype)
-        if arr.dtype.kind == "V":
-            # extension dtypes (bfloat16, fp8): store raw bits; the dtype
-            # map restores the view on load
-            arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
-        arrays[name] = arr
+        value = scope.get(name)
+        if _covers_locally(value):
+            # payload values are process 0's job; other processes never
+            # materialize them (a metadata check, not a device fetch)
+            if pid == 0:
+                _store(arrays, dtypes, name, _local_cover(value))
+            continue
+        # sharded ACROSS processes: save this process's shards + indices
+        pieces = []
+        for i, sh in enumerate(value.addressable_shards):
+            key = f"{name}@shard{i}"
+            _store(shard_arrays, shard_dtypes, key,
+                   np.asarray(sh.data))
+            pieces.append(_index_to_json(sh.index, value.shape))
+        shard_meta[name] = {"shape": list(value.shape),
+                            "indices": pieces}
+
+    payload = os.path.join(dirname, f"ckpt-{step}.npz")
+    written = payload
+    if shard_arrays:
+        shard_arrays["__shards__"] = np.frombuffer(json.dumps(
+            {"meta": shard_meta, "dtypes": shard_dtypes}).encode(),
+            dtype=np.uint8)
+        spath = os.path.join(dirname, f"ckpt-{step}.shard{pid}.npz")
+        stmp = spath + f".tmp{os.getpid()}"
+        with open(stmp, "wb") as f:
+            np.savez(f, **shard_arrays)
+        os.replace(stmp, spath)
+        if pid != 0:
+            written = spath
+    if pid != 0:
+        # only process 0 writes the payload + meta; everyone synchronizes
+        # below so no process can read a half-written checkpoint
+        _sync_processes(nproc, f"ckpt-{step}")
+        return written
     arrays["__dtypes__"] = np.frombuffer(
         json.dumps(dtypes).encode(), dtype=np.uint8)
-    payload = os.path.join(dirname, f"ckpt-{step}.npz")
     tmp = payload + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, payload)  # atomic
 
+    # stale sidecars from a previous, larger fleet at this step would
+    # otherwise be globbed and stitched OVER fresh data on load
+    for f in os.listdir(dirname):
+        if f.startswith(f"ckpt-{step}.shard") and f.endswith(".npz"):
+            try:
+                idx = int(f.split(".shard")[1][:-4])
+            except ValueError:
+                continue
+            if idx >= nproc:
+                os.remove(os.path.join(dirname, f))
     meta = {
         "latest": os.path.basename(payload),
         "step": step,
         "md5": _md5(payload),
         "timestamp": time.time(),
+        "shard_files": nproc if shard_arrays else 0,
+        "shard_values": sorted(shard_meta),
         "extra": extra or {},
     }
     meta_tmp = os.path.join(dirname, META_NAME + f".tmp{os.getpid()}")
@@ -79,13 +204,19 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
     # its step is lower than leftovers from an abandoned longer run
     cks = sorted(
         (p for p in os.listdir(dirname)
-         if p.startswith("ckpt-") and p.endswith(".npz")),
+         if p.startswith("ckpt-") and p.endswith(".npz")
+         and ".shard" not in p),
         key=lambda p: int(p[5:-4]))
     keep = max(int(max_keep), 1)
     keep_set = set(cks[max(len(cks) - keep, 0):]) | {os.path.basename(payload)}
     for old in cks:
         if old not in keep_set:
             os.remove(os.path.join(dirname, old))
+            base = old[:-4]
+            for sf in os.listdir(dirname):
+                if sf.startswith(base + ".shard"):
+                    os.remove(os.path.join(dirname, sf))
+    _sync_processes(nproc, f"ckpt-{step}")
     return payload
 
 
@@ -102,6 +233,9 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True) -> dict:
     payload = os.path.join(dirname, meta["latest"])
     if verify and _md5(payload) != meta["md5"]:
         raise ValueError(f"checkpoint {payload} md5 mismatch (corrupt)")
+    _load_shard_sidecars(dirname, meta["latest"][:-4], scope,
+                         expect_files=meta.get("shard_files"),
+                         expect_values=meta.get("shard_values"))
     with np.load(payload) as data:
         dtypes = {}
         if "__dtypes__" in data.files:
@@ -122,6 +256,54 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True) -> dict:
             else:
                 scope.set(key, arr)
     return meta
+
+
+def _load_shard_sidecars(dirname: str, base: str, scope,
+                         expect_files=None, expect_values=None) -> None:
+    """Stitch cross-process shard sidecars (``{base}.shard*.npz``) back
+    into full values; requires shared storage holding every process's
+    file. Raises if sidecars are missing/extra vs the meta manifest or if
+    the union of shards leaves holes."""
+    import glob
+
+    files = sorted(glob.glob(os.path.join(dirname, base + ".shard*.npz")))
+    if expect_files is not None and len(files) != expect_files:
+        raise ValueError(
+            f"checkpoint expects {expect_files} shard sidecar files for "
+            f"{base!r} but found {len(files)} — values "
+            f"{expect_values or []} were saved as per-process shards and "
+            "cannot be restored without every process's file")
+    if not files:
+        return
+    full, seen, dtypes = {}, {}, {}
+    for path in files:
+        with np.load(path) as data:
+            info = json.loads(bytes(data["__shards__"]).decode())
+            dtypes.update(info["dtypes"])
+            for name, m in info["meta"].items():
+                if name not in full:
+                    first = data[f"{name}@shard0"]                         if f"{name}@shard0" in data.files else None
+                    dt = first.dtype if first is not None else np.float32
+                    full[name] = np.zeros(m["shape"], dt)
+                    seen[name] = np.zeros(m["shape"], bool)
+                for i, idx in enumerate(m["indices"]):
+                    key = f"{name}@shard{i}"
+                    if key not in data.files:
+                        continue
+                    sl = tuple(slice(a, b) for a, b in idx)
+                    full[name][sl] = data[key]
+                    seen[name][sl] = True
+    for name, arr in full.items():
+        if not seen[name].all():
+            raise ValueError(
+                f"checkpoint value {name!r} has uncovered shards — are "
+                "all processes' .shard files on this filesystem?")
+        want = dtypes.get(f"{name}@shard0")
+        if want and str(arr.dtype) != want:
+            import ml_dtypes  # noqa: F401
+
+            arr = arr.view(np.dtype(want))
+        scope.set(name, arr)
 
 
 def latest_step(dirname: str) -> Optional[int]:

@@ -431,10 +431,20 @@ class Executor:
             rw_sh = [self.plan.state_sharding(n, _nd(n)) for n in rw_state]
             replicated = NamedSharding(self.mesh, PartitionSpec())
             in_shardings = (feed_sh, ro_sh, rw_sh)
+            # written-back state must LAND with the plan's shardings (not
+            # whatever GSPMD propagates — e.g. a ZeRO-sharded accumulator
+            # feeding a momentum update would otherwise leak its dp
+            # sharding into the updated parameter); fetches stay
+            # unconstrained (None = compiler's choice)
+            ws_sh = [self.plan.state_sharding(n, _nd(n))
+                     for n in written_persist]
+            out_shardings = ([None] * len(fetch_names), ws_sh)
             if uses_rng:
                 in_shardings = in_shardings + (replicated,)
+                out_shardings = out_shardings + (replicated,)
             jitted = jax.jit(run_traced, donate_argnums=(2,),
-                             in_shardings=in_shardings)
+                             in_shardings=in_shardings,
+                             out_shardings=out_shardings)
         else:
             jitted = jax.jit(run_traced, donate_argnums=(2,))
         logger.debug(

@@ -13,6 +13,14 @@ except the fleet here is real OS processes over a real rendezvous.
 Usage:
   python dcn_worker.py single <out.npz>
   python dcn_worker.py worker <coordinator> <pid> <nproc> <out.npz>
+  python dcn_worker.py single-ckpt <ckpt_dir> <out.npz>
+  python dcn_worker.py worker-ckpt <coordinator> <pid> <nproc> <ckpt_dir> \
+      <out.npz>
+
+The *-ckpt modes additionally exercise DISTRIBUTED checkpointing: train
+under zero_plan (momentum accumulators sharded over the ACROSS-process dp
+axis — each worker holds only its slice), save mid-run (every process
+writes its shard sidecar), restore into a fresh scope, and keep training.
 """
 import os
 import sys
@@ -59,21 +67,85 @@ def run_training():
     return result
 
 
+def run_ckpt_cycle(ckpt_dir):
+    """Train 2 steps under zero_plan, checkpoint, restore into a FRESH
+    scope, train 2 more. The accumulators are sharded across processes in
+    the worker mode — the save writes shard sidecars, the load stitches
+    them. Returns losses + final params."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.checkpoint import load_checkpoint, save_checkpoint
+    from paddle_tpu.parallel import zero_plan
+    from paddle_tpu.parallel.multihost import make_hybrid_mesh
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[16])
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=32, act="relu")
+        logits = layers.fc(h, size=8)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    startup.random_seed = 5
+    main_prog.random_seed = 5
+
+    mesh = make_hybrid_mesh({"dp": 2}, {"mp": 4})
+    plan = zero_plan(mesh)
+    exe = pt.Executor(mesh=mesh, plan=plan)
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 16).astype("float32")
+    ys = rng.randint(0, 8, size=(16, 1)).astype("int64")
+    losses = []
+    for _ in range(2):
+        out, = exe.run(main_prog, feed={"x": xs, "y": ys},
+                       fetch_list=[loss], scope=scope)
+        losses.append(np.asarray(out))
+
+    save_checkpoint(ckpt_dir, scope=scope, step=2)
+
+    # resume in a FRESH scope (and a fresh executor, as a restart would)
+    scope2 = pt.Scope()
+    exe2 = pt.Executor(mesh=mesh, plan=plan)
+    load_checkpoint(ckpt_dir, scope=scope2)
+    for _ in range(2):
+        out, = exe2.run(main_prog, feed={"x": xs, "y": ys},
+                        fetch_list=[loss], scope=scope2)
+        losses.append(np.asarray(out))
+
+    result = {"losses": np.asarray(losses, np.float64)}
+    for p in main_prog.global_block.all_parameters():
+        result["param:" + p.name] = exe2._fetch_numpy(scope2.get(p.name))
+    return result
+
+
 def main():
     mode = sys.argv[1]
     os.environ["JAX_PLATFORMS"] = "cpu"
-    n_local = 8 if mode == "single" else 4
+    n_local = 8 if mode.startswith("single") else 4
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={n_local}")
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import numpy as np
 
+    ckpt_dir = None
     if mode == "single":
         outpath = sys.argv[2]
+    elif mode == "single-ckpt":
+        ckpt_dir, outpath = sys.argv[2], sys.argv[3]
     else:
-        coord, pid, nproc, outpath = (sys.argv[2], int(sys.argv[3]),
-                                      int(sys.argv[4]), sys.argv[5])
+        coord, pid, nproc = sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+        if mode == "worker-ckpt":
+            ckpt_dir, outpath = sys.argv[5], sys.argv[6]
+        else:
+            outpath = sys.argv[5]
         from paddle_tpu.parallel import multihost
 
         multihost.initialize(coordinator_address=coord,
@@ -82,7 +154,7 @@ def main():
         assert info["process_count"] == nproc, info
         assert info["global_devices"] == 8, info
         assert info["local_devices"] == 4, info
-    res = run_training()
+    res = run_ckpt_cycle(ckpt_dir) if ckpt_dir else run_training()
     np.savez(outpath, **res)
     print("OK", mode)
 
