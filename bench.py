@@ -160,6 +160,40 @@ def bench_transformer_step(jax, pt, layers, models,
     return bs * T / sec, flops / sec
 
 
+def bench_decode(jax, pt, layers, models, bs=8, Tp=1024, N=128,
+                 vocab=16384, d=1024, L=8, H=8, steps=3):
+    """Serving metric: KV-cache greedy decode throughput (generated
+    tokens/sec) on the stacked transformer — the O(T)/token path
+    (ops/pipeline_ops.transformer_stack_generate). No reference analogue
+    (the reference predates autoregressive serving)."""
+    import numpy as np
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data("prompt", shape=[Tp], dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+            max_len=Tp + N, max_new_tokens=N)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    # device-resident prompt, like every other secondary metric: the
+    # measurement is the decode loop, not host->device transfer
+    feed = {"prompt": jax.device_put(
+        rng.randint(0, vocab, (bs, Tp)).astype("int64"))}
+    o, = exe.run(prog, feed=feed, fetch_list=[out_ids], scope=scope)
+    np.asarray(o)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        o, = exe.run(prog, feed=feed, fetch_list=[out_ids], scope=scope,
+                     return_numpy=False)
+    np.asarray(o)
+    sec = (time.perf_counter() - t0) / steps
+    return {"tokens_per_sec": round(bs * N / sec),
+            "config": f"bs{bs} prefill{Tp} decode{N} d{d} L{L}"}
+
+
 def bench_lstm_varlen(jax, pt, layers, batch=64, hidden=512, vocab=10000,
                       mean_len=80, cap=200, steps=20):
     """Variable-length 2xLSTM text classification (the reference RNN
@@ -410,6 +444,8 @@ def run_bench(platform):
     lm = attempt("transformer", bench_transformer_step, jax, pt, layers,
                  models) if on_tpu else None
     lm_tok_s, lm_flops_s = lm if lm else (None, None)
+    decode = attempt("decode", bench_decode, jax, pt, layers, models) \
+        if on_tpu else None
     zoo = {}
     infer_zoo = {}
     if on_tpu:
@@ -455,6 +491,7 @@ def run_bench(platform):
                                       "V16k bf16; MFU counts in-kernel "
                                       "causal flash FLOPs"),
             "lstm_varlen": lstm_varlen,
+            "decode_kv_cache": decode,
             "fused_linear_grad": bool(
                 pt.flags.FLAGS.fused_linear_grad),
             "degraded": notes or None,

@@ -55,3 +55,14 @@ def test_transformer_flop_model_is_sane():
     attn = 2 * 128 * 128 * 64
     head = 2 * 128 * 64 * 32
     assert fl == 3 * (dense + attn + head)
+
+
+def test_decode_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    res = _bench().bench_decode(jax, pt, layers, models, bs=2, Tp=8, N=4,
+                                vocab=32, d=16, L=1, H=2, steps=1)
+    assert res["tokens_per_sec"] > 0

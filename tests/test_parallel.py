@@ -227,6 +227,7 @@ class TestTwoProcessDCN:
                 np.testing.assert_allclose(
                     got[k], ref[k], rtol=2e-6, atol=1e-7,
                     err_msg=f"proc{i} key {k}")
+
         # and the two workers' views of the replicated state must be
         # IDENTICAL to each other — they executed one shared program
         got0, got1 = np.load(outs[0]), np.load(outs[1])
@@ -294,3 +295,16 @@ class TestDistributedCheckpoint:
                 np.testing.assert_allclose(
                     got[k], ref[k], rtol=2e-6, atol=1e-7,
                     err_msg=f"proc{i} key {k}")
+
+        # ELASTIC resume: the 2-process fleet's checkpoint restores on a
+        # DIFFERENT topology (this single process) — sidecars stitch into
+        # full host values, the next executor reshards per its own plan
+        from paddle_tpu.checkpoint import load_checkpoint
+        from paddle_tpu.core.scope import Scope
+
+        sc = Scope()
+        meta = load_checkpoint(ckpt_multi, scope=sc)
+        assert meta["shard_files"] == 2
+        restored = set(sc.keys())
+        for v in meta["shard_values"]:
+            assert v in restored, (v, sorted(restored))
