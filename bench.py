@@ -174,22 +174,10 @@ def bench_decode(jax, pt, layers, models, bs=8, Tp=1024, N=128,
         out_ids = models.transformer_lm_generate(
             prompt, vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
             max_len=Tp + N, max_new_tokens=N)
-    scope = pt.Scope()
-    exe = pt.Executor(pt.TPUPlace())
-    exe.run(startup, scope=scope)
     rng = np.random.RandomState(0)
-    # device-resident prompt, like every other secondary metric: the
-    # measurement is the decode loop, not host->device transfer
-    feed = {"prompt": jax.device_put(
-        rng.randint(0, vocab, (bs, Tp)).astype("int64"))}
-    o, = exe.run(prog, feed=feed, fetch_list=[out_ids], scope=scope)
-    np.asarray(o)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        o, = exe.run(prog, feed=feed, fetch_list=[out_ids], scope=scope,
-                     return_numpy=False)
-    np.asarray(o)
-    sec = (time.perf_counter() - t0) / steps
+    feed = {"prompt": rng.randint(0, vocab, (bs, Tp)).astype("int64")}
+    sec = _time_train_steps(jax, pt, prog, startup, out_ids, feed,
+                            warmup=1, steps=steps)
     return {"tokens_per_sec": round(bs * N / sec),
             "config": f"bs{bs} prefill{Tp} decode{N} d{d} L{L}"}
 
