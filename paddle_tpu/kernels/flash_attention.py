@@ -63,13 +63,18 @@ def rotary(x, pos0=0, base=10000.0):
     return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
 
 
-def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
+def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
+                        q_pos0=0):
     """Pure-jnp attention over [B, H, T, D]; the semantic ground truth.
 
     K/V may carry Hkv < H head planes (grouped-query attention, query
     head h reading kv head h // (H//Hkv)): the group structure stays in
     the einsum — no [B, H, T, D] expansion is ever materialised, which is
-    the point of the smaller cache on the decode hot path."""
+    the point of the smaller cache on the decode hot path.
+
+    ``q_pos0`` offsets the queries' GLOBAL positions for causal masking —
+    a window of w queries starting at cache position p attends key j iff
+    j <= p + i (the block-causal mask incremental verify needs)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     H, Hkv = q.shape[1], k.shape[1]
@@ -85,7 +90,7 @@ def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)             * sm_scale
     T = q.shape[2], k.shape[2]
     if causal:
-        qi = jnp.arange(T[0])[:, None]
+        qi = q_pos0 + jnp.arange(T[0])[:, None]
         kj = jnp.arange(T[1])[None, :]
         s = jnp.where(qi >= kj, s, -jnp.inf)
     if lengths is not None:

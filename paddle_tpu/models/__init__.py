@@ -18,11 +18,13 @@ from .googlenet import googlenet
 from .mobilenet import mobilenet
 from .smallnet import smallnet_mnist_cifar
 from .transformer import (transformer_lm, transformer_lm_beam_search,
-                          transformer_lm_generate)
+                          transformer_lm_generate,
+                          transformer_lm_speculative_generate)
 from .wide_deep import wide_deep, wide_deep_loss
 
 __all__ = [
-    "transformer_lm", "transformer_lm_beam_search", "transformer_lm_generate", "wide_deep", "wide_deep_loss",
+    "transformer_lm", "transformer_lm_beam_search", "transformer_lm_generate",
+    "transformer_lm_speculative_generate", "wide_deep", "wide_deep_loss",
     "lenet5", "alexnet", "vgg", "resnet_imagenet", "resnet_cifar10",
     "googlenet", "mobilenet", "smallnet_mnist_cifar",
 ]

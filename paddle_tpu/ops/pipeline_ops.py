@@ -429,3 +429,161 @@ def transformer_stack_beam_search(attrs, ins):
     prompts = jnp.repeat(prompt[:, None, :], K, axis=1)
     return out(Out=jnp.concatenate([prompts, tokens], axis=2),
                Scores=scores)
+
+
+def _window_verify_fn(params, num_heads, d, num_kv_heads=None,
+                      use_rope=False):
+    """Forward a w-token window through ALL layers against the cache
+    (block-causal: window token i attends cache rows <= pos0 + i), writing
+    the window's K/V at rows pos0..pos0+w-1. Returns fn(xw, ck, cv, pos0)
+    -> (hidden [b, w, d], ck, cv) — the verify pass of speculative
+    decoding, and exactly a prefill when the cache is empty."""
+    from ..kernels.flash_attention import reference_attention
+
+    def run(xw, ck, cv, pos0):
+        def layer(hw, inp):
+            layer_p, ck_l, cv_l = inp
+            q, k, v = _attn_proj(layer_p, hw, num_heads, num_kv_heads,
+                                 use_rope, pos0=pos0)
+            ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, pos0, 2)
+            cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, pos0, 2)
+            ctx = reference_attention(q, ck_l, cv_l, causal=True,
+                                      q_pos0=pos0)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(
+                hw.shape[0], hw.shape[1], d)
+            return _attn_out_ffn(layer_p, hw, ctx), (ck_l, cv_l)
+
+        return jax.lax.scan(layer, xw, (params, ck, cv))
+
+    return run
+
+
+@register_op("transformer_stack_speculative_generate",
+             optional_inputs=("PosEmb",))
+def transformer_stack_speculative_generate(attrs, ins):
+    """Self-speculative greedy decoding: an early-exit draft proposes,
+    the full stack verifies.
+
+    Same inputs as transformer_stack_generate plus a draft head
+    (DraftLnS/DraftLnB [d], DraftHeadW [d, V]); attrs: num_heads,
+    max_new_tokens, draft_layers (k < L), gamma (proposals per round).
+
+    Each round the DRAFT — the first k layers of the SAME stack plus its
+    own head — decodes gamma tokens through the shared cache's first k
+    layer planes; the full L-layer stack then scores the whole window in
+    ONE block-causal pass, the longest agreeing prefix is accepted (plus
+    the target's correction/bonus token), and the loop advances. Because
+    acceptance only keeps tokens the full stack itself argmaxes, the
+    output is EXACTLY the plain greedy decode — the draft controls speed,
+    never content (verified by test). Batch rows advance in lockstep at
+    the batch-min acceptance, keeping every cache update uniform.
+
+    Out [b, Tp + N] int; Rounds [1] int32 (verify rounds taken — the
+    speedup diagnostic: plain decode would take N).
+    """
+    (prompt, tok_emb, pos_emb, ln_s, ln_b, head_w,
+     params) = _unpack_lm_ins(ins)
+    d_ln_s = single(ins, "DraftLnS")
+    d_ln_b = single(ins, "DraftLnB")
+    d_head_w = single(ins, "DraftHeadW")
+    num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
+    N = attrs["max_new_tokens"]
+    k_layers = attrs["draft_layers"]
+    gamma = attrs.get("gamma", 4)
+    b, Tp = prompt.shape
+    L, d = params["ln1_s"].shape
+    if not 0 < k_layers < L:
+        raise ValueError(f"draft_layers {k_layers} outside [1, {L - 1}]")
+    if N < 1 or gamma < 1:
+        raise ValueError("max_new_tokens and gamma must be >= 1")
+    # cache slack: a round may write gamma + 1 rows past the last emit
+    Ttot = Tp + N + gamma + 1
+    if pos_emb is not None and Ttot > pos_emb.shape[0]:
+        raise ValueError(
+            f"prompt {Tp} + {N} new tokens (+{gamma + 1} speculative "
+            f"slack) exceeds max_len {pos_emb.shape[0]}")
+    embed = _embed_fn(tok_emb, pos_emb)
+    logits_of = _logits_fn(ln_s, ln_b, head_w)
+    draft_logits_of = _logits_fn(d_ln_s, d_ln_b, d_head_w)
+    draft_params = {key: p[:k_layers] for key, p in params.items()}
+    draft_layer = _decode_layer_fn(draft_params, num_heads, d,
+                                   num_kv_heads, use_rope)
+    verify = _window_verify_fn(params, num_heads, d, num_kv_heads,
+                               use_rope)
+
+    # ---- prefill: the full stack over the prompt -----------------------
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
+                           num_kv_heads, use_rope)
+    pad = [(0, 0)] * 5
+    pad[3] = (0, Ttot - Tp)
+    cache_k = jnp.pad(ks, pad)
+    cache_v = jnp.pad(vs, pad)
+    cur = jnp.argmax(logits_of(h[:, -1]), axis=-1)  # token at pos Tp
+
+    tokens = jnp.zeros((b, N + gamma + 1), prompt.dtype)
+    tokens = tokens.at[:, 0].set(cur.astype(prompt.dtype))
+
+    def round_body(carry):
+        tokens, n, cur, pos, rounds, ck, cv = carry
+        # pos = cache rows filled (cur sits at position pos, unprocessed)
+
+        # 1. draft chain: k-layer incremental decode of gamma proposals.
+        # Only the first k_layers cache planes thread through the scan —
+        # carrying the full L-layer cache would rewrite it per proposal.
+        def draft_step(dcarry, i):
+            dtok, dck, dcv = dcarry
+            x1 = embed(dtok[:, None], pos + i)
+            h1, (dck, dcv) = jax.lax.scan(
+                lambda h1, inp: draft_layer(h1, inp, pos + i),
+                x1, (draft_params, dck, dcv))
+            nxt = jnp.argmax(draft_logits_of(h1[:, 0]), axis=-1)
+            return (nxt.astype(dtok.dtype), dck, dcv), nxt
+
+        (_, dck, dcv), dtoks = jax.lax.scan(
+            draft_step, (cur, ck[:k_layers], cv[:k_layers]),
+            jnp.arange(gamma))
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, dck, 0, 0)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, dcv, 0, 0)
+        dtoks = jnp.moveaxis(dtoks, 0, 1)  # [b, gamma]
+
+        # 2. verify: full stack over [cur, d_0..d_{gamma-1}] in one pass
+        window = jnp.concatenate(
+            [cur[:, None], dtoks.astype(cur.dtype)], axis=1)
+        xw = embed(window, pos)
+        hw, (ck, cv) = verify(xw, ck, cv, pos)
+        t = jnp.argmax(logits_of(
+            hw.reshape(b * (gamma + 1), d)), axis=-1).reshape(
+            b, gamma + 1)  # target tokens for positions pos+1..pos+g+1
+
+        # 3. lockstep acceptance: batch-min longest agreeing prefix
+        agree = (t[:, :gamma] == dtoks)  # [b, gamma]
+        acc_rows = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                           axis=1)  # per-row accepted count
+        a = jnp.min(acc_rows)  # lockstep
+        # emit t_0..t_a (a+1 tokens: accepted + correction/bonus)
+        for i in range(gamma + 1):
+            tokens = jnp.where(
+                i <= a,
+                jax.lax.dynamic_update_index_in_dim(
+                    tokens, t[:, i].astype(tokens.dtype), n + 1 + i, 1),
+                tokens)
+        cur = jax.lax.dynamic_index_in_dim(t, a, 1, keepdims=False)
+        return (tokens, n + 1 + a, cur.astype(tokens.dtype),
+                pos + 1 + a, rounds + 1, ck, cv)
+
+    def cond(carry):
+        # tokens[0] is pre-emitted by the prefill; indices 0..n are
+        # filled, so N emissions means n >= N - 1
+        return carry[1] < N - 1
+
+    init_n = jnp.asarray(0, jnp.int32)
+    tokens, n, cur, pos, rounds, cache_k, cache_v = jax.lax.while_loop(
+        cond, round_body,
+        (tokens, init_n, cur.astype(tokens.dtype),
+         jnp.asarray(Tp, jnp.int32), jnp.asarray(0, jnp.int32),
+         cache_k, cache_v))
+    out_ids = jnp.concatenate(
+        [prompt, tokens[:, :N].astype(prompt.dtype)], axis=1)
+    return out(Out=out_ids, Rounds=rounds.reshape(1))

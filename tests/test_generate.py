@@ -361,3 +361,92 @@ def test_rope_stack_decode_matches_reforwarding():
 
 def test_rope_gqa_combined_decode_matches_reforwarding():
     _decode_vs_reforward({"use_rope": True, "num_kv_heads": 2})
+
+
+class TestSpeculativeDecoding:
+    def test_output_exactly_matches_plain_greedy(self):
+        """THE speculative-decoding guarantee: the draft controls speed,
+        never content — with greedy verification the output equals plain
+        greedy decode token for token, even with an UNTRAINED draft head
+        (it just accepts less)."""
+        Tp, N = 8, 10
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        main, startup, _, loss = _build_train(Tp)
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        seq = (rng.randint(0, VOCAB, (32, 1))
+               + 3 * np.arange(Tp + 1)) % VOCAB
+        feed = {"ids": seq[:, :-1].astype("int64"),
+                "tgt": seq[:, 1:].astype("int64")}
+        for _ in range(30):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+
+        prog, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup2):
+            prompt = layers.data("ps", shape=[Tp], dtype="int64")
+            plain = models.transformer_lm_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N)
+            spec, rounds = models.transformer_lm_speculative_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N,
+                draft_layers=1, gamma=3)
+        # the spec program adds draft_ln/draft_head params: run its
+        # startup for those, then restore every trained tensor it clobbered
+        trained = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+        exe.run(startup2, scope=scope)
+        for k, v in trained.items():
+            scope.set(k, v)
+
+        p = ((rng.randint(0, VOCAB, (3, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+        g, s_, r = exe.run(prog, feed={"ps": p},
+                           fetch_list=[plain, spec, rounds], scope=scope)
+        np.testing.assert_array_equal(np.asarray(s_), np.asarray(g))
+        assert 1 <= int(np.asarray(r)[0]) <= N
+
+    def test_trained_draft_head_accepts_more(self):
+        """A draft head distilled to mimic the full head should cut the
+        verify-round count well below N (the speedup mechanism)."""
+        Tp, N = 8, 12
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        main, startup, _, loss = _build_train(Tp)
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        seq = (rng.randint(0, VOCAB, (64, 1))
+               + 3 * np.arange(Tp + 1)) % VOCAB
+        feed = {"ids": seq[:, :-1].astype("int64"),
+                "tgt": seq[:, 1:].astype("int64")}
+        for _ in range(60):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+
+        prog, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup2):
+            prompt = layers.data("pt2", shape=[Tp], dtype="int64")
+            spec, rounds = models.transformer_lm_speculative_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N,
+                draft_layers=1, gamma=4)
+        trained = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+        exe.run(startup2, scope=scope)
+        for k, v in trained.items():
+            scope.set(k, v)
+        # a PERFECT draft head for this easy task: copy the real head onto
+        # the draft (the 1-layer trunk still differs, so acceptance is
+        # model-driven, not trivially 100%)
+        scope.set("draft_head.w", np.asarray(scope.get("lm_head.w")))
+        scope.set("draft_ln.scale", np.asarray(scope.get("final_ln.scale")))
+        scope.set("draft_ln.bias", np.asarray(scope.get("final_ln.bias")))
+
+        p = ((rng.randint(0, VOCAB, (2, 1)) + 3 * np.arange(Tp)) % VOCAB
+             ).astype("int64")
+        s_, r = exe.run(prog, feed={"pt2": p}, fetch_list=[spec, rounds],
+                        scope=scope)
+        r = int(np.asarray(r)[0])
+        # learned task: the shallow draft tracks the full model, so
+        # rounds must land well under the N-1 = 11 a zero-acceptance
+        # loop would take (ideal: ceil((N-1)/(gamma+1)) = 3; the 1-layer
+        # trunk diverges from the full stack on some steps)
+        assert r <= 8, r
