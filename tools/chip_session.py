@@ -232,6 +232,45 @@ def main():
     experiment("lm_decode_throughput", lm_decode)
     experiment("lm_decode_throughput_gqa2", lambda: lm_decode(2))
 
+    # 3d. Self-speculative decode (draft head = copied target head — a
+    #     deployment would distill it; measures the verify-round win).
+    def lm_spec_decode():
+        import numpy as np
+        bs, Tp, N, vocab, d, Lh = 8, 1024, 128, 16384, 1024, 8
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("prompt", shape=[Tp], dtype="int64")
+            out_ids, rounds = models.transformer_lm_speculative_generate(
+                prompt, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=8, max_len=Tp + N + 8, max_new_tokens=N,
+                draft_layers=2, gamma=4)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        scope.set("draft_head.w", scope.get("lm_head.w"))
+        scope.set("draft_ln.scale", scope.get("final_ln.scale"))
+        scope.set("draft_ln.bias", scope.get("final_ln.bias"))
+        rng = np.random.RandomState(0)
+        import jax as _jax
+        feed = {"prompt": _jax.device_put(
+            rng.randint(0, vocab, (bs, Tp)).astype("int64"))}
+        o, r = exe.run(prog, feed=feed, fetch_list=[out_ids, rounds],
+                       scope=scope)
+        np.asarray(o)
+        t0 = time.perf_counter()
+        steps = 3
+        for _ in range(steps):
+            o, r = exe.run(prog, feed=feed, fetch_list=[out_ids, rounds],
+                           scope=scope, return_numpy=False)
+        np.asarray(o)
+        sec = (time.perf_counter() - t0) / steps
+        return {"decode_tokens_per_sec": round(bs * N / sec),
+                "verify_rounds": int(np.asarray(r)[0]),
+                "config": f"bs{bs} prefill{Tp} decode{N} draft2 gamma4 "
+                          "(untrained weights: rounds ~= worst case)"}
+
+    experiment("lm_spec_decode", lm_spec_decode)
+
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
     pt.flags.FLAGS.fused_linear_grad = True
     experiment("lstm_varlen",
