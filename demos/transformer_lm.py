@@ -82,6 +82,39 @@ def main():
     print("generated continuation:", gen[-n_new:].tolist())
     print(f"rule-consistent steps: {sum(ok)}/{n_new}")
 
+    # the other decoders over the same trained weights: beam search
+    # (best-first with scores) and self-speculative decoding (exactly the
+    # greedy output, fewer full-stack passes)
+    alt_prog, alt_startup = pt.Program(), pt.Program()
+    with pt.program_guard(alt_prog, alt_startup):
+        prompt2 = layers.data("prompt2", shape=[T], dtype="int64")
+        beams, scores = models.transformer_lm_beam_search(
+            prompt2, vocab_size=vocab, d_model=d_model,
+            n_layers=n_layers, num_heads=4, max_len=2 * T,
+            max_new_tokens=n_new, beam_size=3)
+        spec, rounds = models.transformer_lm_speculative_generate(
+            prompt2, vocab_size=vocab, d_model=d_model,
+            n_layers=n_layers, num_heads=4, max_len=2 * T,
+            max_new_tokens=n_new, draft_layers=1, gamma=3)
+    # the only params this program ADDS are the draft head's three
+    # tensors; set them directly (here: copy the target head — a real
+    # deployment would distill a cheaper one) and never run alt_startup,
+    # which would re-initialize the trained weights
+    scope.set("draft_head.w", np.asarray(scope.get("lm_head.w")))
+    scope.set("draft_ln.scale", np.asarray(scope.get("final_ln.scale")))
+    scope.set("draft_ln.bias", np.asarray(scope.get("final_ln.bias")))
+    bm, sc_, sp, rd = exe.run(
+        alt_prog, feed={"prompt2": ctx},
+        fetch_list=[beams, scores, spec, rounds], scope=scope)
+    bm, sc_, sp = np.asarray(bm), np.asarray(sc_), np.asarray(sp)
+    print("beam best :", bm[0, 0, -n_new:].tolist(),
+          f"(score {sc_[0, 0]:.2f})")
+    print("beam 2nd  :", bm[0, 1, -n_new:].tolist(),
+          f"(score {sc_[0, 1]:.2f})")
+    print("speculative:", sp[0, -n_new:].tolist(),
+          f"({int(np.asarray(rd)[0])} verify rounds vs {n_new} plain; "
+          f"greedy-exact: {bool((sp[0, -n_new:] == gen[-n_new:]).all())})")
+
 
 if __name__ == "__main__":
     main()
