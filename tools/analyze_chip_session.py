@@ -69,8 +69,12 @@ def main():
          "lm_h8_fused_on", mfu),
         ("LM d_head 64 -> 128 (fused)", "lm_h16_fused_on",
          "lm_h8_fused_on", mfu),
+        ("LM per-layer -> stacked scan", "lm_h8_fused_on",
+         "lm_stacked_scan", mfu),
         ("decode GQA kv8 -> kv2", "lm_decode_throughput",
          "lm_decode_throughput_gqa2", toks),
+        ("decode plain -> speculative", "lm_decode_throughput",
+         "lm_spec_decode", toks),
     ]
     for label, a, b, metric in pairs:
         va, vb = metric(a), metric(b)
