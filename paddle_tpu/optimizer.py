@@ -79,30 +79,148 @@ class Optimizer:
 
     # -- public API --------------------------------------------------------
     def minimize(self, loss: Variable, startup_program: Optional[Program] = None,
-                 parameter_list=None, no_grad_set=None
+                 parameter_list=None, no_grad_set=None,
+                 accumulate_steps: int = 1
                  ) -> List[Tuple[Variable, Variable]]:
+        """Append backward + update ops for ``loss``.
+
+        ``accumulate_steps`` > 1 turns on in-graph gradient accumulation:
+        each run adds the micro-batch gradient into a persistent buffer
+        and the optimizer (including its momentum/Adam state and the
+        LR-schedule step) applies only every k-th run, on the MEAN of the
+        k gradients — so k micro-batches reproduce one large-batch step
+        exactly. The accumulation buffers are named ``*_gradsum_acc`` and
+        inherit a parameter's sharding-plan rules like any optimizer
+        accumulator (e.g. ZeRO shards them over dp)."""
         from .clip import append_gradient_clip_ops
 
         startup = startup_program or default_startup_program()
         params_grads = append_backward(loss, parameter_list, no_grad_set)
+        block = loss.block
+        lr_var = self._create_lr_var(block.program, startup)
+        if accumulate_steps and int(accumulate_steps) > 1:
+            # clip/reg must see the accumulated MEAN gradient (clipping a
+            # micro-batch then averaging != clipping the mean) — they are
+            # appended inside the accumulation plumbing instead
+            self._create_accumulators(startup,
+                                      [p for p, _ in params_grads])
+            self._minimize_accumulated(block, startup, params_grads,
+                                       lr_var, int(accumulate_steps))
+            self._append_updater_hooks(block, startup,
+                                       [p for p, _ in params_grads])
+            return params_grads
         # clip BEFORE regularization — fluid's order
         # (reference optimizer.py runs append_gradient_clip_ops first, then
         # append_regularization_ops)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
-        block = loss.block
-        lr_var = self._create_lr_var(block.program, startup)
         self._create_accumulators(startup, [p for p, _ in params_grads])
         for pg in params_grads:
             self._append_optimize_op(block, pg, lr_var)
-        self._append_updater_hooks(block, startup,
-                                   [p for p, _ in params_grads])
         if self.global_step is not None:
-            block.append_op("increment", inputs={"X": [self.global_step.name]},
+            block.append_op("increment",
+                            inputs={"X": [self.global_step.name]},
                             outputs={"Out": [self.global_step.name]},
                             attrs={"step": 1.0})
+        self._append_updater_hooks(block, startup,
+                                   [p for p, _ in params_grads])
         return params_grads
+
+    def _minimize_accumulated(self, block, startup, params_grads, lr_var,
+                              k: int):
+        """Gradient accumulation: buffer += grad each run; every k-th run
+        clip + regularization + the optimizer op apply on the MEAN of the
+        k gradients, and every state write (param, velocity/moments/
+        beta-pows, step counters) lands only through a gate — off-step
+        runs leave all state bit-identical.
+
+        Counter gating preserves dtypes (LR-schedule counters are int32
+        by design); schedules driven by the shared ``lr_global_step``
+        counter advance once per apply. A USER-supplied ``global_step``
+        passed directly into a decay fn cannot be discovered here and
+        would still tick per micro-batch — pass it as the optimizer's
+        ``global_step`` instead."""
+        from . import layers as L
+        from .clip import append_gradient_clip_ops
+
+        kw = dict(main_program=block.program, startup_program=startup)
+        counter = L.create_global_var(
+            shape=[1], value=0.0, dtype="float32",
+            name=block.program.unique_name("grad_acc_step"), **kw)
+        block.append_op("increment", inputs={"X": [counter.name]},
+                        outputs={"Out": [counter.name]},
+                        attrs={"step": 1.0})
+        k_c = L.fill_constant(shape=[1], value=float(k), dtype="float32",
+                              **kw)
+        gate = L.cast(L.equal(counter, k_c, **kw), "float32", **kw)
+        inv_gate = L.scale(gate, scale=-1.0, bias=1.0, **kw)
+        # counter resets on apply (no mod op needed)
+        block.append_op("elementwise_mul",
+                        inputs={"X": [counter.name],
+                                "Y": [inv_gate.name]},
+                        outputs={"Out": [counter.name]}, attrs={})
+
+        def gated_advance(name, dtype_name):
+            """counter += gate, in the counter's OWN dtype (int32 LR
+            counters must stay int32 — f32 freezes at 2^24)."""
+            g_typed = L.cast(gate, dtype_name, **kw)                 if dtype_name != "float32" else gate
+            block.append_op("elementwise_add",
+                            inputs={"X": [name], "Y": [g_typed.name]},
+                            outputs={"Out": [name]}, attrs={})
+
+        # LR schedules carry their own per-run counters whose increment
+        # ops were appended at schedule-build time; subtract the
+        # increment back on off-steps so decay advances once per APPLY
+        shared = getattr(block.program, "_lr_step_counter", None)
+        lr_counters = {n for op in block.ops if op.type == "increment"
+                       for n in op.inputs.get("X", [])
+                       if "lr_global_step" in n}
+        if shared is not None:
+            lr_counters.add(shared.name)
+        for name in sorted(lr_counters):
+            var = block.vars[name]
+            ig_typed = L.cast(inv_gate, var.dtype.name, **kw)                 if var.dtype.name != "float32" else inv_gate
+            block.append_op("elementwise_sub",
+                            inputs={"X": [name], "Y": [ig_typed.name]},
+                            outputs={"Out": [name]}, attrs={})
+
+        # pass 1: accumulate and form every mean
+        means = []
+        accs = []
+        for p, g in params_grads:
+            acc = self._add_accumulator("gradsum", p, startup)
+            accs.append(acc)
+            block.append_op("elementwise_add",
+                            inputs={"X": [acc.name], "Y": [g.name]},
+                            outputs={"Out": [acc.name]}, attrs={})
+            means.append(L.scale(acc, scale=1.0 / k, **kw))
+        # clip + regularize the MEANS (global-norm clip needs them all)
+        pg_mean = append_gradient_clip_ops(
+            [(p, m) for (p, _), m in zip(params_grads, means)])
+        pg_mean = append_regularization_ops(pg_mean, self.regularization)
+        # pass 2: gated optimize per param
+        for (p, mean), acc in zip(pg_mean, accs):
+            states = [p] + [vars_[p.name]
+                            for name, vars_ in self._accumulators.items()
+                            if name != "gradsum" and p.name in vars_]
+            olds = [L.assign(s, **kw) for s in states]
+            self._append_optimize_op(block, (p, mean), lr_var)
+            for s, old in zip(states, olds):
+                # s = old + gate * (s - old): the off-step run keeps old
+                delta = L.elementwise_sub(s, old, **kw)
+                gated = L.elementwise_mul(delta, gate, **kw)
+                block.append_op("elementwise_add",
+                                inputs={"X": [old.name],
+                                        "Y": [gated.name]},
+                                outputs={"Out": [s.name]}, attrs={})
+            block.append_op("elementwise_mul",
+                            inputs={"X": [acc.name],
+                                    "Y": [inv_gate.name]},
+                            outputs={"Out": [acc.name]}, attrs={})
+        if self.global_step is not None:
+            gated_advance(self.global_step.name,
+                          self.global_step.dtype.name)
 
     def _append_updater_hooks(self, block, startup, params):
         """ParameterUpdaterHook plane (reference ParameterUpdaterHook.cpp):

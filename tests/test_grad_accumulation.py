@@ -1,0 +1,190 @@
+"""Gradient accumulation (Optimizer.minimize(accumulate_steps=k)): k
+micro-batches must reproduce one large-batch step EXACTLY — including the
+stateful optimizers' velocity/moment/beta-pow updates — and off-step runs
+must leave every parameter and accumulator bit-identical."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _build(accum, opt_cls, **okw):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[6])
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=12, act="tanh")
+        logits = layers.fc(h, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        opt_cls(**okw).minimize(loss, startup_program=startup,
+                                accumulate_steps=accum)
+    startup.random_seed = 7
+    return main, startup, loss
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.rand(32, 6).astype("float32"),
+            rng.randint(0, 4, (32, 1)).astype("int64"))
+
+
+@pytest.mark.parametrize("opt_cls,okw", [
+    (pt.optimizer.SGDOptimizer, {"learning_rate": 0.1}),
+    (pt.optimizer.MomentumOptimizer,
+     {"learning_rate": 0.1, "momentum": 0.9}),
+    (pt.optimizer.AdamOptimizer, {"learning_rate": 1e-2}),
+], ids=["sgd", "momentum", "adam"])
+def test_accumulation_equals_large_batch(opt_cls, okw):
+    X, Y = _data()
+    exe = pt.Executor(pt.TPUPlace())
+
+    main, startup, loss = _build(4, opt_cls, **okw)
+    sa = pt.Scope()
+    exe.run(startup, scope=sa)
+    for _ in range(2):
+        for q in range(4):
+            exe.run(main, feed={"x": X[q * 8:(q + 1) * 8],
+                                "y": Y[q * 8:(q + 1) * 8]},
+                    fetch_list=[loss], scope=sa)
+
+    main_b, startup_b, loss_b = _build(1, opt_cls, **okw)
+    sb = pt.Scope()
+    exe.run(startup_b, scope=sb)
+    for _ in range(2):
+        exe.run(main_b, feed={"x": X, "y": Y}, fetch_list=[loss_b],
+                scope=sb)
+
+    for p, q in zip(main.global_block.all_parameters(),
+                    main_b.global_block.all_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(sa.get(p.name)), np.asarray(sb.get(q.name)),
+            rtol=1e-6, atol=5e-6, err_msg=p.name)
+
+
+def test_off_step_runs_leave_state_untouched():
+    """Between apply points only the gradsum buffer and the micro-step
+    counter may change."""
+    X, Y = _data()
+    exe = pt.Executor(pt.TPUPlace())
+    main, startup, loss = _build(4, pt.optimizer.AdamOptimizer,
+                                 learning_rate=1e-2)
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    frozen = {n: np.asarray(scope.get(n)) for n in scope.keys()
+              if not n.endswith("_gradsum_acc")
+              and "grad_acc_step" not in n}
+    for q in range(3):  # three off-steps; the 4th would apply
+        exe.run(main, feed={"x": X[q * 8:(q + 1) * 8],
+                            "y": Y[q * 8:(q + 1) * 8]},
+                fetch_list=[loss], scope=scope)
+        for n, v in frozen.items():
+            np.testing.assert_array_equal(
+                np.asarray(scope.get(n)), v,
+                err_msg=f"off-step run {q} modified {n}")
+    # the 4th run applies: parameters must move
+    exe.run(main, feed={"x": X[24:32], "y": Y[24:32]},
+            fetch_list=[loss], scope=scope)
+    moved = any(
+        not np.array_equal(np.asarray(scope.get(p.name)),
+                           frozen[p.name])
+        for p in main.global_block.all_parameters())
+    assert moved
+
+
+def test_lr_schedule_step_counts_effective_steps():
+    """With a global-step LR schedule, accumulation advances the schedule
+    once per APPLY, not once per micro-batch."""
+    from paddle_tpu import learning_rate_decay
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[6])
+        y = layers.data("y", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        opt = pt.optimizer.SGDOptimizer(
+            learning_rate=learning_rate_decay.exponential_decay(
+                learning_rate=0.1, decay_steps=1, decay_rate=0.5,
+                staircase=True))
+        opt.minimize(loss, startup_program=startup, accumulate_steps=2)
+    exe = pt.Executor(pt.TPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    X, Y = _data()
+    for i in range(4):  # 4 micro-steps = 2 applies
+        exe.run(main, feed={"x": X[:8], "y": Y[:8]}, fetch_list=[loss],
+                scope=scope)
+    counters = [n for n in scope.keys() if "lr_global_step" in n]
+    assert counters, list(scope.keys())
+    step = float(np.asarray(scope.get(counters[0])))
+    assert step == 2.0, step
+
+
+def test_global_norm_clip_applies_to_the_mean():
+    """Clipping must act on the accumulated mean gradient (clip(mean)),
+    matching the large-batch baseline exactly — not per micro-batch."""
+    from paddle_tpu.clip import GradientClipByGlobalNorm, set_gradient_clip
+
+    X, Y = _data()
+    exe = pt.Executor(pt.TPUPlace())
+
+    def build(accum):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[1], dtype="int64")
+            logits = layers.fc(x, size=4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            set_gradient_clip(GradientClipByGlobalNorm(0.01))
+            pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+                loss, startup_program=startup, accumulate_steps=accum)
+        startup.random_seed = 7
+        return main, startup, loss
+
+    main, startup, loss = build(4)
+    sa = pt.Scope()
+    exe.run(startup, scope=sa)
+    for q in range(4):
+        exe.run(main, feed={"x": X[q * 8:(q + 1) * 8],
+                            "y": Y[q * 8:(q + 1) * 8]},
+                fetch_list=[loss], scope=sa)
+    main_b, startup_b, loss_b = build(1)
+    sb = pt.Scope()
+    exe.run(startup_b, scope=sb)
+    exe.run(main_b, feed={"x": X, "y": Y}, fetch_list=[loss_b], scope=sb)
+    for p, q in zip(main.global_block.all_parameters(),
+                    main_b.global_block.all_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(sa.get(p.name)), np.asarray(sb.get(q.name)),
+            rtol=1e-6, atol=5e-6, err_msg=p.name)
+
+
+def test_lr_counter_keeps_int32_dtype():
+    """The gated off-step restore must not promote the int32 schedule
+    counter to float32 (f32 freezes at 2^24 steps)."""
+    from paddle_tpu import learning_rate_decay
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[6])
+        y = layers.data("y", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.SGDOptimizer(
+            learning_rate=learning_rate_decay.exponential_decay(
+                learning_rate=0.1, decay_steps=1, decay_rate=0.5,
+                staircase=True)).minimize(
+            loss, startup_program=startup, accumulate_steps=2)
+    exe = pt.Executor(pt.TPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    X, Y = _data()
+    for _ in range(4):
+        exe.run(main, feed={"x": X[:8], "y": Y[:8]}, fetch_list=[loss],
+                scope=scope)
+    name = [n for n in scope.keys() if "lr_global_step" in n][0]
+    val = np.asarray(scope.get(name))
+    assert val.dtype == np.int32, val.dtype
+    assert int(val) == 2, val
