@@ -14,12 +14,16 @@ from ..data_feeder import DataFeeder
 
 class SGD:
     def __init__(self, cost, parameters, update_equation,
-                 extra_layers=None, is_local=True):
+                 extra_layers=None, is_local=True, accumulate_steps=1):
+        """``accumulate_steps`` > 1: every k reader batches apply as ONE
+        optimizer step on the mean gradient (in-graph gradient
+        accumulation — optimizer.Optimizer.minimize)."""
         self.cost = cost
         self.parameters = parameters
         self.extra_layers = list(extra_layers or [])
         update_equation.minimize(
-            cost, startup_program=parameters.startup_program)
+            cost, startup_program=parameters.startup_program,
+            accumulate_steps=accumulate_steps)
 
     def _feeder(self, feeding: Optional[Dict[str, int]]):
         return DataFeeder(self.parameters.data_vars(feeding))

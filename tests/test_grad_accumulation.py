@@ -188,3 +188,32 @@ def test_lr_counter_keeps_int32_dtype():
     val = np.asarray(scope.get(name))
     assert val.dtype == np.int32, val.dtype
     assert int(val) == 2, val
+
+
+def test_v2_trainer_accumulate_steps():
+    """The v2 facade exposes accumulation: k reader batches per apply."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(seed=5)
+    x = paddle.layer.data("xv", paddle.data_type.dense_vector(6))
+    y = paddle.layer.data("yv", paddle.data_type.integer_value(4))
+    logits = paddle.layer.fc(input=x, size=4)
+    cost = paddle.layer.classification_cost(input=logits, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+        accumulate_steps=2)
+    rng = np.random.RandomState(0)
+    W = rng.randn(6, 4)
+
+    def reader():
+        for _ in range(8):
+            xb = rng.rand(6).astype("float32")
+            yield xb, int(np.argmax(xb @ W))
+
+    costs = []
+    trainer.train(paddle.batch(reader, 4), num_passes=6,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])
