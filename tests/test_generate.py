@@ -485,3 +485,37 @@ def test_generation_on_dp_mesh_matches_single_device():
     mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
     sharded = run(mesh)
     np.testing.assert_array_equal(sharded, single)
+
+
+def test_speculative_on_dp_mesh_matches_single_device():
+    """The while-loop + gather machinery of speculative decode must also
+    compile and agree under a data-parallel mesh."""
+    import jax
+
+    from paddle_tpu.parallel import data_parallel_plan, make_mesh
+
+    Tp, N = 8, 6
+    feed_ids = np.random.RandomState(5).randint(
+        0, VOCAB, (8, Tp)).astype("int64")
+
+    def run(mesh):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            prompt = layers.data("pms", shape=[Tp], dtype="int64")
+            out_ids, rounds = models.transformer_lm_speculative_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=N,
+                draft_layers=1, gamma=2)
+        scope = pt.Scope()
+        exe = (pt.Executor(mesh=mesh, plan=data_parallel_plan(mesh))
+               if mesh else pt.Executor(pt.TPUPlace()))
+        startup.random_seed = 11
+        exe.run(startup, scope=scope)
+        got, = exe.run(main, feed={"pms": feed_ids},
+                       fetch_list=[out_ids], scope=scope)
+        return np.asarray(got)
+
+    single = run(None)
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sharded = run(mesh)
+    np.testing.assert_array_equal(sharded, single)
