@@ -403,9 +403,11 @@ def run_bench(platform):
         assert np.isfinite(o).all()
         return batch * steps / elapsed
 
-    # The fused Pallas backward is the fast path; if its compile ever
-    # fails on the measuring chip, fall back to the XLA-dot backward
-    # rather than losing the bench (the flag is part of the compile key).
+    # Runs with whatever --fused_linear_grad says (default off — the
+    # kernel lost its on-chip A/B under the 16 MB scoped-vmem limit,
+    # PERF.md round 3); if a fused compile ever fails on the measuring
+    # chip, fall back to the XLA-dot backward rather than losing the
+    # bench (the flag is part of the compile key).
     notes = {}
     try:
         img_per_sec = measure_resnet()

@@ -174,10 +174,12 @@ define_bool("use_amp", False,
             "programs (TPU analogue of the float16 plane)")
 define_string("mxu_precision", "default",
               "MXU contraction precision: default | high | highest")
-define_bool("fused_linear_grad", True,
+define_bool("fused_linear_grad", False,
             "use the fused Pallas dX+dW backward for linear/1x1-conv "
-            "layers on TPU (kernels/linear_grad.py); disable to fall "
-            "back to XLA's separate gradient dots")
+            "layers on TPU (kernels/linear_grad.py). Default off: under "
+            "XLA's 16 MB scoped-vmem limit for custom calls the kernel "
+            "measured slower than XLA's separate gradient dots on both "
+            "ResNet and LM paths (PERF.md round 3)")
 define_string("compilation_cache_dir", "",
               "persist XLA compilations here (jax persistent cache): "
               "repeat runs of the same program skip the 20-40s "

@@ -110,8 +110,15 @@ def save_vars(executor, dirname: str, main_program: Optional[Program] = None,
             continue
         arr = scope.get_numpy(v.name)
         fname = v.name.replace("/", "__")
+        entry = {"name": v.name, "file": fname + ".npy"}
+        if arr.dtype.kind == "V":
+            # ml_dtypes (bf16/fp8) round-trip through np.save as raw void
+            # ('|V2') and come back unreadable — store the integer bit
+            # view and the logical dtype in the manifest instead.
+            entry["dtype"] = str(arr.dtype)
+            arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
         np.save(os.path.join(dirname, fname + ".npy"), arr)
-        manifest.append({"name": v.name, "file": fname + ".npy"})
+        manifest.append(entry)
     with open(os.path.join(dirname, "MANIFEST.json"), "w") as f:
         json.dump(manifest, f, indent=1)
 
@@ -131,13 +138,18 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     if vars is None:
         vars = [v for v in program.list_vars() if predicate(v)]
     with open(os.path.join(dirname, "MANIFEST.json")) as f:
-        manifest = {e["name"]: e["file"] for e in json.load(f)}
+        manifest = {e["name"]: e for e in json.load(f)}
     import jax.numpy as jnp
 
     for v in vars:
         if v.name not in manifest:
             continue
-        arr = np.load(os.path.join(dirname, manifest[v.name]))
+        entry = manifest[v.name]
+        arr = np.load(os.path.join(dirname, entry["file"]))
+        if entry.get("dtype"):
+            import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+
+            arr = arr.view(np.dtype(entry["dtype"]))
         scope.set(v.name, jnp.asarray(arr))
 
 

@@ -51,9 +51,13 @@ def _linear_bwd_kernel(x_ref, dy_ref, w_ref, dx_ref, dw_ref, acc_ref, *,
         dw_ref[...] = acc_ref[...].astype(dw_ref.dtype)
 
 
-# VMEM the kernel may claim (per-core budget is 128 MB on v5e-class chips;
-# leave room for Mosaic's double buffering and everything else).
-_VMEM_BUDGET = 48 * 1024 * 1024
+# VMEM the kernel may claim. The binding constraint is NOT the chip's
+# 128 MB VMEM but XLA's scoped-vmem allocation limit for custom calls
+# (16 MB by default — exceeding it is a hard compile error: "Scoped
+# allocation ... exceeded scoped vmem limit", measured on chip). Stay
+# under it with headroom; shapes that don't fit (e.g. FFN-sized [I, O]
+# weight-resident accumulators) fall back to the two XLA dots.
+_VMEM_BUDGET = 14 * 1024 * 1024
 
 
 def _pick_block(R: int, I: int, O: int, xb: int, yb: int, wb: int) -> int:

@@ -206,7 +206,8 @@ struct Tensor {
 };
 
 // .npy loader (format spec: magic, version, header dict, raw data).
-bool load_npy(const std::string& path, Tensor* t, std::string* err) {
+bool load_npy(const std::string& path, Tensor* t, std::string* err,
+              const std::string& logical_dtype = "") {
   std::ifstream f(path, std::ios::binary);
   if (!f) { *err = "cannot open " + path; return false; }
   char magic[6];
@@ -267,7 +268,27 @@ bool load_npy(const std::string& path, Tensor* t, std::string* err) {
     for (int64_t i = 0; i < n; ++i)
       t->data[static_cast<size_t>(i)] = static_cast<float>(buf[static_cast<size_t>(i)]);
   };
-  if (descr.find("<f4") != std::string::npos) read_as(float{}, 4);
+  // AMP saved models carry bf16 params as uint16 bit views with the
+  // logical dtype in the manifest (python io.py save_vars); widen the
+  // bits to f32 (bf16 is the top half of an IEEE float).
+  bool bf16_bits = logical_dtype == "bfloat16" &&
+                   (descr.find("u2") != std::string::npos ||
+                    descr.find("i2") != std::string::npos);
+  if (bf16_bits) {
+    std::vector<uint16_t> buf(n);
+    f.read(reinterpret_cast<char*>(buf.data()),
+           static_cast<std::streamsize>(n) * 2);
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t bits = static_cast<uint32_t>(buf[static_cast<size_t>(i)]) << 16;
+      float v;
+      memcpy(&v, &bits, 4);
+      t->data[static_cast<size_t>(i)] = v;
+    }
+  } else if (!logical_dtype.empty() && logical_dtype != "float32" &&
+             logical_dtype != "float64") {
+    *err = "unsupported manifest dtype " + logical_dtype + " in " + path;
+    return false;
+  } else if (descr.find("<f4") != std::string::npos) read_as(float{}, 4);
   else if (descr.find("<f8") != std::string::npos) read_as(double{}, 8);
   else if (descr.find("<i8") != std::string::npos) read_as(int64_t{}, 8);
   else if (descr.find("<i4") != std::string::npos) read_as(int32_t{}, 4);
@@ -1092,7 +1113,10 @@ void* load_impl(const char* model_dir) {
   for (auto& entry : manifest.arr) {
     Tensor t;
     std::string err;
-    if (!load_npy(dir + "/params/" + entry.at("file").str, &t, &err)) {
+    std::string logical =
+        entry.has("dtype") ? entry.at("dtype").str : std::string();
+    if (!load_npy(dir + "/params/" + entry.at("file").str, &t, &err,
+                  logical)) {
       g_last_error = err;
       return nullptr;
     }

@@ -47,6 +47,42 @@ class TestCapiLenet:
                                    atol=1e-5)
 
 
+class TestCapiBf16Params:
+    def test_amp_saved_model_loads(self, tmp_path):
+        """bf16 params (AMP saves: uint16 bit-view .npy + manifest dtype)
+        must widen to f32 inside the C machine and match the executor."""
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        def build():
+            x = layers.data("x", shape=[6])
+            h = layers.fc(x, size=12, act="relu",
+                          param_attr=pt.ParamAttr(name="bw0"))
+            out = layers.fc(h, size=3, param_attr=pt.ParamAttr(name="bw1"))
+            return [x], [layers.softmax(out)]
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            feeds, targets = build()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        for name in ("bw0", "bw1"):
+            scope.set(name, jnp.asarray(
+                scope.get_numpy(name).astype(ml_dtypes.bfloat16)))
+        d = str(tmp_path / "model")
+        pt.io.save_inference_model(d, ["x"], targets, exe,
+                                   main_program=main, scope=scope)
+        x = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+        ref, = exe.run(main, feed={"x": x}, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run({"x": x})
+        np.testing.assert_allclose(got, np.asarray(ref, np.float32),
+                                   rtol=2e-2, atol=1e-3)
+
+
 class TestCapiMlp:
     def test_bn_dropout_concat_path(self, tmp_path):
         def build():

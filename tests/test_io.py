@@ -33,6 +33,28 @@ def test_save_load_persistables_roundtrip(tmp_path):
     np.testing.assert_allclose(pt.global_scope().get_numpy("w0"), w0)
 
 
+def test_save_load_bf16_roundtrip(tmp_path):
+    """bf16 (ml_dtypes) params must round-trip through save/load — numpy
+    serialises them as raw void ('|V2') unless the bit view + manifest
+    dtype is used (the r3 chip session lost all three AMP saved-model
+    inference benches to this)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    main, startup, y = _build_net()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    w0 = pt.global_scope().get_numpy("w0").astype(ml_dtypes.bfloat16)
+    pt.global_scope().set("w0", jnp.asarray(w0))
+    pio.save_persistables(exe, str(tmp_path / "ckpt"), main_program=main)
+
+    pt.global_scope().set("w0", jnp.zeros_like(pt.global_scope().get("w0")))
+    pio.load_persistables(exe, str(tmp_path / "ckpt"), main_program=main)
+    got = pt.global_scope().get_numpy("w0")
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got.view(np.uint16), w0.view(np.uint16))
+
+
 def test_program_dict_roundtrip():
     main, startup, y = _build_net()
     d = pio.program_to_dict(main)

@@ -56,8 +56,13 @@ def test_kernel_matches_reference_dots(R, I, O):
 def test_vmem_budget_fallback_decisions():
     # vocab-sized head: weight-resident footprint alone exceeds the budget
     assert lg._pick_block(16384, 1024, 16384, 2, 2, 2) == 0
-    # transformer FFN fits
-    assert lg._pick_block(16384, 1024, 4096, 2, 2, 2) > 0
+    # transformer FFN no longer fits: XLA's 16 MB scoped-vmem limit for
+    # custom calls is the binding constraint (measured on chip — a 44 MB
+    # claim is a hard compile error), so [1024, 4096]-sized weight
+    # residency (32 MB fixed) must fall back to the XLA dots
+    assert lg._pick_block(16384, 1024, 4096, 2, 2, 2) == 0
+    # qkv/out-proj-sized weights still fit
+    assert lg._pick_block(16384, 1024, 1024, 2, 2, 2) > 0
     # untileable R
     assert lg._pick_block(1000, 128, 128, 2, 2, 2) == 0
 

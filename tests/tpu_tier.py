@@ -391,6 +391,7 @@ def fused_linear_backward_trains_through_mul():
     from paddle_tpu import layers
 
     def run(flag):
+        prior = pt.flags.FLAGS.fused_linear_grad
         pt.flags.FLAGS.fused_linear_grad = flag
         try:
             main, startup = pt.Program(), pt.Program()
@@ -414,7 +415,7 @@ def fused_linear_backward_trains_through_mul():
                                   fetch_list=[loss], scope=scope)[0])
                     for _ in range(5)]
         finally:
-            pt.flags.FLAGS.fused_linear_grad = True
+            pt.flags.FLAGS.fused_linear_grad = prior
 
     fused = run(True)
     plain = run(False)

@@ -40,11 +40,24 @@ def _alarm(_sig, _frm):
     raise Timeout()
 
 
+# Set after paddle_tpu imports; every experiment re-asserts AMP because
+# two tpu_tier checks flip it off on exit (the r3 session measured every
+# post-tier experiment in f32 — a clean 2x ResNet slowdown — before this).
+_PT = None
+
+_SKIP = set(filter(None, os.environ.get("CHIP_SKIP", "").split(",")))
+
+
 def experiment(name, fn, seconds=1200):
+    if name in _SKIP:
+        print(f"skip {name} (CHIP_SKIP)", flush=True)
+        return None
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(seconds)
     t0 = time.time()
     try:
+        if _PT is not None:
+            _PT.set_amp(True)
         result = fn()
         emit({"experiment": name, "ok": True,
               "seconds": round(time.time() - t0, 1), "result": result})
@@ -98,6 +111,9 @@ def main():
     import bench
     import paddle_tpu as pt
     from paddle_tpu import layers, models
+
+    global _PT
+    _PT = pt
 
     peak = bench._peak_flops(dev.device_kind)
 
@@ -170,7 +186,10 @@ def main():
     #     compile-time and step-time cost/benefit of the stacked form.
     def lm_stacked():
         import numpy as np
-        pt.flags.FLAGS.fused_linear_grad = True
+        # fused off (loses under the 16 MB scoped-vmem limit) and remat on:
+        # the scan-over-layers body otherwise saves [L, bs, T, d]-sized
+        # activations per layer and OOMs HBM at these shapes.
+        pt.flags.FLAGS.fused_linear_grad = False
         bs, T, vocab, d, Lh = 8, 2048, 16384, 1024, 8
         main_prog, startup = pt.Program(), pt.Program()
         with pt.program_guard(main_prog, startup):
@@ -178,7 +197,7 @@ def main():
             tgt = layers.data("tgt", shape=[T], dtype="int64")
             logits = models.transformer_lm(
                 ids, vocab_size=vocab, d_model=d, n_layers=Lh, num_heads=8,
-                max_len=T, pipeline_stack=True)
+                max_len=T, pipeline_stack=True, remat=True)
             loss = layers.mean(layers.softmax_with_cross_entropy(
                 layers.reshape(logits, shape=[-1, vocab]),
                 layers.reshape(tgt, shape=[-1, 1])))
@@ -272,7 +291,7 @@ def main():
     experiment("lm_spec_decode", lm_spec_decode)
 
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
-    pt.flags.FLAGS.fused_linear_grad = True
+    pt.flags.FLAGS.fused_linear_grad = False
     experiment("lstm_varlen",
                lambda: bench.bench_lstm_varlen(jax, pt, layers))
     experiment("lstm_fixed",
@@ -290,7 +309,8 @@ def main():
     def profile_resnet():
         from paddle_tpu import profiler
         import numpy as np
-        pt.flags.FLAGS.fused_linear_grad = True
+        # the winning (unfused) config — the fused kernel lost the A/B
+        pt.flags.FLAGS.fused_linear_grad = False
         main_prog, startup = pt.Program(), pt.Program()
         with pt.program_guard(main_prog, startup):
             images = layers.data("images", shape=[224, 224, 3])
@@ -320,7 +340,7 @@ def main():
         rows = profiler.framework_op_stats(logdir, top=12)
         return rows
 
-    experiment("profile_resnet_fused", profile_resnet, seconds=1500)
+    experiment("profile_resnet_unfused", profile_resnet, seconds=1500)
     return 0
 
 
