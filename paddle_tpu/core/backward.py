@@ -361,7 +361,8 @@ def append_backward(
             return False
         opdef = get_op(op.type)
         return not (opdef.special or opdef.needs_rng
-                    or opdef.grad_fn is not None)
+                    or (opdef.grad_fn is not None
+                        and not opdef.grad_fn_is_optimization))
 
     def _diffable_input(name: str) -> bool:
         ok = (name in relevant and _is_float_var(block, name)

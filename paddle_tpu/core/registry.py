@@ -39,6 +39,12 @@ class OpDef:
     needs_rng: object = False
     # Custom vjp: grad_fn(attrs, ins, outs, out_grads) -> dict varslot->grads
     grad_fn: Optional[Callable] = None
+    # True when grad_fn is a pure HBM/FLOP optimization and vjp-of-forward
+    # is STILL mathematically valid (batch_norm/layer_norm). Such ops stay
+    # eligible for recompute segments, whose composite jax.vjp ignores
+    # grad_fn; ops whose grad_fn exists for correctness (rng, sparse
+    # grads) must keep this False so segments never swallow them.
+    grad_fn_is_optimization: bool = False
     # Ops whose semantics are stateful/structural and are handled specially by
     # the executor trace (feed/fetch/control-flow) rather than called as fns.
     special: bool = False
@@ -57,6 +63,7 @@ def register_op(
     *,
     needs_rng: bool = False,
     grad_fn: Callable = None,
+    grad_fn_is_optimization: bool = False,
     special: bool = False,
     optional_inputs=(),
     stop_gradient_inputs=(),
@@ -71,6 +78,7 @@ def register_op(
             fn=f,
             needs_rng=needs_rng,
             grad_fn=grad_fn,
+            grad_fn_is_optimization=grad_fn_is_optimization,
             special=special,
             optional_inputs=tuple(optional_inputs),
             stop_gradient_inputs=tuple(stop_gradient_inputs),

@@ -161,7 +161,84 @@ def pool2d(attrs, ins):
     return out(Out=y.astype(x.dtype))
 
 
-@register_op("batch_norm")
+def _bn_axes(fmt, ndim):
+    """(reduce axes, per-channel broadcast shape) for a BN input layout."""
+    if fmt == "NCHW" and ndim == 4:
+        return (0, 2, 3), (1, -1, 1, 1)
+    if ndim == 4:  # NHWC
+        return (0, 1, 2), (1, 1, 1, -1)
+    return (0,), (1, -1)  # 2-D [N, C]
+
+
+def _batch_norm_grad(attrs, ins, outs, ogs):
+    """Hand-written BN backward (the reference's batch_norm_grad kernel
+    formulas). The generic vjp-of-forward grad would recompute the f32-cast
+    activation and the (x - mean) products; XLA CSEs those with the forward
+    and the f32 copies then live in HBM from forward to backward — measured
+    as the dominant convert/normalize byte stream of ResNet-class training
+    (PERF.md). Here the only tensor residuals are the bf16 activation the
+    forward already keeps and the tiny per-channel stats: x-hat is
+    rebuilt in-register from them (in the distributed ``x*inv - mean*inv``
+    form, structurally different from the forward's ``(x-mean)*k`` so CSE
+    cannot pin a shared f32 intermediate), and every reduction accumulates
+    in f32 off bf16 reads."""
+    x = single(ins, "X")
+    scale = single(ins, "Scale")
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        if any(g is not None for g in ogs.get(slot, [])):
+            raise NotImplementedError(
+                "batch_norm running/saved statistics are not "
+                "differentiable (the reference marks them intermediate)")
+    dy = ogs.get("Y", [None])[0]
+    if dy is None:
+        raise NotImplementedError("batch_norm grad with no Y@GRAD")
+    fmt = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
+    axes, bshape = _bn_axes(fmt, x.ndim)
+    eps = attrs.get("epsilon", 1e-5)
+    # Saved stats when the layer wired those outputs; otherwise recompute
+    # with the forward's exact expressions so XLA CSEs them (the stats are
+    # [C]-sized — keeping them is free, recomputing them is one fused pass).
+    sm = outs.get("SavedMean", [None])[0]
+    sv = outs.get("SavedVariance", [None])[0]
+    if attrs.get("is_test", False):
+        mean = single(ins, "Mean").astype(jnp.float32)
+        inv = jax.lax.rsqrt(
+            single(ins, "Variance").astype(jnp.float32) + eps)
+    elif sm is not None and sv is not None:
+        mean = sm.astype(jnp.float32)
+        inv = sv.astype(jnp.float32)  # fwd saves 1/sqrt(var+eps)
+    else:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        bvar = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        inv = jax.lax.rsqrt(bvar + eps)
+    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) * inv.reshape(bshape)
+            - (mean * inv).reshape(bshape))
+    dbias = jnp.sum(dyf, axis=axes)
+    dscale = jnp.sum(dyf * xhat, axis=axes)
+    k = (scale.astype(jnp.float32) * inv).reshape(bshape)
+    grads = {"Scale": [dscale.astype(scale.dtype)],
+             "Bias": [dbias.astype(single(ins, "Bias").dtype)]}
+    if attrs.get("is_test", False):
+        # running stats are INPUTS here, and Y genuinely depends on them:
+        # dY/dMean = -scale*inv, dY/dVar = -(x-mean)*scale*inv^3/2
+        dx = dyf * k
+        sc = scale.astype(jnp.float32)
+        grads["Mean"] = [(-sc * inv * dbias)
+                         .astype(single(ins, "Mean").dtype)]
+        grads["Variance"] = [(-0.5 * sc * jnp.square(inv) * dscale)
+                             .astype(single(ins, "Variance").dtype)]
+    else:
+        n = x.size // scale.size
+        dx = k * (dyf - (dbias.reshape(bshape)
+                         + xhat * dscale.reshape(bshape)) / n)
+    grads["X"] = [dx.astype(x.dtype)]
+    return grads
+
+
+@register_op("batch_norm", grad_fn=_batch_norm_grad,
+             grad_fn_is_optimization=True)
 def batch_norm(attrs, ins):
     """Reference batch_norm_op.cc semantics.
 
@@ -179,15 +256,7 @@ def batch_norm(attrs, ins):
     fmt = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
     is_test = attrs.get("is_test", False)
 
-    if fmt == "NCHW" and x.ndim == 4:
-        axes = (0, 2, 3)
-        bshape = (1, -1, 1, 1)
-    elif x.ndim == 4:  # NHWC
-        axes = (0, 1, 2)
-        bshape = (1, 1, 1, -1)
-    else:  # 2-D [N, C]
-        axes = (0,)
-        bshape = (1, -1)
+    axes, bshape = _bn_axes(fmt, x.ndim)
 
     xf = x.astype(jnp.float32)
     if is_test:
@@ -214,7 +283,62 @@ def batch_norm(attrs, ins):
     }
 
 
-@register_op("layer_norm")
+def _layer_norm_grad(attrs, ins, outs, ogs):
+    """Hand-written LN backward — same byte motive as ``_batch_norm_grad``:
+    the transformer path pays two LNs per block, and the generic vjp keeps
+    an f32 cast + x-hat of every [b, T, d] activation alive across
+    forward->backward. Residuals here are the bf16 x plus the per-position
+    Mean/Variance rows the forward already emits."""
+    x = single(ins, "X")
+    scale = maybe(ins, "Scale")
+    bias = maybe(ins, "Bias")
+    if any(g is not None
+           for g in ogs.get("Mean", []) + ogs.get("Variance", [])):
+        raise NotImplementedError(
+            "layer_norm Mean/Variance outputs are not differentiable")
+    dy = ogs.get("Y", [None])[0]
+    if dy is None:
+        raise NotImplementedError("layer_norm grad with no Y@GRAD")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    kshape = x.shape[:begin] + (1,) * (x.ndim - begin)
+    m = outs.get("Mean", [None])[0]
+    v = outs.get("Variance", [None])[0]
+    if m is not None and v is not None:
+        meanb = m.astype(jnp.float32).reshape(kshape)
+        varb = v.astype(jnp.float32).reshape(kshape)
+    else:
+        # recompute with the forward's exact expressions -> CSE'd by XLA;
+        # the per-position rows are tiny next to the activation itself
+        xf = x.astype(jnp.float32)
+        meanb = jnp.mean(xf, axis=axes, keepdims=True)
+        varb = jnp.mean(jnp.square(xf - meanb), axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(varb + eps)
+    dyf = dy.astype(jnp.float32)
+    xhat = x.astype(jnp.float32) * inv - meanb * inv
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        dxhat = dyf * scale.astype(jnp.float32).reshape(
+            (1,) * begin + norm_shape)
+    else:
+        dxhat = dyf
+    m1 = jnp.mean(dxhat, axis=axes, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=axes, keepdims=True)
+    dx = inv * (dxhat - m1 - xhat * m2)
+    grads = {"X": [dx.astype(x.dtype)]}
+    batch_axes = tuple(range(begin))
+    if scale is not None:
+        grads["Scale"] = [jnp.sum(dyf * xhat, axis=batch_axes)
+                          .reshape(scale.shape).astype(scale.dtype)]
+    if bias is not None:
+        grads["Bias"] = [jnp.sum(dyf, axis=batch_axes)
+                         .reshape(bias.shape).astype(bias.dtype)]
+    return grads
+
+
+@register_op("layer_norm", grad_fn=_layer_norm_grad,
+             grad_fn_is_optimization=True)
 def layer_norm(attrs, ins):
     x = single(ins, "X")
     eps = attrs.get("epsilon", 1e-5)
