@@ -2,7 +2,7 @@
 from .io import data
 from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
                  cross_entropy, dropout, embedding, fc, layer_norm,
-                 linear_chain_crf, lrn, pool2d,
+                 linear_chain_crf, lrn, pool2d, rms_norm,
                  sigmoid_cross_entropy_with_logits, square_error_cost,
                  softmax_with_cross_entropy, topk)
 from .attention import (multi_head_attention, pipelined_transformer_stack,
@@ -32,7 +32,8 @@ from .tensor import (argmax, assign, cast, concat, create_global_var,
 
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
-     "dropout", "lrn", "cross_entropy", "softmax_with_cross_entropy",
+     "rms_norm", "dropout", "lrn", "cross_entropy",
+     "softmax_with_cross_entropy",
      "sigmoid_cross_entropy_with_logits",
      "square_error_cost", "accuracy", "topk",
      "linear_chain_crf", "crf_decoding", "chunk_eval",

@@ -102,17 +102,29 @@ def multi_head_attention(queries, keys=None, values=None, d_model=None,
 def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
                               num_kv_heads=None, use_rope=False,
                               dropout_prob=0.0, sequence_parallel=False,
-                              moe_experts=0, main_program=None,
+                              moe_experts=0, norm_type="layer_norm",
+                              main_program=None,
                               startup_program=None):
     """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x)).
     ``sequence_parallel`` routes attention through the ring kernel when the
     executor mesh has an 'sp' axis; ``moe_experts`` > 0 swaps the dense FFN
-    for a Switch MoE (returns (out, aux_loss) in that case)."""
+    for a Switch MoE (returns (out, aux_loss) in that case);
+    ``norm_type="rms_norm"`` swaps both pre-norms for RMSNorm (single
+    reduction, no shift — the modern LM convention)."""
     from . import nn as N
+
+    if norm_type not in ("layer_norm", "rms_norm"):
+        raise ValueError(f"norm_type must be 'layer_norm' or 'rms_norm', "
+                         f"got {norm_type!r}")
+
+    def pre_norm(t, **kw2):
+        if norm_type == "rms_norm":
+            return N.rms_norm(t, begin_norm_axis=2, **kw2)
+        return N.layer_norm(t, begin_norm_axis=2, **kw2)
 
     kw = dict(main_program=main_program, startup_program=startup_program)
     d_model = x.shape[-1]
-    h = N.layer_norm(x, begin_norm_axis=2, **kw)
+    h = pre_norm(x, **kw)
     h.seq_len = get_seq_len(x)
     attn = multi_head_attention(h, num_heads=num_heads, causal=causal,
                                 num_kv_heads=num_kv_heads,
@@ -120,7 +132,7 @@ def transformer_encoder_layer(x, num_heads, d_ff, causal=False,
                                 sequence_parallel=sequence_parallel, **kw)
     helper = LayerHelper("transformer", **kw)
     x = helper.simple_op("elementwise_add", {"X": [x], "Y": [attn]})
-    h2 = N.layer_norm(x, begin_norm_axis=2, **kw)
+    h2 = pre_norm(x, **kw)
     if moe_experts:
         ff, aux = switch_moe(h2, num_experts=moe_experts, d_ff=d_ff, **kw)
         o = helper.simple_op("elementwise_add", {"X": [x], "Y": [ff]})

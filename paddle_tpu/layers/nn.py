@@ -178,6 +178,30 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
     return helper.append_activation(outs["Y"][0], act)
 
 
+def rms_norm(input, scale=True, shift=False, begin_norm_axis=1,
+             epsilon=1e-6, param_attr=None, bias_attr=None, act=None,
+             main_program=None, startup_program=None):
+    """RMSNorm (beyond-reference; see ops/nn_ops.py rms_norm). Defaults
+    follow the modern LM convention: learned scale, no shift."""
+    helper = LayerHelper("rms_norm", main_program=main_program,
+                         startup_program=startup_program)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape,
+                                    dtype="float32",
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape,
+                                    dtype="float32", is_bias=True)
+        inputs["Bias"] = [b]
+    outs, _ = helper.append_op("rms_norm", inputs, ["Y", "InvRms"],
+                               {"epsilon": epsilon,
+                                "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(outs["Y"][0], act)
+
+
 def dropout(x, dropout_prob=0.5, is_test=False, main_program=None,
             startup_program=None):
     helper = LayerHelper("dropout", main_program=main_program,

@@ -16,7 +16,7 @@ from ..param_attr import ParamAttr
 
 def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                    d_ff=None, num_kv_heads=None, use_rope=False,
-                   max_len=2048,
+                   max_len=2048, norm_type="layer_norm",
                    pipeline_stack=False, n_microbatches=None, remat=False,
                    main_program=None, startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
@@ -45,6 +45,11 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
         x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
         x.seq_len = tok.seq_len
     ln_attr = ln_bias = head_attr = None
+    if norm_type != "layer_norm" and pipeline_stack:
+        raise ValueError(
+            "pipeline_stack=True supports norm_type='layer_norm' only "
+            "(the stacked-weight layout and its generation/serving "
+            "siblings share fixed LN parameter planes)")
     if pipeline_stack:
         # stable parameter names so a generation program (which rebuilds
         # these layers) shares the trained weights by name; one stacked
@@ -69,9 +74,12 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
             x = layers.transformer_encoder_layer(
                 x, num_heads=num_heads, d_ff=d_ff,
                 num_kv_heads=num_kv_heads, use_rope=use_rope, causal=True,
-                **kw)
-    x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
-                          bias_attr=ln_bias, **kw)
+                norm_type=norm_type, **kw)
+    if norm_type == "rms_norm":
+        x = layers.rms_norm(x, begin_norm_axis=2, **kw)
+    else:
+        x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
+                              bias_attr=ln_bias, **kw)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
                        param_attr=head_attr, bias_attr=False, **kw)
     return logits

@@ -184,14 +184,19 @@ def _batch_norm_grad(attrs, ins, outs, ogs):
     in f32 off bf16 reads."""
     x = single(ins, "X")
     scale = single(ins, "Scale")
-    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
-        if any(g is not None for g in ogs.get(slot, [])):
-            raise NotImplementedError(
-                "batch_norm running/saved statistics are not "
-                "differentiable (the reference marks them intermediate)")
+    # Every stat output is a plain differentiable function of the inputs:
+    # mean_out/var_out = momentum*old + (1-momentum)*batch_stat (train)
+    # or identity aliases of the running stats (test); saved_mean is the
+    # batch mean and saved_variance the batch inverse std (train) or the
+    # same aliases (test). All cotangents flow below.
+    gm = ogs.get("MeanOut", [None])[0]
+    gv = ogs.get("VarianceOut", [None])[0]
+    gsm = ogs.get("SavedMean", [None])[0]
+    gsv = ogs.get("SavedVariance", [None])[0]
     dy = ogs.get("Y", [None])[0]
-    if dy is None:
-        raise NotImplementedError("batch_norm grad with no Y@GRAD")
+    if all(g is None for g in (dy, gm, gv, gsm, gsv)):
+        raise NotImplementedError("batch_norm grad with no output grads")
+    momentum = attrs.get("momentum", 0.9)
     fmt = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
     axes, bshape = _bn_axes(fmt, x.ndim)
     eps = attrs.get("epsilon", 1e-5)
@@ -212,27 +217,67 @@ def _batch_norm_grad(attrs, ins, outs, ogs):
         mean = jnp.mean(xf, axis=axes)
         bvar = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
         inv = jax.lax.rsqrt(bvar + eps)
-    dyf = dy.astype(jnp.float32)
     xhat = (x.astype(jnp.float32) * inv.reshape(bshape)
             - (mean * inv).reshape(bshape))
-    dbias = jnp.sum(dyf, axis=axes)
-    dscale = jnp.sum(dyf * xhat, axis=axes)
+    if dy is not None:
+        dyf = dy.astype(jnp.float32)
+        dbias = jnp.sum(dyf, axis=axes)
+        dscale = jnp.sum(dyf * xhat, axis=axes)
+    else:
+        dyf = None
+        dbias = dscale = jnp.zeros(scale.shape, jnp.float32)
     k = (scale.astype(jnp.float32) * inv).reshape(bshape)
     grads = {"Scale": [dscale.astype(scale.dtype)],
              "Bias": [dbias.astype(single(ins, "Bias").dtype)]}
+    sc = scale.astype(jnp.float32)
+    dmean_in = dvar_in = None
     if attrs.get("is_test", False):
         # running stats are INPUTS here, and Y genuinely depends on them:
-        # dY/dMean = -scale*inv, dY/dVar = -(x-mean)*scale*inv^3/2
-        dx = dyf * k
-        sc = scale.astype(jnp.float32)
-        grads["Mean"] = [(-sc * inv * dbias)
-                         .astype(single(ins, "Mean").dtype)]
-        grads["Variance"] = [(-0.5 * sc * jnp.square(inv) * dscale)
-                             .astype(single(ins, "Variance").dtype)]
+        # dY/dMean = -scale*inv, dY/dVar = -(x-mean)*scale*inv^3/2;
+        # MeanOut/VarianceOut alias the inputs; SavedMean = Mean and
+        # SavedVariance = rsqrt(Variance+eps) are functions of them too
+        dx = dyf * k if dyf is not None else jnp.zeros_like(x)
+        dmean_in = -sc * inv * dbias
+        dvar_in = -0.5 * sc * jnp.square(inv) * dscale
+        if gm is not None:
+            dmean_in = dmean_in + gm.astype(jnp.float32)
+        if gv is not None:
+            dvar_in = dvar_in + gv.astype(jnp.float32)
+        if gsm is not None:
+            dmean_in = dmean_in + gsm.astype(jnp.float32)
+        if gsv is not None:
+            dvar_in = dvar_in - 0.5 * (inv ** 3) \
+                * gsv.astype(jnp.float32)
     else:
         n = x.size // scale.size
-        dx = k * (dyf - (dbias.reshape(bshape)
-                         + xhat * dscale.reshape(bshape)) / n)
+        if dyf is not None:
+            dx = k * (dyf - (dbias.reshape(bshape)
+                             + xhat * dscale.reshape(bshape)) / n)
+        else:
+            dx = jnp.zeros(x.shape, jnp.float32)
+        # mean_out/var_out = momentum*old + (1-momentum)*batch_stat:
+        # batch_mean -> x jacobian is 1/n; batch_var -> x is 2(x-mean)/n;
+        # saved_mean = batch_mean, saved_variance = rsqrt(batch_var+eps)
+        if gm is not None:
+            gmf = gm.astype(jnp.float32)
+            dx = dx + ((1.0 - momentum) / n) * gmf.reshape(bshape)
+            dmean_in = momentum * gmf
+        if gv is not None:
+            gvf = gv.astype(jnp.float32)
+            dx = dx + ((1.0 - momentum) * 2.0 / n) * gvf.reshape(bshape) \
+                * (xhat / inv.reshape(bshape))
+            dvar_in = momentum * gvf
+        if gsm is not None:
+            dx = dx + gsm.astype(jnp.float32).reshape(bshape) / n
+        if gsv is not None:
+            dx = dx - ((inv ** 3).reshape(bshape) / n) \
+                * gsv.astype(jnp.float32).reshape(bshape) \
+                * (xhat / inv.reshape(bshape))
+    if dmean_in is not None:
+        grads["Mean"] = [dmean_in.astype(single(ins, "Mean").dtype)]
+    if dvar_in is not None:
+        grads["Variance"] = [dvar_in
+                             .astype(single(ins, "Variance").dtype)]
     grads["X"] = [dx.astype(x.dtype)]
     return grads
 
@@ -292,13 +337,11 @@ def _layer_norm_grad(attrs, ins, outs, ogs):
     x = single(ins, "X")
     scale = maybe(ins, "Scale")
     bias = maybe(ins, "Bias")
-    if any(g is not None
-           for g in ogs.get("Mean", []) + ogs.get("Variance", [])):
-        raise NotImplementedError(
-            "layer_norm Mean/Variance outputs are not differentiable")
+    gmean = ogs.get("Mean", [None])[0]
+    gvar = ogs.get("Variance", [None])[0]
     dy = ogs.get("Y", [None])[0]
-    if dy is None:
-        raise NotImplementedError("layer_norm grad with no Y@GRAD")
+    if dy is None and gmean is None and gvar is None:
+        raise NotImplementedError("layer_norm grad with no output grads")
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
@@ -315,25 +358,37 @@ def _layer_norm_grad(attrs, ins, outs, ogs):
         meanb = jnp.mean(xf, axis=axes, keepdims=True)
         varb = jnp.mean(jnp.square(xf - meanb), axis=axes, keepdims=True)
     inv = jax.lax.rsqrt(varb + eps)
-    dyf = dy.astype(jnp.float32)
     xhat = x.astype(jnp.float32) * inv - meanb * inv
     norm_shape = x.shape[begin:]
-    if scale is not None:
-        dxhat = dyf * scale.astype(jnp.float32).reshape(
-            (1,) * begin + norm_shape)
+    nn = int(np.prod(norm_shape))
+    grads = {}
+    if dy is not None:
+        dyf = dy.astype(jnp.float32)
+        if scale is not None:
+            dxhat = dyf * scale.astype(jnp.float32).reshape(
+                (1,) * begin + norm_shape)
+        else:
+            dxhat = dyf
+        m1 = jnp.mean(dxhat, axis=axes, keepdims=True)
+        m2 = jnp.mean(dxhat * xhat, axis=axes, keepdims=True)
+        dx = inv * (dxhat - m1 - xhat * m2)
+        batch_axes = tuple(range(begin))
+        if scale is not None:
+            grads["Scale"] = [jnp.sum(dyf * xhat, axis=batch_axes)
+                              .reshape(scale.shape).astype(scale.dtype)]
+        if bias is not None:
+            grads["Bias"] = [jnp.sum(dyf, axis=batch_axes)
+                             .reshape(bias.shape).astype(bias.dtype)]
     else:
-        dxhat = dyf
-    m1 = jnp.mean(dxhat, axis=axes, keepdims=True)
-    m2 = jnp.mean(dxhat * xhat, axis=axes, keepdims=True)
-    dx = inv * (dxhat - m1 - xhat * m2)
-    grads = {"X": [dx.astype(x.dtype)]}
-    batch_axes = tuple(range(begin))
-    if scale is not None:
-        grads["Scale"] = [jnp.sum(dyf * xhat, axis=batch_axes)
-                          .reshape(scale.shape).astype(scale.dtype)]
-    if bias is not None:
-        grads["Bias"] = [jnp.sum(dyf, axis=batch_axes)
-                         .reshape(bias.shape).astype(bias.dtype)]
+        dx = jnp.zeros(x.shape, jnp.float32)
+    # Mean/Variance OUTPUTS are plain differentiable functions of X:
+    # d mean/dx = 1/n, d var/dx = 2(x-mean)/n (the dm/dx terms cancel).
+    if gmean is not None:
+        dx = dx + gmean.astype(jnp.float32).reshape(kshape) / nn
+    if gvar is not None:
+        dx = dx + gvar.astype(jnp.float32).reshape(kshape) \
+            * (2.0 / nn) * (xhat / inv)
+    grads["X"] = [dx.astype(x.dtype)]
     return grads
 
 
@@ -361,6 +416,85 @@ def layer_norm(attrs, ins):
         "Mean": [mean.reshape(x.shape[:begin])],
         "Variance": [var.reshape(x.shape[:begin])],
     }
+
+
+def _rms_norm_grad(attrs, ins, outs, ogs):
+    """Hand-written RMSNorm backward (same byte policy as the BN/LN
+    grads: bf16 residuals only, f32 reduction accumulation, x-hat
+    rebuilt in-register). dx = inv*(dxhat - xhat*mean(dxhat*xhat))."""
+    x = single(ins, "X")
+    scale = maybe(ins, "Scale")
+    bias = maybe(ins, "Bias")
+    ginv = ogs.get("InvRms", [None])[0]
+    dy = ogs.get("Y", [None])[0]
+    if dy is None and ginv is None:
+        raise NotImplementedError("rms_norm grad with no output grads")
+    eps = attrs.get("epsilon", 1e-6)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    kshape = x.shape[:begin] + (1,) * (x.ndim - begin)
+    iv = outs.get("InvRms", [None])[0]
+    if iv is not None:
+        inv = iv.astype(jnp.float32).reshape(kshape)
+    else:
+        xf = x.astype(jnp.float32)
+        inv = jax.lax.rsqrt(
+            jnp.mean(jnp.square(xf), axis=axes, keepdims=True) + eps)
+    xhat = x.astype(jnp.float32) * inv
+    norm_shape = x.shape[begin:]
+    grads = {}
+    if dy is not None:
+        dyf = dy.astype(jnp.float32)
+        if scale is not None:
+            dxhat = dyf * scale.astype(jnp.float32).reshape(
+                (1,) * begin + norm_shape)
+        else:
+            dxhat = dyf
+        m = jnp.mean(dxhat * xhat, axis=axes, keepdims=True)
+        dx = inv * (dxhat - xhat * m)
+        batch_axes = tuple(range(begin))
+        if scale is not None:
+            grads["Scale"] = [jnp.sum(dyf * xhat, axis=batch_axes)
+                              .reshape(scale.shape).astype(scale.dtype)]
+        if bias is not None:
+            grads["Bias"] = [jnp.sum(dyf, axis=batch_axes)
+                             .reshape(bias.shape).astype(bias.dtype)]
+    else:
+        dx = jnp.zeros(x.shape, jnp.float32)
+    # InvRms is differentiable too: d inv/dx = -inv^3 * x / n
+    if ginv is not None:
+        nn = int(np.prod(norm_shape))
+        dx = dx + ginv.astype(jnp.float32).reshape(kshape) \
+            * (-(inv ** 3)) * x.astype(jnp.float32) / nn
+    grads["X"] = [dx.astype(x.dtype)]
+    return grads
+
+
+@register_op("rms_norm", grad_fn=_rms_norm_grad,
+             grad_fn_is_optimization=True,
+             optional_inputs=("Scale", "Bias"))
+def rms_norm(attrs, ins):
+    """Root-mean-square normalization (beyond-reference: the reference
+    predates RMSNorm; modern LM stacks default to it). TPU-friendlier
+    than layer_norm — ONE reduction per row, no mean subtraction:
+    y = x * rsqrt(mean(x^2) + eps) * scale (+ bias)."""
+    x = single(ins, "X")
+    eps = attrs.get("epsilon", 1e-6)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(
+        jnp.mean(jnp.square(xf), axis=axes, keepdims=True) + eps)
+    y = xf * inv
+    scale = maybe(ins, "Scale")
+    bias = maybe(ins, "Bias")
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * begin + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * begin + norm_shape)
+    return {"Y": [y.astype(x.dtype)],
+            "InvRms": [inv.reshape(x.shape[:begin])]}
 
 
 @register_op("lrn")

@@ -82,6 +82,10 @@ def adam(attrs, ins):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    # Decoupled weight decay (AdamW, beyond-reference): p -= lr*wd*p
+    # OUTSIDE the moment stream — distinct from L2 regularization, which
+    # flows through the gradients (regularizer.py).
+    wd = attrs.get("weight_decay", 0.0)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     if isinstance(g, SelectedRows):
         # Lazy Adam (the reference adam_op's SelectedRows kernel semantics):
@@ -91,6 +95,9 @@ def adam(attrs, ins):
         m1_rows = b1 * m1[m.rows] + (1 - b1) * gv
         m2_rows = b2 * m2[m.rows] + (1 - b2) * jnp.square(gv)
         step = (lr_t * m1_rows / (jnp.sqrt(m2_rows) + eps)).astype(p.dtype)
+        if wd:
+            # lazy semantics: decay only the touched rows
+            step = step + (lr * wd * p[m.rows]).astype(p.dtype)
         return {
             "ParamOut": [p.at[m.rows].add(-step, mode="drop")],
             "Moment1Out": [m1.at[m.rows].set(m1_rows, mode="drop")],
@@ -102,6 +109,8 @@ def adam(attrs, ins):
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
     p_out = p - (lr_t * m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
+    if wd:
+        p_out = p_out - (lr * wd * p).astype(p.dtype)
     return {
         "ParamOut": [p_out],
         "Moment1Out": [m1_out],

@@ -295,6 +295,7 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, **kw):
         super().__init__(learning_rate, **kw)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.weight_decay = 0.0  # AdamWOptimizer overrides
 
     def _create_accumulators(self, startup, params):
         for p in params:
@@ -319,7 +320,22 @@ class AdamOptimizer(Optimizer):
                      "Beta1PowOut": [self._get_accumulator("beta1_pow", p).name],
                      "Beta2PowOut": [self._get_accumulator("beta2_pow", p).name]},
             attrs={"beta1": self.beta1, "beta2": self.beta2,
-                   "epsilon": self.epsilon})
+                   "epsilon": self.epsilon,
+                   "weight_decay": self.weight_decay})
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """Adam with DECOUPLED weight decay (beyond-reference: the modern LM
+    training default). Decay applies directly to the parameter
+    (p -= lr*wd*p), outside the moment estimates — unlike
+    ``regularization=L2Decay(...)``, which adds wd*p into the gradient
+    and therefore into the Adam moments."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self.weight_decay = weight_decay
 
 
 class AdamaxOptimizer(Optimizer):
@@ -481,6 +497,7 @@ class FtrlOptimizer(Optimizer):
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adam = AdamOptimizer
+AdamW = AdamWOptimizer
 Adamax = AdamaxOptimizer
 Adagrad = AdagradOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer

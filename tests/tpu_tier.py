@@ -460,6 +460,75 @@ def flash_attention_d128_matches_reference():
     return f"fwd err {err_f:.1e}"
 
 
+@check
+def norm_backward_matches_generic_vjp():
+    """The hand-written batch_norm/layer_norm/rms_norm backwards
+    (ops/nn_ops.py, the HBM byte cut) vs the generic vjp-of-forward they
+    replace — ON CHIP under AMP bf16, through the executor surface. The
+    CPU parity tests (tests/test_norm_grads.py) pin f32 math; this pins
+    the bf16 MXU dtype policy the sessions bench."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.registry import get_op
+
+    prior_amp = pt.amp_enabled()
+    saved = {}
+
+    def run(generic):
+        if generic:
+            for name in ("batch_norm", "layer_norm", "rms_norm"):
+                od = get_op(name)
+                saved[name] = od.grad_fn
+                od.grad_fn = None
+        try:
+            pt.set_amp(True)
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[12, 10, 6])
+                x.stop_gradient = False
+                h = layers.conv2d(x, num_filters=8, filter_size=3,
+                                  padding=1, data_format="NHWC",
+                                  param_attr=pt.ParamAttr(name="tcw"),
+                                  bias_attr=False)
+                h = layers.batch_norm(h, data_layout="NHWC", act="relu",
+                                      param_attr=pt.ParamAttr(name="tbs"),
+                                      bias_attr=pt.ParamAttr(name="tbb"))
+                h = layers.reshape(h, shape=[-1, 12 * 10 * 8])
+                h = layers.layer_norm(h, begin_norm_axis=1,
+                                      param_attr=pt.ParamAttr(name="tls"),
+                                      bias_attr=pt.ParamAttr(name="tlb"))
+                h = layers.rms_norm(h, begin_norm_axis=1,
+                                    param_attr=pt.ParamAttr(name="trs"))
+                loss = layers.mean(layers.square(h))
+                pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(
+                    loss, startup_program=startup)
+            exe, scope = _executor_pair()
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(13)
+            feed = {"x": rng.rand(8, 12, 10, 6).astype("float32")}
+            fetch = ["x@GRAD", "tcw@GRAD", "tbs@GRAD", "tbb@GRAD",
+                     "tls@GRAD", "tlb@GRAD", "trs@GRAD"]
+            outs = exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+            return {n: np.asarray(o, dtype=np.float32)
+                    for n, o in zip(fetch, outs)}
+        finally:
+            for name, g in saved.items():
+                get_op(name).grad_fn = g
+            saved.clear()
+            pt.set_amp(prior_amp)
+
+    custom = run(False)
+    generic = run(True)
+    worst = 0.0
+    for n in custom:
+        a, b = custom[n], generic[n]
+        scale = max(np.abs(b).max(), 1e-3)
+        err = np.abs(a - b).max() / scale
+        assert err < 3e-2, (n, err)
+        worst = max(worst, err)
+    return f"worst rel err {worst:.1e}"
+
+
 def main():
     failures = 0
     for fn in CHECKS:

@@ -47,11 +47,15 @@ _PT = None
 
 _SKIP = set(filter(None, os.environ.get("CHIP_SKIP", "").split(",")))
 
+# experiment() returns this for a CHIP_SKIP skip so callers' None-checks
+# (fallback experiments) don't fire on an operator-requested skip.
+SKIPPED = object()
+
 
 def experiment(name, fn, seconds=1200):
     if name in _SKIP:
         print(f"skip {name} (CHIP_SKIP)", flush=True)
-        return None
+        return SKIPPED
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(seconds)
     t0 = time.time()
@@ -74,10 +78,12 @@ def experiment(name, fn, seconds=1200):
     return None
 
 
-def main():
-    # A downed tunnel HANGS backend init in uninterruptible C code (the
-    # xla_env notes; SIGALRM cannot fire mid-call), so probe the backend
-    # in a disposable child first, with a hard subprocess timeout.
+def probe_tpu(session=None):
+    """Shared session preamble: probe the TPU backend in a disposable
+    child first — a downed tunnel HANGS backend init in uninterruptible
+    C code (the xla_env notes; SIGALRM cannot fire mid-call) — then emit
+    the probe row. Returns the jax module on success, None on failure
+    (caller should exit nonzero)."""
     import subprocess
 
     detail = ""
@@ -98,15 +104,98 @@ def main():
         emit({"experiment": "probe", "ok": False,
               "error": f"no TPU backend (probe got {platform!r}; "
                        f"tunnel down or hung){detail}"[:500]})
-        return 1
+        return None
 
     import jax
 
     dev = jax.devices()[0]
+    result = {"platform": dev.platform, "kind": dev.device_kind}
+    if session:
+        result["session"] = session
     emit({"experiment": "probe", "ok": dev.platform != "cpu",
-          "result": {"platform": dev.platform, "kind": dev.device_kind}})
-    if dev.platform == "cpu":
+          "result": result})
+    return None if dev.platform == "cpu" else jax
+
+
+def build_resnet50_train(pt, layers, models):
+    """The canonical ResNet-50 bs256 A/B program (one definition so the
+    A and B sides of every session measure the same graph)."""
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        images = layers.data("images", shape=[224, 224, 3])
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = models.resnet_imagenet(images, num_classes=1000,
+                                        depth=50)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    return main_prog, startup, loss
+
+
+def resnet50_bs256_step(jax, pt, layers, models, bench, peak,
+                        batch=256, steps=20, extra=None):
+    """Measure the canonical ResNet-50 bs256 train step (img/s, ms, MFU).
+    ONE definition of the timing + MFU math so every session's A and B
+    sides are comparable."""
+    import numpy as np
+
+    main_prog, startup, loss = build_resnet50_train(pt, layers, models)
+    rng = np.random.RandomState(0)
+    feed = {"images": rng.rand(batch, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+    sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
+                                  feed, warmup=3, steps=steps)
+    flops = bench.RESNET50_TRAIN_FLOPS_224
+    out = {"img_per_sec": round(batch / sec, 1),
+           "ms_per_step": round(sec * 1e3, 2),
+           "mfu": round(flops * batch / sec / peak, 4) if peak else None}
+    out.update(extra or {})
+    return out
+
+
+def transformer_lm_step(jax, pt, layers, models, bench, peak,
+                        bs=8, d=1024, H=8, L=8, extra=None):
+    """Measure the canonical transformer LM train step (tokens/s, MFU).
+    ONE definition of the probe schema so journal rows from different
+    sessions stay comparable."""
+    tok_s, flops_s = bench.bench_transformer_step(
+        jax, pt, layers, models, bs=bs, d=d, H=H, L=L)
+    out = {"tokens_per_sec": round(tok_s),
+           "mfu": round(flops_s / peak, 4) if peak else None,
+           "d_model": d, "d_head": d // H, "bs": bs}
+    out.update(extra or {})
+    return out
+
+
+def resnet50_profile(pt, layers, models, logdir):
+    """Per-op xprof profile of the canonical ResNet-50 bs256 train step."""
+    import numpy as np
+
+    from paddle_tpu import profiler
+
+    main_prog, startup, loss = build_resnet50_train(pt, layers, models)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
+            "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
+    for _ in range(3):
+        exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+    with profiler.xprof_trace(logdir):
+        for _ in range(5):
+            o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                         scope=scope, return_numpy=False)
+        np.asarray(o)
+    return profiler.framework_op_stats(logdir, top=12)
+
+
+def main():
+    if probe_tpu() is None:
         return 1
+    import jax
 
     import bench
     import paddle_tpu as pt
@@ -115,6 +204,7 @@ def main():
     global _PT
     _PT = pt
 
+    dev = jax.devices()[0]
     peak = bench._peak_flops(dev.device_kind)
 
     def mfu(flops_per_sec):
@@ -138,31 +228,11 @@ def main():
     experiment("tpu_tier", run_tier, seconds=1500)
 
     # 2. ResNet-50 bs256 A/B over the fused linear backward.
-    flops_img = bench.RESNET50_TRAIN_FLOPS_224
-
     def resnet_step(fused, batch=256, steps=20):
         pt.flags.FLAGS.fused_linear_grad = fused
-        import numpy as np
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(batch, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
-        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
-                                      feed, warmup=3, steps=steps)
-        return {"img_per_sec": round(batch / sec, 1),
-                "ms_per_step": round(sec * 1e3, 2),
-                "mfu": mfu(flops_img * batch / sec),
-                "fused_linear_grad": fused}
+        return resnet50_bs256_step(jax, pt, layers, models, bench, peak,
+                                   batch=batch, steps=steps,
+                                   extra={"fused_linear_grad": fused})
 
     experiment("resnet50_bs256_fused_off", lambda: resnet_step(False))
     experiment("resnet50_bs256_fused_on", lambda: resnet_step(True))
@@ -171,11 +241,9 @@ def main():
     #    fused backward on/off. H8+fused is the headline candidate.
     def lm(heads, fused):
         pt.flags.FLAGS.fused_linear_grad = fused
-        tok_s, flops_s = bench.bench_transformer_step(
-            jax, pt, layers, models, H=heads)
-        return {"tokens_per_sec": round(tok_s),
-                "mfu": mfu(flops_s),
-                "d_head": 1024 // heads, "fused_linear_grad": fused}
+        return transformer_lm_step(
+            jax, pt, layers, models, bench, peak, d=1024, H=heads,
+            extra={"fused_linear_grad": fused})
 
     experiment("lm_h8_fused_on", lambda: lm(8, True))
     experiment("lm_h8_fused_off", lambda: lm(8, False))
@@ -307,38 +375,10 @@ def main():
 
     # 6. Per-op profile of the winning ResNet config.
     def profile_resnet():
-        from paddle_tpu import profiler
-        import numpy as np
         # the winning (unfused) config — the fused kernel lost the A/B
         pt.flags.FLAGS.fused_linear_grad = False
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        scope = pt.Scope()
-        exe = pt.Executor(pt.TPUPlace())
-        exe.run(startup, scope=scope)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
-        logdir = "/tmp/chip_session_trace"
-        with profiler.xprof_trace(logdir):
-            for _ in range(5):
-                o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                             scope=scope, return_numpy=False)
-            import numpy as _np
-            _np.asarray(o)
-        rows = profiler.framework_op_stats(logdir, top=12)
-        return rows
+        return resnet50_profile(pt, layers, models,
+                                "/tmp/chip_session_trace")
 
     experiment("profile_resnet_unfused", profile_resnet, seconds=1500)
     return 0

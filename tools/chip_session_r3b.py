@@ -17,7 +17,6 @@ tunnel tolerates one attached process).
 Usage: python tools/chip_session_r3b.py   (tunnel env already in shell)
 """
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -28,35 +27,8 @@ import chip_session as cs  # noqa: E402  (journal + watchdog scaffolding)
 
 
 def main():
-    # Probe the backend in a disposable child first: a downed tunnel hangs
-    # backend init in uninterruptible C code (xla_env notes).
-    detail = ""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180)
-        platform = (probe.stdout or "").strip().splitlines()[-1] \
-            if probe.returncode == 0 and probe.stdout.strip() else None
-        if platform is None:
-            tail = (probe.stderr or "").strip().splitlines()[-3:]
-            detail = f" rc={probe.returncode}: " + " | ".join(tail)
-    except subprocess.TimeoutExpired:
-        platform = None
-        detail = " (probe timed out after 180s)"
-    if platform is None or platform == "cpu":
-        cs.emit({"experiment": "probe", "ok": False,
-                 "error": f"no TPU backend (probe got {platform!r}; "
-                          f"tunnel down or hung){detail}"[:500]})
-        return 1
-
-    import jax
-
-    dev = jax.devices()[0]
-    cs.emit({"experiment": "probe", "ok": dev.platform != "cpu",
-             "result": {"platform": dev.platform, "kind": dev.device_kind,
-                        "session": "r3b"}})
-    if dev.platform == "cpu":
+    jax = cs.probe_tpu('r3b')
+    if jax is None:
         return 1
 
     import bench
@@ -64,7 +36,7 @@ def main():
     from paddle_tpu import layers, models
 
     cs._PT = pt
-    peak = bench._peak_flops(dev.device_kind)
+    peak = bench._peak_flops(jax.devices()[0].device_kind)
     pt.set_amp(True)
 
     # 1. The three bf16 saved-model inference rows (BASELINE.md "Infer
@@ -78,11 +50,8 @@ def main():
     # 2. Transformer MFU candidates, fused backward off (won the bs8 A/B).
     def lm(bs, d=1024, H=8, L=8):
         pt.flags.FLAGS.fused_linear_grad = False
-        tok_s, flops_s = bench.bench_transformer_step(
-            jax, pt, layers, models, bs=bs, d=d, H=H, L=L)
-        return {"tokens_per_sec": round(tok_s),
-                "mfu": round(flops_s / peak, 4) if peak else None,
-                "d_model": d, "d_head": d // H, "bs": bs}
+        return cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                      peak, bs=bs, d=d, H=H, L=L)
 
     r16 = cs.experiment("lm_h8_bs16", lambda: lm(16), seconds=600)
     if r16 is None:
@@ -96,37 +65,9 @@ def main():
 
     # 3. Per-op profile of the winning (unfused) ResNet config.
     def profile_resnet():
-        import numpy as np
-
-        from paddle_tpu import profiler
-
         pt.flags.FLAGS.fused_linear_grad = False
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        scope = pt.Scope()
-        exe = pt.Executor(pt.TPUPlace())
-        exe.run(startup, scope=scope)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
-        logdir = "/tmp/chip_session_trace_r3b"
-        with profiler.xprof_trace(logdir):
-            for _ in range(5):
-                o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                             scope=scope, return_numpy=False)
-            np.asarray(o)
-        return profiler.framework_op_stats(logdir, top=12)
+        return cs.resnet50_profile(pt, layers, models,
+                                   "/tmp/chip_session_trace_r3b")
 
     cs.experiment("profile_resnet_unfused", profile_resnet, seconds=1500)
     return 0

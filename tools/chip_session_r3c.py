@@ -8,7 +8,6 @@ unfused) and the transformer LM (two LNs/block; d1024 at 48.1%, d2048 at
 Usage: python tools/chip_session_r3c.py
 """
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -19,116 +18,42 @@ import chip_session as cs  # noqa: E402
 
 
 def main():
-    detail = ""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180)
-        platform = (probe.stdout or "").strip().splitlines()[-1] \
-            if probe.returncode == 0 and probe.stdout.strip() else None
-        if platform is None:
-            tail = (probe.stderr or "").strip().splitlines()[-3:]
-            detail = f" rc={probe.returncode}: " + " | ".join(tail)
-    except subprocess.TimeoutExpired:
-        platform = None
-        detail = " (probe timed out after 180s)"
-    if platform is None or platform == "cpu":
-        cs.emit({"experiment": "probe", "ok": False,
-                 "error": f"no TPU backend (probe got {platform!r}; "
-                          f"tunnel down or hung){detail}"[:500]})
+    jax = cs.probe_tpu('r3c: custom norm backward')
+    if jax is None:
         return 1
-
-    import jax
-
-    dev = jax.devices()[0]
-    cs.emit({"experiment": "probe", "ok": dev.platform != "cpu",
-             "result": {"platform": dev.platform, "kind": dev.device_kind,
-                        "session": "r3c: custom norm backward"}})
-    if dev.platform == "cpu":
-        return 1
-
-    import numpy as np
 
     import bench
     import paddle_tpu as pt
     from paddle_tpu import layers, models
 
     cs._PT = pt
-    peak = bench._peak_flops(dev.device_kind)
+    peak = bench._peak_flops(jax.devices()[0].device_kind)
     pt.set_amp(True)
     pt.flags.FLAGS.fused_linear_grad = False
 
     def lm(bs, d=1024, H=8):
-        tok_s, flops_s = bench.bench_transformer_step(
-            jax, pt, layers, models, bs=bs, d=d, H=H)
-        return {"tokens_per_sec": round(tok_s),
-                "mfu": round(flops_s / peak, 4) if peak else None,
-                "d_model": d, "bs": bs, "norm_grad": "custom"}
+        return cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                      peak, bs=bs, d=d, H=H,
+                                      extra={"norm_grad": "custom"})
 
     cs.experiment("lm_h8_customln", lambda: lm(8), seconds=600)
     cs.experiment("lm_d2048_customln", lambda: lm(8, d=2048, H=16),
                   seconds=700)
 
-    def resnet_step(batch=256, steps=20):
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(batch, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
-        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
-                                      feed, warmup=3, steps=steps)
-        flops = bench.RESNET50_TRAIN_FLOPS_224
-        return {"img_per_sec": round(batch / sec, 1),
-                "ms_per_step": round(sec * 1e3, 2),
-                "mfu": round(flops * batch / sec / peak, 4) if peak
-                else None,
-                "norm_grad": "custom"}
-
-    cs.experiment("resnet50_bs256_custombn", resnet_step, seconds=900)
+    cs.experiment(
+        "resnet50_bs256_custombn",
+        lambda: cs.resnet50_bs256_step(jax, pt, layers, models, bench,
+                                       peak,
+                                       extra={"norm_grad": "custom"}),
+        seconds=900)
 
     # Per-op profile with the custom BN backward: did the convert /
     # normalize byte streams actually shrink?
-    def profile_resnet():
-        from paddle_tpu import profiler
-
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        scope = pt.Scope()
-        exe = pt.Executor(pt.TPUPlace())
-        exe.run(startup, scope=scope)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
-        logdir = "/tmp/chip_session_trace_r3c"
-        with profiler.xprof_trace(logdir):
-            for _ in range(5):
-                o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                             scope=scope, return_numpy=False)
-            np.asarray(o)
-        return profiler.framework_op_stats(logdir, top=12)
-
-    cs.experiment("profile_resnet_custombn", profile_resnet, seconds=1500)
+    cs.experiment(
+        "profile_resnet_custombn",
+        lambda: cs.resnet50_profile(pt, layers, models,
+                                    "/tmp/chip_session_trace_r3c"),
+        seconds=1500)
     return 0
 
 

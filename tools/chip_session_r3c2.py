@@ -1,10 +1,10 @@
 """r3c2: the tail of the r3c A/B that the tunnel drop cost — ResNet-50
-bs256 with the custom batch_norm backward, plus its per-op profile.
-(The LM rows already landed: d1024 48.1%->49.2%, d2048 55.8%->55.9%,
-CHIP_SESSION_r3.jsonl.) Run by tools/tunnel_watch.sh when the tunnel
-returns. Reuses tools/chip_session scaffolding."""
+bs256 with the custom batch_norm backward, plus its per-op profile and
+two wide-grid transformer MFU probes. (The LM custom-LN rows already
+landed: d1024 48.1%->49.2%, d2048 55.8%->55.9%, CHIP_SESSION_r3.jsonl.)
+Run by tools/tunnel_watch.sh when the tunnel returns. Uses the shared
+tools/chip_session scaffolding (journal, watchdog, probe, builders)."""
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -15,89 +15,53 @@ import chip_session as cs  # noqa: E402
 
 
 def main():
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180)
-        platform = (probe.stdout or "").strip().splitlines()[-1] \
-            if probe.returncode == 0 and probe.stdout.strip() else None
-    except subprocess.TimeoutExpired:
-        platform = None
-    if platform is None or platform == "cpu":
-        cs.emit({"experiment": "probe", "ok": False,
-                 "error": f"r3c2: no TPU backend (got {platform!r})"})
+    jax = cs.probe_tpu("r3c2: ResNet custom-BN A/B")
+    if jax is None:
         return 1
-
-    import jax
-
-    dev = jax.devices()[0]
-    cs.emit({"experiment": "probe", "ok": True,
-             "result": {"platform": dev.platform, "kind": dev.device_kind,
-                        "session": "r3c2: ResNet custom-BN A/B"}})
-
-    import numpy as np
 
     import bench
     import paddle_tpu as pt
     from paddle_tpu import layers, models
 
     cs._PT = pt
-    peak = bench._peak_flops(dev.device_kind)
+    peak = bench._peak_flops(jax.devices()[0].device_kind)
     pt.set_amp(True)
     pt.flags.FLAGS.fused_linear_grad = False
 
-    def build():
-        main_prog, startup = pt.Program(), pt.Program()
-        with pt.program_guard(main_prog, startup):
-            images = layers.data("images", shape=[224, 224, 3])
-            label = layers.data("label", shape=[1], dtype="int64")
-            logits = models.resnet_imagenet(images, num_classes=1000,
-                                            depth=50)
-            loss = layers.mean(
-                layers.softmax_with_cross_entropy(logits, label))
-            pt.optimizer.MomentumOptimizer(
-                learning_rate=0.1, momentum=0.9).minimize(
-                loss, startup_program=startup)
-        return main_prog, startup, loss
+    # On-chip correctness first: the custom norm backwards vs generic
+    # vjp under bf16 (the new tier check, run standalone to keep this
+    # session short).
+    def norm_check():
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        import tpu_tier
 
-    def resnet_step(batch=256, steps=20):
-        main_prog, startup, loss = build()
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(batch, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
-        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
-                                      feed, warmup=3, steps=steps)
-        flops = bench.RESNET50_TRAIN_FLOPS_224
-        return {"img_per_sec": round(batch / sec, 1),
-                "ms_per_step": round(sec * 1e3, 2),
-                "mfu": round(flops * batch / sec / peak, 4) if peak
-                else None,
-                "norm_grad": "custom"}
+        return {"detail": tpu_tier.norm_backward_matches_generic_vjp()}
 
-    cs.experiment("resnet50_bs256_custombn", resnet_step, seconds=900)
+    cs.experiment("tier_norm_backward_parity", norm_check, seconds=600)
 
-    def profile_resnet():
-        from paddle_tpu import profiler
+    cs.experiment(
+        "resnet50_bs256_custombn",
+        lambda: cs.resnet50_bs256_step(jax, pt, layers, models, bench,
+                                       peak,
+                                       extra={"norm_grad": "custom"}),
+        seconds=900)
 
-        main_prog, startup, loss = build()
-        scope = pt.Scope()
-        exe = pt.Executor(pt.TPUPlace())
-        exe.run(startup, scope=scope)
-        rng = np.random.RandomState(0)
-        feed = {"images": rng.rand(256, 224, 224, 3).astype("float32"),
-                "label": rng.randint(0, 1000, (256, 1)).astype("int64")}
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
-        logdir = "/tmp/chip_session_trace_r3c2"
-        with profiler.xprof_trace(logdir):
-            for _ in range(5):
-                o, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                             scope=scope, return_numpy=False)
-            np.asarray(o)
-        return profiler.framework_op_stats(logdir, top=12)
+    # Wide-grid MFU probes past the 55.9% d2048 row: more tokens per step
+    # at d2048, and a d3072 config (d_head 128 via H24) — both keep the
+    # MXU-native head width and fatten the FFN contractions further.
+    def lm(bs, d, H):
+        return cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                      peak, bs=bs, d=d, H=H,
+                                      extra={"norm_grad": "custom"})
 
-    cs.experiment("profile_resnet_custombn", profile_resnet, seconds=1200)
+    cs.experiment("lm_d2048_bs16", lambda: lm(16, 2048, 16), seconds=700)
+    cs.experiment("lm_d3072_bs4", lambda: lm(4, 3072, 24), seconds=700)
+
+    cs.experiment(
+        "profile_resnet_custombn",
+        lambda: cs.resnet50_profile(pt, layers, models,
+                                    "/tmp/chip_session_trace_r3c2"),
+        seconds=1200)
     return 0
 
 
