@@ -131,7 +131,7 @@ def transformer_train_flops(bs, T, d, n_layers, vocab, d_ff=None):
 
 def bench_transformer_step(jax, pt, layers, models,
                            bs=8, T=2048, vocab=16384, d=1024, L=8, H=8,
-                           steps=10):
+                           steps=10, fused_head=False):
     """Secondary metric: GPT-style LM train step in tokens/sec AND MFU —
     the compute-dense path where the >=50% MFU target lives (flash
     attention fwd+bwd in Pallas, fused qkv, fused matmul backward;
@@ -144,11 +144,22 @@ def bench_transformer_step(jax, pt, layers, models,
     with pt.program_guard(main_prog, startup):
         ids = layers.data("ids", shape=[T], dtype="int64")
         tgt = layers.data("tgt", shape=[T], dtype="int64")
-        logits = models.transformer_lm(ids, vocab_size=vocab, d_model=d,
-                                       n_layers=L, num_heads=H, max_len=T)
-        loss = layers.mean(layers.softmax_with_cross_entropy(
-            layers.reshape(logits, shape=[-1, vocab]),
-            layers.reshape(tgt, shape=[-1, 1])))
+        if fused_head:
+            # chunked head+loss: the [tokens, vocab] logits never
+            # materialize (layers.fused_head_cross_entropy)
+            h = models.transformer_lm(ids, vocab_size=vocab, d_model=d,
+                                      n_layers=L, num_heads=H, max_len=T,
+                                      include_head=False)
+            loss = layers.mean(layers.fused_head_cross_entropy(
+                h, layers.reshape(tgt, shape=[-1, T, 1]),
+                num_classes=vocab))
+        else:
+            logits = models.transformer_lm(ids, vocab_size=vocab,
+                                           d_model=d, n_layers=L,
+                                           num_heads=H, max_len=T)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, vocab]),
+                layers.reshape(tgt, shape=[-1, 1])))
         pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
             loss, startup_program=startup)
     rng = np.random.RandomState(0)

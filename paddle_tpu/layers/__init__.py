@@ -1,7 +1,8 @@
 """User-facing layer functions (fluid layers package parity)."""
 from .io import data
 from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
-                 cross_entropy, dropout, embedding, fc, layer_norm,
+                 cross_entropy, dropout, embedding, fc,
+                 fused_head_cross_entropy, layer_norm,
                  linear_chain_crf, lrn, pool2d, rms_norm,
                  sigmoid_cross_entropy_with_logits, square_error_cost,
                  softmax_with_cross_entropy, topk)
@@ -33,6 +34,7 @@ from .tensor import (argmax, assign, cast, concat, create_global_var,
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
      "rms_norm", "dropout", "lrn", "cross_entropy",
+     "fused_head_cross_entropy",
      "softmax_with_cross_entropy",
      "sigmoid_cross_entropy_with_logits",
      "square_error_cost", "accuracy", "topk",

@@ -178,6 +178,29 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
     return helper.append_activation(outs["Y"][0], act)
 
 
+def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
+                             param_attr=None, main_program=None,
+                             startup_program=None):
+    """LM-head projection + softmax cross-entropy in one chunked op: the
+    [tokens, num_classes] logits tensor never materializes (online
+    logsumexp over vocab chunks — ops/loss_ops.py). Use in place of
+    ``fc(x, num_classes)`` + ``softmax_with_cross_entropy`` when the
+    vocabulary is large. Returns the per-row Loss [.., 1]; the head
+    weight is a normal [d, num_classes] parameter."""
+    helper = LayerHelper("fused_head_cross_entropy",
+                         main_program=main_program,
+                         startup_program=startup_program)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[int(d), int(num_classes)],
+                                dtype="float32")
+    outs, _ = helper.append_op(
+        "fused_head_cross_entropy",
+        {"X": [input], "W": [w], "Label": [label]},
+        ["Loss", "LSE"], {"chunk": int(chunk)})
+    outs["LSE"][0].stop_gradient = True
+    return outs["Loss"][0]
+
+
 def rms_norm(input, scale=True, shift=False, begin_norm_axis=1,
              epsilon=1e-6, param_attr=None, bias_attr=None, act=None,
              main_program=None, startup_program=None):

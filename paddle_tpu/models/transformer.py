@@ -18,13 +18,33 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
                    d_ff=None, num_kv_heads=None, use_rope=False,
                    max_len=2048, norm_type="layer_norm",
                    pipeline_stack=False, n_microbatches=None, remat=False,
+                   include_head=True,
                    main_program=None, startup_program=None):
     """ids [b, T] int64 -> logits [b, T, vocab]. Pre-LN GPT-style blocks,
     learned positional embedding, weight-tied-free output head.
 
     ``pipeline_stack=True`` builds the blocks as one stacked-weight layer
     (scan over layers; pipeline-parallel under a 'pp' mesh axis with
-    ``parallel.pipeline_plan`` — see layers.pipelined_transformer_stack)."""
+    ``parallel.pipeline_plan`` — see layers.pipelined_transformer_stack).
+    ``include_head=False`` returns the final-norm hidden states [b, T, d]
+    instead of logits, for use with
+    ``layers.fused_head_cross_entropy`` (chunked large-vocab loss that
+    never materializes the logits)."""
+    # validate BEFORE building anything: a raise must not leave orphan
+    # embedding ops/parameters in the caller's program
+    if norm_type != "layer_norm" and pipeline_stack:
+        raise ValueError(
+            "pipeline_stack=True supports norm_type='layer_norm' only "
+            "(the stacked-weight layout and its generation/serving "
+            "siblings share fixed LN parameter planes)")
+    if not include_head and pipeline_stack:
+        raise ValueError(
+            "pipeline_stack=True requires include_head=True: the "
+            "generation/serving siblings rejoin the trained head by its "
+            "fixed name (lm_head.w), which only the built-in head "
+            "creates — a fused_head_cross_entropy head would train "
+            "under a different parameter name and serving would "
+            "silently run an untrained head")
     kw = dict(main_program=main_program, startup_program=startup_program)
     d_ff = d_ff or 4 * d_model
     tok = layers.embedding(ids, size=[vocab_size, d_model],
@@ -45,11 +65,6 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
         x = helper.simple_op("elementwise_add", {"X": [tok], "Y": [pos]})
         x.seq_len = tok.seq_len
     ln_attr = ln_bias = head_attr = None
-    if norm_type != "layer_norm" and pipeline_stack:
-        raise ValueError(
-            "pipeline_stack=True supports norm_type='layer_norm' only "
-            "(the stacked-weight layout and its generation/serving "
-            "siblings share fixed LN parameter planes)")
     if pipeline_stack:
         # stable parameter names so a generation program (which rebuilds
         # these layers) shares the trained weights by name; one stacked
@@ -80,6 +95,8 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
     else:
         x = layers.layer_norm(x, begin_norm_axis=2, param_attr=ln_attr,
                               bias_attr=ln_bias, **kw)
+    if not include_head:
+        return x
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
                        param_attr=head_attr, bias_attr=False, **kw)
     return logits

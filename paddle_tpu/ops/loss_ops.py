@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_op
-from .common import broadcast_to_x, maybe, out, single
+from .common import (amp_cast, broadcast_to_x, maybe, mxu_precision,
+                     out, single)
 
 
 def _take_label_prob(x, label):
@@ -72,6 +74,166 @@ def softmax_with_cross_entropy(attrs, ins):
     else:
         loss = lse - _take_label_prob(x, label)
     return {"Softmax": [jnp.exp(x - lse)], "Loss": [loss]}
+
+
+def _fhce_chunks(vocab, chunk):
+    """(chunk, n_chunks) with n_chunks = ceil(vocab/chunk): the last
+    chunk is padded, never shrunk — an awkward vocab (prime, GPT-2's
+    50257) must not degrade into a longer sequential loop."""
+    c = min(chunk, vocab)
+    return c, -(-vocab // c)
+
+
+def _fhce_w3(wc, chunk, n_chunks, vocab):
+    """W [d, vocab] -> [d, n_chunks, chunk], zero-padding the tail chunk.
+    Padded columns are masked to -inf logits by the callers."""
+    d = wc.shape[0]
+    pad = n_chunks * chunk - vocab
+    if pad:
+        wc = jnp.pad(wc, ((0, 0), (0, pad)))
+    return wc.reshape(d, n_chunks, chunk)
+
+
+def _fhce_gather(logits_c, lab, c0, cols):
+    """Per-row logit at ``lab`` when it falls inside this chunk, else 0."""
+    local = lab - c0
+    inside = (local >= 0) & (local < cols)
+    safe = jnp.clip(local, 0, cols - 1)
+    picked = jnp.take_along_axis(logits_c, safe[:, None], axis=1)[:, 0]
+    return jnp.where(inside, picked, 0.0)
+
+
+def _fhce_chunk_logits(x2, w3, i, chunk, vocab):
+    """Chunk ``i``'s logits in f32, padded columns masked to -inf. The
+    ONE recompute kernel shared by forward LSE and backward softmax —
+    they must stay bit-identical for the saved-LSE reuse to be valid."""
+    wck = jax.lax.dynamic_index_in_dim(w3, i, axis=1, keepdims=False)
+    logits = jax.lax.dot_general(
+        x2, wck, (((1,), (0,)), ((), ())),
+        precision=mxu_precision(),
+        preferred_element_type=jnp.float32)
+    valid = (i * chunk + jnp.arange(chunk)) < vocab
+    return jnp.where(valid[None, :], logits, -jnp.inf), wck
+
+
+def _fused_head_ce_grad(attrs, ins, outs, ogs):
+    """Chunked backward: recompute each logits chunk, form
+    (softmax - onehot) * dLoss in-register, and contract it immediately
+    into dX and that chunk's dW rows — the [N, vocab] gradient tensor
+    never materializes either. LSE is re-used from the forward's saved
+    [N] row (or recomputed chunk-wise if the layer didn't wire it)."""
+    x = single(ins, "X")
+    w = single(ins, "W")
+    label = single(ins, "Label")
+    dloss = ogs.get("Loss", [None])[0]
+    if dloss is None:
+        raise NotImplementedError("fused_head_cross_entropy grad needs "
+                                  "Loss@GRAD (LSE is not differentiable)")
+    if any(g is not None for g in ogs.get("LSE", [])):
+        raise NotImplementedError(
+            "fused_head_cross_entropy LSE output is an auxiliary "
+            "residual, not a differentiable head")
+    xc, wc = amp_cast(x, w)
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    vocab = w.shape[-1]
+    n = int(np.prod(lead))
+    x2 = xc.reshape(n, d)
+    lab = label.reshape(n).astype(jnp.int32)
+    dl = dloss.reshape(n).astype(jnp.float32)
+    chunk, n_chunks = _fhce_chunks(vocab, attrs.get("chunk", 8192))
+
+    lse = outs.get("LSE", [None])[0]
+    if lse is None:
+        lse = _fhce_lse(x2, wc, lab, chunk, n_chunks)[0]
+    lse = lse.reshape(n, 1).astype(jnp.float32)
+
+    w3 = _fhce_w3(wc, chunk, n_chunks, vocab)
+
+    def body(i, carry):
+        dx_acc, dw_acc = carry
+        logits, wck = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
+        p = jnp.exp(logits - lse)
+        local = lab - i * chunk
+        onehot = jax.nn.one_hot(
+            jnp.where((local >= 0) & (local < chunk), local, -1),
+            chunk, dtype=jnp.float32)
+        g = ((p - onehot) * dl[:, None]).astype(x2.dtype)
+        dx_acc = dx_acc + jax.lax.dot_general(
+            g, wck, (((1,), (1,)), ((), ())),
+            precision=mxu_precision(),
+            preferred_element_type=jnp.float32)
+        dwk = jax.lax.dot_general(
+            x2, g, (((0,), (0,)), ((), ())),
+            precision=mxu_precision(),
+            preferred_element_type=jnp.float32)
+        dw_acc = jax.lax.dynamic_update_index_in_dim(dw_acc, dwk, i,
+                                                     axis=1)
+        return dx_acc, dw_acc
+
+    dx0 = jnp.zeros((n, d), jnp.float32)
+    dw0 = jnp.zeros((d, n_chunks, chunk), jnp.float32)
+    dx, dw = jax.lax.fori_loop(0, n_chunks, body, (dx0, dw0))
+    dw = dw.reshape(d, n_chunks * chunk)[:, :vocab]
+    return {"X": [dx.reshape(x.shape).astype(x.dtype)],
+            "W": [dw.astype(w.dtype)],
+            "Label": [None]}
+
+
+def _fhce_lse(x2, wc, lab, chunk, n_chunks):
+    """Online logsumexp + label-logit gather over vocab chunks."""
+    vocab = wc.shape[-1]
+    w3 = _fhce_w3(wc, chunk, n_chunks, vocab)
+    n = x2.shape[0]
+
+    def body(i, carry):
+        m, s, ll = carry
+        logits, _ = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
+        m_c = jnp.max(logits, axis=1)
+        m_new = jnp.maximum(m, m_c)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        ll = ll + _fhce_gather(logits, lab, i * chunk, chunk)
+        return m_new, s, ll
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    ll0 = jnp.zeros((n,), jnp.float32)
+    m, s, ll = jax.lax.fori_loop(0, n_chunks, body, (m0, s0, ll0))
+    return m + jnp.log(s), ll
+
+
+@register_op("fused_head_cross_entropy", grad_fn=_fused_head_ce_grad)
+def fused_head_cross_entropy(attrs, ins):
+    """LM-head projection + softmax cross-entropy WITHOUT materializing
+    the [tokens, vocab] logits tensor (beyond-reference; the reference's
+    softmax_with_cross_entropy_op.cc predates 100k-token vocabularies).
+    Scans the vocab in chunks with an online logsumexp, so peak memory is
+    O(tokens * chunk) and the full logits never touch HBM — the TPU-native
+    answer to large-vocab heads, where a [16k tokens, 128k vocab] logits
+    tensor alone would be 4 GB bf16 (plus its gradient). The chunked
+    backward recomputes each chunk and contracts it immediately into
+    dX/dW (see _fused_head_ce_grad). Hard labels only.
+
+    X [.., d] x W [d, vocab] + Label [.., 1] -> Loss [.., 1]; also emits
+    LSE [..] as a tiny auxiliary residual for the backward."""
+    x = single(ins, "X")
+    w = single(ins, "W")
+    label = single(ins, "Label")
+    if attrs.get("soft_label", False):
+        raise NotImplementedError(
+            "fused_head_cross_entropy supports hard labels only")
+    xc, wc = amp_cast(x, w)
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    vocab = w.shape[-1]
+    n = int(np.prod(lead))
+    x2 = xc.reshape(n, d)
+    lab = label.reshape(n).astype(jnp.int32)
+    chunk, n_chunks = _fhce_chunks(vocab, attrs.get("chunk", 8192))
+    lse, ll = _fhce_lse(x2, wc, lab, chunk, n_chunks)
+    loss = (lse - ll).reshape(lead + (1,))
+    return {"Loss": [loss], "LSE": [lse.reshape(lead)]}
 
 
 @register_op("square_error_cost")

@@ -23,6 +23,18 @@ def test_transformer_bench_path_runs():
     assert tok_s > 0 and flops_s > 0
 
 
+def test_transformer_bench_fused_head_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    tok_s, flops_s = _bench().bench_transformer_step(
+        jax, pt, layers, models, bs=2, T=128, vocab=64, d=32, L=1, H=2,
+        steps=2, fused_head=True)
+    assert tok_s > 0 and flops_s > 0
+
+
 def test_lstm_varlen_bench_path_runs():
     import jax
 

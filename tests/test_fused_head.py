@@ -1,0 +1,155 @@
+"""fused_head_cross_entropy: chunked LM-head + softmax CE that never
+materializes the [tokens, vocab] logits (ops/loss_ops.py). Must match
+fc(bias=False) + softmax_with_cross_entropy exactly — loss AND
+gradients — across chunk boundaries, AMP, and awkward vocab sizes."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, fetch, seed=0):
+    rng = np.random.RandomState(seed)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, feed = build(rng)
+        pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    outs = exe.run(main, feed=feed, fetch_list=["loss_mean"] + fetch,
+                   scope=scope)
+    return [np.asarray(o, dtype=np.float32) for o in outs]
+
+
+def _nets(vocab, chunk, n=6, d=16, seed=3):
+    """(fused build, reference build) sharing shapes/feeds/seeds."""
+    def feed_of(rng):
+        x = rng.randn(n, d).astype("float32") * 0.5
+        lab = rng.randint(0, vocab, (n, 1)).astype("int64")
+        return {"x": x, "lab": lab}
+
+    def fused(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=chunk,
+            param_attr=pt.ParamAttr(name="headw"))
+        m = layers.mean(loss)
+        m.block.program.global_block.create_var(name="loss_mean")
+        m.block.append_op("assign", inputs={"X": [m.name]},
+                          outputs={"Out": ["loss_mean"]})
+        return m, feed_of(rng)
+
+    def ref(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=vocab, bias_attr=False,
+                           param_attr=pt.ParamAttr(name="headw"))
+        loss = layers.softmax_with_cross_entropy(logits, lab)
+        m = layers.mean(loss)
+        m.block.program.global_block.create_var(name="loss_mean")
+        m.block.append_op("assign", inputs={"X": [m.name]},
+                          outputs={"Out": ["loss_mean"]})
+        return m, feed_of(rng)
+
+    return fused, ref
+
+
+@pytest.mark.parametrize("vocab,chunk", [(64, 16), (96, 40), (50, 7),
+                                         (128, 8192), (97, 32)])
+def test_fused_head_matches_unfused(vocab, chunk):
+    fused, ref = _nets(vocab, chunk)
+    fetch = ["x@GRAD", "headw@GRAD"]
+    got = _run(fused, fetch, seed=1)
+    want = _run(ref, fetch, seed=1)
+    for g, w, name in zip(got, want, ["loss"] + fetch):
+        np.testing.assert_allclose(g, w, rtol=3e-5, atol=3e-6,
+                                   err_msg=f"{vocab}/{chunk}:{name}")
+
+
+def test_fused_head_matches_unfused_amp():
+    fused, ref = _nets(128, 32, n=8, d=32)
+    fetch = ["x@GRAD", "headw@GRAD"]
+    pt.set_amp(True)
+    try:
+        got = _run(fused, fetch, seed=2)
+        want = _run(ref, fetch, seed=2)
+    finally:
+        pt.set_amp(False)
+    for g, w, name in zip(got, want, ["loss"] + fetch):
+        np.testing.assert_allclose(g, w, rtol=3e-2, atol=3e-3,
+                                   err_msg=name)
+
+
+def test_fused_head_labels_on_chunk_boundaries():
+    """Labels at positions 0, chunk-1, chunk, vocab-1 all gather the
+    right logit."""
+    vocab, chunk, d = 64, 16, 8
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, d).astype("float32")
+    labs = np.array([[0], [15], [16], [63]], "int64")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = layers.data("x", shape=[d])
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.fused_head_cross_entropy(
+            xv, lab, num_classes=vocab, chunk=chunk,
+            param_attr=pt.ParamAttr(name="bw"))
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    (lv,) = exe.run(main, feed={"x": x, "lab": labs},
+                    fetch_list=[loss], scope=scope)
+    w = np.asarray(scope.get("bw"))
+    logits = x @ w
+    lse = np.log(np.exp(logits - logits.max(1, keepdims=True)).sum(1)) \
+        + logits.max(1)
+    want = (lse - logits[np.arange(4), labs[:, 0]])[:, None]
+    np.testing.assert_allclose(np.asarray(lv), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_include_head_false_rejected_on_stacked_path():
+    """The stacked serving siblings rejoin the head by its fixed name
+    (lm_head.w); a fused external head would silently train a different
+    parameter, so the combination must refuse loudly."""
+    from paddle_tpu import models
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[8], dtype="int64")
+        with pytest.raises(ValueError, match="include_head"):
+            models.transformer_lm(ids, vocab_size=32, d_model=16,
+                                  n_layers=1, num_heads=1, max_len=8,
+                                  include_head=False, pipeline_stack=True)
+
+
+def test_fused_head_sequence_rank3():
+    """[b, T, d] inputs with [b, T, 1] labels (the LM layout)."""
+    b, T, d, vocab = 2, 5, 8, 32
+    rng = np.random.RandomState(4)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[T, d])
+        lab = layers.data("lab", shape=[T, 1], dtype="int64")
+        loss = layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=8,
+            param_attr=pt.ParamAttr(name="sw"))
+        m = layers.mean(loss)
+        pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(
+            m, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {"x": rng.randn(b, T, d).astype("float32"),
+            "lab": rng.randint(0, vocab, (b, T, 1)).astype("int64")}
+    ls = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[m],
+                                   scope=scope)[0]))
+          for _ in range(20)]
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0] * 0.8, (ls[0], ls[-1])

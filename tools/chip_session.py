@@ -156,15 +156,21 @@ def resnet50_bs256_step(jax, pt, layers, models, bench, peak,
 
 
 def transformer_lm_step(jax, pt, layers, models, bench, peak,
-                        bs=8, d=1024, H=8, L=8, extra=None):
+                        bs=8, d=1024, H=8, L=8, vocab=16384,
+                        fused_head=False, extra=None):
     """Measure the canonical transformer LM train step (tokens/s, MFU).
     ONE definition of the probe schema so journal rows from different
     sessions stay comparable."""
     tok_s, flops_s = bench.bench_transformer_step(
-        jax, pt, layers, models, bs=bs, d=d, H=H, L=L)
+        jax, pt, layers, models, bs=bs, d=d, H=H, L=L, vocab=vocab,
+        fused_head=fused_head)
     out = {"tokens_per_sec": round(tok_s),
            "mfu": round(flops_s / peak, 4) if peak else None,
            "d_model": d, "d_head": d // H, "bs": bs}
+    if vocab != 16384:
+        out["vocab"] = vocab
+    if fused_head:
+        out["fused_head"] = True
     out.update(extra or {})
     return out
 

@@ -57,6 +57,23 @@ def main():
     cs.experiment("lm_d2048_bs16", lambda: lm(16, 2048, 16), seconds=700)
     cs.experiment("lm_d3072_bs4", lambda: lm(4, 3072, 24), seconds=700)
 
+    # Chunked fused head+loss (layers.fused_head_cross_entropy): A/B at
+    # the bench vocab, then a 131k vocab that the naive [tokens, vocab]
+    # logits path could not hold (16k tokens x 131k bf16 = 4 GB + grad).
+    cs.experiment(
+        "lm_d1024_fusedhead",
+        lambda: cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                       peak, fused_head=True,
+                                       extra={"norm_grad": "custom"}),
+        seconds=700)
+    cs.experiment(
+        "lm_v131k_fusedhead",
+        lambda: cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                       peak, vocab=131072,
+                                       fused_head=True,
+                                       extra={"norm_grad": "custom"}),
+        seconds=900)
+
     cs.experiment(
         "profile_resnet_custombn",
         lambda: cs.resnet50_profile(pt, layers, models,

@@ -10,7 +10,7 @@ PY="${PYTHON:-/opt/venv/bin/python}"
   for i in $(seq 1 260); do
     if timeout -k 5 120 "$PY" -c "import jax; d=jax.devices()[0]; import sys; sys.exit(0 if d.platform!='cpu' else 1)" 2>/dev/null; then
       echo "tunnel up at $(date -u +%FT%TZ) (probe $i) — running r3c2"
-      timeout 4500 "$PY" tools/chip_session_r3c2.py
+      timeout 6600 "$PY" tools/chip_session_r3c2.py
       echo "r3c2 rc=$? — running bench refresh"
       timeout 3000 "$PY" bench.py > /tmp/bench_refresh.json 2>/tmp/bench_refresh.err
       echo "bench rc=$? at $(date -u +%FT%TZ)"
