@@ -1,0 +1,88 @@
+"""The modern LM training recipe on the per-layer transformer path:
+RMSNorm pre-norms, rotary positions, grouped-query attention, AdamW
+(decoupled weight decay), and the chunked fused head+loss that never
+materializes the [tokens, vocab] logits — every piece beyond the
+reference's capability set (it predates Transformers), all through the
+same program/executor idiom as the classic demos.
+
+Run:  python demos/gpt_modern.py  (PADDLE_TPU_DEMO_FAST=1 to smoke)
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+
+def synthetic_corpus(rng, vocab, n, T):
+    """A learnable language: token t+1 = (5*t + noise) % vocab."""
+    x = np.zeros((n, T + 1), np.int64)
+    x[:, 0] = rng.randint(0, vocab, size=n)
+    for t in range(T):
+        noise = rng.randint(0, 2, size=n)
+        x[:, t + 1] = (5 * x[:, t] + noise) % vocab
+    return x
+
+
+def main():
+    vocab, T = 211, 24 if FAST else 64  # odd vocab: the fused head pads
+    d_model, n_layers, heads = 64, 2, 4
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T, 1], dtype="int64")
+        h = models.transformer_lm(
+            ids, vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            num_heads=heads, num_kv_heads=2,       # GQA: 2 KV head groups
+            use_rope=True,                          # rotary positions
+            norm_type="rms_norm",                   # single-reduction norm
+            max_len=2 * T,
+            include_head=False)                     # head lives in the loss
+        loss = layers.mean(layers.fused_head_cross_entropy(
+            h, tgt, num_classes=vocab, chunk=128,
+            param_attr=pt.ParamAttr(name="head.w")))
+        # eval clone BEFORE minimize (the reference contract)
+        eval_prog = main_prog.clone(for_test=True)
+        pt.optimizer.AdamWOptimizer(
+            learning_rate=3e-3, weight_decay=0.01).minimize(
+            loss, startup_program=startup)
+
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    steps = 10 if FAST else 150
+    first = last = None
+    for step in range(steps):
+        seq = synthetic_corpus(rng, vocab, n=32, T=T)
+        lo, = exe.run(main_prog,
+                      feed={"ids": seq[:, :-1], "tgt": seq[:, 1:, None]},
+                      fetch_list=[loss], scope=scope)
+        lo = float(np.asarray(lo))
+        first = lo if first is None else first
+        last = lo
+        if step % 25 == 0 or step == steps - 1:
+            print(f"step {step}: loss {lo:.4f}")
+    print(f"loss {first:.3f} -> {last:.3f} "
+          f"(rms_norm + rope + gqa + adamw + fused head)")
+
+    # next-token accuracy: run the eval clone up to the hidden states and
+    # project against the trained fused head weight on the host
+    seq = synthetic_corpus(rng, vocab, n=16, T=T)
+    hv, = exe.run(eval_prog,
+                  feed={"ids": seq[:, :-1], "tgt": seq[:, 1:, None]},
+                  fetch_list=[h.name], scope=scope)
+    w_np = np.asarray(scope.get("head.w"), dtype=np.float32)
+    pred = (np.asarray(hv, dtype=np.float32) @ w_np).argmax(-1)
+    acc = float((pred[:, :-1] == seq[:, 1:-1]).mean())
+    print(f"next-token accuracy: {acc:.2f}")
+    if not FAST:
+        assert acc > 0.4, acc
+
+
+if __name__ == "__main__":
+    main()

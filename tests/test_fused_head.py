@@ -19,7 +19,7 @@ def _run(build, fetch, seed=0):
     scope = pt.Scope()
     exe = pt.Executor(pt.CPUPlace())
     exe.run(startup, scope=scope)
-    outs = exe.run(main, feed=feed, fetch_list=["loss_mean"] + fetch,
+    outs = exe.run(main, feed=feed, fetch_list=[loss] + fetch,
                    scope=scope)
     return [np.asarray(o, dtype=np.float32) for o in outs]
 
@@ -38,11 +38,7 @@ def _nets(vocab, chunk, n=6, d=16, seed=3):
         loss = layers.fused_head_cross_entropy(
             x, lab, num_classes=vocab, chunk=chunk,
             param_attr=pt.ParamAttr(name="headw"))
-        m = layers.mean(loss)
-        m.block.program.global_block.create_var(name="loss_mean")
-        m.block.append_op("assign", inputs={"X": [m.name]},
-                          outputs={"Out": ["loss_mean"]})
-        return m, feed_of(rng)
+        return layers.mean(loss), feed_of(rng)
 
     def ref(rng):
         x = layers.data("x", shape=[d])
@@ -51,11 +47,7 @@ def _nets(vocab, chunk, n=6, d=16, seed=3):
         logits = layers.fc(x, size=vocab, bias_attr=False,
                            param_attr=pt.ParamAttr(name="headw"))
         loss = layers.softmax_with_cross_entropy(logits, lab)
-        m = layers.mean(loss)
-        m.block.program.global_block.create_var(name="loss_mean")
-        m.block.append_op("assign", inputs={"X": [m.name]},
-                          outputs={"Out": ["loss_mean"]})
-        return m, feed_of(rng)
+        return layers.mean(loss), feed_of(rng)
 
     return fused, ref
 

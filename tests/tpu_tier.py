@@ -529,6 +529,59 @@ def norm_backward_matches_generic_vjp():
     return f"worst rel err {worst:.1e}"
 
 
+@check
+def fused_head_matches_unfused():
+    """Chunked fused_head_cross_entropy vs fc + softmax_with_cross_entropy
+    ON CHIP under AMP bf16 — loss and both gradients, including a padded
+    tail chunk (vocab 100, chunk 32)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    prior_amp = pt.amp_enabled()
+    n, d, vocab, chunk = 64, 32, 100, 32
+    rng = np.random.RandomState(17)
+    feed = {"x": (rng.randn(n, d) * 0.5).astype("float32"),
+            "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+
+    def run(fused):
+        pt.set_amp(True)
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[d])
+                x.stop_gradient = False
+                lab = layers.data("lab", shape=[1], dtype="int64")
+                if fused:
+                    loss = layers.fused_head_cross_entropy(
+                        x, lab, num_classes=vocab, chunk=chunk,
+                        param_attr=pt.ParamAttr(name="fhw"))
+                else:
+                    logits = layers.fc(x, size=vocab, bias_attr=False,
+                                       param_attr=pt.ParamAttr(name="fhw"))
+                    loss = layers.softmax_with_cross_entropy(logits, lab)
+                m = layers.mean(loss)
+                pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(
+                    m, startup_program=startup)
+            exe, scope = _executor_pair()
+            exe.run(startup, scope=scope)
+            outs = exe.run(main, feed=feed,
+                           fetch_list=[m, "x@GRAD", "fhw@GRAD"],
+                           scope=scope)
+            return [np.asarray(o, dtype=np.float32) for o in outs]
+        finally:
+            pt.set_amp(prior_amp)
+
+    got = run(True)
+    want = run(False)
+    worst = 0.0
+    for name, a, b in zip(["loss", "dx", "dw"], got, want):
+        scale = max(np.abs(b).max(), 1e-3)
+        err = np.abs(a - b).max() / scale
+        assert err < 3e-2, (name, err)
+        worst = max(worst, err)
+    return f"worst rel err {worst:.1e}"
+
+
 def main():
     failures = 0
     for fn in CHECKS:

@@ -31,13 +31,18 @@ def main():
     # On-chip correctness first: the custom norm backwards vs generic
     # vjp under bf16 (the new tier check, run standalone to keep this
     # session short).
-    def norm_check():
+    def tier(check_name):
         sys.path.insert(0, os.path.join(REPO, "tests"))
         import tpu_tier
 
-        return {"detail": tpu_tier.norm_backward_matches_generic_vjp()}
+        return {"detail": getattr(tpu_tier, check_name)()}
 
-    cs.experiment("tier_norm_backward_parity", norm_check, seconds=600)
+    cs.experiment("tier_norm_backward_parity",
+                  lambda: tier("norm_backward_matches_generic_vjp"),
+                  seconds=600)
+    cs.experiment("tier_fused_head_parity",
+                  lambda: tier("fused_head_matches_unfused"),
+                  seconds=600)
 
     cs.experiment(
         "resnet50_bs256_custombn",
