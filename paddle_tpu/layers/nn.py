@@ -180,13 +180,22 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
 
 def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
                              param_attr=None, main_program=None,
-                             startup_program=None):
+                             startup_program=None, *,
+                             vocab_parallel=False, model_axis="mp",
+                             data_axis="dp"):
     """LM-head projection + softmax cross-entropy in one chunked op: the
     [tokens, num_classes] logits tensor never materializes (online
     logsumexp over vocab chunks — ops/loss_ops.py). Use in place of
     ``fc(x, num_classes)`` + ``softmax_with_cross_entropy`` when the
     vocabulary is large. Returns the per-row Loss [.., 1]; the head
-    weight is a normal [d, num_classes] parameter."""
+    weight is a normal [d, num_classes] parameter.
+
+    ``vocab_parallel=True``: when the executor compiles with a mesh whose
+    ``model_axis`` has size > 1, the head computes Megatron-style — each
+    device scans only its vocab shard and three per-row collectives
+    combine the statistics (parallel/vocab_parallel_loss.py). Pair it
+    with a plan rule sharding this weight's LAST dim over ``model_axis``;
+    the same program still runs unchanged on one device."""
     helper = LayerHelper("fused_head_cross_entropy",
                          main_program=main_program,
                          startup_program=startup_program)
@@ -196,7 +205,10 @@ def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
     outs, _ = helper.append_op(
         "fused_head_cross_entropy",
         {"X": [input], "W": [w], "Label": [label]},
-        ["Loss", "LSE"], {"chunk": int(chunk)})
+        ["Loss", "LSE"], {"chunk": int(chunk),
+                          "vocab_parallel": bool(vocab_parallel),
+                          "model_axis": model_axis,
+                          "data_axis": data_axis})
     outs["LSE"][0].stop_gradient = True
     return outs["Loss"][0]
 

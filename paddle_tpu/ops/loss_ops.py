@@ -103,6 +103,19 @@ def _fhce_gather(logits_c, lab, c0, cols):
     return jnp.where(inside, picked, 0.0)
 
 
+def _fhce_vp_mesh(attrs):
+    """The executor mesh when this op instance should lower
+    vocab-parallel (attr opt-in + a model axis of size > 1); None means
+    the serial chunked path — the SAME program runs on one device."""
+    if not attrs.get("vocab_parallel", False):
+        return None
+    from ..parallel.context import current_mesh, mesh_axis
+
+    if mesh_axis(attrs.get("model_axis", "mp")) <= 1:
+        return None
+    return current_mesh()
+
+
 def _fhce_chunk_logits(x2, w3, i, chunk, vocab):
     """Chunk ``i``'s logits in f32, padded columns masked to -inf. The
     ONE recompute kernel shared by forward LSE and backward softmax —
@@ -114,6 +127,42 @@ def _fhce_chunk_logits(x2, w3, i, chunk, vocab):
         preferred_element_type=jnp.float32)
     valid = (i * chunk + jnp.arange(chunk)) < vocab
     return jnp.where(valid[None, :], logits, -jnp.inf), wck
+
+
+def _fhce_lse_chunk(x2, w3, i, chunk, vocab, lab, carry):
+    """One online-logsumexp step over chunk ``i``; carry = (m, s, ll).
+    Out-of-range labels (< 0 or >= vocab) never gather — callers with
+    vocab shards map foreign labels to -1."""
+    m, s, ll = carry
+    logits, _ = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
+    m_c = jnp.max(logits, axis=1)
+    m_new = jnp.maximum(m, m_c)
+    s = s * jnp.exp(m - m_new) + jnp.sum(
+        jnp.exp(logits - m_new[:, None]), axis=1)
+    ll = ll + _fhce_gather(logits, lab, i * chunk, chunk)
+    return m_new, s, ll
+
+
+def _fhce_grad_chunk(x2, w3, i, chunk, vocab, lab, lse2, dl2):
+    """One backward step over chunk ``i``: (dX contribution [n, d],
+    dW chunk [d, chunk]) from g = (softmax - onehot) * dLoss. The ONE
+    definition shared by the serial and vocab-parallel backwards."""
+    logits, wck = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
+    p = jnp.exp(logits - lse2)
+    local = lab - i * chunk
+    onehot = jax.nn.one_hot(
+        jnp.where((local >= 0) & (local < chunk), local, -1),
+        chunk, dtype=jnp.float32)
+    g = ((p - onehot) * dl2).astype(x2.dtype)
+    dx_c = jax.lax.dot_general(
+        g, wck, (((1,), (1,)), ((), ())),
+        precision=mxu_precision(),
+        preferred_element_type=jnp.float32)
+    dw_c = jax.lax.dot_general(
+        x2, g, (((0,), (0,)), ((), ())),
+        precision=mxu_precision(),
+        preferred_element_type=jnp.float32)
+    return dx_c, dw_c
 
 
 def _fused_head_ce_grad(attrs, ins, outs, ogs):
@@ -141,35 +190,40 @@ def _fused_head_ce_grad(attrs, ins, outs, ogs):
     x2 = xc.reshape(n, d)
     lab = label.reshape(n).astype(jnp.int32)
     dl = dloss.reshape(n).astype(jnp.float32)
-    chunk, n_chunks = _fhce_chunks(vocab, attrs.get("chunk", 8192))
+    raw_chunk = attrs.get("chunk", 8192)
 
+    mesh = _fhce_vp_mesh(attrs)
     lse = outs.get("LSE", [None])[0]
+    if mesh is not None:
+        from ..parallel.vocab_parallel_loss import (vp_fused_head_grad,
+                                                   vp_fused_head_lse)
+
+        vp_axis = attrs.get("model_axis", "mp")
+        data_axis = attrs.get("data_axis", "dp")
+        if lse is None:
+            lse = vp_fused_head_lse(x2, wc, lab, raw_chunk, mesh,
+                                    vp_axis, data_axis)[0]
+        dx, dw = vp_fused_head_grad(x2, wc, lab, dl,
+                                    lse.reshape(n).astype(jnp.float32),
+                                    raw_chunk, mesh, vp_axis, data_axis)
+        return {"X": [dx.reshape(x.shape).astype(x.dtype)],
+                "W": [dw.astype(w.dtype)],
+                "Label": [None]}
+    chunk, n_chunks = _fhce_chunks(vocab, raw_chunk)
     if lse is None:
         lse = _fhce_lse(x2, wc, lab, chunk, n_chunks)[0]
     lse = lse.reshape(n, 1).astype(jnp.float32)
 
     w3 = _fhce_w3(wc, chunk, n_chunks, vocab)
+    dl2 = dl[:, None]
 
     def body(i, carry):
         dx_acc, dw_acc = carry
-        logits, wck = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
-        p = jnp.exp(logits - lse)
-        local = lab - i * chunk
-        onehot = jax.nn.one_hot(
-            jnp.where((local >= 0) & (local < chunk), local, -1),
-            chunk, dtype=jnp.float32)
-        g = ((p - onehot) * dl[:, None]).astype(x2.dtype)
-        dx_acc = dx_acc + jax.lax.dot_general(
-            g, wck, (((1,), (1,)), ((), ())),
-            precision=mxu_precision(),
-            preferred_element_type=jnp.float32)
-        dwk = jax.lax.dot_general(
-            x2, g, (((0,), (0,)), ((), ())),
-            precision=mxu_precision(),
-            preferred_element_type=jnp.float32)
-        dw_acc = jax.lax.dynamic_update_index_in_dim(dw_acc, dwk, i,
-                                                     axis=1)
-        return dx_acc, dw_acc
+        dx_c, dw_c = _fhce_grad_chunk(x2, w3, i, chunk, vocab, lab, lse,
+                                      dl2)
+        return (dx_acc + dx_c,
+                jax.lax.dynamic_update_index_in_dim(dw_acc, dw_c, i,
+                                                    axis=1))
 
     dx0 = jnp.zeros((n, d), jnp.float32)
     dw0 = jnp.zeros((d, n_chunks, chunk), jnp.float32)
@@ -187,14 +241,7 @@ def _fhce_lse(x2, wc, lab, chunk, n_chunks):
     n = x2.shape[0]
 
     def body(i, carry):
-        m, s, ll = carry
-        logits, _ = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
-        m_c = jnp.max(logits, axis=1)
-        m_new = jnp.maximum(m, m_c)
-        s = s * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(logits - m_new[:, None]), axis=1)
-        ll = ll + _fhce_gather(logits, lab, i * chunk, chunk)
-        return m_new, s, ll
+        return _fhce_lse_chunk(x2, w3, i, chunk, vocab, lab, carry)
 
     m0 = jnp.full((n,), -jnp.inf, jnp.float32)
     s0 = jnp.zeros((n,), jnp.float32)
@@ -230,8 +277,17 @@ def fused_head_cross_entropy(attrs, ins):
     n = int(np.prod(lead))
     x2 = xc.reshape(n, d)
     lab = label.reshape(n).astype(jnp.int32)
-    chunk, n_chunks = _fhce_chunks(vocab, attrs.get("chunk", 8192))
-    lse, ll = _fhce_lse(x2, wc, lab, chunk, n_chunks)
+    raw_chunk = attrs.get("chunk", 8192)
+    mesh = _fhce_vp_mesh(attrs)
+    if mesh is not None:
+        from ..parallel.vocab_parallel_loss import vp_fused_head_lse
+
+        lse, ll = vp_fused_head_lse(
+            x2, wc, lab, raw_chunk, mesh,
+            attrs.get("model_axis", "mp"), attrs.get("data_axis", "dp"))
+    else:
+        chunk, n_chunks = _fhce_chunks(vocab, raw_chunk)
+        lse, ll = _fhce_lse(x2, wc, lab, chunk, n_chunks)
     loss = (lse - ll).reshape(lead + (1,))
     return {"Loss": [loss], "LSE": [lse.reshape(lead)]}
 

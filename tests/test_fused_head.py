@@ -121,6 +121,130 @@ def test_include_head_false_rejected_on_stacked_path():
                                   include_head=False, pipeline_stack=True)
 
 
+def test_fused_head_data_parallel_matches_single_device():
+    """The chunked op must shard cleanly over a dp mesh (tokens split,
+    W replicated): losses match the single-device run."""
+    import jax
+
+    from paddle_tpu.parallel import data_parallel_plan, make_mesh
+
+    n, d, vocab = 16, 8, 48
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[d])
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=16,
+            param_attr=pt.ParamAttr(name="dpw"))
+        m = layers.mean(loss)
+        pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+            m, startup_program=startup)
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.randn(n, d).astype("float32"),
+            "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+
+    single = pt.Executor(pt.CPUPlace())
+    scope1 = pt.Scope()
+    with jax.default_device(jax.devices()[0]):
+        single.run(startup, scope=scope1)
+        ref = [float(np.asarray(single.run(main, feed=feed,
+                                           fetch_list=[m],
+                                           scope=scope1)[0]))
+               for _ in range(3)]
+
+    mesh = make_mesh({"dp": 8})
+    spmd = pt.Executor(pt.TPUPlace(), mesh=mesh,
+                       plan=data_parallel_plan(mesh))
+    scope2 = pt.Scope()
+    spmd.run(startup, scope=scope2)
+    got = [float(np.asarray(spmd.run(main, feed=feed, fetch_list=[m],
+                                     scope=scope2)[0]))
+           for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def _vp_build(vocab, chunk, d, vocab_parallel):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=chunk,
+            vocab_parallel=vocab_parallel,
+            param_attr=pt.ParamAttr(name="vp_headw"))
+        m = layers.mean(loss)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+            m, startup_program=startup)
+    return main, startup, m
+
+
+@pytest.mark.parametrize("mesh_shape,vocab,chunk", [
+    ({"mp": 8}, 64, 8),
+    ({"dp": 2, "mp": 4}, 64, 8),
+    # vl=10, chunk=4 -> padded tail window [10, 12): out-of-shard labels
+    # must NOT gather the -inf pad (regression: a bare label shift let
+    # foreign labels poison the psummed loss to +inf)
+    ({"mp": 8}, 80, 4),
+])
+def test_fused_head_vocab_parallel_matches_single_device(mesh_shape,
+                                                         vocab, chunk):
+    """Megatron-style vocab-parallel head: the weight shards its vocab
+    dim over mp, every device scans only its shard, and loss + trained
+    weights match the single-device run."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    n, d = 16, 8
+    rng = np.random.RandomState(9)
+    feed = {"x": rng.randn(n, d).astype("float32"),
+            "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+
+    main, startup, m = _vp_build(vocab, chunk, d, vocab_parallel=True)
+    single = pt.Executor(pt.CPUPlace())
+    scope1 = pt.Scope()
+    with jax.default_device(jax.devices()[0]):
+        single.run(startup, scope=scope1)
+        ref = [float(np.asarray(single.run(main, feed=feed,
+                                           fetch_list=[m],
+                                           scope=scope1)[0]))
+               for _ in range(3)]
+        w_ref = np.asarray(scope1.get("vp_headw"))
+
+    mesh = make_mesh(dict(mesh_shape))
+    plan = ShardingPlan(mesh, rules=[(r"vp_headw", P(None, "mp"))],
+                        data_axis="dp")
+    spmd = pt.Executor(pt.TPUPlace(), mesh=mesh, plan=plan)
+    scope2 = pt.Scope()
+    spmd.run(startup, scope=scope2)
+    got = [float(np.asarray(spmd.run(main, feed=feed, fetch_list=[m],
+                                     scope=scope2)[0]))
+           for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+    w_got = np.asarray(scope2.get("vp_headw"))
+    np.testing.assert_allclose(w_got, w_ref, rtol=2e-5, atol=2e-6)
+
+
+def test_fused_head_vocab_parallel_indivisible_raises():
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    main, startup, m = _vp_build(60, 8, 8, vocab_parallel=True)
+    mesh = make_mesh({"mp": 8})  # 60 % 8 != 0
+    spmd = pt.Executor(pt.TPUPlace(), mesh=mesh,
+                       plan=ShardingPlan(mesh, data_axis=None))
+    scope = pt.Scope()
+    spmd.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 8).astype("float32"),
+            "lab": rng.randint(0, 60, (8, 1)).astype("int64")}
+    with pytest.raises(Exception, match="divisible"):
+        spmd.run(main, feed=feed, fetch_list=[m], scope=scope)
+
+
 def test_fused_head_sequence_rank3():
     """[b, T, d] inputs with [b, T, 1] labels (the LM layout)."""
     b, T, d, vocab = 2, 5, 8, 32
