@@ -43,11 +43,17 @@ def main():
             include_head=False)                     # head lives in the loss
         loss = layers.mean(layers.fused_head_cross_entropy(
             h, tgt, num_classes=vocab, chunk=128,
+            label_smoothing=0.05,                   # smoothed targets
             param_attr=pt.ParamAttr(name="head.w")))
         # eval clone BEFORE minimize (the reference contract)
         eval_prog = main_prog.clone(for_test=True)
+        from paddle_tpu.learning_rate_decay import (cosine_decay,
+                                                    linear_lr_warmup)
+
+        lr = linear_lr_warmup(cosine_decay(3e-3, decay_steps=150),
+                              warmup_steps=10, start_lr=3e-4, end_lr=3e-3)
         pt.optimizer.AdamWOptimizer(
-            learning_rate=3e-3, weight_decay=0.01).minimize(
+            learning_rate=lr, weight_decay=0.01).minimize(
             loss, startup_program=startup)
 
     scope = pt.Scope()
@@ -68,7 +74,8 @@ def main():
         if step % 25 == 0 or step == steps - 1:
             print(f"step {step}: loss {lo:.4f}")
     print(f"loss {first:.3f} -> {last:.3f} "
-          f"(rms_norm + rope + gqa + adamw + fused head)")
+          f"(rms_norm + rope + gqa + adamw + warmup-cosine + "
+          f"smoothed fused head)")
 
     # next-token accuracy: run the eval clone up to the hidden states and
     # project against the trained fused head weight on the host

@@ -181,6 +181,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
 def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
                              param_attr=None, main_program=None,
                              startup_program=None, *,
+                             label_smoothing=0.0,
                              vocab_parallel=False, model_axis="mp",
                              data_axis="dp"):
     """LM-head projection + softmax cross-entropy in one chunked op: the
@@ -196,6 +197,9 @@ def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
     combine the statistics (parallel/vocab_parallel_loss.py). Pair it
     with a plan rule sharding this weight's LAST dim over ``model_axis``;
     the same program still runs unchanged on one device."""
+    if not 0.0 <= float(label_smoothing) < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}")
     helper = LayerHelper("fused_head_cross_entropy",
                          main_program=main_program,
                          startup_program=startup_program)
@@ -206,6 +210,7 @@ def fused_head_cross_entropy(input, label, num_classes, chunk=8192,
         "fused_head_cross_entropy",
         {"X": [input], "W": [w], "Label": [label]},
         ["Loss", "LSE"], {"chunk": int(chunk),
+                          "label_smoothing": float(label_smoothing),
                           "vocab_parallel": bool(vocab_parallel),
                           "model_axis": model_axis,
                           "data_axis": data_axis})
@@ -276,13 +281,23 @@ def cross_entropy(input, label, soft_label=False, main_program=None,
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               label_smoothing=0.0,
                                main_program=None, startup_program=None):
+    """``label_smoothing`` (hard labels only): train against the target
+    (1-eps)*onehot + eps/V — beyond-reference, the seq2seq/ViT-era
+    regularizer; the fused grad stays (softmax - target)."""
     helper = LayerHelper("softmax_with_cross_entropy",
                          main_program=main_program,
                          startup_program=startup_program)
+    if soft_label and label_smoothing:
+        raise ValueError("label_smoothing applies to hard labels only")
+    if not 0.0 <= float(label_smoothing) < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}")
     outs, _ = helper.append_op(
         "softmax_with_cross_entropy", {"Logits": [logits], "Label": [label]},
-        ["Softmax", "Loss"], {"soft_label": soft_label})
+        ["Softmax", "Loss"], {"soft_label": soft_label,
+                              "label_smoothing": float(label_smoothing)})
     return outs["Loss"][0]
 
 

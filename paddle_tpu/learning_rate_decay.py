@@ -128,6 +128,19 @@ def piecewise_decay(boundaries, values, global_step=None,
                      global_step, main_program, startup_program)
 
 
+def cosine_decay(learning_rate, decay_steps, alpha=0.0, global_step=None,
+                 main_program=None, startup_program=None):
+    """Cosine annealing (beyond-reference; the modern LM default):
+    lr * ((1-alpha) * 0.5*(1+cos(pi*step/decay_steps)) + alpha),
+    clamped at ``alpha*lr`` past ``decay_steps``. Compose with
+    ``linear_lr_warmup`` for the standard warmup+cosine recipe."""
+    return _schedule("cosine",
+                     {"learning_rate": float(learning_rate),
+                      "decay_steps": int(decay_steps),
+                      "alpha": float(alpha)},
+                     global_step, main_program, startup_program)
+
+
 def noam_decay(d_model, warmup_steps, global_step=None,
                main_program=None, startup_program=None):
     """The transformer schedule: d_model^-0.5 * min(s^-0.5, s*warmup^-1.5)."""

@@ -49,8 +49,12 @@ def _softmax_with_ce_grad(attrs, ins, outs, ogs):
     if attrs.get("soft_label", False):
         grad = sm - label.astype(jnp.float32)
     else:
+        eps = attrs.get("label_smoothing", 0.0)
         onehot = jax.nn.one_hot(label.reshape(logits.shape[:-1]),
                                 logits.shape[-1], dtype=sm.dtype)
+        if eps:
+            # smoothed target: (1-eps)*onehot + eps/V uniform mass
+            onehot = (1.0 - eps) * onehot + eps / logits.shape[-1]
         grad = sm - onehot
     dy = ogs["Loss"][0].astype(jnp.float32)
     return {"Logits": [(grad * dy).astype(logits.dtype)], "Label": [None]}
@@ -73,6 +77,12 @@ def softmax_with_cross_entropy(attrs, ins):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
         loss = lse - _take_label_prob(x, label)
+        eps = attrs.get("label_smoothing", 0.0)
+        if eps:
+            # -sum(((1-eps)*onehot + eps/V) * logp) = lse
+            #   - (1-eps)*x_label - (eps/V)*sum(x)
+            loss = (1.0 - eps) * loss + eps * (
+                lse - jnp.mean(x, axis=-1, keepdims=True))
     return {"Softmax": [jnp.exp(x - lse)], "Loss": [loss]}
 
 
@@ -130,30 +140,41 @@ def _fhce_chunk_logits(x2, w3, i, chunk, vocab):
 
 
 def _fhce_lse_chunk(x2, w3, i, chunk, vocab, lab, carry):
-    """One online-logsumexp step over chunk ``i``; carry = (m, s, ll).
+    """One online-logsumexp step over chunk ``i``; carry = (m, s, ll, rs)
+    with rs the per-row sum of valid logits (the label-smoothing term).
     Out-of-range labels (< 0 or >= vocab) never gather — callers with
     vocab shards map foreign labels to -1."""
-    m, s, ll = carry
+    m, s, ll, rs = carry
     logits, _ = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
     m_c = jnp.max(logits, axis=1)
     m_new = jnp.maximum(m, m_c)
     s = s * jnp.exp(m - m_new) + jnp.sum(
         jnp.exp(logits - m_new[:, None]), axis=1)
     ll = ll + _fhce_gather(logits, lab, i * chunk, chunk)
-    return m_new, s, ll
+    rs = rs + jnp.sum(jnp.where(jnp.isneginf(logits), 0.0, logits),
+                      axis=1)
+    return m_new, s, ll, rs
 
 
-def _fhce_grad_chunk(x2, w3, i, chunk, vocab, lab, lse2, dl2):
+def _fhce_grad_chunk(x2, w3, i, chunk, vocab, lab, lse2, dl2,
+                     smoothing=0.0, full_vocab=None):
     """One backward step over chunk ``i``: (dX contribution [n, d],
-    dW chunk [d, chunk]) from g = (softmax - onehot) * dLoss. The ONE
-    definition shared by the serial and vocab-parallel backwards."""
+    dW chunk [d, chunk]) from g = (softmax - target) * dLoss, where the
+    target is the one-hot label or its label-smoothed form. The ONE
+    definition shared by the serial and vocab-parallel backwards.
+    ``full_vocab``: the GLOBAL vocabulary size the eps/V mass spreads
+    over (differs from ``vocab`` on a vocab shard)."""
     logits, wck = _fhce_chunk_logits(x2, w3, i, chunk, vocab)
     p = jnp.exp(logits - lse2)
     local = lab - i * chunk
-    onehot = jax.nn.one_hot(
+    target = jax.nn.one_hot(
         jnp.where((local >= 0) & (local < chunk), local, -1),
         chunk, dtype=jnp.float32)
-    g = ((p - onehot) * dl2).astype(x2.dtype)
+    if smoothing:
+        valid = ~jnp.isneginf(logits)
+        target = ((1.0 - smoothing) * target
+                  + (smoothing / (full_vocab or vocab)) * valid)
+    g = ((p - target) * dl2).astype(x2.dtype)
     dx_c = jax.lax.dot_general(
         g, wck, (((1,), (1,)), ((), ())),
         precision=mxu_precision(),
@@ -203,9 +224,10 @@ def _fused_head_ce_grad(attrs, ins, outs, ogs):
         if lse is None:
             lse = vp_fused_head_lse(x2, wc, lab, raw_chunk, mesh,
                                     vp_axis, data_axis)[0]
-        dx, dw = vp_fused_head_grad(x2, wc, lab, dl,
-                                    lse.reshape(n).astype(jnp.float32),
-                                    raw_chunk, mesh, vp_axis, data_axis)
+        dx, dw = vp_fused_head_grad(
+            x2, wc, lab, dl, lse.reshape(n).astype(jnp.float32),
+            raw_chunk, mesh, vp_axis, data_axis,
+            smoothing=attrs.get("label_smoothing", 0.0))
         return {"X": [dx.reshape(x.shape).astype(x.dtype)],
                 "W": [dw.astype(w.dtype)],
                 "Label": [None]}
@@ -213,6 +235,7 @@ def _fused_head_ce_grad(attrs, ins, outs, ogs):
     if lse is None:
         lse = _fhce_lse(x2, wc, lab, chunk, n_chunks)[0]
     lse = lse.reshape(n, 1).astype(jnp.float32)
+    eps = attrs.get("label_smoothing", 0.0)
 
     w3 = _fhce_w3(wc, chunk, n_chunks, vocab)
     dl2 = dl[:, None]
@@ -220,7 +243,7 @@ def _fused_head_ce_grad(attrs, ins, outs, ogs):
     def body(i, carry):
         dx_acc, dw_acc = carry
         dx_c, dw_c = _fhce_grad_chunk(x2, w3, i, chunk, vocab, lab, lse,
-                                      dl2)
+                                      dl2, smoothing=eps)
         return (dx_acc + dx_c,
                 jax.lax.dynamic_update_index_in_dim(dw_acc, dw_c, i,
                                                     axis=1))
@@ -235,7 +258,7 @@ def _fused_head_ce_grad(attrs, ins, outs, ogs):
 
 
 def _fhce_lse(x2, wc, lab, chunk, n_chunks):
-    """Online logsumexp + label-logit gather over vocab chunks."""
+    """(lse, label logit, row logit-sum) over vocab chunks (online)."""
     vocab = wc.shape[-1]
     w3 = _fhce_w3(wc, chunk, n_chunks, vocab)
     n = x2.shape[0]
@@ -244,10 +267,10 @@ def _fhce_lse(x2, wc, lab, chunk, n_chunks):
         return _fhce_lse_chunk(x2, w3, i, chunk, vocab, lab, carry)
 
     m0 = jnp.full((n,), -jnp.inf, jnp.float32)
-    s0 = jnp.zeros((n,), jnp.float32)
-    ll0 = jnp.zeros((n,), jnp.float32)
-    m, s, ll = jax.lax.fori_loop(0, n_chunks, body, (m0, s0, ll0))
-    return m + jnp.log(s), ll
+    zeros = jnp.zeros((n,), jnp.float32)
+    m, s, ll, rs = jax.lax.fori_loop(0, n_chunks, body,
+                                     (m0, zeros, zeros, zeros))
+    return m + jnp.log(s), ll, rs
 
 
 @register_op("fused_head_cross_entropy", grad_fn=_fused_head_ce_grad)
@@ -278,17 +301,23 @@ def fused_head_cross_entropy(attrs, ins):
     x2 = xc.reshape(n, d)
     lab = label.reshape(n).astype(jnp.int32)
     raw_chunk = attrs.get("chunk", 8192)
+    eps = attrs.get("label_smoothing", 0.0)
     mesh = _fhce_vp_mesh(attrs)
     if mesh is not None:
         from ..parallel.vocab_parallel_loss import vp_fused_head_lse
 
-        lse, ll = vp_fused_head_lse(
+        lse, ll, rs = vp_fused_head_lse(
             x2, wc, lab, raw_chunk, mesh,
             attrs.get("model_axis", "mp"), attrs.get("data_axis", "dp"))
     else:
         chunk, n_chunks = _fhce_chunks(vocab, raw_chunk)
-        lse, ll = _fhce_lse(x2, wc, lab, chunk, n_chunks)
-    loss = (lse - ll).reshape(lead + (1,))
+        lse, ll, rs = _fhce_lse(x2, wc, lab, chunk, n_chunks)
+    loss = lse - ll
+    if eps:
+        # target (1-eps)*onehot + eps/V: loss = lse - (1-eps)*x_label
+        #   - (eps/V)*sum(x)
+        loss = (1.0 - eps) * (lse - ll) + eps * (lse - rs / vocab)
+    loss = loss.reshape(lead + (1,))
     return {"Loss": [loss], "LSE": [lse.reshape(lead)]}
 
 

@@ -303,6 +303,11 @@ def lr_schedule(attrs, ins):
         s = jnp.maximum(step, 1.0)
         lr = (d_model ** -0.5) * jnp.minimum(s ** -0.5,
                                              s * warmup ** -1.5)
+    elif policy == "cosine":  # cosine annealing (modern LM default)
+        alpha = attrs.get("alpha", 0.0)
+        frac = jnp.minimum(step, float(decay_steps)) / decay_steps
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        lr = lr0 * ((1.0 - alpha) * cos + alpha)
     else:
         raise ValueError(f"unknown lr_schedule policy {policy!r}")
     return out(Out=lr.reshape(1))

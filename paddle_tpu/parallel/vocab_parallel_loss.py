@@ -40,7 +40,8 @@ def _shard_local_labels(labl, base, vl):
 
 
 def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
-    """(global lse [n], global label-logit [n]) over a vocab-sharded w."""
+    """(global lse [n], global label-logit [n], global row logit-sum [n])
+    over a vocab-sharded w."""
     from ..ops.loss_ops import _fhce_chunks, _fhce_lse_chunk, _fhce_w3
 
     nshard = mesh.shape[vp_axis]
@@ -54,7 +55,7 @@ def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
     xs, ws, vs, varying = _axes(mesh, data_axis, vp_axis)
 
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(xs, ws, vs),
-                       out_specs=(vs, vs))
+                       out_specs=(vs, vs, vs))
     def run(x2l, wl, labl):
         base = jax.lax.axis_index(vp_axis) * vl
         lab_l = _shard_local_labels(labl, base, vl)
@@ -62,12 +63,12 @@ def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
         n = x2l.shape[0]
         # carries become device-varying once shard data mixes in
         # (shard_map vma typing) — pcast them up front
+        zeros = jnp.zeros((n,), jnp.float32)
         carry = tuple(
             jax.lax.pcast(a, varying, to="varying")
             for a in (jnp.full((n,), -jnp.inf, jnp.float32),
-                      jnp.zeros((n,), jnp.float32),
-                      jnp.zeros((n,), jnp.float32)))
-        m, s, ll = jax.lax.fori_loop(
+                      zeros, zeros, zeros))
+        m, s, ll, rs = jax.lax.fori_loop(
             0, n_chunks_l,
             lambda i, c: _fhce_lse_chunk(x2l, w3, i, chunk_l, vl,
                                          lab_l, c),
@@ -76,13 +77,14 @@ def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
         m_g = jax.lax.pmax(lse_l, vp_axis)
         lse_g = m_g + jnp.log(jax.lax.psum(jnp.exp(lse_l - m_g), vp_axis))
         ll_g = jax.lax.psum(ll, vp_axis)
-        return lse_g, ll_g
+        rs_g = jax.lax.psum(rs, vp_axis)
+        return lse_g, ll_g, rs_g
 
     return run(x2, w, lab)
 
 
 def vp_fused_head_grad(x2, w, lab, dl, lse, chunk, mesh, vp_axis,
-                       data_axis):
+                       data_axis, smoothing=0.0):
     """(dX [n, d] psummed over vocab shards, dW [d, vocab] shard-local,
     psummed over the data axis)."""
     from ..ops.loss_ops import _fhce_chunks, _fhce_grad_chunk, _fhce_w3
@@ -108,7 +110,9 @@ def vp_fused_head_grad(x2, w, lab, dl, lse, chunk, mesh, vp_axis,
         def body(i, carry):
             dx_acc, dw_acc = carry
             dx_c, dw_c = _fhce_grad_chunk(x2l, w3, i, chunk_l, vl,
-                                          lab_l, lse2, dl2)
+                                          lab_l, lse2, dl2,
+                                          smoothing=smoothing,
+                                          full_vocab=vocab)
             return (dx_acc + dx_c,
                     jax.lax.dynamic_update_index_in_dim(dw_acc, dw_c, i,
                                                         axis=1))

@@ -1,0 +1,188 @@
+"""Modern-recipe training pieces: cosine LR annealing and label
+smoothing (both beyond-reference), pinned against their closed forms and
+against the soft-label formulation they shortcut."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.learning_rate_decay import cosine_decay
+
+
+def test_cosine_decay_matches_closed_form():
+    lr0, steps, alpha = 0.2, 10, 0.1
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        lr = cosine_decay(lr0, steps, alpha=alpha)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    got = [float(np.asarray(exe.run(main, feed={}, fetch_list=[lr],
+                                    scope=scope)[0]).reshape(()))
+           for _ in range(14)]
+    # counter increments before the schedule reads it: step = 1, 2, ...
+    want = [lr0 * ((1 - alpha) * 0.5
+                   * (1 + np.cos(np.pi * min(s, steps) / steps)) + alpha)
+            for s in range(1, 15)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # clamped at alpha * lr0 past decay_steps
+    np.testing.assert_allclose(got[-1], alpha * lr0, rtol=1e-5)
+
+
+def test_cosine_decay_drives_training():
+    rng = np.random.RandomState(0)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(layers.square(y))
+        lr = cosine_decay(0.1, 50)
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=lr, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {"x": rng.rand(8, 4).astype("float32")}
+    ls = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                   scope=scope)[0])) for _ in range(30)]
+    assert ls[-1] < ls[0] * 0.1, (ls[0], ls[-1])
+
+
+def _smooth_nets(eps, vocab=12, n=6, d=8):
+    """(hard+smoothing build, explicit soft-label build) — must agree."""
+    def feed_of(rng):
+        x = rng.randn(n, d).astype("float32")
+        lab = rng.randint(0, vocab, (n, 1)).astype("int64")
+        soft = np.full((n, vocab), eps / vocab, "float32")
+        soft[np.arange(n), lab[:, 0]] += 1.0 - eps
+        return {"x": x, "lab": lab, "soft": soft}
+
+    def smoothed(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=vocab, bias_attr=False,
+                           param_attr=pt.ParamAttr(name="smw"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, lab, label_smoothing=eps))
+        return loss, feed_of(rng)
+
+    def soft(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        soft_t = layers.data("soft", shape=[vocab])
+        logits = layers.fc(x, size=vocab, bias_attr=False,
+                           param_attr=pt.ParamAttr(name="smw"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, soft_t, soft_label=True))
+        return loss, feed_of(rng)
+
+    return smoothed, soft
+
+
+def _run(build, fetch, seed=0):
+    rng = np.random.RandomState(seed)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, feed = build(rng)
+        pt.optimizer.SGDOptimizer(learning_rate=0.0).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    outs = exe.run(main, feed=feed, fetch_list=[loss] + fetch,
+                   scope=scope)
+    return [np.asarray(o, dtype=np.float32) for o in outs]
+
+
+@pytest.mark.parametrize("eps", [0.1, 0.3])
+def test_label_smoothing_equals_explicit_soft_target(eps):
+    smoothed, soft = _smooth_nets(eps)
+    fetch = ["x@GRAD", "smw@GRAD"]
+    got = _run(smoothed, fetch, seed=2)
+    want = _run(soft, fetch, seed=2)
+    for g, w, name in zip(got, want, ["loss"] + fetch):
+        np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-6,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("vocab,chunk", [(24, 8), (26, 8)])
+def test_fused_head_label_smoothing_matches_unfused(vocab, chunk):
+    """Smoothing through the chunked fused head == fc + smoothed CE,
+    including a padded tail chunk (vocab 26)."""
+    eps, n, d = 0.2, 6, 8
+
+    def feed_of(rng):
+        return {"x": rng.randn(n, d).astype("float32"),
+                "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+
+    def fused(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.mean(layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=chunk, label_smoothing=eps,
+            param_attr=pt.ParamAttr(name="fsw")))
+        return loss, feed_of(rng)
+
+    def ref(rng):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        logits = layers.fc(x, size=vocab, bias_attr=False,
+                           param_attr=pt.ParamAttr(name="fsw"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, lab, label_smoothing=eps))
+        return loss, feed_of(rng)
+
+    fetch = ["x@GRAD", "fsw@GRAD"]
+    got = _run(fused, fetch, seed=3)
+    want = _run(ref, fetch, seed=3)
+    for g, w, name in zip(got, want, ["loss"] + fetch):
+        np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-6,
+                                   err_msg=name)
+
+
+def test_fused_head_vp_label_smoothing_matches_single_device():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    n, d, vocab, chunk, eps = 8, 8, 48, 8, 0.15
+    rng = np.random.RandomState(11)
+    feed = {"x": rng.randn(n, d).astype("float32"),
+            "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[d])
+        x.stop_gradient = False
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.mean(layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=chunk, label_smoothing=eps,
+            vocab_parallel=True,
+            param_attr=pt.ParamAttr(name="vsw")))
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+            loss, startup_program=startup)
+
+    single = pt.Executor(pt.CPUPlace())
+    scope1 = pt.Scope()
+    with jax.default_device(jax.devices()[0]):
+        single.run(startup, scope=scope1)
+        ref = [float(np.asarray(single.run(main, feed=feed,
+                                           fetch_list=[loss],
+                                           scope=scope1)[0]))
+               for _ in range(3)]
+
+    mesh = make_mesh({"mp": 8})
+    plan = ShardingPlan(mesh, rules=[(r"vsw", P(None, "mp"))],
+                        data_axis=None)
+    spmd = pt.Executor(pt.TPUPlace(), mesh=mesh, plan=plan)
+    scope2 = pt.Scope()
+    spmd.run(startup, scope=scope2)
+    got = [float(np.asarray(spmd.run(main, feed=feed, fetch_list=[loss],
+                                     scope=scope2)[0]))
+           for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
