@@ -273,7 +273,8 @@ def _fhce_lse(x2, wc, lab, chunk, n_chunks):
     return m + jnp.log(s), ll, rs
 
 
-@register_op("fused_head_cross_entropy", grad_fn=_fused_head_ce_grad)
+@register_op("fused_head_cross_entropy", grad_fn=_fused_head_ce_grad,
+             grad_fn_is_optimization=True)
 def fused_head_cross_entropy(attrs, ins):
     """LM-head projection + softmax cross-entropy WITHOUT materializing
     the [tokens, vocab] logits tensor (beyond-reference; the reference's

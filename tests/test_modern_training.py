@@ -144,6 +144,67 @@ def test_fused_head_label_smoothing_matches_unfused(vocab, chunk):
                                    err_msg=name)
 
 
+def test_vp_head_checkpoint_restores_on_different_topology(tmp_path):
+    """Train the vocab-parallel head on an mp mesh, checkpoint, restore
+    into a SINGLE-DEVICE executor, and keep training: the elastic
+    train-sharded / serve-unsharded cycle."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.checkpoint import load_checkpoint, save_checkpoint
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    n, d, vocab = 8, 8, 32
+    rng = np.random.RandomState(21)
+    feed = {"x": rng.randn(n, d).astype("float32"),
+            "lab": rng.randint(0, vocab, (n, 1)).astype("int64")}
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[d])
+        lab = layers.data("lab", shape=[1], dtype="int64")
+        loss = layers.mean(layers.fused_head_cross_entropy(
+            x, lab, num_classes=vocab, chunk=8, vocab_parallel=True,
+            param_attr=pt.ParamAttr(name="ckw")))
+        pt.optimizer.AdamWOptimizer(learning_rate=0.05,
+                                    weight_decay=0.01).minimize(
+            loss, startup_program=startup)
+
+    mesh = make_mesh({"mp": 8})
+    plan = ShardingPlan(mesh, rules=[(r"ckw", P(None, "mp"))],
+                        data_axis=None)
+    spmd = pt.Executor(pt.TPUPlace(), mesh=mesh, plan=plan)
+    scope = pt.Scope()
+    spmd.run(startup, scope=scope)
+    sharded = [float(np.asarray(spmd.run(main, feed=feed,
+                                         fetch_list=[loss],
+                                         scope=scope)[0]))
+               for _ in range(4)]
+    save_checkpoint(str(tmp_path / "ck"), scope=scope, step=4)
+
+    # reference: the same 8 steps on one device from the same init
+    with jax.default_device(jax.devices()[0]):
+        ref_scope = pt.Scope()
+        single = pt.Executor(pt.CPUPlace())
+        single.run(startup, scope=ref_scope)
+        ref = [float(np.asarray(single.run(main, feed=feed,
+                                           fetch_list=[loss],
+                                           scope=ref_scope)[0]))
+               for _ in range(8)]
+
+        # elastic restore: sharded checkpoint -> single-device executor
+        scope2 = pt.Scope()
+        single.run(startup, scope=scope2)
+        load_checkpoint(str(tmp_path / "ck"), scope=scope2)
+        resumed = [float(np.asarray(single.run(main, feed=feed,
+                                               fetch_list=[loss],
+                                               scope=scope2)[0]))
+                   for _ in range(4)]
+    np.testing.assert_allclose(sharded + resumed, ref, rtol=2e-4,
+                               atol=2e-5)
+
+
 def test_fused_head_vp_label_smoothing_matches_single_device():
     import jax
     from jax.sharding import PartitionSpec as P
