@@ -326,6 +326,16 @@ struct OpDesc {
     auto* a = attr(name);
     return a && a->type == JValue::kStr ? a->str : dflt;
   }
+  // integer-list attrs (split sections, slice axes/starts/ends)
+  std::vector<int64_t> attr_ints(const std::string& name) const {
+    std::vector<int64_t> v;
+    const JValue* a = attr(name);
+    if (a && a->type == JValue::kArr)
+      for (const auto& e : a->arr) v.push_back(static_cast<int64_t>(e.num));
+    else if (a && a->type == JValue::kNum)
+      v.push_back(static_cast<int64_t>(a->num));
+    return v;
+  }
   // int-or-[int, int] attrs (strides/paddings/ksize)
   void attr_pair(const std::string& name, int dflt, int* a_, int* b_) const {
     const JValue* a = attr(name);
@@ -1020,6 +1030,264 @@ bool k_sequence_pool(Machine& m, const OpDesc& op) {
   return true;
 }
 
+// ---------------------------------------------------------------------
+// Transformer inference kernels (the per-layer encoder path: layer_norm /
+// rms_norm, split/slice, rotary positions, scaled-dot-product attention
+// with GQA broadcast). Mirrors ops/attention_ops.py + ops/nn_ops.py
+// semantics in plain loops, f32.
+// ---------------------------------------------------------------------
+static int64_t prod_range(const std::vector<int64_t>& shape, size_t a,
+                          size_t b) {
+  int64_t p = 1;
+  for (size_t i = a; i < b && i < shape.size(); ++i) p *= shape[i];
+  return p;
+}
+
+bool k_layer_norm(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor* scale = opt_in(m, op, "Scale");
+  Tensor* bias = opt_in(m, op, "Bias");
+  float eps = static_cast<float>(op.attr_num("epsilon", 1e-5));
+  int begin = static_cast<int>(op.attr_num("begin_norm_axis", 1));
+  int64_t rows = prod_range(x->shape, 0, static_cast<size_t>(begin));
+  int64_t cols = x->numel() / rows;
+  Tensor& o = set_out(m, op, "Y");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x->data.data() + r * cols;
+    float* oi = o.data.data() + r * cols;
+    double mean = 0;
+    for (int64_t c = 0; c < cols; ++c) mean += xi[c];
+    mean /= static_cast<double>(cols);
+    double var = 0;
+    for (int64_t c = 0; c < cols; ++c) {
+      double d = xi[c] - mean;
+      var += d * d;
+    }
+    var /= static_cast<double>(cols);
+    float inv = 1.0f / std::sqrt(static_cast<float>(var) + eps);
+    for (int64_t c = 0; c < cols; ++c) {
+      float y = (xi[c] - static_cast<float>(mean)) * inv;
+      if (scale) y *= scale->data[static_cast<size_t>(c)];
+      if (bias) y += bias->data[static_cast<size_t>(c)];
+      oi[c] = y;
+    }
+  }
+  return true;
+}
+
+bool k_rms_norm(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  Tensor* scale = opt_in(m, op, "Scale");
+  Tensor* bias = opt_in(m, op, "Bias");
+  float eps = static_cast<float>(op.attr_num("epsilon", 1e-6));
+  int begin = static_cast<int>(op.attr_num("begin_norm_axis", 1));
+  int64_t rows = prod_range(x->shape, 0, static_cast<size_t>(begin));
+  int64_t cols = x->numel() / rows;
+  Tensor& o = set_out(m, op, "Y");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x->data.data() + r * cols;
+    float* oi = o.data.data() + r * cols;
+    double ms = 0;
+    for (int64_t c = 0; c < cols; ++c) ms += double(xi[c]) * xi[c];
+    float inv = 1.0f /
+        std::sqrt(static_cast<float>(ms / double(cols)) + eps);
+    for (int64_t c = 0; c < cols; ++c) {
+      float y = xi[c] * inv;
+      if (scale) y *= scale->data[static_cast<size_t>(c)];
+      if (bias) y += bias->data[static_cast<size_t>(c)];
+      oi[c] = y;
+    }
+  }
+  return true;
+}
+
+bool k_split(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  int axis = static_cast<int>(op.attr_num("axis", 0));
+  if (axis < 0) axis += static_cast<int>(x->shape.size());
+  std::vector<int64_t> sections = op.attr_ints("sections");
+  auto oit = op.outs.find("Out");
+  if (oit == op.outs.end()) { m.error = "split: no Out"; return false; }
+  const auto& names = oit->second;
+  if (sections.empty()) {
+    int64_t num = static_cast<int64_t>(op.attr_num(
+        "num", static_cast<double>(names.size())));
+    sections.assign(static_cast<size_t>(num),
+                    x->shape[static_cast<size_t>(axis)] / num);
+  }
+  int64_t pre = prod_range(x->shape, 0, static_cast<size_t>(axis));
+  int64_t ax = x->shape[static_cast<size_t>(axis)];
+  int64_t post = x->numel() / (pre * ax);
+  int64_t off = 0;
+  for (size_t s = 0; s < names.size(); ++s) {
+    Tensor& o = m.env[names[s]];
+    o.shape = x->shape;
+    o.shape[static_cast<size_t>(axis)] = sections[s];
+    o.data.resize(static_cast<size_t>(pre * sections[s] * post));
+    for (int64_t p = 0; p < pre; ++p)
+      std::copy(x->data.data() + (p * ax + off) * post,
+                x->data.data() + (p * ax + off + sections[s]) * post,
+                o.data.data() + p * sections[s] * post);
+    off += sections[s];
+  }
+  return true;
+}
+
+bool k_slice(Machine& m, const OpDesc& op) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  std::vector<int64_t> axes = op.attr_ints("axes");
+  std::vector<int64_t> starts = op.attr_ints("starts");
+  std::vector<int64_t> ends = op.attr_ints("ends");
+  std::vector<int64_t> lo(x->shape.size(), 0), hi = x->shape;
+  for (size_t i = 0; i < axes.size(); ++i) {
+    size_t ax = static_cast<size_t>(axes[i]);
+    int64_t dim = x->shape[ax];
+    int64_t st = starts[i] < 0 ? starts[i] + dim : starts[i];
+    int64_t en = ends[i] < 0 ? ends[i] + dim : ends[i];
+    lo[ax] = std::max<int64_t>(0, st);
+    hi[ax] = std::min<int64_t>(dim, en);
+  }
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.resize(x->shape.size());
+  for (size_t i = 0; i < x->shape.size(); ++i) o.shape[i] = hi[i] - lo[i];
+  o.data.resize(static_cast<size_t>(o.numel()));
+  // generic strided copy
+  std::vector<int64_t> xstr(x->shape.size(), 1), ostr(o.shape.size(), 1);
+  for (int i = static_cast<int>(x->shape.size()) - 2; i >= 0; --i) {
+    xstr[i] = xstr[i + 1] * x->shape[i + 1];
+    ostr[i] = ostr[i + 1] * o.shape[i + 1];
+  }
+  for (int64_t oi = 0; oi < o.numel(); ++oi) {
+    int64_t rem = oi, xi = 0;
+    for (size_t d = 0; d < o.shape.size(); ++d) {
+      int64_t id = rem / ostr[d];
+      rem %= ostr[d];
+      xi += (id + lo[d]) * xstr[d];
+    }
+    o.data[static_cast<size_t>(oi)] = x->data[static_cast<size_t>(xi)];
+  }
+  return true;
+}
+
+bool k_gelu(Machine& m, const OpDesc& op) {
+  // tanh approximation — jax.nn.gelu's DEFAULT (approximate=True), which
+  // is what ops/activation_ops.py registers; exact-erf GELU differs by
+  // up to ~5e-4 per activation and breaks executor parity
+  return k_unary(m, op, [](float v) {
+    float c = 0.7978845608028654f;  // sqrt(2/pi)
+    float u = c * (v + 0.044715f * v * v * v);
+    return 0.5f * v * (1.0f + std::tanh(u));
+  });
+}
+
+bool k_rotary_embed(Machine& m, const OpDesc& op) {
+  Tensor* x;  // [B, H, T, D]
+  if (!need(m, op, "X", &x)) return false;
+  if (x->shape.size() != 4) { m.error = "rotary_embed: rank != 4"; return false; }
+  double base = op.attr_num("base", 10000.0);
+  int64_t B = x->shape[0], H = x->shape[1], T = x->shape[2],
+          D = x->shape[3];
+  int64_t half = D / 2;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = x->shape;
+  o.data.resize(x->data.size());
+  // the angle depends only on (t, i): one [T, half] cos/sin table
+  // instead of B*H repeated transcendentals
+  std::vector<float> cst(static_cast<size_t>(T * half)),
+      snt(static_cast<size_t>(T * half));
+  for (int64_t t = 0; t < T; ++t)
+    for (int64_t i = 0; i < half; ++i) {
+      // ops/attention_ops.py: pair (x[2i], x[2i+1]) rotates by
+      // pos * base^(-i/half)
+      double ang = double(t) * std::pow(base, -double(i) / double(half));
+      cst[static_cast<size_t>(t * half + i)] =
+          static_cast<float>(std::cos(ang));
+      snt[static_cast<size_t>(t * half + i)] =
+          static_cast<float>(std::sin(ang));
+    }
+  for (int64_t b = 0; b < B; ++b)
+    for (int64_t h = 0; h < H; ++h)
+      for (int64_t t = 0; t < T; ++t) {
+        const float* xi = x->data.data() + ((b * H + h) * T + t) * D;
+        float* oi = o.data.data() + ((b * H + h) * T + t) * D;
+        const float* ct = cst.data() + t * half;
+        const float* st = snt.data() + t * half;
+        for (int64_t i = 0; i < half; ++i) {
+          float x1 = xi[2 * i], x2 = xi[2 * i + 1];
+          oi[2 * i] = x1 * ct[i] - x2 * st[i];
+          oi[2 * i + 1] = x1 * st[i] + x2 * ct[i];
+        }
+      }
+  return true;
+}
+
+bool k_sdpa(Machine& m, const OpDesc& op) {
+  Tensor *q, *k, *v;  // Q [B, H, T, D], K/V [B, Hkv, Tk, D]
+  if (!need(m, op, "Q", &q) || !need(m, op, "K", &k) ||
+      !need(m, op, "V", &v))
+    return false;
+  Tensor* len = opt_in(m, op, "Length");
+  bool causal = op.attr_bool("causal", false);
+  int64_t B = q->shape[0], H = q->shape[1], Tq = q->shape[2],
+          D = q->shape[3];
+  int64_t Hkv = k->shape[1], Tk = k->shape[2];
+  if (H % Hkv) { m.error = "sdpa: H not a multiple of Hkv"; return false; }
+  int64_t group = H / Hkv;
+  float scale = static_cast<float>(
+      op.attr_num("sm_scale", 1.0 / std::sqrt(double(D))));
+  Tensor& o = set_out(m, op, "Out");
+  o.shape = q->shape;
+  o.data.resize(q->data.size());
+  std::vector<float> row(static_cast<size_t>(Tk));
+  for (int64_t b = 0; b < B; ++b) {
+    int64_t limit = Tk;
+    if (len)
+      limit = std::min(
+          Tk, static_cast<int64_t>(len->data[static_cast<size_t>(b)]));
+    for (int64_t h = 0; h < H; ++h) {
+      int64_t hk = h / group;
+      const float* kb = k->data.data() + (b * Hkv + hk) * Tk * D;
+      const float* vb = v->data.data() + (b * Hkv + hk) * Tk * D;
+      for (int64_t tq = 0; tq < Tq; ++tq) {
+        const float* qi = q->data.data() + ((b * H + h) * Tq + tq) * D;
+        int64_t kmax = causal ? std::min(limit, tq + 1) : limit;
+        float mx = -1e30f;
+        for (int64_t tk = 0; tk < kmax; ++tk) {
+          float s = 0;
+          const float* ki = kb + tk * D;
+          for (int64_t d = 0; d < D; ++d) s += qi[d] * ki[d];
+          row[static_cast<size_t>(tk)] = s * scale;
+          mx = std::max(mx, row[static_cast<size_t>(tk)]);
+        }
+        float sum = 0;
+        for (int64_t tk = 0; tk < kmax; ++tk) {
+          row[static_cast<size_t>(tk)] =
+              std::exp(row[static_cast<size_t>(tk)] - mx);
+          sum += row[static_cast<size_t>(tk)];
+        }
+        float* oi = o.data.data() + ((b * H + h) * Tq + tq) * D;
+        for (int64_t d = 0; d < D; ++d) oi[d] = 0;
+        if (sum > 0 && kmax > 0) {
+          for (int64_t tk = 0; tk < kmax; ++tk) {
+            float p = row[static_cast<size_t>(tk)] / sum;
+            const float* vi = vb + tk * D;
+            for (int64_t d = 0; d < D; ++d) oi[d] += p * vi[d];
+          }
+        }
+      }
+    }
+  }
+  return true;
+}
+
 bool run_op(Machine& m, const OpDesc& op) {
   const std::string& t = op.type;
   if (t == "mul") return k_mul(m, op);
@@ -1054,11 +1322,19 @@ bool run_op(Machine& m, const OpDesc& op) {
   if (t == "lstm") return k_lstm(m, op);
   if (t == "gru") return k_gru(m, op);
   if (t == "sequence_pool") return k_sequence_pool(m, op);
+  if (t == "layer_norm") return k_layer_norm(m, op);
+  if (t == "rms_norm") return k_rms_norm(m, op);
+  if (t == "split") return k_split(m, op);
+  if (t == "slice") return k_slice(m, op);
+  if (t == "gelu") return k_gelu(m, op);
+  if (t == "rotary_embed") return k_rotary_embed(m, op);
+  if (t == "scaled_dot_product_attention") return k_sdpa(m, op);
   m.error = "unsupported op in capi inference machine: '" + t +
             "' (supported: mul, elementwise_*, relu/sigmoid/tanh/exp/sqrt/"
-            "abs/square, softmax, conv2d, pool2d, batch_norm, reshape, "
-            "concat, scale, dropout, mean, transpose, assign, lookup_table, "
-            "lstm, gru, sequence_pool)";
+            "abs/square/gelu, softmax, conv2d, pool2d, batch_norm, "
+            "layer_norm, rms_norm, reshape, concat, split, slice, scale, "
+            "dropout, mean, transpose, assign, lookup_table, lstm, gru, "
+            "sequence_pool, rotary_embed, scaled_dot_product_attention)";
   return false;
 }
 

@@ -249,3 +249,34 @@ class TestCapiRecomputeTrainedModel:
             got, = machine.run({"img": x})
         np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
                                    atol=1e-5)
+
+
+class TestCapiTransformer:
+    """The flagship per-layer transformer deploys through the C machine:
+    layer_norm/rms_norm, split/slice, gelu, rotary positions, and
+    scaled-dot-product attention with GQA — executor-parity tested."""
+
+    @pytest.mark.parametrize("norm,rope,kv", [("layer_norm", False, None),
+                                              ("rms_norm", True, 2)])
+    def test_transformer_lm_matches_executor(self, tmp_path, norm, rope,
+                                             kv):
+        vocab, T, d = 40, 10, 16
+
+        def build():
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=2, num_heads=4,
+                num_kv_heads=kv, use_rope=rope, norm_type=norm,
+                max_len=T)
+            return [ids], [layers.softmax(logits)]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        rng = np.random.RandomState(3)
+        feed = {"ids": rng.randint(0, vocab, size=(3, T)).astype(np.int64)}
+        ref, = exe.run(main, feed=feed, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d_) as machine:
+            got, = machine.run(feed)
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=2e-4)
