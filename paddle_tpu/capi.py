@@ -101,6 +101,36 @@ class InferenceMachine:
             outs.append(buf.reshape(tuple(shape[:rank])))
         return outs
 
+    def generate(self, prompt, max_new_tokens: int, seq_len: int,
+                 input_name: str = None, fetch_index: int = 0,
+                 pad_id: int = 0) -> np.ndarray:
+        """Greedy autoregressive decode through the C machine.
+
+        The saved per-layer LM has a STATIC [*, seq_len] input (its
+        position table is sliced at build time), so each step feeds the
+        ids buffer padded to ``seq_len`` and re-runs the full forward —
+        causal attention makes positions past the cursor irrelevant.
+        O(n * full-forward): the native serving loop for deployments
+        without the KV-cache path. The fetched target must be the
+        [*, seq_len, vocab] next-token distribution (logits or softmax).
+        prompt: [b, p] ints -> [b, p + max_new_tokens]."""
+        prompt = np.asarray(prompt, dtype=np.int64)
+        b, p = prompt.shape
+        if p < 1:
+            raise ValueError("generate needs at least one prompt token "
+                             "(position -1 would wrap to the pad tail)")
+        if p + max_new_tokens > seq_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the model's static seq_len ({seq_len})")
+        name = input_name or self.feed_names[0]
+        ids = np.full((b, seq_len), pad_id, np.int64)
+        ids[:, :p] = prompt
+        for cur in range(p, p + max_new_tokens):
+            probs = self.run({name: ids})[fetch_index]
+            ids[:, cur] = probs[:, cur - 1, :].argmax(-1)
+        return ids[:, :p + max_new_tokens]
+
     def close(self):
         if getattr(self, "_h", None):
             self._lib.pdtpu_free(self._h)

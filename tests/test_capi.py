@@ -280,3 +280,50 @@ class TestCapiTransformer:
             got, = machine.run(feed)
         np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
                                    atol=2e-4)
+
+    def test_generate_matches_executor_greedy(self, tmp_path):
+        """The C machine's greedy decode loop == an executor-side greedy
+        loop over the same saved model."""
+        vocab, T, d = 24, 12, 16
+
+        def build():
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=2, num_heads=2,
+                norm_type="rms_norm", use_rope=True, max_len=T)
+            return [ids], [layers.softmax(logits)]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        rng = np.random.RandomState(7)
+        b, p, n_new = 2, 4, 6
+        prompt = rng.randint(0, vocab, size=(b, p)).astype(np.int64)
+
+        # executor-side greedy reference over the same program; track the
+        # top-2 probability gap at every chosen step so float drift
+        # between the C forward and the executor (~3e-7 after the gelu
+        # alignment; bound kept 1000x above it) cannot flip an argmax
+        # near-tie into a flake
+        ids = np.zeros((b, T), np.int64)
+        ids[:, :p] = prompt
+        min_gap = np.inf
+        for cur in range(p, p + n_new):
+            (probs,) = exe.run(main, feed={"ids": ids},
+                               fetch_list=targets, scope=scope)
+            row = np.asarray(probs)[:, cur - 1, :]
+            top2 = np.sort(row, axis=-1)[:, -2:]
+            min_gap = min(min_gap, float((top2[:, 1] - top2[:, 0]).min()))
+            ids[:, cur] = row.argmax(-1)
+        want = ids[:, :p + n_new]
+        assert min_gap > 5e-4, (
+            f"seed produced a near-tie (gap {min_gap}); pick a seed whose "
+            "greedy path is robust to C-vs-executor drift")
+
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d_) as machine:
+            got = machine.generate(prompt, n_new, seq_len=T)
+        np.testing.assert_array_equal(got, want)
+
+        with InferenceMachine(d_) as machine, \
+                pytest.raises(ValueError, match="at least one"):
+            machine.generate(np.empty((1, 0), np.int64), 2, seq_len=T)
