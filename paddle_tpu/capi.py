@@ -103,8 +103,11 @@ class InferenceMachine:
 
     def generate(self, prompt, max_new_tokens: int, seq_len: int,
                  input_name: str = None, fetch_index: int = 0,
-                 pad_id: int = 0) -> np.ndarray:
-        """Greedy autoregressive decode through the C machine.
+                 pad_id: int = 0, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0) -> np.ndarray:
+        """Autoregressive decode through the C machine — greedy by
+        default; ``temperature`` > 0 samples (optionally ``top_k``
+        truncated) on the host from the C-computed distribution.
 
         The saved per-layer LM has a STATIC [*, seq_len] input (its
         position table is sliced at build time), so each step feeds the
@@ -112,7 +115,8 @@ class InferenceMachine:
         causal attention makes positions past the cursor irrelevant.
         O(n * full-forward): the native serving loop for deployments
         without the KV-cache path. The fetched target must be the
-        [*, seq_len, vocab] next-token distribution (logits or softmax).
+        [*, seq_len, vocab] next-token distribution (softmax probs when
+        sampling; logits also work for greedy).
         prompt: [b, p] ints -> [b, p + max_new_tokens]."""
         prompt = np.asarray(prompt, dtype=np.int64)
         b, p = prompt.shape
@@ -123,12 +127,31 @@ class InferenceMachine:
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the model's static seq_len ({seq_len})")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         name = input_name or self.feed_names[0]
+        rng = np.random.RandomState(seed)
         ids = np.full((b, seq_len), pad_id, np.int64)
         ids[:, :p] = prompt
         for cur in range(p, p + max_new_tokens):
-            probs = self.run({name: ids})[fetch_index]
-            ids[:, cur] = probs[:, cur - 1, :].argmax(-1)
+            row = self.run({name: ids})[fetch_index][:, cur - 1, :]
+            if temperature > 0:
+                z = np.log(np.maximum(row.astype(np.float64), 1e-30))
+                z /= temperature
+                if top_k:
+                    if not 0 < int(top_k) <= row.shape[-1]:
+                        raise ValueError(
+                            f"top_k must be in (0, vocab={row.shape[-1]}],"
+                            f" got {top_k}")
+                    kth = np.sort(z, axis=-1)[:, -int(top_k)][:, None]
+                    z = np.where(z >= kth, z, -np.inf)
+                z -= z.max(-1, keepdims=True)
+                pr = np.exp(z)
+                pr /= pr.sum(-1, keepdims=True)
+                ids[:, cur] = [rng.choice(pr.shape[-1], p=pr[i])
+                               for i in range(b)]
+            else:
+                ids[:, cur] = row.argmax(-1)
         return ids[:, :p + max_new_tokens]
 
     def close(self):

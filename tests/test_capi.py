@@ -327,3 +327,45 @@ class TestCapiTransformer:
         with InferenceMachine(d_) as machine, \
                 pytest.raises(ValueError, match="at least one"):
             machine.generate(np.empty((1, 0), np.int64), 2, seq_len=T)
+
+    def test_generate_sampling_respects_top_k(self, tmp_path):
+        """temperature/top-k sampling through the C machine: every
+        sampled token must come from that step's top-k of the executor
+        distribution, and sampling is reproducible under a seed."""
+        vocab, T, d = 24, 12, 16
+
+        def build():
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=1, num_heads=2,
+                max_len=T)
+            return [ids], [layers.softmax(logits)]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(0, vocab, size=(2, 3)).astype(np.int64)
+        from paddle_tpu.capi import InferenceMachine
+
+        k, n_new = 3, 5
+        with InferenceMachine(d_) as machine:
+            a = machine.generate(prompt, n_new, seq_len=T,
+                                 temperature=0.8, top_k=k, seed=5)
+            b = machine.generate(prompt, n_new, seq_len=T,
+                                 temperature=0.8, top_k=k, seed=5)
+        np.testing.assert_array_equal(a, b)  # seeded => reproducible
+        # each sampled token lies in the executor's top-k at its step
+        ids = np.zeros((2, T), np.int64)
+        ids[:, :3] = prompt
+        for cur in range(3, 3 + n_new):
+            ids[:, cur] = a[:, cur]
+            (probs,) = exe.run(main, feed={"ids": ids},
+                               fetch_list=targets, scope=scope)
+            row = np.asarray(probs)[:, cur - 1, :]
+            # guard the k boundary against C-vs-executor drift (~3e-7):
+            # accept membership in the top-k set widened by the tokens
+            # within that drift of the rank-k probability
+            srt = np.sort(row, axis=-1)
+            thresh = srt[:, -k] - 1e-5
+            for i in range(2):
+                assert row[i, a[i, cur]] >= thresh[i], (
+                    cur, a[i, cur], row[i, a[i, cur]], thresh[i])
