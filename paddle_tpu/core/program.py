@@ -360,6 +360,15 @@ def recompute_guard(main_program: Optional[Program] = None):
         p._recompute_seg = old
 
 
+def maybe_recompute(enabled: bool, main_program: Optional[Program] = None):
+    """``recompute_guard`` when enabled, else a no-op context — the one
+    helper model builders share so the guard always lands on the program
+    the ops are actually appended to."""
+    if enabled:
+        return recompute_guard(main_program)
+    return contextlib.nullcontext()
+
+
 @contextlib.contextmanager
 def program_guard(main_program: Program, startup_program: Optional[Program] = None):
     """Route layer construction into the given programs (fluid parity API)."""

@@ -18,13 +18,12 @@ rematerialization adds HBM traffic rather than removing it — see PERF.md
 """
 import contextlib
 
-from ..core.program import recompute_guard
+from ..core.program import maybe_recompute
 
 from .. import layers
 
 
-def _maybe_recompute(enabled):
-    return recompute_guard() if enabled else contextlib.nullcontext()
+_maybe_recompute = maybe_recompute
 
 
 def _conv_bn(x, num_filters, filter_size, stride=1, padding=0, act="relu",

@@ -85,11 +85,18 @@ def transformer_lm(ids, vocab_size, d_model=256, n_layers=4, num_heads=8,
         ln_bias = ParamAttr(name="final_ln.bias")
         head_attr = ParamAttr(name="lm_head.w")
     else:
+        from ..core.program import maybe_recompute
+
         for _ in range(n_layers):
-            x = layers.transformer_encoder_layer(
-                x, num_heads=num_heads, d_ff=d_ff,
-                num_kv_heads=num_kv_heads, use_rope=use_rope, causal=True,
-                norm_type=norm_type, **kw)
+            # remat: each block becomes one recompute segment — only its
+            # matmul outputs survive to the backward (the norms'
+            # grad_fn_is_optimization keeps them segment-eligible), the
+            # deep-stack activation-memory lever for the per-layer path
+            with maybe_recompute(remat, main_program):
+                x = layers.transformer_encoder_layer(
+                    x, num_heads=num_heads, d_ff=d_ff,
+                    num_kv_heads=num_kv_heads, use_rope=use_rope,
+                    causal=True, norm_type=norm_type, **kw)
     if norm_type == "rms_norm":
         x = layers.rms_norm(x, begin_norm_axis=2, **kw)
     else:
