@@ -230,6 +230,73 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
               main_program=pruned, predicate=_is_persistable, scope=scope)
 
 
+def quantize_inference_model(dirname: str, out_dirname: str,
+                             min_elems: int = 1024) -> List[str]:
+    """Weight-only per-output-channel int8 quantization of a saved
+    inference model, for the C machine (beyond-reference; the reference
+    era predates int8 deployment).
+
+    Eligible weights — f32 2-D params of at least ``min_elems`` whose
+    EVERY use in the program is as a ``mul`` right-hand side (fc / qkv /
+    head projections, the bulk of LM bytes) — are stored as int8 payload
+    + one f32 scale per output column (scale = max|w[:, c]| / 127) in
+    ``__quant__.json`` sidecars; everything else copies through. The C
+    machine keeps the int8 bytes in memory and folds the scales into the
+    matmul epilogue, so serving memory and artifact size drop ~4x for
+    the quantized weights. The quantized directory is C-machine-only
+    (the Python executor load path expects the f32 manifest)."""
+    import shutil
+
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        payload = json.load(f)
+    # a param is eligible only if every reference to it is mul's Y slot
+    usage_ok: dict = {}
+    for op in payload["program"]["blocks"][0]["ops"]:
+        for slot, names in op["inputs"].items():
+            for n in names:
+                ok = (op["type"] == "mul" and slot == "Y")
+                usage_ok[n] = usage_ok.get(n, True) and ok
+    os.makedirs(os.path.join(out_dirname, "params"), exist_ok=True)
+    shutil.copyfile(os.path.join(dirname, "__model__.json"),
+                    os.path.join(out_dirname, "__model__.json"))
+    with open(os.path.join(dirname, "params", "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    kept, quant, quantized = [], [], []
+    for entry in manifest:
+        arr = None
+        if "dtype" in entry:  # bf16 bit-view — leave on the f32 path
+            eligible = False
+        elif not usage_ok.get(entry["name"], False):
+            eligible = False
+        else:
+            arr = np.load(os.path.join(dirname, "params", entry["file"]))
+            eligible = (arr.dtype == np.float32 and arr.ndim == 2
+                        and arr.size >= min_elems)
+        if not eligible:
+            shutil.copyfile(os.path.join(dirname, "params", entry["file"]),
+                            os.path.join(out_dirname, "params",
+                                         entry["file"]))
+            kept.append(entry)
+            continue
+        scales = np.maximum(np.abs(arr).max(axis=0), 1e-12) / 127.0
+        q = np.clip(np.round(arr / scales), -127, 127).astype(np.int8)
+        base = entry["file"][:-4]
+        qfile, sfile = base + ".int8.bin", base + ".scale.bin"
+        q.tofile(os.path.join(out_dirname, "params", qfile))
+        scales.astype(np.float32).tofile(
+            os.path.join(out_dirname, "params", sfile))
+        quant.append({"name": entry["name"], "qfile": qfile,
+                      "sfile": sfile, "rows": int(arr.shape[0]),
+                      "cols": int(arr.shape[1])})
+        quantized.append(entry["name"])
+    with open(os.path.join(out_dirname, "params", "MANIFEST.json"),
+              "w") as f:
+        json.dump(kept, f, indent=1)
+    with open(os.path.join(out_dirname, "__quant__.json"), "w") as f:
+        json.dump(quant, f, indent=1)
+    return quantized
+
+
 def load_inference_model(dirname: str, executor, scope=None):
     """Returns (program, feed_names, fetch_names); parameters are loaded into
     the scope (reference io.py load_inference_model)."""

@@ -16,7 +16,6 @@ shows it trades ~45% step time for that memory (the barriered
 rematerialization adds HBM traffic rather than removing it — see PERF.md
 "recompute segments").
 """
-import contextlib
 
 from ..core.program import maybe_recompute
 

@@ -351,10 +351,17 @@ struct OpDesc {
   }
 };
 
+struct QTensor {  // weight-only int8 (per-output-channel scales)
+  std::vector<int8_t> data;   // [rows, cols] row-major
+  std::vector<float> scales;  // [cols]
+  int64_t rows = 0, cols = 0;
+};
+
 struct Machine {
   std::vector<OpDesc> ops;
   std::vector<std::string> feeds, fetches;
   std::map<std::string, Tensor> params;  // persistables from params/
+  std::map<std::string, QTensor> qweights;  // __quant__.json int8 weights
   std::map<std::string, Tensor> env;     // per-run values
   std::string error;
 };
@@ -394,8 +401,48 @@ Tensor& set_out(Machine& m, const OpDesc& op, const std::string& slot) {
   return m.env[op.outs.at(slot).at(0)];
 }
 
+bool k_mul_quant(Machine& m, const OpDesc& op, const QTensor& q) {
+  Tensor* x;
+  if (!need(m, op, "X", &x)) return false;
+  int xd = static_cast<int>(op.attr_num("x_num_col_dims", 1));
+  int64_t M = 1, K = 1;
+  for (int i = 0; i < xd; ++i) M *= x->shape[static_cast<size_t>(i)];
+  for (size_t i = static_cast<size_t>(xd); i < x->shape.size(); ++i)
+    K *= x->shape[i];
+  if (K != q.rows) {
+    m.error = "mul(int8): contraction mismatch " + std::to_string(K) +
+              " vs " + std::to_string(q.rows);
+    return false;
+  }
+  int64_t N = q.cols;
+  Tensor& o = set_out(m, op, "Out");
+  o.shape.assign(x->shape.begin(), x->shape.begin() + xd);
+  o.shape.push_back(N);
+  o.data.assign(static_cast<size_t>(M * N), 0.f);
+  const float* A = x->data.data();
+  const int8_t* B = q.data.data();
+  float* C = o.data.data();
+  // accumulate against raw int8, fold the per-column scale once at the end
+  for (int64_t i = 0; i < M; ++i)
+    for (int64_t k = 0; k < K; ++k) {
+      float a = A[i * K + k];
+      if (a == 0.f) continue;
+      const int8_t* Bk = B + k * N;
+      float* Ci = C + i * N;
+      for (int64_t n = 0; n < N; ++n) Ci[n] += a * Bk[n];
+    }
+  for (int64_t i = 0; i < M; ++i)
+    for (int64_t n = 0; n < N; ++n) C[i * N + n] *= q.scales[n];
+  return true;
+}
+
 bool k_mul(Machine& m, const OpDesc& op) {
   Tensor *x, *y;
+  auto yit = op.ins.find("Y");
+  if (yit != op.ins.end() && !yit->second.empty()) {
+    auto q = m.qweights.find(yit->second[0]);
+    if (q != m.qweights.end()) return k_mul_quant(m, op, q->second);
+  }
   if (!need(m, op, "X", &x) || !need(m, op, "Y", &y)) return false;
   int xd = static_cast<int>(op.attr_num("x_num_col_dims", 1));
   int yd = static_cast<int>(op.attr_num("y_num_col_dims", 1));
@@ -1340,6 +1387,18 @@ bool run_op(Machine& m, const OpDesc& op) {
 
 // impl bodies (may throw on malformed models; the extern "C" wrappers
 // below convert that into g_last_error + failure codes)
+template <typename T>
+bool read_raw(const std::string& path, size_t n, std::vector<T>* out,
+              std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) { *err = "cannot open " + path; return false; }
+  out->resize(n);
+  f.read(reinterpret_cast<char*>(out->data()),
+         static_cast<std::streamsize>(n * sizeof(T)));
+  if (!f) { *err = "short read in " + path; return false; }
+  return true;
+}
+
 void* load_impl(const char* model_dir) {
   auto m = std::make_unique<Machine>();
   std::string dir(model_dir);
@@ -1397,6 +1456,34 @@ void* load_impl(const char* model_dir) {
       return nullptr;
     }
     m->params[entry.at("name").str] = std::move(t);
+  }
+  // optional weight-only int8 sidecars (io.quantize_inference_model)
+  std::ifstream qf(dir + "/__quant__.json");
+  if (qf) {
+    std::stringstream qs;
+    qs << qf.rdbuf();
+    const std::string qtext = qs.str();
+    JValue quant;
+    JParser qp(qtext);
+    if (!qp.parse(&quant)) {
+      g_last_error = "__quant__.json parse error: " + qp.err;
+      return nullptr;
+    }
+    for (auto& entry : quant.arr) {
+      QTensor q;
+      q.rows = static_cast<int64_t>(entry.at("rows").num);
+      q.cols = static_cast<int64_t>(entry.at("cols").num);
+      std::string err;
+      if (!read_raw(dir + "/params/" + entry.at("qfile").str,
+                    static_cast<size_t>(q.rows * q.cols), &q.data,
+                    &err) ||
+          !read_raw(dir + "/params/" + entry.at("sfile").str,
+                    static_cast<size_t>(q.cols), &q.scales, &err)) {
+        g_last_error = err;
+        return nullptr;
+      }
+      m->qweights[entry.at("name").str] = std::move(q);
+    }
   }
   return m.release();
 }

@@ -369,3 +369,67 @@ class TestCapiTransformer:
             for i in range(2):
                 assert row[i, a[i, cur]] >= thresh[i], (
                     cur, a[i, cur], row[i, a[i, cur]], thresh[i])
+
+
+class TestCapiQuantized:
+    """Weight-only int8 quantization (io.quantize_inference_model): the C
+    machine serves the int8 artifact with small, bounded error vs the
+    f32 model, and the artifact genuinely shrinks."""
+
+    def test_quantized_transformer_close_to_f32(self, tmp_path):
+        import os
+
+        vocab, T, d = 40, 10, 32
+
+        def build():
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=2, num_heads=4,
+                max_len=T)
+            return [ids], [layers.softmax(logits)]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        qd = str(tmp_path / "quant")
+        quantized = pt.io.quantize_inference_model(d_, qd, min_elems=64)
+        assert quantized, "no weight was quantized"
+
+        rng = np.random.RandomState(5)
+        feed = {"ids": rng.randint(0, vocab, size=(3, T)).astype(np.int64)}
+        ref, = exe.run(main, feed=feed, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(qd) as machine:
+            got, = machine.run(feed)
+        # int8 weights: probabilities within ~1e-2 of the f32 model
+        assert np.abs(got - np.asarray(ref)).max() < 2e-2
+
+        def tree_size(root):
+            return sum(os.path.getsize(os.path.join(r, f))
+                       for r, _, fs in os.walk(root) for f in fs)
+
+        # quantized mul weights store ~1/4 the bytes
+        pdir, qdir = os.path.join(d_, "params"), os.path.join(qd, "params")
+        assert tree_size(qdir) < 0.55 * tree_size(pdir), (
+            tree_size(qdir), tree_size(pdir))
+
+    def test_quantizer_skips_shared_use_weights(self, tmp_path):
+        """A weight also consumed outside mul's Y slot must stay f32."""
+
+        def build():
+            x = layers.data("x", shape=[8])
+            from paddle_tpu.layers.layer_helper import LayerHelper
+
+            helper = LayerHelper("qshare")
+            w = helper.create_parameter(pt.ParamAttr(name="shared_w"),
+                                        shape=[8, 64], dtype="float32")
+            y = helper.simple_op("mul", {"X": [x], "Y": [w]},
+                                 {"x_num_col_dims": 1})
+            extra = helper.simple_op("reduce_sum", {"X": [w]},
+                                     {"dim": [0], "keep_dim": False})
+            z = layers.elementwise_add(y, extra)
+            return [x], [z]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        qd = str(tmp_path / "quant")
+        quantized = pt.io.quantize_inference_model(d_, qd, min_elems=1)
+        assert "shared_w" not in quantized
