@@ -236,26 +236,41 @@ def quantize_inference_model(dirname: str, out_dirname: str,
     inference model, for the C machine (beyond-reference; the reference
     era predates int8 deployment).
 
-    Eligible weights — f32 2-D params of at least ``min_elems`` whose
-    EVERY use in the program is as a ``mul`` right-hand side (fc / qkv /
-    head projections, the bulk of LM bytes) — are stored as int8 payload
-    + one f32 scale per output column (scale = max|w[:, c]| / 127) in
-    ``__quant__.json`` sidecars; everything else copies through. The C
-    machine keeps the int8 bytes in memory and folds the scales into the
-    matmul epilogue, so serving memory and artifact size drop ~4x for
-    the quantized weights. The quantized directory is C-machine-only
-    (the Python executor load path expects the f32 manifest)."""
+    Eligible weights (>= ``min_elems`` f32 elements) are per-output-
+    channel symmetric int8 (scale = max|w over channel| / 127), recorded
+    in ``__quant__.json`` sidecars; everything else copies through:
+    - 2-D params used EXCLUSIVELY as ``mul`` right-hand sides (fc / qkv
+      / head projections, the bulk of LM bytes): the C machine keeps the
+      int8 bytes resident and folds the scales into the matmul epilogue
+      — ~4x serving memory AND artifact size;
+    - 4-D params used exclusively as ``conv2d`` filters (one consistent
+      data_format): int8 in the artifact, dequantized once at load
+      (filters are small next to activations — the win is the shipped
+      bytes).
+    Weights with any other/shared use stay f32. The quantized directory
+    is C-machine-only (the Python executor load path expects the f32
+    manifest)."""
     import shutil
 
     with open(os.path.join(dirname, "__model__.json")) as f:
         payload = json.load(f)
-    # a param is eligible only if every reference to it is mul's Y slot
-    usage_ok: dict = {}
+    # a param is eligible only if EVERY reference to it is mul's Y slot
+    # (int8 stays resident) or conv2d's Filter slot with one consistent
+    # data_format (int8 on disk, dequantized once at load)
+    usage: dict = {}
     for op in payload["program"]["blocks"][0]["ops"]:
         for slot, names in op["inputs"].items():
             for n in names:
-                ok = (op["type"] == "mul" and slot == "Y")
-                usage_ok[n] = usage_ok.get(n, True) and ok
+                if op["type"] == "mul" and slot == "Y":
+                    kind = "mul"
+                elif op["type"] == "conv2d" and slot == "Filter":
+                    kind = "conv:" + op["attrs"].get("data_format",
+                                                     "NCHW")
+                else:
+                    kind = "no"
+                prev = usage.setdefault(n, kind)
+                if prev != kind:
+                    usage[n] = "no"
     os.makedirs(os.path.join(out_dirname, "params"), exist_ok=True)
     shutil.copyfile(os.path.join(dirname, "__model__.json"),
                     os.path.join(out_dirname, "__model__.json"))
@@ -264,13 +279,14 @@ def quantize_inference_model(dirname: str, out_dirname: str,
     kept, quant, quantized = [], [], []
     for entry in manifest:
         arr = None
-        if "dtype" in entry:  # bf16 bit-view — leave on the f32 path
-            eligible = False
-        elif not usage_ok.get(entry["name"], False):
-            eligible = False
+        kind = usage.get(entry["name"], "no")
+        if "dtype" in entry or kind == "no":
+            eligible = False  # bf16 bit-view / shared or unknown use
         else:
             arr = np.load(os.path.join(dirname, "params", entry["file"]))
-            eligible = (arr.dtype == np.float32 and arr.ndim == 2
+            want_ndim = 2 if kind == "mul" else 4
+            eligible = (arr.dtype == np.float32
+                        and arr.ndim == want_ndim
                         and arr.size >= min_elems)
         if not eligible:
             shutil.copyfile(os.path.join(dirname, "params", entry["file"]),
@@ -278,16 +294,28 @@ def quantize_inference_model(dirname: str, out_dirname: str,
                                          entry["file"]))
             kept.append(entry)
             continue
-        scales = np.maximum(np.abs(arr).max(axis=0), 1e-12) / 127.0
-        q = np.clip(np.round(arr / scales), -127, 127).astype(np.int8)
+        if kind == "mul":
+            reduce_axes, out_axis = (0,), 1
+        else:  # conv filters: OIHW for NCHW, HWIO for NHWC
+            out_axis = 0 if kind.endswith("NCHW") else 3
+            reduce_axes = tuple(a for a in range(4) if a != out_axis)
+        scales = np.maximum(np.abs(arr).max(axis=reduce_axes),
+                            1e-12) / 127.0
+        bshape = tuple(-1 if a == out_axis else 1 for a in range(arr.ndim))
+        q = np.clip(np.round(arr / scales.reshape(bshape)), -127,
+                    127).astype(np.int8)
         base = entry["file"][:-4]
         qfile, sfile = base + ".int8.bin", base + ".scale.bin"
         q.tofile(os.path.join(out_dirname, "params", qfile))
         scales.astype(np.float32).tofile(
             os.path.join(out_dirname, "params", sfile))
-        quant.append({"name": entry["name"], "qfile": qfile,
-                      "sfile": sfile, "rows": int(arr.shape[0]),
-                      "cols": int(arr.shape[1])})
+        rec = {"name": entry["name"], "qfile": qfile, "sfile": sfile,
+               "kind": "mul" if kind == "mul" else "conv",
+               "shape": [int(d) for d in arr.shape],
+               "out_axis": out_axis}
+        if kind == "mul":
+            rec["rows"], rec["cols"] = int(arr.shape[0]), int(arr.shape[1])
+        quant.append(rec)
         quantized.append(entry["name"])
     with open(os.path.join(out_dirname, "params", "MANIFEST.json"),
               "w") as f:

@@ -1470,19 +1470,64 @@ void* load_impl(const char* model_dir) {
       return nullptr;
     }
     for (auto& entry : quant.arr) {
-      QTensor q;
-      q.rows = static_cast<int64_t>(entry.at("rows").num);
-      q.cols = static_cast<int64_t>(entry.at("cols").num);
+      const std::string kind =
+          entry.has("kind") ? entry.at("kind").str : std::string("mul");
       std::string err;
+      if (kind == "mul") {
+        QTensor q;
+        q.rows = static_cast<int64_t>(entry.at("rows").num);
+        q.cols = static_cast<int64_t>(entry.at("cols").num);
+        if (!read_raw(dir + "/params/" + entry.at("qfile").str,
+                      static_cast<size_t>(q.rows * q.cols), &q.data,
+                      &err) ||
+            !read_raw(dir + "/params/" + entry.at("sfile").str,
+                      static_cast<size_t>(q.cols), &q.scales, &err)) {
+          g_last_error = err;
+          return nullptr;
+        }
+        m->qweights[entry.at("name").str] = std::move(q);
+        continue;
+      }
+      // conv filters: int8 on disk only — dequantize once into the f32
+      // param table (filters are small next to activations; the win is
+      // the shipped artifact)
+      std::vector<int64_t> shape;
+      int64_t numel = 1;
+      for (auto& d : entry.at("shape").arr) {
+        shape.push_back(static_cast<int64_t>(d.num));
+        numel *= static_cast<int64_t>(d.num);
+      }
+      int out_axis = static_cast<int>(entry.at("out_axis").num);
+      if (shape.empty() || out_axis < 0 ||
+          out_axis >= static_cast<int>(shape.size())) {
+        g_last_error = "__quant__.json: bad out_axis for '" +
+                       entry.at("name").str + "'";
+        return nullptr;
+      }
+      int64_t oc = shape[static_cast<size_t>(out_axis)];
+      std::vector<int8_t> qd;
+      std::vector<float> sc;
       if (!read_raw(dir + "/params/" + entry.at("qfile").str,
-                    static_cast<size_t>(q.rows * q.cols), &q.data,
-                    &err) ||
+                    static_cast<size_t>(numel), &qd, &err) ||
           !read_raw(dir + "/params/" + entry.at("sfile").str,
-                    static_cast<size_t>(q.cols), &q.scales, &err)) {
+                    static_cast<size_t>(oc), &sc, &err)) {
         g_last_error = err;
         return nullptr;
       }
-      m->qweights[entry.at("name").str] = std::move(q);
+      Tensor t;
+      t.shape = shape;
+      t.data.resize(static_cast<size_t>(numel));
+      int64_t inner = 1;
+      for (size_t a = static_cast<size_t>(out_axis) + 1; a < shape.size();
+           ++a)
+        inner *= shape[a];
+      for (int64_t i = 0; i < numel; ++i) {
+        int64_t c = (i / inner) % oc;
+        t.data[static_cast<size_t>(i)] =
+            static_cast<float>(qd[static_cast<size_t>(i)]) *
+            sc[static_cast<size_t>(c)];
+      }
+      m->params[entry.at("name").str] = std::move(t);
     }
   }
   return m.release();

@@ -433,3 +433,31 @@ class TestCapiQuantized:
         qd = str(tmp_path / "quant")
         quantized = pt.io.quantize_inference_model(d_, qd, min_elems=1)
         assert "shared_w" not in quantized
+
+    def test_quantized_cnn_close_to_f32(self, tmp_path):
+        """Conv filters quantize too (int8 artifact, dequantized once at
+        load): a LeNet-style CNN serves within tolerance of f32."""
+        def build():
+            img = layers.data("img", shape=[1, 12, 12])
+            h = layers.conv2d(img, num_filters=8, filter_size=3,
+                              padding=1, act="relu")
+            h = layers.pool2d(h, pool_size=2, pool_stride=2)
+            h = layers.reshape(h, shape=[-1, 8 * 6 * 6])
+            h = layers.fc(h, size=32, act="relu")
+            logits = layers.fc(h, size=5)
+            return [img], [layers.softmax(logits)]
+
+        d_, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        qd = str(tmp_path / "quant")
+        quantized = pt.io.quantize_inference_model(d_, qd, min_elems=64)
+        # both the conv filter and the fc weights quantize
+        assert len(quantized) >= 2, quantized
+
+        rng = np.random.RandomState(9)
+        feed = {"img": rng.rand(4, 1, 12, 12).astype(np.float32)}
+        ref, = exe.run(main, feed=feed, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(qd) as machine:
+            got, = machine.run(feed)
+        assert np.abs(got - np.asarray(ref)).max() < 2e-2
