@@ -8,14 +8,24 @@ peak is reported alongside.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Resilience: TPU backend bring-up through the dev tunnel can transiently fail
-(UNAVAILABLE) or hang for minutes. The measurement therefore runs in a child
-subprocess with a hard timeout; the parent retries the TPU attempt, then
-falls back to a CPU smoke run, and always emits one JSON line (a structured
-failure record in the worst case) instead of a traceback.
+Resilience (round-4 hardening — the round-3 record was lost to a single
+150s probe timing out while the tunnel was merely slow to recover):
+  * probes are RETRIED on a backoff schedule spread across a total budget
+    window (``BENCH_BUDGET_S``, default 5400s) instead of once;
+  * every completed metric is checkpointed to a sidecar JSONL keyed by a
+    digest of the source tree, so a tunnel drop mid-sweep keeps the
+    completed rows and the next attempt resumes instead of restarting;
+  * before falling back to CPU the parent does a final TPU re-probe, and
+    if the sidecar holds TPU rows it assembles a partial TPU record in
+    preference to a CPU smoke number;
+  * SIGTERM makes the parent flush the best available record instead of
+    dying silently.
+Always emits one JSON line (a structured failure record in the worst case).
 """
+import hashlib
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -42,10 +52,16 @@ TPU_PEAK_FLOPS = [
     ("v2", 45e12),
 ]
 
-TPU_ATTEMPTS = 2
 TPU_TIMEOUT_S = 1500
 TPU_PROBE_TIMEOUT_S = 150
 CPU_TIMEOUT_S = 900
+# Total wall budget for the whole bench (probing + attempts + fallback).
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 5400))
+# Tail reserve kept for the final re-probe + CPU fallback path.
+CPU_RESERVE_S = 1100
+SIDECAR_PATH = os.environ.get("BENCH_SIDECAR",
+                              "/tmp/paddle_tpu_bench_sidecar.jsonl")
+SIDECAR_MAX_AGE_S = 24 * 3600
 
 
 def _peak_flops(device_kind):
@@ -334,6 +350,151 @@ def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
     return batch / sec
 
 
+def _source_digest(root=None):
+    """Digest of the measured surface (bench.py + the package sources).
+    Sidecar rows are only reused while the digest matches, so a code change
+    invalidates cached measurements but a mere re-commit does not."""
+    h = hashlib.sha256()
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    paths = [os.path.join(root, "bench.py")]
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root,
+                                                             "paddle_tpu")):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        paths.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                     if f.endswith((".py", ".c", ".cc", ".h")))
+    for p in paths:
+        h.update(os.path.relpath(p, root).encode())
+        try:
+            with open(p, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
+def _sidecar_load(digest, device=None):
+    """step-name -> row dict for rows matching this digest (latest wins).
+
+    Rows are additionally filtered by the measuring device: pass the
+    current ``device_kind`` explicitly (the child does), or None to trust
+    the latest info row's device — rows measured on a different chip are
+    never mixed into a record (their FLOP peaks differ)."""
+    rows = {}
+    try:
+        with open(SIDECAR_PATH) as fh:
+            for line in fh:
+                try:
+                    r = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if (r.get("digest") == digest
+                        and time.time() - r.get("t", 0) < SIDECAR_MAX_AGE_S):
+                    rows[r["step"]] = r
+    except OSError:
+        pass
+    if device is None and "info" in rows:
+        device = rows["info"].get("device")
+    if device is not None:
+        rows = {s: r for s, r in rows.items() if r.get("device") == device}
+    return rows
+
+
+def _sidecar_append(digest, step, result=None, error=None, device=None):
+    row = {"digest": digest, "step": step, "t": time.time(),
+           "device": device}
+    if error is not None:
+        row["error"] = error
+    else:
+        row["result"] = result
+    with open(SIDECAR_PATH, "a") as fh:
+        fh.write(json.dumps(row) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def assemble(rows, parent_notes=None):
+    """Build the single output record from sidecar-style rows.
+
+    ``rows`` maps step name -> {"result": ...} or {"error": ...}. Needs an
+    "info" row (platform/device_kind/batch/image_size); metric rows are
+    optional — missing ones emit as null, exactly like the r3 schema."""
+    info = rows["info"]["result"]
+    platform, device_kind = info["platform"], info["device_kind"]
+    batch, hw = info["batch"], info["image_size"]
+    on_tpu = platform != "cpu"
+    peak = _peak_flops(device_kind) if on_tpu else None
+
+    def res(step):
+        r = rows.get(step)
+        return r.get("result") if r else None
+
+    resnet = res("resnet") or {}
+    img_per_sec = resnet.get("img_per_sec", 0.0)
+    flops_per_img = RESNET50_TRAIN_FLOPS_224 * (hw / 224.0) ** 2
+    achieved_flops = img_per_sec * flops_per_img
+    lstm_ms = res("lstm")
+    lm = res("transformer")
+    lm_tok_s, lm_flops_s = lm if lm else (None, None)
+    lm_wide = res("transformer_wide")
+    lmw_tok_s, lmw_flops_s = lm_wide if lm_wide else (None, None)
+    zoo = {}
+    for name in IMAGE_MODEL_BASELINES:
+        ips = res("zoo_" + name)
+        if ips:
+            zoo[name] = {"img_per_sec": round(ips, 1),
+                         "vs_baseline": round(
+                             ips / IMAGE_MODEL_BASELINES[name], 1)}
+    infer_zoo = {n: res("infer_" + n) for n in INFER_BASELINES
+                 if res("infer_" + n)}
+    degraded = {s: r["error"] for s, r in rows.items() if "error" in r}
+    degraded.update(resnet.get("notes") or {})
+    extra = {
+        "platform": platform,
+        "device_kind": device_kind,
+        "batch": batch,
+        "image_size": hw,
+        "achieved_tflops": round(achieved_flops / 1e12, 2),
+        "mfu": round(achieved_flops / peak, 4) if peak else None,
+        "baseline": "84.08 img/s ResNet-50 train, "
+                    "IntelOptimizedPaddle.md:43-45",
+        "lstm_ms_per_batch": (round(lstm_ms, 2)
+                              if lstm_ms is not None else None),
+        "lstm_vs_baseline": (round(LSTM_BASELINE_MS / lstm_ms, 2)
+                             if lstm_ms else None),
+        "lstm_baseline": "184 ms/batch 2xLSTM bs64 hidden512, "
+                         "benchmark/README.md:119",
+        "transformer_lm_tokens_per_sec": (round(lm_tok_s)
+                                          if lm_tok_s else None),
+        "transformer_mfu": (round(lm_flops_s / peak, 4)
+                            if lm_flops_s and peak else None),
+        "transformer_lm_config": ("d1024 L8 h8 (d_head=128) bs8 T2048 "
+                                  "V16k bf16; MFU counts in-kernel "
+                                  "causal flash FLOPs"),
+        "transformer_wide_tokens_per_sec": (round(lmw_tok_s)
+                                            if lmw_tok_s else None),
+        "transformer_wide_mfu": (round(lmw_flops_s / peak, 4)
+                                 if lmw_flops_s and peak else None),
+        "transformer_wide_config": ("d2048 L8 h16 (d_head=128) bs8 "
+                                    "T2048 V16k bf16 — the >=50% MFU "
+                                    "demonstration config"),
+        "lstm_varlen": res("lstm_varlen"),
+        "decode_kv_cache": res("decode"),
+        "fused_linear_grad": resnet.get("fused_linear_grad"),
+        "degraded": degraded or None,
+        "image_zoo_train_bs128": zoo or None,
+        "infer_bs16": infer_zoo or None,
+    }
+    if parent_notes:
+        extra["bench_notes"] = parent_notes
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "extra": extra,
+    }
+
+
 def run_probe():
     """Child-mode entry: prove the TPU backend is alive with one tiny
     computation. A downed tunnel HANGS backend init rather than failing,
@@ -350,7 +511,11 @@ def run_probe():
 
 
 def run_bench(platform):
-    """Child-mode entry: run the measurement and print the JSON line."""
+    """Child-mode entry: run the measurement sweep and print the JSON line.
+
+    On TPU every completed metric is checkpointed to the sidecar as it
+    lands, and already-checkpointed metrics (same source digest) are
+    skipped — a retry after a tunnel drop resumes mid-sweep."""
     import jax
 
     if platform == "cpu":
@@ -414,106 +579,83 @@ def run_bench(platform):
         assert np.isfinite(o).all()
         return batch * steps / elapsed
 
-    # Runs with whatever --fused_linear_grad says (default off — the
-    # kernel lost its on-chip A/B under the 16 MB scoped-vmem limit,
-    # PERF.md round 3); if a fused compile ever fails on the measuring
-    # chip, fall back to the XLA-dot backward rather than losing the
-    # bench (the flag is part of the compile key).
-    notes = {}
-    try:
-        img_per_sec = measure_resnet()
-    except Exception as exc:  # noqa: BLE001 - any compile/runtime failure
-        pt.flags.FLAGS.fused_linear_grad = False
-        notes["fused_linear_grad_disabled"] = repr(exc)[:200]
-        img_per_sec = measure_resnet()
-
-    def attempt(label, fn, *args, **kw):
-        """Secondary metrics must degrade, not kill the bench."""
+    def measure_resnet_with_fallback():
+        # Runs with whatever --fused_linear_grad says (default off — the
+        # kernel lost its on-chip A/B under the 16 MB scoped-vmem limit,
+        # PERF.md round 3); if a fused compile ever fails on the measuring
+        # chip, fall back to the XLA backward rather than losing the bench
+        # (the flag is part of the compile key).
+        notes = {}
         try:
-            return fn(*args, **kw)
-        except Exception as exc:  # noqa: BLE001
-            notes[label + "_error"] = repr(exc)[:200]
-            return None
+            ips = measure_resnet()
+        except Exception as exc:  # noqa: BLE001 - compile/runtime failure
+            pt.flags.FLAGS.fused_linear_grad = False
+            notes["fused_linear_grad_disabled"] = repr(exc)[:200]
+            ips = measure_resnet()
+        return {"img_per_sec": ips,
+                "fused_linear_grad": bool(pt.flags.FLAGS.fused_linear_grad),
+                "notes": notes or None}
 
-    flops_per_img = RESNET50_TRAIN_FLOPS_224 * (hw / 224.0) ** 2
-    achieved_flops = img_per_sec * flops_per_img
-    peak = _peak_flops(dev.device_kind) if on_tpu else None
-    lstm_ms = attempt("lstm", bench_lstm_step, jax, pt, layers) \
-        if on_tpu else None
-    lstm_varlen = attempt("lstm_varlen", bench_lstm_varlen, jax, pt,
-                          layers) if on_tpu else None
-    lm = attempt("transformer", bench_transformer_step, jax, pt, layers,
-                 models) if on_tpu else None
-    lm_tok_s, lm_flops_s = lm if lm else (None, None)
-    # The wide config (d2048, d_head=128) is where the >=50% MFU north
-    # star is demonstrated: fatter MXU contractions, same causal flash
-    # attention (55.8% measured round 3, CHIP_SESSION_r3.jsonl).
-    lm_wide = attempt("transformer_wide", bench_transformer_step, jax, pt,
-                      layers, models, bs=8, d=2048, H=16) \
-        if on_tpu else None
-    lmw_tok_s, lmw_flops_s = lm_wide if lm_wide else (None, None)
-    decode = attempt("decode", bench_decode, jax, pt, layers, models) \
-        if on_tpu else None
-    zoo = {}
-    infer_zoo = {}
+    digest = os.environ.get("BENCH_DIGEST") or _source_digest()
+    rows = _sidecar_load(digest, device=dev.device_kind) if on_tpu else {}
+
+    def step(name, fn, *args, **kw):
+        """Run one metric, checkpointing the result. Completed results are
+        reused; a checkpointed ERROR row is retried (once per child run) —
+        errors are often transient tunnel failures, and a deterministic
+        one just fails again quickly."""
+        if "result" in rows.get(name, {}):
+            return rows[name]["result"]
+        try:
+            out = fn(*args, **kw)
+        except Exception as exc:  # noqa: BLE001 - degrade, don't die
+            err = repr(exc)[:300]
+            if on_tpu:
+                _sidecar_append(digest, name, error=err,
+                                device=dev.device_kind)
+            rows[name] = {"error": err}
+            return None
+        if on_tpu:
+            _sidecar_append(digest, name, result=out,
+                            device=dev.device_kind)
+        rows[name] = {"result": out}
+        return out
+
+    # The info row is always refreshed (platform identity must be current).
+    rows["info"] = {"result": {"platform": dev.platform,
+                               "device_kind": dev.device_kind,
+                               "batch": batch, "image_size": hw}}
     if on_tpu:
-        for name in ("alexnet", "googlenet", "vgg16"):
-            ips = attempt(name, bench_image_model, jax, pt, layers, models,
-                          name)
-            if ips:
-                zoo[name] = {
-                    "img_per_sec": round(ips, 1),
-                    "vs_baseline": round(ips / IMAGE_MODEL_BASELINES[name],
-                                         1),
-                }
+        _sidecar_append(digest, "info", result=rows["info"]["result"],
+                        device=dev.device_kind)
+
+    # Headline first, then the >=50%-MFU north-star config, then the rest
+    # — ordered so an early tunnel drop still captures the rows that
+    # matter most.
+    step("resnet", measure_resnet_with_fallback)
+    if on_tpu:
+        step("transformer_wide", bench_transformer_step, jax, pt, layers,
+             models, bs=8, d=2048, H=16)
+        step("transformer", bench_transformer_step, jax, pt, layers, models)
+        step("decode", bench_decode, jax, pt, layers, models)
+        step("lstm", bench_lstm_step, jax, pt, layers)
+        step("lstm_varlen", bench_lstm_varlen, jax, pt, layers)
+        for name in IMAGE_MODEL_BASELINES:
+            step("zoo_" + name, bench_image_model, jax, pt, layers, models,
+                 name)
         for name in INFER_BASELINES:
-            r = attempt("infer_" + name, bench_inference, jax, pt, layers,
-                        models, name)
-            if r:
-                infer_zoo[name] = r
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(img_per_sec, 2),
-        "unit": "img/s",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-        "extra": {
-            "platform": dev.platform,
-            "device_kind": dev.device_kind,
-            "batch": batch,
-            "image_size": hw,
-            "achieved_tflops": round(achieved_flops / 1e12, 2),
-            "mfu": round(achieved_flops / peak, 4) if peak else None,
-            "baseline": "84.08 img/s ResNet-50 train, "
-                        "IntelOptimizedPaddle.md:43-45",
-            "lstm_ms_per_batch": (round(lstm_ms, 2)
-                                  if lstm_ms is not None else None),
-            "lstm_vs_baseline": (round(LSTM_BASELINE_MS / lstm_ms, 2)
-                                 if lstm_ms else None),
-            "lstm_baseline": "184 ms/batch 2xLSTM bs64 hidden512, "
-                             "benchmark/README.md:119",
-            "transformer_lm_tokens_per_sec": (round(lm_tok_s)
-                                              if lm_tok_s else None),
-            "transformer_mfu": (round(lm_flops_s / peak, 4)
-                                if lm_flops_s and peak else None),
-            "transformer_lm_config": ("d1024 L8 h8 (d_head=128) bs8 T2048 "
-                                      "V16k bf16; MFU counts in-kernel "
-                                      "causal flash FLOPs"),
-            "transformer_wide_tokens_per_sec": (round(lmw_tok_s)
-                                                if lmw_tok_s else None),
-            "transformer_wide_mfu": (round(lmw_flops_s / peak, 4)
-                                     if lmw_flops_s and peak else None),
-            "transformer_wide_config": ("d2048 L8 h16 (d_head=128) bs8 "
-                                        "T2048 V16k bf16 — the >=50% MFU "
-                                        "demonstration config"),
-            "lstm_varlen": lstm_varlen,
-            "decode_kv_cache": decode,
-            "fused_linear_grad": bool(
-                pt.flags.FLAGS.fused_linear_grad),
-            "degraded": notes or None,
-            "image_zoo_train_bs128": zoo or None,
-            "infer_bs16": infer_zoo or None,
-        },
-    }), flush=True)
+            step("infer_" + name, bench_inference, jax, pt, layers, models,
+                 name)
+    if "result" not in rows.get("resnet", {}):
+        # Without the headline this child must NOT print a plausible final
+        # record (a value-0.0 line would be parsed as success); secondary
+        # rows are already checkpointed, so exit nonzero and let the
+        # parent's retry/partial-assembly machinery decide.
+        print("# headline resnet metric failed: "
+              + str(rows.get("resnet", {}).get("error")), file=sys.stderr,
+              flush=True)
+        sys.exit(3)
+    print(json.dumps(assemble(rows)), flush=True)
 
 
 def _spawn(platform, timeout):
@@ -527,7 +669,7 @@ def _spawn(platform, timeout):
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        return None, f"{platform} attempt timed out after {timeout}s"
+        return None, f"{platform} attempt timed out after {int(timeout)}s"
     for line in reversed(proc.stdout.strip().splitlines()):
         if line.startswith("{"):
             try:
@@ -539,34 +681,140 @@ def _spawn(platform, timeout):
 
 
 def main():
+    t0 = time.time()
+    deadline = t0 + BENCH_BUDGET_S
+    digest = _source_digest()
+    os.environ["BENCH_DIGEST"] = digest  # children inherit via _spawn env
     notes = []
-    probe, pnote = _spawn("tpu-probe", TPU_PROBE_TIMEOUT_S)
-    attempts = TPU_ATTEMPTS if probe is not None else 0
-    if probe is None:
-        notes.append(f"tpu probe failed (skipping TPU attempts): {pnote}")
-        print(f"# {notes[-1]}", file=sys.stderr, flush=True)
-    for attempt in range(attempts):
-        result, note = _spawn("tpu", TPU_TIMEOUT_S)
-        if result is not None:
-            print(json.dumps(result), flush=True)
-            return 0
-        notes.append(note)
-        print(f"# tpu attempt {attempt + 1}/{attempts} failed: {note}",
-              file=sys.stderr, flush=True)
+    emitted = []
+
+    def emit(obj):
+        if emitted:
+            return
+        emitted.append(obj)
+        print(json.dumps(obj), flush=True)
+        try:  # repo-local snapshot for post-mortems; stdout stays canonical
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "BENCH_PARTIAL.json"), "w") as fh:
+                json.dump(obj, fh, indent=1)
+        except OSError:
+            pass
+
+    def tpu_metric_rows():
+        rows = _sidecar_load(digest)
+        n = sum(1 for s, r in rows.items() if s != "info" and "result" in r)
+        return rows, n
+
+    def finalize_from_sidecar(extra_notes):
+        """Assemble a partial TPU record from checkpointed rows — only
+        when the HEADLINE row is among them (a value-0.0 record would
+        parse as a successful measurement downstream)."""
+        rows, n = tpu_metric_rows()
+        if "info" in rows and "result" in rows.get("resnet", {}):
+            emit(assemble(rows, parent_notes=extra_notes
+                          + [f"partial: {n} TPU metric rows from sidecar"]))
+            return True
+        return False
+
+    def on_term(signum, frame):
+        if not finalize_from_sidecar(notes + [f"signal {signum}"]):
+            emit({"metric": "resnet50_train_images_per_sec_per_chip",
+                  "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+                  "extra": {"error": notes + [f"signal {signum}"]}})
+        sys.exit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_term)
+
+    def log(msg):
+        print(f"# [{int(time.time() - t0)}s] {msg}", file=sys.stderr,
+              flush=True)
+
+    # TPU phase: probe on a backoff schedule spread across the budget
+    # window; each successful probe buys one (resuming) sweep attempt.
+    # A probe that TIMES OUT means a wedged tunnel that may recover (keep
+    # probing); a probe that fails FAST means a deterministic no-TPU
+    # environment (two strikes, then go straight to the CPU smoke path).
+    backoffs = [30, 60, 90, 120, 180, 240]
+    probe_i = 0
+    fast_fails = 0
+    while time.time() < deadline - CPU_RESERVE_S and fast_fails < 2:
+        remaining = deadline - CPU_RESERVE_S - time.time()
+        pt0 = time.time()
+        probe, pnote = _spawn("tpu-probe",
+                              min(TPU_PROBE_TIMEOUT_S, max(60, remaining)))
+        probe_i += 1
+        if probe is not None:
+            fast_fails = 0
+            log(f"probe {probe_i} ok ({probe.get('device_kind')})")
+            att_timeout = min(TPU_TIMEOUT_S,
+                              deadline - CPU_RESERVE_S - time.time())
+            if att_timeout < 120:
+                break
+            _, before = tpu_metric_rows()
+            result, note = _spawn("tpu", att_timeout)
+            if result is not None:
+                emit(result)
+                return 0
+            notes.append(note)
+            _, after = tpu_metric_rows()
+            log(f"tpu attempt failed ({note}); sidecar rows {before}->"
+                f"{after}")
+            # Forward progress → retry immediately; stuck → back off.
+            sleep = 15 if after > before else backoffs[
+                min(probe_i - 1, len(backoffs) - 1)]
+        else:
+            if "timed out" not in pnote and time.time() - pt0 < 60:
+                fast_fails += 1
+            notes.append(f"probe {probe_i}: {pnote}")
+            log(f"probe {probe_i} failed (fast_fails={fast_fails}): "
+                f"{pnote}")
+            sleep = backoffs[min(probe_i - 1, len(backoffs) - 1)]
+        time.sleep(max(0, min(sleep,
+                              deadline - CPU_RESERVE_S - time.time())))
+
+    # Final TPU re-probe before giving up on the chip (the r3 tunnel
+    # recovered between the probe and the end of the bench window). Only
+    # worth it for a wedged-tunnel environment with budget left.
+    if not emitted and fast_fails < 2 and time.time() < deadline - 500:
+        probe, pnote = _spawn("tpu-probe", TPU_PROBE_TIMEOUT_S)
+        if probe is not None:
+            att_timeout = min(TPU_TIMEOUT_S,
+                              max(240, deadline - time.time()
+                                  - CPU_RESERVE_S + 200))
+            result, note = _spawn("tpu", att_timeout)
+            if result is not None:
+                emit(result)
+                return 0
+            notes.append(note)
+        else:
+            notes.append(f"final probe: {pnote}")
+
+    # Partial TPU record beats a CPU smoke number.
+    if finalize_from_sidecar(notes):
+        return 0
+
     result, note = _spawn("cpu", CPU_TIMEOUT_S)
     if result is not None:
         result.setdefault("extra", {})["tpu_unavailable"] = notes
-        print(json.dumps(result), flush=True)
+        # Headline-less TPU rows (e.g. a deterministic resnet failure with
+        # working secondary metrics) still ride along for the record.
+        rows, n = tpu_metric_rows()
+        if n:
+            result["extra"]["tpu_partial_rows"] = {
+                s: r.get("result", {"error": r.get("error")})
+                for s, r in rows.items() if s != "info"}
+        emit(result)
         return 0
     notes.append(note)
     # Worst case: still one parseable JSON line, never a bare traceback.
-    print(json.dumps({
+    emit({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": 0.0,
         "unit": "img/s",
         "vs_baseline": 0.0,
         "extra": {"error": notes},
-    }), flush=True)
+    })
     return 0
 
 

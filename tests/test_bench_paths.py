@@ -78,3 +78,85 @@ def test_decode_bench_path_runs():
     res = _bench().bench_decode(jax, pt, layers, models, bs=2, Tp=8, N=4,
                                 vocab=32, d=16, L=1, H=2, steps=1)
     assert res["tokens_per_sec"] > 0
+
+
+def test_source_digest_stable_and_sensitive(tmp_path):
+    b = _bench()
+    assert b._source_digest() == b._source_digest()
+    # content sensitivity, proven on a synthetic tree
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    (pkg / "mod.py").write_text("y = 1\n")
+    d1 = b._source_digest(root=str(tmp_path))
+    (pkg / "mod.py").write_text("y = 2\n")
+    d2 = b._source_digest(root=str(tmp_path))
+    assert d1 != d2 and len(d1) == 16
+    (pkg / "mod.py").write_text("y = 1\n")
+    assert b._source_digest(root=str(tmp_path)) == d1
+
+
+def test_sidecar_roundtrip_and_digest_isolation(tmp_path, monkeypatch):
+    b = _bench()
+    monkeypatch.setattr(b, "SIDECAR_PATH", str(tmp_path / "sc.jsonl"))
+    b._sidecar_append("aaaa", "resnet", result={"img_per_sec": 100.0})
+    b._sidecar_append("aaaa", "lstm", error="boom")
+    b._sidecar_append("bbbb", "resnet", result={"img_per_sec": 1.0})
+    rows = b._sidecar_load("aaaa")
+    assert rows["resnet"]["result"]["img_per_sec"] == 100.0
+    assert rows["lstm"]["error"] == "boom"
+    assert b._sidecar_load("bbbb")["resnet"]["result"]["img_per_sec"] == 1.0
+    assert b._sidecar_load("cccc") == {}
+
+
+def test_assemble_partial_rows_emit_nulls():
+    b = _bench()
+    rows = {
+        "info": {"result": {"platform": "tpu", "device_kind": "TPU v5e",
+                            "batch": 256, "image_size": 224}},
+        "resnet": {"result": {"img_per_sec": 1000.0,
+                              "fused_linear_grad": False, "notes": None}},
+        "transformer_wide": {"result": [39100.0, 110e12]},
+        "lstm": {"error": "dropped mid-run"},
+    }
+    out = b.assemble(rows, parent_notes=["partial"])
+    assert out["value"] == 1000.0
+    assert out["extra"]["platform"] == "tpu"
+    assert out["extra"]["mfu"] is not None
+    assert out["extra"]["transformer_wide_mfu"] is not None
+    assert out["extra"]["transformer_lm_tokens_per_sec"] is None
+    assert out["extra"]["degraded"]["lstm"] == "dropped mid-run"
+    assert out["extra"]["bench_notes"] == ["partial"]
+    # the r3 schema keys all survive
+    for key in ("lstm_varlen", "decode_kv_cache", "image_zoo_train_bs128",
+                "infer_bs16", "transformer_mfu"):
+        assert key in out["extra"]
+
+
+def test_assemble_cpu_smoke_schema():
+    b = _bench()
+    rows = {
+        "info": {"result": {"platform": "cpu", "device_kind": "cpu",
+                            "batch": 8, "image_size": 64}},
+        "resnet": {"result": {"img_per_sec": 1.2,
+                              "fused_linear_grad": False, "notes": None}},
+    }
+    out = b.assemble(rows)
+    assert out["extra"]["mfu"] is None and out["value"] == 1.2
+
+
+def test_sidecar_device_filtering(tmp_path, monkeypatch):
+    b = _bench()
+    monkeypatch.setattr(b, "SIDECAR_PATH", str(tmp_path / "sc.jsonl"))
+    b._sidecar_append("aaaa", "info", result={"device_kind": "v5e"},
+                      device="v5e")
+    b._sidecar_append("aaaa", "resnet", result={"img_per_sec": 9.0},
+                      device="v5e")
+    # chip swap: same digest, different device
+    assert b._sidecar_load("aaaa", device="v4") == {}
+    assert "resnet" in b._sidecar_load("aaaa", device="v5e")
+    # device=None trusts the latest info row
+    assert "resnet" in b._sidecar_load("aaaa")
+    b._sidecar_append("aaaa", "info", result={"device_kind": "v4"},
+                      device="v4")
+    assert "resnet" not in b._sidecar_load("aaaa")
