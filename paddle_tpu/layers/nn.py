@@ -96,15 +96,19 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
-           global_pooling=False, data_format="NCHW", main_program=None,
-           startup_program=None):
+           global_pooling=False, ceil_mode=False, data_format="NCHW",
+           main_program=None, startup_program=None):
+    """``ceil_mode`` selects the legacy v1 output-size rule
+    (ceil((I+2p-F)/S)+1, reference config_parser.py cnn_output_size with
+    caffe_mode=False); fluid's default is floor."""
     helper = LayerHelper("pool2d", main_program=main_program,
                          startup_program=startup_program)
     return helper.simple_op(
         "pool2d", {"X": [input]},
         {"pooling_type": pool_type, "ksize": pool_size,
          "strides": pool_stride, "paddings": pool_padding,
-         "global_pooling": global_pooling, "data_format": data_format})
+         "global_pooling": global_pooling, "ceil_mode": bool(ceil_mode),
+         "data_format": data_format})
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,

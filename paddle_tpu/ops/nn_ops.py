@@ -142,6 +142,22 @@ def pool2d(attrs, ins):
         window = tuple(x.shape[i] if i in spatial else 1 for i in range(x.ndim))
         stride = (1,) * x.ndim
         padding = [(0, 0)] * x.ndim
+    elif attrs.get("ceil_mode", False):
+        # legacy v1 semantics (config_parser.py cnn_output_size with
+        # caffe_mode=False): output = ceil((I + 2p - F)/S) + 1 — realised
+        # as extra high-side padding. The exclusive-average count below
+        # already ignores the synthetic cells.
+        for d in spatial:
+            i_dim, f, s = x.shape[d], window[d], stride[d]
+            lo, hi = padding[d]
+            out_dim = -(-(i_dim + lo + hi - f) // s) + 1
+            # legacy clamp: the last window must start inside the (user-
+            # padded) input, else it would pool only synthetic cells
+            # (NaN for exclusive-avg, -inf for max)
+            if (out_dim - 1) * s >= i_dim + lo:
+                out_dim -= 1
+            need = (out_dim - 1) * s + f - (i_dim + lo + hi)
+            padding[d] = (lo, hi + max(0, need))
     # init values must be Python scalars so JAX recognises the monoid and
     # uses the differentiable reduce_window_{sum,max} primitives
     if ptype == "max":

@@ -1,0 +1,25 @@
+"""v1 compatibility layer: the reference's first-generation user API.
+
+Three pieces, matching how a 2017 reference user worked
+(/root/reference/v1_api_demo/*):
+
+- :func:`parse_config` — evaluate a trainer-config python file (the
+  ``from paddle.trainer_config_helpers import *`` DSL) into Programs
+  (config_parser.py; reference trainer/config_parser.py:4345).
+- :mod:`~paddle_tpu.v1.data_provider` — the PyDataProvider2 ``@provider``
+  decorator + input-type declarations provider modules import.
+- :func:`train_from_config` — the ``paddle_trainer --config=...``
+  equivalent: provider-fed batched training of the parsed config.
+
+Import shims for the ``paddle.trainer_config_helpers`` /
+``paddle.trainer.PyDataProvider2`` module names are installed on first
+parse (only when no real ``paddle`` package exists), so unmodified
+reference config + provider files run as-is.
+"""
+from . import data_provider
+from .config_parser import ParsedConfig, parse_config
+from .helpers import ParseContext
+from .trainer import V1DataFeeder, make_reader, train_from_config
+
+__all__ = ["parse_config", "ParsedConfig", "ParseContext", "data_provider",
+           "train_from_config", "make_reader", "V1DataFeeder"]

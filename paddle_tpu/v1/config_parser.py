@@ -1,0 +1,145 @@
+"""parse_config — evaluate a v1 trainer config file into Programs.
+
+The reference's first user API is a Python config file evaluated by
+``parse_config`` (/root/reference/python/paddle/trainer/config_parser.py:
+4345, driven from C++ via TrainerConfigHelper.cpp:34-59) under the
+trainer_config_helpers DSL, producing a ModelConfig proto the trainer
+consumes. Here the same evaluation produces the repo's Program pair plus
+the config-level records (settings, data sources, inputs/outputs,
+evaluators) that :mod:`paddle_tpu.v1.trainer` consumes.
+
+Because reference config files open with
+``from paddle.trainer_config_helpers import *`` (and provider modules with
+``from paddle.trainer.PyDataProvider2 import *``), importable shim modules
+under the ``paddle`` name are installed on first use — only when no real
+``paddle`` package is present — so unmodified reference config files
+execute as-is.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+from ..core.program import Program, program_guard
+from . import data_provider as _dp
+from . import helpers as _h
+
+
+def _install_shims():
+    """Make ``paddle.trainer_config_helpers`` / ``paddle.trainer.
+    PyDataProvider2`` importable, pointing at the v1 compat modules."""
+    if "paddle" in sys.modules:
+        have = sys.modules["paddle"]
+        if not getattr(have, "__paddle_tpu_v1_shim__", False):
+            return  # a real paddle is installed; leave it alone
+    try:
+        import paddle  # noqa: F401 - a real installation wins
+        return
+    except ImportError:
+        pass
+    paddle = types.ModuleType("paddle")
+    paddle.__paddle_tpu_v1_shim__ = True
+    tch = types.ModuleType("paddle.trainer_config_helpers")
+    for name in _h._EXPORTS:
+        setattr(tch, name, getattr(_h, name))
+    tch.__all__ = list(_h._EXPORTS)
+    trainer = types.ModuleType("paddle.trainer")
+    pdp2 = types.ModuleType("paddle.trainer.PyDataProvider2")
+    for name in _dp.__all__:
+        setattr(pdp2, name, getattr(_dp, name))
+    pdp2.__all__ = list(_dp.__all__)
+    paddle.trainer_config_helpers = tch
+    paddle.trainer = trainer
+    trainer.PyDataProvider2 = pdp2
+    sys.modules["paddle"] = paddle
+    sys.modules["paddle.trainer_config_helpers"] = tch
+    sys.modules["paddle.trainer"] = trainer
+    sys.modules["paddle.trainer.PyDataProvider2"] = pdp2
+
+
+def _parse_config_args(config_arg_str):
+    """'a=1,b=x' -> dict (reference config_parser.py parse_config)."""
+    out = {}
+    for part in (config_arg_str or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+class ParsedConfig:
+    """What parse_config returns: the built Program pair + config records.
+
+    ``input_vars`` are the feed variables in the config's ``inputs()``
+    order (creation order when inputs() was not called) — the order
+    provider row tuples follow. ``output_vars`` are the ``outputs()``
+    targets (training configs: the cost)."""
+
+    def __init__(self, ctx, main_program, startup_program):
+        self.main_program = main_program
+        self.startup_program = startup_program
+        self.settings = ctx.settings
+        self.data_sources = ctx.data_sources
+        self.evaluators = ctx.evaluators
+        self.output_vars = list(ctx.outputs or [])
+        by_name = {v.name: v for v in ctx.data_layers}
+        order = ctx.inputs_order or [v.name for v in ctx.data_layers]
+        self.input_vars = [by_name[n] for n in order if n in by_name]
+        self.config_dir = ctx.config_dir
+
+    @property
+    def cost(self):
+        if not self.output_vars:
+            raise ValueError("config declared no outputs()")
+        return self.output_vars[0]
+
+    def build_optimizer(self):
+        """settings record -> a concrete optimizer, with the legacy
+        gradient_clipping_threshold installed on the main program."""
+        opt = (self.settings.get("learning_method")
+               or _h.MomentumOptimizer(momentum=0.0)).build(
+            self.settings.get("learning_rate", 0.01),
+            regularization=self.settings.get("regularization"))
+        thr = self.settings.get("gradient_clipping_threshold")
+        if thr:
+            from ..clip import GradientClipByGlobalNorm, set_gradient_clip
+
+            set_gradient_clip(GradientClipByGlobalNorm(thr),
+                              program=self.main_program)
+        return opt
+
+
+def parse_config(config_file, config_arg_str=""):
+    """Evaluate ``config_file`` (a v1 trainer config) and return a
+    :class:`ParsedConfig`. ``config_arg_str`` is the reference's
+    ``--config_args`` comma list, read inside the config via
+    get_config_arg()."""
+    _install_shims()
+    config_file = os.fspath(config_file)
+    with open(config_file) as fh:
+        source = fh.read()
+    ctx = _h.ParseContext(_parse_config_args(config_arg_str),
+                          config_dir=os.path.dirname(
+                              os.path.abspath(config_file)))
+    main_program, startup_program = Program(), Program()
+    ns = {name: getattr(_h, name) for name in _h._EXPORTS}
+    ns["__file__"] = config_file
+    ns["__name__"] = "__paddle_v1_config__"
+    prev_ctx = _h._CTX
+    _h._CTX = ctx
+    added_path = ctx.config_dir not in sys.path
+    if added_path:
+        sys.path.insert(0, ctx.config_dir)
+    try:
+        with program_guard(main_program, startup_program):
+            exec(compile(source, config_file, "exec"), ns)  # noqa: S102
+    finally:
+        _h._CTX = prev_ctx
+        if added_path and ctx.config_dir in sys.path:
+            sys.path.remove(ctx.config_dir)
+    if ctx.outputs is None and ctx.data_layers:
+        raise ValueError(f"{config_file}: config declared no outputs()")
+    return ParsedConfig(ctx, main_program, startup_program)

@@ -1,0 +1,597 @@
+"""trainer_config_helpers compatibility namespace — the v1 config DSL.
+
+This is the surface a reference v1 config file sees after
+``from paddle.trainer_config_helpers import *``
+(/root/reference/python/paddle/trainer_config_helpers/layers.py et al.).
+Each builder delegates to the v2 facade / fluid layers and records
+config-level state (settings, data sources, inputs/outputs, evaluators)
+into the active :class:`ParseContext` — the role the reference's global
+``g_config`` plays in config_parser.py.
+
+Input typing: the v1 DSL's ``data_layer(name, size)`` carries no dtype or
+sparsity — in the reference those come from the DATA PROVIDER's
+input_types at runtime. ``define_py_data_sources2`` therefore resolves the
+provider eagerly (imports the module, runs the init_hook) so data_layer
+can claim its InputType: by name when the provider declares a dict, by
+best dimension match when it declares a positional list (the reference
+matches positionally against the ``inputs()`` order, which is not yet
+known at data_layer time; dimension matching reproduces it for real
+configs, and ambiguity raises with a pointer to dict declarations).
+"""
+from __future__ import annotations
+
+import importlib
+import math
+import os
+import sys
+
+from .. import layers as L
+from .. import optimizer as _opt
+from ..initializer import (ConstantInitializer, NormalInitializer,
+                           UniformInitializer)
+from ..param_attr import ParamAttr as _FluidParamAttr
+from ..regularizer import L1DecayRegularizer, L2DecayRegularizer
+from ..v2 import layer as v2l
+from ..v2.data_type import InputType, dense_vector
+from . import data_provider as _dp
+
+# ---------------------------------------------------------------------------
+# parse context
+# ---------------------------------------------------------------------------
+
+_CTX = None  # the active ParseContext (set by config_parser.parse_config)
+
+
+class ParseContext:
+    def __init__(self, config_args=None, config_dir="."):
+        self.config_args = dict(config_args or {})
+        self.config_dir = config_dir
+        self.settings = {
+            "batch_size": 100,
+            "learning_rate": 0.01,
+            "learning_method": None,
+            "regularization": None,
+            "gradient_clipping_threshold": None,
+            "model_average": None,
+        }
+        self.data_sources = None       # define_py_data_sources2 record
+        self.provider_types = None     # dict name->InputType | list
+        self._claimed = set()          # claimed positional slots
+        self.data_layers = []          # creation order
+        self.inputs_order = None       # inputs() override
+        self.outputs = None
+        self.evaluators = []
+
+
+def _ctx() -> ParseContext:
+    if _CTX is None:
+        raise RuntimeError(
+            "the v1 DSL must run under parse_config() "
+            "(paddle_tpu.v1.parse_config)")
+    return _CTX
+
+
+# ---------------------------------------------------------------------------
+# config-level declarations
+# ---------------------------------------------------------------------------
+
+def get_config_arg(name, type_=str, default=None):
+    """Read a --config_args key (reference config_parser.py
+    get_config_arg)."""
+    val = _ctx().config_args.get(name)
+    if val is None:
+        return default
+    if type_ is bool:
+        return str(val).lower() not in ("0", "false", "")
+    return type_(val)
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """Record the data sources and eagerly resolve the provider's
+    input_types (reference trainer/config_parser data_sources handling) so
+    data_layer() can type its feeds."""
+    ctx = _ctx()
+    ctx.data_sources = {"train_list": train_list, "test_list": test_list,
+                        "module": module, "obj": obj,
+                        "args": dict(args or {})}
+    sys_path_added = ctx.config_dir not in sys.path
+    if sys_path_added:
+        sys.path.insert(0, ctx.config_dir)
+    try:
+        mod = importlib.import_module(module)
+    except Exception:  # noqa: BLE001 - unimportable provider (missing, or
+        # py2-only like the reference sequence_tagging dataprovider):
+        # data_layer falls back to dense typing; training needs a usable
+        # provider but parsing should not
+        return
+    finally:
+        if sys_path_added:
+            sys.path.remove(ctx.config_dir)
+    dp = getattr(mod, obj, None)
+    if isinstance(dp, _dp.DataProvider):
+        settings = dp.create(**ctx.data_sources["args"])
+        ctx.provider_types = settings.input_types
+        ctx.data_sources["provider"] = dp
+        ctx.data_sources["provider_settings"] = settings
+
+
+def settings(batch_size=None, learning_rate=None, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None,
+             model_average=None, **kw):
+    """The v1 settings() call (reference trainer_config_helpers/
+    optimizers.py settings): records the optimization recipe; the trainer
+    materializes it via build_optimizer()."""
+    ctx = _ctx()
+    for k, v in [("batch_size", batch_size),
+                 ("learning_rate", learning_rate),
+                 ("learning_method", learning_method),
+                 ("regularization", regularization),
+                 ("gradient_clipping_threshold",
+                  gradient_clipping_threshold),
+                 ("model_average", model_average)]:
+        if v is not None:
+            ctx.settings[k] = v
+    ctx.settings.update(kw)  # decay_a/b etc. kept for inspection
+
+
+def inputs(*layers_):
+    _ctx().inputs_order = [getattr(v, "name", v) for v in layers_]
+
+
+def outputs(*layers_):
+    flat = []
+    for item in layers_:
+        flat.extend(item if isinstance(item, (list, tuple)) else [item])
+    _ctx().outputs = flat
+
+
+# ---------------------------------------------------------------------------
+# settings objects: optimizers / regularization / model average
+# ---------------------------------------------------------------------------
+
+class _V1Optimizer:
+    factory = None
+    kwargs = {}
+
+    def build(self, learning_rate, regularization=None):
+        return type(self).factory(learning_rate=learning_rate,
+                                  regularization=regularization,
+                                  **self.kwargs)
+
+
+class AdamOptimizer(_V1Optimizer):
+    factory = _opt.AdamOptimizer
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.kwargs = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+
+class AdamaxOptimizer(_V1Optimizer):
+    factory = _opt.AdamaxOptimizer
+
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.kwargs = {"beta1": beta1, "beta2": beta2}
+
+
+class MomentumOptimizer(_V1Optimizer):
+    factory = _opt.MomentumOptimizer
+
+    def __init__(self, momentum=0.9, sparse=False):
+        self.kwargs = {"momentum": momentum}
+
+
+class AdaGradOptimizer(_V1Optimizer):
+    factory = _opt.AdagradOptimizer
+
+    def __init__(self):
+        self.kwargs = {}
+
+
+class DecayedAdaGradOptimizer(_V1Optimizer):
+    factory = _opt.DecayedAdagradOptimizer
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"decay": rho, "epsilon": epsilon}
+
+
+class AdaDeltaOptimizer(_V1Optimizer):
+    factory = _opt.AdadeltaOptimizer
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class RMSPropOptimizer(_V1Optimizer):
+    factory = _opt.RMSPropOptimizer
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"decay": rho, "epsilon": epsilon}
+
+
+def L2Regularization(rate):
+    return L2DecayRegularizer(regularization_coeff=rate)
+
+
+def L1Regularization(rate):
+    return L1DecayRegularizer(regularization_coeff=rate)
+
+
+class ModelAverage:
+    """settings(model_average=ModelAverage(w)) marker (the trainer may wire
+    it to optimizer.ModelAverage)."""
+
+    def __init__(self, average_window, max_average_window=None):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+
+
+# ---------------------------------------------------------------------------
+# activations / poolings / attrs
+# ---------------------------------------------------------------------------
+
+from ..v2 import activation as _act  # noqa: E402
+from ..v2 import pooling as _pool  # noqa: E402
+
+LinearActivation = _act.Linear
+IdentityActivation = _act.Linear
+ReluActivation = _act.Relu
+BReluActivation = _act.BRelu
+SoftReluActivation = _act.SoftRelu
+TanhActivation = _act.Tanh
+STanhActivation = _act.STanh
+SigmoidActivation = _act.Sigmoid
+SoftmaxActivation = _act.Softmax
+ExpActivation = _act.Exp
+LogActivation = _act.Log
+AbsActivation = _act.Abs
+SquareActivation = _act.Square
+SequenceSoftmaxActivation = _act.SequenceSoftmax
+
+MaxPooling = _pool.Max
+AvgPooling = _pool.Avg
+SumPooling = _pool.Sum
+SquareRootNPooling = _pool.SquareRootN
+
+
+class ParamAttr:
+    """v1 ParameterAttribute (reference trainer_config_helpers/attrs.py):
+    translated onto the fluid ParamAttr at use time."""
+
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, initializer=None):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+        self.initializer = initializer
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+
+    def to_fluid(self):
+        init = self.initializer
+        if init is None and self.initial_std is not None:
+            if self.initial_std == 0 and not self.initial_mean:
+                init = ConstantInitializer(0.0)
+            else:
+                init = NormalInitializer(loc=self.initial_mean or 0.0,
+                                         scale=self.initial_std)
+        elif init is None and self.initial_max is not None:
+            init = UniformInitializer(low=self.initial_min or 0.0,
+                                      high=self.initial_max)
+        reg = None
+        if self.l2_rate:
+            reg = L2DecayRegularizer(regularization_coeff=self.l2_rate)
+        elif self.l1_rate:
+            reg = L1DecayRegularizer(regularization_coeff=self.l1_rate)
+        from ..clip import GradientClipByNorm
+
+        clip = (GradientClipByNorm(self.gradient_clipping_threshold)
+                if self.gradient_clipping_threshold else None)
+        return _FluidParamAttr(
+            name=self.name, initializer=init,
+            learning_rate=self.learning_rate
+            if self.learning_rate is not None else 1.0,
+            regularizer=reg, trainable=not self.is_static,
+            gradient_clip=clip)
+
+
+ParameterAttribute = ParamAttr
+
+
+def _pa(attr):
+    """None | v1 ParamAttr | fluid ParamAttr -> fluid-compatible attr."""
+    if isinstance(attr, ParamAttr):
+        return attr.to_fluid()
+    return attr
+
+
+class ExtraLayerAttribute:
+    """Accepted for source compatibility (drop_rate is honored)."""
+
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.drop_rate = drop_rate
+
+
+ExtraAttr = ExtraLayerAttribute
+
+
+# ---------------------------------------------------------------------------
+# input-type resolution for data_layer
+# ---------------------------------------------------------------------------
+
+def _resolve_input_type(name, size):
+    """Claim this data layer's InputType from the provider declaration."""
+    ctx = _ctx()
+    types = ctx.provider_types
+    if isinstance(types, dict):
+        t = types.get(name)
+        if t is not None:
+            return t
+    elif isinstance(types, (list, tuple)):
+        # positional list: the reference matches slots to the inputs()
+        # order, unknown at this point — recover the pairing by dimension.
+        exact = [i for i, t in enumerate(types)
+                 if i not in ctx._claimed and t.dim == size]
+        loose = [i for i, t in enumerate(types)
+                 if i not in ctx._claimed and t.dim <= size]
+        pick = exact or loose
+        if len(pick) >= 1:
+            # several equal dims: claim in declaration order (matches the
+            # reference when creation order follows inputs() order for the
+            # tied slots)
+            ctx._claimed.add(pick[0])
+            return types[pick[0]]
+        raise ValueError(
+            f"data_layer({name!r}, size={size}): no unclaimed provider "
+            f"input_type slot fits; declare input_types as a dict keyed "
+            f"by layer name to disambiguate")
+    return dense_vector(size)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+def data_layer(name, size, height=None, width=None, **kw):
+    t = _resolve_input_type(name, size)
+    if t.sparse and t.seq_type:
+        # per-timestep sparse id lists: [b, T, K] ids, K-padded with -1;
+        # fc masks the pads (see _sparse_seq_fc_branch)
+        var = L.data(name, shape=[-1], dtype="int64", lod_level=1)
+        var.input_type = t
+        var.sparse_seq = True
+        ctx = _ctx()
+        ctx.data_layers.append(var)
+        return var
+    var = v2l.data(name, t)
+    var.height, var.width = height, width
+    _ctx().data_layers.append(var)
+    return var
+
+
+def _sparse_seq_fc_branch(inp, size, param_attr):
+    """fc over a sequence of sparse binary vectors: per-timestep
+    embedding-sum. ids [b, T, K] are K-padded with -1; the pad mask zeroes
+    their contribution so the result equals each timestep's multi-hot row
+    @ W exactly."""
+    t = inp.input_type
+    ids = L.relu(inp)  # clamp the -1 pads to a valid lookup id
+    emb = L.embedding(ids, size=[t.dim, size], param_attr=_pa(param_attr))
+    mask = L.cast(L.greater_equal(
+        inp, L.fill_constant(shape=[1], value=0, dtype=inp.dtype)),
+        "float32")
+    emb = L.elementwise_mul(emb, L.reshape(mask, shape=[0, 0, -1, 1]))
+    summed = L.reduce_sum(emb, dim=-2)
+    summed.seq_len = inp.seq_len
+    return summed
+
+
+def fc_layer(input, size, act=None, param_attr=None, bias_attr=None, **kw):
+    inputs_ = input if isinstance(input, (list, tuple)) else [input]
+    sparse_seq = [v for v in inputs_ if getattr(v, "sparse_seq", False)]
+    rest = [v for v in inputs_ if not getattr(v, "sparse_seq", False)]
+    if not sparse_seq:
+        return v2l.fc(input if isinstance(input, (list, tuple)) and
+                      len(inputs_) > 1 else inputs_[0], size, act=act,
+                      param_attr=_pa(param_attr), bias_attr=bias_attr)
+    from ..layers.layer_helper import LayerHelper
+
+    branches = [_sparse_seq_fc_branch(v, size, param_attr)
+                for v in sparse_seq]
+    if rest:
+        # a [b, size] dense branch cannot broadcast onto the [b, T, size]
+        # per-timestep branches
+        raise ValueError("fc over mixed sparse-sequence and plain inputs "
+                         "is not supported")
+    summed = branches[0] if len(branches) == 1 else L.addto(branches,
+                                                            act=None)
+    helper = LayerHelper("fc")
+    seq_len = branches[0].seq_len
+    if bias_attr is not False:
+        summed = helper.append_bias_op(summed, bias_attr, size,
+                                       dim_start=len(summed.shape) - 1)
+    summed = helper.append_activation(summed, _act.resolve(act))
+    summed.seq_len = seq_len
+    return summed
+
+
+def embedding_layer(input, size, param_attr=None, **kw):
+    return v2l.embedding(input, size, param_attr=_pa(param_attr))
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, groups=1, act=None, param_attr=None,
+                   bias_attr=None, **kw):
+    input = _as_image(input, num_channels)
+    return v2l.img_conv(input, filter_size, num_filters,
+                        num_channels=num_channels, stride=stride,
+                        padding=padding, groups=groups, act=act,
+                        param_attr=_pa(param_attr), bias_attr=bias_attr)
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   num_channels=None, ceil_mode=True, **kw):
+    return v2l.img_pool(_as_image(input, num_channels), pool_size,
+                        stride=stride, padding=padding, pool_type=pool_type,
+                        ceil_mode=ceil_mode)
+
+
+def batch_norm_layer(input, act=None, **kw):
+    return v2l.batch_norm(input, act=act, **kw)
+
+
+def dropout_layer(input, dropout_rate=0.5, **kw):
+    return v2l.dropout(input, dropout_rate)
+
+
+def pooling_layer(input, pooling_type=None, **kw):
+    return v2l.pooling(input, pooling_type)
+
+
+def concat_layer(input, **kw):
+    return v2l.concat(input)
+
+
+def addto_layer(input, act=None, **kw):
+    return v2l.addto(input, act=act)
+
+
+def maxid_layer(input, **kw):
+    return v2l.max_id(input)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kw):
+    return v2l.lstmemory(input, size=size, reverse=reverse)
+
+
+def grumemory(input, size=None, reverse=False, **kw):
+    return v2l.grumemory(input, size=size, reverse=reverse)
+
+
+def first_seq(input, **kw):
+    return v2l.first_seq(input)
+
+
+def last_seq(input, **kw):
+    return v2l.last_seq(input)
+
+
+def crf_layer(input, label, size=None, param_attr=None, **kw):
+    return L.linear_chain_crf(input, label, param_attr=_pa(param_attr))
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       **kw):
+    return L.crf_decoding(input, param_attr=_pa(param_attr), label=label)
+
+
+def classification_cost(input, label, name=None, **kw):
+    return v2l.classification_cost(input, label)
+
+
+def cross_entropy(input, label, **kw):
+    return v2l.cross_entropy_cost(input, label)
+
+
+def regression_cost(input, label, **kw):
+    return v2l.square_error_cost(input, label)
+
+
+mse_cost = regression_cost
+
+
+def _as_image(var, num_channels=None):
+    """v1 image layers consume flat [C*H*W] data vectors; reshape to NHWC
+    when needed (the reference config_parser infers H=W=sqrt(size/C),
+    config_parser.py parse_image)."""
+    shape = [int(d) for d in var.shape if d != -1]
+    if len(shape) == 1 and num_channels:
+        hw = int(math.isqrt(shape[0] // num_channels))
+        if hw * hw * num_channels != shape[0]:
+            raise ValueError(
+                f"cannot infer square image from size {shape[0]} with "
+                f"{num_channels} channels")
+        return L.reshape(var, shape=[-1, hw, hw, num_channels])
+    return var
+
+
+def img_conv_group(input, conv_num_filter, num_channels=None, pool_size=2,
+                   pool_stride=2, conv_padding=1, conv_filter_size=3,
+                   conv_act=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_type=None, **kw):
+    """VGG-style group (reference trainer_config_helpers/networks.py
+    img_conv_group): N convs (+BN (+dropout)) then one pool. Honors the
+    v1 conv_padding contract (the fluid nets version always same-pads)."""
+    n = len(conv_num_filter)
+
+    def per(x):
+        return list(x) if isinstance(x, (list, tuple)) else [x] * n
+
+    pads = per(conv_padding)
+    sizes = per(conv_filter_size)
+    with_bn = per(conv_with_batchnorm)
+    drops = per(conv_batchnorm_drop_rate)
+    tmp = _as_image(input, num_channels)
+    for i in range(n):
+        tmp = v2l.img_conv(tmp, sizes[i], conv_num_filter[i],
+                           stride=1, padding=pads[i],
+                           act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = v2l.batch_norm(tmp, act=conv_act)
+            if drops[i] > 0:
+                tmp = v2l.dropout(tmp, drops[i])
+    return v2l.img_pool(tmp, pool_size, stride=pool_stride,
+                        pool_type=pool_type)
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None, **kw):
+    tmp = img_conv_layer(input, filter_size, num_filters,
+                         num_channels=num_channel, padding=(filter_size - 1)
+                         // 2, act=act)
+    return v2l.img_pool(tmp, pool_size, stride=pool_stride,
+                        pool_type=MaxPooling())
+
+
+# ---------------------------------------------------------------------------
+# evaluators: record the declaration; the v1 trainer materializes them
+# ---------------------------------------------------------------------------
+
+def _evaluator(kind, **kw):
+    _ctx().evaluators.append({"kind": kind, **kw})
+
+
+def sum_evaluator(input, name=None, **kw):
+    _evaluator("sum", name=name, input=input)
+
+
+def classification_error_evaluator(input, label, name=None, **kw):
+    _evaluator("classification_error", name=name, input=input, label=label)
+
+
+def chunk_evaluator(input, label=None, chunk_scheme=None,
+                    num_chunk_types=None, name=None, **kw):
+    _evaluator("chunk", name=name, input=input, label=label,
+               chunk_scheme=chunk_scheme, num_chunk_types=num_chunk_types)
+
+
+def auc_evaluator(input, label, name=None, **kw):
+    _evaluator("auc", name=name, input=input, label=label)
+
+
+def precision_recall_evaluator(input, label, name=None, **kw):
+    _evaluator("precision_recall", name=name, input=input, label=label)
+
+
+# everything a `from paddle.trainer_config_helpers import *` should see
+_EXPORTS = [n for n in dir() if not n.startswith("_")
+            and n not in ("annotations", "importlib", "math", "os", "sys")]

@@ -109,12 +109,14 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
 
 
 def img_pool(input, pool_size, stride=1, padding=0, pool_type=None,
-             data_format="NHWC", **kw):
-    """img_pool_layer."""
+             ceil_mode=True, data_format="NHWC", **kw):
+    """img_pool_layer. ``ceil_mode`` defaults True — the v1 DSL's output
+    size rule (reference trainer_config_helpers/layers.py img_pool_layer
+    ceil_mode=True)."""
     return L.pool2d(input, pool_size=pool_size, pool_stride=stride,
                     pool_padding=padding,
                     pool_type=_pool.resolve(pool_type),
-                    data_format=data_format)
+                    ceil_mode=ceil_mode, data_format=data_format)
 
 
 def batch_norm(input, act=None, **kw):
