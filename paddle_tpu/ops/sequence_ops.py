@@ -244,3 +244,30 @@ def sequence_mask(attrs, ins):
         raise ValueError("sequence_mask requires a static maxlen attr on TPU")
     dtype = attrs.get("out_dtype", "float32")
     return out(Y=time_mask(lengths, maxlen, jnp.dtype(dtype)))
+
+
+@register_op("context_project", optional_inputs=("Length",))
+def context_project(attrs, ins):
+    """Context-window concatenation WITHOUT the filter matmul — the v1
+    context_projection (reference trainer_config_helpers/layers.py
+    context_projection -> ContextProjection.cpp): each timestep's feature
+    row becomes the concat of its [start, start+length) neighbours, zeros
+    outside the sequence. The filterless half of sequence_conv above."""
+    x = single(ins, "X")  # [b, T, d]
+    lengths = maybe(ins, "Length")
+    k = int(attrs["context_length"])
+    start = int(attrs.get("context_start", -(k // 2)))
+    b, T, d = x.shape
+    mask = (time_mask(lengths, T, x.dtype)[..., None]
+            if lengths is not None else jnp.ones((b, T, 1), x.dtype))
+    xm = x * mask
+    cols = []
+    for off in range(start, start + k):
+        if off < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+        elif off > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    return out(Out=jnp.concatenate(cols, axis=-1) * mask)

@@ -234,3 +234,438 @@ def huber_regression_cost(input, label, delta=1.0, **kw):
     outs, _ = h.append_op("huber_loss", {"X": [input], "Y": [label]},
                           ["Out", "Residual"], {"delta": float(delta)})
     return L.mean(outs["Out"][0])
+
+
+# ---------------------------------------------------------------------------
+# mixed_layer + projections (reference trainer_config_helpers/layers.py
+# mixed_layer, *_projection: a mixed layer sums the projected inputs, then
+# bias + activation — MixedLayer.cpp. Projections are deferred builders;
+# mixed_layer(input=[...]) is the immediate form, `with mixed_layer(...)
+# as m: m += proj` the incremental one.)
+# ---------------------------------------------------------------------------
+
+class BaseProjection:
+    def __init__(self, input, param_attr=None):
+        self.input = input
+        self.param_attr = param_attr
+
+    def build(self, size):
+        raise NotImplementedError
+
+
+class full_matrix_projection(BaseProjection):
+    """input @ W, no bias (FullMatrixProjection.cpp)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        super().__init__(input, param_attr)
+
+    def build(self, size):
+        return L.fc(self.input, size=size, act=None,
+                    param_attr=self.param_attr, bias_attr=False)
+
+
+class trans_full_matrix_projection(BaseProjection):
+    """input @ W^T — the weight is stored [size, in] and shared with a
+    forward projection by name (TransposedFullMatrixProjection.cpp)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        super().__init__(input, param_attr)
+
+    def build(self, size):
+        from ..layers.layer_helper import LayerHelper
+
+        helper = LayerHelper("trans_fc")
+        d = int(self.input.shape[-1])
+        w = helper.create_parameter(self.param_attr, shape=[size, d],
+                                    dtype=self.input.dtype)
+        return L.matmul(self.input, L.transpose(w, axis=[1, 0]))
+
+
+class table_projection(BaseProjection):
+    """Embedding-table lookup of integer input (TableProjection.cpp)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        super().__init__(input, param_attr)
+
+    def build(self, size):
+        return embedding(self.input, size, param_attr=self.param_attr)
+
+
+class identity_projection(BaseProjection):
+    """Pass-through, or a feature slice when offset is given
+    (IdentityProjection.cpp / IdentityOffsetProjection.cpp)."""
+
+    def __init__(self, input, offset=None, size=None):
+        super().__init__(input)
+        self.offset = offset
+        self.size = size
+
+    def build(self, size):
+        if self.offset is None:
+            return self.input
+        end = self.offset + (self.size or size)
+        from ..layers.layer_helper import LayerHelper
+
+        helper = LayerHelper("identity_offset")
+        rank = len(self.input.shape)
+        return helper.simple_op(
+            "slice", {"X": [self.input]},
+            {"axes": [rank - 1], "starts": [int(self.offset)],
+             "ends": [int(end)]})
+
+
+class scaling_projection(BaseProjection):
+    """w * input with a single learned scalar (ScalingProjection.cpp)."""
+
+    def build(self, size):
+        from ..layers.layer_helper import LayerHelper
+
+        helper = LayerHelper("scaling_projection")
+        w = helper.create_parameter(self.param_attr, shape=[1],
+                                    dtype=self.input.dtype)
+        return L.elementwise_mul(self.input, w)
+
+
+class dotmul_projection(BaseProjection):
+    """input .* w with a learned per-feature vector (DotMulProjection)."""
+
+    def build(self, size):
+        from ..layers.layer_helper import LayerHelper
+
+        helper = LayerHelper("dotmul_projection")
+        d = int(self.input.shape[-1])
+        w = helper.create_parameter(self.param_attr, shape=[d],
+                                    dtype=self.input.dtype)
+        return L.elementwise_mul(self.input, w)
+
+
+class context_projection(BaseProjection):
+    """Neighbour-window concat over the time axis (ContextProjection.cpp);
+    trainable out-of-range padding is not supported (rows are zeros)."""
+
+    def __init__(self, input, context_len, context_start=None, **kw):
+        super().__init__(input)
+        self.context_len = int(context_len)
+        self.context_start = (-(self.context_len // 2)
+                              if context_start is None else
+                              int(context_start))
+
+    def build(self, size):
+        from ..layers.layer_helper import LayerHelper
+        from ..layers.sequence import _len_input
+
+        helper = LayerHelper("context_project")
+        out = helper.simple_op(
+            "context_project",
+            {"X": [self.input], **_len_input(self.input)},
+            {"context_length": self.context_len,
+             "context_start": self.context_start})
+        sl = getattr(self.input, "seq_len", None)
+        if sl is not None:
+            out.seq_len = sl
+        return out
+
+
+class MixedLayerType:
+    """What mixed_layer() returns: collects projections via ``+=`` inside
+    a ``with`` block; at exit it BECOMES the built output variable (the
+    instance adopts the Variable's class/state), so the reference idiom
+    of using the mixed object as a layer input works unchanged."""
+
+    def __init__(self, size, act=None, bias_attr=None):
+        self._size = size
+        self._act = act
+        self._bias_attr = bias_attr
+        self._projections = []
+
+    def __iadd__(self, proj):
+        if not isinstance(proj, BaseProjection):
+            raise TypeError(f"mixed_layer += expects a projection, got "
+                            f"{type(proj).__name__}")
+        self._projections.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def _finalize(self):
+        var = _build_mixed(self._projections, self._size, self._act,
+                           self._bias_attr)
+        # adopt the Variable's identity: everything downstream reads
+        # name/shape/block from the shared state
+        self.__class__ = var.__class__
+        self.__dict__ = var.__dict__
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+
+def _build_mixed(projections, size, act, bias_attr):
+    if not projections:
+        raise ValueError("mixed_layer has no projections")
+    from ..layers.layer_helper import LayerHelper
+
+    built = [p.build(size) for p in projections]
+    widths = {int(v.shape[-1]) for v in built}
+    if len(widths) > 1:
+        raise ValueError(
+            f"mixed_layer projections disagree on width: {sorted(widths)}")
+    summed = built[0] if len(built) == 1 else L.sums(built)
+    helper = LayerHelper("mixed")
+    out_size = widths.pop()
+    if bias_attr is not False:
+        summed = helper.append_bias_op(summed, bias_attr, out_size,
+                                       dim_start=len(summed.shape) - 1)
+    result = helper.append_activation(summed, _act.resolve(act))
+    sl = next((getattr(v, "seq_len", None) for v in built
+               if getattr(v, "seq_len", None) is not None), None)
+    if sl is not None:
+        result.seq_len = sl
+    return result
+
+
+def mixed_layer(size=0, input=None, act=None, bias_attr=None, **kw):
+    """mixed_layer: immediate form returns the Variable; without input,
+    a context manager collecting ``+=`` projections."""
+    if input is not None:
+        projs = input if isinstance(input, (list, tuple)) else [input]
+        return _build_mixed(list(projs), size, act, bias_attr)
+    return MixedLayerType(size, act=act, bias_attr=bias_attr)
+
+
+mixed = mixed_layer
+
+
+# ---------------------------------------------------------------------------
+# v1 layer-name tail: thin keyword adapters over the fluid layer fns
+# (reference trainer_config_helpers/layers.py names; math in
+# layers/legacy.py and the op registry)
+# ---------------------------------------------------------------------------
+
+def cos_sim(a, b, scale=1.0, **kw):
+    """cos_sim layer (CosSimLayer.cpp); ``scale`` multiplies the cosine."""
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("cos_sim")
+    outs, _ = helper.append_op("cos_sim", {"X": [a], "Y": [b]},
+                               ["Out", "XNorm", "YNorm"], {})
+    sim = outs["Out"][0]
+    return L.scale(sim, float(scale)) if scale != 1.0 else sim
+
+
+def trans(input, **kw):
+    """trans_layer: transpose the two feature dims (TransLayer.cpp)."""
+    return L.transpose(input, axis=[0, 2, 1])
+
+
+def interpolation(input, weight, **kw):
+    """interpolation_layer: w*x + (1-w)*y (InterpolationLayer.cpp)."""
+    x, y = input
+    return L.interpolation(x, y, weight)
+
+
+def power(input, weight, **kw):
+    return L.power(input, weight)
+
+
+def scaling(input, weight, **kw):
+    return L.scaling(input, weight)
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, **kw):
+    return L.slope_intercept(input, slope=slope, intercept=intercept)
+
+
+def sum_to_one_norm(input, **kw):
+    return L.sum_to_one_norm(input)
+
+
+def row_l2_norm(input, **kw):
+    return L.row_l2_norm(input)
+
+
+def scale_shift(input, param_attr=None, bias_attr=None, **kw):
+    return L.scale_shift(input, param_attr=param_attr, bias_attr=bias_attr)
+
+
+def linear_comb(weights, vectors, size=None, **kw):
+    return L.linear_comb(weights, vectors)
+
+
+def dot_prod(a, b, **kw):
+    return L.dot_prod(a, b)
+
+
+def out_prod(a, b, **kw):
+    return L.out_prod(a, b)
+
+
+def l2_distance(a, b, **kw):
+    return L.l2_distance(a, b)
+
+
+def repeat(input, num_repeats, as_row_vector=True, **kw):
+    return L.repeat(input, num_repeats, as_row_vector=as_row_vector)
+
+
+def resize(input, size, **kw):
+    return L.resize(input, size)
+
+
+def rotate(input, height, width=None, **kw):
+    return L.rotate(input, height, width or height)
+
+
+def multiplex(input, index, **kw):
+    return L.multiplex(list(input), index)
+
+
+def kmax_seq_score(input, beam_size=1, **kw):
+    return L.kmax_seq_score(input, beam_size=beam_size)
+
+
+def seq_reshape(input, reshape_size, **kw):
+    return L.sequence_reshape(input, reshape_size)
+
+
+def seq_concat(a, b, **kw):
+    return L.sequence_concat([a, b])
+
+
+def sampling_id(input, **kw):
+    return L.sampling_id(input)
+
+
+def factorization_machine(input, factor_size, param_attr=None, **kw):
+    return L.factorization_machine(input, factor_size,
+                                   param_attr=param_attr)
+
+
+def gated_unit(input, size, act=None, **kw):
+    return L.gated_unit(input, size, act=_act.resolve(act) or "tanh")
+
+
+def maxout(input, groups, num_channels=None, **kw):
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("maxout")
+    return helper.simple_op("maxout", {"X": [input]}, {"groups": groups})
+
+
+def prelu(input, param_attr=None, **kw):
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("prelu")
+    alpha = helper.create_parameter(
+        param_attr, shape=[1], dtype=input.dtype,
+        default_initializer=None) if param_attr is not None else None
+    if alpha is None:
+        from ..initializer import ConstantInitializer
+        from ..param_attr import ParamAttr as _PA
+
+        alpha = helper.create_parameter(
+            _PA(initializer=ConstantInitializer(0.25)), shape=[1],
+            dtype=input.dtype)
+    return helper.simple_op("prelu", {"X": [input], "Alpha": [alpha]}, {})
+
+
+def pad(input, paddings, pad_value=0.0, **kw):
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("pad")
+    return helper.simple_op("pad", {"X": [input]},
+                            {"paddings": list(paddings),
+                             "pad_value": float(pad_value)})
+
+
+def block_expand(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                 padding_x=0, padding_y=0, num_channels=None, **kw):
+    """block_expand_layer (BlockExpandLayer.cpp -> im2sequence_op)."""
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("block_expand")
+    return helper.simple_op(
+        "im2sequence", {"X": [input]},
+        {"kernels": [block_y, block_x], "strides": [stride_y, stride_x],
+         "paddings": [padding_y, padding_x, padding_y, padding_x]})
+
+
+def conv_shift(a, b, **kw):
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("conv_shift")
+    return helper.simple_op("conv_shift", {"X": [a], "Y": [b]}, {})
+
+
+def sum_cost(input, **kw):
+    """sum_cost (SumCostLayer.cpp): plain sum of the input."""
+    return L.reduce_sum(input)
+
+
+def huber_classification_cost(input, label, delta=1.0, **kw):
+    """HuberTwoClassification (CostLayer.cpp): labels {0,1} -> y in
+    {-1,+1}; loss = max(0, 1-z)^2 where z = y*f for z >= -1, else -4z."""
+    y = L.scale(L.cast(label, "float32"), 2.0, bias=-1.0)
+    z = L.elementwise_mul(y, input)
+    sq = L.square(L.relu(L.scale(z, -1.0, bias=1.0)))
+    lin = L.scale(z, -4.0)
+    ge = L.cast(L.greater_equal(
+        z, L.fill_constant(shape=[1], value=-1.0, dtype="float32")),
+        "float32")
+    cost = L.elementwise_add(
+        L.elementwise_mul(ge, sq),
+        L.elementwise_mul(L.scale(ge, -1.0, bias=1.0), lin))
+    return L.mean(cost)
+
+
+def multi_binary_label_cross_entropy(input, label, **kw):
+    """multi_binary_label_cross_entropy_layer: per-class sigmoid CE."""
+    return L.mean(L.sigmoid_cross_entropy_with_logits(input, label))
+
+
+def smooth_l1_cost(input, label, **kw):
+    """smooth_l1_cost (SmoothL1CostLayer.cpp)."""
+    d = L.elementwise_sub(input, label)
+    a = L.abs(d)
+    lt = L.cast(L.less_than(
+        a, L.fill_constant(shape=[1], value=1.0, dtype="float32")),
+        "float32")
+    quad = L.scale(L.square(d), 0.5)
+    lin = L.scale(a, 1.0, bias=-0.5)
+    return L.mean(L.elementwise_add(
+        L.elementwise_mul(lt, quad),
+        L.elementwise_mul(L.scale(lt, -1.0, bias=1.0), lin)))
+
+
+def nce(input, label, num_classes, num_neg_samples=10, param_attr=None,
+        bias_attr=None, **kw):
+    """nce_layer (NCELayer.cpp): noise-contrastive estimation cost."""
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("nce")
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[num_classes, d],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_classes],
+                                dtype=input.dtype, is_bias=True)
+    return helper.simple_op(
+        "nce", {"Input": [input], "Label": [label], "Weight": [w],
+                "Bias": [b]},
+        {"num_total_classes": int(num_classes),
+         "num_neg_samples": int(num_neg_samples)}, out_slot="Cost")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             **kw):
+    return L.hsigmoid(input, label, num_classes, param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+def eos(input, eos_id, **kw):
+    """eos_layer: 1 where the id equals eos_id (EosIdCheckLayer.cpp)."""
+    return L.cast(L.equal(
+        input, L.fill_constant(shape=[1], value=int(eos_id),
+                               dtype=input.dtype)), "float32")
