@@ -133,8 +133,14 @@ def log_softmax(attrs, ins):
 
 @register_op("maxout")
 def maxout(attrs, ins):
-    x = single(ins, "X")  # NCHW
+    # NCHW image form (reference maxout_op.cc) and the v1 2-D feature form
+    # (reference MaxOutLayer on flattened vectors): channels split into
+    # `groups` consecutive chunks, elementwise max across the chunk.
+    x = single(ins, "X")
     groups = attrs["groups"]
+    if x.ndim == 2:
+        n, d = x.shape
+        return out(Out=jnp.max(x.reshape(n, d // groups, groups), axis=2))
     n, c, h, w = x.shape
     return out(Out=jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
 

@@ -235,13 +235,23 @@ def sequence_enumerate(attrs, ins):
     return out(Out=jnp.stack(cols, axis=-1))
 
 
-@register_op("sequence_mask")
+@register_op("sequence_mask", optional_inputs=("MaxLenRef",))
 def sequence_mask(attrs, ins):
-    """Lengths -> [b, maxlen] 0/1 mask (sequence_mask semantics)."""
+    """Lengths -> [b, maxlen] 0/1 mask (sequence_mask semantics).
+
+    maxlen comes from the static ``maxlen`` attr, or — for dynamic-length
+    graphs where no static bound exists at build time — from the last dim
+    of the optional ``MaxLenRef`` input (concrete once the executor
+    compiles against the actual feeds)."""
     lengths = single(ins, "X")
     maxlen = int(attrs.get("maxlen", -1))
     if maxlen <= 0:
-        raise ValueError("sequence_mask requires a static maxlen attr on TPU")
+        ref = maybe(ins, "MaxLenRef")
+        if ref is None:
+            raise ValueError(
+                "sequence_mask requires a static maxlen attr or a "
+                "MaxLenRef input on TPU")
+        maxlen = ref.shape[-1]
     dtype = attrs.get("out_dtype", "float32")
     return out(Y=time_mask(lengths, maxlen, jnp.dtype(dtype)))
 

@@ -56,6 +56,25 @@ def _is_sparse(v):
     return t is not None and t.sparse
 
 
+def _fc_flatten_dims(inputs):
+    """The v1 fc_layer contract, PER INPUT: a [b, T, d] sequence input is
+    transformed per timestep (reference fc_layer applied inside the time
+    loop); a static image tensor is flattened whole. Per-timestep
+    whenever an input carries sequence-ness or a dynamic inner dim that
+    would poison the flattened fan-in with the -1 sentinel."""
+    nfds = []
+    for v in inputs:
+        shape = v.shape or ()
+        if len(shape) > 2 and (getattr(v, "seq_len", None) is not None
+                               or getattr(getattr(v, "input_type", None),
+                                          "seq_type", 0)
+                               or -1 in shape[1:-1]):
+            nfds.append(len(shape) - 1)
+        else:
+            nfds.append(1)
+    return nfds
+
+
 def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
     """fc_layer. ``input`` may be a list (each gets its own weight); sparse
     id-list inputs route through the embedding-sum path. The bias (one per
@@ -65,8 +84,14 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
     sparse = [v for v in inputs if _is_sparse(v)]
     dense = [v for v in inputs if not _is_sparse(v)]
     if not sparse:
-        return L.fc(input, size=size, act=_act.resolve(act),
-                    param_attr=param_attr, bias_attr=bias_attr)
+        r = L.fc(input, size=size, act=_act.resolve(act),
+                 param_attr=param_attr, bias_attr=bias_attr,
+                 num_flatten_dims=_fc_flatten_dims(inputs))
+        sl = next((getattr(v, "seq_len", None) for v in inputs
+                   if getattr(v, "seq_len", None) is not None), None)
+        if sl is not None and len(r.shape) >= 2:
+            r.seq_len = sl
+        return r
     from ..layers.layer_helper import LayerHelper
 
     branches = [_sparse_fc_branch(v, size, param_attr) for v in sparse]
@@ -124,6 +149,16 @@ def batch_norm(input, act=None, **kw):
     return L.batch_norm(input, act=_act.resolve(act),
                         data_layout=kw.get("data_format", "NHWC"),
                         is_test=kw.get("is_test", False))
+
+
+def dropout_keep_len(var, rate):
+    """Dropout that preserves the sequence-length annotation (dropout is
+    shape-preserving, so the mask survives)."""
+    sl = getattr(var, "seq_len", None)
+    var = dropout(var, rate)
+    if sl is not None:
+        var.seq_len = sl
+    return var
 
 
 def dropout(input, dropout_rate=0.5, **kw):
@@ -260,8 +295,10 @@ class full_matrix_projection(BaseProjection):
         super().__init__(input, param_attr)
 
     def build(self, size):
-        return L.fc(self.input, size=size, act=None,
-                    param_attr=self.param_attr, bias_attr=False)
+        # via the v2 fc: per-timestep on sequence inputs (the reference
+        # projection operates inside the time loop)
+        return fc(self.input, size, act=None,
+                  param_attr=self.param_attr, bias_attr=False)
 
 
 class trans_full_matrix_projection(BaseProjection):
@@ -372,10 +409,11 @@ class MixedLayerType:
     instance adopts the Variable's class/state), so the reference idiom
     of using the mixed object as a layer input works unchanged."""
 
-    def __init__(self, size, act=None, bias_attr=None):
+    def __init__(self, size, act=None, bias_attr=False, drop_rate=0.0):
         self._size = size
         self._act = act
         self._bias_attr = bias_attr
+        self._drop_rate = drop_rate
         self._projections = []
 
     def __iadd__(self, proj):
@@ -391,6 +429,8 @@ class MixedLayerType:
     def _finalize(self):
         var = _build_mixed(self._projections, self._size, self._act,
                            self._bias_attr)
+        if self._drop_rate:
+            var = dropout_keep_len(var, self._drop_rate)
         # adopt the Variable's identity: everything downstream reads
         # name/shape/block from the shared state
         self.__class__ = var.__class__
@@ -427,13 +467,20 @@ def _build_mixed(projections, size, act, bias_attr):
     return result
 
 
-def mixed_layer(size=0, input=None, act=None, bias_attr=None, **kw):
+def mixed_layer(size=0, input=None, act=None, bias_attr=False,
+                drop_rate=0.0, **kw):
     """mixed_layer: immediate form returns the Variable; without input,
-    a context manager collecting ``+=`` projections."""
+    a context manager collecting ``+=`` projections. NO bias unless
+    bias_attr is given — the reference decorates mixed_layer with
+    wrap_bias_attr_default(has_bias=False) (layers.py:865)."""
     if input is not None:
         projs = input if isinstance(input, (list, tuple)) else [input]
-        return _build_mixed(list(projs), size, act, bias_attr)
-    return MixedLayerType(size, act=act, bias_attr=bias_attr)
+        var = _build_mixed(list(projs), size, act, bias_attr)
+        if drop_rate:
+            var = dropout_keep_len(var, drop_rate)
+        return var
+    return MixedLayerType(size, act=act, bias_attr=bias_attr,
+                          drop_rate=drop_rate)
 
 
 mixed = mixed_layer

@@ -8,14 +8,20 @@ from . import layer as l2
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
-                         pool_stride=None, act=None, data_format="NHWC",
-                         **kw):
+                         pool_stride=1, act=None, pool_type=None,
+                         groups=1, conv_stride=1, conv_padding=0,
+                         bias_attr=None, param_attr=None, pool_padding=0,
+                         data_format="NHWC", **kw):
+    """conv -> pool with the REFERENCE defaults (reference networks.py:144:
+    conv_padding=0, conv_stride=1, pool_stride=1, pool_padding=0) so
+    unmodified configs reproduce the reference's output geometry."""
     conv = l2.img_conv(input, filter_size=filter_size,
                        num_filters=num_filters, act=act,
-                       padding=(filter_size - 1) // 2,
-                       data_format=data_format)
-    return l2.img_pool(conv, pool_size=pool_size,
-                       stride=pool_stride or pool_size,
+                       stride=conv_stride, padding=conv_padding,
+                       groups=groups, param_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    return l2.img_pool(conv, pool_size=pool_size, stride=pool_stride,
+                       padding=pool_padding, pool_type=pool_type,
                        data_format=data_format)
 
 
@@ -158,11 +164,18 @@ def _masked_softmax_over_time(scores, seq_len):
 
     helper = LayerHelper("attn_mask")
     Te = int(scores.shape[-1])
-    mask = helper.simple_op(  # [b, Te] 1/0
-        "sequence_mask", {"X": [seq_len]},
-        {"maxlen": Te, "out_dtype": "float32"}, out_slot="Y")
+    if Te > 0:
+        mask = helper.simple_op(  # [b, Te] 1/0
+            "sequence_mask", {"X": [seq_len]},
+            {"maxlen": Te, "out_dtype": "float32"}, out_slot="Y")
+    else:
+        # Dynamic source-time dim: resolve maxlen from the scores' own
+        # runtime shape at executor compile time.
+        mask = helper.simple_op(
+            "sequence_mask", {"X": [seq_len], "MaxLenRef": [scores]},
+            {"maxlen": -1, "out_dtype": "float32"}, out_slot="Y")
     penalty = L.scale(mask, 1e9, bias=-1e9)  # 0 where valid, -1e9 at pads
-    penalty = L.reshape(penalty, shape=[0, 1, Te])
+    penalty = L.reshape(penalty, shape=[0, 1, Te if Te > 0 else -1])
     return L.softmax(L.elementwise_add(scores, penalty))
 
 
@@ -242,11 +255,14 @@ def gru_encoder_decoder(src, trg_in, src_dict_dim, trg_dict_dim,
     s_emb.seq_len = src.seq_len
     if bidirectional:
         enc = bidirectional_gru(s_emb, encoder_size)
-        enc.seq_len = src.seq_len
         enc_dim = 2 * encoder_size
     else:
         enc = simple_gru(s_emb, encoder_size)
         enc_dim = encoder_size
+    # simple_gru's fc projection drops seq_len; without it
+    # sequence_last_step would read the last PADDED timestep and the
+    # attention softmax would attend to padding.
+    enc.seq_len = src.seq_len
     enc_last = L.sequence_last_step(enc)
     t_emb = l2.embedding(trg_in, word_vector_dim, vocab_size=trg_dict_dim)
     t_emb.seq_len = trg_in.seq_len
@@ -257,11 +273,14 @@ def gru_encoder_decoder(src, trg_in, src_dict_dim, trg_dict_dim,
     dec = L.dynamic_gru(t_proj, size=decoder_size, h0=h0)
     dec.seq_len = trg_in.seq_len
     if with_attention:
-        ctx = dot_product_attention(enc, attending_sequence=dec) \
-            if enc_dim == decoder_size else dot_product_attention(
-                L.fc(enc, size=decoder_size, num_flatten_dims=2,
-                     bias_attr=False), attending_sequence=dec,
-                attended_sequence=enc)
+        if enc_dim == decoder_size:
+            ctx = dot_product_attention(enc, attending_sequence=dec)
+        else:
+            keys = L.fc(enc, size=decoder_size, num_flatten_dims=2,
+                        bias_attr=False)
+            keys.seq_len = src.seq_len  # mask must survive the projection
+            ctx = dot_product_attention(keys, attending_sequence=dec,
+                                        attended_sequence=enc)
         both = L.concat([dec, ctx], axis=2)
     else:
         both = dec

@@ -110,8 +110,9 @@ class TestV2Networks:
             grid = L.reshape(grid, shape=[-1, 16, 16, 3])
             out = paddle.networks.simple_img_conv_pool(
                 grid, filter_size=3, num_filters=4, pool_size=2,
-                act=paddle.activation.Relu())
-        assert tuple(out.shape)[1:] == (8, 8, 4)
+                pool_stride=2, act=paddle.activation.Relu())
+        # reference defaults: conv_padding=0 (16 -> 14), pool 2/2 -> 7
+        assert tuple(out.shape)[1:] == (7, 7, 4)
 
     def test_activation_and_pooling_objects(self):
         assert paddle.activation.Relu().name == "relu"

@@ -214,10 +214,11 @@ def test_gru_encoder_decoder_trains():
     exe = pt.Executor(pt.TPUPlace())
     exe.run(startup, scope=scope)
     vals = []
-    for _ in range(12):
+    for _ in range(18):
         out, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
         vals.append(float(np.asarray(out)))
     assert np.isfinite(vals).all()
+    # steady descent (measured trajectory: 2.52 -> ~1.69 by step 18)
     assert vals[-1] < vals[0] * 0.8, vals
 
 
@@ -260,3 +261,25 @@ def test_small_vgg_builds_and_serves():
         1, 32 * 32 * 3).astype("float32")}, main, startup)
     assert out.shape == (1, 10)
     np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-3)
+
+
+def test_mixed_layer_default_has_no_bias():
+    """Reference mixed_layer is wrap_bias_attr_default(has_bias=False):
+    unset bias_attr must add NO parameter (layers.py:865)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = l2.data("x", pt.v2.data_type.dense_vector(6))
+        l2.mixed_layer(size=4, input=[l2.full_matrix_projection(x)])
+    names = [p.name for p in main.global_block.all_parameters()]
+    assert len(names) == 1, names  # just the projection weight
+
+
+def test_mixed_layer_context_form_honors_drop_rate():
+    """drop_rate applies in the with-form too (v1 ExtraAttr contract)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = l2.data("x", pt.v2.data_type.dense_vector(6))
+        with l2.mixed_layer(size=4, drop_rate=0.5) as m:
+            m += l2.full_matrix_projection(x)
+    types = [op.type for op in main.global_block.ops]
+    assert "dropout" in types, types
