@@ -291,12 +291,10 @@ class Executor:
         # scope (state inputs), so the set of scope keys is part of the key —
         # as are the global dtype policies (AMP / MXU precision) and the
         # mesh/plan, all of which change the traced computation.
-        from ..flags import FLAGS
-
         scope_keys = frozenset(self._all_scope_keys(scope))
         return (id(program), program.version, feed_sig, tuple(fetch_names),
                 id(scope), scope_keys, ops_common.amp_enabled(),
-                ops_common.mxu_precision(), FLAGS.fused_linear_grad,
+                ops_common.mxu_precision(),
                 id(self.mesh), id(self.plan))
 
     @staticmethod

@@ -174,12 +174,12 @@ define_bool("use_amp", False,
             "programs (TPU analogue of the float16 plane)")
 define_string("mxu_precision", "default",
               "MXU contraction precision: default | high | highest")
-define_bool("fused_linear_grad", False,
-            "use the fused Pallas dX+dW backward for linear/1x1-conv "
-            "layers on TPU (kernels/linear_grad.py). Default off: under "
-            "XLA's 16 MB scoped-vmem limit for custom calls the kernel "
-            "measured slower than XLA's separate gradient dots on both "
-            "ResNet and LM paths (PERF.md round 3)")
+define_bool("fused_conv_epilogue", False,
+            "lower NHWC 1x1/stride-1 conv+BN(+relu)(+residual) chains in "
+            "models as the fused conv1x1_bn_act op (Pallas forward that "
+            "computes BN stats in the conv pass and folds the epilogue "
+            "into the output tile; ops/fusion_ops.py). Default off until "
+            "the chip A/B lands (tools/chip_session_r5.py)")
 define_string("compilation_cache_dir", "",
               "persist XLA compilations here (jax persistent cache): "
               "repeat runs of the same program skip the 20-40s "

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import functools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,14 +38,12 @@ def mul(attrs, ins):
     x2 = _flatten2d(x, xd)
     y2 = y.reshape(int(np.prod(y.shape[:yd])), -1)
     x2, y2 = amp_cast(x2, y2)
-    # Fused-backward matmul: one Pallas pass computes dX and dW together
-    # (kernels/linear_grad.py), reading the activations/cotangent once —
-    # these contractions dominate backward HBM traffic in both ResNet
-    # (1x1 convs) and transformer (QKV/FFN/head) training. Forward is the
-    # same XLA dot either way.
-    from ..kernels.linear_grad import linear2d
-
-    res = linear2d(x2, y2, _precision(x2, y2))
+    # Plain XLA dot. A fused Pallas dX+dW backward was tried (round 3) and
+    # measured SLOWER than XLA's two gradient dots under the 16 MB
+    # scoped-vmem limit for custom calls — see PERF.md "fused linear
+    # backward: tombstone".
+    res = jax.lax.dot_general(x2, y2, (((1,), (0,)), ((), ())),
+                              precision=_precision(x2, y2))
     out_shape = x.shape[:xd] + y.shape[yd:]
     return out(Out=res.reshape(out_shape))
 

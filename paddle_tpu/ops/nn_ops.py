@@ -49,12 +49,11 @@ def conv2d(attrs, ins):
     if (k_hw == (1, 1) and tuple(strides) == (1, 1)
             and tuple(pads) == (0, 0) and groups == 1):
         if fmt == "NHWC":
-            from ..kernels.linear_grad import linear2d
-
             wm = w.reshape(w.shape[2], w.shape[3])  # HWIO -> [I, O]
             B, H, W_, I = x.shape
-            y = linear2d(x.reshape(B * H * W_, I), wm,
-                         common.mxu_precision())
+            y = jax.lax.dot_general(
+                x.reshape(B * H * W_, I), wm, (((1,), (0,)), ((), ())),
+                precision=common.mxu_precision())
             return out(Output=y.reshape(B, H, W_, -1).astype(x.dtype))
         wm = w.reshape(w.shape[0], w.shape[1])  # OIHW -> [O, I]
         y = jax.lax.dot_general(

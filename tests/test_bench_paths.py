@@ -115,7 +115,7 @@ def test_assemble_partial_rows_emit_nulls():
         "info": {"result": {"platform": "tpu", "device_kind": "TPU v5e",
                             "batch": 256, "image_size": 224}},
         "resnet": {"result": {"img_per_sec": 1000.0,
-                              "fused_linear_grad": False, "notes": None}},
+                              "notes": None}},
         "transformer_wide": {"result": [39100.0, 110e12]},
         "lstm": {"error": "dropped mid-run"},
     }
@@ -139,7 +139,7 @@ def test_assemble_cpu_smoke_schema():
         "info": {"result": {"platform": "cpu", "device_kind": "cpu",
                             "batch": 8, "image_size": 64}},
         "resnet": {"result": {"img_per_sec": 1.2,
-                              "fused_linear_grad": False, "notes": None}},
+                              "notes": None}},
     }
     out = b.assemble(rows)
     assert out["extra"]["mfu"] is None and out["value"] == 1.2

@@ -351,78 +351,59 @@ def int_label_pipeline():
 
 
 @check
-def fused_linear_backward_matches_xla():
-    """The Pallas fused dX+dW kernel (kernels/linear_grad.py) vs XLA's
-    separate gradient dots, bf16 operands, shapes covering every ResNet
-    1x1-conv stage plus a transformer FFN block."""
-    import jax
-    import jax.numpy as jnp
-    from paddle_tpu.kernels.linear_grad import linear_bwd
-
-    rng = np.random.RandomState(7)
-    errs = []
-    for (R, I, O) in [(1024, 256, 64), (12544, 2048, 512),
-                      (2048, 64, 256), (4096, 1024, 4096)]:
-        x = jnp.asarray(rng.randn(R, I), jnp.bfloat16)
-        w = jnp.asarray(rng.randn(I, O), jnp.bfloat16)
-        dy = jnp.asarray(rng.randn(R, O), jnp.bfloat16)
-        dx, dw = jax.jit(linear_bwd)(x, dy, w)
-        dxr = (dy.astype(jnp.float32)
-               @ w.astype(jnp.float32).T).astype(jnp.bfloat16)
-        dwr = (x.astype(jnp.float32).T
-               @ dy.astype(jnp.float32)).astype(jnp.bfloat16)
-        e1 = float(jnp.max(jnp.abs(dx.astype(jnp.float32)
-                                   - dxr.astype(jnp.float32))))
-        s1 = max(float(jnp.max(jnp.abs(dxr.astype(jnp.float32)))), 1.0)
-        e2 = float(jnp.max(jnp.abs(dw.astype(jnp.float32)
-                                   - dwr.astype(jnp.float32))))
-        s2 = max(float(jnp.max(jnp.abs(dwr.astype(jnp.float32)))), 1.0)
-        assert e1 < 2e-2 * s1, (R, I, O, "dx", e1, s1)
-        assert e2 < 2e-2 * s2, (R, I, O, "dw", e2, s2)
-        errs.append(f"{R}x{I}x{O}: {e1/s1:.1e}/{e2/s2:.1e}")
-    return "; ".join(errs)
-
-
-@check
-def fused_linear_backward_trains_through_mul():
-    """End-to-end: the mul op's custom vjp (fused backward) gives the same
-    training trajectory as the XLA-dot fallback (--fused_linear_grad=0)."""
+def conv_epilogue_matches_unfused():
+    """The fused conv1x1+BN+relu(+residual) Pallas path (compiled, real
+    chip — not interpret mode) vs the separate-op composition, at a
+    ResNet-stage shape, training and inference modes."""
     import paddle_tpu as pt
     from paddle_tpu import layers
 
-    def run(flag):
-        prior = pt.flags.FLAGS.fused_linear_grad
-        pt.flags.FLAGS.fused_linear_grad = flag
+    def run(fused, is_test):
+        pt.flags.FLAGS.fused_conv_epilogue = fused
         try:
             main, startup = pt.Program(), pt.Program()
             with pt.program_guard(main, startup):
-                x = layers.data("x", shape=[128])
-                y = layers.data("y", shape=[1], dtype="int64")
-                h = layers.fc(x, size=256, act="relu")
-                logits = layers.fc(h, size=8)
-                loss = layers.mean(
-                    layers.softmax_with_cross_entropy(logits, y))
-                pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
-                    loss, startup_program=startup)
-            main.random_seed = startup.random_seed = 3
-            scope = pt.Scope()
-            exe = pt.Executor(pt.TPUPlace())
-            exe.run(startup, scope=scope)
-            rng = np.random.RandomState(0)
-            xs = rng.rand(256, 128).astype(np.float32)
-            ys = rng.randint(0, 8, size=(256, 1)).astype(np.int64)
-            return [float(exe.run(main, feed={"x": xs, "y": ys},
-                                  fetch_list=[loss], scope=scope)[0])
-                    for _ in range(5)]
-        finally:
-            pt.flags.FLAGS.fused_linear_grad = prior
+                x = layers.data("x", shape=[14, 14, 256])
+                if fused:
+                    y = layers.conv1x1_bn_act(
+                        x, 512, act="relu", is_test=is_test,
+                        residual=layers.conv1x1_bn_act(
+                            x, 512, act=None, is_test=is_test))
+                else:
+                    def cbn(inp):
+                        c = layers.conv2d(inp, num_filters=512,
+                                          filter_size=1, bias_attr=False,
+                                          data_format="NHWC")
+                        return layers.batch_norm(c, act=None,
+                                                 is_test=is_test,
+                                                 data_layout="NHWC")
 
-    fused = run(True)
-    plain = run(False)
-    for a, b in zip(fused, plain):
-        assert abs(a - b) < 5e-3 * max(abs(b), 1.0), (fused, plain)
-    assert fused[-1] < fused[0]
-    return f"loss {fused[0]:.3f}->{fused[-1]:.3f}, matches fallback"
+                    r = cbn(x)
+                    y = layers.relu(layers.elementwise_add(cbn(x), r))
+                loss = layers.mean(y)
+                if not is_test:
+                    pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                        loss, startup_program=startup)
+            main.random_seed = startup.random_seed = 5
+            exe, scope = _executor_pair()
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(2)
+            feed = {"x": rng.randn(8, 14, 14, 256).astype(np.float32)}
+            return [float(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[loss],
+                        scope=scope)[0])) for _ in range(3)]
+        finally:
+            pt.flags.FLAGS.fused_conv_epilogue = False
+
+    msgs = []
+    for is_test in (False, True):
+        a = run(True, is_test)
+        b = run(False, is_test)
+        for f, p in zip(a, b):
+            assert abs(f - p) < 5e-3 * max(abs(p), 1.0), (is_test, a, b)
+        msgs.append(f"{'test' if is_test else 'train'}: "
+                    f"{a[0]:.5f}~{b[0]:.5f}")
+    return "; ".join(msgs)
 
 
 @check

@@ -233,27 +233,21 @@ def main():
 
     experiment("tpu_tier", run_tier, seconds=1500)
 
-    # 2. ResNet-50 bs256 A/B over the fused linear backward.
-    def resnet_step(fused, batch=256, steps=20):
-        pt.flags.FLAGS.fused_linear_grad = fused
+    # 2. ResNet-50 bs256. (The round-3 fused-linear-backward A/B is gone:
+    #    the kernel lost on chip and was removed in round 5.)
+    def resnet_step(batch=256, steps=20):
         return resnet50_bs256_step(jax, pt, layers, models, bench, peak,
-                                   batch=batch, steps=steps,
-                                   extra={"fused_linear_grad": fused})
+                                   batch=batch, steps=steps)
 
-    experiment("resnet50_bs256_fused_off", lambda: resnet_step(False))
-    experiment("resnet50_bs256_fused_on", lambda: resnet_step(True))
+    experiment("resnet50_bs256", resnet_step)
 
-    # 3. Transformer MFU grid: d_head via heads (d1024: H8 -> 128, H16 -> 64),
-    #    fused backward on/off. H8+fused is the headline candidate.
-    def lm(heads, fused):
-        pt.flags.FLAGS.fused_linear_grad = fused
+    # 3. Transformer MFU grid: d_head via heads (d1024: H8 -> 128, H16 -> 64).
+    def lm(heads):
         return transformer_lm_step(
-            jax, pt, layers, models, bench, peak, d=1024, H=heads,
-            extra={"fused_linear_grad": fused})
+            jax, pt, layers, models, bench, peak, d=1024, H=heads)
 
-    experiment("lm_h8_fused_on", lambda: lm(8, True))
-    experiment("lm_h8_fused_off", lambda: lm(8, False))
-    experiment("lm_h16_fused_on", lambda: lm(16, True))
+    experiment("lm_h8", lambda: lm(8))
+    experiment("lm_h16", lambda: lm(16))
 
     # 3b. Stacked scan-over-layers variant (pipeline_stack=True on one
     #     chip): same math, one compiled block body — measures the
@@ -263,7 +257,7 @@ def main():
         # fused off (loses under the 16 MB scoped-vmem limit) and remat on:
         # the scan-over-layers body otherwise saves [L, bs, T, d]-sized
         # activations per layer and OOMs HBM at these shapes.
-        pt.flags.FLAGS.fused_linear_grad = False
+        pass  # fused linear backward removed in round 5 (lost its chip A/B)
         bs, T, vocab, d, Lh = 8, 2048, 16384, 1024, 8
         main_prog, startup = pt.Program(), pt.Program()
         with pt.program_guard(main_prog, startup):
@@ -365,7 +359,7 @@ def main():
     experiment("lm_spec_decode", lm_spec_decode)
 
     # 4. Varlen LSTM (the reference RNN benchmark's ragged semantics).
-    pt.flags.FLAGS.fused_linear_grad = False
+    pass  # fused linear backward removed in round 5 (lost its chip A/B)
     experiment("lstm_varlen",
                lambda: bench.bench_lstm_varlen(jax, pt, layers))
     experiment("lstm_fixed",
@@ -382,7 +376,7 @@ def main():
     # 6. Per-op profile of the winning ResNet config.
     def profile_resnet():
         # the winning (unfused) config — the fused kernel lost the A/B
-        pt.flags.FLAGS.fused_linear_grad = False
+        pass  # fused linear backward removed in round 5 (lost its chip A/B)
         return resnet50_profile(pt, layers, models,
                                 "/tmp/chip_session_trace")
 

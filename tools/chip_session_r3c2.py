@@ -26,7 +26,7 @@ def main():
     cs._PT = pt
     peak = bench._peak_flops(jax.devices()[0].device_kind)
     pt.set_amp(True)
-    pt.flags.FLAGS.fused_linear_grad = False
+    pass  # fused linear backward removed in round 5 (lost its chip A/B)
 
     # On-chip correctness first: the custom norm backwards vs generic
     # vjp under bf16 (the new tier check, run standalone to keep this
