@@ -1,6 +1,7 @@
 """User-facing layer functions (fluid layers package parity)."""
 from .io import data
-from .nn import (accuracy, batch_norm, chunk_eval, conv2d, crf_decoding,
+from .nn import (accuracy, batch_norm, chunk_eval, conv1x1_bn_act,
+                 conv2d, crf_decoding,
                  cross_entropy, dropout, embedding, fc,
                  fused_head_cross_entropy, layer_norm,
                  linear_chain_crf, lrn, pool2d, rms_norm,
@@ -13,7 +14,8 @@ from .control_flow import (StaticRNN, While, array_read, array_write,
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
 from .ops import __all__ as _ops_all
 from .sequence import (ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
-                       gru_unit, lstm_unit, row_conv, sequence_concat,
+                       gru_unit, lstm_unit, row_conv, simple_rnn,
+                       sequence_concat,
                        sequence_conv, sequence_expand, sequence_first_step,
                        sequence_last_step, sequence_pool, sequence_reverse,
                        sequence_softmax, warpctc)
@@ -33,7 +35,7 @@ from .tensor import (argmax, assign, cast, concat, create_global_var,
 
 __all__ = (
     ["data", "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
-     "rms_norm", "dropout", "lrn", "cross_entropy",
+     "rms_norm", "dropout", "lrn", "cross_entropy", "conv1x1_bn_act",
      "fused_head_cross_entropy",
      "softmax_with_cross_entropy",
      "sigmoid_cross_entropy_with_logits",
@@ -47,7 +49,7 @@ __all__ = (
      "sequence_pool", "sequence_first_step", "sequence_last_step",
      "sequence_softmax", "sequence_expand", "sequence_reverse",
      "sequence_conv", "sequence_concat", "row_conv",
-     "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
+     "dynamic_lstm", "dynamic_gru", "simple_rnn", "lstm_unit", "gru_unit",
      "warpctc", "ctc_greedy_decoder",
      "StaticRNN", "While", "create_array", "array_write", "array_read",
      "increment", "beam_search_decoder",

@@ -17,20 +17,29 @@ from .layer_helper import LayerHelper
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
        act=None, name=None, main_program=None, startup_program=None):
     """Fully-connected layer (reference nn.py fc): mul per input + sum + bias
-    + activation. Multiple inputs each get their own weight."""
+    + activation. Multiple inputs each get their own weight.
+    ``num_flatten_dims`` may be a list (one value per input) — needed when
+    a sequence input and a plain 2-D input feed the same fc."""
     helper = LayerHelper("fc", main_program=main_program,
                          startup_program=startup_program)
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    nfds = (list(num_flatten_dims)
+            if isinstance(num_flatten_dims, (list, tuple))
+            else [num_flatten_dims] * len(inputs))
+    if len(nfds) != len(inputs):
+        raise ValueError(
+            f"fc: num_flatten_dims list has {len(nfds)} entries for "
+            f"{len(inputs)} inputs")
     mul_results = []
-    for inp in inputs:
+    for inp, nfd in zip(inputs, nfds):
         in_shape = inp.shape
-        fan_in = int(np.prod(in_shape[num_flatten_dims:]))
+        fan_in = int(np.prod(in_shape[nfd:]))
         w = helper.create_parameter(
             param_attr, shape=[fan_in, size], dtype=inp.dtype,
             default_initializer=XavierInitializer())
         mul_results.append(
             helper.simple_op("mul", {"X": [inp], "Y": [w]},
-                             {"x_num_col_dims": num_flatten_dims,
+                             {"x_num_col_dims": nfd,
                               "y_num_col_dims": 1}))
     if len(mul_results) == 1:
         pre_bias = mul_results[0]
@@ -40,7 +49,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_act = pre_bias
     else:
         pre_act = helper.append_bias_op(pre_bias, bias_attr, size,
-                                        dim_start=num_flatten_dims)
+                                        dim_start=nfds[0])
     return helper.append_activation(pre_act, act)
 
 
@@ -111,6 +120,38 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
          "data_format": data_format})
 
 
+def _bn_state(helper, channels, param_attr, bias_attr):
+    """Shared BN affine+running-stats setup (batch_norm and the fused
+    conv1x1_bn_act): scale/bias params, persistable .mean/.var state in
+    BOTH programs (init ops in startup, state in main — the '.mean'/
+    '.var' suffix is what the executor's state-threading keys on), and
+    the saved-stat tmp outputs. Returns (scale, bias, mean, variance,
+    saved_mean, saved_var)."""
+    dtype = "float32"  # stats/affine in f32 even under bf16 compute
+    scale = helper.create_parameter(
+        param_attr, shape=[channels], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(
+        bias_attr, shape=[channels], dtype=dtype, is_bias=True)
+    mean_name = scale.name + ".mean"
+    var_name = scale.name + ".var"
+    block = helper.main_program.global_block
+    mean = block.create_var(name=mean_name, shape=[channels], dtype=dtype,
+                            persistable=True, stop_gradient=True)
+    variance = block.create_var(name=var_name, shape=[channels], dtype=dtype,
+                                persistable=True, stop_gradient=True)
+    sb = helper.startup_program.global_block
+    for name, value in ((mean_name, 0.0), (var_name, 1.0)):
+        v = sb.create_var(name=name, shape=[channels], dtype=dtype,
+                          persistable=True)
+        ConstantInitializer(value)(v, sb)
+    saved_mean = helper.create_tmp_variable(dtype, shape=[channels],
+                                            stop_gradient=True)
+    saved_var = helper.create_tmp_variable(dtype, shape=[channels],
+                                           stop_gradient=True)
+    return scale, bias, mean, variance, saved_mean, saved_var
+
+
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                main_program=None, startup_program=None):
@@ -125,30 +166,9 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
         channels = input.shape[1]
     else:
         channels = input.shape[-1]
-    dtype = "float32"  # stats/affine in f32 even under bf16 compute
-    scale = helper.create_parameter(
-        param_attr, shape=[channels], dtype=dtype,
-        default_initializer=ConstantInitializer(1.0))
-    bias = helper.create_parameter(
-        bias_attr, shape=[channels], dtype=dtype, is_bias=True)
-    # Running stats live in BOTH programs: init ops in startup, state in main.
-    mean_name = scale.name + ".mean"
-    var_name = scale.name + ".var"
-    block = helper.main_program.global_block
-    mean = block.create_var(name=mean_name, shape=[channels], dtype=dtype,
-                            persistable=True, stop_gradient=True)
-    variance = block.create_var(name=var_name, shape=[channels], dtype=dtype,
-                                persistable=True, stop_gradient=True)
-    sb = helper.startup_program.global_block
-    for name, value in ((mean_name, 0.0), (var_name, 1.0)):
-        v = sb.create_var(name=name, shape=[channels], dtype=dtype,
-                          persistable=True)
-        ConstantInitializer(value)(v, sb)
+    scale, bias, mean, variance, saved_mean, saved_var = _bn_state(
+        helper, channels, param_attr, bias_attr)
     y = helper.create_tmp_variable(input.dtype, shape=input.shape)
-    saved_mean = helper.create_tmp_variable(dtype, shape=[channels],
-                                            stop_gradient=True)
-    saved_var = helper.create_tmp_variable(dtype, shape=[channels],
-                                           stop_gradient=True)
     helper.append_op(
         "batch_norm",
         {"X": [input], "Scale": [scale], "Bias": [bias],
@@ -159,6 +179,48 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
          "data_layout": data_layout},
     )
     return helper.append_activation(y, act)
+
+
+def conv1x1_bn_act(input, num_filters, residual=None, act=None,
+                   is_test=False, momentum=0.9, epsilon=1e-5,
+                   param_attr=None, bn_param_attr=None, bn_bias_attr=None,
+                   main_program=None, startup_program=None):
+    """Fused NHWC 1x1-conv + batch_norm + activation (+ residual add)
+    as one op (ops/fusion_ops.py): the epilogue-fusion form of the
+    conv2d->batch_norm->elementwise_add->relu chain that bounds the
+    ResNet roofline (PERF.md). Enabled from models via
+    --fused_conv_epilogue."""
+    if act not in (None, "", "relu"):
+        raise ValueError(
+            f"conv1x1_bn_act supports act None or 'relu' (the fused "
+            f"kernels implement exactly these), got {act!r}")
+    helper = LayerHelper("conv1x1_bn_act", main_program=main_program,
+                         startup_program=startup_program)
+    channels_in = int(input.shape[-1])
+    filt = helper.create_parameter(
+        param_attr, shape=[1, 1, channels_in, num_filters],
+        dtype=input.dtype,
+        default_initializer=NormalInitializer(  # match conv2d's init
+            0.0, (2.0 / channels_in) ** 0.5))
+    scale, bias, mean, variance, saved_mean, saved_var = _bn_state(
+        helper, num_filters, bn_param_attr, bn_bias_attr)
+    out_shape = list(input.shape[:-1]) + [num_filters]
+    y = helper.create_tmp_variable(input.dtype, shape=out_shape)
+    conv_out = helper.create_tmp_variable(
+        input.dtype, shape=[1, 1] if is_test else out_shape,
+        stop_gradient=True)
+    ins = {"X": [input], "Filter": [filt], "Scale": [scale],
+           "Bias": [bias], "Mean": [mean], "Variance": [variance]}
+    if residual is not None:
+        ins["Residual"] = [residual]
+    helper.append_op(
+        "conv1x1_bn_act", ins,
+        {"Y": [y], "MeanOut": [mean], "VarianceOut": [variance],
+         "SavedMean": [saved_mean], "SavedVariance": [saved_var],
+         "ConvOut": [conv_out]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "act": act or ""})
+    return y
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,

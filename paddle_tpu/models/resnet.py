@@ -25,8 +25,18 @@ from .. import layers
 _maybe_recompute = maybe_recompute
 
 
+def _fuse_epilogue(filter_size, stride, padding, data_format):
+    from ..flags import FLAGS
+
+    return (FLAGS.fused_conv_epilogue and filter_size == 1 and stride == 1
+            and padding == 0 and data_format == "NHWC")
+
+
 def _conv_bn(x, num_filters, filter_size, stride=1, padding=0, act="relu",
              data_format="NHWC", is_test=False):
+    if _fuse_epilogue(filter_size, stride, padding, data_format):
+        return layers.conv1x1_bn_act(x, num_filters, act=act,
+                                     is_test=is_test)
     conv = layers.conv2d(x, num_filters=num_filters, filter_size=filter_size,
                          stride=stride, padding=padding, bias_attr=False,
                          data_format=data_format)
@@ -51,6 +61,10 @@ def _bottleneck(x, ch_mid, stride, data_format, is_test, recompute=False):
                      is_test=is_test)
         y = _conv_bn(y, ch_mid, 3, stride, 1, data_format=data_format,
                      is_test=is_test)
+        if _fuse_epilogue(1, 1, 0, data_format):
+            # residual add + relu ride the final 1x1 conv's output tile
+            return layers.conv1x1_bn_act(y, ch_out, residual=short,
+                                         act="relu", is_test=is_test)
         y = _conv_bn(y, ch_out, 1, 1, 0, act=None, data_format=data_format,
                      is_test=is_test)
         added = layers.elementwise_add(y, short)

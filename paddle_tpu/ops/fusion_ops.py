@@ -1,0 +1,181 @@
+"""Fused epilogue ops: 1x1-conv + BN + activation (+ residual) as ONE op.
+
+TPU-first replacement for the reference's separate cudnn-conv + BN +
+eltwise kernel sequence (/root/reference/paddle/operators/
+conv_cudnn_op.cu.cc, batch_norm_op.cc, elementwise_add_op.cc): the
+ResNet roofline (PERF.md) is HBM-bound and the byte cut comes from not
+materializing intermediates between the conv dot and its epilogue. The
+forward runs the Pallas kernels in kernels/conv_epilogue.py; the
+backward is plain XLA (the fused-backward tombstone in PERF.md is why).
+
+Only the NHWC 1x1/stride-1/pad-0 form exists — exactly the layers the
+roofline names. The model layer (models/resnet.py _conv_bn) falls back
+to the separate conv2d/batch_norm/elementwise_add ops for every other
+shape, and when --fused_conv_epilogue is off (the default until the
+chip A/B lands).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from . import common
+from .common import maybe, out, single
+
+
+def _affine_from_stats(scale, bias, mean, var, eps):
+    """Fold (gamma, beta, mean, var) into the elementwise (k, b):
+    y = xhat*gamma + beta = x*k + b with k = gamma*rsqrt(var+eps)."""
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    k = scale.astype(jnp.float32) * inv
+    b = bias.astype(jnp.float32) - mean.astype(jnp.float32) * k
+    return k, b, inv
+
+
+def conv1x1_bn_act(attrs, ins):
+    """Fused y = act(BN(x @ W) [+ residual]) for NHWC 1x1 convs.
+
+    Training: one Pallas pass computes the conv output AND the BN batch
+    statistics; a second elementwise pass applies the folded affine,
+    residual and activation. ConvOut (the raw conv output) is a real
+    output so the backward reads it instead of recomputing the dot.
+    Inference: single pass, raw conv output never reaches HBM.
+    Output contract mirrors batch_norm (MeanOut/VarianceOut alias the
+    running stats; SavedMean/SavedVariance are batch mean / inv-std).
+    """
+    from ..kernels import conv_epilogue as ke
+
+    x = single(ins, "X")            # [B, H, W, I]
+    w = single(ins, "Filter")       # [1, 1, I, O] (HWIO) or [I, O]
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    mean = single(ins, "Mean")
+    var = single(ins, "Variance")
+    res = maybe(ins, "Residual")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    act = attrs.get("act") or None
+    is_test = attrs.get("is_test", False)
+    interpret = jax.default_backend() != "tpu"
+
+    B, H, W_, I = x.shape
+    wm = w.reshape(w.shape[-2], w.shape[-1])
+    O = wm.shape[-1]
+    x2, wm = common.amp_cast(x.reshape(B * H * W_, I), wm)
+    res2 = None if res is None else res.reshape(B * H * W_, O)
+    prec = common.mxu_precision()
+
+    if is_test:
+        k, b, inv = _affine_from_stats(scale, bias, mean, var, eps)
+        y2 = ke.conv1x1_epilogue(x2, wm, k, b, residual=res2, act=act,
+                                 precision=prec, interpret=interpret)
+        return out(Y=y2.reshape(B, H, W_, O).astype(x.dtype),
+                   MeanOut=mean, VarianceOut=var, SavedMean=mean,
+                   SavedVariance=jax.lax.rsqrt(
+                       var.astype(jnp.float32) + eps).astype(var.dtype),
+                   ConvOut=jnp.zeros((1, 1), x.dtype))
+
+    y_raw2, stats = ke.conv1x1_stats(x2, wm, precision=prec,
+                                     interpret=interpret)
+    n = x2.shape[0]
+    bmean = stats[0] / n
+    bvar = jnp.maximum(stats[1] / n - jnp.square(bmean), 0.0)
+    k, b, inv = _affine_from_stats(scale, bias, bmean, bvar, eps)
+    y2 = ke.scale_shift_act(y_raw2, k, b, residual=res2, act=act,
+                            interpret=interpret)
+    mean_out = momentum * mean.astype(jnp.float32) + (1 - momentum) * bmean
+    var_out = momentum * var.astype(jnp.float32) + (1 - momentum) * bvar
+    return out(Y=y2.reshape(B, H, W_, O).astype(x.dtype),
+               MeanOut=mean_out.astype(mean.dtype),
+               VarianceOut=var_out.astype(var.dtype),
+               SavedMean=bmean.astype(mean.dtype),
+               SavedVariance=inv.astype(var.dtype),
+               ConvOut=y_raw2.reshape(B, H, W_, O))
+
+
+def _conv1x1_bn_act_grad(attrs, ins, outs, ogs):
+    """XLA backward for the fused op: relu mask -> BN backward over the
+    saved raw conv output -> the two gradient dots (reference
+    mul_op.cc backward structure)."""
+    x = single(ins, "X")
+    w = single(ins, "Filter")
+    scale = single(ins, "Scale")
+    res = maybe(ins, "Residual")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    act = attrs.get("act") or None
+    is_test = attrs.get("is_test", False)
+
+    dy = ogs.get("Y", [None])[0]
+    gm = ogs.get("MeanOut", [None])[0]
+    gv = ogs.get("VarianceOut", [None])[0]
+    y = outs.get("Y", [None])[0]
+    if dy is None:
+        raise NotImplementedError("conv1x1_bn_act grad needs dY")
+
+    B, H, W_, I = x.shape
+    wm = w.reshape(w.shape[-2], w.shape[-1])
+    O = wm.shape[-1]
+    n = B * H * W_
+    x2 = x.reshape(n, I)
+    dy2 = dy.reshape(n, O).astype(jnp.float32)
+    if act == "relu":
+        dy2 = dy2 * (y.reshape(n, O) > 0)
+    dres = None if res is None else dy2.astype(res.dtype).reshape(res.shape)
+
+    sm = outs["SavedMean"][0].astype(jnp.float32)
+    inv = outs["SavedVariance"][0].astype(jnp.float32)
+    sc = scale.astype(jnp.float32)
+    prec = common.mxu_precision()
+    if is_test:
+        # the inference forward never materialized the raw conv output
+        # (that is its point) — recompute it for the scale/bias grads
+        x2c_, wmc_ = common.amp_cast(x2, wm)
+        y_raw2 = jax.lax.dot_general(
+            x2c_, wmc_, (((1,), (0,)), ((), ())), precision=prec,
+            preferred_element_type=jnp.float32)
+        dz = dy2 * (sc * inv)
+        xhat = (y_raw2 - sm) * inv
+        dscale = jnp.sum(dy2 * xhat, axis=0)
+        dbias = jnp.sum(dy2, axis=0)
+    else:
+        y_raw2 = outs["ConvOut"][0].reshape(n, O).astype(jnp.float32)
+        xhat = (y_raw2 - sm) * inv
+        dbias = jnp.sum(dy2, axis=0)
+        dscale = jnp.sum(dy2 * xhat, axis=0)
+        dz = (sc * inv) * (dy2 - (dbias + xhat * dscale) / n)
+        # running-stat update cotangents flow into y_raw through the
+        # batch statistics, and into the Mean/Variance state inputs
+        if gm is not None:
+            dz = dz + ((1.0 - momentum) / n) * gm.astype(jnp.float32)
+        if gv is not None:
+            dz = dz + ((1.0 - momentum) * 2.0 / n) \
+                * gv.astype(jnp.float32) * (y_raw2 - sm)
+    x2c, dzc = common.amp_cast(x2, dz.astype(x.dtype))
+    wmc = common.amp_cast(wm)
+    dx2 = jax.lax.dot_general(dzc, wmc, (((1,), (1,)), ((), ())),
+                              precision=prec)
+    dw2 = jax.lax.dot_general(x2c, dzc, (((0,), (0,)), ((), ())),
+                              precision=prec)
+    grads = {"X": [dx2.reshape(x.shape).astype(x.dtype)],
+             "Filter": [dw2.reshape(w.shape).astype(w.dtype)],
+             "Scale": [dscale.astype(scale.dtype)],
+             "Bias": [dbias.astype(scale.dtype)]}
+    if dres is not None:
+        grads["Residual"] = [dres]
+    if not is_test:
+        mean_in = single(ins, "Mean")
+        var_in = single(ins, "Variance")
+        if gm is not None:
+            grads["Mean"] = [(momentum * gm.astype(jnp.float32))
+                             .astype(mean_in.dtype)]
+        if gv is not None:
+            grads["Variance"] = [(momentum * gv.astype(jnp.float32))
+                                 .astype(var_in.dtype)]
+    return grads
+
+
+register_op("conv1x1_bn_act", conv1x1_bn_act,
+            grad_fn=_conv1x1_bn_act_grad,
+            optional_inputs=("Residual",))

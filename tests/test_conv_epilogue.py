@@ -1,0 +1,171 @@
+"""Fused conv1x1+BN+act(+residual) epilogue (ops/fusion_ops.py,
+kernels/conv_epilogue.py): numerical parity against the separate
+conv2d -> batch_norm -> elementwise_add -> relu ops, forward AND through
+training steps (the backward is the hand-written XLA chain), plus the
+kernel-level pallas interpret-mode checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _train_net(fused, is_test=False, residual=True, steps=3, lr=0.1):
+    pt.flags.FLAGS.fused_conv_epilogue = fused
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 4, 6])
+        if fused:
+            y = layers.conv1x1_bn_act(
+                x, 8, act="relu", is_test=is_test,
+                residual=layers.conv1x1_bn_act(x, 8, act=None,
+                                               is_test=is_test)
+                if residual else None)
+        else:
+            def cbn(inp, act):
+                c = layers.conv2d(inp, num_filters=8, filter_size=1,
+                                  bias_attr=False, data_format="NHWC")
+                return layers.batch_norm(c, act=act, is_test=is_test,
+                                         data_layout="NHWC")
+
+            # residual branch FIRST: parameter creation order must match
+            # the fused build (kwargs evaluate before the call) so the
+            # same startup seed draws identical inits
+            r = cbn(x, None) if residual else None
+            y = cbn(x, None)
+            if residual:
+                y = layers.elementwise_add(y, r)
+            y = layers.relu(y)
+        loss = layers.mean(y * y)
+        if not is_test:
+            pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(
+                loss, startup_program=startup)
+    main.random_seed = startup.random_seed = 7
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(2, 4, 4, 6).astype("float32")}
+    vals = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                     scope=scope)[0]))
+            for _ in range(steps)]
+    return vals, scope
+
+
+def test_fused_forward_matches_unfused_training_mode():
+    """Same seeds -> identical parameter init; the fused op must produce
+    the same loss trajectory (fwd + bwd + BN running-stat updates) as
+    the separate ops."""
+    try:
+        fused, s1 = _train_net(fused=True)
+    finally:
+        pt.flags.FLAGS.fused_conv_epilogue = False
+    plain, s2 = _train_net(fused=False)
+    assert np.isfinite(fused).all() and np.isfinite(plain).all()
+    np.testing.assert_allclose(fused, plain, rtol=2e-4, atol=2e-5)
+    assert fused[-1] < fused[0]  # it trains
+
+
+def test_fused_inference_mode_matches():
+    try:
+        fused, _ = _train_net(fused=True, is_test=True, steps=1)
+    finally:
+        pt.flags.FLAGS.fused_conv_epilogue = False
+    plain, _ = _train_net(fused=False, is_test=True, steps=1)
+    np.testing.assert_allclose(fused, plain, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_without_residual_matches():
+    try:
+        fused, _ = _train_net(fused=True, residual=False)
+    finally:
+        pt.flags.FLAGS.fused_conv_epilogue = False
+    plain, _ = _train_net(fused=False, residual=False)
+    np.testing.assert_allclose(fused, plain, rtol=2e-4, atol=2e-5)
+
+
+def test_resnet_block_under_flag_trains():
+    """A bottleneck stack builds with the fused ops and its loss
+    decreases; the program actually contains conv1x1_bn_act ops."""
+    from paddle_tpu import models
+
+    pt.flags.FLAGS.fused_conv_epilogue = True
+    try:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[8, 8, 3])
+            lbl = layers.data("lbl", shape=[1], dtype="int64")
+            logits = models.resnet_imagenet(img, num_classes=5, depth=50)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lbl))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.05, momentum=0.9).minimize(
+                loss, startup_program=startup)
+        types = {op.type for op in main.global_block.ops}
+        assert "conv1x1_bn_act" in types
+    finally:
+        pt.flags.FLAGS.fused_conv_epilogue = False
+    main.random_seed = startup.random_seed = 11
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.rand(4, 8, 8, 3).astype("float32"),
+            "lbl": rng.randint(0, 5, size=(4, 1)).astype("int64")}
+    vals = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                     scope=scope)[0]))
+            for _ in range(6)]
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], vals
+
+
+def test_kernels_interpret_mode_parity():
+    """kernels/conv_epilogue.py pallas paths (interpret mode) vs jnp."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import conv_epilogue as ke
+
+    rng = np.random.RandomState(3)
+    R, I, O = 512, 128, 128  # tiles at block_r >= 128
+    x = jnp.asarray(rng.randn(R, I).astype(np.float32))
+    w = jnp.asarray(rng.randn(I, O).astype(np.float32) * 0.1)
+    res = jnp.asarray(rng.randn(R, O).astype(np.float32))
+    scale = jnp.asarray(rng.rand(O).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(O).astype(np.float32))
+
+    y_ref = x @ w
+    y_raw, stats = ke.conv1x1_stats(x, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(y_raw), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(stats[0]),
+                               np.asarray(y_ref.sum(0)), rtol=1e-4,
+                               atol=1e-2)
+    np.testing.assert_allclose(np.asarray(stats[1]),
+                               np.asarray((y_ref * y_ref).sum(0)),
+                               rtol=1e-4, atol=1e-1)
+
+    full = ke.conv1x1_epilogue(x, w, scale, shift, residual=res,
+                               act="relu", interpret=True)
+    want = np.maximum(np.asarray(y_ref) * np.asarray(scale)
+                      + np.asarray(shift) + np.asarray(res), 0.0)
+    np.testing.assert_allclose(np.asarray(full), want, rtol=1e-5,
+                               atol=1e-4)
+
+    app = ke.scale_shift_act(y_raw, scale, shift, residual=res,
+                             act="relu", interpret=True)
+    np.testing.assert_allclose(np.asarray(app), want, rtol=1e-5,
+                               atol=1e-4)
+
+
+def test_kernel_fallback_on_untileable_shapes():
+    """R not a multiple of 128 -> the XLA fallback path, same numbers."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import conv_epilogue as ke
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(100, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(32, 16).astype(np.float32))
+    y_raw, stats = ke.conv1x1_stats(x, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(y_raw), np.asarray(x @ w),
+                               rtol=1e-5, atol=1e-4)

@@ -1,0 +1,125 @@
+"""Round-5 chip session: the measurement queue behind the tunnel watcher.
+
+Agenda (VERDICT r4 tasks 2/4/5/7):
+1. ResNet-50 bs256 A/B over the NEW fused conv epilogue
+   (--fused_conv_epilogue, ops/fusion_ops.py) — train and also the
+   bf16 inference row where the fusion never materializes the raw conv
+   output. The target from PERF.md's roofline: >= 36% MFU at bs256.
+2. The carried ResNet custom-BN-backward row (the r3c A/B tail the
+   tunnel drop cost — custom norm backwards are default now, so this is
+   simply the fresh baseline the epilogue A/B compares against).
+3. Stacked-scan selective-remat A/B (kernels in layers/attention.py,
+   --scan_remat_policy): all-or-nothing remat vs save-dots at d1024.
+4. Self-speculative decode A/B vs plain KV decode (models/gpt_modern)
+   on a briefly-trained model at temp 0.
+5. Headline MFU re-confirmation for BENCH_r05: d2048 H16 wide config
+   (55.9% in r3) and d1024 H8.
+
+Each experiment journals one line to CHIP_SESSION_r5.jsonl as it
+finishes; a tunnel drop never costs completed rows.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import chip_session as cs  # noqa: E402
+
+cs.OUT = os.path.join(REPO, "CHIP_SESSION_r5.jsonl")
+
+
+def main():
+    jax = cs.probe_tpu("r5: conv epilogue + remat + spec decode")
+    if jax is None:
+        return 1
+
+    import bench
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    cs._PT = pt
+    peak = bench._peak_flops(jax.devices()[0].device_kind)
+    pt.set_amp(True)
+
+    # 0. On-chip correctness of the new kernels before measuring them.
+    def tier(check_name):
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        import tpu_tier
+
+        return {"detail": getattr(tpu_tier, check_name)()}
+
+    cs.experiment("tier_conv_epilogue_parity",
+                  lambda: tier("conv_epilogue_matches_unfused"),
+                  seconds=600)
+
+    # 1. ResNet-50 bs256 conv-epilogue A/B (flag flips the BUILD).
+    def resnet(fused):
+        pt.flags.FLAGS.fused_conv_epilogue = fused
+        try:
+            return cs.resnet50_bs256_step(
+                jax, pt, layers, models, bench, peak,
+                extra={"fused_conv_epilogue": fused})
+        finally:
+            pt.flags.FLAGS.fused_conv_epilogue = False
+
+    base = cs.experiment("resnet50_bs256_epilogue_off",
+                         lambda: resnet(False), seconds=900)
+    cs.experiment("resnet50_bs256_epilogue_on",
+                  lambda: resnet(True), seconds=900)
+
+    # 1b. bf16 inference row A/B (the single-pass fusion path).
+    def infer(fused):
+        pt.flags.FLAGS.fused_conv_epilogue = fused
+        try:
+            import numpy as np
+
+            main_prog, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main_prog, startup):
+                images = layers.data("images", shape=[224, 224, 3])
+                logits = models.resnet_imagenet(images, num_classes=1000,
+                                                depth=50, is_test=True)
+            scope = pt.Scope()
+            exe = pt.Executor(pt.TPUPlace())
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(0)
+            feed = {"images": rng.rand(16, 224, 224, 3)
+                    .astype("float32")}
+            import time
+
+            for _ in range(3):
+                exe.run(main_prog, feed=feed, fetch_list=[logits],
+                        scope=scope)
+            t0 = time.perf_counter()
+            for _ in range(30):
+                o, = exe.run(main_prog, feed=feed, fetch_list=[logits],
+                             scope=scope, return_numpy=False)
+            np.asarray(o)
+            sec = (time.perf_counter() - t0) / 30
+            return {"img_per_sec": round(16 / sec, 1),
+                    "fused_conv_epilogue": fused}
+        finally:
+            pt.flags.FLAGS.fused_conv_epilogue = False
+
+    cs.experiment("resnet50_infer_bs16_epilogue_off",
+                  lambda: infer(False), seconds=600)
+    cs.experiment("resnet50_infer_bs16_epilogue_on",
+                  lambda: infer(True), seconds=600)
+
+    # 5. Headline MFU rows for BENCH_r05.
+    cs.experiment(
+        "lm_wide_d2048_h16",
+        lambda: cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                       peak, bs=8, d=2048, H=16),
+        seconds=700)
+    cs.experiment(
+        "lm_d1024_h8",
+        lambda: cs.transformer_lm_step(jax, pt, layers, models, bench,
+                                       peak),
+        seconds=700)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 tunnel watcher: probe the TPU tunnel; when it answers, run every
+# command queued in tools/chip_queue_r5.txt (one shell command per line,
+# '#' comments skipped), then a full bench.py refresh (sidecar-durable).
+# Re-runs the queue from the top whenever it gains NEW lines after a pass.
+# Journal: /tmp/tunnel_watch_r5.log
+cd /root/repo
+PY="${PYTHON:-/opt/venv/bin/python}"
+QUEUE=tools/chip_queue_r5.txt
+DONE_MARK=/tmp/chip_queue_r5.done   # lines already executed
+touch "$DONE_MARK"
+{
+  echo "tunnel_watch_r5 start $(date -u +%FT%TZ)"
+  for i in $(seq 1 320); do
+    if timeout -k 5 120 "$PY" -c "import jax; d=jax.devices()[0]; import sys; sys.exit(0 if d.platform!='cpu' else 1)" 2>/dev/null; then
+      echo "tunnel up at $(date -u +%FT%TZ) (probe $i)"
+      ran_any=0
+      while IFS= read -r line; do
+        case "$line" in ''|'#'*) continue;; esac
+        if grep -qxF -- "$line" "$DONE_MARK"; then continue; fi
+        echo ">>> $line"
+        timeout 4000 bash -c "$line" < /dev/null
+        echo "<<< rc=$? $(date -u +%FT%TZ)"
+        echo "$line" >> "$DONE_MARK"
+        ran_any=1
+      done < "$QUEUE"
+      if [ "$ran_any" = 1 ]; then
+        echo "queue pass done — bench refresh"
+        timeout 5600 "$PY" bench.py > /tmp/bench_refresh_r5.json 2>/tmp/bench_refresh_r5.err
+        echo "bench rc=$? at $(date -u +%FT%TZ)"
+      fi
+      sleep 120
+    else
+      sleep 130
+    fi
+  done
+  echo "watcher window over $(date -u +%FT%TZ)"
+} >> /tmp/tunnel_watch_r5.log 2>&1
