@@ -196,6 +196,33 @@ def dynamic_gru(input, size, is_reverse=False, gate_activation="sigmoid",
     return h_seq
 
 
+def simple_rnn(input, size=None, is_reverse=False, activation="tanh",
+               param_attr=None, bias_attr=None, h0=None,
+               main_program=None, startup_program=None):
+    """Plain RNN over a sequence already at hidden width (the v1
+    ``recurrent_layer``, reference gserver/layers/RecurrentLayer.cpp):
+    out_t = act(in_t + out_{t-1} @ W + b). ``input`` is [b, T, h]."""
+    helper = LayerHelper("simple_rnn", main_program=main_program,
+                         startup_program=startup_program)
+    hdim = int(size or input.shape[-1])
+    w = helper.create_parameter(
+        param_attr, shape=[hdim, hdim], dtype=input.dtype,
+        default_initializer=XavierInitializer())
+    bias = None if bias_attr is False else helper.create_parameter(
+        bias_attr, shape=[1, hdim], dtype=input.dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], **_len_input(input)}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if h0 is not None:
+        ins["H0"] = [h0]
+    outs, _ = helper.append_op(
+        "simple_rnn", ins, ["Hidden", "LastH"],
+        {"is_reverse": is_reverse, "activation": activation})
+    h_seq = outs["Hidden"][0]
+    h_seq.seq_len = get_seq_len(input)
+    return h_seq
+
+
 def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
               param_attr=None, bias_attr=None, main_program=None,
               startup_program=None):

@@ -175,6 +175,50 @@ def gru(attrs, ins):
     return out(Hidden=jnp.swapaxes(ys, 0, 1), LastH=h)
 
 
+@register_op("simple_rnn", optional_inputs=("Bias", "H0", "Length"))
+def simple_rnn(attrs, ins):
+    """Plain recurrent layer (reference gserver RecurrentLayer.cpp, the v1
+    ``recurrent_layer``): out_t = act(in_t + out_{t-1} @ W + b). ``Input``
+    is [b, T, h] ALREADY at hidden width (the v1 contract: the projection
+    into the layer happens outside, e.g. via mixed_layer); only the h@W
+    recurrence is sequential."""
+    x = single(ins, "Input")  # [b, T, h]
+    w = single(ins, "Weight")  # [h, h]
+    bias = maybe(ins, "Bias")
+    lengths = maybe(ins, "Length")
+    h0 = maybe(ins, "H0")
+    b, T, hdim = x.shape
+    reverse = attrs.get("is_reverse", False)
+    act = _ACT[attrs.get("activation", "tanh")]
+
+    h = h0 if h0 is not None else jnp.zeros((b, hdim), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)  # [T, b, h]
+    if bias is not None:
+        xs = xs + bias
+    mask = (jnp.swapaxes(time_mask(lengths, T, x.dtype), 0, 1)[..., None]
+            if lengths is not None else None)
+    prec = common.mxu_precision()
+    xs, w_cast = common.amp_cast(xs, w)
+
+    def step(h, inp):
+        if mask is None:
+            xt, m = inp, None
+        else:
+            xt, m = inp
+        h_new = act(xt + jnp.dot(common.amp_cast(h), w_cast,
+                                 precision=prec).astype(h.dtype))
+        if m is not None:
+            h_new = m * h_new + (1 - m) * h
+            y = h_new * m
+        else:
+            y = h_new
+        return h_new, y
+
+    seq = xs if mask is None else (xs, mask)
+    h, ys = jax.lax.scan(step, h, seq, reverse=reverse)
+    return out(Hidden=jnp.swapaxes(ys, 0, 1), LastH=h)
+
+
 @register_op("lstm_unit", optional_inputs=("Bias",))
 def lstm_unit(attrs, ins):
     """Single LSTM step (lstm_unit_op.cc): gates already projected, [b, 4h]."""

@@ -314,7 +314,11 @@ def _pa(attr):
 
 
 class ExtraLayerAttribute:
-    """Accepted for source compatibility (drop_rate is honored)."""
+    """v1 ExtraLayerAttribute (reference attrs.py): ``drop_rate`` is
+    honored (the wrapper applies dropout to the layer output, the role
+    LayerConfig.drop_rate plays in the reference); ``device`` and
+    ``error_clipping_threshold`` are accepted no-ops (there is no
+    per-layer device placement under one compiled XLA program)."""
 
     def __init__(self, error_clipping_threshold=None, drop_rate=None,
                  device=None):
@@ -322,6 +326,19 @@ class ExtraLayerAttribute:
 
 
 ExtraAttr = ExtraLayerAttribute
+
+
+def _maybe_drop(var, kw):
+    """Apply layer_attr=ExtraAttr(drop_rate=...) to a layer output."""
+    rate = getattr(kw.get("layer_attr"), "drop_rate", None)
+    if rate:
+        var = v2l.dropout_keep_len(var, rate)
+    return var
+
+
+def default_device(device=0):
+    """Accepted no-op: per-layer device placement does not exist under a
+    single compiled XLA program (sharding is the plan's job)."""
 
 
 # ---------------------------------------------------------------------------
@@ -399,10 +416,13 @@ def fc_layer(input, size, act=None, param_attr=None, bias_attr=None, **kw):
     inputs_ = input if isinstance(input, (list, tuple)) else [input]
     sparse_seq = [v for v in inputs_ if getattr(v, "sparse_seq", False)]
     rest = [v for v in inputs_ if not getattr(v, "sparse_seq", False)]
+    if isinstance(bias_attr, ParamAttr):
+        bias_attr = bias_attr.to_fluid()
     if not sparse_seq:
-        return v2l.fc(input if isinstance(input, (list, tuple)) and
-                      len(inputs_) > 1 else inputs_[0], size, act=act,
-                      param_attr=_pa(param_attr), bias_attr=bias_attr)
+        return _maybe_drop(
+            v2l.fc(input if isinstance(input, (list, tuple)) and
+                   len(inputs_) > 1 else inputs_[0], size, act=act,
+                   param_attr=_pa(param_attr), bias_attr=bias_attr), kw)
     from ..layers.layer_helper import LayerHelper
 
     branches = [_sparse_seq_fc_branch(v, size, param_attr)
@@ -425,7 +445,78 @@ def fc_layer(input, size, act=None, param_attr=None, bias_attr=None, **kw):
 
 
 def embedding_layer(input, size, param_attr=None, **kw):
-    return v2l.embedding(input, size, param_attr=_pa(param_attr))
+    return _maybe_drop(v2l.embedding(input, size, param_attr=_pa(param_attr)),
+                       kw)
+
+
+# -- mixed_layer + projections (reference layers.py mixed_layer et al.) ----
+# The builders live in the v2 facade; these shims translate v1 ParamAttr
+# objects at the boundary so reference configs pass them unchanged.
+
+def full_matrix_projection(input, size=0, param_attr=None, **kw):
+    return v2l.full_matrix_projection(input, size=size,
+                                      param_attr=_pa(param_attr))
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None, **kw):
+    return v2l.trans_full_matrix_projection(input, size=size,
+                                            param_attr=_pa(param_attr))
+
+
+def table_projection(input, size=0, param_attr=None, **kw):
+    return v2l.table_projection(input, size=size, param_attr=_pa(param_attr))
+
+
+def identity_projection(input, offset=None, size=None, **kw):
+    return v2l.identity_projection(input, offset=offset, size=size)
+
+
+def scaling_projection(input, param_attr=None, **kw):
+    return v2l.scaling_projection(input, param_attr=_pa(param_attr))
+
+
+def dotmul_projection(input, param_attr=None, **kw):
+    return v2l.dotmul_projection(input, param_attr=_pa(param_attr))
+
+
+def context_projection(input, context_len, context_start=None, **kw):
+    return v2l.context_projection(input, context_len,
+                                  context_start=context_start)
+
+
+def mixed_layer(size=0, input=None, act=None, bias_attr=None, **kw):
+    """v1 mixed_layer: immediate form (input=[projections]) or context
+    manager collecting ``+=`` projections. Reference defaults: NO bias
+    unless bias_attr is set (wrap_bias_attr_default(has_bias=False),
+    layers.py:865); layer_attr=ExtraAttr(drop_rate=...) applies dropout
+    in both forms."""
+    if isinstance(bias_attr, ParamAttr):
+        bias_attr = bias_attr.to_fluid()
+    elif bias_attr is True:
+        bias_attr = None  # default bias
+    elif bias_attr is None:
+        bias_attr = False  # reference default: no bias
+    rate = getattr(kw.get("layer_attr"), "drop_rate", None) or 0.0
+    return v2l.mixed_layer(size=size, input=input, act=act,
+                           bias_attr=bias_attr, drop_rate=rate)
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    reverse=False, **kw):
+    """v1 recurrent_layer (reference layers.py recurrent_layer ->
+    gserver RecurrentLayer.cpp): out_t = act(in_t + out_{t-1} @ W + b);
+    the input is already at the layer's width."""
+    # act unset -> tanh (reference wrap_act_default); an EXPLICIT
+    # LinearActivation (whose resolved name is empty) means the identity
+    # recurrence, not the default.
+    act_name = "tanh" if act is None else (_act.resolve(act) or "identity")
+    if isinstance(bias_attr, ParamAttr):
+        bias_attr = bias_attr.to_fluid()
+    elif bias_attr is True:
+        bias_attr = None  # default bias
+    o = L.simple_rnn(input, is_reverse=reverse, activation=act_name,
+                     param_attr=_pa(param_attr), bias_attr=bias_attr)
+    return _maybe_drop(o, kw)
 
 
 def img_conv_layer(input, filter_size, num_filters, num_channels=None,
@@ -470,11 +561,11 @@ def maxid_layer(input, **kw):
 
 
 def lstmemory(input, size=None, reverse=False, act=None, **kw):
-    return v2l.lstmemory(input, size=size, reverse=reverse)
+    return _maybe_drop(v2l.lstmemory(input, size=size, reverse=reverse), kw)
 
 
 def grumemory(input, size=None, reverse=False, **kw):
-    return v2l.grumemory(input, size=size, reverse=reverse)
+    return _maybe_drop(v2l.grumemory(input, size=size, reverse=reverse), kw)
 
 
 def first_seq(input, **kw):
@@ -554,12 +645,21 @@ def img_conv_group(input, conv_num_filter, num_channels=None, pool_size=2,
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
-                         pool_stride, act=None, num_channel=None, **kw):
+                         pool_stride=1, act=None, num_channel=None,
+                         pool_type=None, groups=1, conv_stride=1,
+                         conv_padding=0, bias_attr=None, param_attr=None,
+                         pool_padding=0, **kw):
+    """conv -> pool with the REFERENCE defaults (networks.py:144
+    simple_img_conv_pool: conv_padding=0, conv_stride=1, pool_padding=0)
+    so unmodified v1 configs get the reference's output geometry and
+    parameter shapes."""
     tmp = img_conv_layer(input, filter_size, num_filters,
-                         num_channels=num_channel, padding=(filter_size - 1)
-                         // 2, act=act)
+                         num_channels=num_channel, stride=conv_stride,
+                         padding=conv_padding, groups=groups, act=act,
+                         param_attr=param_attr, bias_attr=bias_attr)
     return v2l.img_pool(tmp, pool_size, stride=pool_stride,
-                        pool_type=MaxPooling())
+                        padding=pool_padding,
+                        pool_type=pool_type or MaxPooling())
 
 
 # ---------------------------------------------------------------------------

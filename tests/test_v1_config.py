@@ -252,6 +252,70 @@ def test_reference_linear_crf_parses_and_trains(monkeypatch, tmp_path):
     assert {"sum", "chunk"} <= kinds
 
 
+@needs_ref
+def test_reference_rnn_crf_parses_and_trains(monkeypatch, tmp_path):
+    """sequence_tagging/rnn_crf.py AS-IS (mixed_layer + table_projection +
+    recurrent_layer + CRF), with the py3 stand-in provider: parse, then a
+    provider-driven training pass."""
+    (tmp_path / "dataprovider.py").write_text(CRF_STANDIN_PROVIDER)
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "train.list").write_text("data/train-0\n")
+    (data / "test.list").write_text("data/train-0\n")
+    (data / "train-0").write_text("")
+    monkeypatch.chdir(tmp_path)
+    import importlib.util
+
+    v1.parse_config.__globals__["_install_shims"]()
+    spec = importlib.util.spec_from_file_location(
+        "dataprovider", tmp_path / "dataprovider.py")
+    standin = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(standin)
+    monkeypatch.setitem(sys.modules, "dataprovider", standin)
+    conf = f"{REF}/sequence_tagging/rnn_crf.py"
+    parsed, scope, costs = v1.train_from_config(conf, num_passes=1)
+    assert [v.name for v in parsed.input_vars] == ["word", "pos", "chunk",
+                                                   "features"]
+    assert np.isfinite(costs).all() and costs[0] > 0
+    kinds = {e["kind"] for e in parsed.evaluators}
+    assert {"sum", "chunk"} <= kinds
+    # the recurrent weights exist and trained (W is [128, 128])
+    rnn_params = [k for k in scope.keys() if "simple_rnn" in k]
+    assert rnn_params, sorted(scope.keys())
+
+
+@needs_ref
+def test_reference_db_lstm_trains_end_to_end(monkeypatch, tmp_path):
+    """quick_start/trainer_config.db-lstm.py AS-IS (mixed_layer +
+    full_matrix_projection + 8 stacked lstmemory with ExtraAttr
+    drop_rate), trained end-to-end through the real dataprovider_emb
+    module on synthetic review files."""
+    words = ["good", "bad", "fine", "awful", "great", "poor", "nice",
+             "sad", "happy", "meh"]
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "dict.txt").write_text(
+        "".join(f"{w}\t{i}\n" for i, w in enumerate(words)))
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(16):
+        lbl = int(rng.randint(2))
+        pick = (["good", "great", "nice", "happy"] if lbl else
+                ["bad", "awful", "poor", "sad"])
+        toks = [pick[rng.randint(4)] for _ in range(5)]
+        lines.append(f"{lbl}\t{' '.join(toks)}")
+    (data / "train.data").write_text("\n".join(lines) + "\n")
+    (data / "train.list").write_text("data/train.data\n")
+    (data / "test.list").write_text("data/train.data\n")
+    monkeypatch.chdir(tmp_path)
+    sys.modules.pop("dataprovider_emb", None)
+    conf = f"{REF}/quick_start/trainer_config.db-lstm.py"
+    parsed, scope, costs = v1.train_from_config(conf, num_passes=2)
+    assert [v.name for v in parsed.input_vars] == ["word", "label"]
+    assert parsed.input_vars[0].input_type.seq_type == 1
+    assert np.isfinite(costs).all() and costs[0] > 0
+
+
 def test_pool2d_ceil_mode_output_sizes():
     """ceil_mode reproduces config_parser.py cnn_output_size
     (caffe_mode=False): 5/2/s2 -> 3 (floor: 2), 1/2/s2 -> 1 (floor: 0)."""
