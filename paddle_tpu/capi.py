@@ -136,6 +136,21 @@ class InferenceMachine:
         for cur in range(p, p + max_new_tokens):
             row = self.run({name: ids})[fetch_index][:, cur - 1, :]
             if temperature > 0:
+                # Sampling treats the fetched row as PROBABILITIES (the
+                # docstring contract). Negative entries mean the fetch is
+                # logits — log() would silently invert their ranking, so
+                # fail loudly; NaN/Inf means a broken model.
+                if not np.isfinite(row).all():
+                    raise ValueError(
+                        "generate(temperature>0): model output contains "
+                        "NaN/Inf — cannot sample from it")
+                if (row < 0).any():
+                    raise ValueError(
+                        "generate(temperature>0): model output has "
+                        "negative entries — sampling needs softmax "
+                        "probabilities, not logits (fetch the softmax "
+                        "output, or use temperature=0 greedy decode "
+                        "which accepts logits)")
                 z = np.log(np.maximum(row.astype(np.float64), 1e-30))
                 z /= temperature
                 if top_k:

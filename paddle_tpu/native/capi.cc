@@ -405,6 +405,19 @@ bool k_mul_quant(Machine& m, const OpDesc& op, const QTensor& q) {
   Tensor* x;
   if (!need(m, op, "X", &x)) return false;
   int xd = static_cast<int>(op.attr_num("x_num_col_dims", 1));
+  // The int8 path stores Y as a 2-D [rows, cols] QTensor; a model asking
+  // to re-flatten Y (y_num_col_dims != 1) cannot be served from it.
+  int yd = static_cast<int>(op.attr_num("y_num_col_dims", 1));
+  if (yd != 1) {
+    m.error = "mul(int8): y_num_col_dims=" + std::to_string(yd) +
+              " unsupported for quantized weights (expected 1)";
+    return false;
+  }
+  if (xd <= 0 || xd >= static_cast<int>(x->shape.size())) {
+    m.error = "mul(int8): x_num_col_dims=" + std::to_string(xd) +
+              " out of range for rank " + std::to_string(x->shape.size());
+    return false;
+  }
   int64_t M = 1, K = 1;
   for (int i = 0; i < xd; ++i) M *= x->shape[static_cast<size_t>(i)];
   for (size_t i = static_cast<size_t>(xd); i < x->shape.size(); ++i)
@@ -446,6 +459,14 @@ bool k_mul(Machine& m, const OpDesc& op) {
   if (!need(m, op, "X", &x) || !need(m, op, "Y", &y)) return false;
   int xd = static_cast<int>(op.attr_num("x_num_col_dims", 1));
   int yd = static_cast<int>(op.attr_num("y_num_col_dims", 1));
+  if (xd <= 0 || xd >= static_cast<int>(x->shape.size()) ||
+      yd <= 0 || yd >= static_cast<int>(y->shape.size())) {
+    m.error = "mul: num_col_dims (" + std::to_string(xd) + ", " +
+              std::to_string(yd) + ") out of range for ranks (" +
+              std::to_string(x->shape.size()) + ", " +
+              std::to_string(y->shape.size()) + ")";
+    return false;
+  }
   int64_t M = 1, K = 1, K2 = 1, N = 1;
   for (int i = 0; i < xd; ++i) M *= x->shape[static_cast<size_t>(i)];
   for (size_t i = static_cast<size_t>(xd); i < x->shape.size(); ++i) K *= x->shape[i];
@@ -1163,14 +1184,31 @@ bool k_split(Machine& m, const OpDesc& op) {
   auto oit = op.outs.find("Out");
   if (oit == op.outs.end()) { m.error = "split: no Out"; return false; }
   const auto& names = oit->second;
+  if (axis < 0 || axis >= static_cast<int>(x->shape.size())) {
+    m.error = "split: axis out of range for rank " +
+              std::to_string(x->shape.size());
+    return false;
+  }
+  int64_t ax = x->shape[static_cast<size_t>(axis)];
   if (sections.empty()) {
     int64_t num = static_cast<int64_t>(op.attr_num(
         "num", static_cast<double>(names.size())));
-    sections.assign(static_cast<size_t>(num),
-                    x->shape[static_cast<size_t>(axis)] / num);
+    if (num <= 0 || ax % num != 0) {
+      m.error = "split: axis size " + std::to_string(ax) +
+                " not divisible into " + std::to_string(num) + " parts";
+      return false;
+    }
+    sections.assign(static_cast<size_t>(num), ax / num);
+  }
+  int64_t sec_sum = 0;
+  for (int64_t s : sections) sec_sum += s;
+  if (sections.size() != names.size() || sec_sum != ax) {
+    m.error = "split: sections sum " + std::to_string(sec_sum) + " (" +
+              std::to_string(sections.size()) + " outputs) does not cover "
+              "axis size " + std::to_string(ax);
+    return false;
   }
   int64_t pre = prod_range(x->shape, 0, static_cast<size_t>(axis));
-  int64_t ax = x->shape[static_cast<size_t>(axis)];
   int64_t post = x->numel() / (pre * ax);
   int64_t off = 0;
   for (size_t s = 0; s < names.size(); ++s) {
@@ -1193,14 +1231,26 @@ bool k_slice(Machine& m, const OpDesc& op) {
   std::vector<int64_t> axes = op.attr_ints("axes");
   std::vector<int64_t> starts = op.attr_ints("starts");
   std::vector<int64_t> ends = op.attr_ints("ends");
+  if (starts.size() != axes.size() || ends.size() != axes.size()) {
+    m.error = "slice: axes/starts/ends length mismatch";
+    return false;
+  }
   std::vector<int64_t> lo(x->shape.size(), 0), hi = x->shape;
   for (size_t i = 0; i < axes.size(); ++i) {
-    size_t ax = static_cast<size_t>(axes[i]);
+    int64_t a = axes[i];
+    if (a < 0) a += static_cast<int64_t>(x->shape.size());
+    if (a < 0 || a >= static_cast<int64_t>(x->shape.size())) {
+      m.error = "slice: axis " + std::to_string(axes[i]) +
+                " out of range for rank " +
+                std::to_string(x->shape.size());
+      return false;
+    }
+    size_t ax = static_cast<size_t>(a);
     int64_t dim = x->shape[ax];
     int64_t st = starts[i] < 0 ? starts[i] + dim : starts[i];
     int64_t en = ends[i] < 0 ? ends[i] + dim : ends[i];
     lo[ax] = std::max<int64_t>(0, st);
-    hi[ax] = std::min<int64_t>(dim, en);
+    hi[ax] = std::max(lo[ax], std::min<int64_t>(dim, en));
   }
   Tensor& o = set_out(m, op, "Out");
   o.shape.resize(x->shape.size());

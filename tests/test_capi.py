@@ -461,3 +461,121 @@ class TestCapiQuantized:
         with InferenceMachine(qd) as machine:
             got, = machine.run(feed)
         assert np.abs(got - np.asarray(ref)).max() < 2e-2
+
+
+class TestCapiMalformedModels:
+    """Robustness against malformed saved models (ADVICE r3/r4 items):
+    the machine must return a clear error through pdtpu_last_error, never
+    crash or silently compute a wrong result."""
+
+    def _tiny_model(self, tmp_path):
+        def build():
+            x = layers.data("x", shape=[8])
+            h = layers.fc(x, size=6, act="relu")
+            return [x], [layers.fc(h, size=4)]
+
+        d, *_ = _save_model(tmp_path, build)
+        return d
+
+    def _mutate(self, d, fn):
+        import json
+        import os
+
+        p = os.path.join(d, "__model__.json")
+        with open(p) as f:
+            model = json.load(f)
+        fn(model["program"]["blocks"][0]["ops"])
+        with open(p, "w") as f:
+            json.dump(model, f)
+
+    def _run(self, d):
+        from paddle_tpu.capi import InferenceMachine
+
+        x = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+        with InferenceMachine(d) as machine:
+            return machine.run({"x": x})
+
+    def test_mul_num_col_dims_out_of_range_errors(self, tmp_path):
+        d = self._tiny_model(tmp_path)
+
+        def corrupt(ops):
+            mul = next(op for op in ops if op["type"] == "mul")
+            mul["attrs"]["x_num_col_dims"] = 7
+
+        self._mutate(d, corrupt)
+        with pytest.raises(RuntimeError, match="num_col_dims"):
+            self._run(d)
+
+    def test_split_non_divisible_errors(self, tmp_path):
+        d = self._tiny_model(tmp_path)
+
+        def corrupt(ops):
+            # splice a bad split between fc1 and relu: 6 cols into 4 parts
+            relu = next(op for op in ops if op["type"] == "relu")
+            src = relu["inputs"]["X"][0]
+            relu["inputs"]["X"] = ["s0"]
+            ops.insert(ops.index(relu), {
+                "type": "split", "inputs": {"X": [src]},
+                "outputs": {"Out": ["s0", "s1", "s2", "s3"]},
+                "attrs": {"axis": 1, "num": 4}})
+
+        self._mutate(d, corrupt)
+        with pytest.raises(RuntimeError, match="divisible"):
+            self._run(d)
+
+    def test_slice_axis_out_of_range_errors(self, tmp_path):
+        d = self._tiny_model(tmp_path)
+
+        def corrupt(ops):
+            relu = next(op for op in ops if op["type"] == "relu")
+            src = relu["inputs"]["X"][0]
+            relu["inputs"]["X"] = ["sl0"]
+            ops.insert(ops.index(relu), {
+                "type": "slice", "inputs": {"X": [src]},
+                "outputs": {"Out": ["sl0"]},
+                "attrs": {"axes": [-5], "starts": [0], "ends": [3]}})
+
+        self._mutate(d, corrupt)
+        with pytest.raises(RuntimeError, match="axis"):
+            self._run(d)
+
+    def test_slice_negative_axis_normalizes(self, tmp_path):
+        """Valid negative axis must behave like the python op, not UB."""
+        def build():
+            x = layers.data("x", shape=[8])
+            from paddle_tpu.layers.layer_helper import LayerHelper
+
+            helper = LayerHelper("slice")
+            s = helper.simple_op("slice", {"X": [x]},
+                                 {"axes": [-1], "starts": [2],
+                                  "ends": [6]})
+            return [x], [layers.fc(s, size=3)]
+
+        d, main, scope, exe, feeds, targets = _save_model(tmp_path, build)
+        x = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+        ref, = exe.run(main, feed={"x": x}, fetch_list=targets, scope=scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run({"x": x})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+    def test_sampling_rejects_logits_and_nonfinite(self, tmp_path):
+        from paddle_tpu.capi import InferenceMachine
+
+        def build():
+            ids = layers.data("ids", shape=[4], dtype="int64")
+            emb = layers.embedding(ids, size=[9, 8])
+            return [ids], [layers.fc(emb, size=9, num_flatten_dims=2)]
+
+        d, *_ = _save_model(tmp_path, build)
+        with InferenceMachine(d) as machine:
+            prompt = np.array([[1, 2]], np.int64)
+            # greedy accepts logits
+            out = machine.generate(prompt, max_new_tokens=1, seq_len=4)
+            assert out.shape == (1, 3)
+            # sampling must reject raw logits (negative entries)
+            with pytest.raises(ValueError, match="probabilities"):
+                machine.generate(prompt, max_new_tokens=1, seq_len=4,
+                                 temperature=1.0, seed=0)
