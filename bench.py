@@ -53,12 +53,18 @@ TPU_PEAK_FLOPS = [
 ]
 
 TPU_TIMEOUT_S = 1500
-TPU_PROBE_TIMEOUT_S = 150
+TPU_PROBE_TIMEOUT_S = 120
 CPU_TIMEOUT_S = 900
 # Total wall budget for the whole bench (probing + attempts + fallback).
-BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 5400))
-# Tail reserve kept for the final re-probe + CPU fallback path.
-CPU_RESERVE_S = 1100
+# The round-4 post-mortem: the driver's real window is ~2000s, so a 5400s
+# default meant probing consumed everything and the CPU fallback never
+# ran — BENCH_r04.json recorded 0.0. Default now fits inside the observed
+# window with margin; a larger driver can raise it via env.
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 1700))
+# Tail margin kept when a CPU record is ALREADY banked (flush + emit).
+TAIL_MARGIN_S = 60
+# Budget cap for the bank-first CPU run (must fit early in the window).
+CPU_BANK_TIMEOUT_S = float(os.environ.get("BENCH_CPU_BANK_S", 700))
 SIDECAR_PATH = os.environ.get("BENCH_SIDECAR",
                               "/tmp/paddle_tpu_bench_sidecar.jsonl")
 SIDECAR_MAX_AGE_S = 24 * 3600
@@ -479,7 +485,6 @@ def assemble(rows, parent_notes=None):
                                     "demonstration config"),
         "lstm_varlen": res("lstm_varlen"),
         "decode_kv_cache": res("decode"),
-        "fused_linear_grad": resnet.get("fused_linear_grad"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -579,22 +584,8 @@ def run_bench(platform):
         assert np.isfinite(o).all()
         return batch * steps / elapsed
 
-    def measure_resnet_with_fallback():
-        # Runs with whatever --fused_linear_grad says (default off — the
-        # kernel lost its on-chip A/B under the 16 MB scoped-vmem limit,
-        # PERF.md round 3); if a fused compile ever fails on the measuring
-        # chip, fall back to the XLA backward rather than losing the bench
-        # (the flag is part of the compile key).
-        notes = {}
-        try:
-            ips = measure_resnet()
-        except Exception as exc:  # noqa: BLE001 - compile/runtime failure
-            pt.flags.FLAGS.fused_linear_grad = False
-            notes["fused_linear_grad_disabled"] = repr(exc)[:200]
-            ips = measure_resnet()
-        return {"img_per_sec": ips,
-                "fused_linear_grad": bool(pt.flags.FLAGS.fused_linear_grad),
-                "notes": notes or None}
+    def measure_resnet_row():
+        return {"img_per_sec": measure_resnet(), "notes": None}
 
     digest = os.environ.get("BENCH_DIGEST") or _source_digest()
     rows = _sidecar_load(digest, device=dev.device_kind) if on_tpu else {}
@@ -632,7 +623,7 @@ def run_bench(platform):
     # Headline first, then the >=50%-MFU north-star config, then the rest
     # — ordered so an early tunnel drop still captures the rows that
     # matter most.
-    step("resnet", measure_resnet_with_fallback)
+    step("resnet", measure_resnet_row)
     if on_tpu:
         step("transformer_wide", bench_transformer_step, jax, pt, layers,
              models, bs=8, d=2048, H=16)
@@ -716,11 +707,31 @@ def main():
             return True
         return False
 
+    banked = []  # CPU record banked early, emitted if no TPU record lands
+
+    def emit_banked(extra_notes):
+        if not banked:
+            return False
+        result = banked[0]
+        result.setdefault("extra", {})["tpu_unavailable"] = (
+            notes + extra_notes)
+        rows, n = tpu_metric_rows()
+        if n:
+            # Headline-less TPU rows (e.g. a deterministic resnet failure
+            # with working secondary metrics) still ride along.
+            result["extra"]["tpu_partial_rows"] = {
+                s: r.get("result", {"error": r.get("error")})
+                for s, r in rows.items() if s != "info"}
+        emit(result)
+        return True
+
     def on_term(signum, frame):
+        # Flush order: partial TPU record > banked CPU record > zero.
         if not finalize_from_sidecar(notes + [f"signal {signum}"]):
-            emit({"metric": "resnet50_train_images_per_sec_per_chip",
-                  "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
-                  "extra": {"error": notes + [f"signal {signum}"]}})
+            if not emit_banked([f"signal {signum}"]):
+                emit({"metric": "resnet50_train_images_per_sec_per_chip",
+                      "value": 0.0, "unit": "img/s", "vs_baseline": 0.0,
+                      "extra": {"error": notes + [f"signal {signum}"]}})
         sys.exit(0)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -730,75 +741,88 @@ def main():
         print(f"# [{int(time.time() - t0)}s] {msg}", file=sys.stderr,
               flush=True)
 
-    # TPU phase: probe on a backoff schedule spread across the budget
-    # window; each successful probe buys one (resuming) sweep attempt.
-    # A probe that TIMES OUT means a wedged tunnel that may recover (keep
+    # Phase 0: ONE quick probe. Tunnel up → go straight to the TPU sweep
+    # (no CPU detour on the happy path). Tunnel down → BANK THE CPU
+    # RECORD FIRST (the round-4 failure mode was spending the whole
+    # window probing a dead tunnel and recording 0.0), then spend every
+    # remaining second probing for the chip.
+    probe, pnote = _spawn("tpu-probe", TPU_PROBE_TIMEOUT_S)
+    tunnel_up_at_start = probe is not None
+    if not tunnel_up_at_start:
+        notes.append(f"probe 0: {pnote}")
+        log(f"initial probe failed ({pnote}); banking CPU record first")
+        bank_timeout = min(CPU_BANK_TIMEOUT_S,
+                           max(120, deadline - time.time() - 120))
+        result, note = _spawn("cpu", bank_timeout)
+        if result is not None:
+            banked.append(result)
+            log(f"CPU record banked (value={result.get('value')})")
+        else:
+            notes.append(f"cpu bank: {note}")
+            log(f"CPU bank failed: {note}")
+
+    # TPU phase: probe on a backoff schedule across the remaining window;
+    # each successful probe buys one (resuming) sweep attempt. A probe
+    # that TIMES OUT means a wedged tunnel that may recover (keep
     # probing); a probe that fails FAST means a deterministic no-TPU
-    # environment (two strikes, then go straight to the CPU smoke path).
-    backoffs = [30, 60, 90, 120, 180, 240]
+    # environment (two strikes, then stop).
+    reserve = TAIL_MARGIN_S if banked else CPU_TIMEOUT_S // 2
+    backoffs = [20, 40, 60, 90, 120, 180]
     probe_i = 0
     fast_fails = 0
-    while time.time() < deadline - CPU_RESERVE_S and fast_fails < 2:
-        remaining = deadline - CPU_RESERVE_S - time.time()
-        pt0 = time.time()
-        probe, pnote = _spawn("tpu-probe",
-                              min(TPU_PROBE_TIMEOUT_S, max(60, remaining)))
+    while time.time() < deadline - reserve and fast_fails < 2:
+        remaining = deadline - reserve - time.time()
+        if tunnel_up_at_start and probe_i == 0:
+            pass  # reuse the phase-0 probe result
+        else:
+            pt0 = time.time()
+            probe, pnote = _spawn(
+                "tpu-probe", min(TPU_PROBE_TIMEOUT_S, max(60, remaining)))
+            if probe is None:
+                if "timed out" not in pnote and time.time() - pt0 < 60:
+                    fast_fails += 1
+                probe_i += 1
+                notes.append(f"probe {probe_i}: {pnote}")
+                log(f"probe {probe_i} failed (fast_fails={fast_fails}): "
+                    f"{pnote}")
+                sleep = backoffs[min(probe_i - 1, len(backoffs) - 1)]
+                time.sleep(max(0, min(sleep,
+                                      deadline - reserve - time.time())))
+                continue
         probe_i += 1
-        if probe is not None:
-            fast_fails = 0
-            log(f"probe {probe_i} ok ({probe.get('device_kind')})")
-            att_timeout = min(TPU_TIMEOUT_S,
-                              deadline - CPU_RESERVE_S - time.time())
-            if att_timeout < 120:
-                break
-            _, before = tpu_metric_rows()
-            result, note = _spawn("tpu", att_timeout)
-            if result is not None:
-                emit(result)
-                return 0
-            notes.append(note)
-            _, after = tpu_metric_rows()
-            log(f"tpu attempt failed ({note}); sidecar rows {before}->"
-                f"{after}")
-            # Forward progress → retry immediately; stuck → back off.
-            sleep = 15 if after > before else backoffs[
-                min(probe_i - 1, len(backoffs) - 1)]
-        else:
-            if "timed out" not in pnote and time.time() - pt0 < 60:
-                fast_fails += 1
-            notes.append(f"probe {probe_i}: {pnote}")
-            log(f"probe {probe_i} failed (fast_fails={fast_fails}): "
-                f"{pnote}")
-            sleep = backoffs[min(probe_i - 1, len(backoffs) - 1)]
-        time.sleep(max(0, min(sleep,
-                              deadline - CPU_RESERVE_S - time.time())))
-
-    # Final TPU re-probe before giving up on the chip (the r3 tunnel
-    # recovered between the probe and the end of the bench window). Only
-    # worth it for a wedged-tunnel environment with budget left.
-    if not emitted and fast_fails < 2 and time.time() < deadline - 500:
-        probe, pnote = _spawn("tpu-probe", TPU_PROBE_TIMEOUT_S)
-        if probe is not None:
-            att_timeout = min(TPU_TIMEOUT_S,
-                              max(240, deadline - time.time()
-                                  - CPU_RESERVE_S + 200))
-            result, note = _spawn("tpu", att_timeout)
-            if result is not None:
-                emit(result)
-                return 0
-            notes.append(note)
-        else:
-            notes.append(f"final probe: {pnote}")
+        fast_fails = 0
+        log(f"probe {probe_i} ok ({probe.get('device_kind')})")
+        att_timeout = min(TPU_TIMEOUT_S, deadline - reserve - time.time())
+        if att_timeout < 120:
+            break
+        _, before = tpu_metric_rows()
+        result, note = _spawn("tpu", att_timeout)
+        if result is not None:
+            emit(result)
+            return 0
+        notes.append(note)
+        _, after = tpu_metric_rows()
+        log(f"tpu attempt failed ({note}); sidecar rows {before}->{after}")
+        # Forward progress → retry immediately; stuck → back off.
+        sleep = 15 if after > before else backoffs[
+            min(probe_i - 1, len(backoffs) - 1)]
+        time.sleep(max(0, min(sleep, deadline - reserve - time.time())))
 
     # Partial TPU record beats a CPU smoke number.
+    exit_reason = ("no-TPU fast-fail (deterministic probe failures)"
+                   if fast_fails >= 2 else "deadline reached")
     if finalize_from_sidecar(notes):
         return 0
+    if emit_banked([exit_reason]):
+        return 0
 
-    result, note = _spawn("cpu", CPU_TIMEOUT_S)
+    # No banked record (tunnel looked up at first, or the bank failed):
+    # run the CPU fallback now.
+    result, note = _spawn("cpu", max(120.0,
+                                     min(CPU_TIMEOUT_S,
+                                         deadline - time.time() + 300)))
     if result is not None:
         result.setdefault("extra", {})["tpu_unavailable"] = notes
-        # Headline-less TPU rows (e.g. a deterministic resnet failure with
-        # working secondary metrics) still ride along for the record.
         rows, n = tpu_metric_rows()
         if n:
             result["extra"]["tpu_partial_rows"] = {
