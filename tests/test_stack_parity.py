@@ -71,3 +71,41 @@ def test_stacked_matches_per_layer_with_copied_weights():
                      scope=scope_b)
     np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_a),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_stack_remat_policies_match_numerically():
+    """remat=False / True / "dots" (selective save-dots policy) are pure
+    memory-schedule choices — identical losses through training steps."""
+    def run(remat):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, d_ff=FF, max_len=T, pipeline_stack=True,
+                remat=remat)
+            nxt = layers.data("nxt", shape=[T], dtype="int64")
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(
+                    logits, layers.reshape(nxt, shape=[0, T, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(
+                loss, startup_program=startup)
+        main.random_seed = startup.random_seed = 13
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        ids_v = rng.randint(0, VOCAB, size=(2, T)).astype("int64")
+        feed = {"ids": ids_v, "nxt": np.roll(ids_v, -1, 1)}
+        return [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss],
+                                         scope=scope)[0]))
+                for _ in range(4)]
+
+    plain = run(False)
+    full = run(True)
+    dots = run("dots")
+    assert np.isfinite(plain).all()
+    np.testing.assert_allclose(full, plain, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dots, plain, rtol=1e-5, atol=1e-6)
+    assert plain[-1] < plain[0]
