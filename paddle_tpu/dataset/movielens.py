@@ -1,11 +1,20 @@
 """MovieLens-1M recommender data (reference v2/dataset/movielens.py API).
 
 Samples are ``(user_id, gender_id, age_id, job_id, movie_id, category_ids,
-title_ids, score)`` — the recommender book-test feature tuple. Synthetic
-fallback: a low-rank latent-factor model generates consistent ratings, so
+title_ids, score)`` — the recommender book-test feature tuple. When the
+real ``ml-1m.zip`` is present in the cache dir its '::'-separated
+movies/users/ratings .dat files are parsed with the reference's rules
+(title-year stripping, age bucketing via age_table, deterministic
+0.1 train/test ratings split — movielens.py:101-160); otherwise a
+low-rank latent-factor synthetic model generates consistent ratings, so
 matrix-factorisation models can actually fit.
 """
 from __future__ import annotations
+
+import os
+import random
+import re
+import zipfile
 
 import numpy as np
 
@@ -25,25 +34,101 @@ TEST_SIZE = 1024
 
 age_table = [1, 18, 25, 35, 45, 50, 56]
 
+_META = None  # (movies {mid: (cats, title_ids)}, users {uid: tuple},
+#                title_dict, cat_dict) from the real zip, once parsed
+
+
+def _real_path():
+    p = os.path.join(common.DATA_HOME, "movielens", "ml-1m.zip")
+    return p if os.path.exists(p) else None
+
+
+def _meta():
+    """Parse movies.dat/users.dat from the real zip (reference
+    __initialize_meta_info__)."""
+    global _META
+    if _META is not None:
+        return _META
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    movies, users = {}, {}
+    title_words, cat_names = set(), set()
+    raw_movies = []
+    with zipfile.ZipFile(_real_path()) as pkg:
+        with pkg.open("ml-1m/movies.dat") as f:
+            for line in f:
+                mid, title, cats = line.decode(
+                    "latin1").strip().split("::")
+                cats = cats.split("|")
+                title = pattern.match(title).group(1)
+                raw_movies.append((int(mid), cats, title))
+                cat_names.update(cats)
+                title_words.update(w.lower() for w in title.split())
+        cat_dict = {c: i for i, c in enumerate(sorted(cat_names))}
+        title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+        for mid, cats, title in raw_movies:
+            movies[mid] = ([cat_dict[c] for c in cats],
+                           [title_dict[w.lower()] for w in title.split()])
+        with pkg.open("ml-1m/users.dat") as f:
+            for line in f:
+                uid, gender, age, job, _zip = line.decode(
+                    "latin1").strip().split("::")
+                users[int(uid)] = (int(uid), 0 if gender == "M" else 1,
+                                   age_table.index(int(age)), int(job))
+    _META = (movies, users, title_dict, cat_dict)
+    return _META
+
 
 def max_user_id():
+    if _real_path():
+        return max(_meta()[1])
     return N_USERS
 
 
 def max_movie_id():
+    if _real_path():
+        return max(_meta()[0])
     return N_MOVIES
 
 
 def max_job_id():
+    if _real_path():
+        return max(u[3] for u in _meta()[1].values())
     return N_JOBS - 1
 
 
 def movie_categories():
+    if _real_path():
+        return dict(_meta()[3])
     return {f"cat{i}": i for i in range(N_CATEGORIES)}
 
 
 def get_movie_title_dict():
+    if _real_path():
+        return dict(_meta()[2])
     return {f"t{i}": i for i in range(TITLE_VOCAB)}
+
+
+def _real_reader(is_test, test_ratio=0.1, rand_seed=0):
+    """Ratings stream from the real zip; the same deterministic
+    rand.random() < test_ratio row split as the reference __reader__."""
+
+    def reader():
+        movies, users, _, _ = _meta()
+        rand = random.Random(x=rand_seed)
+        with zipfile.ZipFile(_real_path()) as pkg:
+            with pkg.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rand.random() < test_ratio) != is_test:
+                        continue
+                    uid, mid, score, _ts = line.decode(
+                        "latin1").strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    cats, titles = movies[mid]
+                    u = users[uid]
+                    yield (u[0], u[1], u[2], u[3], mid, cats, titles,
+                           float(score))
+
+    return reader
 
 
 def _factors():
@@ -84,8 +169,12 @@ def _reader(n, seed_name):
 
 
 def train():
+    if _real_path():
+        return _real_reader(is_test=False)
     return _reader(TRAIN_SIZE, "movielens-train")
 
 
 def test():
+    if _real_path():
+        return _real_reader(is_test=True)
     return _reader(TEST_SIZE, "movielens-test")

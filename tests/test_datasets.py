@@ -59,10 +59,13 @@ def test_conll05():
     assert emb.shape[1] == 32
     for sample in first_n(dataset.conll05.test(), 5):
         assert len(sample) == 9
-        words, preds = sample[0], sample[1]
-        labels = sample[8]
-        assert len(words) == len(labels) == len(preds)
+        # reference reader_creator order: word, ctx_n2..ctx_p2, pred,
+        # mark, label (conll05.py:176)
+        words, preds = sample[0], sample[6]
+        mark, labels = sample[7], sample[8]
+        assert len(words) == len(labels) == len(preds) == len(mark)
         assert all(0 <= l < 9 for l in labels)
+        assert sum(mark) >= 1
 
 
 def test_wmt14():
