@@ -367,3 +367,32 @@ def test_pool2d_ceil_mode_clamps_all_padding_window():
     assert np.isfinite(np.asarray(om)).all()
     assert np.isfinite(np.asarray(oa)).all()
     assert np.asarray(om).shape == (1, 1, 1, 1)
+
+
+def test_v1_trainer_jobs(tmp_path, capsys):
+    """The paddle_trainer CLI jobs (TrainerMain.cpp:54): train, test,
+    time, checkgrad over the fixture config."""
+    conf = str(_write_fixture(tmp_path))
+    import os
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        from paddle_tpu.v1 import trainer as v1t
+
+        assert v1t.main(["--config", conf, "--job", "train",
+                         "--num_passes", "1", "--config_args",
+                         "dim=12"]) == 0
+        assert "pass 0" in capsys.readouterr().out
+        assert v1t.main(["--config", conf, "--job", "time",
+                         "--config_args", "dim=12"]) == 0
+        out = capsys.readouterr().out
+        assert "ms/batch" in out and "train_step" in out
+        assert v1t.main(["--config", conf, "--job", "test",
+                         "--config_args", "dim=12"]) == 0
+        assert "mean cost" in capsys.readouterr().out
+        assert v1t.main(["--config", conf, "--job", "checkgrad",
+                         "--config_args", "dim=12"]) == 0
+        assert "max rel err" in capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
