@@ -228,7 +228,15 @@ def transformer_lm_speculative_generate(prompt, vocab_size, d_model=256,
     them in one block-causal pass. Output is EXACTLY the plain greedy
     decode (acceptance keeps only tokens the full stack argmaxes); the
     draft only buys fewer full-stack passes. Returns (ids [b, Tp+N],
-    rounds [1] — plain decode would take N)."""
+    rounds [1] — plain decode would take N).
+
+    EXPERIMENTAL (status, PERF.md "speculative decoding"): correctness is
+    pinned (tests/test_generate.py) and a trained draft head cuts verify
+    rounds well below N on the CPU mesh, but the only wall-clock A/B on
+    record (r3 chip, UNtrained model — zero acceptance) was a 2.4x
+    slowdown. Until tools/chip_session_r5.py's trained-model A/B records
+    a speedup > 1, prefer plain ``transformer_lm_generate`` in
+    production."""
     from ..initializer import ConstantInitializer
 
     kw = dict(main_program=main_program, startup_program=startup_program)

@@ -107,6 +107,120 @@ def main():
     cs.experiment("resnet50_infer_bs16_epilogue_on",
                   lambda: infer(True), seconds=600)
 
+    # 3. Stacked-scan remat A/B: all-or-nothing vs the save-dots policy.
+    def lm_stacked(remat):
+        import time
+
+        import numpy as np
+
+        bs, T, vocab, d, Lh = 8, 2048, 16384, 1024, 8
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            tgt = layers.data("tgt", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=8, max_len=T, pipeline_stack=True, remat=remat)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, vocab]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
+                loss, startup_program=startup)
+        rng = np.random.RandomState(0)
+        feed = {"ids": rng.randint(0, vocab, (bs, T)).astype("int64"),
+                "tgt": rng.randint(0, vocab, (bs, T)).astype("int64")}
+        t0 = time.perf_counter()
+        sec = bench._time_train_steps(jax, pt, main_prog, startup, loss,
+                                      feed, steps=10)
+        wall = time.perf_counter() - t0
+        flops = bench.transformer_train_flops(bs, T, d, Lh, vocab)
+        return {"tokens_per_sec": round(bs * T / sec),
+                "mfu": round(flops / sec / peak, 4) if peak else None,
+                "remat": str(remat),
+                "compile_plus_run_wall_s": round(wall, 1)}
+
+    cs.experiment("lm_stacked_remat_full", lambda: lm_stacked(True),
+                  seconds=900)
+    cs.experiment("lm_stacked_remat_dots", lambda: lm_stacked("dots"),
+                  seconds=900)
+
+    # 4. Self-speculative decode A/B at temp 0: train the stack briefly on
+    #    a learnable pattern, distill the draft head (copy the real head;
+    #    the k-layer trunk still differs), then time spec vs plain decode.
+    def spec_decode_ab():
+        import time
+
+        import numpy as np
+
+        vocab, d, Lh, H = 2048, 512, 8, 8
+        Tp, N, bs = 128, 128, 8
+        maxlen = Tp + N + 8
+        main_prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_prog, startup):
+            ids = layers.data("ids", shape=[maxlen - 1], dtype="int64")
+            tgt = layers.data("tgt", shape=[maxlen - 1], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=H, max_len=maxlen, pipeline_stack=True)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, vocab]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=3e-4).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        seq = (rng.randint(0, vocab, (64, 1))
+               + 7 * np.arange(maxlen)) % vocab
+        feed = {"ids": seq[:, :-1].astype("int64"),
+                "tgt": seq[:, 1:].astype("int64")}
+        for _ in range(150):
+            exe.run(main_prog, feed=feed, fetch_list=[loss], scope=scope)
+
+        prog, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup2):
+            prompt = layers.data("ps", shape=[Tp], dtype="int64")
+            plain = models.transformer_lm_generate(
+                prompt, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=H, max_len=maxlen, max_new_tokens=N)
+            spec, rounds = models.transformer_lm_speculative_generate(
+                prompt, vocab_size=vocab, d_model=d, n_layers=Lh,
+                num_heads=H, max_len=maxlen, max_new_tokens=N,
+                draft_layers=2, gamma=4)
+        trained = {k: np.asarray(scope.get(k)) for k in scope.keys()}
+        exe.run(startup2, scope=scope)
+        for k, v in trained.items():
+            scope.set(k, v)
+        scope.set("draft_head.w", np.asarray(scope.get("lm_head.w")))
+        scope.set("draft_ln.scale",
+                  np.asarray(scope.get("final_ln.scale")))
+        scope.set("draft_ln.bias", np.asarray(scope.get("final_ln.bias")))
+        p = ((rng.randint(0, vocab, (bs, 1)) + 7 * np.arange(Tp))
+             % vocab).astype("int64")
+
+        def timed(fetches):
+            for _ in range(2):
+                exe.run(prog, feed={"ps": p}, fetch_list=fetches,
+                        scope=scope)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                outs = exe.run(prog, feed={"ps": p}, fetch_list=fetches,
+                               scope=scope, return_numpy=False)
+            got = [np.asarray(o) for o in outs]
+            return (time.perf_counter() - t0) / 5, got
+
+        sec_plain, (g_plain,) = timed([plain])
+        sec_spec, (g_spec, r) = timed([spec, rounds])
+        assert (g_spec == g_plain).all(), "spec decode diverged"
+        return {"plain_s": round(sec_plain, 3),
+                "spec_s": round(sec_spec, 3),
+                "speedup": round(sec_plain / sec_spec, 3),
+                "verify_rounds": int(r[0]), "plain_rounds": N,
+                "tokens_per_sec_spec": round(bs * N / sec_spec)}
+
+    cs.experiment("spec_decode_ab", spec_decode_ab, seconds=1400)
+
     # 5. Headline MFU rows for BENCH_r05.
     cs.experiment(
         "lm_wide_d2048_h16",
