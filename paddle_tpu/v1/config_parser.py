@@ -84,11 +84,25 @@ class ParsedConfig:
         self.settings = ctx.settings
         self.data_sources = ctx.data_sources
         self.evaluators = ctx.evaluators
-        self.output_vars = list(ctx.outputs or [])
+        # Outputs("name") entries resolve against the v1 name registry
+        self.output_vars = []
+        for o in (ctx.outputs or []):
+            if isinstance(o, str):
+                if o not in ctx.named_layers:
+                    raise ValueError(
+                        f"Outputs({o!r}): no layer was created with "
+                        f"name={o!r}; known names: "
+                        f"{sorted(ctx.named_layers)[:20]}")
+                o = ctx.named_layers[o]
+            self.output_vars.append(o)
         by_name = {v.name: v for v in ctx.data_layers}
         order = ctx.inputs_order or [v.name for v in ctx.data_layers]
         self.input_vars = [by_name[n] for n in order if n in by_name]
         self.config_dir = ctx.config_dir
+        # lazily-applied config-wide defaults (reference reads them at
+        # parameter/optimizer build, so call order vs Settings is free)
+        self.default_momentum = ctx.default_momentum
+        self.default_decay_rate = ctx.default_decay_rate
 
     @property
     def cost(self):
@@ -98,11 +112,19 @@ class ParsedConfig:
 
     def build_optimizer(self):
         """settings record -> a concrete optimizer, with the legacy
-        gradient_clipping_threshold installed on the main program."""
-        opt = (self.settings.get("learning_method")
-               or _h.MomentumOptimizer(momentum=0.0)).build(
+        gradient_clipping_threshold installed on the main program.
+        String learning_methods (the Settings() form) and the
+        default_momentum/default_decay_rate config-wide defaults resolve
+        HERE, after the whole config evaluated (reference timing)."""
+        method = _h.resolve_learning_method(
+            self.settings.get("learning_method"),
+            default_momentum=self.default_momentum)
+        reg = self.settings.get("regularization")
+        if reg is None and self.default_decay_rate:
+            reg = _h.L2Regularization(self.default_decay_rate)
+        opt = (method or _h.MomentumOptimizer(momentum=0.0)).build(
             self.settings.get("learning_rate", 0.01),
-            regularization=self.settings.get("regularization"))
+            regularization=reg)
         thr = self.settings.get("gradient_clipping_threshold")
         if thr:
             from ..clip import GradientClipByGlobalNorm, set_gradient_clip

@@ -62,6 +62,9 @@ class ParseContext:
         self.inputs_order = None       # inputs() override
         self.outputs = None
         self.evaluators = []
+        self.named_layers = {}         # v1 name= kwarg -> built var
+        self.default_momentum = None   # default_momentum()
+        self.default_decay_rate = None  # default_decay_rate()
 
 
 def _ctx() -> ParseContext:
@@ -144,6 +147,83 @@ def outputs(*layers_):
     for item in layers_:
         flat.extend(item if isinstance(item, (list, tuple)) else [item])
     _ctx().outputs = flat
+
+
+def Inputs(*names):
+    """Name-string form (reference config_parser Inputs): the feed order
+    by data-layer name."""
+    _ctx().inputs_order = list(names)
+
+
+def Outputs(*names):
+    """Name-string form (reference config_parser Outputs): entries are
+    v1 layer names resolved against the name registry at parse end."""
+    _ctx().outputs = list(names)
+
+
+def default_momentum(momentum):
+    """Config-wide momentum default consumed by Settings(
+    learning_method='momentum') (reference config_parser
+    default_momentum)."""
+    _ctx().default_momentum = float(momentum)
+
+
+def default_decay_rate(rate):
+    """Config-wide L2 decay default (reference default_decay_rate)."""
+    _ctx().default_decay_rate = float(rate)
+
+
+def default_initial_std(std):
+    """Accepted no-op: per-layer attrs carry their own initializers."""
+
+
+def default_initial_mean(mean):
+    """Accepted no-op (see default_initial_std)."""
+
+
+def Settings(algorithm="sgd", batch_size=None, learning_rate=None,
+             learning_method=None, learning_rate_decay_a=None,
+             learning_rate_decay_b=None, learning_rate_schedule=None,
+             **kw):
+    """The capitalized low-level form (reference config_parser Settings):
+    ``learning_method`` arrives as a STRING and is recorded AS-IS —
+    resolution to an optimizer object happens lazily in
+    build_optimizer, because the reference reads default_momentum()/
+    default_decay_rate() at parameter-build time, so configs may call
+    them in any order relative to Settings()."""
+    settings(batch_size=batch_size, learning_rate=learning_rate,
+             learning_method=learning_method,
+             learning_rate_decay_a=learning_rate_decay_a,
+             learning_rate_decay_b=learning_rate_decay_b,
+             learning_rate_schedule=learning_rate_schedule, **kw)
+
+
+def resolve_learning_method(method, default_momentum=None):
+    """STRING learning_method -> optimizer object (reference
+    config_parser Settings algorithm table). Momentum defaults to the
+    reference's 0.0 unless default_momentum() was called; unknown
+    methods fail loudly."""
+    if not isinstance(method, str):
+        return method
+    mom = default_momentum if default_momentum is not None else 0.0
+    table = {
+        "momentum": lambda: MomentumOptimizer(momentum=mom),
+        # the sparse variant differs only in pserver-side update layout;
+        # sparse gradients here are SelectedRows either way
+        "sparse_momentum": lambda: MomentumOptimizer(momentum=mom),
+        "sgd": lambda: MomentumOptimizer(momentum=mom),
+        "adam": AdamOptimizer,
+        "adamax": AdamaxOptimizer,
+        "adagrad": AdaGradOptimizer,
+        "decayed_adagrad": DecayedAdaGradOptimizer,
+        "adadelta": AdaDeltaOptimizer,
+        "rmsprop": RMSPropOptimizer,
+    }
+    if method not in table:
+        raise ValueError(
+            f"Settings(learning_method={method!r}) is not a supported "
+            f"method; known: {sorted(table)}")
+    return table[method]()
 
 
 # ---------------------------------------------------------------------------
@@ -527,21 +607,24 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    stride=1, padding=0, groups=1, act=None, param_attr=None,
                    bias_attr=None, **kw):
     input = _as_image(input, num_channels)
-    return v2l.img_conv(input, filter_size, num_filters,
-                        num_channels=num_channels, stride=stride,
-                        padding=padding, groups=groups, act=act,
-                        param_attr=_pa(param_attr), bias_attr=bias_attr)
+    return _group_register_name(kw.get("name"), v2l.img_conv(
+        input, filter_size, num_filters, num_channels=num_channels,
+        stride=stride, padding=padding, groups=groups, act=act,
+        param_attr=_pa(param_attr), bias_attr=bias_attr))
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
                    num_channels=None, ceil_mode=True, **kw):
-    return v2l.img_pool(_as_image(input, num_channels), pool_size,
-                        stride=stride, padding=padding, pool_type=pool_type,
-                        ceil_mode=ceil_mode)
+    return _group_register_name(kw.get("name"), v2l.img_pool(
+        _as_image(input, num_channels), pool_size, stride=stride,
+        padding=padding, pool_type=pool_type, ceil_mode=ceil_mode))
 
 
-def batch_norm_layer(input, act=None, **kw):
-    return v2l.batch_norm(input, act=act, **kw)
+def batch_norm_layer(input, act=None, use_global_stats=None, **kw):
+    if use_global_stats is not None:
+        kw.setdefault("is_test", bool(use_global_stats))
+    return _group_register_name(kw.get("name"),
+                                v2l.batch_norm(input, act=act, **kw))
 
 
 def dropout_layer(input, dropout_rate=0.5, **kw):
@@ -711,9 +794,16 @@ _GROUP: Optional[_GroupState] = None
 def _group_register_name(name, var):
     """Layer shims call this so memory(name=...) can link to a step
     layer produced under that name (the reference's name-based memory
-    wiring)."""
-    if _GROUP is not None and name:
-        _GROUP.named_outputs[name] = var
+    wiring), and so Outputs("name") can resolve layers by their v1
+    name at parse end."""
+    if name:
+        if _GROUP is not None:
+            # step-internal names stay group-scoped: they denote scan
+            # sub-block vars the main program never produces, so they
+            # must not shadow/poison the Outputs() registry
+            _GROUP.named_outputs[name] = var
+        elif _CTX is not None:
+            _CTX.named_layers[name] = var
     return var
 
 
@@ -951,6 +1041,42 @@ def precision_recall_evaluator(input, label, name=None, **kw):
     _evaluator("precision_recall", name=name, input=input, label=label)
 
 
+def _register_named(fn):
+    """Wrap a layer shim so a name= kwarg registers the result in the
+    Outputs()/memory name registry — the reference accepts name= on
+    EVERY layer, not just the handful that consume it."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        out = fn(*a, **kw)
+        nm = kw.get("name")
+        if nm and hasattr(out, "name"):
+            _group_register_name(nm, out)
+        return out
+
+    return wrapped
+
+
+for _n in list(globals()):
+    if (_n.endswith("_layer") or _n in ("lstmemory", "grumemory",
+                                        "mixed_layer", "first_seq",
+                                        "last_seq", "classification_cost",
+                                        "cross_entropy", "regression_cost",
+                                        "lambda_cost",
+                                        "cross_entropy_with_selfnorm",
+                                        "img_conv_group",
+                                        "simple_img_conv_pool")):
+        _f = globals()[_n]
+        if callable(_f) and not isinstance(_f, type):
+            globals()[_n] = _register_named(_f)
+del _n, _f
+
+
+xrange = range  # py2-era reference configs iterate with xrange
+
+
 # everything a `from paddle.trainer_config_helpers import *` should see
 _EXPORTS = [n for n in dir() if not n.startswith("_")
-            and n not in ("annotations", "importlib", "math", "os", "sys")]
+            and n not in ("annotations", "importlib", "math", "os", "sys",
+                          "Optional")]
