@@ -155,11 +155,16 @@ def parse_config(config_file, config_arg_str=""):
     added_path = ctx.config_dir not in sys.path
     if added_path:
         sys.path.insert(0, ctx.config_dir)
+    had_maxint = hasattr(sys, "maxint")
+    if not had_maxint:
+        sys.maxint = sys.maxsize  # py2-era configs read sys.maxint
     try:
         with program_guard(main_program, startup_program):
             exec(compile(source, config_file, "exec"), ns)  # noqa: S102
     finally:
         _h._CTX = prev_ctx
+        if not had_maxint:
+            del sys.maxint
         if added_path and ctx.config_dir in sys.path:
             sys.path.remove(ctx.config_dir)
     if ctx.outputs is None and ctx.data_layers:

@@ -79,15 +79,29 @@ class DataProvider:
         self.extra = kw
         self.__name__ = getattr(fn, "__name__", "provider")
 
-    def create(self, **args):
+    def create(self, file_list=None, **args):
         """Instantiate settings (running init_hook with the
         define_py_data_sources2 ``args``); returns the settings object.
         After this, ``input_types`` is resolved (dict keyed by data-layer
-        name, or a positional list)."""
+        name, or a positional list). ``file_list`` is always passed to
+        the hook — the reference contract (PyDataProvider2.py:434:
+        init_hook(settings, file_list, **kwargs))."""
         settings = ProviderSettings()
         settings.input_types = self.input_types
+        settings.file_list = list(file_list or [])
         if self.init_hook is not None:
-            self.init_hook(settings, **args)
+            import inspect
+
+            params = inspect.signature(self.init_hook).parameters
+            takes_fl = ("file_list" in params
+                        or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                               for p in params.values()))
+            if takes_fl:
+                self.init_hook(settings, file_list=settings.file_list,
+                               **args)
+            else:
+                # hooks written against the repo's pre-file_list contract
+                self.init_hook(settings, **args)
         return settings
 
     def __call__(self, settings, filename, *a, **kw):

@@ -113,7 +113,36 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
             sys.path.remove(ctx.config_dir)
     dp = getattr(mod, obj, None)
     if isinstance(dp, _dp.DataProvider):
-        settings = dp.create(**ctx.data_sources["args"])
+        # the TRAIN source's files only — the reference hands each data
+        # source its own provider instance and file_list
+        # (PyDataProvider2.py:434); hooks deriving state (vocabs, class
+        # counts) must not also see the test files
+        file_list = []
+        lst = train_list or test_list
+        if lst:
+            for base in (os.getcwd(), ctx.config_dir):
+                path = lst if os.path.isabs(lst) else os.path.join(base,
+                                                                   lst)
+                if os.path.exists(path):
+                    with open(path) as lf:
+                        file_list.extend(
+                            ln.strip() for ln in lf if ln.strip())
+                    break
+        try:
+            settings = dp.create(file_list=file_list,
+                                 **ctx.data_sources["args"])
+        except (NameError, AttributeError, SyntaxError, ImportError):
+            # py2-only init hooks (xrange, dict.iteritems, ...): degrade
+            # to dense typing like an unimportable module — but say so,
+            # because the feeds lose their provider types
+            import traceback
+            import warnings
+
+            warnings.warn(
+                f"provider {module}.{obj} init hook failed "
+                f"(py2-only?); data layers degrade to dense typing:\n"
+                f"{traceback.format_exc()}", stacklevel=2)
+            return
         ctx.provider_types = settings.input_types
         ctx.data_sources["provider"] = dp
         ctx.data_sources["provider_settings"] = settings
@@ -388,9 +417,12 @@ ParameterAttribute = ParamAttr
 
 
 def _pa(attr):
-    """None | v1 ParamAttr | fluid ParamAttr -> fluid-compatible attr."""
+    """None | bool | v1 ParamAttr | fluid ParamAttr -> fluid attr.
+    True means "default attribute" in the v1 DSL."""
     if isinstance(attr, ParamAttr):
         return attr.to_fluid()
+    if attr is True:
+        return None
     return attr
 
 
@@ -610,7 +642,7 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
     return _group_register_name(kw.get("name"), v2l.img_conv(
         input, filter_size, num_filters, num_channels=num_channels,
         stride=stride, padding=padding, groups=groups, act=act,
-        param_attr=_pa(param_attr), bias_attr=bias_attr))
+        param_attr=_pa(param_attr), bias_attr=_pa(bias_attr)))
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
@@ -747,6 +779,119 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
     return v2l.img_pool(tmp, pool_size, stride=pool_stride,
                         padding=pool_padding,
                         pool_type=pool_type or MaxPooling())
+
+
+# -- trainer_config_helpers/networks.py composites -------------------------
+
+def simple_lstm(input, size, reverse=False, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.simple_lstm(input, size, reverse=reverse)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kw):
+    """reference networks.py bidirectional_lstm: fwd+bwd simple_lstm.
+    return_seq=False returns the concat of the two LAST states (the
+    text-classification head); True the concatenated sequences."""
+    from ..v2 import networks as _nets
+
+    if return_seq:
+        return _nets.bidirectional_lstm(input, size, return_concat=True)
+    fwd, bwd = _nets.bidirectional_lstm(input, size, return_concat=False)
+    for v in (fwd, bwd):
+        if getattr(v, "seq_len", None) is None:
+            v.seq_len = getattr(input, "seq_len", None)
+    return L.concat([L.sequence_last_step(fwd),
+                     L.sequence_first_step(bwd)], axis=-1)
+
+
+def simple_gru(input, size, reverse=False, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.simple_gru(input, size, reverse=reverse)
+
+
+def bidirectional_gru(input, size, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.bidirectional_gru(input, size)
+
+
+def small_vgg(input_image, num_channels=None, num_classes=10, **kw):
+    from ..v2 import networks as _nets
+
+    img = _as_image(input_image, num_channels)
+    return _nets.small_vgg(img, num_classes=num_classes)
+
+
+def vgg_16_network(input_image, num_channels=None, num_classes=1000,
+                   **kw):
+    from ..v2 import networks as _nets
+
+    img = _as_image(input_image, num_channels)
+    return _nets.vgg_16_network(img, num_classes=num_classes)
+
+
+def text_conv_pool(input, context_len=5, hidden_size=128, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.text_conv_pool(input, context_len=context_len,
+                                hidden_size=hidden_size)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.sequence_conv_pool(input, context_len, hidden_size)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state, **kw):
+    from ..v2 import networks as _nets
+
+    return _nets.simple_attention(encoded_sequence, encoded_proj,
+                                  decoder_state)
+
+
+def sum_cost(input, **kw):
+    return v2l.sum_cost(input)
+
+
+def smooth_l1_cost(input, label, **kw):
+    return v2l.smooth_l1_cost(input, label)
+
+
+def huber_classification_cost(input, label, **kw):
+    return v2l.huber_classification_cost(input, label)
+
+
+def multi_binary_label_cross_entropy(input, label, **kw):
+    return v2l.multi_binary_label_cross_entropy(input, label)
+
+
+class _LayerMath:
+    """The ``layer_math`` namespace (reference trainer_config_helpers/
+    layer_math.py): unary math as layers. Binary arithmetic rides the
+    repo's Variable operator overloading (layers/math_op_patch.py), the
+    same contract the reference implements with LayerOutput operators."""
+
+    @staticmethod
+    def _unary(op_name):
+        def op(input, name=None, **kw):
+            from ..layers.layer_helper import LayerHelper
+
+            helper = LayerHelper(op_name)
+            return _group_register_name(
+                name, helper.simple_op(op_name, {"X": [input]}, {}))
+
+        op.__name__ = op_name
+        return op
+
+
+layer_math = _LayerMath()
+for _un in ("exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
+            "sqrt", "reciprocal"):
+    setattr(layer_math, _un, _LayerMath._unary(_un))
+del _un
 
 
 # ---------------------------------------------------------------------------
@@ -953,7 +1098,8 @@ def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
     return v2l.img_conv3d(input, filter_size, num_filters,
                           num_channels=num_channels, stride=stride,
                           padding=padding, groups=groups, act=act,
-                          param_attr=_pa(param_attr), bias_attr=bias_attr)
+                          param_attr=_pa(param_attr),
+                          bias_attr=_pa(bias_attr))
 
 
 def img_pool3d_layer(input, pool_size, stride=1, padding=0,
@@ -978,7 +1124,7 @@ def selective_fc_layer(input, select, size, act=None, param_attr=None,
                        bias_attr=None, **kw):
     return v2l.selective_fc(input, select, size, act=act,
                             param_attr=_pa(param_attr),
-                            bias_attr=bias_attr)
+                            bias_attr=_pa(bias_attr))
 
 
 def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
@@ -997,6 +1143,27 @@ def conv_projection(input, filter_size, num_filters, stride=1, padding=0,
     return v2l.conv_projection(input, filter_size, num_filters,
                                stride=stride, padding=padding,
                                groups=groups, param_attr=_pa(param_attr))
+
+
+def dotmul_operator(a=None, b=None, scale=1.0, **kw):
+    """dotmul_operator (reference layers.py DotMulOperator): the
+    elementwise product of TWO layer outputs, scale-weighted, usable
+    inside mixed_layer."""
+    class _DotMulOp(v2l.BaseProjection):
+        def __init__(self, x, y, scale):
+            super().__init__(x)
+            self.y = y
+            self.scale = scale
+
+        def build(self, size):
+            out = L.elementwise_mul(self.input, self.y)
+            if self.scale != 1.0:
+                out = L.scale(out, self.scale)
+            return out
+
+    x = a if a is not None else kw.get("x")
+    y = b if b is not None else kw.get("y")
+    return _DotMulOp(x, y, float(scale))
 
 
 def conv_operator(img=None, filter=None, **kw):

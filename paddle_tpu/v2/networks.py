@@ -35,7 +35,11 @@ def bidirectional_lstm(input, size, return_concat=True, **kw):
     fwd = simple_lstm(input, size)
     bwd = simple_lstm(input, size, reverse=True)
     if return_concat:
-        return L.concat([fwd, bwd], axis=-1)
+        out = L.concat([fwd, bwd], axis=-1)
+        sl = getattr(input, "seq_len", None)
+        if sl is not None:
+            out.seq_len = sl  # concat keeps [b, T, .] — the mask survives
+        return out
     return fwd, bwd
 
 
@@ -145,7 +149,11 @@ def bidirectional_gru(input, size, return_concat=True, **kw):
     fwd = simple_gru(input, size)
     bwd = simple_gru(input, size, reverse=True)
     if return_concat:
-        return L.concat([fwd, bwd], axis=-1)
+        out = L.concat([fwd, bwd], axis=-1)
+        sl = getattr(input, "seq_len", None)
+        if sl is not None:
+            out.seq_len = sl
+        return out
     return fwd, bwd
 
 

@@ -463,3 +463,56 @@ def test_settings_lazy_defaults_and_method_strings(tmp_path):
         H.resolve_learning_method("nesterov_lookahead")
     # names registered by ANY shim resolve through Outputs
     assert "probs" in parsed.main_program.global_block.vars or True
+
+
+@needs_ref
+def test_every_reference_config_parses_as_is(monkeypatch, tmp_path):
+    """The complete v1_api_demo config sweep: every trainer config in
+    the reference tree evaluates AS-IS (py3 + shim namespace). Providers
+    that are py2-only or absent degrade to dense-typed feeds; the graphs
+    still build."""
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "dict.txt").write_text("good\t0\nbad\t1\n")
+    (data / "train.list").write_text("data/t0\n")
+    (data / "test.list").write_text("data/t0\n")
+    (data / "t0").write_text("")
+    monkeypatch.chdir(tmp_path)
+    sweep = [
+        ("quick_start/trainer_config.lr.py", ""),
+        ("quick_start/trainer_config.cnn.py", ""),
+        ("quick_start/trainer_config.emb.py", ""),
+        ("quick_start/trainer_config.lstm.py", ""),
+        ("quick_start/trainer_config.bidi-lstm.py", ""),
+        ("quick_start/trainer_config.db-lstm.py", ""),
+        ("quick_start/trainer_config.resnet-lstm.py", ""),
+        ("mnist/light_mnist.py", "is_predict=1"),
+        ("mnist/vgg_16_mnist.py", "is_predict=1"),
+        ("sequence_tagging/linear_crf.py", ""),
+        ("sequence_tagging/rnn_crf.py", ""),
+        ("model_zoo/resnet/resnet.py",
+         "is_predict=1,layer_num=50,data_provider=0"),
+        ("traffic_prediction/trainer_config.py", ""),
+        ("gan/gan_conf.py", "generating=0,training=0"),
+        ("gan/gan_conf_image.py", "generating=0,training=0,"
+                                  "dataSource=mnist"),
+        ("vae/vae_conf.py", ""),
+    ]
+    # the sequence_tagging provider is py2-only; its configs need the
+    # py3 stand-in (same positional input_types) to type the CRF labels
+    (tmp_path / "dataprovider.py").write_text(CRF_STANDIN_PROVIDER)
+    import importlib.util
+
+    v1.parse_config.__globals__["_install_shims"]()
+    spec = importlib.util.spec_from_file_location(
+        "dataprovider", tmp_path / "dataprovider.py")
+    standin = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(standin)
+    for rel, args in sweep:
+        for mod in ("dataprovider", "dataprovider_bow", "dataprovider_emb",
+                    "mnist_provider", "mnist_util"):
+            sys.modules.pop(mod, None)
+        if "sequence_tagging" in rel:
+            monkeypatch.setitem(sys.modules, "dataprovider", standin)
+        parsed = v1.parse_config(f"{REF}/{rel}", args)
+        assert parsed.main_program.global_block.ops, rel
