@@ -877,3 +877,26 @@ def conv_operator(*a, **kw):
     raise NotImplementedError(
         "conv_operator (dynamic data-dependent conv filters) is not "
         "supported; use conv_projection")
+
+
+# ---------------------------------------------------------------------------
+# the step-level recurrent DSL, re-exported (reference v2/layer.py carries
+# recurrent_group/memory/StaticInput from trainer_config_helpers into the
+# v2 namespace). The machinery lives in v1/helpers.py and needs no parse
+# context — it builds directly on StaticRNN.
+# ---------------------------------------------------------------------------
+
+_DSL_REEXPORTS = ("recurrent_group", "memory", "StaticInput",
+                  "GeneratedInput", "gru_step_layer", "lstm_step_layer")
+
+
+def __getattr__(name):
+    if name in _DSL_REEXPORTS:
+        from ..v1 import helpers as _h
+
+        return getattr(_h, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_DSL_REEXPORTS))

@@ -178,3 +178,30 @@ def test_memory_outside_group_raises():
 
     with pytest.raises(RuntimeError, match="recurrent_group"):
         H.memory(name="x", size=4)
+
+
+def test_v2_namespace_carries_the_group_dsl_without_parse_context():
+    """The reference v2 API re-exports recurrent_group/memory/StaticInput
+    (v2/layer.py __all__); ours serves them from the v2 facade with NO
+    v1 parse context — they build directly on StaticRNN."""
+    from paddle_tpu.v2 import layer as l2
+    from paddle_tpu.v1 import helpers as H
+
+    assert H._CTX is None  # genuinely context-free
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[4, 3])
+
+        def step(x_t):
+            mem = l2.memory(name="s", size=3)
+            return H.addto_layer([x_t, mem], name="s")
+
+        out = l2.recurrent_group(step=step, input=x)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    o, = exe.run(main, feed={"x": np.ones((1, 4, 3), np.float32)},
+                 fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(o)[0, -1], [4.0, 4.0, 4.0],
+                               rtol=1e-6)
+    assert l2.StaticInput is H.StaticInput

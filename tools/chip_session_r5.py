@@ -232,6 +232,27 @@ def main():
         lambda: cs.transformer_lm_step(jax, pt, layers, models, bench,
                                        peak),
         seconds=700)
+
+    # 6. Flash-attention block-size sweep at d1024 H8 (PERF.md: the
+    #    d1024 residual gap is partly the flash kernel's in-kernel
+    #    softmax VPU work — bigger K blocks amortize it; the sweep says
+    #    whether the 512x512 default leaves MFU on the table).
+    def lm_blocks(bq, bk):
+        from paddle_tpu.kernels import flash_attention as fa
+
+        prev = (fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
+        fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = bq, bk
+        try:
+            return cs.transformer_lm_step(
+                jax, pt, layers, models, bench, peak,
+                extra={"block_q": bq, "block_k": bk})
+        finally:
+            fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = prev
+
+    for bq, bk in ((256, 512), (512, 1024), (1024, 512), (1024, 1024)):
+        cs.experiment(f"lm_d1024_blocks_q{bq}_k{bk}",
+                      lambda bq=bq, bk=bk: lm_blocks(bq, bk),
+                      seconds=600)
     return 0
 
 
