@@ -715,6 +715,71 @@ bool k_batch_norm(Machine& m, const OpDesc& op) {
   return true;
 }
 
+bool k_conv1x1_bn_act(Machine& m, const OpDesc& op) {
+  // Fused NHWC 1x1-conv + BN + act (+ residual) — ops/fusion_ops.py.
+  // Serving form: fold (scale, bias, mean, var) into the elementwise
+  // affine k = scale*rsqrt(var+eps), b = bias - mean*k, then
+  // y = act((x . W) * k + b [+ residual]).
+  Tensor *x, *w, *scale, *bias, *mean, *var;
+  if (!need(m, op, "X", &x) || !need(m, op, "Filter", &w) ||
+      !need(m, op, "Scale", &scale) || !need(m, op, "Bias", &bias) ||
+      !need(m, op, "Mean", &mean) || !need(m, op, "Variance", &var))
+    return false;
+  Tensor* res = nullptr;
+  auto rit = op.ins.find("Residual");
+  if (rit != op.ins.end() && !rit->second.empty()) {
+    auto e = m.env.find(rit->second[0]);
+    if (e == m.env.end()) {
+      m.error = "conv1x1_bn_act: residual input missing";
+      return false;
+    }
+    res = &e->second;
+  }
+  if (x->shape.size() != 4) {
+    m.error = "conv1x1_bn_act: X must be NHWC 4-D";
+    return false;
+  }
+  int64_t N = x->shape[0], H = x->shape[1], W = x->shape[2],
+          I = x->shape[3];
+  int64_t O = w->shape[w->shape.size() - 1];
+  if (w->numel() != I * O) {
+    m.error = "conv1x1_bn_act: filter is not [1,1,I,O]";
+    return false;
+  }
+  bool relu = op.attr_str("act", "") == std::string("relu");
+  double eps = op.attr_num("epsilon", 1e-5);
+  std::vector<float> kf(static_cast<size_t>(O)), bf(static_cast<size_t>(O));
+  for (int64_t c = 0; c < O; ++c) {
+    float inv = 1.0f / std::sqrt(var->data[static_cast<size_t>(c)] +
+                                 static_cast<float>(eps));
+    kf[static_cast<size_t>(c)] = scale->data[static_cast<size_t>(c)] * inv;
+    bf[static_cast<size_t>(c)] =
+        bias->data[static_cast<size_t>(c)] -
+        mean->data[static_cast<size_t>(c)] * kf[static_cast<size_t>(c)];
+  }
+  Tensor& o = set_out(m, op, "Y");
+  o.shape = {N, H, W, O};
+  o.data.assign(static_cast<size_t>(N * H * W * O), 0.f);
+  int64_t R = N * H * W;
+  for (int64_t r = 0; r < R; ++r) {
+    const float* xr = x->data.data() + r * I;
+    float* orow = o.data.data() + r * O;
+    for (int64_t i = 0; i < I; ++i) {
+      float a = xr[i];
+      if (a == 0.f) continue;
+      const float* wrow = w->data.data() + i * O;
+      for (int64_t c = 0; c < O; ++c) orow[c] += a * wrow[c];
+    }
+    for (int64_t c = 0; c < O; ++c) {
+      float y = orow[c] * kf[static_cast<size_t>(c)] +
+                bf[static_cast<size_t>(c)];
+      if (res) y += res->data[static_cast<size_t>(r * O + c)];
+      orow[c] = relu ? std::max(y, 0.f) : y;
+    }
+  }
+  return true;
+}
+
 bool k_reshape(Machine& m, const OpDesc& op) {
   Tensor* x;
   if (!need(m, op, "X", &x)) return false;
@@ -1408,6 +1473,7 @@ bool run_op(Machine& m, const OpDesc& op) {
   if (t == "conv2d") return k_conv2d(m, op);
   if (t == "pool2d") return k_pool2d(m, op);
   if (t == "batch_norm") return k_batch_norm(m, op);
+  if (t == "conv1x1_bn_act") return k_conv1x1_bn_act(m, op);
   if (t == "reshape") return k_reshape(m, op);
   if (t == "concat") return k_concat(m, op);
   if (t == "scale") return k_scale(m, op);

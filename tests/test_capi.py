@@ -579,3 +579,53 @@ class TestCapiMalformedModels:
             with pytest.raises(ValueError, match="probabilities"):
                 machine.generate(prompt, max_new_tokens=1, seq_len=4,
                                  temperature=1.0, seed=0)
+
+
+class TestCapiFusedEpilogue:
+    def test_fused_conv_model_serves_through_c_machine(self, tmp_path):
+        """A model saved with the fused conv1x1_bn_act op (trained BN
+        stats + residual + relu) must serve through the C machine within
+        tolerance of the executor."""
+        pt.flags.FLAGS.fused_conv_epilogue = True
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[4, 4, 6])
+                y = layers.conv1x1_bn_act(
+                    x, 8, act="relu",
+                    residual=layers.conv1x1_bn_act(x, 8, act=None))
+                pooled = layers.pool2d(y, pool_size=4, pool_stride=4,
+                                       data_format="NHWC")
+                logits = layers.fc(
+                    layers.reshape(pooled, shape=[-1, 8]), size=3)
+                loss = layers.mean(logits * logits)
+                pt.optimizer.SGDOptimizer(learning_rate=0.05).minimize(
+                    loss, startup_program=startup)
+        finally:
+            pt.flags.FLAGS.fused_conv_epilogue = False
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        # a few train steps so BN running stats are non-trivial
+        for _ in range(5):
+            exe.run(main, feed={"x": rng.randn(8, 4, 4, 6)
+                                .astype("float32")},
+                    fetch_list=[loss], scope=scope)
+        d = str(tmp_path / "model")
+        pt.io.save_inference_model(d, ["x"], [logits], exe,
+                                   main_program=main, scope=scope)
+        xv = rng.randn(3, 4, 4, 6).astype("float32")
+        # the saved (pruned, is_test-flipped) program through the
+        # python executor is the reference
+        s2 = pt.Scope()
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe,
+                                                          scope=s2)
+        ref, = exe.run(prog, feed={"x": xv}, fetch_list=fetches,
+                       scope=s2)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            got, = machine.run({"x": xv})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-4)
