@@ -746,6 +746,17 @@ bool k_conv1x1_bn_act(Machine& m, const OpDesc& op) {
     m.error = "conv1x1_bn_act: filter is not [1,1,I,O]";
     return false;
   }
+  if (scale->numel() < O || bias->numel() < O || mean->numel() < O ||
+      var->numel() < O) {
+    m.error = "conv1x1_bn_act: BN vectors smaller than O=" +
+              std::to_string(O);
+    return false;
+  }
+  if (res && res->numel() != N * H * W * O) {
+    m.error = "conv1x1_bn_act: residual numel " +
+              std::to_string(res->numel()) + " != N*H*W*O";
+    return false;
+  }
   bool relu = op.attr_str("act", "") == std::string("relu");
   double eps = op.attr_num("epsilon", 1e-5);
   std::vector<float> kf(static_cast<size_t>(O)), bf(static_cast<size_t>(O));
