@@ -119,6 +119,36 @@ def lambda_cost(attrs, ins):
     return out(Out=cost.reshape(b, 1))
 
 
+@register_op("sub_nested_seq")
+def sub_nested_seq(attrs, ins):
+    """Select sub-sequences from a nested sequence (reference
+    SubNestedSequenceLayer.cpp). Dense form: X [b, S, T, d] (the
+    lod_level=2 plane), Indices [b, K] sub-sequence ids per row ->
+    Out [b, K, T, d] (gather along the sub-sequence axis; negative
+    ids select nothing and zero the slot)."""
+    x = single(ins, "X")
+    idx = single(ins, "Indices").astype(jnp.int32)
+    b, S = x.shape[0], x.shape[1]
+    k = idx.shape[1]
+    safe = jnp.clip(idx, 0, S - 1)
+    expand = safe.reshape(b, k, *([1] * (x.ndim - 2)))
+    gathered = jnp.take_along_axis(
+        x, jnp.broadcast_to(expand, (b, k) + x.shape[2:]), axis=1)
+    valid = (idx >= 0).reshape(b, k, *([1] * (x.ndim - 2)))
+    return out(Out=gathered * valid.astype(x.dtype))
+
+
+@register_op("tensor_product")
+def tensor_product(attrs, ins):
+    """Bilinear tensor product (reference gserver TensorLayer.cpp):
+    out[b, i] = a[b] @ W[i] @ b[b]^T, W [size, da, db] — one einsum,
+    MXU-shaped."""
+    a = single(ins, "A")
+    b2 = single(ins, "B")
+    w = single(ins, "Weight")
+    return out(Out=jnp.einsum("bm,imn,bn->bi", a, w, b2))
+
+
 @register_op("cross_entropy_with_selfnorm")
 def cross_entropy_with_selfnorm(attrs, ins):
     """CE over softmax OUTPUT probs plus the self-normalization penalty

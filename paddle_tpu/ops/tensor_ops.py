@@ -191,7 +191,10 @@ def crop(attrs, ins):
     x = single(ins, "X")
     offsets = attrs["offsets"]
     shape = attrs["shape"]
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 in the target shape = keep the input's full extent on that axis
+    # (the dynamic-batch dim in particular)
+    idx = tuple(slice(o, None if s == -1 else o + s)
+                for o, s in zip(offsets, shape))
     return out(Out=x[idx])
 
 

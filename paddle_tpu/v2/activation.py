@@ -28,6 +28,9 @@ Log = _make("Log", "log")
 Abs = _make("Abs", "abs")
 Square = _make("Square", "square")
 SequenceSoftmax = _make("SequenceSoftmax", "softmax")
+Sqrt = _make("Sqrt", "sqrt")
+Reciprocal = _make("Reciprocal", "reciprocal")
+SoftSign = _make("SoftSign", "softsign")
 
 
 def resolve(act):
