@@ -1,7 +1,7 @@
 """User-facing layer functions (fluid layers package parity)."""
 from .io import data
-from .nn import (accuracy, batch_norm, chunk_eval, conv1x1_bn_act,
-                 conv2d, crf_decoding,
+from .nn import (accuracy, batch_norm, chunk_eval, clip, conv1x1_bn_act,
+                 conv2d, conv2d_transpose, cos_sim, crf_decoding, mul,
                  cross_entropy, dropout, embedding, fc,
                  fused_head_cross_entropy, layer_norm,
                  linear_chain_crf, lrn, pool2d, rms_norm,
@@ -9,8 +9,10 @@ from .nn import (accuracy, batch_norm, chunk_eval, conv1x1_bn_act,
                  softmax_with_cross_entropy, topk)
 from .attention import (multi_head_attention, pipelined_transformer_stack,
                         switch_moe, transformer_encoder_layer)
-from .control_flow import (StaticRNN, While, array_read, array_write,
-                           beam_search_decoder, create_array, increment)
+from .control_flow import (DynamicRNN, StaticRNN, While, array_length,
+                           array_read, array_write, beam_search_decoder,
+                           create_array, increment)
+from .control_flow import beam_search_decode
 from .ops import *  # noqa: F401,F403  (auto-generated unary/binary wrappers)
 from .ops import __all__ as _ops_all
 from .sequence import (ctc_greedy_decoder, dynamic_gru, dynamic_lstm,
@@ -28,7 +30,8 @@ from .legacy import (addto, dot_prod, factorization_machine, gated_unit,
                      sequence_reshape, slope_intercept, sum_to_one_norm)
 from . import math_op_patch  # noqa: F401 - patches +,-,*,/ onto Variable
 from .tensor import (argmax, assign, cast, concat, create_global_var,
-                     fill_constant, fill_constant_batch_size_like,
+                     create_tensor, fill_constant,
+                     fill_constant_batch_size_like, ones, zeros,
                      gaussian_random_batch_size_like, matmul,
                      mean, one_hot, reduce_max, reduce_mean, reduce_min,
                      reduce_sum, reshape, scale, split, sums, transpose)
@@ -51,8 +54,10 @@ __all__ = (
      "sequence_conv", "sequence_concat", "row_conv",
      "dynamic_lstm", "dynamic_gru", "simple_rnn", "lstm_unit", "gru_unit",
      "warpctc", "ctc_greedy_decoder",
-     "StaticRNN", "While", "create_array", "array_write", "array_read",
-     "increment", "beam_search_decoder",
+     "StaticRNN", "DynamicRNN", "While", "create_array", "array_write",
+     "array_read", "array_length", "increment", "beam_search_decoder",
+     "beam_search_decode", "cos_sim", "mul", "clip", "conv2d_transpose",
+     "create_tensor", "ones", "zeros",
      "multi_head_attention", "transformer_encoder_layer", "switch_moe",
      "pipelined_transformer_stack",
      "interpolation", "scaling", "power", "slope_intercept", "addto",

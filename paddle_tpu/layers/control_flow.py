@@ -71,7 +71,7 @@ class StaticRNN:
         self.mem_vars: List[Variable] = []
         self.mem_out: Dict[str, Optional[str]] = {}
         self.out_vars: List[Variable] = []
-        self.block = None
+        self.step_block = None
         self._len_var = None
 
     # -- context ----------------------------------------------------------
@@ -81,7 +81,7 @@ class StaticRNN:
 
         def __enter__(self):
             prog = self.rnn.helper.main_program
-            self.rnn.block = prog.create_block()
+            self.rnn.step_block = prog.create_block()
             return self.rnn
 
         def __exit__(self, exc_type, *a):
@@ -101,7 +101,7 @@ class StaticRNN:
         if self._len_var is None:
             self._len_var = get_seq_len(seq)
         shape = (seq.shape[0],) + tuple(seq.shape[2:])
-        xt = self.block.create_var(
+        xt = self.step_block.create_var(
             name=prog.unique_name("static_rnn.x"), shape=shape,
             dtype=seq.dtype)
         self.seq_vars.append(seq)
@@ -111,7 +111,7 @@ class StaticRNN:
     def memory(self, init: Variable) -> Variable:
         """Loop-carried state seeded from ``init`` ([b, ...])."""
         prog = self.helper.main_program
-        mem = self.block.create_var(
+        mem = self.step_block.create_var(
             name=prog.unique_name("static_rnn.mem"), shape=init.shape,
             dtype=init.dtype)
         self.mem_init.append(init)
@@ -133,7 +133,7 @@ class StaticRNN:
             if tgt is None:
                 raise ValueError(f"memory {m!r} was never update_memory()'d")
         bound = [v.name for v in self.x_vars] + [v.name for v in self.mem_vars]
-        body_ops, params = _collect_body(self.block, bound)
+        body_ops, params = _collect_body(self.step_block, bound)
         ins = {
             "X": self.seq_vars,
             "MemInit": self.mem_init,
@@ -393,3 +393,64 @@ def beam_search_decoder(init_state, embedding_param, cell_params, out_params,
         {"beam_size": beam_size, "max_len": max_len, "bos_id": bos_id,
          "eos_id": eos_id, "cell": cell})
     return outs["Ids"][0], outs["SeqScores"][0], outs["SeqLen"][0]
+
+
+def array_length(array, main_program=None, startup_program=None):
+    """Length of a functional LoDTensorArray (fluid control_flow.py
+    array_length): the [max_len, ...] buffer's leading extent, as a
+    [1] int64 constant."""
+    from . import tensor as tensor_layers
+
+    return tensor_layers.fill_constant(
+        shape=[1], value=int(array.shape[0]), dtype="int64",
+        main_program=main_program, startup_program=startup_program)
+
+
+class DynamicRNN(StaticRNN):
+    """fluid DynamicRNN (control_flow.py DynamicRNN): user-defined
+    recurrence over VARIABLE-length sequences. The reference sorts rows
+    by length through a lod_rank_table and shrinks the batch as
+    sequences end (recurrent_op StepScopes); the dense+mask plane makes
+    that machinery unnecessary — this is StaticRNN whose scan carries
+    each row's state through unchanged past its length (the static_rnn
+    op masks on Length), so dynamic == static + mask, one lax.scan.
+
+    API differences served: ``block()`` (the fluid name for the step
+    context) and ``memory(init=... | shape/value zeros-boot)``.
+    """
+
+    def block(self):
+        return self.step()
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               **kw):
+        if init is None:
+            if not self.seq_vars:
+                raise ValueError(
+                    "DynamicRNN.memory(shape=...) needs a step_input "
+                    "first (the zeros boot sizes its batch from it)")
+            from . import tensor as tensor_layers
+
+            prog = self.helper.main_program
+            cur = prog.current_block_idx
+            prog.current_block_idx = prog.blocks[cur].parent_idx
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    input=self.seq_vars[0],
+                    shape=[-1] + list(shape or []), value=value,
+                    dtype=dtype)
+            finally:
+                prog.current_block_idx = cur
+        return super().memory(init)
+
+
+def beam_search_decode(ids, scores, main_program=None,
+                       startup_program=None):
+    """fluid's beam_search_decode converts the While-loop beam arrays
+    (LoDTensorArray ids/scores) into final sequences — machinery the
+    fused in-graph decoder makes unnecessary."""
+    raise NotImplementedError(
+        "beam_search_decode (array-to-tensor conversion for the "
+        "While-loop beam) is served by the fused in-graph decoders, "
+        "which return finished sequences directly: "
+        "layers.beam_search_decoder / models.transformer_lm_beam_search")

@@ -469,3 +469,58 @@ def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1,
     return (outs["Precision"][0], outs["Recall"][0], outs["F1-Score"][0],
             outs["NumInferChunks"][0], outs["NumLabelChunks"][0],
             outs["NumCorrectChunks"][0])
+
+
+def cos_sim(X, Y, main_program=None, startup_program=None):
+    """Cosine similarity rows of X vs Y (fluid nn.py cos_sim /
+    cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("cos_sim", {"X": [X], "Y": [Y]}, {})
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, main_program=None,
+        startup_program=None):
+    """The raw mul op as a layer (fluid ops.py mul)."""
+    helper = LayerHelper("mul", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("mul", {"X": [x], "Y": [y]},
+                            {"x_num_col_dims": x_num_col_dims,
+                             "y_num_col_dims": y_num_col_dims})
+
+
+def clip(x, min, max, main_program=None, startup_program=None):  # noqa: A002
+    """Elementwise clamp (fluid ops.py clip / clip_op.cc)."""
+    helper = LayerHelper("clip", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.simple_op("clip", {"X": [x]},
+                            {"min": float(min), "max": float(max)})
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", main_program=None,
+                     startup_program=None):
+    """Transposed convolution (fluid nn.py conv2d_transpose /
+    conv2d_transpose_op.cc)."""
+    helper = LayerHelper("conv2d_transpose", main_program=main_program,
+                         startup_program=startup_program)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    channel_axis = 1 if data_format == "NCHW" else 3
+    cin = input.shape[channel_axis]
+    # filter layout per the op contract: IOHW (NCHW) / HWIO (NHWC)
+    shape = ([cin, num_filters] + list(filter_size)
+             if data_format == "NCHW"
+             else list(filter_size) + [cin, num_filters])
+    w = helper.create_parameter(
+        param_attr, shape=shape, dtype=input.dtype,
+        default_initializer=NormalInitializer(
+            0.0, (2.0 / (cin * filter_size[0] * filter_size[1])) ** 0.5))
+    o = helper.simple_op(
+        "conv2d_transpose", {"Input": [input], "Filter": [w]},
+        {"strides": stride, "paddings": padding,
+         "data_format": data_format}, out_slot="Output")
+    o = helper.append_bias_op(o, bias_attr, num_filters,
+                              dim_start=channel_axis)
+    return helper.append_activation(o, act)

@@ -152,3 +152,31 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
         "matmul", {"X": [x], "Y": [y]},
         {"transpose_X": transpose_x, "transpose_Y": transpose_y,
          "alpha": alpha})
+
+
+def create_tensor(dtype="float32", name=None, main_program=None,
+                  startup_program=None):
+    """fluid tensor.py create_tensor: an empty named variable to assign
+    into (While loop counters etc.)."""
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("create_tensor", main_program=main_program,
+                         startup_program=startup_program)
+    return helper.block.create_var(
+        name=name or helper.main_program.unique_name("tensor"),
+        shape=[1], dtype=dtype)
+
+
+def ones(shape, dtype="float32", main_program=None, startup_program=None):
+    """fluid tensor.py ones."""
+    return fill_constant(shape=shape, value=1.0, dtype=dtype,
+                         main_program=main_program,
+                         startup_program=startup_program)
+
+
+def zeros(shape, dtype="float32", main_program=None,
+          startup_program=None):
+    """fluid tensor.py zeros."""
+    return fill_constant(shape=shape, value=0.0, dtype=dtype,
+                         main_program=main_program,
+                         startup_program=startup_program)

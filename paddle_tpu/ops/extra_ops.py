@@ -505,11 +505,11 @@ def detection_output(attrs, ins):
         rows.append(jnp.concatenate(
             [cls_col[..., None], score_out[..., None], sel], axis=-1))
     packed = jnp.concatenate(rows, axis=1)
-    # cross-class cap (reference keep_top_k): keep the global top-K
-    # surviving detections per image, the rest marked score -1
+    # cross-class cap (reference keep_top_k): the output TRUNCATES to
+    # the global top-K rows by score per image
     keep_top = int(attrs.get("keep_top_k", -1))
     if 0 < keep_top < packed.shape[1]:
-        top_s, top_i = jax.lax.top_k(packed[:, :, 1], keep_top)
+        _, top_i = jax.lax.top_k(packed[:, :, 1], keep_top)
         packed = jnp.take_along_axis(packed, top_i[..., None], axis=1)
     return out(Out=packed)
 

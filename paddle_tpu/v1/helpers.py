@@ -1511,8 +1511,14 @@ def crop_layer(input, offset, axis=2, shape=None, name=None, **kw):
                                         for d in tgt]}
     return _group_register_name(
         name, helper.simple_op("crop", {"X": [input]}, attrs))
-clip_layer = _simple_op_shim(
-    "clip", doc="clip_layer: min/max clamp (reference ClipLayer.cpp)")
+def clip_layer(input, min, max, name=None, **kw):  # noqa: A002
+    """clip_layer (reference layers.py signature (input, min, max)):
+    elementwise clamp over the clip op (ClipLayer.cpp)."""
+    from ..layers.layer_helper import LayerHelper
+
+    helper = LayerHelper("clip")
+    return _group_register_name(name, helper.simple_op(
+        "clip", {"X": [input]}, {"min": float(min), "max": float(max)}))
 
 
 def spp_layer(input, pyramid_height=3, pool_type=None, name=None, **kw):

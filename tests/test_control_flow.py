@@ -548,3 +548,78 @@ class TestWhileBoundInference:
                 layers.assign(layers.less_than(i, n), output=cond)
         w_ops = [op for op in main.global_block.ops if op.type == "while"]
         assert w_ops and w_ops[0].attrs["max_iters"] is None
+
+
+def test_dynamic_rnn_masks_variable_lengths():
+    """DynamicRNN (fluid control_flow.py): running-sum recurrence over a
+    variable-length batch — state freezes past each row's length (the
+    dense+mask replacement for the reference's rank-table batch
+    shrinking)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        seq = layers.data("seq", shape=[3], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(seq)
+            mem = drnn.memory(shape=[3])
+            acc = layers.sums([x_t, mem])
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 4, 3).astype("float32")
+    lens = np.array([4, 2], "int32")
+    o, = exe.run(main, feed={"seq": xv, "seq@len": lens},
+                 fetch_list=[out], scope=scope)
+    o = np.asarray(o)
+    np.testing.assert_allclose(o[0, -1], xv[0].sum(0), rtol=1e-5)
+    # row 1 finished at t=2: outputs past the length are masked to 0
+    np.testing.assert_allclose(o[1, 1], xv[1, :2].sum(0), rtol=1e-5)
+    assert np.abs(o[1, 2:]).max() == 0
+
+
+def test_fluid_namespace_parity_with_reference_layers():
+    """Structural diff against the reference fluid layers __all__
+    (nn/control_flow/tensor/ops): every name the reference exports that
+    maps onto this design exists; the deliberate absences are exactly
+    the LoD-array machinery the dense+mask plane replaces."""
+    import os
+    import re
+
+    import pytest
+
+    from paddle_tpu import layers as L
+
+    base = "/root/reference/python/paddle/v2/fluid/layers"
+    if not os.path.isdir(base):
+        pytest.skip("reference tree not present")
+    # the LoD pointer machinery is REPLACED by dense+mask (SURVEY §5.7):
+    # rank tables, array<->lod conversion, batch shrinking, and the
+    # block-guard internals of the python-side IR builder
+    replaced = {
+        "split_lod_tensor", "merge_lod_tensor", "BlockGuard",
+        "BlockGuardWithCompletion", "StaticRNNMemoryLink", "WhileGuard",
+        "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+        "array_to_lod_tensor", "shrink_memory", "IfElse",
+        "ConditionalBlock", "reorder_lod_tensor_by_rank", "ParallelDo",
+    }
+    missing = {}
+    for mod in ("nn", "control_flow", "tensor", "ops"):
+        src = open(f"{base}/{mod}.py").read()
+        m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+        if not m:
+            continue
+        names = re.findall(r"[\"']([A-Za-z_0-9]+)[\"']", m.group(1))
+        miss = [n for n in names
+                if not hasattr(L, n) and n not in replaced]
+        if miss:
+            missing[mod] = miss
+    assert not missing, missing
