@@ -1,11 +1,19 @@
 """Oxford 102 Flowers (reference v2/dataset/flowers.py API).
 
 ``train()``/``test()``/``valid()`` yield ``(image, label)`` with image flat
-float32[3*224*224] CHW — the reference's default_mapper output. Synthetic
-fallback: 102 colour-field prototypes at lower internal resolution upsampled
-to 224, keeping per-sample cost reasonable.
+float32[3*224*224] CHW — the reference's default_mapper output. When the
+real corpus is present in the cache dir (``102flowers.tgz`` +
+``imagelabels.mat`` + ``setid.mat``) it is parsed with the reference's
+rules (1-based .mat labels; the tstid/trnid TRAIN/TEST swap the
+reference documents at flowers.py:50-54; short-side-256 resize +
+center crop 224 + mean subtraction) via PIL/scipy — deterministic (no
+random aug). Otherwise a synthetic fallback: 102 colour-field
+prototypes upsampled to 224.
 """
 from __future__ import annotations
+
+import os
+import tarfile
 
 import numpy as np
 
@@ -43,13 +51,76 @@ def _reader(n, seed_name):
     return reader
 
 
+_MEAN = np.array([103.94, 116.78, 123.68], np.float32)
+
+
+def _real_dir():
+    d = os.path.join(common.DATA_HOME, "flowers")
+    need = ("102flowers.tgz", "imagelabels.mat", "setid.mat")
+    if all(os.path.exists(os.path.join(d, n)) for n in need):
+        return d
+    return None
+
+
+def _decode(raw):
+    """The reference default_mapper, deterministically: short side 256,
+    center crop 224, BGR CHW float32 minus the channel means (the
+    reference loads via cv2, so its channel order and its
+    [103.94, 116.78, 123.68] means are BGR — image.py
+    simple_transform)."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    w, h = img.size
+    scale = 256.0 / min(w, h)
+    img = img.resize((max(224, int(w * scale)),
+                      max(224, int(h * scale))))
+    w, h = img.size
+    left, top = (w - SIZE) // 2, (h - SIZE) // 2
+    img = img.crop((left, top, left + SIZE, top + SIZE))
+    arr = np.asarray(img, np.float32)[:, :, ::-1]  # HWC RGB -> BGR
+    arr = arr - _MEAN[None, None, :]
+    return arr.transpose(2, 0, 1).reshape(-1)
+
+
+def _real_reader(flag):
+    def reader():
+        import scipy.io as scio
+
+        d = _real_dir()
+        labels = scio.loadmat(
+            os.path.join(d, "imagelabels.mat"))["labels"][0]
+        indexes = scio.loadmat(os.path.join(d, "setid.mat"))[flag][0]
+        wanted = {f"jpg/image_{i:05d}.jpg": int(labels[i - 1])
+                  for i in indexes}
+        with tarfile.open(os.path.join(d, "102flowers.tgz")) as tf:
+            m = tf.next()
+            while m is not None:
+                if m.name in wanted:
+                    raw = tf.extractfile(m).read()
+                    yield (_decode(raw),
+                           wanted[m.name] - 1)  # 1-based -> 0-based
+                m = tf.next()
+
+    return reader
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=False):
+    if _real_dir():
+        # the reference's documented swap: tstid flags the TRAIN split
+        return _real_reader("tstid")
     return _reader(TRAIN_SIZE, "flowers-train")
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
+    if _real_dir():
+        return _real_reader("trnid")
     return _reader(TEST_SIZE, "flowers-test")
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    if _real_dir():
+        return _real_reader("valid")
     return _reader(TEST_SIZE, "flowers-valid")

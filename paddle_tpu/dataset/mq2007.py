@@ -2,10 +2,16 @@
 
 ``train_reader(format=...)``/``test_reader`` with formats "pointwise"
 (feature, relevance), "pairwise" ((f_hi, f_lo) preference pairs) and
-"listwise" (query group lists) — mq2007.py Query/QueryList. Synthetic
-fallback: relevance is a noisy linear function of the 46-dim feature vector.
+"listwise" (query group lists) — mq2007.py Query/QueryList. When the
+real LETOR files are present in the cache dir (``train.txt`` /
+``test.txt``, lines "rel qid:n 1:v 2:v ... #docid = ..." —
+mq2007.py:96) they are parsed and grouped by qid; otherwise a
+synthetic fallback whose relevance is a noisy linear function of the
+46-dim feature vector.
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -62,9 +68,78 @@ def _reader(n_queries, seed_name, format):
             "listwise": listwise}[format]
 
 
+def _real_path(split):
+    p = os.path.join(common.DATA_HOME, "MQ2007", f"{split}.txt")
+    return p if os.path.exists(p) else None
+
+
+def _parse_letor(path):
+    """LETOR line format (reference mq2007.py Query.__init__ /
+    _parse_one_line): "rel qid:n 1:v ... 46:v #docid = ..." grouped by
+    qid in file order."""
+    groups = {}
+    order = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = int(parts[0])
+            qid = parts[1].split(":")[1]
+            feats = np.zeros(FEATURE_DIM, np.float32)
+            for pair in parts[2:]:
+                k, _, v = pair.partition(":")
+                idx = int(k) - 1
+                if 0 <= idx < FEATURE_DIM:
+                    feats[idx] = float(v)
+            if qid not in groups:
+                groups[qid] = []
+                order.append(qid)
+            groups[qid].append((feats, rel))
+    for qid in order:
+        rows = groups[qid]
+        yield (np.stack([f for f, _ in rows]),
+               np.array([r for _, r in rows], np.int64))
+
+
+def _real_queries(split):
+    def gen():
+        yield from _parse_letor(_real_path(split))
+
+    return gen
+
+
+def _real_format_reader(split, format):
+    queries = _real_queries(split)
+
+    def pointwise():
+        for feats, rel in queries():
+            for f, r in zip(feats, rel):
+                yield f, int(r)
+
+    def pairwise():
+        for feats, rel in queries():
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for feats, rel in queries():
+            yield feats, rel
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
 def train_reader(format="pointwise"):
+    if _real_path("train"):
+        return _real_format_reader("train", format)
     return _reader(N_QUERIES_TRAIN, "mq2007-train", format)
 
 
 def test_reader(format="pointwise"):
+    if _real_path("test"):
+        return _real_format_reader("test", format)
     return _reader(N_QUERIES_TEST, "mq2007-test", format)

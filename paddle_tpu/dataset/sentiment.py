@@ -1,8 +1,19 @@
 """Movie-review sentiment polarity (reference v2/dataset/sentiment.py API —
 the NLTK movie_reviews corpus). ``get_word_dict()`` then ``train()``/
-``test()`` yield ``(ids, 0|1)``. Synthetic fallback shares the IMDB topic
-construction with a distinct seed/vocab."""
+``test()`` yield ``(ids, 0|1)``. When the corpus is present on disk
+(``movie_reviews/pos|neg/*.txt`` under the cache dir — the layout
+nltk.download unpacks) it is parsed with the reference's rules
+(frequency-sorted dict over the whole corpus, neg=0/pos=1,
+neg/pos-interleaved file order, first 1600 rows train —
+sentiment.py:53-128) WITHOUT needing nltk; otherwise the synthetic
+fallback shares the IMDB topic construction with a distinct seed/vocab.
+"""
 from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
 
 import numpy as np
 
@@ -13,9 +24,67 @@ __all__ = ["get_word_dict", "train", "test"]
 VOCAB_SIZE = 1024
 TRAIN_SIZE = 1024
 TEST_SIZE = 128
+NUM_TRAINING_INSTANCES = 1600  # the reference's train/test split point
+
+_TOKEN = re.compile(r"[A-Za-z0-9']+|[^\sA-Za-z0-9]")
+
+
+def _real_dir():
+    for cand in (os.path.join(common.DATA_HOME, "movie_reviews"),
+                 os.path.join(common.DATA_HOME, "corpora",
+                              "movie_reviews")):
+        if os.path.isdir(os.path.join(cand, "pos")) \
+                and os.path.isdir(os.path.join(cand, "neg")):
+            return cand
+    return None
+
+
+def _files(category):
+    return sorted(glob.glob(os.path.join(_real_dir(), category, "*.txt")))
+
+
+def _words(path):
+    with open(path, errors="ignore") as f:
+        return [w.lower() for w in _TOKEN.findall(f.read())]
+
+
+_CACHE = {}  # parsed word dict + rows, keyed by the corpus dir
+
+
+def _real_word_dict():
+    d = _real_dir()
+    if ("dict", d) in _CACHE:
+        return _CACHE[("dict", d)]
+    freq = collections.defaultdict(int)
+    for cat in ("neg", "pos"):
+        for path in _files(cat):
+            for w in _words(path):
+                freq[w] += 1
+    ordered = sorted(freq.items(), key=lambda kv: -kv[1])
+    wd = {w: i for i, (w, _) in enumerate(ordered)}
+    _CACHE[("dict", d)] = wd
+    return wd
+
+
+def _real_rows():
+    d = _real_dir()
+    if ("rows", d) in _CACHE:
+        return _CACHE[("rows", d)]
+    wd = _real_word_dict()
+    neg, pos = _files("neg"), _files("pos")
+    rows = []
+    # neg/pos interleaved, neg=0 / pos=1 (reference sort_files +
+    # load_sentiment_data)
+    for n, p in zip(neg, pos):
+        rows.append(([wd[w] for w in _words(n)], 0))
+        rows.append(([wd[w] for w in _words(p)], 1))
+    _CACHE[("rows", d)] = rows
+    return rows
 
 
 def get_word_dict():
+    if _real_dir():
+        return _real_word_dict()
     return {f"s{i}": i for i in range(VOCAB_SIZE)}
 
 
@@ -39,8 +108,18 @@ def _reader(n, seed_name):
 
 
 def train():
+    if _real_dir():
+        def reader():
+            yield from _real_rows()[:NUM_TRAINING_INSTANCES]
+
+        return reader
     return _reader(TRAIN_SIZE, "sentiment-train")
 
 
 def test():
+    if _real_dir():
+        def reader():
+            yield from _real_rows()[NUM_TRAINING_INSTANCES:]
+
+        return reader
     return _reader(TEST_SIZE, "sentiment-test")

@@ -1,10 +1,16 @@
 """UCI housing regression dataset (reference v2/dataset/uci_housing.py API).
 
-Samples: (features float32[13], price float32[1]). Synthetic fallback draws
-features then prices from a fixed linear model + noise, so fit_a_line-style
-book tests converge deterministically.
+Samples: (features float32[13], price float32[1]). When the real
+``housing.data`` is present in the cache dir it is parsed with the
+reference's rules (whitespace floats, 14 cols, per-feature
+(x-avg)/(max-min) normalization, 80/20 split — uci_housing.py:60
+load_data); otherwise a synthetic fallback draws features then prices
+from a fixed linear model + noise, so fit_a_line-style book tests
+converge deterministically.
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -35,9 +41,39 @@ def _synthetic(n, seed_name):
     return reader
 
 
+def _real_path():
+    p = os.path.join(common.DATA_HOME, "uci_housing", "housing.data")
+    return p if os.path.exists(p) else None
+
+
+def _load_real(ratio=0.8):
+    data = np.fromfile(_real_path(), sep=" ").astype(np.float64)
+    data = data.reshape(data.shape[0] // 14, 14)
+    maxs, mins = data.max(axis=0), data.min(axis=0)
+    avgs = data.mean(axis=0)
+    for i in range(13):
+        data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
+
+def _real_reader(is_test):
+    def reader():
+        train_rows, test_rows = _load_real()
+        for row in (test_rows if is_test else train_rows):
+            yield (row[:13].astype(np.float32),
+                   row[13:].astype(np.float32))
+
+    return reader
+
+
 def train():
+    if _real_path():
+        return _real_reader(is_test=False)
     return _synthetic(TRAIN_SIZE, "uci-train")
 
 
 def test():
+    if _real_path():
+        return _real_reader(is_test=True)
     return _synthetic(TEST_SIZE, "uci-test")

@@ -2,10 +2,20 @@
 
 ``train()``/``test()``/``val()`` yield ``(image, label_mask)``: image
 float32[3, H, W], mask int64[H, W] with 21 classes — the reference's
-(image, label) segmentation pairs. Synthetic fallback: rectangle objects of
-class-coloured texture on background, masks exactly consistent with images.
+(image, label) segmentation pairs. When the real
+``VOCtrainval_11-May-2012.tar`` is present in the cache dir it is
+parsed with the reference's rules (ImageSets/Segmentation/{split}.txt
+name lists, JPEGImages + palette-PNG SegmentationClass pairs —
+voc2012.py:34-63; splits: train()='trainval', test()='train',
+val()='val', the reference's own mapping) via PIL. Otherwise a
+synthetic fallback: rectangle objects of class-coloured texture on
+background, masks exactly consistent with images.
 """
 from __future__ import annotations
+
+import io
+import os
+import tarfile
 
 import numpy as np
 
@@ -37,13 +47,58 @@ def _reader(n, seed_name):
     return reader
 
 
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def _real_path():
+    p = os.path.join(common.DATA_HOME, "voc2012",
+                     "VOCtrainval_11-May-2012.tar")
+    return p if os.path.exists(p) else None
+
+
+def _real_reader(sub_name):
+    # one tar open + member index, shared across epochs (the reference
+    # builds name2mem once in reader_creator)
+    tf = tarfile.open(_real_path())
+    members = {m.name: m for m in tf.getmembers()}
+
+    def reader():
+        from PIL import Image
+
+        sets = tf.extractfile(members[_SET_FILE.format(sub_name)])
+        for line in sets:
+            name = line.decode("utf-8").strip()
+            if not name:
+                continue
+            data = tf.extractfile(members[_DATA_FILE.format(name)]).read()
+            label = tf.extractfile(
+                members[_LABEL_FILE.format(name)]).read()
+            # the module contract (same as the synthetic path): image
+            # float32 [3, H, W] in [0, 1], mask int64 [H, W]
+            img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"),
+                             np.float32).transpose(2, 0, 1) / 255.0
+            mask = np.asarray(Image.open(io.BytesIO(label)),
+                              np.int64)
+            yield img, mask
+
+    return reader
+
+
 def train():
+    if _real_path():
+        return _real_reader("trainval")  # the reference's own mapping
     return _reader(TRAIN_SIZE, "voc2012-train")
 
 
 def test():
+    if _real_path():
+        return _real_reader("train")
     return _reader(TEST_SIZE, "voc2012-test")
 
 
 def val():
+    if _real_path():
+        return _real_reader("val")
     return _reader(TEST_SIZE, "voc2012-val")

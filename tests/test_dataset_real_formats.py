@@ -21,8 +21,9 @@ from paddle_tpu.dataset import common
 @pytest.fixture
 def data_home(tmp_path, monkeypatch):
     monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
-    # movielens caches parsed metadata at module level
+    # movielens/sentiment cache parsed corpora at module level
     monkeypatch.setattr(dataset.movielens, "_META", None)
+    monkeypatch.setattr(dataset.sentiment, "_CACHE", {})
     return tmp_path
 
 
@@ -191,3 +192,140 @@ def test_imikolov_real_ptb_tarball(data_home):
     assert all(len(g) == 3 for g in grams)
     vgrams = list(dataset.imikolov.test(wd, 3)())
     assert vgrams[0][0] == wd["<s>"]
+
+
+def test_uci_housing_real_file(data_home):
+    d = data_home / "uci_housing"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    rows = (rng.rand(10, 14) * 10).round(4)  # match the file precision
+    (d / "housing.data").write_text(
+        "\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows) + "\n")
+    tr = list(dataset.uci_housing.train()())
+    te = list(dataset.uci_housing.test()())
+    assert len(tr) == 8 and len(te) == 2  # the reference 80/20 split
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # reference normalization: (x - avg) / (max - min), price untouched
+    want = (rows[0, 0] - rows[:, 0].mean()) / (rows[:, 0].max()
+                                               - rows[:, 0].min())
+    np.testing.assert_allclose(x[0], want, rtol=1e-4)
+    np.testing.assert_allclose(float(y[0]), rows[0, 13], rtol=1e-4)
+
+
+def test_mq2007_real_letor_file(data_home):
+    d = data_home / "MQ2007"
+    d.mkdir()
+    lines = [
+        "2 qid:10 1:0.5 2:0.25 46:1.0 #docid = GX1",
+        "0 qid:10 1:0.1 46:0.2 #docid = GX2",
+        "1 qid:11 3:0.7 #docid = GX3",
+    ]
+    (d / "train.txt").write_text("\n".join(lines) + "\n")
+    groups = list(dataset.mq2007.train_reader(format="listwise")())
+    assert len(groups) == 2  # grouped by qid, file order
+    feats, rel = groups[0]
+    assert feats.shape == (2, 46)
+    np.testing.assert_allclose(feats[0, 0], 0.5)
+    np.testing.assert_allclose(feats[0, 45], 1.0)
+    assert rel.tolist() == [2, 0]
+    pairs = list(dataset.mq2007.train_reader(format="pairwise")())
+    assert len(pairs) == 1  # only rel 2 > rel 0 inside qid:10
+    points = list(dataset.mq2007.train_reader(format="pointwise")())
+    assert len(points) == 3
+
+
+def test_sentiment_real_corpus(data_home):
+    d = data_home / "movie_reviews"
+    (d / "pos").mkdir(parents=True)
+    (d / "neg").mkdir(parents=True)
+    (d / "pos" / "cv000.txt").write_text("great great fun film")
+    (d / "pos" / "cv001.txt").write_text("great movie")
+    (d / "neg" / "cv000.txt").write_text("awful, awful awful film")
+    (d / "neg" / "cv001.txt").write_text("bad movie")
+    wd = dataset.sentiment.get_word_dict()
+    # frequency-sorted: 'awful' (3) tops 'great' (3)... ties ok; both
+    # outrank singletons
+    assert wd["great"] < wd["movie"] or wd["awful"] < wd["movie"]
+    rows = list(dataset.sentiment.train()())
+    assert len(rows) == 4  # tiny corpus: all rows inside the split
+    labels = [l for _, l in rows]
+    assert labels == [0, 1, 0, 1]  # neg/pos interleaved
+    for ids, _ in rows:
+        assert all(0 <= i < len(wd) for i in ids)
+
+
+def test_flowers_real_corpus(data_home):
+    import io
+
+    import scipy.io as scio
+    from PIL import Image
+
+    d = data_home / "flowers"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    with tarfile.open(d / "102flowers.tgz", "w:gz") as tf:
+        for i in (1, 2, 3):
+            img = Image.fromarray(
+                (rng.rand(300, 280, 3) * 255).astype("uint8"))
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    scio.savemat(d / "imagelabels.mat",
+                 {"labels": np.array([[5, 9, 5]])})
+    scio.savemat(d / "setid.mat",
+                 {"tstid": np.array([[1, 3]]),   # TRAIN (the swap)
+                  "trnid": np.array([[2]]),
+                  "valid": np.array([[2]])})
+    tr = list(dataset.flowers.train()())
+    te = list(dataset.flowers.test()())
+    assert len(tr) == 2 and len(te) == 1
+    img, lbl = tr[0]
+    assert img.shape == (3 * 224 * 224,)
+    assert lbl == 4  # 1-based 5 -> 0-based 4
+    assert te[0][1] == 8
+
+
+def test_voc2012_real_tarball(data_home):
+    import io
+
+    from PIL import Image
+
+    d = data_home / "voc2012"
+    d.mkdir()
+    rng = np.random.RandomState(1)
+    with tarfile.open(d / "VOCtrainval_11-May-2012.tar", "w") as tf:
+        _add_text(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                      "trainval.txt", "img_a\n")
+        _add_text(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                      "train.txt", "img_a\n")
+        _add_text(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                      "val.txt", "")
+        img = Image.fromarray((rng.rand(20, 24, 3) * 255).astype("uint8"))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        data = buf.getvalue()
+        info = tarfile.TarInfo("VOCdevkit/VOC2012/JPEGImages/img_a.jpg")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+        mask = Image.fromarray(
+            rng.randint(0, 21, (20, 24)).astype("uint8"), mode="P")
+        buf = io.BytesIO()
+        mask.save(buf, format="PNG")
+        data = buf.getvalue()
+        info = tarfile.TarInfo(
+            "VOCdevkit/VOC2012/SegmentationClass/img_a.png")
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    rows = list(dataset.voc2012.train()())
+    assert len(rows) == 1
+    img_arr, mask_arr = rows[0]
+    # the module contract (same as the synthetic path): CHW [0,1] float
+    assert img_arr.shape == (3, 20, 24) and img_arr.dtype == np.float32
+    assert 0.0 <= img_arr.min() and img_arr.max() <= 1.0
+    assert mask_arr.shape == (20, 24) and mask_arr.dtype == np.int64
+    assert mask_arr.max() < 21
+    assert list(dataset.voc2012.val()()) == []
