@@ -1469,23 +1469,9 @@ class slice_projection(v2l.BaseProjection):
             {"axes": [rank - 1], "starts": [s], "ends": [e]})
             for s, e in self.slices]
         return parts[0] if len(parts) == 1 else L.concat(parts, axis=-1)
+
+
 gru_step_naive_layer = gru_step_layer  # one fused formulation here
-
-
-def _simple_op_shim(op_type, out_slot="Out", doc=""):
-    def shim(input, name=None, **kw):
-        from ..layers.layer_helper import LayerHelper
-
-        helper = LayerHelper(op_type)
-        attrs = {k: v for k, v in kw.items()
-                 if isinstance(v, (int, float, bool, str, list))}
-        return _group_register_name(
-            name, helper.simple_op(op_type, {"X": [input]}, attrs,
-                                   out_slot=out_slot))
-
-    shim.__name__ = op_type
-    shim.__doc__ = doc
-    return shim
 
 
 def crop_layer(input, offset, axis=2, shape=None, name=None, **kw):
