@@ -205,3 +205,41 @@ def test_v2_namespace_carries_the_group_dsl_without_parse_context():
     np.testing.assert_allclose(np.asarray(o)[0, -1], [4.0, 4.0, 4.0],
                                rtol=1e-6)
     assert l2.StaticInput is H.StaticInput
+
+
+def test_nested_recurrent_group_hierarchical_rnn():
+    """The reference's nested-sequence machinery
+    (RecurrentGradientMachine.h:32 nested seqs; sequence_nest demos):
+    an OUTER recurrent_group steps over the sub-sequences of a
+    [b, S, T, d] plane, each step running an INNER group over the words
+    — the inner static_rnn op nests inside the outer scan body. Checked
+    bit-exactly: inner running sums + an outer accumulator across
+    sub-sequences."""
+    from paddle_tpu.v1 import helpers as H
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[3, 4, 2])  # [b, S=3, T=4, d=2]
+
+        def outer_step(sub):  # [b, T, d] — one sub-sequence
+            def inner_step(w_t):  # [b, d] — one word
+                mem = H.memory(name="inner", size=2)
+                return H.addto_layer([w_t, mem], name="inner")
+
+            inner = H.recurrent_group(step=inner_step, input=sub)
+            summed = L.sequence_last_step(inner)  # [b, d]
+            acc = H.memory(name="outer_acc", size=2)
+            return H.addto_layer([summed, acc], name="outer_acc")
+
+        out = H.recurrent_group(step=outer_step, input=x)  # [b, S, d]
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 3, 4, 2).astype("f4")
+    o, = exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+    o = np.asarray(o)
+    assert o.shape == (2, 3, 2)
+    # inner sums over T, outer prefix-sums over S
+    want = np.cumsum(xv.sum(axis=2), axis=1)
+    np.testing.assert_allclose(o, want, rtol=1e-5)
