@@ -243,3 +243,39 @@ def test_nested_recurrent_group_hierarchical_rnn():
     # inner sums over T, outer prefix-sums over S
     want = np.cumsum(xv.sum(axis=2), axis=1)
     np.testing.assert_allclose(o, want, rtol=1e-5)
+
+
+def test_nested_groups_with_variable_inner_lengths():
+    """Two-level ragged LoD: per-(batch, sub-sequence) lengths thread
+    through a stepped length input; the inner group masks past each
+    sub-sequence's true length and sequence_last_step reads the true
+    last step — the reference's subSequenceStartPositions semantics
+    (Argument.h:84-90) on the dense plane, checked against ragged numpy
+    sums."""
+    from paddle_tpu.v1 import helpers as H
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data("x", shape=[3, 4, 2])              # [b, S, T, d]
+        lens = L.data("lens", shape=[3], dtype="int32")  # [b, S]
+
+        def outer_step(sub, len_s):
+            sub.seq_len = len_s
+
+            def inner_step(w_t):
+                mem = H.memory(name="inner", size=2)
+                return H.addto_layer([w_t, mem], name="inner")
+
+            inner = H.recurrent_group(step=inner_step, input=sub)
+            # no hand re-attachment: the group must propagate seq_len
+            # to its outputs itself (StaticRNN o.seq_len plumbing)
+            return L.sequence_last_step(inner)
+
+        out = H.recurrent_group(step=outer_step, input=[x, lens])
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 3, 4, 2).astype("f4")
+    lv = np.array([[4, 2, 3], [1, 4, 2]], "int32")
+    o, = _run(main, startup, [out], {"x": xv, "lens": lv})
+    want = np.stack([[xv[b, s, :lv[b, s]].sum(0) for s in range(3)]
+                     for b in range(2)])
+    np.testing.assert_allclose(o, want, rtol=1e-5)
