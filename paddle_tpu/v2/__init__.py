@@ -30,7 +30,9 @@ Usage mirrors the reference's book examples::
 from __future__ import annotations
 
 from .. import dataset, event  # noqa: F401  (reference re-exports)
-from .. import image  # noqa: F401
+from .. import evaluator, image, master, plot  # noqa: F401
+from ..core.program import (default_main_program,  # noqa: F401
+                            default_startup_program)
 from ..reader import decorator as reader  # noqa: F401
 from ..reader.minibatch import batch  # noqa: F401
 from . import activation, attr, data_type, layer, networks, optimizer, \
@@ -38,7 +40,9 @@ from . import activation, attr, data_type, layer, networks, optimizer, \
 
 __all__ = ["init", "infer", "batch", "reader", "dataset", "event", "layer",
            "activation", "pooling", "attr", "data_type", "optimizer",
-           "parameters", "trainer", "networks", "image"]
+           "parameters", "trainer", "networks", "image",
+           "evaluator", "master", "plot",
+           "default_main_program", "default_startup_program"]
 
 
 def init(use_gpu: bool = False, trainer_count: int = 1, seed: int = None,
