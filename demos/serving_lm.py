@@ -1,0 +1,167 @@
+"""Serve a transformer LM with continuous batching (paddle_tpu.serving).
+
+The full deployment path: train a small stacked LM, freeze it with
+save_inference_model, load it into a GenerationEngine (slot-table KV
+cache), pre-warm every compile bucket, then push a wave of concurrent
+generate requests through the Server's dynamic batcher — requests join
+and leave decode slots mid-flight, and after warmup the whole workload
+runs without a single fresh XLA compile (the executor's compile-cache
+counters are printed as proof). A JSON HTTP endpoint serves the same
+engine over stdlib http.server.
+
+Run:  python demos/serving_lm.py  (PADDLE_TPU_DEMO_FAST=1 to smoke)
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.serving import GenerationEngine, Server
+
+FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
+
+VOCAB, D_MODEL, N_LAYERS, HEADS = 97, 32 if FAST else 64, 2, 4
+MAX_LEN = 64
+N_REQUESTS = 64 if FAST else 96
+SLOTS = 8
+
+
+def train_and_save(model_dir):
+    """Train next = (3*cur + noise) % VOCAB and save the GENERATION
+    program (KV-cache decode op + shared weights) as the frozen serving
+    artifact."""
+    T = 16
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=VOCAB,
+                                       d_model=D_MODEL, n_layers=N_LAYERS,
+                                       num_heads=HEADS, max_len=MAX_LEN,
+                                       pipeline_stack=True)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, VOCAB]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    steps = 8 if FAST else 80
+    for step in range(steps):
+        seq = np.zeros((32, T + 1), np.int64)
+        seq[:, 0] = rng.randint(0, VOCAB, size=32)
+        for t in range(T):
+            seq[:, t + 1] = (3 * seq[:, t]
+                             + rng.randint(0, 2, size=32)) % VOCAB
+        lo, = exe.run(main_prog,
+                      feed={"ids": seq[:, :-1], "tgt": seq[:, 1:]},
+                      fetch_list=[loss], scope=scope)
+        if step % 20 == 0 or step == steps - 1:
+            print(f"train step {step}: loss {float(lo):.4f}")
+
+    gen_prog, gen_startup = pt.Program(), pt.Program()
+    with pt.program_guard(gen_prog, gen_startup):
+        prompt = layers.data("prompt", shape=[8], dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D_MODEL, n_layers=N_LAYERS,
+            num_heads=HEADS, max_len=MAX_LEN, max_new_tokens=8)
+    pt.io.save_inference_model(model_dir, ["prompt"], [out_ids], exe,
+                               main_program=gen_prog, scope=scope)
+    print(f"saved inference model -> {model_dir}")
+
+
+def main():
+    model_dir = os.path.join(tempfile.mkdtemp(prefix="pdtpu_serving_"),
+                             "lm")
+    train_and_save(model_dir)
+
+    engine = GenerationEngine.from_saved(
+        model_dir, slots=SLOTS, prompt_buckets=(8, 16),
+        prefill_batch_buckets=(1, 2, 4, 8),
+        default_max_new_tokens=8)
+    t0 = time.perf_counter()
+    n_shapes = engine.warmup()
+    print(f"warmup: {n_shapes} bucket shapes compiled in "
+          f"{time.perf_counter() - t0:.1f}s -> {engine.cache_stats()}")
+    misses_after_warmup = engine.cache_stats()["misses"]
+
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, VOCAB, size=rng.randint(3, 13))
+               for _ in range(N_REQUESTS)]
+
+    with Server(engine, max_wait_ms=2, max_queue=2 * N_REQUESTS) as srv:
+        # ---- concurrent wave through the continuous batcher ----------
+        t0 = time.perf_counter()
+        futs, lock = [], threading.Lock()
+
+        def client(chunk):
+            for p in chunk:
+                f = srv.submit({"prompt": p},
+                               max_new_tokens=int(4 + p[0] % 5))
+                with lock:
+                    futs.append((p, f))
+
+        threads = [threading.Thread(target=client,
+                                    args=(prompts[i::4],))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        results = [(p, f.result(timeout=300)) for p, f in futs]
+        wall = time.perf_counter() - t0
+        for p, ids in results:
+            assert ids.shape[0] > p.shape[0]
+            np.testing.assert_array_equal(ids[:p.shape[0]], p)
+        print(f"served {len(results)} concurrent generate requests in "
+              f"{wall:.2f}s through {SLOTS} decode slots")
+
+        stats = engine.cache_stats()
+        fresh = stats["misses"] - misses_after_warmup
+        print(f"compile cache: {stats} -> {fresh} recompiles after "
+              "warmup" + (" (WARM STEADY STATE)" if fresh == 0 else ""))
+        assert fresh == 0, "serving path recompiled after warmup!"
+
+        # a learned-rule spot check: the model was trained on
+        # next = 3*cur (+noise), so generated tokens should mostly track
+        p, ids = results[0]
+        gen = ids[p.shape[0]:]
+        print(f"sample: prompt={p.tolist()} -> generated={gen.tolist()}")
+
+        # ---- the same engine over HTTP -------------------------------
+        port = srv.serve_http(port=0)
+        body = json.dumps({"prompt": prompts[0].tolist(),
+                           "max_new_tokens": 5}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            print("HTTP /v1/generate ->", json.loads(resp.read()))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            snap = json.loads(resp.read())
+
+    lat = snap["latency"].get("request_ms", {})
+    print("metrics snapshot:")
+    print(f"  qps(10s window)   {snap['qps']:.1f}")
+    print(f"  completed         {snap['counters'].get('completed')}")
+    print(f"  decode steps      {snap['counters'].get('decode_steps')}")
+    print(f"  prefills          {snap['counters'].get('prefills')}")
+    print(f"  latency ms        p50={lat.get('p50', 0):.1f} "
+          f"p95={lat.get('p95', 0):.1f} p99={lat.get('p99', 0):.1f}")
+    print(f"  batch occupancy   "
+          f"{snap['gauges'].get('batch_occupancy', 0):.2f}")
+    print(f"  compile cache     {snap.get('compile_cache/engine0')}")
+    print("serving demo OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,18 @@
-"""ctypes binding for the native C inference ABI (native/capi.cc).
+"""Inference machines: the native C ABI binding and its serving reroute.
 
-Mirrors the reference's paddle/capi usage pattern
+``InferenceMachine`` mirrors the reference's paddle/capi usage pattern
 (/root/reference/paddle/capi/capi.h, examples/model_inference/dense):
 create a machine from a saved model, feed inputs, forward, read outputs —
-no Python framework (and no JAX) in the serving process. This module is
-only the test/convenience binding; C/C++ applications link the compiled
-shared library directly.
+no Python framework (and no JAX) in the serving process. C/C++
+applications link the compiled shared library directly.
+
+``EngineInferenceMachine`` is the same surface reroute through
+:mod:`paddle_tpu.serving`: the forward runs through a pre-warmed,
+bucket-padded InferenceEngine instead of the per-call native machine, so
+repeated ``run``/``generate`` calls hit the compile cache and share the
+engine's metrics plane. ``inference_machine()`` picks whichever backend
+the environment supports — existing capi callers get the serving path for
+free where no C++ toolchain exists.
 """
 from __future__ import annotations
 
@@ -15,6 +22,74 @@ from typing import Dict, List
 import numpy as np
 
 from .native.build import load_library
+
+
+def _autoregressive_generate(run, feed_names, prompt, max_new_tokens: int,
+                             seq_len: int, input_name: str = None,
+                             fetch_index: int = 0, pad_id: int = 0,
+                             temperature: float = 0.0, top_k: int = 0,
+                             seed: int = 0) -> np.ndarray:
+    """The host-side decode loop shared by every one-shot machine
+    (native C and serving-engine backed): the saved per-layer LM has a
+    STATIC [*, seq_len] input (its position table is sliced at build
+    time), so each step feeds the ids buffer padded to ``seq_len`` and
+    re-runs the full forward — causal attention makes positions past the
+    cursor irrelevant. O(n * full-forward); deployments wanting the O(n)
+    KV-cache path serve a stacked LM through
+    serving.GenerationEngine instead. Greedy by default; ``temperature``
+    > 0 samples (optionally ``top_k`` truncated) on the host from the
+    machine-computed distribution. prompt: [b, p] ints ->
+    [b, p + max_new_tokens]."""
+    prompt = np.asarray(prompt, dtype=np.int64)
+    b, p = prompt.shape
+    if p < 1:
+        raise ValueError("generate needs at least one prompt token "
+                         "(position -1 would wrap to the pad tail)")
+    if p + max_new_tokens > seq_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's static seq_len ({seq_len})")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    name = input_name or feed_names[0]
+    rng = np.random.RandomState(seed)
+    ids = np.full((b, seq_len), pad_id, np.int64)
+    ids[:, :p] = prompt
+    for cur in range(p, p + max_new_tokens):
+        row = run({name: ids})[fetch_index][:, cur - 1, :]
+        if temperature > 0:
+            # Sampling treats the fetched row as PROBABILITIES (the
+            # docstring contract). Negative entries mean the fetch is
+            # logits — log() would silently invert their ranking, so
+            # fail loudly; NaN/Inf means a broken model.
+            if not np.isfinite(row).all():
+                raise ValueError(
+                    "generate(temperature>0): model output contains "
+                    "NaN/Inf — cannot sample from it")
+            if (row < 0).any():
+                raise ValueError(
+                    "generate(temperature>0): model output has "
+                    "negative entries — sampling needs softmax "
+                    "probabilities, not logits (fetch the softmax "
+                    "output, or use temperature=0 greedy decode "
+                    "which accepts logits)")
+            z = np.log(np.maximum(row.astype(np.float64), 1e-30))
+            z /= temperature
+            if top_k:
+                if not 0 < int(top_k) <= row.shape[-1]:
+                    raise ValueError(
+                        f"top_k must be in (0, vocab={row.shape[-1]}],"
+                        f" got {top_k}")
+                kth = np.sort(z, axis=-1)[:, -int(top_k)][:, None]
+                z = np.where(z >= kth, z, -np.inf)
+            z -= z.max(-1, keepdims=True)
+            pr = np.exp(z)
+            pr /= pr.sum(-1, keepdims=True)
+            ids[:, cur] = [rng.choice(pr.shape[-1], p=pr[i])
+                           for i in range(b)]
+        else:
+            ids[:, cur] = row.argmax(-1)
+    return ids[:, :p + max_new_tokens]
 
 
 def _lib():
@@ -109,65 +184,16 @@ class InferenceMachine:
         default; ``temperature`` > 0 samples (optionally ``top_k``
         truncated) on the host from the C-computed distribution.
 
-        The saved per-layer LM has a STATIC [*, seq_len] input (its
-        position table is sliced at build time), so each step feeds the
-        ids buffer padded to ``seq_len`` and re-runs the full forward —
-        causal attention makes positions past the cursor irrelevant.
-        O(n * full-forward): the native serving loop for deployments
-        without the KV-cache path. The fetched target must be the
-        [*, seq_len, vocab] next-token distribution (softmax probs when
-        sampling; logits also work for greedy).
+        The decode loop itself is the module-level
+        ``_autoregressive_generate`` — shared with the serving-engine
+        machine, so both backends keep identical semantics. The fetched
+        target must be the [*, seq_len, vocab] next-token distribution
+        (softmax probs when sampling; logits also work for greedy).
         prompt: [b, p] ints -> [b, p + max_new_tokens]."""
-        prompt = np.asarray(prompt, dtype=np.int64)
-        b, p = prompt.shape
-        if p < 1:
-            raise ValueError("generate needs at least one prompt token "
-                             "(position -1 would wrap to the pad tail)")
-        if p + max_new_tokens > seq_len:
-            raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the model's static seq_len ({seq_len})")
-        if temperature < 0:
-            raise ValueError(f"temperature must be >= 0, got {temperature}")
-        name = input_name or self.feed_names[0]
-        rng = np.random.RandomState(seed)
-        ids = np.full((b, seq_len), pad_id, np.int64)
-        ids[:, :p] = prompt
-        for cur in range(p, p + max_new_tokens):
-            row = self.run({name: ids})[fetch_index][:, cur - 1, :]
-            if temperature > 0:
-                # Sampling treats the fetched row as PROBABILITIES (the
-                # docstring contract). Negative entries mean the fetch is
-                # logits — log() would silently invert their ranking, so
-                # fail loudly; NaN/Inf means a broken model.
-                if not np.isfinite(row).all():
-                    raise ValueError(
-                        "generate(temperature>0): model output contains "
-                        "NaN/Inf — cannot sample from it")
-                if (row < 0).any():
-                    raise ValueError(
-                        "generate(temperature>0): model output has "
-                        "negative entries — sampling needs softmax "
-                        "probabilities, not logits (fetch the softmax "
-                        "output, or use temperature=0 greedy decode "
-                        "which accepts logits)")
-                z = np.log(np.maximum(row.astype(np.float64), 1e-30))
-                z /= temperature
-                if top_k:
-                    if not 0 < int(top_k) <= row.shape[-1]:
-                        raise ValueError(
-                            f"top_k must be in (0, vocab={row.shape[-1]}],"
-                            f" got {top_k}")
-                    kth = np.sort(z, axis=-1)[:, -int(top_k)][:, None]
-                    z = np.where(z >= kth, z, -np.inf)
-                z -= z.max(-1, keepdims=True)
-                pr = np.exp(z)
-                pr /= pr.sum(-1, keepdims=True)
-                ids[:, cur] = [rng.choice(pr.shape[-1], p=pr[i])
-                               for i in range(b)]
-            else:
-                ids[:, cur] = row.argmax(-1)
-        return ids[:, :p + max_new_tokens]
+        return _autoregressive_generate(
+            self.run, self.feed_names, prompt, max_new_tokens, seq_len,
+            input_name=input_name, fetch_index=fetch_index, pad_id=pad_id,
+            temperature=temperature, top_k=top_k, seed=seed)
 
     def close(self):
         if getattr(self, "_h", None):
@@ -185,3 +211,76 @@ class InferenceMachine:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class EngineInferenceMachine:
+    """InferenceMachine surface rerouted through the serving engine.
+
+    Same run/generate contract as the native machine, but the forward
+    goes through a pre-warmed serving.InferenceEngine: batches pad to
+    warm buckets (zero compiles on the serving path after ``warmup()``),
+    and repeated generate() steps reuse the one compiled shape. Drop-in
+    for environments without a C++ toolchain — and the batching/metrics
+    story the bare ctypes binding never had."""
+
+    def __init__(self, model_dir: str, **engine_kw):
+        from .serving import InferenceEngine
+
+        self._engine = InferenceEngine(model_dir, **engine_kw)
+        self._engine.warmup()
+
+    @property
+    def engine(self):
+        return self._engine
+
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._engine.feed_names)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._engine.fetch_names)
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        return self._engine.run(feed)
+
+    def generate(self, prompt, max_new_tokens: int, seq_len: int,
+                 input_name: str = None, fetch_index: int = 0,
+                 pad_id: int = 0, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0) -> np.ndarray:
+        """Autoregressive decode through the engine — the shared host
+        loop over a static [*, seq_len] saved LM (see
+        ``_autoregressive_generate``). Every step feeds the same padded
+        shape, so after the first step the whole decode is compile-free."""
+        return _autoregressive_generate(
+            self.run, self.feed_names, prompt, max_new_tokens, seq_len,
+            input_name=input_name, fetch_index=fetch_index, pad_id=pad_id,
+            temperature=temperature, top_k=top_k, seed=seed)
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def inference_machine(model_dir: str, backend: str = "auto", **engine_kw):
+    """Open a saved inference model with the best available machine.
+
+    backend: 'native' (the C ABI binding; raises without a toolchain),
+    'engine' (the Python serving engine), or 'auto' — native when a
+    C++ toolchain is present, otherwise the serving engine."""
+    if backend == "native":
+        return InferenceMachine(model_dir)
+    if backend == "engine":
+        return EngineInferenceMachine(model_dir, **engine_kw)
+    if backend != "auto":
+        raise ValueError(f"unknown backend {backend!r} "
+                         "(expected 'native', 'engine', or 'auto')")
+    try:
+        return InferenceMachine(model_dir)
+    except RuntimeError:
+        return EngineInferenceMachine(model_dir, **engine_kw)

@@ -144,6 +144,18 @@ class Executor:
                 mesh, data_axis=mesh.axis_names[0])
         self.plan = plan
         self._cache: Dict[Tuple, _Compiled] = {}
+        # Compile-cache observability (the serving warm-path contract:
+        # after warmup a steady-state server shows hits only). Counts
+        # in-process (program, signature) cache lookups — the persistent
+        # on-disk cache above only shortens a miss, it does not hide one.
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def cache_stats(self) -> Dict[str, int]:
+        """{'hits', 'misses', 'entries'} of the (program, shapes) ->
+        compiled-callable cache."""
+        return {"hits": self.cache_hits, "misses": self.cache_misses,
+                "entries": len(self._cache)}
 
     # ------------------------------------------------------------------
     def run(
@@ -167,8 +179,11 @@ class Executor:
         key = self._cache_key(program, feed_vals, fetch_names, scope)
         compiled = self._cache.get(key)
         if compiled is None:
+            self.cache_misses += 1
             compiled = self._compile(program, feed_vals, fetch_names, scope)
             self._cache[key] = compiled
+        else:
+            self.cache_hits += 1
 
         feed_args = [feed_vals[n] for n in compiled.feed_names]
         ro_args = [scope.get(n) for n in compiled.ro_state_names]
@@ -241,8 +256,11 @@ class Executor:
         key = self._cache_key(program, feed_vals, fetch_names, scope)
         compiled = self._cache.get(key)
         if compiled is None:
+            self.cache_misses += 1
             compiled = self._compile(program, feed_vals, fetch_names, scope)
             self._cache[key] = compiled
+        else:
+            self.cache_hits += 1
         args = (
             [feed_vals[n] for n in compiled.feed_names],
             [scope.get(n) for n in compiled.ro_state_names],

@@ -28,7 +28,8 @@ from .core.scope import Scope, global_scope
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "program_to_dict", "program_from_dict",
+    "load_inference_model", "read_inference_model_meta",
+    "program_to_dict", "program_from_dict",
 ]
 
 
@@ -323,6 +324,16 @@ def quantize_inference_model(dirname: str, out_dirname: str,
     with open(os.path.join(out_dirname, "__quant__.json"), "w") as f:
         json.dump(quant, f, indent=1)
     return quantized
+
+
+def read_inference_model_meta(dirname: str) -> dict:
+    """Read a saved inference model's metadata WITHOUT loading parameters:
+    returns ``{"program": <program dict>, "feed_names": [...],
+    "fetch_names": [...]}``. The serving engines use this to derive
+    shape buckets and decode hyperparameters (attrs + var shapes live in
+    the program dict) before deciding how to place the weights."""
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        return json.load(f)
 
 
 def load_inference_model(dirname: str, executor, scope=None):

@@ -195,8 +195,16 @@ def scale_shift_act(y_raw, scale, shift, residual=None, act=None,
     R, O = y_raw.shape
     block_r = 0
     itemsize = y_raw.dtype.itemsize
+    # Mirror _pick_block_r's accounting: every R-streamed tile (y_raw in,
+    # y out, optional residual in) is DOUBLE-BUFFERED by Pallas while the
+    # grid walks R — 2 streams without a residual, 3 with one, i.e.
+    # ~4-6x b*O*itemsize resident, not the single-copy 3x the old
+    # estimate assumed (which overshot the budget and silently fell back
+    # to XLA at sizes that actually fit, and vice versa near the edge).
+    streams = 3 if residual is not None else 2
+    fixed = 2 * O * 4  # scale + shift f32 rows, revisited (not streamed)
     for b in (2048, 1024, 512, 256, 128):
-        if R % b == 0 and (3 * b * O * itemsize + 2 * O * 4) \
+        if R % b == 0 and (2 * streams * b * O * itemsize + fixed) \
                 <= _VMEM_BUDGET:
             block_r = b
             break

@@ -47,11 +47,24 @@ def rotary(x, pos0=0, base=10000.0):
     pos0..pos0+T-1 (RoFormer pairing: (x[2i], x[2i+1]) rotates by
     pos * base^(-2i/D)). The single source of truth for RoPE math — the
     per-layer encoder op and the stacked/decode path both call it; the
-    offset form serves incremental decode."""
+    offset form serves incremental decode. ``pos0`` may be a [B] array
+    of PER-ROW offsets (the slot-decode path, where every batch row sits
+    at its own sequence position)."""
     D = x.shape[-1]
     T = x.shape[2]
     half = D // 2
     inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos0 = jnp.asarray(pos0, jnp.float32)
+    if pos0.ndim:  # per-row offsets: [B] -> angles [B, T, half]
+        pos = pos0[:, None] + jnp.arange(T, dtype=jnp.float32)[None, :]
+        ang = pos[:, :, None] * inv[None, None, :]
+        cos = jnp.cos(ang)[:, None].astype(x.dtype)  # [B, 1, T, half]
+        sin = jnp.sin(ang)[:, None].astype(x.dtype)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x1 * sin + x2 * cos
+        return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
     pos = pos0 + jnp.arange(T, dtype=jnp.float32)
     ang = pos[:, None] * inv[None, :]  # [T, half]
     cos = jnp.cos(ang)[None, None].astype(x.dtype)

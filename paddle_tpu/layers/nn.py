@@ -18,8 +18,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
        act=None, name=None, main_program=None, startup_program=None):
     """Fully-connected layer (reference nn.py fc): mul per input + sum + bias
     + activation. Multiple inputs each get their own weight.
-    ``num_flatten_dims`` may be a list (one value per input) — needed when
-    a sequence input and a plain 2-D input feed the same fc."""
+    ``num_flatten_dims`` may be a list (one value per input) — for inputs
+    of different ranks feeding the same fc. Each input's mul output keeps
+    its leading ``num_flatten_dims`` dims plus the size axis, so every
+    entry must produce the SAME output rank (e.g. a [b, T, d] input with
+    nfd=2 combines with another [b, T, d2] at nfd=2, rank 3 + 3; a
+    [b, d2] input at nfd=1 yields rank 2 and cannot be summed with it —
+    rejected at build time rather than failing inside XLA broadcasting)."""
     helper = LayerHelper("fc", main_program=main_program,
                          startup_program=startup_program)
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -30,6 +35,15 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         raise ValueError(
             f"fc: num_flatten_dims list has {len(nfds)} entries for "
             f"{len(inputs)} inputs")
+    out_ranks = {nfd + 1 for nfd in nfds}
+    if len(out_ranks) > 1:
+        raise ValueError(
+            "fc: per-input num_flatten_dims produce MIXED partial-sum "
+            f"ranks {sorted(nfd + 1 for nfd in nfds)} (each input "
+            "contributes a [*leading_dims, size] partial of rank "
+            "num_flatten_dims+1, and the partials are summed "
+            "elementwise) — use num_flatten_dims values whose outputs "
+            "share one rank, or reshape the lower-rank inputs first")
     mul_results = []
     for inp, nfd in zip(inputs, nfds):
         in_shape = inp.shape

@@ -246,6 +246,27 @@ def _decode_layer_fn(params, num_heads, d, num_kv_heads=None,
     return layer
 
 
+def _make_pick(temperature, top_k, vocab, rng):
+    """Next-token selection shared by the decode ops: argmax when
+    ``temperature`` == 0 (draws nothing — the op's needs_rng predicate
+    keeps the scope RNG untouched), otherwise temperature/top-k sampling
+    folding ``step`` into the rng so every call draws fresh."""
+    if top_k and not 0 < top_k <= vocab:
+        raise ValueError(f"top_k {top_k} outside [1, vocab {vocab}]")
+
+    def pick(logits, step):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        z = logits
+        if top_k:
+            kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+            z = jnp.where(z >= kth, z, -jnp.inf)
+        return jax.random.categorical(jax.random.fold_in(rng, step),
+                                      z / temperature, axis=-1)
+
+    return pick
+
+
 @register_op("transformer_stack_generate", optional_inputs=("PosEmb",),
              needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
 def transformer_stack_generate(attrs, ins, rng):
@@ -282,19 +303,7 @@ def transformer_stack_generate(attrs, ins, rng):
     embed = _embed_fn(tok_emb, pos_emb)
     logits_of = _logits_fn(ln_s, ln_b, head_w)
     vocab = head_w.shape[1]
-    if top_k and not 0 < top_k <= vocab:
-        raise ValueError(f"top_k {top_k} outside [1, vocab {vocab}]")
-
-    def pick(logits, step):
-        if temperature == 0.0:
-            # greedy draws nothing: the op then declares needs_rng False
-            # (rng is None) and the run leaves the scope's RNG untouched
-            return jnp.argmax(logits, axis=-1)
-        if top_k:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
-        return jax.random.categorical(jax.random.fold_in(rng, step),
-                                      logits / temperature, axis=-1)
+    pick = _make_pick(temperature, top_k, vocab, rng)
 
     # ---- prefill: run the stack over the prompt, capturing K/V -------
     h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
@@ -606,3 +615,133 @@ def transformer_stack_speculative_generate(attrs, ins):
     out_ids = jnp.concatenate(
         [prompt, tokens[:, :N].astype(prompt.dtype)], axis=1)
     return out(Out=out_ids, Rounds=rounds.reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# Slot-cache decode ops: the continuous-batching serving path
+# (paddle_tpu/serving/generation.py). The KV cache is a SLOT TABLE
+# [L, S, Hkv, Tmax, dh] living in the scope as persistable state: requests
+# claim a slot, prefill scatters their prompt K/V into it, and every decode
+# step advances ALL slots one token (each at its own position) — finished
+# sequences vacate their slot and new requests join mid-flight. Both ops
+# read AND write the cache variables, so the executor threads them as
+# donated read-write state (in-place buffer update, no cache copy per step).
+# ---------------------------------------------------------------------------
+
+@register_op("transformer_stack_slot_prefill", optional_inputs=("PosEmb",),
+             needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
+def transformer_stack_slot_prefill(attrs, ins, rng=None):
+    """Prefill a batch of prompts into their cache slots.
+
+    Prompt [b, Tp] int (right-padded to the bucket width), SlotIds [b]
+    int32 (target slot per row; duplicate ids are only legal for a scrap
+    slot), Lengths [b] int32 (true prompt lengths, 1..Tp), CacheK/CacheV
+    [L, S, Hkv, Tmax, dh], plus the shared LM weights
+    (transformer_stack_generate's contract). Returns NextTok [b] — the
+    first generated token per row, from the hidden state at each row's
+    true last prompt position — and the caches with rows 0..Tp-1 of each
+    target slot overwritten. Pad rows beyond a row's length write pad K/V
+    into rows length..Tp-1, which decode never attends (its per-slot
+    length mask stops at the current position) and progressively
+    overwrites.
+    """
+    prompt = single(ins, "Prompt")
+    slot_ids = single(ins, "SlotIds").astype(jnp.int32)
+    lengths = single(ins, "Lengths").astype(jnp.int32)
+    cache_k = single(ins, "CacheK")
+    cache_v = single(ins, "CacheV")
+    tok_emb = single(ins, "TokEmb")
+    pos_emb = maybe(ins, "PosEmb")
+    ln_s, ln_b = single(ins, "FinalLnS"), single(ins, "FinalLnB")
+    head_w = single(ins, "HeadW")
+    params = {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()}
+    num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
+    b, Tp = prompt.shape
+    Tmax = cache_k.shape[3]
+    if Tp > Tmax:
+        raise ValueError(f"prompt bucket {Tp} exceeds cache length {Tmax}")
+    if pos_emb is not None and Tp > pos_emb.shape[0]:
+        raise ValueError(f"prompt bucket {Tp} exceeds max_len "
+                         f"{pos_emb.shape[0]}")
+    embed = _embed_fn(tok_emb, pos_emb)
+    pick = _make_pick(attrs.get("temperature") or 0.0,
+                      attrs.get("top_k") or 0, head_w.shape[1], rng)
+    h, (ks, vs) = _prefill(params, embed(prompt, 0), num_heads, b, Tp,
+                           num_kv_heads, use_rope)
+    last = h[jnp.arange(b), jnp.clip(lengths, 1, Tp) - 1]  # [b, d]
+    next_tok = pick(_logits_fn(ln_s, ln_b, head_w)(last), 0)
+    # ks/vs [L, b, Hkv, Tp, dh] -> scatter each row into its slot's rows
+    # 0..Tp-1 (one advanced index: the batch axis maps onto slot ids)
+    cache_k = cache_k.at[:, slot_ids, :, :Tp, :].set(ks)
+    cache_v = cache_v.at[:, slot_ids, :, :Tp, :].set(vs)
+    return out(NextTok=next_tok.astype(prompt.dtype),
+               CacheK=cache_k, CacheV=cache_v)
+
+
+@register_op("transformer_stack_slot_decode", optional_inputs=("PosEmb",),
+             needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
+def transformer_stack_slot_decode(attrs, ins, rng=None):
+    """One decode step over EVERY cache slot, each at its own position.
+
+    Tok [S] int (the pending token per slot — its K/V is not yet in the
+    cache), Pos [S] int32 (that token's sequence position == cache rows
+    already filled for the slot), CacheK/CacheV [L, S, Hkv, Tmax, dh],
+    plus the shared LM weights. Returns NextTok [S] and the caches with
+    row Pos[s] of every slot s overwritten by Tok's K/V.
+
+    The slot axis IS the batch axis, so the compiled shape never depends
+    on which slots are occupied — the one-compile steady state of
+    continuous batching (vacant slots compute a garbage token the host
+    ignores; their row-Pos write lands in a region the next prefill
+    overwrites). Attention masks each slot to rows <= Pos[s] via the
+    per-row lengths plane, so stale rows beyond a slot's position are
+    never visible.
+    """
+    tok = single(ins, "Tok")
+    pos = single(ins, "Pos").astype(jnp.int32)
+    cache_k = single(ins, "CacheK")
+    cache_v = single(ins, "CacheV")
+    tok_emb = single(ins, "TokEmb")
+    pos_emb = maybe(ins, "PosEmb")
+    ln_s, ln_b = single(ins, "FinalLnS"), single(ins, "FinalLnB")
+    head_w = single(ins, "HeadW")
+    params = {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()}
+    num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
+    S = tok.shape[0]
+    if S != cache_k.shape[1]:
+        raise ValueError(f"Tok has {S} slots but the cache holds "
+                         f"{cache_k.shape[1]}")
+    L, d = params["ln1_s"].shape
+    Tmax = cache_k.shape[3]
+    pos = jnp.clip(pos, 0, Tmax - 1)
+    x = tok_emb[tok]
+    if pos_emb is not None:
+        x = x + pos_emb[jnp.clip(pos, 0, pos_emb.shape[0] - 1)]
+    h1 = x[:, None, :]  # [S, 1, d]
+    pick = _make_pick(attrs.get("temperature") or 0.0,
+                      attrs.get("top_k") or 0, head_w.shape[1], rng)
+    srange = jnp.arange(S)
+
+    def layer(h1, inp):
+        layer_p, ck_l, cv_l = inp  # caches [S, Hkv, Tmax, dh]
+        q, k, v = _attn_proj(layer_p, h1, num_heads, num_kv_heads,
+                             use_rope, pos0=pos)
+        Hkv = k.shape[1]
+        ix = (srange[:, None], jnp.arange(Hkv)[None, :], pos[:, None])
+        ck_l = ck_l.at[ix].set(k[:, :, 0, :])
+        cv_l = cv_l.at[ix].set(v[:, :, 0, :])
+        from ..kernels.flash_attention import reference_attention
+
+        ctx = reference_attention(q, ck_l, cv_l, lengths=pos + 1)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(S, 1, d)
+        return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
+
+    h1, (cache_k, cache_v) = jax.lax.scan(layer, h1,
+                                          (params, cache_k, cache_v))
+    nxt = pick(_logits_fn(ln_s, ln_b, head_w)(h1[:, 0]), 0)
+    return out(NextTok=nxt.astype(tok.dtype),
+               CacheK=cache_k, CacheV=cache_v)

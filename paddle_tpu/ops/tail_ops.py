@@ -78,8 +78,11 @@ def lambda_cost(attrs, ins):
     per list, sum over item pairs (i, j) with rel_i > rel_j of
     |dNDCG_ij| * log(1 + exp(-(s_i - s_j))) — the differentiable
     surrogate whose gradient is the lambda the reference computes
-    directly. NDCG truncated at ``NDCG_num``; pairs beyond
-    ``max_sort_size`` top items are ignored when set (>0)."""
+    directly. NDCG truncated at ``NDCG_num``; with ``max_sort_size`` set
+    (>0) only pairs whose HIGHER-relevance anchor ranks inside the top
+    ``max_sort_size`` items contribute (LambdaCost::calcGrad iterates
+    anchors over the truncated sort only — the partner may rank
+    anywhere)."""
     score = single(ins, "Score")    # [b, T] model scores
     rel = single(ins, "Label")      # [b, T] relevance
     lengths = maybe(ins, "Length")
@@ -111,10 +114,13 @@ def lambda_cost(attrs, ins):
     pair_valid = (valid[:, :, None] * valid[:, None, :]
                   * (relf[:, :, None] > relf[:, None, :]))
     if max_sort > 0:
-        # the reference's truncated-sort mode: only pairs whose members
-        # both rank inside the top max_sort_size items contribute
+        # the reference's truncated-sort mode: the ANCHOR (the higher-
+        # relevance member, axis 1 here since pair_valid keeps rel_i >
+        # rel_j) must rank inside the top max_sort_size items; the
+        # partner j may rank anywhere (LambdaCost::calcGrad's outer loop
+        # runs over the truncated sort, the inner over the full list)
         in_top = (rank < max_sort).astype(jnp.float32)
-        pair_valid = pair_valid * in_top[:, :, None] * in_top[:, None, :]
+        pair_valid = pair_valid * in_top[:, :, None]
     cost = jnp.sum(delta * pairloss * pair_valid, axis=(1, 2))
     return out(Out=cost.reshape(b, 1))
 

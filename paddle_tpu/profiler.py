@@ -72,6 +72,18 @@ class StatSet:
             ]
         return rows
 
+    def as_dict(self, prefix: str = ""):
+        """JSON-safe export of the timer table (name -> calls/total/min/
+        max/avg ms), optionally filtered to names starting with
+        ``prefix`` — how the serving /metrics endpoint surfaces its
+        engine timers (serving/metrics.py merge_timer_dict)."""
+        return {
+            name: {"calls": calls, "total_ms": total, "min_ms": mn,
+                   "max_ms": mx, "avg_ms": avg}
+            for name, calls, total, mn, mx, avg in self.table()
+            if name.startswith(prefix)
+        }
+
     def format(self):
         rows = self.table()
         if not rows:

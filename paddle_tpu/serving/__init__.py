@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — TPU-native model serving.
+
+The deployment story past the one-shot C-API machine: a saved inference
+model becomes a production server loop with
+
+- :class:`InferenceEngine` — bucketed, pre-warmed one-shot inference
+  (zero compiles on the serving path after warmup), data-parallel across
+  local devices via a ``parallel.make_mesh`` mesh;
+- :class:`GenerationEngine` — Orca-style continuous batching for
+  autoregressive decode over a slot-table KV cache (requests join and
+  leave mid-flight; one compiled decode step in steady state);
+- :class:`DynamicBatcher` — Clipper-style deadline batching with bounded
+  admission and typed backpressure errors;
+- :class:`Server` — the dispatch thread plus an in-process ``submit()``
+  API and a stdlib JSON HTTP endpoint;
+- :class:`MetricsRegistry` — QPS / queue depth / batch occupancy /
+  latency quantiles / compile-cache hits as a plain dict snapshot,
+  publishable into :mod:`paddle_tpu.profiler`.
+
+See demos/serving_lm.py for the end-to-end walkthrough.
+"""
+from .batcher import DynamicBatcher, Future, Request
+from .engine import InferenceEngine
+from .errors import (BadRequestError, EngineClosedError, QueueFullError,
+                     RequestTimeoutError, ServingError)
+from .generation import GenerationEngine, LMSpec, spec_from_program_dict
+from .metrics import MetricsRegistry
+from .server import Server
+
+__all__ = [
+    "DynamicBatcher", "Future", "Request",
+    "InferenceEngine", "GenerationEngine", "LMSpec",
+    "spec_from_program_dict", "MetricsRegistry", "Server",
+    "ServingError", "QueueFullError", "RequestTimeoutError",
+    "BadRequestError", "EngineClosedError",
+]

@@ -1,0 +1,234 @@
+"""InferenceEngine: bucketed one-shot inference over a saved model.
+
+The serving half of the whole-block-compile design: the executor compiles
+one XLA computation per (program, feed-shape) signature, so a server that
+pads every batch to a small set of batch-size (and optional seq-len)
+buckets hits the compile cache on EVERY request after warmup — the
+reference's per-op interpreter had per-op dispatch cost but no compile
+cliff; here the cliff is real and bucketing is the contract that removes
+it from the serving path.
+
+Replica dispatch rides :mod:`paddle_tpu.parallel`: pass a ``mesh`` (e.g.
+``make_mesh({"dp": n_local_devices})``) and every padded batch is sharded
+across the devices by the data-parallel plan — XLA splits the batch, runs
+the same weights per device, and the fetch gathers rows back. Without a
+mesh, ``place`` pins the engine to one local device so several engines
+can serve side by side (one replica per device, each with its own warm
+cache).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import profiler
+from ..core.executor import Executor, TPUPlace
+from ..core.scope import Scope
+from .errors import BadRequestError
+from .metrics import MetricsRegistry
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def _round_buckets(buckets: Sequence[int], multiple: int) -> List[int]:
+    """Round every bucket up to ``multiple`` (mesh data-parallel needs
+    per-device batch divisibility) and dedup, keeping order."""
+    return sorted({max(multiple, -(-int(b) // multiple) * multiple)
+                   for b in buckets})
+
+
+class InferenceEngine:
+    """Loads a saved inference model and serves padded-bucket batches.
+
+    Construct from a ``save_inference_model`` directory (``model_dir``)
+    or from an already-built (program, feed_names, fetch_names, scope).
+    """
+
+    def __init__(self, model_dir: Optional[str] = None, *,
+                 program=None, feed_names=None, fetch_names=None,
+                 scope: Optional[Scope] = None,
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 mesh=None, plan=None, place=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.metrics = metrics or MetricsRegistry()
+        self.scope = scope or Scope()
+        self.mesh = mesh
+        if mesh is not None and plan is None:
+            from ..parallel import data_parallel_plan
+            plan = data_parallel_plan(mesh, data_axis=mesh.axis_names[0])
+        self._place = place
+        self.executor = Executor(place or TPUPlace(0), mesh=mesh, plan=plan)
+        if model_dir is not None:
+            from ..io import load_inference_model
+            program, feed_names, fetch_names = load_inference_model(
+                model_dir, self.executor, scope=self.scope)
+        if program is None or not feed_names or not fetch_names:
+            raise ValueError("need model_dir or (program, feed_names, "
+                             "fetch_names)")
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        if mesh is not None:
+            dp = int(np.prod(mesh.devices.shape))
+            batch_buckets = _round_buckets(batch_buckets, dp)
+        self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
+        self.seq_buckets = (sorted(set(int(s) for s in seq_buckets))
+                            if seq_buckets else None)
+
+    # ------------------------------------------------------------------
+    def _device_ctx(self):
+        if self.mesh is None and self._place is not None:
+            import jax
+            return jax.default_device(self._place.device())
+        return contextlib.nullcontext()
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    def _feed_template(self, name: str):
+        block = self.program.global_block
+        if not block.has_var(name):
+            return None, None
+        v = block.var(name)
+        return list(v.shape or []), v.dtype
+
+    # ------------------------------------------------------------------
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Execute one user batch: pads the leading dim to the nearest
+        bucket (chunking batches beyond the largest), runs the compiled
+        program, and returns the fetches sliced back to the true batch.
+        Assumes every feed and fetch carries the batch on axis 0 — the
+        save_inference_model feed contract."""
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise BadRequestError(f"missing feeds: {missing}")
+        arrays = {n: np.asarray(feed[n]) for n in self.feed_names}
+        ns = {n: a.shape[0] for n, a in arrays.items()}
+        if len(set(ns.values())) != 1:
+            raise BadRequestError(f"inconsistent batch sizes: {ns}")
+        n = next(iter(ns.values()))
+        if n == 0:
+            raise BadRequestError("empty batch")
+        outs: List[List[np.ndarray]] = []
+        start = 0
+        while start < n:
+            chunk = min(n - start, self.batch_buckets[-1])
+            outs.append(self._run_padded(
+                {k: a[start:start + chunk] for k, a in arrays.items()},
+                chunk))
+            start += chunk
+        if len(outs) == 1:
+            return outs[0]
+        return [np.concatenate([o[i] for o in outs], axis=0)
+                for i in range(len(self.fetch_names))]
+
+    def _run_padded(self, arrays: Dict[str, np.ndarray], n: int):
+        bucket = self.bucket_for(n)
+        pad = bucket - n
+        fed = {}
+        for name, a in arrays.items():
+            if pad:
+                # replicate the last row: numerically safe for any model
+                # (an all-zeros row can hit log/div landmines)
+                a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+            fed[name] = a
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/infer_batch"):
+            res = self.executor.run(self.program, feed=fed,
+                                    fetch_list=self.fetch_names,
+                                    scope=self.scope)
+        self.metrics.observe_latency(
+            time.perf_counter() - t0, name="batch_execute")
+        self.metrics.inc("batches_executed")
+        self.metrics.set_gauge("batch_occupancy", n / bucket)
+        return [np.asarray(r)[:n] for r in res]
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> int:
+        """Compile every configured bucket shape up front with dummy
+        feeds so live traffic never pays a compile. Returns the number
+        of (batch, seq) combinations warmed; feeds with a dynamic
+        non-batch dim need ``seq_buckets`` configured or they are
+        skipped (and counted in the 'warmup_skipped' metric)."""
+        combos = 0
+        seqs = self.seq_buckets or [None]
+        for b in self.batch_buckets:
+            for s in seqs:
+                feed = {}
+                ok = True
+                for name in self.feed_names:
+                    shape, dtype = self._feed_template(name)
+                    if shape is None:
+                        ok = False
+                        break
+                    dims = [b]
+                    for d in shape[1:]:
+                        if d in (-1, None):
+                            if s is None:
+                                ok = False
+                                break
+                            dims.append(s)
+                        else:
+                            dims.append(int(d))
+                    if not ok:
+                        break
+                    feed[name] = np.zeros(dims, dtype=dtype)
+                if not ok:
+                    self.metrics.inc("warmup_skipped")
+                    continue
+                with self._device_ctx():
+                    self.executor.run(self.program, feed=feed,
+                                      fetch_list=self.fetch_names,
+                                      scope=self.scope)
+                combos += 1
+        self.metrics.inc("warmup_compiles", combos)
+        return combos
+
+    def cache_stats(self) -> dict:
+        return self.executor.cache_stats()
+
+    # ------------------------------------------------------------------
+    # Server-driver interface
+    # ------------------------------------------------------------------
+    def serve_step(self, batcher, idle_wait_s: Optional[float] = None) -> bool:
+        """Pull one batch from the batcher and execute it. Request
+        payloads are per-row feed dicts (no batch dim); rows with
+        identical shapes coalesce into one padded run. Returns True when
+        work was done."""
+        reqs = batcher.next_batch(wait_s=idle_wait_s)
+        if not reqs:
+            return False
+        groups: Dict[tuple, list] = {}
+        for req in reqs:
+            try:
+                rows = {n: np.asarray(req.payload[n])
+                        for n in self.feed_names}
+            except (KeyError, TypeError) as exc:
+                req.future.set_exception(BadRequestError(
+                    f"payload must be a dict with feeds "
+                    f"{self.feed_names}: {exc}"))
+                continue
+            sig = tuple((n, rows[n].shape) for n in self.feed_names)
+            groups.setdefault(sig, []).append((req, rows))
+        for _, members in groups.items():
+            feed = {n: np.stack([rows[n] for _, rows in members])
+                    for n in self.feed_names}
+            try:
+                fetched = self.run(feed)
+            except Exception as exc:  # engine failure fails the batch
+                for req, _ in members:
+                    req.future.set_exception(exc)
+                continue
+            now = time.monotonic()
+            for i, (req, _) in enumerate(members):
+                req.future.set_result([f[i] for f in fetched])
+                self.metrics.inc("completed")
+                self.metrics.observe_latency(now - req.enqueue_t)
+        return True

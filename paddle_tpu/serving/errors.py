@@ -1,0 +1,35 @@
+"""Typed serving errors — the admission-control contract.
+
+Callers (and the HTTP front end) distinguish overload from timeout from
+bad input by type, the way the reference's pserver distinguishes its RPC
+status codes; a bare exception string is not a backpressure protocol.
+"""
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for every serving-subsystem failure."""
+
+
+class QueueFullError(ServingError):
+    """Admission rejected: the request queue is at capacity.
+
+    The backpressure signal — clients should retry with backoff (the
+    HTTP front end maps it to 429).
+    """
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline expired before a result was produced
+    (while queued, or because a fault-injected batch was delayed or
+    dropped past the deadline). Maps to HTTP 504."""
+
+
+class BadRequestError(ServingError):
+    """Malformed request payload (wrong feed names/shapes, prompt longer
+    than the model's context, non-positive max_new_tokens). Maps to
+    HTTP 400."""
+
+
+class EngineClosedError(ServingError):
+    """Submit after the server/engine was stopped."""

@@ -1,0 +1,494 @@
+"""Continuous batching for autoregressive generation (Orca-style).
+
+The one-shot ``transformer_stack_generate`` op decodes a fixed batch to a
+fixed horizon: a 64-token request and a 4-token request pay the same loop,
+and nobody can join until the whole batch drains. This engine replaces
+that with ITERATION-LEVEL scheduling over a slot table: the KV cache is a
+persistable scope tensor ``[L, slots+1, Hkv, Tmax, dh]``; each request
+claims a slot, a bucketed prefill scatters its prompt K/V into it
+(``transformer_stack_slot_prefill``), and ONE compiled decode step
+(``transformer_stack_slot_decode``) advances every occupied slot each
+tick — finished sequences vacate between ticks and queued requests join
+mid-flight. The decode step's shape depends only on the slot count, so
+the steady state is a single compile-cache entry; prefill compiles once
+per (batch-bucket, prompt-bucket) pair, all warmed up front.
+
+The extra slot (index ``slots``) is a scrap slot: padding rows of a
+partially-filled prefill bucket scatter their K/V there, keeping every
+compiled shape independent of how many requests actually arrived.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import profiler
+from ..core.executor import Executor, TPUPlace
+from ..core.program import Program, program_guard
+from ..core.scope import Scope
+from ..layers import data as data_layer
+from ..layers.layer_helper import LayerHelper
+from .batcher import Request
+from .errors import BadRequestError
+from .metrics import MetricsRegistry
+
+CACHE_K = "serving.cache_k"
+CACHE_V = "serving.cache_v"
+
+# decode-family op types whose attrs + shared weights describe a stacked LM
+_DECODE_OPS = ("transformer_stack_generate", "transformer_stack_beam_search",
+               "transformer_stack_speculative_generate",
+               "transformer_stack_slot_prefill",
+               "transformer_stack_slot_decode")
+
+
+@dataclasses.dataclass
+class LMSpec:
+    """Hyperparameters of a stacked transformer LM — everything the slot
+    programs need to rebuild the shared-by-name weights
+    (``transformer_lm(pipeline_stack=True)`` contract)."""
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    num_heads: int
+    num_kv_heads: Optional[int] = None
+    use_rope: bool = False
+    max_len: int = 2048
+    d_ff: Optional[int] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def spec_from_program_dict(pd: dict,
+                           max_len: Optional[int] = None) -> LMSpec:
+    """Derive an LMSpec from a saved generation program's dict (the
+    ``io.read_inference_model_meta``/``program_to_dict`` payload): decode
+    hyperparameters come from the decode op's attrs, sizes from the
+    shared parameter shapes."""
+    block = pd["blocks"][0]
+    op = next((o for o in block["ops"] if o["type"] in _DECODE_OPS), None)
+    if op is None:
+        raise ValueError(
+            "no stacked-LM decode op in the saved program — save an "
+            "inference model built from transformer_lm_generate (or "
+            "another transformer_stack_* decode program)")
+    attrs = op["attrs"]
+    shapes = {v["name"]: v["shape"] for v in block["vars"]}
+    if "tok_emb" not in shapes or "lm_stack.stack_qkv_w" not in shapes:
+        raise ValueError("saved program lacks the shared LM parameters "
+                         "(tok_emb / lm_stack.*)")
+    vocab, d_model = shapes["tok_emb"]
+    n_layers = shapes["lm_stack.stack_qkv_w"][0]
+    d_ff = shapes["lm_stack.stack_ff_w1"][2]
+    use_rope = bool(attrs.get("use_rope", False))
+    if max_len is None:
+        if "pos_emb" in shapes:
+            max_len = shapes["pos_emb"][0]
+        else:
+            raise ValueError("RoPE model has no pos_emb table to bound "
+                             "sequence length — pass max_len explicitly")
+    return LMSpec(vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+                  num_heads=attrs["num_heads"],
+                  num_kv_heads=attrs.get("num_kv_heads"),
+                  use_rope=use_rope, max_len=max_len, d_ff=d_ff)
+
+
+def _default_prompt_buckets(tmax: int) -> List[int]:
+    buckets, b = [], 8
+    while b < tmax:
+        buckets.append(b)
+        b *= 2
+    buckets.append(tmax)
+    return sorted(set(buckets))
+
+
+class _Slot:
+    __slots__ = ("request", "generated", "max_new", "eos_id", "prompt")
+
+    def __init__(self, request: Request, prompt: np.ndarray,
+                 max_new: int, eos_id: Optional[int]):
+        self.request = request
+        self.prompt = prompt
+        self.generated: List[int] = []
+        self.max_new = max_new
+        self.eos_id = eos_id
+
+
+class GenerationEngine:
+    """Slot-table continuous batcher over the stacked-LM decode ops."""
+
+    def __init__(self, spec: LMSpec, scope: Optional[Scope] = None, *,
+                 slots: int = 8, max_seq_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 prefill_batch_buckets: Optional[Sequence[int]] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 default_max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 place=None, metrics: Optional[MetricsRegistry] = None):
+        if slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.spec = spec
+        self.scope = scope or Scope()
+        self.slots = int(slots)
+        self.tmax = int(max_seq_len or spec.max_len)
+        if spec.use_rope is False and self.tmax > spec.max_len:
+            raise ValueError(f"max_seq_len {self.tmax} exceeds the "
+                             f"position table ({spec.max_len})")
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.eos_id = eos_id
+        self.pad_id = int(pad_id)
+        self._place = place
+        self.metrics = metrics or MetricsRegistry()
+        self.executor = Executor(place or TPUPlace(0))
+        self.prompt_buckets = sorted(set(
+            min(int(b), self.tmax) for b in
+            (prompt_buckets or _default_prompt_buckets(self.tmax))))
+        nb = prefill_batch_buckets
+        if nb is None:
+            nb, b = [], 1
+            while b < self.slots:
+                nb.append(b)
+                b *= 2
+            nb.append(self.slots)
+        self.prefill_batch_buckets = sorted(set(int(b) for b in nb))
+        # slot table: index `slots` is the scrap slot (prefill padding)
+        self._nslots = self.slots + 1
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._tok = np.zeros(self._nslots, np.int64)
+        self._pos = np.zeros(self._nslots, np.int32)
+        self._init_cache()
+        self._prefill_progs: Dict[int, tuple] = {}
+        self._decode_prog = self._build_decode()
+
+    # -- program/scope construction ------------------------------------
+    @classmethod
+    def from_saved(cls, model_dir: str, max_seq_len: Optional[int] = None,
+                   **kw) -> "GenerationEngine":
+        """Build from a ``save_inference_model`` directory holding a
+        stacked-LM generation program: hyperparameters are read from the
+        saved decode op, weights are loaded into a fresh scope."""
+        from ..io import load_inference_model, read_inference_model_meta
+
+        meta = read_inference_model_meta(model_dir)
+        spec = spec_from_program_dict(meta["program"], max_len=max_seq_len)
+        scope = kw.pop("scope", None) or Scope()
+        eng = cls(spec, scope, max_seq_len=max_seq_len, **kw)
+        load_inference_model(model_dir, eng.executor, scope=scope)
+        return eng
+
+    def _init_cache(self):
+        import jax.numpy as jnp
+
+        s = self.spec
+        shape = (s.n_layers, self._nslots, s.kv_heads, self.tmax,
+                 s.head_dim)
+        self.scope.set(CACHE_K, jnp.zeros(shape, jnp.float32))
+        self.scope.set(CACHE_V, jnp.zeros(shape, jnp.float32))
+
+    def _cache_vars(self, helper):
+        s = self.spec
+        shape = [s.n_layers, self._nslots, s.kv_heads, self.tmax,
+                 s.head_dim]
+        ck = helper.create_global_variable(name=CACHE_K, shape=shape,
+                                           dtype="float32")
+        cv = helper.create_global_variable(name=CACHE_V, shape=shape,
+                                           dtype="float32")
+        return ck, cv
+
+    def _lm_ins(self, helper):
+        from ..models.transformer import _shared_lm_params
+
+        s = self.spec
+        return _shared_lm_params(helper, s.vocab_size, s.d_model,
+                                 s.d_ff or 4 * s.d_model, s.max_len,
+                                 s.n_layers, s.num_heads, s.num_kv_heads,
+                                 s.use_rope)
+
+    def _decode_attrs(self):
+        return {"num_heads": self.spec.num_heads,
+                "num_kv_heads": self.spec.num_kv_heads,
+                "use_rope": self.spec.use_rope,
+                "temperature": self.temperature, "top_k": self.top_k}
+
+    def _build_prefill(self, tp: int):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            prompt = data_layer("serving.prompt", shape=[tp],
+                                dtype="int64")
+            slot_ids = data_layer("serving.slot_ids", shape=[],
+                                  dtype="int32")
+            lengths = data_layer("serving.lengths", shape=[],
+                                 dtype="int32")
+            helper = LayerHelper("serving_prefill", main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            nxt = helper.block.create_var(
+                name=prog.unique_name("serving.next_tok"), shape=[-1],
+                dtype="int64", stop_gradient=True)
+            ins = {"Prompt": [prompt], "SlotIds": [slot_ids],
+                   "Lengths": [lengths], "CacheK": [ck], "CacheV": [cv]}
+            ins.update(self._lm_ins(helper))
+            helper.append_op(
+                "transformer_stack_slot_prefill", ins,
+                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
+                self._decode_attrs())
+        return prog, nxt
+
+    def _build_decode(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            tok = data_layer("serving.tok", shape=[self._nslots],
+                             dtype="int64", append_batch_size=False)
+            pos = data_layer("serving.pos", shape=[self._nslots],
+                             dtype="int32", append_batch_size=False)
+            helper = LayerHelper("serving_decode", main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            nxt = helper.block.create_var(
+                name=prog.unique_name("serving.next_tok"),
+                shape=[self._nslots], dtype="int64", stop_gradient=True)
+            ins = {"Tok": [tok], "Pos": [pos], "CacheK": [ck],
+                   "CacheV": [cv]}
+            ins.update(self._lm_ins(helper))
+            helper.append_op(
+                "transformer_stack_slot_decode", ins,
+                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
+                self._decode_attrs())
+        return prog, nxt
+
+    def _prefill_prog(self, tp: int):
+        if tp not in self._prefill_progs:
+            self._prefill_progs[tp] = self._build_prefill(tp)
+        return self._prefill_progs[tp]
+
+    # -- bucket helpers -------------------------------------------------
+    def prompt_bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise BadRequestError(
+            f"prompt length {n} exceeds the largest prompt bucket "
+            f"{self.prompt_buckets[-1]}")
+
+    def _batch_bucket_for(self, n: int) -> int:
+        for b in self.prefill_batch_buckets:
+            if n <= b:
+                return b
+        return self.prefill_batch_buckets[-1]
+
+    # -- slot accounting ------------------------------------------------
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - self.active
+
+    def _device_ctx(self):
+        if self._place is not None:
+            import jax
+            return jax.default_device(self._place.device())
+        import contextlib
+        return contextlib.nullcontext()
+
+    # -- serving ---------------------------------------------------------
+    def warmup(self) -> int:
+        """Compile every prefill (batch-bucket x prompt-bucket) pair and
+        the decode step before traffic arrives. All warmup rows target
+        the scrap slot, so live slots are never polluted. Returns the
+        number of shapes compiled."""
+        combos = 0
+        if self.temperature > 0:
+            # sampled serving threads the scope RNG plane: seed it BEFORE
+            # warmup so the scope key set (part of the compile-cache key)
+            # is identical between warmup and live traffic
+            self.executor._rng_state(self._decode_prog[0], self.scope)
+        for tp in self.prompt_buckets:
+            prog, nxt = self._prefill_prog(tp)
+            for b in self.prefill_batch_buckets:
+                feed = {
+                    "serving.prompt": np.full((b, tp), self.pad_id,
+                                              np.int64),
+                    "serving.slot_ids": np.full(b, self.slots, np.int32),
+                    "serving.lengths": np.ones(b, np.int32),
+                }
+                with self._device_ctx():
+                    self.executor.run(prog, feed=feed, fetch_list=[nxt],
+                                      scope=self.scope)
+                combos += 1
+        with self._device_ctx():
+            self._run_decode()
+        combos += 1
+        self.metrics.inc("warmup_compiles", combos)
+        return combos
+
+    def _validate(self, req: Request):
+        try:
+            raw = (req.payload["prompt"] if isinstance(req.payload, dict)
+                   else req.payload)
+            prompt = np.asarray(raw, dtype=np.int64).reshape(-1)
+        except (KeyError, TypeError, ValueError) as exc:
+            raise BadRequestError(f"bad prompt payload: {exc}")
+        if prompt.size < 1:
+            raise BadRequestError("empty prompt")
+        max_new = int(req.meta.get("max_new_tokens")
+                      or self.default_max_new_tokens)
+        if max_new < 1:
+            raise BadRequestError("max_new_tokens must be >= 1")
+        if prompt.size + max_new > self.tmax:
+            raise BadRequestError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds the serving context ({self.tmax})")
+        self.prompt_bucket_for(prompt.size)  # raises when over-long
+        eos = req.meta.get("eos_id")
+        return prompt, max_new, self.eos_id if eos is None else eos
+
+    def admit(self, requests: List[Request]) -> int:
+        """Prefill a group of requests into free slots (one bucketed
+        batch). Returns the number admitted; invalid requests fail their
+        future and consume no slot."""
+        todo = []
+        for req in requests:
+            try:
+                todo.append((req, *self._validate(req)))
+            except BadRequestError as exc:
+                self.metrics.inc("bad_requests")
+                req.future.set_exception(exc)
+        if not todo:
+            return 0
+        free = [i for i in range(self.slots) if self._slots[i] is None]
+        if len(todo) > len(free):
+            raise RuntimeError(f"admit() got {len(todo)} requests for "
+                               f"{len(free)} free slots")
+        tp = self.prompt_bucket_for(max(p.size for _, p, _, _ in todo))
+        bucket = self._batch_bucket_for(len(todo))
+        prompt = np.full((bucket, tp), self.pad_id, np.int64)
+        slot_ids = np.full(bucket, self.slots, np.int32)  # scrap default
+        lengths = np.ones(bucket, np.int32)
+        for row, (req, p, max_new, eos) in enumerate(todo):
+            slot = free[row]
+            prompt[row, :p.size] = p
+            slot_ids[row] = slot
+            lengths[row] = p.size
+        prog, nxt = self._prefill_prog(tp)
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/prefill"):
+            first, = self.executor.run(
+                prog, feed={"serving.prompt": prompt,
+                            "serving.slot_ids": slot_ids,
+                            "serving.lengths": lengths},
+                fetch_list=[nxt], scope=self.scope)
+        self.metrics.observe_latency(time.perf_counter() - t0,
+                                     name="prefill")
+        self.metrics.inc("prefills")
+        self.metrics.set_gauge("prefill_occupancy", len(todo) / bucket)
+        first = np.asarray(first)
+        for row, (req, p, max_new, eos) in enumerate(todo):
+            slot = free[row]
+            st = _Slot(req, p, max_new, eos)
+            self._slots[slot] = st
+            self._tok[slot] = first[row]
+            self._pos[slot] = p.size
+            self._emit(slot, int(first[row]))
+        self._gauges()
+        return len(todo)
+
+    def _emit(self, slot: int, token: int) -> None:
+        st = self._slots[slot]
+        st.generated.append(token)
+        if (len(st.generated) >= st.max_new
+                or (st.eos_id is not None and token == st.eos_id)):
+            self._finish(slot)
+
+    def _finish(self, slot: int) -> None:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        ids = np.concatenate([st.prompt,
+                              np.asarray(st.generated, np.int64)])
+        st.request.future.set_result(ids)
+        self.metrics.inc("completed")
+        self.metrics.observe_latency(
+            time.monotonic() - st.request.enqueue_t)
+
+    def _run_decode(self):
+        prog, nxt = self._decode_prog
+        res, = self.executor.run(
+            prog, feed={"serving.tok": self._tok.copy(),
+                        "serving.pos": self._pos.copy()},
+            fetch_list=[nxt], scope=self.scope)
+        return np.asarray(res)
+
+    def decode_tick(self) -> bool:
+        """Advance every occupied slot one token (one compiled step).
+        Returns True when any slot was active."""
+        if self.active == 0:
+            return False
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/decode_step"):
+            nxt = self._run_decode()
+        self.metrics.observe_latency(time.perf_counter() - t0,
+                                     name="decode_step")
+        self.metrics.inc("decode_steps")
+        self.metrics.set_gauge("batch_occupancy", self.active / self.slots)
+        for slot in range(self.slots):
+            if self._slots[slot] is None:
+                continue
+            self._pos[slot] += 1
+            self._tok[slot] = nxt[slot]
+            self._emit(slot, int(nxt[slot]))
+        self._gauges()
+        return True
+
+    def _gauges(self):
+        self.metrics.set_gauge("active_slots", self.active)
+
+    def cache_stats(self) -> dict:
+        return self.executor.cache_stats()
+
+    # -- server-driver interface -----------------------------------------
+    def serve_step(self, batcher, idle_wait_s: Optional[float] = None) -> bool:
+        """One engine tick: admit queued requests into free slots (a
+        non-blocking grab while decoding, a coalescing wait when idle),
+        then advance the decode loop one step."""
+        did = False
+        free = self.free_slots
+        if free:
+            wait = 0 if self.active else idle_wait_s
+            reqs = batcher.next_batch(max_n=free, wait_s=wait)
+            if reqs:
+                did = self.admit(reqs) > 0
+        did = self.decode_tick() or did
+        return did
+
+    # -- synchronous convenience ------------------------------------------
+    def generate_all(self, prompts: Sequence[Sequence[int]],
+                     max_new_tokens: Optional[int] = None,
+                     eos_id: Optional[int] = None) -> List[np.ndarray]:
+        """Drive the continuous batcher to completion over a request list
+        (no server thread): requests stream into slots as they free up —
+        the in-process analogue of a loaded server."""
+        max_new = max_new_tokens or self.default_max_new_tokens
+        reqs = [Request({"prompt": p},
+                        {"max_new_tokens": max_new, "eos_id": eos_id},
+                        None)
+                for p in prompts]
+        pending = list(reqs)
+        while pending or self.active:
+            if pending and self.free_slots:
+                k = min(len(pending), self.free_slots)
+                self.admit(pending[:k])
+                pending = pending[k:]
+            self.decode_tick()
+        return [r.future.result(timeout=0.1) for r in reqs]

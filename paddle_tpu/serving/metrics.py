@@ -1,0 +1,112 @@
+"""Serving metrics registry: counters, gauges, latency quantiles.
+
+One process-local registry per Server/engine (no global singleton — tests
+and multi-engine processes keep their numbers separate). Everything is
+exported as a plain dict snapshot (JSON-safe: the HTTP front end serves it
+verbatim at /metrics) and can be published into :mod:`paddle_tpu.profiler`'s
+StatSet plane so ``print_all_status`` shows serving timers next to the
+training timers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, Optional
+
+# Latency reservoir size: enough for stable p99 at demo scale without
+# unbounded growth under sustained traffic (oldest samples fall off).
+_RESERVOIR = 4096
+# Sliding window for the QPS gauge.
+_QPS_WINDOW_S = 10.0
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+class MetricsRegistry:
+    """Thread-safe counters/gauges/latency-histograms for one server."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, float] = {}
+        self._latencies: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=_RESERVOIR))
+        self._completions = deque()  # timestamps for the QPS window
+        self._t0 = time.monotonic()
+
+    # -- write side --------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe_latency(self, seconds: float, name: str = "request") -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._latencies[name].append(float(seconds))
+            if name == "request":
+                self._completions.append(now)
+                cutoff = now - _QPS_WINDOW_S
+                while self._completions and self._completions[0] < cutoff:
+                    self._completions.popleft()
+
+    # -- read side ---------------------------------------------------------
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        """Plain-dict export: counters, gauges, per-name latency quantiles
+        (ms), windowed QPS, uptime. JSON-serializable by construction."""
+        now = time.monotonic()
+        with self._lock:
+            lat = {}
+            for name, buf in self._latencies.items():
+                vals = sorted(buf)
+                lat[name + "_ms"] = {
+                    "count": len(vals),
+                    "mean": (sum(vals) / len(vals) * 1e3) if vals else 0.0,
+                    "p50": _quantile(vals, 0.50) * 1e3,
+                    "p95": _quantile(vals, 0.95) * 1e3,
+                    "p99": _quantile(vals, 0.99) * 1e3,
+                }
+            cutoff = now - _QPS_WINDOW_S
+            qps_n = sum(1 for t in self._completions if t >= cutoff)
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "latency": lat,
+                "qps": qps_n / min(max(now - self._t0, 1e-9), _QPS_WINDOW_S),
+                "uptime_s": now - self._t0,
+            }
+
+    def publish_to_profiler(self, stat_set=None, prefix: str = "serving/"):
+        """Push the latency reservoirs into a profiler StatSet (the global
+        one by default) so serving quantile sources show up in
+        ``profiler.print_all_status`` alongside training timers."""
+        from .. import profiler
+
+        target = stat_set or profiler.global_stat
+        with self._lock:
+            items = [(n, list(buf)) for n, buf in self._latencies.items()]
+        for name, vals in items:
+            for v in vals:
+                target.add(prefix + name, v)
+        return target
+
+    def merge_timer_dict(self, timers: Optional[dict]) -> dict:
+        """snapshot() + a profiler StatSet.as_dict() payload in one dict
+        (the /metrics endpoint body)."""
+        snap = self.snapshot()
+        if timers:
+            snap["timers"] = timers
+        return snap

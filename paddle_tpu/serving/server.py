@@ -1,0 +1,201 @@
+"""Server front end: a dispatch thread over engines + a JSON HTTP endpoint.
+
+``Server`` owns the DynamicBatcher and a daemon dispatch loop that drives
+one or more engines' ``serve_step`` — an InferenceEngine executes whole
+batches, a GenerationEngine interleaves prefill admissions with decode
+ticks (continuous batching). Multiple engines round-robin the shared
+queue: the local-replica pattern (one engine per device via ``place``).
+
+The HTTP endpoint is stdlib ``http.server`` (no framework dependency —
+the container bakes none), JSON in/out:
+
+    POST /v1/generate  {"prompt": [ids], "max_new_tokens": n, "eos_id": e}
+                       -> {"ids": [...]}
+    POST /v1/infer     {"inputs": {feed: nested-list-row}}
+                       -> {"outputs": [...]}
+    GET  /metrics      -> MetricsRegistry snapshot + serving timers
+    GET  /healthz      -> {"ok": true, "active": ..., "queue": ...}
+
+Typed errors map onto status codes: QueueFullError -> 429,
+RequestTimeoutError -> 504, BadRequestError -> 400.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import profiler
+from .batcher import DynamicBatcher, Future
+from .errors import (BadRequestError, EngineClosedError, QueueFullError,
+                     RequestTimeoutError, ServingError)
+from .metrics import MetricsRegistry
+
+_IDLE_WAIT_S = 0.02  # dispatch-loop poll when the queue is empty
+
+
+class Server:
+    """Dispatch loop + admission queue over one or more engines."""
+
+    def __init__(self, engine, *, batcher: Optional[DynamicBatcher] = None,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_wait_ms: float = 5.0, max_queue: int = 256,
+                 default_timeout_ms: Optional[float] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.engines = list(engine) if isinstance(
+            engine, (list, tuple)) else [engine]
+        self.metrics = metrics or self.engines[0].metrics
+        self.batcher = batcher or DynamicBatcher(
+            buckets=batch_buckets, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, default_timeout_ms=default_timeout_ms,
+            metrics=self.metrics)
+        if self.batcher.metrics is None:
+            self.batcher.metrics = self.metrics
+        self._thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._running = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Server":
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="paddle-tpu-serving",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _loop(self) -> None:
+        idx = 0
+        while self._running:
+            engine = self.engines[idx % len(self.engines)]
+            idx += 1
+            try:
+                did = engine.serve_step(self.batcher,
+                                        idle_wait_s=_IDLE_WAIT_S)
+            except Exception:
+                # engine errors fail their requests individually; a crash
+                # here would silently stop dispatch — keep looping
+                self.metrics.inc("dispatch_errors")
+                did = False
+            if not did and len(self.engines) > 1:
+                continue  # try the next replica before idling
+
+    # -- in-process API ----------------------------------------------------
+    def submit(self, payload, timeout_ms: Optional[float] = None,
+               **meta) -> Future:
+        """Enqueue a request; returns a Future. Raises QueueFullError on
+        backpressure. For generation engines the payload is a prompt (or
+        {"prompt": ids}) with max_new_tokens/eos_id in ``meta``; for
+        inference engines it is a per-row feed dict."""
+        return self.batcher.submit(payload, timeout_ms=timeout_ms, **meta)
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout_s: Optional[float] = 60.0) -> np.ndarray:
+        """Blocking convenience wrapper around submit() for LM engines."""
+        fut = self.submit({"prompt": prompt},
+                          max_new_tokens=max_new_tokens, eos_id=eos_id)
+        return fut.result(timeout=timeout_s)
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.merge_timer_dict(
+            profiler.global_stat.as_dict(prefix="serving/"))
+        for i, eng in enumerate(self.engines):
+            if hasattr(eng, "cache_stats"):
+                snap[f"compile_cache/engine{i}"] = eng.cache_stats()
+        snap["queue_depth"] = self.batcher.depth
+        return snap
+
+    # -- HTTP front end ----------------------------------------------------
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the JSON endpoint on a daemon thread; returns the bound
+        port (pass port=0 for an ephemeral one)."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet: metrics carry the signal
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, server.metrics_snapshot())
+                elif self.path == "/healthz":
+                    self._send(200, {
+                        "ok": True,
+                        "queue": server.batcher.depth,
+                        "engines": len(server.engines),
+                    })
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, TypeError) as exc:
+                    self._send(400, {"error": f"bad JSON: {exc}"})
+                    return
+                try:
+                    if self.path == "/v1/generate":
+                        fut = server.submit(
+                            {"prompt": req["prompt"]},
+                            timeout_ms=req.get("timeout_ms"),
+                            max_new_tokens=req.get("max_new_tokens"),
+                            eos_id=req.get("eos_id"))
+                        ids = fut.result(timeout=req.get("timeout_s", 60))
+                        self._send(200, {"ids": np.asarray(ids).tolist()})
+                    elif self.path == "/v1/infer":
+                        inputs = {k: np.asarray(v)
+                                  for k, v in req["inputs"].items()}
+                        fut = server.submit(inputs,
+                                            timeout_ms=req.get("timeout_ms"))
+                        outs = fut.result(timeout=req.get("timeout_s", 60))
+                        self._send(200, {"outputs": [
+                            np.asarray(o).tolist() for o in outs]})
+                    else:
+                        self._send(404, {"error": "not found"})
+                except KeyError as exc:
+                    self._send(400, {"error": f"missing field {exc}"})
+                except BadRequestError as exc:
+                    self._send(400, {"error": str(exc)})
+                except QueueFullError as exc:
+                    self._send(429, {"error": str(exc)})
+                except (RequestTimeoutError, TimeoutError) as exc:
+                    self._send(504, {"error": str(exc) or "timed out"})
+                except (EngineClosedError, ServingError) as exc:
+                    self._send(503, {"error": str(exc)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="paddle-tpu-serving-http",
+                         daemon=True).start()
+        return self._httpd.server_address[1]

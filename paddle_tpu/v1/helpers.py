@@ -1174,6 +1174,9 @@ def selective_fc_layer(input, select, size, act=None, param_attr=None,
 
 
 def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
+    # reference order (trainer_config_helpers.layers.lambda_cost):
+    # ``input`` = the model's score output, ``score`` = the ground-truth
+    # relevance — forwarded positionally, NOT swapped
     return v2l.lambda_cost(input, score, NDCG_num=NDCG_num,
                            max_sort_size=max_sort_size)
 

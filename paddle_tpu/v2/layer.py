@@ -824,16 +824,18 @@ def selective_fc(input, select, size, act=None, param_attr=None,
 
 
 def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
-    """lambda_cost (LambdaRank): ``input`` is the relevance-label
-    sequence, ``score`` the model score sequence (reference CostLayer
-    LambdaCost input order)."""
+    """lambda_cost (LambdaRank): ``input`` is the MODEL SCORE sequence
+    (the network output, LambdaCost's first input in the reference
+    CostLayer.cpp), ``score`` the ground-truth relevance sequence —
+    the reference's counter-intuitive but load-bearing argument order,
+    which v1 configs depend on."""
     from ..layers.layer_helper import LayerHelper
     from ..layers.sequence import _len_input
 
     helper = LayerHelper("lambda_cost")
     return helper.simple_op(
         "lambda_cost",
-        {"Score": [score], "Label": [input], **_len_input(score)},
+        {"Score": [input], "Label": [score], **_len_input(input)},
         {"NDCG_num": int(NDCG_num),
          "max_sort_size": int(max_sort_size)})
 

@@ -1,0 +1,445 @@
+"""paddle_tpu.serving: dynamic batching, continuous decode, backpressure.
+
+Pins the four serving contracts: (1) the batcher's bucket/deadline
+coalescing and typed admission control, (2) the continuous batcher's
+token-exact parity with the one-shot transformer_lm_generate op —
+INCLUDING slot reuse and mid-flight joins, (3) per-request timeout
+semantics under fault-injected (delayed/dropped) batches, and (4) the
+zero-recompile steady state after warmup (the executor's compile-cache
+counters are the witness)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.serving import (BadRequestError, DynamicBatcher,
+                                GenerationEngine, InferenceEngine, LMSpec,
+                                QueueFullError, Request,
+                                RequestTimeoutError, Server)
+
+VOCAB, D, L, H, MAXLEN = 32, 16, 2, 2, 32
+
+
+# ---------------------------------------------------------------------------
+# batcher
+# ---------------------------------------------------------------------------
+class TestDynamicBatcher:
+    def test_deadline_dispatches_partial_bucket(self):
+        b = DynamicBatcher(buckets=(4, 8), max_wait_ms=30)
+        t0 = time.monotonic()
+        for _ in range(3):
+            b.submit({"x": 1})
+        batch = b.next_batch()
+        waited = time.monotonic() - t0
+        # 3 < largest bucket: dispatched at the deadline, not blocked
+        assert len(batch) == 3
+        assert 0.02 <= waited < 1.0
+        assert b.bucket_for(3) == 4 and b.bucket_for(5) == 8
+
+    def test_full_bucket_dispatches_immediately(self):
+        b = DynamicBatcher(buckets=(2,), max_wait_ms=10_000)
+        b.submit(1)
+        b.submit(2)
+        t0 = time.monotonic()
+        batch = b.next_batch()
+        assert len(batch) == 2
+        assert time.monotonic() - t0 < 1.0  # no deadline wait
+
+    def test_backpressure_rejects_typed(self):
+        from paddle_tpu.serving import MetricsRegistry
+
+        m = MetricsRegistry()
+        b = DynamicBatcher(buckets=(4,), max_queue=2, metrics=m)
+        b.submit(1)
+        b.submit(2)
+        with pytest.raises(QueueFullError):
+            b.submit(3)
+        assert m.counter("rejected_queue_full") == 1
+        assert m.counter("requests") == 2
+
+    def test_dropped_batch_requeues_then_times_out(self):
+        """Fault injection: a hook that drops the batch pushes the
+        requests back; once their deadline passes they complete with
+        RequestTimeoutError instead of hanging or executing late."""
+        b = DynamicBatcher(buckets=(4,), max_wait_ms=1,
+                           default_timeout_ms=40,
+                           fault_hook=lambda batch: "drop")
+        fut = b.submit({"x": 1})
+        assert b.next_batch() == []     # dropped -> requeued
+        assert not fut.done()            # still live before the deadline
+        time.sleep(0.05)
+        assert b.next_batch() == []     # expired at the next poll
+        with pytest.raises(RequestTimeoutError):
+            fut.result(timeout=1)
+
+    def test_delayed_batch_honors_request_deadline(self):
+        """A hook that merely DELAYS past the deadline: the batch is
+        re-checked after the hook and expired requests fail instead of
+        being executed late."""
+        b = DynamicBatcher(buckets=(4,), max_wait_ms=1,
+                           default_timeout_ms=30,
+                           fault_hook=lambda batch: time.sleep(0.06))
+        fut = b.submit({"x": 1})
+        assert b.next_batch() == []  # everything expired inside the hook
+        with pytest.raises(RequestTimeoutError):
+            fut.result(timeout=1)
+
+    def test_mixed_expiry_keeps_live_requests(self):
+        b = DynamicBatcher(buckets=(4,), max_wait_ms=1)
+        dead = b.submit(1, timeout_ms=10)
+        live = b.submit(2)  # no deadline
+        time.sleep(0.03)
+        batch = b.next_batch()
+        assert [r.payload for r in batch] == [2]
+        with pytest.raises(RequestTimeoutError):
+            dead.result(timeout=1)
+        assert not live.done()
+
+
+# ---------------------------------------------------------------------------
+# LM fixtures
+# ---------------------------------------------------------------------------
+def _init_lm_scope(seed=7, **lm_kwargs):
+    """Random-init the shared stacked-LM weights in a fresh scope (via a
+    generate program's startup) and return (scope, exe)."""
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data("p_init", shape=[8], dtype="int64")
+        models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=1, **lm_kwargs)
+    startup.random_seed = seed
+    exe.run(startup, scope=scope)
+    return scope, exe
+
+
+def _reference_decode(scope, exe, prompts, max_new, **lm_kwargs):
+    """One-shot transformer_lm_generate over a [b, Tp] prompt batch."""
+    tp = prompts.shape[1]
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data(f"p_ref{tp}_{max_new}", shape=[tp],
+                             dtype="int64")
+        out_ids = models.transformer_lm_generate(
+            prompt, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=max_new, **lm_kwargs)
+    got, = exe.run(prog, feed={f"p_ref{tp}_{max_new}": prompts},
+                   fetch_list=[out_ids], scope=scope)
+    return np.asarray(got)
+
+
+def _spec(**kw):
+    return LMSpec(vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+                  max_len=MAXLEN, **kw)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------------
+class TestContinuousBatching:
+    def test_slot_reuse_matches_one_shot_generate(self):
+        """More requests than slots with DIFFERENT per-request horizons:
+        finished sequences vacate and new ones take their slot, and
+        every emitted token equals the one-shot KV-cache decode."""
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(0)
+        prompts = rng.randint(0, VOCAB, (6, 8)).astype("int64")
+        ref_long = _reference_decode(scope, exe, prompts, 7)
+        eng = GenerationEngine(_spec(), scope, slots=2,
+                               prompt_buckets=(8, 16))
+        horizons = [7, 3, 5, 7, 3, 5]
+        reqs = [Request({"prompt": prompts[i]},
+                        {"max_new_tokens": horizons[i]}, None)
+                for i in range(6)]
+        pending = list(reqs)
+        while pending or eng.active:
+            k = min(len(pending), eng.free_slots)
+            if k:
+                eng.admit(pending[:k])
+                pending = pending[k:]
+            eng.decode_tick()
+        for i, r in enumerate(reqs):
+            got = r.future.result(timeout=1)
+            np.testing.assert_array_equal(got, ref_long[i, :8 + horizons[i]])
+        assert eng.metrics.counter("completed") == 6
+        # 6 requests through 2 slots: at least three prefill waves
+        assert eng.metrics.counter("prefills") >= 3
+
+    def test_midflight_join_is_token_exact(self):
+        """A request admitted while another is mid-decode must not
+        perturb either stream (the slot caches are independent)."""
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(1)
+        pa = rng.randint(0, VOCAB, (1, 8)).astype("int64")
+        pb = rng.randint(0, VOCAB, (1, 5)).astype("int64")
+        ra = _reference_decode(scope, exe, pa, 8)
+        rb = _reference_decode(scope, exe, pb, 6)
+        eng = GenerationEngine(_spec(), scope, slots=2,
+                               prompt_buckets=(8, 16))
+        req_a = Request({"prompt": pa[0]}, {"max_new_tokens": 8}, None)
+        req_b = Request({"prompt": pb[0]}, {"max_new_tokens": 6}, None)
+        eng.admit([req_a])
+        for _ in range(3):
+            eng.decode_tick()
+        eng.admit([req_b])  # joins while A is mid-flight
+        while eng.active:
+            eng.decode_tick()
+        np.testing.assert_array_equal(req_a.future.result(1), ra[0])
+        np.testing.assert_array_equal(req_b.future.result(1), rb[0])
+
+    def test_mixed_prompt_lengths_pad_to_bucket(self):
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(2)
+        lens = [3, 8, 11, 6]
+        prompts = [rng.randint(0, VOCAB, (n,)).astype("int64")
+                   for n in lens]
+        refs = [_reference_decode(scope, exe, p[None], 4)[0]
+                for p in prompts]
+        eng = GenerationEngine(_spec(), scope, slots=4,
+                               prompt_buckets=(4, 8, 16))
+        got = eng.generate_all(prompts, max_new_tokens=4)
+        for g, r in zip(got, refs):
+            np.testing.assert_array_equal(g, r)
+
+    def test_eos_vacates_slot_early(self):
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(3)
+        p = rng.randint(0, VOCAB, (1, 8)).astype("int64")
+        ref = _reference_decode(scope, exe, p, 8)[0]
+        eos = int(ref[8 + 2])  # the 3rd generated token
+        eng = GenerationEngine(_spec(), scope, slots=1,
+                               prompt_buckets=(8,))
+        got = eng.generate_all([p[0]], max_new_tokens=8, eos_id=eos)[0]
+        np.testing.assert_array_equal(got, ref[:8 + 3])  # stops AT eos
+
+    def test_zero_recompiles_after_warmup(self):
+        """THE serving acceptance gate: warm every bucket, then a full
+        multi-wave workload must add ZERO compile-cache misses."""
+        scope, _ = _init_lm_scope()
+        eng = GenerationEngine(_spec(), scope, slots=4,
+                               prompt_buckets=(8, 16),
+                               prefill_batch_buckets=(1, 2, 4))
+        eng.warmup()
+        misses0 = eng.cache_stats()["misses"]
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, VOCAB, (rng.randint(2, 15),))
+                   .astype("int64") for _ in range(24)]
+        eng.generate_all(prompts, max_new_tokens=5)
+        stats = eng.cache_stats()
+        assert stats["misses"] == misses0, stats
+        assert stats["hits"] > 0
+        snap = eng.metrics.snapshot()
+        assert snap["counters"]["completed"] == 24
+        assert "decode_step_ms" in snap["latency"]
+
+    def test_gqa_rope_variant(self):
+        scope, exe = _init_lm_scope(use_rope=True, num_kv_heads=1)
+        rng = np.random.RandomState(5)
+        prompts = rng.randint(0, VOCAB, (3, 8)).astype("int64")
+        ref = _reference_decode(scope, exe, prompts, 5, use_rope=True,
+                                num_kv_heads=1)
+        eng = GenerationEngine(_spec(use_rope=True, num_kv_heads=1),
+                               scope, slots=2, prompt_buckets=(8,),
+                               max_seq_len=MAXLEN)
+        got = np.stack(eng.generate_all(list(prompts), max_new_tokens=5))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_bad_requests_fail_typed_without_slot_leak(self):
+        scope, _ = _init_lm_scope()
+        eng = GenerationEngine(_spec(), scope, slots=2,
+                               prompt_buckets=(8,))
+        too_long = Request({"prompt": np.arange(30) % VOCAB},
+                           {"max_new_tokens": 8}, None)
+        empty = Request({"prompt": np.zeros(0, np.int64)}, {}, None)
+        assert eng.admit([too_long, empty]) == 0
+        with pytest.raises(BadRequestError):
+            too_long.future.result(timeout=1)
+        with pytest.raises(BadRequestError):
+            empty.future.result(timeout=1)
+        assert eng.free_slots == 2
+
+    def test_save_load_roundtrip_from_saved(self, tmp_path):
+        """save_inference_model of a generation program -> engine: the
+        spec is recovered from the saved decode op and the weights serve
+        identical tokens."""
+        scope, exe = _init_lm_scope()
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("p_save", shape=[8], dtype="int64")
+            out_ids = models.transformer_lm_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=4)
+        d = str(tmp_path / "lm")
+        pt.io.save_inference_model(d, ["p_save"], [out_ids], exe,
+                                   main_program=prog, scope=scope)
+        rng = np.random.RandomState(6)
+        prompts = rng.randint(0, VOCAB, (2, 8)).astype("int64")
+        ref = _reference_decode(scope, exe, prompts, 4)
+        eng = GenerationEngine.from_saved(d, slots=2, prompt_buckets=(8,))
+        assert eng.spec.n_layers == L and eng.spec.vocab_size == VOCAB
+        got = np.stack(eng.generate_all(list(prompts), max_new_tokens=4))
+        np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# dense engine + server
+# ---------------------------------------------------------------------------
+def _save_dense_model(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[6])
+        y = layers.fc(x, size=4, act="softmax",
+                      param_attr=pt.ParamAttr(name="dense_w"))
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    startup.random_seed = 11
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "dense")
+    pt.io.save_inference_model(d, ["x"], [y], exe, main_program=main,
+                               scope=scope)
+    x5 = np.random.RandomState(0).rand(5, 6).astype(np.float32)
+    ref, = exe.run(main, feed={"x": x5}, fetch_list=[y], scope=scope)
+    return d, x5, np.asarray(ref)
+
+
+class TestInferenceEngine:
+    def test_bucket_padding_and_warm_cache(self, tmp_path):
+        d, x5, ref = _save_dense_model(tmp_path)
+        eng = InferenceEngine(d, batch_buckets=(2, 8))
+        assert eng.warmup() == 2
+        misses0 = eng.cache_stats()["misses"]
+        got, = eng.run({"x": x5})  # 5 -> bucket 8, sliced back
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        got1, = eng.run({"x": x5[:1]})  # 1 -> bucket 2
+        np.testing.assert_allclose(got1, ref[:1], rtol=1e-5, atol=1e-6)
+        big = np.concatenate([x5, x5, x5])  # 15 -> chunked 8 + 8(pad)
+        gotb, = eng.run({"x": big})
+        np.testing.assert_allclose(gotb, np.concatenate([ref] * 3),
+                                   rtol=1e-5, atol=1e-6)
+        assert eng.cache_stats()["misses"] == misses0
+
+    def test_server_round_trips_futures(self, tmp_path):
+        d, x5, ref = _save_dense_model(tmp_path)
+        eng = InferenceEngine(d, batch_buckets=(1, 4))
+        eng.warmup()
+        with Server(eng, batch_buckets=(1, 4), max_wait_ms=5) as srv:
+            futs = [srv.submit({"x": x5[i]}) for i in range(5)]
+            for i, f in enumerate(futs):
+                out, = f.result(timeout=30)
+                np.testing.assert_allclose(out, ref[i], rtol=1e-5,
+                                           atol=1e-6)
+        snap = eng.metrics.snapshot()
+        assert snap["counters"]["completed"] == 5
+
+    def test_mesh_data_parallel_replicas(self, tmp_path):
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        from paddle_tpu.parallel import make_mesh
+
+        d, x5, ref = _save_dense_model(tmp_path)
+        mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+        eng = InferenceEngine(d, batch_buckets=(2, 8), mesh=mesh)
+        # buckets rounded up to the dp size
+        assert all(b % 4 == 0 for b in eng.batch_buckets)
+        eng.warmup()
+        got, = eng.run({"x": x5})
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestCapiReroute:
+    def test_engine_machine_runs_and_generates(self, tmp_path):
+        """The capi surface over the serving engine: run() matches the
+        executor and generate() walks the shared host decode loop —
+        available with NO C++ toolchain."""
+        from paddle_tpu.capi import inference_machine
+
+        T = 6
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids_c", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=T)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        startup.random_seed = 13
+        exe.run(startup, scope=scope)
+        d = str(tmp_path / "lm_capi")
+        pt.io.save_inference_model(d, ["ids_c"], [logits], exe,
+                                   main_program=main, scope=scope)
+        x = np.random.RandomState(0).randint(0, VOCAB, (2, T))
+        ref, = exe.run(main, feed={"ids_c": x}, fetch_list=[logits],
+                       scope=scope)
+        with inference_machine(d, backend="engine",
+                               batch_buckets=(2, 4)) as machine:
+            assert machine.feed_names == ["ids_c"]
+            got, = machine.run({"ids_c": x})
+            np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                       atol=1e-5)
+            # greedy static-seq_len decode through the warm engine
+            prompt = x[:, :2]
+            out = machine.generate(prompt, max_new_tokens=3, seq_len=T)
+            assert out.shape == (2, 5)
+            np.testing.assert_array_equal(out[:, :2], prompt)
+            misses = machine.engine.cache_stats()["misses"]
+            out2 = machine.generate(prompt, max_new_tokens=3, seq_len=T)
+            np.testing.assert_array_equal(out, out2)
+            # the second decode reuses the one compiled step shape
+            assert machine.engine.cache_stats()["misses"] == misses
+
+
+class TestServerGeneration:
+    def test_http_endpoint_serves_generate_and_metrics(self):
+        import json
+        import urllib.request
+
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(8)
+        p = rng.randint(0, VOCAB, (1, 8)).astype("int64")
+        ref = _reference_decode(scope, exe, p, 4)[0]
+        eng = GenerationEngine(_spec(), scope, slots=2,
+                               prompt_buckets=(8,))
+        eng.warmup()
+        with Server(eng, max_wait_ms=2) as srv:
+            port = srv.serve_http(port=0)
+            body = json.dumps({"prompt": p[0].tolist(),
+                               "max_new_tokens": 4}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                ids = json.loads(resp.read())["ids"]
+            np.testing.assert_array_equal(np.asarray(ids), ref)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                snap = json.loads(r.read())
+            assert snap["counters"]["completed"] >= 1
+            assert "compile_cache/engine0" in snap
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                assert json.loads(r.read())["ok"] is True
+
+    def test_concurrent_submits_through_server(self):
+        """Threaded submits + the continuous loop: every future resolves
+        with the exact one-shot decode."""
+        scope, exe = _init_lm_scope()
+        rng = np.random.RandomState(9)
+        prompts = rng.randint(0, VOCAB, (10, 8)).astype("int64")
+        ref = _reference_decode(scope, exe, prompts, 4)
+        eng = GenerationEngine(_spec(), scope, slots=3,
+                               prompt_buckets=(8,),
+                               prefill_batch_buckets=(1, 2, 3))
+        eng.warmup()
+        with Server(eng, max_wait_ms=2, max_queue=64) as srv:
+            futs = [srv.submit({"prompt": prompts[i]}, max_new_tokens=4)
+                    for i in range(10)]
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(f.result(timeout=60),
+                                              ref[i])
+        assert eng.metrics.counter("completed") == 10

@@ -79,19 +79,59 @@ def test_selective_fc_masks_unselected():
 
 
 def test_lambda_cost_orders_scores():
-    """Perfectly ordered scores cost less than inverted ones, and the
-    cost trains a linear scorer to rank correctly."""
+    """Perfectly ordered scores cost less than inverted ones. Reference
+    argument order (CostLayer.cpp LambdaCost): the FIRST argument is the
+    model's score output, the second the ground-truth relevance."""
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
-        rel = L.data("rel", shape=[5])
         sc = L.data("sc", shape=[5])
-        cost = l2.lambda_cost(rel, sc, NDCG_num=5)
+        rel = L.data("rel", shape=[5])
+        cost = l2.lambda_cost(sc, rel, NDCG_num=5)
     relv = np.array([[3, 2, 1, 0, 0]], "float32")
     good = np.array([[5, 4, 3, 2, 1]], "float32")
     bad = np.array([[1, 2, 3, 4, 5]], "float32")
     g, = _run([cost], {"rel": relv, "sc": good}, main, startup)
     b, = _run([cost], {"rel": relv, "sc": bad}, main, startup)
     assert float(g[0]) < float(b[0])
+
+
+def test_lambda_cost_max_sort_size_gates_anchor_only():
+    """Truncated-sort mode (LambdaCost::calcGrad): only the HIGHER-
+    relevance anchor must rank inside the top max_sort_size; pairs whose
+    partner ranks outside still contribute — so the truncated cost sits
+    strictly between zero and the untruncated cost when relevant items
+    rank below the cut."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        sc = L.data("sc", shape=[6])
+        rel = L.data("rel", shape=[6])
+        full = l2.lambda_cost(sc, rel, NDCG_num=6)
+        cut = l2.lambda_cost(sc, rel, NDCG_num=6, max_sort_size=2)
+    # scores rank items as [s0 s1 | s2 s3 s4 s5]; the only relevant item
+    # (rel=2) sits at rank 2 — OUTSIDE the top-2 cut
+    relv = np.array([[0, 0, 2, 0, 0, 1]], "float32")
+    scv = np.array([[6, 5, 4, 3, 2, 1]], "float32")
+    f, c = _run([full, cut], {"rel": relv, "sc": scv}, main, startup)
+    # both anchors (ranks 2 and 5) are outside the top-2: truncation
+    # must zero the cost even though partners rank inside
+    assert float(f[0]) > 0
+    assert float(c[0]) == 0
+    # move the rel=2 anchor into the cut (rank 0): its pairs against ALL
+    # lower-relevance partners count, including partners beyond the cut
+    scv2 = np.array([[1, 5, 6, 3, 2, 4]], "float32")
+    f2, c2 = _run([full, cut], {"rel": relv, "sc": scv2}, main, startup)
+    assert 0 < float(c2[0]) < float(f2[0]) + 1e-6
+    # with the pair-side (old, wrong) gating, the rank-0 anchor's pairs
+    # against partners ranked >= 2 would vanish; anchor-side gating
+    # keeps them: the truncated cost must count pairs whose partner is
+    # outside the cut. rel=1 at rank 5 contributes nothing (anchor out).
+    # Hand-count: anchor rank0 pairs vs the five rel<2 partners all
+    # survive => cut == those pairs' sum under the full delta/loss —
+    # equality with a full cost computed on a list where the OTHER
+    # anchor (rel=1) is removed is checked structurally instead:
+    relv3 = np.array([[0, 0, 2, 0, 0, 0]], "float32")
+    f3, c3 = _run([full, cut], {"rel": relv3, "sc": scv2}, main, startup)
+    np.testing.assert_allclose(float(c3[0]), float(f3[0]), rtol=1e-6)
 
 
 def test_cross_entropy_with_selfnorm_formula():
