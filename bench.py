@@ -329,6 +329,69 @@ def bench_inference(jax, pt, layers, models, name, batch=16, hw=224,
             "vs_baseline": round(batch / sec / INFER_BASELINES[name], 1)}
 
 
+def bench_transpiler(jax, pt, layers, models, name="resnet50", batch=16,
+                     hw=224, steps=30, epilogue=True):
+    """Transpiled-vs-raw inference through the deployment path: op count,
+    compile wall-time, and steady-state latency for the pruned-only
+    program vs the same program through transpiler.inference_pipeline()
+    (dropout→scale, constant folding, fused-kernel rewrites, BN folding).
+    ``epilogue=True`` forces the conv1x1_bn_act fusion on (the
+    deployment-tuned path) regardless of --fused_conv_epilogue. The
+    transpiler's own wall time is reported separately — it is paid once
+    per deployment, not per request."""
+    import numpy as np
+
+    build = {
+        "resnet50": lambda img: models.resnet_imagenet(
+            img, num_classes=1000, depth=50, is_test=True),
+        "vgg19": lambda img: models.vgg(img, num_classes=1000, depth=19,
+                                        is_test=True),
+    }[name]
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        images = layers.data("images", shape=[hw, hw, 3])
+        logits = build(images)
+    scope = pt.Scope()
+    pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    img = jax.device_put(rng.rand(batch, hw, hw, 3).astype("float32"))
+
+    raw = pt.io.prune_program(main_prog, ["images"], [logits.name])
+    opt_scope = pt.Scope(parent=scope)
+    pm = pt.transpiler.inference_pipeline(epilogue=epilogue or None)
+    t0 = time.perf_counter()
+    opt = pm.run(main_prog.clone(), ["images"], [logits.name],
+                 scope=opt_scope)
+    transpile_ms = (time.perf_counter() - t0) * 1e3
+
+    def measure(prog, run_scope):
+        exe = pt.Executor(pt.TPUPlace())
+        t0 = time.perf_counter()
+        exe.run(prog, feed={"images": img}, fetch_list=[logits.name],
+                scope=run_scope)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            o, = exe.run(prog, feed={"images": img},
+                         fetch_list=[logits.name], scope=run_scope,
+                         return_numpy=False)
+        np.asarray(o)
+        return compile_s, (time.perf_counter() - t0) / steps
+
+    raw_compile, raw_step = measure(raw, scope)
+    opt_compile, opt_step = measure(opt, opt_scope)
+    return {
+        "raw_ops": len(raw.global_block.ops),
+        "transpiled_ops": len(opt.global_block.ops),
+        "transpile_ms": round(transpile_ms, 1),
+        "raw_compile_s": round(raw_compile, 3),
+        "transpiled_compile_s": round(opt_compile, 3),
+        "raw_ms_per_batch": round(raw_step * 1e3, 3),
+        "transpiled_ms_per_batch": round(opt_step * 1e3, 3),
+        "pass_stats": pm.stats(),
+    }
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -637,6 +700,8 @@ def run_bench(platform):
         for name in INFER_BASELINES:
             step("infer_" + name, bench_inference, jax, pt, layers, models,
                  name)
+        step("transpiler_resnet50", bench_transpiler, jax, pt, layers,
+             models, "resnet50")
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

@@ -101,6 +101,20 @@ def main():
                          input=rows)
     print(f"[{config}] predicted labels:",
           np.argmax(probs, axis=1).tolist())
+
+    # Deployment view: run the transpiler's inference pipeline over the
+    # pruned serving program and show the per-pass stats table (wall time
+    # + op-count deltas — the same numbers the serving engines publish
+    # into their MetricsRegistry).
+    from paddle_tpu import Scope, transpiler
+
+    prog = parameters.test_program_for([output])
+    feeds = [v.name for v in parameters.data_vars(program=prog)]
+    pm = transpiler.inference_pipeline()
+    pm.run(prog, feeds, [output.name],
+           scope=Scope(parent=parameters.scope))
+    print(f"[{config}] transpiler pass stats:")
+    print(pm.format_stats())
     return result.cost
 
 

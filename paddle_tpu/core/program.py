@@ -127,6 +127,28 @@ class Operator:
     def output_names(self) -> List[str]:
         return [n for vs in self.outputs.values() for n in vs]
 
+    # -- single-name slot accessors (pattern matching sugar) ---------------
+    def input(self, slot: str) -> Optional[str]:
+        """The single var name in an input slot, or None when the slot is
+        absent/empty. Raises if the slot holds more than one name (a
+        pattern matcher that assumed single-arity would silently mismatch
+        multi-input slots like ``sum``'s otherwise)."""
+        names = self.inputs.get(slot) or []
+        if len(names) > 1:
+            raise ValueError(
+                f"op {self.type!r} input slot {slot!r} has {len(names)} "
+                f"names; use .inputs[{slot!r}] for multi-arity slots")
+        return names[0] if names else None
+
+    def output(self, slot: str) -> Optional[str]:
+        """Single-name accessor for an output slot (see ``input``)."""
+        names = self.outputs.get(slot) or []
+        if len(names) > 1:
+            raise ValueError(
+                f"op {self.type!r} output slot {slot!r} has {len(names)} "
+                f"names; use .outputs[{slot!r}] for multi-arity slots")
+        return names[0] if names else None
+
     def __repr__(self):
         ins = {k: v for k, v in self.inputs.items()}
         outs = {k: v for k, v in self.outputs.items()}
@@ -210,6 +232,77 @@ class Block:
 
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- pattern-match / rewrite helpers (transpiler plane) ----------------
+    def var_producers(self) -> Dict[str, List[Tuple[int, "Operator"]]]:
+        """name -> [(op index, op)] of every op writing that name, in
+        program order. Aliased state (batch_norm's MeanOut writing onto
+        Mean) shows up as multiple producers — matchers must handle it."""
+        prod: Dict[str, List[Tuple[int, Operator]]] = {}
+        for i, op in enumerate(self.ops):
+            for name in op.output_names():
+                prod.setdefault(name, []).append((i, op))
+        return prod
+
+    def var_consumers(self) -> Dict[str, List[Tuple[int, "Operator"]]]:
+        """name -> [(op index, op)] of every op reading that name."""
+        cons: Dict[str, List[Tuple[int, Operator]]] = {}
+        for i, op in enumerate(self.ops):
+            seen = set()
+            for name in op.input_names():
+                if name in seen:
+                    continue
+                seen.add(name)
+                cons.setdefault(name, []).append((i, op))
+        return cons
+
+    def sole_producer(self, name: str,
+                      producers=None) -> Optional["Operator"]:
+        """The op producing ``name`` iff exactly one op writes it."""
+        ps = (producers if producers is not None
+              else self.var_producers()).get(name, [])
+        return ps[0][1] if len(ps) == 1 else None
+
+    def replace_ops(self, old_ops: Sequence["Operator"], op_type: str,
+                    inputs=None, outputs=None, attrs=None) -> "Operator":
+        """Replace a matched op chain with ONE new op, inserted at the
+        position of the last replaced op so every input is still produced
+        upstream and every consumer still reads downstream. The core
+        rewrite primitive for fusion passes."""
+        idxs = []
+        for op in old_ops:
+            for i, o in enumerate(self.ops):
+                if o is op:
+                    idxs.append(i)
+                    break
+            else:
+                raise ValueError(f"op {op.type!r} not in block {self.idx}")
+        at = max(idxs)
+        new = Operator(self, op_type, inputs or {}, outputs or {}, attrs)
+        self.ops[at] = new
+        drop = set(idxs) - {at}
+        self.ops = [o for i, o in enumerate(self.ops) if i not in drop]
+        self.program._bump()
+        return new
+
+    def remove_ops(self, old_ops: Sequence["Operator"]) -> None:
+        olds = {id(op) for op in old_ops}
+        self.ops = [o for o in self.ops if id(o) not in olds]
+        self.program._bump()
+
+    def drop_unused_vars(self, keep: Sequence[str] = ()) -> List[str]:
+        """Drop vars referenced by no op (transpile cleanup). ``keep``
+        names (feeds/fetches) survive regardless. Returns dropped names."""
+        used = set(keep)
+        for op in self.ops:
+            used.update(op.input_names())
+            used.update(op.output_names())
+        dropped = [n for n in self.vars if n not in used]
+        for n in dropped:
+            del self.vars[n]
+        if dropped:
+            self.program._bump()
+        return dropped
 
 
 class Program:

@@ -29,7 +29,8 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "read_inference_model_meta",
-    "program_to_dict", "program_from_dict",
+    "program_to_dict", "program_from_dict", "prune_program",
+    "transpile_saved_model", "quantize_inference_model",
 ]
 
 
@@ -170,56 +171,40 @@ def prune_program(program: Program, feed_names: List[str],
     """Slice the program to the subgraph producing ``fetch_names`` from
     ``feed_names`` (the reference's prune.cc / inference_optimize).
 
-    ``for_test`` flips every op's ``is_test`` attr like the reference's
-    inference_optimize — a saved inference model must read running BN
-    stats and use deterministic dropout even when pruned straight from a
-    training program. Composite ``seg_fwd`` ops (recompute segments,
-    core/backward.py) are expanded back into their plain forward ops
-    first: checkpointing only matters when training, and a flat op list
-    keeps the saved artifact consumable by every backend (including the
-    native C machine)."""
-    pruned = program.clone()
-    block = pruned.global_block
-    flat = []
-    for op in block.ops:
-        if op.type == "seg_fwd":
-            from .core.program import Operator
+    Runs the transpiler's ``prune_pipeline`` on a clone: composite
+    ``seg_fwd`` recompute segments flatten back to plain forward ops
+    (checkpointing only matters when training, and a flat op list keeps
+    the saved artifact consumable by every backend including the native
+    C machine), ``for_test`` canonicalizes every ``is_test`` attr, and
+    dead-op elimination takes the backward slice from the fetches."""
+    from .transpiler import prune_pipeline
 
-            for sop in op.attrs["seg_ops"]:
-                flat.append(Operator(block, sop["type"], sop["ins"],
-                                     sop["outs"], sop["attrs"]))
-        else:
-            flat.append(op)
-    if for_test:
-        for op in flat:
-            if "is_test" in op.attrs:
-                op.attrs = dict(op.attrs)
-                op.attrs["is_test"] = True
-    block.ops = flat
-    needed = set(fetch_names)
-    keep = []
-    for op in reversed(block.ops):
-        if any(o in needed for o in op.output_names()):
-            keep.append(op)
-            needed.update(n for n in op.input_names() if n not in feed_names)
-    keep.reverse()
-    block.ops = keep
-    used = set(feed_names) | set(fetch_names)
-    for op in keep:
-        used.update(op.input_names())
-        used.update(op.output_names())
-    block.vars = {n: v for n, v in block.vars.items() if n in used}
-    return pruned
+    return prune_pipeline(for_test=for_test).run(
+        program.clone(), feed_names, fetch_names)
 
 
 def save_inference_model(dirname: str, feeded_var_names: List[str],
                          target_vars: List[Variable], executor,
-                         main_program: Optional[Program] = None, scope=None):
-    """Prune to the inference subgraph and persist program + params
-    (reference io.py:165 save_inference_model)."""
+                         main_program: Optional[Program] = None, scope=None,
+                         transpile: bool = True):
+    """Prune to the inference subgraph, run the transpiler's inference
+    pipeline (dropout→scale, constant folding, BN folding, fused-kernel
+    rewrites — ``transpile=False`` restores the plain prune), and persist
+    program + params (reference io.py:165 save_inference_model).
+
+    Weight-rewriting passes write NEW names into a child scope; the
+    caller's scope is never mutated."""
     program = main_program or default_main_program()
     fetch_names = [v.name if hasattr(v, "name") else v for v in target_vars]
     pruned = prune_program(program, feeded_var_names, fetch_names)
+    save_scope = scope or global_scope()
+    if transpile:
+        from .transpiler import inference_pipeline
+
+        work_scope = Scope(parent=save_scope)
+        pruned = inference_pipeline().run(
+            pruned, feeded_var_names, fetch_names, scope=work_scope)
+        save_scope = work_scope
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump({
@@ -228,11 +213,57 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
             "fetch_names": fetch_names,
         }, f)
     save_vars(executor, os.path.join(dirname, "params"),
-              main_program=pruned, predicate=_is_persistable, scope=scope)
+              main_program=pruned, predicate=_is_persistable,
+              scope=save_scope)
+
+
+def _load_saved_params(dirname: str) -> Scope:
+    """Load a saved model's params/ directory into a fresh host Scope
+    (numpy arrays; no executor involved) for offline transpilation."""
+    scope = Scope()
+    with open(os.path.join(dirname, "params", "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    for entry in manifest:
+        arr = np.load(os.path.join(dirname, "params", entry["file"]))
+        if entry.get("dtype"):
+            import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+
+            arr = arr.view(np.dtype(entry["dtype"]))
+        scope.set(entry["name"], arr)
+    return scope
+
+
+def transpile_saved_model(dirname: str, out_dirname: str, pipeline=None):
+    """Re-run a transpile pipeline over an already-saved inference model,
+    writing a new saved-model directory. Defaults to the transpiler's
+    ``deployment_pipeline`` — the portable form with fused ops lowered
+    back to folded conv2d + bias add, which is what int8 weight
+    quantization and the native C machine want. Returns the PassManager
+    (``.stats()`` has the per-pass numbers)."""
+    from .transpiler import deployment_pipeline
+
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        payload = json.load(f)
+    program = program_from_dict(payload["program"])
+    scope = _load_saved_params(dirname)
+    pm = pipeline or deployment_pipeline()
+    program = pm.run(program, payload["feed_names"],
+                     payload["fetch_names"], scope=scope)
+    os.makedirs(out_dirname, exist_ok=True)
+    with open(os.path.join(out_dirname, "__model__.json"), "w") as f:
+        json.dump({
+            "program": program_to_dict(program),
+            "feed_names": payload["feed_names"],
+            "fetch_names": payload["fetch_names"],
+        }, f)
+    save_vars(None, os.path.join(out_dirname, "params"),
+              main_program=program, predicate=_is_persistable, scope=scope)
+    return pm
 
 
 def quantize_inference_model(dirname: str, out_dirname: str,
-                             min_elems: int = 1024) -> List[str]:
+                             min_elems: int = 1024,
+                             transpile: bool = True) -> List[str]:
     """Weight-only per-output-channel int8 quantization of a saved
     inference model, for the C machine (beyond-reference; the reference
     era predates int8 deployment).
@@ -250,7 +281,31 @@ def quantize_inference_model(dirname: str, out_dirname: str,
       bytes).
     Weights with any other/shared use stay f32. The quantized directory
     is C-machine-only (the Python executor load path expects the f32
-    manifest)."""
+    manifest).
+
+    ``transpile`` (default) first runs the transpiler's deployment
+    pipeline over the saved model: batch_norm folds into the preceding
+    conv/mul weights and fused ``conv1x1_bn_act`` ops lower to plain
+    folded conv2d — so weights that were locked up in BN-adjacent or
+    fused forms become int8-eligible (strictly more parameter bytes
+    quantize on conv+BN models)."""
+    import shutil
+    import tempfile
+
+    tmpdir = None
+    if transpile:
+        tmpdir = tempfile.mkdtemp(prefix="quant_transpile_")
+        transpile_saved_model(dirname, tmpdir)
+        dirname = tmpdir
+    try:
+        return _quantize_saved_model(dirname, out_dirname, min_elems)
+    finally:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _quantize_saved_model(dirname: str, out_dirname: str,
+                          min_elems: int) -> List[str]:
     import shutil
 
     with open(os.path.join(dirname, "__model__.json")) as f:

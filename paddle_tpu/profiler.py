@@ -58,6 +58,15 @@ class StatSet:
         with self._lock:
             self._stats[name].add(dt)
 
+    def add_count(self, name, n):
+        """Record a unitless count (op-count deltas, sizes) in the same
+        plane as the timers: stored pre-divided by 1e3 so the ms-scaled
+        table/as_dict columns read back as the raw count. Keeps counts
+        and timers in ONE snapshot (the transpiler publishes per-pass
+        wall time AND op deltas side by side)."""
+        with self._lock:
+            self._stats[name].add(n / 1e3)
+
     def reset(self):
         with self._lock:
             self._stats.clear()

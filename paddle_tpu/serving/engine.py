@@ -53,7 +53,8 @@ class InferenceEngine:
                  batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
                  seq_buckets: Optional[Sequence[int]] = None,
                  mesh=None, plan=None, place=None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 transpile: Optional[bool] = None):
         self.metrics = metrics or MetricsRegistry()
         self.scope = scope or Scope()
         self.mesh = mesh
@@ -72,6 +73,21 @@ class InferenceEngine:
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        # Transpile before warmup (default only for models we own the copy
+        # of, i.e. loaded from disk): the inference pipeline re-runs — a
+        # no-op on already-transpiled artifacts, the full rewrite set on
+        # raw ones — and its per-pass stats land in the MetricsRegistry.
+        if transpile is None:
+            transpile = model_dir is not None
+        if transpile:
+            from ..transpiler import inference_pipeline
+
+            pm = inference_pipeline()
+            self.program = pm.run(self.program.clone(), self.feed_names,
+                                  self.fetch_names, scope=self.scope,
+                                  preserve_state_writes=True)
+            for k, v in pm.metrics_dict().items():
+                self.metrics.set_gauge(k, v)
         if mesh is not None:
             dp = int(np.prod(mesh.devices.shape))
             batch_buckets = _round_buckets(batch_buckets, dp)

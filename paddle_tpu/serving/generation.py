@@ -243,6 +243,9 @@ class GenerationEngine:
                 "transformer_stack_slot_prefill", ins,
                 {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
                 self._decode_attrs())
+        self._transpile(prog, ["serving.prompt", "serving.slot_ids",
+                               "serving.lengths"], [nxt.name],
+                        f"transpile/prefill{tp}/")
         return prog, nxt
 
     def _build_decode(self):
@@ -265,7 +268,25 @@ class GenerationEngine:
                 "transformer_stack_slot_decode", ins,
                 {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
                 self._decode_attrs())
+        self._transpile(prog, ["serving.tok", "serving.pos"], [nxt.name],
+                        "transpile/decode/")
         return prog, nxt
+
+    def _transpile(self, prog, feed_names, fetch_names, metric_prefix):
+        """Run the inference pipeline over a freshly-built serving program
+        before it is ever compiled (the decode/prefill ops are already
+        maximally fused, so this is usually a fast no-op — but custom or
+        saved-program variants get the full rewrite set) and publish the
+        per-pass stats into the MetricsRegistry.
+        ``preserve_state_writes`` keeps the KV-cache update ops alive even
+        though nothing fetches them."""
+        from ..transpiler import inference_pipeline
+
+        pm = inference_pipeline()
+        pm.run(prog, feed_names, fetch_names, scope=self.scope,
+               preserve_state_writes=True)
+        for k, v in pm.metrics_dict(prefix=metric_prefix).items():
+            self.metrics.set_gauge(k, v)
 
     def _prefill_prog(self, tp: int):
         if tp not in self._prefill_progs:

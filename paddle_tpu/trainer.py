@@ -36,7 +36,8 @@ class SGD:
                  place: Optional[TPUPlace] = None, mesh=None, plan=None,
                  metrics: Optional[Dict[str, Variable]] = None,
                  scope: Optional[Scope] = None,
-                 check_nan_inf: Optional[bool] = None):
+                 check_nan_inf: Optional[bool] = None,
+                 transpile: bool = False):
         self.cost = cost
         self.metrics = dict(metrics or {})
         self.main_program: Program = cost.block.program
@@ -44,6 +45,19 @@ class SGD:
         # Inference/test clone is taken BEFORE optimizer ops are appended
         # and flips is_test (fluid's Program.clone(for_test=True)).
         self.test_program = self.main_program.clone(for_test=True)
+        if transpile:
+            # Training rewrites must land BEFORE minimize appends the
+            # backward: grad ops reference the op list they were derived
+            # from, and the fused replacements carry their own grad_fns.
+            # Per-pass wall time / op deltas go to the profiler StatSet
+            # (profiler.print_all_status shows them next to step timers).
+            from .transpiler import training_pipeline, prune_pipeline
+
+            feeds = [v.name for v in feed_list]
+            fetches = [cost.name] + [v.name for v in self.metrics.values()]
+            training_pipeline().run(self.main_program, feeds, fetches,
+                                    scope=scope or global_scope())
+            prune_pipeline().run(self.test_program, feeds, fetches)
         optimizer.minimize(cost, startup_program=self.startup_program)
         self.feeder = DataFeeder(feed_list)
         self.scope = scope or global_scope()

@@ -1,0 +1,106 @@
+"""paddle_tpu.transpiler — program-level optimization pass framework.
+
+The program-to-program rewriting plane (the reference era's
+``inference_optimize``/``prune.cc`` and inference/memory transpilers,
+rebuilt as an XLA-HLO-style pass pipeline): a ``Pass`` base class, a
+registry, a ``PassManager`` with per-pass wall-time + op-count-delta
+stats published into the profiler ``StatSet`` plane, IR dump hooks, and
+a standard pass library (dead-op elimination, constant folding, is_test
+canonicalization, dropout→scale, conv/fc+BN folding, fused-kernel
+pattern rewriting).
+
+Typical use::
+
+    from paddle_tpu import transpiler
+
+    pm = transpiler.inference_pipeline()
+    program = pm.run(program, feed_names, fetch_names, scope=scope)
+    print(pm.format_stats())          # per-pass ms + op deltas
+
+``io.save_inference_model`` runs the inference pipeline by default; the
+serving engines transpile before warmup and publish the pass stats into
+their ``MetricsRegistry``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .framework import (Pass, PassContext, PassManager, PassResult,
+                        get_pass, ir_dump_hook, register_pass,
+                        registered_passes)
+from .passes import (CanonicalizeIsTest, ConstantFolding,
+                     DeadOpElimination, DropoutToScale,
+                     ExpandRecomputeSegments, FoldBatchNorm, FusePatterns)
+
+__all__ = [
+    "Pass", "PassContext", "PassManager", "PassResult", "register_pass",
+    "get_pass", "registered_passes", "ir_dump_hook",
+    "ExpandRecomputeSegments", "CanonicalizeIsTest", "DropoutToScale",
+    "DeadOpElimination", "ConstantFolding", "FoldBatchNorm",
+    "FusePatterns", "inference_pipeline", "training_pipeline",
+    "deployment_pipeline", "prune_pipeline",
+]
+
+
+def prune_pipeline(for_test: bool = True, **pm_kw) -> PassManager:
+    """The minimal slice pipeline backing ``io.prune_program``: flatten
+    recompute segments, (optionally) canonicalize ``is_test``, then
+    dead-op-eliminate down to the fetch targets."""
+    passes = [ExpandRecomputeSegments()]
+    if for_test:
+        passes.append(CanonicalizeIsTest())
+    passes.append(DeadOpElimination())
+    return PassManager(passes, **pm_kw)
+
+
+def inference_pipeline(*, constant_fold: bool = True,
+                       fold_batch_norm: bool = True,
+                       fuse: bool = True,
+                       epilogue: Optional[bool] = None,
+                       **pm_kw) -> PassManager:
+    """The deploy-time pipeline (``save_inference_model`` default):
+    flatten → is_test → dropout→scale → DCE → constant-fold →
+    fused-kernel rewrites → BN folding → cleanup DCE.
+
+    Fusion runs BEFORE BN folding so that, when the fused conv epilogue
+    is enabled (``--fused_conv_epilogue`` or ``epilogue=True``), 1x1-NHWC
+    conv→BN chains become the Pallas-backed fused op and folding only
+    absorbs the chains fusion cannot take (3x3 convs, fc+BN). Weight
+    folding writes NEW names into the given scope — hand a child scope
+    to keep the caller's state pristine.
+    """
+    # DCE runs BEFORE the dropout rewrite: in a training program the
+    # backward's grad ops consume dropout's Mask, and only after the
+    # training slice is gone is the mask provably dead.
+    passes = [ExpandRecomputeSegments(), CanonicalizeIsTest(),
+              DeadOpElimination(), DropoutToScale()]
+    if constant_fold:
+        passes.append(ConstantFolding())
+    if fuse:
+        passes.append(FusePatterns(epilogue=epilogue))
+    if fold_batch_norm:
+        passes.append(FoldBatchNorm())
+    passes.append(DeadOpElimination())
+    return PassManager(passes, **pm_kw)
+
+
+def training_pipeline(*, epilogue: Optional[bool] = None,
+                      **pm_kw) -> PassManager:
+    """The build-time pipeline for training programs (run BEFORE
+    ``append_backward``/``minimize`` — the rewrites carry grad_fns, but
+    already-emitted grad ops reference the pre-rewrite op list): literal
+    constant folding (parameters stay live — they change every step) and
+    fused-kernel pattern rewrites."""
+    return PassManager([ConstantFolding(fold_params=False),
+                        FusePatterns(epilogue=epilogue)], **pm_kw)
+
+
+def deployment_pipeline(**pm_kw) -> PassManager:
+    """The portable-artifact pipeline (int8 quantization, the native C
+    machine): like ``inference_pipeline`` but with NO fused ops — fused
+    ``conv1x1_bn_act`` is lowered back to folded conv2d + bias add so
+    plain-conv weights become weight-only-quantization eligible."""
+    return PassManager(
+        [ExpandRecomputeSegments(), CanonicalizeIsTest(),
+         DeadOpElimination(), DropoutToScale(), ConstantFolding(),
+         FoldBatchNorm(lower_fused=True), DeadOpElimination()], **pm_kw)

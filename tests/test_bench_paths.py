@@ -160,3 +160,16 @@ def test_sidecar_device_filtering(tmp_path, monkeypatch):
     b._sidecar_append("aaaa", "info", result={"device_kind": "v4"},
                       device="v4")
     assert "resnet" not in b._sidecar_load("aaaa")
+
+
+def test_transpiler_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    res = _bench().bench_transpiler(jax, pt, layers, models, "resnet50",
+                                    batch=2, hw=32, steps=2)
+    assert res["transpiled_ops"] < res["raw_ops"]
+    assert res["transpiled_ms_per_batch"] > 0
+    assert res["pass_stats"], "per-pass stats must be recorded"

@@ -1,0 +1,641 @@
+"""paddle_tpu.transpiler: pass framework + standard pass library.
+
+The acceptance surface: transpiled programs are numerically faithful
+(dropout→scale and DCE bit-exact; BN folding within fp32 tolerance on
+conv and fc models), the fusion rewriter reaches the fused kernels from
+primitive-op programs with ≥20% fewer block ops on the demo CNN and a
+primitive-attention transformer block, per-pass timing/op-delta stats
+are visible via the profiler StatSet snapshot, and transpiled programs
+round-trip program_to_dict / the C machine."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import layers, models, profiler
+from paddle_tpu import transpiler as T
+from paddle_tpu.layers.layer_helper import LayerHelper
+
+
+def _run(prog, feed, fetches, scope=None):
+    exe = pt.Executor(pt.CPUPlace())
+    return exe.run(prog, feed=feed, fetch_list=fetches, scope=scope)
+
+
+def _init(main_startup):
+    scope = pt.Scope()
+    pt.Executor(pt.CPUPlace()).run(main_startup, scope=scope)
+    return scope
+
+
+# --------------------------------------------------------------------------
+# Framework
+# --------------------------------------------------------------------------
+class TestFramework:
+    def test_registry_and_custom_pass(self):
+        class NopPass(T.Pass):
+            name = "test_nop_pass_xyz"
+
+            def apply(self, program, ctx):
+                ctx.note("ran")
+
+        if "test_nop_pass_xyz" not in T.registered_passes():
+            T.register_pass(NopPass)
+        p = T.get_pass("test_nop_pass_xyz")
+        assert isinstance(p, NopPass)
+        for std in ["dead_op_elimination", "constant_fold",
+                    "fold_batch_norm", "fuse_patterns", "dropout_to_scale",
+                    "canonicalize_is_test", "expand_recompute_segments"]:
+            assert std in T.registered_passes()
+        # PassManager accepts registered names as well as instances
+        pm = T.PassManager(["test_nop_pass_xyz"])
+        pm.run(pt.Program(), [], [])
+        assert pm.last_notes == ["ran"]
+
+    def test_stats_visible_in_profiler_statset(self):
+        stats = profiler.StatSet()
+        pm = T.PassManager([T.DeadOpElimination()], stat_set=stats)
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, size=2)
+            dead = layers.fc(x, size=3)  # noqa: F841 — sliced away
+        pm.run(main, ["x"], [y.name])
+        snap = stats.as_dict(prefix="transpiler/")
+        assert "transpiler/pass/dead_op_elimination" in snap
+        # add_count stores op deltas so the ms-scaled column reads the
+        # raw count: the dead fc (mul + add) gives delta -2
+        delta = snap["transpiler/delta/dead_op_elimination"]["total_ms"]
+        assert delta == pytest.approx(-2.0)
+        assert pm.results[0].op_delta == -2
+        assert pm.stats()[0]["pass"] == "dead_op_elimination"
+
+    def test_ir_dump_hook(self, tmp_path):
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, size=2)
+            layers.fc(x, size=3)
+        pm = T.PassManager([T.DeadOpElimination()],
+                           dump_hook=T.ir_dump_hook(str(tmp_path / "ir")))
+        pm.run(main, ["x"], [y.name])
+        dumps = sorted((tmp_path / "ir").iterdir())
+        assert len(dumps) == 2  # before + after for the one changing pass
+        assert "mul" in dumps[0].read_text()
+
+
+# --------------------------------------------------------------------------
+# Faithfulness: dropout→scale + DCE bit-exact
+# --------------------------------------------------------------------------
+class TestDropoutAndDCE:
+    def test_bit_exact_vs_untranspiled_is_test(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[6])
+            h = layers.fc(x, size=16, act="relu")
+            h = layers.dropout(h, dropout_prob=0.3)
+            y = layers.fc(h, size=4)
+            label = layers.data("label", shape=[4])
+            loss = layers.mean(layers.square_error_cost(y, label))
+            pt.optimizer.SGD(0.1).minimize(loss, startup_program=startup)
+        scope = _init(startup)
+        # the untranspiled is_test program: plain slice of the training
+        # program with is_test flipped (no rewrites)
+        test_prog = pio.prune_program(main, ["x"], [y.name], for_test=True)
+        xv = np.random.rand(3, 6).astype(np.float32)
+        (ref,) = _run(test_prog, {"x": xv}, [y], scope=scope)
+
+        pm = T.inference_pipeline()
+        prog = pm.run(main.clone(), ["x"], [y.name],
+                      scope=pt.Scope(parent=scope))
+        types = [op.type for op in prog.global_block.ops]
+        assert "dropout" not in types and "scale" in types
+        assert "sgd" not in types and "grad" not in types
+        (out,) = _run(prog, {"x": xv}, [y], scope=scope)
+        np.testing.assert_array_equal(out, ref)  # bit-exact
+
+    def test_dropout_kept_when_mask_is_consumed(self):
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", shape=[4])
+            helper = LayerHelper("d")
+            outs, _ = helper.append_op(
+                "dropout", {"X": [x]}, ["Out", "Mask"],
+                {"dropout_prob": 0.5, "is_test": True})
+            y = layers.elementwise_add(outs["Out"][0], outs["Mask"][0])
+        pm = T.PassManager([T.DropoutToScale()])
+        pm.run(main, ["x"], [y.name])
+        assert [op.type for op in main.global_block.ops][0] == "dropout"
+
+    def test_dce_preserve_state_writes(self):
+        main = pt.Program()
+        scope = pt.Scope()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", shape=[4])
+            helper = LayerHelper("s")
+            state = helper.block.create_var(name="cache_state", shape=[4],
+                                            persistable=True)
+            helper.append_op("scale", {"X": [x]}, {"Out": [state]},
+                             {"scale": 2.0})
+            y = layers.scale(x, scale=3.0)
+        import jax.numpy as jnp
+
+        scope.set("cache_state", jnp.zeros(4))
+        # with preservation the unfetched state write survives
+        prog = main.clone()
+        T.PassManager([T.DeadOpElimination()]).run(
+            prog, ["x"], [y.name], scope=scope, preserve_state_writes=True)
+        assert len(prog.global_block.ops) == 2
+        # without it the write is dead code
+        prog2 = main.clone()
+        T.PassManager([T.DeadOpElimination()]).run(
+            prog2, ["x"], [y.name], scope=scope)
+        assert len(prog2.global_block.ops) == 1
+
+
+# --------------------------------------------------------------------------
+# BN folding
+# --------------------------------------------------------------------------
+class TestFoldBatchNorm:
+    def _nontrivial_stats(self, scope):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(7)
+        for n in list(scope.keys()):
+            if "mean" in n:
+                scope.set(n, jnp.asarray(
+                    rng.rand(*scope.get_numpy(n).shape).astype(np.float32)))
+            if "variance" in n:
+                scope.set(n, jnp.asarray(
+                    (0.5 + rng.rand(*scope.get_numpy(n).shape))
+                    .astype(np.float32)))
+
+    @pytest.mark.parametrize("fmt", ["NHWC", "NCHW"])
+    def test_conv_bn_folds_fp32_tolerance(self, fmt):
+        shape = [6, 6, 3] if fmt == "NHWC" else [3, 6, 6]
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=shape)
+            c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                              bias_attr=False, data_format=fmt)
+            y = layers.batch_norm(c, act="relu", is_test=True,
+                                  data_layout=fmt)
+        scope = _init(startup)
+        self._nontrivial_stats(scope)
+        xv = np.random.rand(2, *shape).astype(np.float32)
+        (ref,) = _run(main, {"img": xv}, [y], scope=scope)
+
+        work = pt.Scope(parent=scope)
+        prog = T.inference_pipeline().run(main.clone(), ["img"], [y.name],
+                                          scope=work)
+        types = [op.type for op in prog.global_block.ops]
+        assert "batch_norm" not in types
+        assert types == ["conv2d", "elementwise_add", "relu"]
+        conv = prog.global_block.ops[0]
+        assert conv.attrs.get("__bn_folded__") is True
+        (out,) = _run(prog, {"img": xv}, [y], scope=work)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_fc_bias_bn_folds_through_existing_add(self):
+        """mul → elementwise_add(bias) → batch_norm collapses onto the
+        existing add (bias folded through the BN affine)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[12])
+            h = layers.fc(x, size=16)  # mul + bias add
+            y = layers.batch_norm(h, is_test=True)
+        scope = _init(startup)
+        self._nontrivial_stats(scope)
+        xv = np.random.rand(4, 12).astype(np.float32)
+        (ref,) = _run(main, {"x": xv}, [y], scope=scope)
+
+        work = pt.Scope(parent=scope)
+        prog = T.inference_pipeline().run(main.clone(), ["x"], [y.name],
+                                          scope=work)
+        types = [op.type for op in prog.global_block.ops]
+        assert types == ["mul", "elementwise_add"]
+        (out,) = _run(prog, {"x": xv}, [y], scope=work)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_training_bn_does_not_fold(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 4, 3])
+            c = layers.conv2d(img, num_filters=4, filter_size=1,
+                              bias_attr=False, data_format="NHWC")
+            y = layers.batch_norm(c, is_test=False, data_layout="NHWC")
+        scope = _init(startup)
+        prog = main.clone()
+        T.PassManager([T.FoldBatchNorm()]).run(
+            prog, ["img"], [y.name], scope=pt.Scope(parent=scope))
+        assert any(op.type == "batch_norm"
+                   for op in prog.global_block.ops)
+
+    def test_shared_conv_output_not_folded(self):
+        """A conv output consumed by BN AND something else must survive."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 4, 3])
+            c = layers.conv2d(img, num_filters=4, filter_size=1,
+                              bias_attr=False, data_format="NHWC")
+            b = layers.batch_norm(c, is_test=True, data_layout="NHWC")
+            y = layers.elementwise_add(b, c)  # second consumer of c
+        scope = _init(startup)
+        work = pt.Scope(parent=scope)
+        prog = main.clone()
+        T.PassManager([T.FoldBatchNorm()]).run(prog, ["img"], [y.name],
+                                               scope=work)
+        assert any(op.type == "batch_norm"
+                   for op in prog.global_block.ops)
+
+
+# --------------------------------------------------------------------------
+# Constant folding
+# --------------------------------------------------------------------------
+class TestConstantFolding:
+    def test_param_subgraph_folds_and_matches(self):
+        """The transformer position-table slice: feed-independent, folds
+        to a precomputed persistable var."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[5], dtype="int64")
+            tok = layers.embedding(ids, size=[11, 8])
+            helper = LayerHelper("cf")
+            table = helper.create_parameter(
+                pt.ParamAttr(name="pos_table"), shape=[32, 8],
+                dtype="float32")
+            pos = helper.simple_op("slice", {"X": [table]},
+                                   {"axes": [0], "starts": [0],
+                                    "ends": [5]})
+            y = helper.simple_op("elementwise_add", {"X": [tok],
+                                                     "Y": [pos]})
+        scope = _init(startup)
+        feed = {"ids": np.random.randint(0, 11, size=(2, 5))
+                .astype(np.int64)}
+        (ref,) = _run(main, feed, [y], scope=scope)
+        work = pt.Scope(parent=scope)
+        prog = main.clone()
+        pm = T.PassManager([T.ConstantFolding()])
+        pm.run(prog, ["ids"], [y.name], scope=work)
+        types = [op.type for op in prog.global_block.ops]
+        assert "slice" not in types
+        (out,) = _run(prog, feed, [y], scope=work)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_params_stay_live_without_fold_params(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            helper = LayerHelper("cf2")
+            table = helper.create_parameter(
+                pt.ParamAttr(name="t2"), shape=[8, 4], dtype="float32")
+            s = helper.simple_op("slice", {"X": [table]},
+                                 {"axes": [0], "starts": [0], "ends": [2]})
+            y = helper.simple_op("reduce_sum", {"X": [s]}, {"dim": [0]})
+        scope = _init(startup)
+        prog = main.clone()
+        T.PassManager([T.ConstantFolding(fold_params=False)]).run(
+            prog, ["x"], [y.name], scope=pt.Scope(parent=scope))
+        assert any(op.type == "slice" for op in prog.global_block.ops)
+
+
+# --------------------------------------------------------------------------
+# Fusion rewrites
+# --------------------------------------------------------------------------
+class TestFusePatterns:
+    def test_conv_bn_residual_relu_fuses_and_matches(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 4, 8])
+            c = layers.conv2d(img, num_filters=8, filter_size=1,
+                              bias_attr=False, data_format="NHWC")
+            b = layers.batch_norm(c, is_test=True, data_layout="NHWC")
+            a = layers.elementwise_add(b, img)
+            y = layers.relu(a)
+        scope = _init(startup)
+        xv = np.random.rand(2, 4, 4, 8).astype(np.float32)
+        (ref,) = _run(main, {"img": xv}, [y], scope=scope)
+        prog = main.clone()
+        T.PassManager([T.FusePatterns(epilogue=True)]).run(
+            prog, ["img"], [y.name])
+        ops = prog.global_block.ops
+        assert [o.type for o in ops] == ["conv1x1_bn_act"]
+        assert ops[0].attrs["act"] == "relu"
+        assert ops[0].input("Residual") == "img"
+        assert ops[0].attrs["__fused_from__"] == [
+            "conv2d", "batch_norm", "elementwise_add", "relu"]
+        (out,) = _run(prog, {"img": xv}, [y], scope=scope)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_nonunit_conv_does_not_fuse(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[6, 6, 3])
+            c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                              bias_attr=False, data_format="NHWC")
+            y = layers.batch_norm(c, is_test=True, data_layout="NHWC")
+        prog = main.clone()
+        T.PassManager([T.FusePatterns(epilogue=True)]).run(
+            prog, ["img"], [y.name])
+        assert any(op.type == "batch_norm" for op in prog.global_block.ops)
+
+    def test_demo_cnn_op_reduction_at_least_20pct(self):
+        """The fusion rewriter on the demo CNN (ResNet-50): ≥20% fewer
+        block ops, fused epilogue present."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[32, 32, 3])
+            logits = models.resnet_imagenet(img, num_classes=10, depth=50,
+                                            is_test=True)
+        prog = pio.prune_program(main, ["img"], [logits.name])
+        before = len(prog.global_block.ops)
+        T.PassManager([T.FusePatterns(epilogue=True)]).run(
+            prog, ["img"], [logits.name])
+        after = len(prog.global_block.ops)
+        fused = sum(1 for op in prog.global_block.ops
+                    if op.type == "conv1x1_bn_act")
+        assert fused >= 30
+        assert after <= 0.8 * before, (before, after)
+
+    def _primitive_attention_block(self, main, startup, T_len=8, d=16,
+                                   heads=2):
+        """A transformer block with attention written in PRIMITIVE ops
+        (matmul/scale/softmax/matmul) — what a hand-ported model or an
+        imported graph looks like before the rewriter."""
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[T_len, d])
+            helper = LayerHelper("prim")
+            hd = d // heads
+
+            def heads_split(t):
+                t = layers.reshape(t, [-1, T_len, heads, hd])
+                return layers.transpose(t, [0, 2, 1, 3])
+
+            q = heads_split(layers.fc(x, size=d, num_flatten_dims=2,
+                                      bias_attr=False))
+            k = heads_split(layers.fc(x, size=d, num_flatten_dims=2,
+                                      bias_attr=False))
+            v = heads_split(layers.fc(x, size=d, num_flatten_dims=2,
+                                      bias_attr=False))
+            s = helper.simple_op("matmul", {"X": [q], "Y": [k]},
+                                 {"transpose_Y": True})
+            s = helper.simple_op("scale", {"X": [s]},
+                                 {"scale": 1.0 / math.sqrt(hd)})
+            p = helper.simple_op("softmax", {"X": [s]})
+            ctxv = helper.simple_op("matmul", {"X": [p], "Y": [v]})
+            ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
+            ctxv = layers.reshape(ctxv, [-1, T_len, d])
+            o = layers.fc(ctxv, size=d, num_flatten_dims=2,
+                          bias_attr=False)
+            y = layers.elementwise_add(x, o)
+        return x, y
+
+    def test_primitive_attention_transformer_fuses_and_matches(self):
+        main, startup = pt.Program(), pt.Program()
+        x, y = self._primitive_attention_block(main, startup)
+        scope = _init(startup)
+        xv = np.random.rand(2, 8, 16).astype(np.float32)
+        (ref,) = _run(main, {"x": xv}, [y], scope=scope)
+
+        prog = pio.prune_program(main, ["x"], [y.name])
+        before = len(prog.global_block.ops)
+        pm = T.inference_pipeline()
+        work = pt.Scope(parent=scope)
+        pm.run(prog, ["x"], [y.name], scope=work)
+        after = len(prog.global_block.ops)
+        types = [op.type for op in prog.global_block.ops]
+        assert "scaled_dot_product_attention" in types
+        assert "softmax" not in types
+        assert after < before  # matmul+scale+softmax+matmul -> one op
+        (out,) = _run(prog, {"x": xv}, [y], scope=work)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_transformer_op_reduction_at_least_20pct(self):
+        """Two transformer layers over head-space tensors ([B, H, T, D],
+        the layout the repo's own attention ops use): the rewriter takes
+        every layer's primitive attention to the fused op with ≥20% fewer
+        block ops overall."""
+        H, T_len, hd = 2, 8, 8
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[H, T_len, hd])
+            helper = LayerHelper("tfm")
+            h = x
+            for i in range(2):
+                s = helper.simple_op("matmul", {"X": [h], "Y": [h]},
+                                     {"transpose_Y": True})
+                s = helper.simple_op("scale", {"X": [s]},
+                                     {"scale": 1.0 / math.sqrt(hd)})
+                p = helper.simple_op("softmax", {"X": [s]})
+                ctxv = helper.simple_op("matmul", {"X": [p], "Y": [h]})
+                h = helper.simple_op("elementwise_add",
+                                     {"X": [h], "Y": [ctxv]})
+                w = helper.create_parameter(
+                    pt.ParamAttr(name=f"ff_w{i}"), shape=[hd, hd],
+                    dtype="float32")
+                ff = helper.simple_op("matmul", {"X": [h], "Y": [w]})
+                ff = helper.simple_op("gelu", {"X": [ff]})
+                h = helper.simple_op("elementwise_add",
+                                     {"X": [h], "Y": [ff]})
+        scope = _init(startup)
+        xv = np.random.rand(2, H, T_len, hd).astype(np.float32)
+        (ref,) = _run(main, {"x": xv}, [h], scope=scope)
+        prog = pio.prune_program(main, ["x"], [h.name])
+        before = len(prog.global_block.ops)
+        work = pt.Scope(parent=scope)
+        T.inference_pipeline().run(prog, ["x"], [h.name], scope=work)
+        after = len(prog.global_block.ops)
+        fused = sum(1 for op in prog.global_block.ops
+                    if op.type == "scaled_dot_product_attention")
+        assert fused == 2
+        assert after <= 0.8 * before, (before, after)
+        (out,) = _run(prog, {"x": xv}, [h], scope=work)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# Round-trip + deployment satellites
+# --------------------------------------------------------------------------
+class TestRoundTrip:
+    def test_transpiled_program_dict_roundtrip(self):
+        """Rewritten fused ops, folded weights and pass-metadata attrs
+        survive program_to_dict/program_from_dict."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 4, 8])
+            c = layers.conv2d(img, num_filters=8, filter_size=1,
+                              bias_attr=False, data_format="NHWC")
+            b = layers.batch_norm(c, is_test=True, data_layout="NHWC")
+            h = layers.relu(b)
+            f = layers.fc(h, size=6)
+            y = layers.batch_norm(f, is_test=True)
+        scope = _init(startup)
+        work = pt.Scope(parent=scope)
+        prog = T.inference_pipeline(epilogue=True).run(
+            main.clone(), ["img"], [y.name], scope=work)
+        types = [op.type for op in prog.global_block.ops]
+        assert "conv1x1_bn_act" in types          # fused op
+        assert any(op.attrs.get("__folded_from__") == "batch_norm"
+                   for op in prog.global_block.ops)
+
+        back = pio.program_from_dict(pio.program_to_dict(prog))
+        assert [op.type for op in back.global_block.ops] == types
+        assert [op.attrs for op in back.global_block.ops] == \
+            [op.attrs for op in prog.global_block.ops]
+        xv = np.random.rand(2, 4, 4, 8).astype(np.float32)
+        (a,) = _run(prog, {"img": xv}, [y.name], scope=work)
+        (bk,) = _run(back, {"img": xv}, [y.name], scope=work)
+        np.testing.assert_array_equal(a, bk)
+
+    def test_c_machine_loads_transpiled_model(self, tmp_path):
+        """save_inference_model (transpiling) artifacts still load and
+        serve through the native C machine."""
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain")
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[6, 6, 3])
+            c = layers.conv2d(img, num_filters=8, filter_size=1,
+                              bias_attr=False, data_format="NHWC")
+            h = layers.batch_norm(c, act="relu", is_test=True,
+                                  data_layout="NHWC")
+            h = layers.dropout(h, dropout_prob=0.25, is_test=True)
+            y = layers.fc(h, size=4)
+        scope = _init(startup)
+        d = str(tmp_path / "m")
+        exe = pt.Executor(pt.CPUPlace())
+        pio.save_inference_model(d, ["img"], [y], exe, main_program=main,
+                                 scope=scope)
+        meta = pio.read_inference_model_meta(d)
+        saved_types = [o["type"] for o in
+                       meta["program"]["blocks"][0]["ops"]]
+        assert "batch_norm" not in saved_types  # folded at save time
+        assert "dropout" not in saved_types     # rewritten to scale
+        xv = np.random.rand(2, 6, 6, 3).astype(np.float32)
+        load_scope = pt.Scope()
+        prog, feeds, fetches = pio.load_inference_model(d, exe,
+                                                        scope=load_scope)
+        (ref,) = exe.run(prog, feed={"img": xv}, fetch_list=fetches,
+                         scope=load_scope)
+        from paddle_tpu.capi import InferenceMachine
+
+        with InferenceMachine(d) as machine:
+            (got,) = machine.run({"img": xv})
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+
+class TestQuantizeAfterFolding:
+    def test_strictly_more_bytes_quantize_after_folding(self, tmp_path):
+        """conv+BN model where the conv rides the fused epilogue op: raw
+        quantization cannot touch the filter (not a conv2d Filter slot);
+        the deployment pipeline folds/lowers it back to plain conv2d and
+        strictly more parameter bytes quantize."""
+        import json
+        import os
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 4, 8])
+            h = layers.conv1x1_bn_act(img, num_filters=32, act="relu",
+                                      is_test=True)
+            y = layers.fc(h, size=10)
+        scope = _init(startup)
+        d = str(tmp_path / "m")
+        exe = pt.Executor(pt.CPUPlace())
+        pio.save_inference_model(d, ["img"], [y], exe, main_program=main,
+                                 scope=scope)
+
+        def quant_bytes(qdir):
+            with open(os.path.join(qdir, "__quant__.json")) as f:
+                return sum(int(np.prod(r["shape"])) for r in json.load(f))
+
+        q_raw = str(tmp_path / "q_raw")
+        raw_names = pio.quantize_inference_model(d, q_raw, min_elems=64,
+                                                 transpile=False)
+        q_opt = str(tmp_path / "q_opt")
+        opt_names = pio.quantize_inference_model(d, q_opt, min_elems=64)
+        assert quant_bytes(q_opt) > quant_bytes(q_raw), (raw_names,
+                                                         opt_names)
+        # the folded conv filter is the newly-eligible weight
+        assert any("@bnfold" in n or "conv" in n for n in opt_names)
+
+        # and the quantized artifact still matches the f32 model closely
+        xv = np.random.rand(2, 4, 4, 8).astype(np.float32)
+        (ref,) = _run(main, {"img": xv}, [y], scope=scope)
+        import shutil
+
+        if shutil.which("g++") is not None:
+            from paddle_tpu.capi import InferenceMachine
+
+            with InferenceMachine(q_opt) as machine:
+                (got,) = machine.run({"img": xv})
+            assert np.abs(got - np.asarray(ref)).max() < 2e-2
+
+
+# --------------------------------------------------------------------------
+# Serving integration
+# --------------------------------------------------------------------------
+class TestServingTranspile:
+    def test_inference_engine_publishes_pass_stats(self, tmp_path):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            h = layers.dropout(layers.fc(x, size=8, act="relu"), 0.5)
+            y = layers.fc(h, size=2)
+        scope = _init(startup)
+        d = str(tmp_path / "m")
+        exe = pt.Executor(pt.CPUPlace())
+        pio.save_inference_model(d, ["x"], [y], exe, main_program=main,
+                                 scope=scope, transpile=False)
+        from paddle_tpu.serving import InferenceEngine
+
+        eng = InferenceEngine(model_dir=d, batch_buckets=[2])
+        gauges = eng.metrics.snapshot()["gauges"]
+        assert any(k.startswith("transpile/") for k in gauges), gauges
+        assert gauges["transpile/total_ms"] >= 0
+        # the engine's program was transpiled: inference dropout is gone
+        assert not any(op.type == "dropout"
+                       for op in eng.program.global_block.ops)
+        out = eng.run({"x": np.random.rand(2, 4).astype(np.float32)})
+        assert out[0].shape == (2, 2)
+
+    def test_generation_engine_publishes_pass_stats(self):
+        from paddle_tpu.serving.generation import GenerationEngine, LMSpec
+
+        spec = LMSpec(vocab_size=17, d_model=16, n_layers=1, num_heads=2,
+                      d_ff=32, max_len=16)
+        eng = GenerationEngine(spec, slots=2, max_seq_len=8)
+        gauges = eng.metrics.snapshot()["gauges"]
+        assert any(k.startswith("transpile/decode/") for k in gauges), \
+            gauges
+
+
+class TestTrainerTranspile:
+    def test_sgd_transpile_trains_and_tests(self):
+        # default programs: the SGD trainer owns default_startup_program
+        x = layers.data("x", shape=[4])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(h, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        rng = np.random.RandomState(0)
+
+        def reader():
+            for _ in range(3):
+                yield [(rng.rand(4).astype(np.float32),
+                        np.array([int(rng.randint(2))])) for _ in range(8)]
+
+        sgd = pt.trainer.SGD(loss, pt.optimizer.SGDOptimizer(0.1),
+                             [x, label], place=pt.CPUPlace(),
+                             transpile=True)
+        costs = []
+        sgd.train(reader, num_passes=1,
+                  event_handler=lambda e: costs.append(e))
+        res = sgd.test(reader)
+        assert np.isfinite(res.cost)
