@@ -392,6 +392,74 @@ def bench_transpiler(jax, pt, layers, models, name="resnet50", batch=16,
     }
 
 
+def bench_trace_overhead(jax, pt, layers, models, name="resnet50",
+                         batch=8, hw=64, steps=30, warmup=3):
+    """Level-1 span-tracing overhead on the bucket-padded serving path:
+    the same InferenceEngine batch measured untraced, then with
+    trace.enable(level=1) (executor run spans + serving batch spans —
+    what a traced production server pays per request). Reported as
+    ms/batch both ways plus the relative overhead; PERF.md records the
+    number and pins the <5% budget."""
+    import numpy as np
+
+    from paddle_tpu import trace
+    from paddle_tpu.serving import InferenceEngine
+
+    build = {
+        "resnet50": lambda img: models.resnet_imagenet(
+            img, num_classes=1000, depth=50, is_test=True),
+        "vgg19": lambda img: models.vgg(img, num_classes=1000, depth=19,
+                                        is_test=True),
+    }[name]
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        images = layers.data("images", shape=[hw, hw, 3])
+        logits = build(images)
+    scope = pt.Scope()
+    pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+    eng = InferenceEngine(program=main_prog, feed_names=["images"],
+                          fetch_names=[logits.name], scope=scope,
+                          batch_buckets=[batch], transpile=False)
+    rng = np.random.RandomState(0)
+    feed = {"images": rng.rand(batch, hw, hw, 3).astype("float32")}
+
+    def measure():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.run(feed)
+        return (time.perf_counter() - t0) / steps
+
+    # Interleaved A/B rounds with medians: host clock drift between two
+    # long back-to-back phases would otherwise swamp the µs-scale span
+    # cost being measured.
+    tracer = trace.get_tracer()
+    prev_level = tracer.level
+    rounds = 3
+    untraced_s, traced_s = [], []
+    try:
+        for _ in range(warmup):
+            eng.run(feed)
+        n_spans = 0
+        for _ in range(rounds):
+            trace.disable()
+            untraced_s.append(measure())
+            trace.enable(level=1)
+            tracer.clear()
+            traced_s.append(measure())
+            n_spans = len(tracer)
+    finally:
+        tracer.configure(level=prev_level)
+    untraced = sorted(untraced_s)[rounds // 2]
+    traced = sorted(traced_s)[rounds // 2]
+    overhead_pct = (traced - untraced) / untraced * 100.0
+    return {
+        "untraced_ms_per_batch": round(untraced * 1e3, 3),
+        "traced_ms_per_batch": round(traced * 1e3, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "spans_recorded": n_spans,
+    }
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -548,6 +616,7 @@ def assemble(rows, parent_notes=None):
                                     "demonstration config"),
         "lstm_varlen": res("lstm_varlen"),
         "decode_kv_cache": res("decode"),
+        "trace_overhead": res("trace_overhead"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -702,6 +771,8 @@ def run_bench(platform):
                  name)
         step("transpiler_resnet50", bench_transpiler, jax, pt, layers,
              models, "resnet50")
+        step("trace_overhead", bench_trace_overhead, jax, pt, layers,
+             models)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

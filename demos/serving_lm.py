@@ -21,7 +21,7 @@ import urllib.request
 import numpy as np
 
 import paddle_tpu as pt
-from paddle_tpu import layers, models
+from paddle_tpu import layers, models, trace
 from paddle_tpu.serving import GenerationEngine, Server
 
 FAST = bool(os.environ.get("PADDLE_TPU_DEMO_FAST"))
@@ -97,6 +97,11 @@ def main():
     prompts = [rng.randint(0, VOCAB, size=rng.randint(3, 13))
                for _ in range(N_REQUESTS)]
 
+    # span tracing across the wave: every request records admission ->
+    # queue wait -> prefill -> completion; exported below as a Chrome
+    # trace (chrome://tracing / Perfetto)
+    trace.enable(level=1)
+
     with Server(engine, max_wait_ms=2, max_queue=2 * N_REQUESTS) as srv:
         # ---- concurrent wave through the continuous batcher ----------
         t0 = time.perf_counter()
@@ -148,6 +153,20 @@ def main():
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
             snap = json.loads(resp.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=prom",
+                timeout=30) as resp:
+            prom = resp.read().decode()
+        print("Prometheus exposition (first lines):")
+        for line in prom.splitlines()[:6]:
+            print("  " + line)
+
+    trace_path = os.path.join(tempfile.gettempdir(),
+                              "paddle_tpu_serving_trace.json")
+    n_events = trace.export_chrome_trace(trace_path)
+    trace.disable()
+    print(f"chrome trace: {n_events} spans -> {trace_path} "
+          "(load in chrome://tracing or Perfetto)")
 
     lat = snap["latency"].get("request_ms", {})
     print("metrics snapshot:")

@@ -19,6 +19,7 @@ fetched.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +32,7 @@ from .program import Program, RNG_VAR
 from .registry import get_op, op_uses_rng
 from .selected_rows import SelectedRows, densify
 from .scope import Scope, global_scope
+from .. import trace
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -59,12 +61,40 @@ class CPUPlace(TPUPlace):
         return f"CPUPlace({self.device_id})"
 
 
-def _check_nan_inf(name: str, value) -> None:
+def _nonfinite_counts(value) -> Optional[Tuple[int, int]]:
+    """(n_nan, n_inf) for float arrays, None for non-float / all-finite."""
     if isinstance(value, SelectedRows):
         value = value.values
     arr = np.asarray(value)
-    if np.issubdtype(arr.dtype, np.floating) and not np.all(np.isfinite(arr)):
-        raise FloatingPointError(f"variable {name!r} contains NaN/Inf")
+    if not np.issubdtype(arr.dtype, np.floating):
+        return None
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    return (n_nan, n_inf) if n_nan or n_inf else None
+
+
+def _check_nan_inf(name: str, value) -> None:
+    bad = _nonfinite_counts(value)
+    if bad is not None:
+        raise FloatingPointError(
+            f"variable {name!r} contains NaN/Inf "
+            f"({bad[0]} NaN, {bad[1]} Inf); re-run with trace_level=2 "
+            f"(or --trace_level=2) to locate the producing op")
+
+
+def _value_stats(value) -> dict:
+    """JSON-safe per-output stats for the interpret-mode op spans."""
+    if isinstance(value, SelectedRows):
+        value = value.values
+    arr = np.asarray(value)
+    out = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    if arr.size and np.issubdtype(arr.dtype, np.floating):
+        finite = arr[np.isfinite(arr)]
+        out["nonfinite"] = int(arr.size - finite.size)
+        if finite.size:
+            out["mean"] = float(finite.mean())
+            out["absmax"] = float(np.abs(finite).max())
+    return out
 
 
 _cache_enabled = False
@@ -165,7 +195,14 @@ class Executor:
         fetch_list: Optional[Sequence] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        trace_level: Optional[int] = None,
     ):
+        """``trace_level`` overrides the global trace level for this run:
+        at >= 2 the block is NOT compiled — it executes op-by-op through
+        the un-jitted kernel dispatch (``_run_interpreted``), recording a
+        span per op with host time and output stats and naming the exact
+        op/output var on NaN/Inf. None inherits ``trace.active_level()``
+        (seeded from --trace_level)."""
         program = program or prog_mod.default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -176,15 +213,34 @@ class Executor:
 
         feed_vals = self._normalize_feeds(block, feed)
 
+        level = trace.active_level() if trace_level is None else trace_level
+        if level >= 2 and self.mesh is None:
+            return self._run_interpreted(program, feed_vals, fetch_names,
+                                         scope, return_numpy)
+
         key = self._cache_key(program, feed_vals, fetch_names, scope)
         compiled = self._cache.get(key)
+        cache_hit = compiled is not None
         if compiled is None:
             self.cache_misses += 1
-            compiled = self._compile(program, feed_vals, fetch_names, scope)
+            with trace.span("executor/compile", cache="miss",
+                            key=f"{hash(key) & 0xffffffff:08x}",
+                            ops=len(block.ops), feeds=len(feed_vals),
+                            fetches=len(fetch_names)):
+                compiled = self._compile(program, feed_vals, fetch_names,
+                                         scope)
             self._cache[key] = compiled
         else:
             self.cache_hits += 1
+        with trace.span("executor/run",
+                        cache="hit" if cache_hit else "miss",
+                        key=f"{hash(key) & 0xffffffff:08x}",
+                        ops=len(block.ops)):
+            return self._run_compiled(compiled, feed_vals, fetch_names,
+                                      scope, program, return_numpy)
 
+    def _run_compiled(self, compiled: "_Compiled", feed_vals, fetch_names,
+                      scope: Scope, program: Program, return_numpy: bool):
         feed_args = [feed_vals[n] for n in compiled.feed_names]
         ro_args = [scope.get(n) for n in compiled.ro_state_names]
         rw_args = [scope.get(n) for n in compiled.rw_state_names]
@@ -217,6 +273,128 @@ class Executor:
         if self.check_nan_inf:
             for name, val in zip(fetch_names, fetches):
                 _check_nan_inf(name, val)
+        if return_numpy:
+            return [self._fetch_numpy(densify(v)) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _run_interpreted(self, program: Program, feed_vals, fetch_names,
+                         scope: Scope, return_numpy: bool = True):
+        """Per-op debug execution (trace_level=2): walk the block and
+        dispatch each kernel eagerly through the registry — the
+        reference's per-op interpreter loop (executor.cc:112-125),
+        deliberately revived for observability. Each op records a span
+        with host wall time and output stats, and a non-finite output
+        raises immediately naming the exact op, its callsite, and the
+        output variable — upgrading --check_nan_inf's "a variable is
+        bad" to a located diagnosis. Orders of magnitude slower than the
+        compiled path; never use it for serving traffic."""
+        block = program.global_block
+        ops = list(block.ops)
+        env: Dict[str, Any] = dict(feed_vals)
+        state_read: set = set()
+        rng = None
+        uses_rng = any(op_uses_rng(get_op(op.type), op.attrs) for op in ops)
+        if uses_rng:
+            rng = self._rng_state(program, scope)
+        with trace.span("executor/interpret", ops=len(ops),
+                        feeds=len(feed_vals), fetches=len(fetch_names)):
+            for op_index, op in enumerate(ops):
+                opdef = get_op(op.type)
+                ins = {}
+                for slot, names in op.inputs.items():
+                    if not names:
+                        continue
+                    vals = []
+                    for name in names:
+                        if name in env:
+                            vals.append(env[name])
+                        elif scope.has(name):
+                            state_read.add(name)
+                            env[name] = scope.get(name)
+                            vals.append(env[name])
+                        else:
+                            raise RuntimeError(
+                                f"op {op.type!r} input {slot}={name!r} is "
+                                f"neither a feed, produced by a prior op, "
+                                f"nor present in the scope. Did you forget "
+                                f"to run the startup program?")
+                    ins[slot] = vals
+                t0 = time.perf_counter()
+                try:
+                    if opdef.special:
+                        outs = opdef.fn(op.attrs, ins, executor=self,
+                                        env=env, op=op, program=program,
+                                        scope=scope)
+                    elif op_uses_rng(opdef, op.attrs):
+                        rng, sub = jax.random.split(rng)
+                        outs = opdef.fn(op.attrs, ins, rng=sub)
+                    elif callable(opdef.needs_rng):
+                        outs = opdef.fn(op.attrs, ins, rng=None)
+                    else:
+                        outs = opdef.fn(op.attrs, ins)
+                except EnforceError:
+                    raise
+                except Exception as exc:
+                    raise op_error(op, op_index, ins, exc) from exc
+                produced = []
+                if outs:
+                    for slot, names in op.outputs.items():
+                        if slot not in outs:
+                            continue
+                        for name, val in zip(names, outs[slot]):
+                            env[name] = val
+                            produced.append((slot, name, val))
+                # host time includes device completion: the stats readback
+                # below blocks on the outputs, so the span closes after
+                # the op's device work — per-op device-inclusive timing.
+                stats = {name: _value_stats(val)
+                         for _, name, val in produced}
+                t1 = time.perf_counter()
+                trace.record(
+                    f"op/{op.type}", t0, t1,
+                    parent=trace.current_span(), op_index=op_index,
+                    callsite=op.attrs.get("_callsite"), outputs=stats)
+                for slot, name, val in produced:
+                    bad = _nonfinite_counts(val)
+                    if bad is None:
+                        continue
+                    # NaN is never legitimate; Inf can be (top-k/beam
+                    # masking emits -inf by design), so Inf-only outputs
+                    # raise only under the strict --check_nan_inf mode.
+                    if bad[0] == 0 and not self.check_nan_inf:
+                        continue
+                    site = op.attrs.get("_callsite")
+                    raise FloatingPointError(
+                        f"op #{op_index} {op.type!r}"
+                        + (f" (created at {site})" if site else "")
+                        + f" produced NaN/Inf in output {slot}="
+                        f"{name!r}: {bad[0]} NaN, {bad[1]} Inf "
+                        f"(inputs: "
+                        + ", ".join(f"{s}={list(n)}" for s, n in
+                                    op.inputs.items() if n)
+                        + ")")
+            # write-back contract matches the compiled path: persistable
+            # outputs and state read from the scope land back in the scope
+            for op in ops:
+                for name in op.output_names():
+                    if name not in env:
+                        continue
+                    is_persist = (block.has_var(name)
+                                  and block.var(name).persistable)
+                    if is_persist or name in state_read:
+                        scope.set(name, env[name])
+            if uses_rng:
+                scope.set(RNG_VAR, rng)
+            fetches = []
+            for name in fetch_names:
+                if name in env:
+                    fetches.append(env[name])
+                elif scope.has(name):
+                    fetches.append(scope.get(name))
+                else:
+                    raise RuntimeError(
+                        f"fetch variable {name!r} is never produced")
         if return_numpy:
             return [self._fetch_numpy(densify(v)) for v in fetches]
         return list(fetches)

@@ -30,11 +30,16 @@ class BeginIteration:
 
 
 class EndIteration(WithMetric):
-    def __init__(self, pass_id, batch_id, cost, metrics=None):
+    def __init__(self, pass_id, batch_id, cost, metrics=None,
+                 batch_size=None):
         super().__init__(metrics)
         self.pass_id = pass_id
         self.batch_id = batch_id
         self.cost = cost
+        # rows in the just-trained minibatch (None when the reader yields
+        # something len() can't see through) — trace.RunLog derives
+        # examples/sec from it
+        self.batch_size = batch_size
 
 
 class TestResult(WithMetric):

@@ -194,3 +194,15 @@ define_bool("op_callsite", True,
             "record user file:line on every appended op for error "
             "reports (CustomStackTrace analogue); disable to shave "
             "graph-build time")
+define_int32("trace_level", 0,
+             "span-tracing level seeding trace.get_tracer() at import: "
+             "0 off, 1 executor/serving/trainer spans, 2 additionally "
+             "per-op interpret-mode debug runs (Executor.run walks the "
+             "block op-by-op, locating NaN/Inf producers). Runtime flips "
+             "go through trace.enable(level)")
+define_float("trace_sample_rate", 1.0,
+             "fraction of trace roots kept by the span tracer "
+             "(deterministic counter-based sampling, no RNG)")
+define_int32("trace_buffer", 16384,
+             "span ring-buffer capacity; oldest completed spans fall "
+             "off — bounds tracing memory on long-lived servers")

@@ -32,23 +32,39 @@ _local = threading.local()
 
 
 class _Stat:
-    __slots__ = ("calls", "total", "min", "max")
+    __slots__ = ("calls", "total", "min", "max", "kind")
 
     def __init__(self):
         self.calls = 0
         self.total = 0.0
         self.min = float("inf")
-        self.max = 0.0
+        self.max = float("-inf")
+        # "time" (seconds, displayed as ms) or "count" (exact raw
+        # numbers). Fixed by the first sample; later samples of the
+        # other kind are converted into this entry's display plane so
+        # min/max stay in one unit.
+        self.kind = None
 
-    def add(self, dt):
+    def add(self, v):
         self.calls += 1
-        self.total += dt
-        self.min = min(self.min, dt)
-        self.max = max(self.max, dt)
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @property
+    def scale(self):
+        return 1.0 if self.kind == "count" else 1e3
 
 
 class StatSet:
-    """Named wall-time accumulators (the legacy globalStat)."""
+    """Named wall-time + count accumulators (the legacy globalStat).
+
+    Two first-class kinds share the table: timers (seconds in, ms out)
+    and counts (op-count deltas, sizes — exact numbers in AND out, no
+    unit scaling). A name's kind is set by its first sample; the
+    table/as_dict column shape is identical for both, so consumers that
+    pin it (transpiler tests, serving /metrics) read counts from the
+    ms-named columns as raw values."""
 
     def __init__(self):
         self._stats = defaultdict(_Stat)
@@ -56,39 +72,61 @@ class StatSet:
 
     def add(self, name, dt):
         with self._lock:
-            self._stats[name].add(dt)
+            s = self._stats[name]
+            if s.kind is None:
+                s.kind = "time"
+            # a timer sample on a count-kind name lands as ms (that
+            # entry's display unit) instead of polluting min/max with
+            # second-scaled values
+            s.add(dt if s.kind == "time" else dt * 1e3)
 
     def add_count(self, name, n):
-        """Record a unitless count (op-count deltas, sizes) in the same
-        plane as the timers: stored pre-divided by 1e3 so the ms-scaled
-        table/as_dict columns read back as the raw count. Keeps counts
-        and timers in ONE snapshot (the transpiler publishes per-pass
-        wall time AND op deltas side by side)."""
+        """Record a unitless count (op-count deltas, sizes) as a
+        first-class count entry: exact values, no ms scaling on
+        readback. On a name already carrying timers the count is
+        converted to that entry's ms plane (reads back as ``n``)."""
         with self._lock:
-            self._stats[name].add(n / 1e3)
+            s = self._stats[name]
+            if s.kind is None:
+                s.kind = "count"
+            s.add(n if s.kind == "count" else n / 1e3)
 
     def reset(self):
         with self._lock:
             self._stats.clear()
 
+    def kind_of(self, name):
+        """'time' | 'count' | None (unknown name)."""
+        with self._lock:
+            s = self._stats.get(name)
+            return s.kind if s else None
+
     def table(self):
+        """Rows of (name, calls, total, min, max, avg) — ms for time
+        entries, raw exact values for count entries."""
         with self._lock:
             rows = [
-                (name, s.calls, s.total * 1e3, s.min * 1e3, s.max * 1e3,
-                 s.total / s.calls * 1e3)
+                (name, s.calls, s.total * s.scale, s.min * s.scale,
+                 s.max * s.scale, s.total / s.calls * s.scale)
                 for name, s in sorted(self._stats.items(),
-                                      key=lambda kv: -kv[1].total)
+                                      key=lambda kv: -kv[1].total
+                                      * kv[1].scale)
             ]
         return rows
 
     def as_dict(self, prefix: str = ""):
         """JSON-safe export of the timer table (name -> calls/total/min/
-        max/avg ms), optionally filtered to names starting with
+        max/avg ms + kind), optionally filtered to names starting with
         ``prefix`` — how the serving /metrics endpoint surfaces its
-        engine timers (serving/metrics.py merge_timer_dict)."""
+        engine timers (serving/metrics.py merge_timer_dict). Count-kind
+        entries read back exactly through the ms-named keys (the pinned
+        shape)."""
+        with self._lock:
+            kinds = {name: s.kind for name, s in self._stats.items()}
         return {
             name: {"calls": calls, "total_ms": total, "min_ms": mn,
-                   "max_ms": mx, "avg_ms": avg}
+                   "max_ms": mx, "avg_ms": avg,
+                   "kind": kinds.get(name, "time")}
             for name, calls, total, mn, mx, avg in self.table()
             if name.startswith(prefix)
         }

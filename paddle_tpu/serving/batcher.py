@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Sequence
 
+from .. import trace
 from .errors import EngineClosedError, QueueFullError, RequestTimeoutError
 
 
@@ -54,9 +55,16 @@ class Future:
 
 
 class Request:
-    """One queued unit of work: an opaque payload plus scheduling state."""
+    """One queued unit of work: an opaque payload plus scheduling state.
 
-    __slots__ = ("payload", "meta", "future", "enqueue_t", "deadline")
+    ``span``/``queue_span`` carry the request's trace: the request span
+    opens at admission and closes at completion (whichever thread that
+    happens on); the queue span covers admission -> dispatch and records
+    the queue-wait attribute. Both are None with tracing off.
+    """
+
+    __slots__ = ("payload", "meta", "future", "enqueue_t", "deadline",
+                 "span", "queue_span")
 
     def __init__(self, payload: Any, meta: dict,
                  timeout_ms: Optional[float]):
@@ -66,10 +74,43 @@ class Request:
         self.enqueue_t = time.monotonic()
         self.deadline = (self.enqueue_t + timeout_ms / 1e3
                          if timeout_ms else None)
+        self.span = None
+        self.queue_span = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
                 and (now or time.monotonic()) >= self.deadline)
+
+    def begin_trace(self) -> None:
+        """Open the request + queue spans (detached: they cross threads
+        and are ended explicitly by the dispatch/completion path)."""
+        self.span = trace.start_span(
+            "serving/request", detached=True,
+            timeout_ms=(None if self.deadline is None
+                        else round((self.deadline - self.enqueue_t) * 1e3)))
+        if self.span is not None:
+            self.queue_span = trace.start_span(
+                "serving/queue", parent=self.span, detached=True)
+
+    def mark_dispatched(self, batch_size: int) -> None:
+        """Close the queue span, recording the queue wait."""
+        wait_s = time.monotonic() - self.enqueue_t
+        if self.queue_span is not None:
+            self.queue_span.finish(queue_wait_s=round(wait_s, 6),
+                                   batch_size=batch_size)
+            self.queue_span = None
+        if self.span is not None:
+            self.span.set_attr("queue_wait_s", round(wait_s, 6))
+
+    def end_trace(self, status: str = "ok", **attrs) -> None:
+        """Close the request span (and a still-open queue span) — called
+        from whichever thread completes the request."""
+        if self.queue_span is not None:
+            self.queue_span.finish(status=status)
+            self.queue_span = None
+        if self.span is not None:
+            self.span.finish(status=status, **attrs)
+            self.span = None
 
 
 class DynamicBatcher:
@@ -105,12 +146,15 @@ class DynamicBatcher:
         req = Request(payload, meta,
                       timeout_ms if timeout_ms is not None
                       else self.default_timeout_ms)
+        req.begin_trace()
         with self._cond:
             if self._closed:
+                req.end_trace(status="closed")
                 raise EngineClosedError("batcher is closed")
             if len(self._q) >= self.max_queue:
                 if self.metrics:
                     self.metrics.inc("rejected_queue_full")
+                req.end_trace(status="rejected_queue_full")
                 raise QueueFullError(
                     f"queue at capacity ({self.max_queue}); retry with "
                     "backoff")
@@ -142,6 +186,7 @@ class DynamicBatcher:
     def _fail_timeout(self, req: Request) -> None:
         if self.metrics:
             self.metrics.inc("timeouts")
+        req.end_trace(status="timeout")
         req.future.set_exception(RequestTimeoutError(
             "request deadline expired before execution"))
 
@@ -203,12 +248,19 @@ class DynamicBatcher:
         if self.metrics:
             self.metrics.inc("batches")
             self.metrics.inc("batched_requests", len(batch))
+        for req in batch:
+            req.mark_dispatched(len(batch))
         return batch
 
     def requeue(self, requests: List[Request]) -> None:
         """Push requests back to the queue front (oldest first)."""
         with self._cond:
             for req in reversed(requests):
+                if req.span is not None and req.queue_span is None:
+                    # back in the queue: reopen a queue segment
+                    req.queue_span = trace.start_span(
+                        "serving/queue", parent=req.span, detached=True,
+                        requeued=True)
                 self._q.appendleft(req)
             if self.metrics:
                 self.metrics.set_gauge("queue_depth", len(self._q))
@@ -232,4 +284,5 @@ class DynamicBatcher:
             self._q.clear()
             self._cond.notify_all()
         for req in pending:
+            req.end_trace(status="closed")
             req.future.set_exception(EngineClosedError("server stopped"))

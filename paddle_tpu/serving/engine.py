@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import profiler
+from .. import profiler, trace
 from ..core.executor import Executor, TPUPlace
 from ..core.scope import Scope
 from .errors import BadRequestError
@@ -156,7 +156,8 @@ class InferenceEngine:
                 a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
             fed[name] = a
         t0 = time.perf_counter()
-        with self._device_ctx(), profiler.timer("serving/infer_batch"):
+        with self._device_ctx(), profiler.timer("serving/infer_batch"), \
+                trace.span("serving/infer_batch", bucket=bucket, rows=n):
             res = self.executor.run(self.program, feed=fed,
                                     fetch_list=self.fetch_names,
                                     scope=self.scope)
@@ -227,6 +228,7 @@ class InferenceEngine:
                 rows = {n: np.asarray(req.payload[n])
                         for n in self.feed_names}
             except (KeyError, TypeError) as exc:
+                req.end_trace(status="bad_request")
                 req.future.set_exception(BadRequestError(
                     f"payload must be a dict with feeds "
                     f"{self.feed_names}: {exc}"))
@@ -236,15 +238,32 @@ class InferenceEngine:
         for _, members in groups.items():
             feed = {n: np.stack([rows[n] for _, rows in members])
                     for n in self.feed_names}
+            t0 = time.perf_counter()
             try:
                 fetched = self.run(feed)
             except Exception as exc:  # engine failure fails the batch
+                t1 = time.perf_counter()
                 for req, _ in members:
+                    if req.span is not None:  # keep sampling decisions
+                        trace.record("serving/execute", t0, t1,
+                                     parent=req.span, batch=len(members),
+                                     error=repr(exc)[:200])
+                    req.end_trace(status="error", error=repr(exc)[:200])
                     req.future.set_exception(exc)
                 continue
+            t1 = time.perf_counter()
             now = time.monotonic()
             for i, (req, _) in enumerate(members):
+                # attribute the shared batch execution to each rider
+                # (skipped for unsampled requests: a root 'execute' span
+                # would defeat the per-request sampling decision)
+                if req.span is not None:
+                    trace.record("serving/execute", t0, t1,
+                                 parent=req.span, batch=len(members),
+                                 row=i)
                 req.future.set_result([f[i] for f in fetched])
+                req.end_trace(status="ok",
+                              latency_s=round(now - req.enqueue_t, 6))
                 self.metrics.inc("completed")
                 self.metrics.observe_latency(now - req.enqueue_t)
         return True

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import profiler
+from .. import profiler, trace
 from ..core.executor import Executor, TPUPlace
 from ..core.program import Program, program_guard
 from ..core.scope import Scope
@@ -386,6 +386,7 @@ class GenerationEngine:
                 todo.append((req, *self._validate(req)))
             except BadRequestError as exc:
                 self.metrics.inc("bad_requests")
+                req.end_trace(status="bad_request")
                 req.future.set_exception(exc)
         if not todo:
             return 0
@@ -411,13 +412,19 @@ class GenerationEngine:
                             "serving.slot_ids": slot_ids,
                             "serving.lengths": lengths},
                 fetch_list=[nxt], scope=self.scope)
-        self.metrics.observe_latency(time.perf_counter() - t0,
-                                     name="prefill")
+        t1 = time.perf_counter()
+        self.metrics.observe_latency(t1 - t0, name="prefill")
         self.metrics.inc("prefills")
         self.metrics.set_gauge("prefill_occupancy", len(todo) / bucket)
         first = np.asarray(first)
         for row, (req, p, max_new, eos) in enumerate(todo):
             slot = free[row]
+            if req.span is not None:  # keep per-request sampling
+                trace.record("serving/execute", t0, t1, parent=req.span,
+                             phase="prefill", slot=slot,
+                             prompt_len=int(p.size), prompt_bucket=tp,
+                             batch_bucket=bucket)
+                req.span.set_attrs(slot=slot, prompt_len=int(p.size))
             st = _Slot(req, p, max_new, eos)
             self._slots[slot] = st
             self._tok[slot] = first[row]
@@ -438,10 +445,13 @@ class GenerationEngine:
         self._slots[slot] = None
         ids = np.concatenate([st.prompt,
                               np.asarray(st.generated, np.int64)])
+        latency = time.monotonic() - st.request.enqueue_t
         st.request.future.set_result(ids)
+        st.request.end_trace(status="ok",
+                             tokens_generated=len(st.generated),
+                             latency_s=round(latency, 6))
         self.metrics.inc("completed")
-        self.metrics.observe_latency(
-            time.monotonic() - st.request.enqueue_t)
+        self.metrics.observe_latency(latency)
 
     def _run_decode(self):
         prog, nxt = self._decode_prog
@@ -457,7 +467,8 @@ class GenerationEngine:
         if self.active == 0:
             return False
         t0 = time.perf_counter()
-        with self._device_ctx(), profiler.timer("serving/decode_step"):
+        with self._device_ctx(), profiler.timer("serving/decode_step"), \
+                trace.span("serving/decode_step", active=self.active):
             nxt = self._run_decode()
         self.metrics.observe_latency(time.perf_counter() - t0,
                                      name="decode_step")

@@ -37,6 +37,11 @@ class MetricsRegistry:
         self._gauges: Dict[str, float] = {}
         self._latencies: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=_RESERVOIR))
+        # total observations ever pushed per reservoir (reservoirs drop
+        # old samples; this never decreases) + the publish high-water
+        # mark, so publish_to_profiler is incremental across calls.
+        self._observed: Dict[str, int] = defaultdict(int)
+        self._published: Dict[str, int] = defaultdict(int)
         self._completions = deque()  # timestamps for the QPS window
         self._t0 = time.monotonic()
 
@@ -53,6 +58,7 @@ class MetricsRegistry:
         now = time.monotonic()
         with self._lock:
             self._latencies[name].append(float(seconds))
+            self._observed[name] += 1
             if name == "request":
                 self._completions.append(now)
                 cutoff = now - _QPS_WINDOW_S
@@ -92,16 +98,40 @@ class MetricsRegistry:
     def publish_to_profiler(self, stat_set=None, prefix: str = "serving/"):
         """Push the latency reservoirs into a profiler StatSet (the global
         one by default) so serving quantile sources show up in
-        ``profiler.print_all_status`` alongside training timers."""
+        ``profiler.print_all_status`` alongside training timers.
+
+        Incremental: a per-reservoir high-water mark tracks how many
+        observations have already been published, so repeated calls (a
+        periodic dump loop) add only the NEW samples instead of
+        re-pushing the whole reservoir. Samples that aged out of the
+        bounded reservoir before a publish are counted but gone — the
+        StatSet receives what is still buffered."""
         from .. import profiler
 
         target = stat_set or profiler.global_stat
         with self._lock:
-            items = [(n, list(buf)) for n, buf in self._latencies.items()]
+            items = []
+            for name, buf in self._latencies.items():
+                new = self._observed[name] - self._published[name]
+                if new <= 0:
+                    continue
+                # the reservoir holds the most recent len(buf) samples;
+                # anything beyond that aged out unpublished
+                fresh = list(buf)[-min(new, len(buf)):]
+                items.append((name, fresh))
+                self._published[name] = self._observed[name]
         for name, vals in items:
             for v in vals:
                 target.add(prefix + name, v)
         return target
+
+    def update_device_gauges(self) -> None:
+        """Refresh the device-memory gauge plane (jax live-bytes per
+        local device) — a no-op on backends without allocator stats."""
+        from ..trace import device_memory_stats
+
+        for name, value in device_memory_stats().items():
+            self.set_gauge("mem/" + name, value)
 
     def merge_timer_dict(self, timers: Optional[dict]) -> dict:
         """snapshot() + a profiler StatSet.as_dict() payload in one dict
@@ -110,3 +140,73 @@ class MetricsRegistry:
         if timers:
             snap["timers"] = timers
         return snap
+
+    # -- Prometheus exposition --------------------------------------------
+    def prometheus_text(self, timers: Optional[dict] = None,
+                        namespace: str = "paddle_tpu") -> str:
+        """Render the registry in Prometheus text exposition format
+        (v0.0.4): counters as ``<ns>_<name>_total``, gauges as
+        ``<ns>_<name>``, latency reservoirs as summaries with
+        p50/p95/p99 quantile samples, plus qps/uptime. ``timers`` (a
+        StatSet.as_dict payload) export as ``<ns>_timer_seconds`` sum/
+        count pairs labelled by timer name."""
+        snap = self.snapshot()
+        lines = []
+
+        def emit(name, kind, samples, help_str=""):
+            if help_str:
+                lines.append(f"# HELP {name} {help_str}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in samples:
+                lines.append(f"{name}{labels} {_prom_num(value)}")
+
+        for cname in sorted(snap["counters"]):
+            emit(f"{namespace}_{_prom_name(cname)}_total", "counter",
+                 [("", snap["counters"][cname])])
+        for gname in sorted(snap["gauges"]):
+            emit(f"{namespace}_{_prom_name(gname)}", "gauge",
+                 [("", snap["gauges"][gname])])
+        for lname in sorted(snap["latency"]):
+            base = _prom_name(lname[:-3] if lname.endswith("_ms")
+                              else lname)
+            d = snap["latency"][lname]
+            metric = f"{namespace}_{base}_latency_seconds"
+            emit(metric, "summary", [
+                ('{quantile="0.5"}', d["p50"] / 1e3),
+                ('{quantile="0.95"}', d["p95"] / 1e3),
+                ('{quantile="0.99"}', d["p99"] / 1e3),
+            ], help_str=f"{lname} latency quantiles over the reservoir")
+            lines.append(f"{metric}_sum "
+                         f"{_prom_num(d['mean'] / 1e3 * d['count'])}")
+            lines.append(f"{metric}_count {d['count']}")
+        emit(f"{namespace}_qps", "gauge", [("", snap["qps"])],
+             help_str="completions per second (sliding window)")
+        emit(f"{namespace}_uptime_seconds", "gauge",
+             [("", snap["uptime_s"])])
+        if timers:
+            metric = f"{namespace}_timer_seconds"
+            lines.append(f"# TYPE {metric} summary")
+            for tname in sorted(timers):
+                d = timers[tname]
+                label = _prom_label(tname)
+                lines.append(f'{metric}_sum{{name="{label}"}} '
+                             f"{_prom_num(d['total_ms'] / 1e3)}")
+                lines.append(f'{metric}_count{{name="{label}"}} '
+                             f"{d['calls']}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to a legal Prometheus metric-name fragment."""
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return ("_" + out) if out and out[0].isdigit() else out
+
+
+def _prom_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)

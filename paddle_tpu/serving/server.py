@@ -14,6 +14,8 @@ the container bakes none), JSON in/out:
     POST /v1/infer     {"inputs": {feed: nested-list-row}}
                        -> {"outputs": [...]}
     GET  /metrics      -> MetricsRegistry snapshot + serving timers
+    GET  /metrics?format=prom -> Prometheus text exposition (v0.0.4),
+                       also selected by an Accept: text/plain header
     GET  /healthz      -> {"ok": true, "active": ..., "queue": ...}
 
 Typed errors map onto status codes: QueueFullError -> 429,
@@ -119,6 +121,7 @@ class Server:
         return fut.result(timeout=timeout_s)
 
     def metrics_snapshot(self) -> dict:
+        self.metrics.update_device_gauges()
         snap = self.metrics.merge_timer_dict(
             profiler.global_stat.as_dict(prefix="serving/"))
         for i, eng in enumerate(self.engines):
@@ -126,6 +129,18 @@ class Server:
                 snap[f"compile_cache/engine{i}"] = eng.cache_stats()
         snap["queue_depth"] = self.batcher.depth
         return snap
+
+    def metrics_prometheus(self) -> str:
+        """The /metrics?format=prom body: Prometheus text exposition of
+        the registry + serving timers + compile-cache/queue gauges."""
+        self.metrics.update_device_gauges()
+        self.metrics.set_gauge("queue_depth", self.batcher.depth)
+        for i, eng in enumerate(self.engines):
+            if hasattr(eng, "cache_stats"):
+                for k, v in eng.cache_stats().items():
+                    self.metrics.set_gauge(f"compile_cache/e{i}_{k}", v)
+        return self.metrics.prometheus_text(
+            timers=profiler.global_stat.as_dict(prefix="serving/"))
 
     # -- HTTP front end ----------------------------------------------------
     def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -146,7 +161,21 @@ class Server:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
+                    want_prom = ("format=prom" in query
+                                 or "text/plain" in
+                                 (self.headers.get("Accept") or ""))
+                    if want_prom:
+                        body = server.metrics_prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     self._send(200, server.metrics_snapshot())
                 elif self.path == "/healthz":
                     self._send(200, {

@@ -1,0 +1,34 @@
+"""paddle_tpu.trace — structured span tracing and the unified telemetry
+plane.
+
+The observability islands (profiler timers, serving metrics, trainer
+events) share one spine here: a hierarchical span tracer (thread-local
+context, parent links, bounded ring buffer, deterministic sampling) that
+the executor, trainer and serving stack all emit into, with Chrome
+trace-event and JSONL exporters plus a training RunLog journal.
+
+Quick start::
+
+    from paddle_tpu import trace
+    trace.enable(level=1)            # level 2 = per-op executor debug
+    ... run things ...
+    trace.export_chrome_trace("trace.json")   # chrome://tracing
+    trace.export_jsonl("spans.jsonl")
+
+Summarize offline with ``python tools/trace_summary.py trace.json``.
+"""
+from .tracer import (DEFAULT_CAPACITY, Span, Tracer, active_level,
+                     current_span, disable, enable, enabled, get_tracer,
+                     record, span, start_span)
+from .export import (export_chrome_trace, export_jsonl, load_trace_events,
+                     spans_to_chrome_events)
+from .runlog import RunLog
+from .device import device_memory_stats
+
+__all__ = [
+    "DEFAULT_CAPACITY", "Span", "Tracer", "RunLog",
+    "active_level", "current_span", "disable", "enable", "enabled",
+    "get_tracer", "record", "span", "start_span",
+    "export_chrome_trace", "export_jsonl", "load_trace_events",
+    "spans_to_chrome_events", "device_memory_stats",
+]

@@ -1,0 +1,34 @@
+"""Device-memory gauge plane: jax live-bytes per local device.
+
+TPU runtimes expose allocator stats per device (``bytes_in_use``,
+``bytes_limit``, peak). The CPU backend usually exposes nothing — this
+degrades to an empty dict there, so the serving /metrics endpoint can
+call it unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+def device_memory_stats() -> Dict[str, float]:
+    """Flat gauge dict keyed ``device<N>_<stat>`` (bytes): live bytes,
+    limit and peak per local device, when the backend reports them."""
+    out: Dict[str, float] = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # backend not initializable here
+        return out
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        for src, dst in (("bytes_in_use", "bytes_in_use"),
+                         ("bytes_limit", "bytes_limit"),
+                         ("peak_bytes_in_use", "peak_bytes_in_use")):
+            if src in ms:
+                out[f"device{d.id}_{dst}"] = float(ms[src])
+    return out

@@ -1,0 +1,135 @@
+"""Trace exporters: Chrome/Perfetto trace-event JSON and JSONL journals.
+
+Chrome format (the ``chrome://tracing`` / Perfetto "JSON Array" flavor):
+one complete event (``"ph": "X"``) per span, microsecond timestamps. Track
+assignment is the part worth getting right on an async, multi-threaded
+stack: rows are keyed by ``trace_id``, not OS thread, so a serving
+request's queue wait (recorded from the HTTP thread) and its execute span
+(recorded from the dispatch thread) nest on ONE row under the request
+span, which is how the viewer shows per-request timelines. Span/parent
+ids ride in ``args`` for machine consumers (tools/trace_summary.py).
+
+The JSONL journal is the grep-able flavor: one span per line via
+``Span.to_dict()``, plus a header line carrying the tracer's wall-clock
+epoch so offline tooling can reconstruct absolute times.
+"""
+from __future__ import annotations
+
+import json
+from typing import IO, List, Optional, Union
+
+from .tracer import Span, Tracer, get_tracer
+
+
+def spans_to_chrome_events(spans: List[Span]) -> List[dict]:
+    """Spans -> list of Chrome trace-event dicts (complete 'X' events)."""
+    events = []
+    for sp in spans:
+        if sp.end is None:
+            continue
+        args = {"span_id": sp.span_id, "parent_id": sp.parent_id,
+                "thread": sp.thread}
+        args.update(sp.attrs)
+        events.append({
+            "name": sp.name,
+            "ph": "X",
+            "ts": round(sp.start * 1e6, 3),
+            "dur": round((sp.end - sp.start) * 1e6, 3),
+            "pid": 0,
+            "tid": sp.trace_id,
+            "cat": sp.name.split("/", 1)[0],
+            "args": args,
+        })
+    events.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+    return events
+
+
+def export_chrome_trace(path_or_file: Union[str, IO],
+                        tracer: Optional[Tracer] = None,
+                        drain: bool = False) -> int:
+    """Write the tracer's completed spans as Chrome trace-event JSON
+    (object form: ``{"traceEvents": [...], ...}``). Load the file in
+    chrome://tracing, Perfetto, or ``tools/trace_summary.py``. Returns
+    the number of events written."""
+    tracer = tracer or get_tracer()
+    spans = tracer.drain() if drain else tracer.spans()
+    doc = {
+        "traceEvents": spans_to_chrome_events(spans),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "paddle_tpu.trace",
+                      "epoch_unix": tracer.epoch_unix},
+    }
+    if isinstance(path_or_file, str):
+        with open(path_or_file, "w") as f:
+            json.dump(doc, f)
+    else:
+        json.dump(doc, path_or_file)
+    return len(doc["traceEvents"])
+
+
+def export_jsonl(path_or_file: Union[str, IO],
+                 tracer: Optional[Tracer] = None,
+                 drain: bool = False, append: bool = False) -> int:
+    """Write completed spans as a JSONL run journal (one span per line,
+    preceded by a ``{"type": "trace_header", ...}`` line). Returns the
+    number of span lines written."""
+    tracer = tracer or get_tracer()
+    spans = tracer.drain() if drain else tracer.spans()
+
+    def _write(f) -> int:
+        f.write(json.dumps({"type": "trace_header",
+                            "epoch_unix": tracer.epoch_unix,
+                            "spans": len(spans)}) + "\n")
+        n = 0
+        for sp in spans:
+            if sp.end is None:
+                continue
+            row = sp.to_dict()
+            row["type"] = "span"
+            f.write(json.dumps(row) + "\n")
+            n += 1
+        return n
+
+    if isinstance(path_or_file, str):
+        with open(path_or_file, "a" if append else "w") as f:
+            return _write(f)
+    return _write(path_or_file)
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Read either export format back into a flat list of event dicts
+    with ``name``/``ts``/``dur``(us)/``args`` keys — the
+    tools/trace_summary.py input contract."""
+    with open(path) as f:
+        first = f.readline()
+        f.seek(0)
+        jsonl = False
+        try:  # JSONL starts with a one-line trace_header/span row
+            row = json.loads(first)
+            jsonl = isinstance(row, dict) and row.get("type") in (
+                "trace_header", "span")
+        except json.JSONDecodeError:
+            pass  # multi-line chrome JSON
+        if not jsonl:
+            doc = json.load(f)
+            events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+                else doc
+            return [e for e in events if e.get("ph", "X") == "X"]
+        events = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("type") != "span" or row.get("end_s") is None:
+                continue
+            events.append({
+                "name": row["name"],
+                "ts": row["start_s"] * 1e6,
+                "dur": (row["end_s"] - row["start_s"]) * 1e6,
+                "tid": row.get("trace_id", 0),
+                "args": dict(row.get("attrs") or {},
+                             span_id=row.get("span_id"),
+                             parent_id=row.get("parent_id")),
+            })
+        return events

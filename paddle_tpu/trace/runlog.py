@@ -1,0 +1,106 @@
+"""RunLog: a JSONL training-run journal driven by trainer events.
+
+The reference trainer prints per-batch cost at ``--log_period`` and dumps
+the global Stat table at pass end (Trainer.cpp:449
+``globalStat.printAllStatus()``). RunLog is the machine-readable version:
+hand one to ``SGD.train(..., run_log=...)`` (or call it yourself as an
+event handler) and every iteration lands as one JSON line with cost,
+metrics, wall time and examples/sec; every pass end lands with the pass
+summary AND a snapshot of the profiler's global StatSet, so a run is
+fully reconstructable offline (``tools/trace_summary.py --runlog``).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional, Union
+
+from .. import event as evt
+from .. import profiler
+
+
+class RunLog:
+    """Journals training progress to a JSONL file (or any writable).
+
+    Parameters:
+      sink: path or open file-like; lines are flushed as written.
+      stat_set: StatSet dumped at EndPass (default: the profiler's
+        process-global one — Trainer.cpp:449 parity).
+      echo_stats: also print the StatSet table at pass end.
+    """
+
+    def __init__(self, sink: Union[str, IO], stat_set=None,
+                 echo_stats: bool = False):
+        if isinstance(sink, str):
+            self._f: IO = open(sink, "w")
+            self._owns = True
+        else:
+            self._f = sink
+            self._owns = False
+        self.stat_set = stat_set
+        self.echo_stats = echo_stats
+        self._iter_t0: Optional[float] = None
+        self._pass_t0: Optional[float] = None
+        self._pass_examples = 0
+        self._write({"type": "run_header", "t_unix": time.time()})
+
+    # -- plumbing ----------------------------------------------------------
+    def _write(self, row: dict) -> None:
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self._f.close()
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- event handler -----------------------------------------------------
+    def __call__(self, e) -> None:
+        now = time.perf_counter()
+        if isinstance(e, evt.BeginPass):
+            self._pass_t0 = now
+            self._pass_examples = 0
+            self._write({"type": "pass_begin", "pass": e.pass_id})
+        elif isinstance(e, evt.BeginIteration):
+            self._iter_t0 = now
+        elif isinstance(e, evt.EndIteration):
+            wall = (now - self._iter_t0) if self._iter_t0 is not None \
+                else None
+            bs = getattr(e, "batch_size", None)
+            if bs:
+                self._pass_examples += bs
+            row = {"type": "iteration", "pass": e.pass_id,
+                   "batch": e.batch_id, "cost": e.cost,
+                   "metrics": e.metrics or {}}
+            if wall is not None:
+                row["wall_ms"] = round(wall * 1e3, 3)
+                if bs and wall > 0:
+                    row["examples_per_sec"] = round(bs / wall, 2)
+            if bs:
+                row["batch_size"] = bs
+            self._write(row)
+            self._iter_t0 = None
+        elif isinstance(e, evt.EndPass):
+            stats = self.stat_set if self.stat_set is not None \
+                else profiler.global_stat
+            wall = (now - self._pass_t0) if self._pass_t0 is not None \
+                else None
+            row = {"type": "pass_end", "pass": e.pass_id,
+                   "metrics": e.metrics or {},
+                   "stat_set": stats.as_dict()}
+            if wall is not None:
+                row["wall_s"] = round(wall, 3)
+                if self._pass_examples and wall > 0:
+                    row["examples_per_sec"] = round(
+                        self._pass_examples / wall, 2)
+            self._write(row)
+            if self.echo_stats:
+                print(stats.format(), flush=True)
+        elif isinstance(e, evt.TestResult):
+            self._write({"type": "test", "cost": e.cost,
+                         "metrics": e.metrics or {}})

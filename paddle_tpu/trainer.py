@@ -17,6 +17,7 @@ import numpy as np
 
 from . import event as evt
 from . import io as io_mod
+from . import profiler
 from .core.executor import Executor, TPUPlace
 from .core.program import (Program, Variable, default_main_program,
                            default_startup_program)
@@ -84,28 +85,56 @@ class SGD:
     # ------------------------------------------------------------------
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
-              test_reader: Optional[Callable] = None):
+              test_reader: Optional[Callable] = None,
+              run_log=None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
         Without an ``event_handler``, batch cost is logged every
         ``--log_period`` batches (flags.py), the reference trainer's
-        default output (TrainerInternal.cpp log_period path)."""
-        event_handler = event_handler or _default_log_handler()
+        default output (TrainerInternal.cpp log_period path).
+
+        ``run_log`` (a :class:`paddle_tpu.trace.RunLog` or any event
+        callable) receives every event IN ADDITION to ``event_handler``:
+        per-iteration cost/metrics/examples-per-sec land in its JSONL
+        journal and the global StatSet is dumped at EndPass — the
+        Trainer.cpp:449 stat dump, machine-readable."""
+        from . import trace
+
+        user_handler = event_handler or _default_log_handler()
+        if run_log is not None:
+            def event_handler(e, _h=user_handler, _r=run_log):
+                _h(e)
+                _r(e)
+        else:
+            event_handler = user_handler
         self._init_params()
         for pass_id in range(num_passes):
             event_handler(evt.BeginPass(pass_id))
             pass_costs, pass_metrics = [], []
             for batch_id, batch in enumerate(reader()):
                 event_handler(evt.BeginIteration(pass_id, batch_id))
-                feed = self.feeder.feed(batch)
-                fetched = self.exe.run(self.main_program, feed=feed,
-                                       fetch_list=self._fetch_list(),
-                                       scope=self.scope)
-                cost, mvals = self._split(fetched)
+                # REGISTER_TIMER("TrainBatch") parity: the step timer
+                # accumulates in the global StatSet, which RunLog dumps
+                # (and print_all_status prints) at pass end
+                with trace.span("trainer/iteration", pass_id=pass_id,
+                                batch_id=batch_id) as sp, \
+                        profiler.timer("trainer/step"):
+                    feed = self.feeder.feed(batch)
+                    fetched = self.exe.run(self.main_program, feed=feed,
+                                           fetch_list=self._fetch_list(),
+                                           scope=self.scope)
+                    cost, mvals = self._split(fetched)
+                    if sp is not None:
+                        sp.set_attr("cost", cost)
                 pass_costs.append(cost)
                 pass_metrics.append(mvals)
-                event_handler(evt.EndIteration(pass_id, batch_id, cost, mvals))
+                try:
+                    bs = len(batch)
+                except TypeError:
+                    bs = None
+                event_handler(evt.EndIteration(pass_id, batch_id, cost,
+                                               mvals, batch_size=bs))
             summary = _mean_metrics(pass_metrics)
             summary["cost"] = float(np.mean(pass_costs)) if pass_costs else 0.0
             if test_reader is not None:

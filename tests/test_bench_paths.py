@@ -162,6 +162,21 @@ def test_sidecar_device_filtering(tmp_path, monkeypatch):
     assert "resnet" not in b._sidecar_load("aaaa")
 
 
+def test_trace_overhead_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models, trace
+
+    res = _bench().bench_trace_overhead(jax, pt, layers, models,
+                                        batch=2, hw=32, steps=3, warmup=1)
+    assert res["untraced_ms_per_batch"] > 0
+    assert res["traced_ms_per_batch"] > 0
+    assert res["spans_recorded"] > 0
+    # measurement must leave the global tracer off for later tests
+    assert not trace.enabled()
+
+
 def test_transpiler_bench_path_runs():
     import jax
 

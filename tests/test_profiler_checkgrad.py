@@ -1,5 +1,8 @@
 """Profiler / Stat-timer / checkgrad / check_nan_inf tests (SURVEY.md §5.1,
 §5.2: Stat.h timers, fluid profiler, --job=checkgrad, --check_nan_inf)."""
+import json
+import sys
+
 import numpy as np
 import pytest
 
@@ -32,6 +35,156 @@ class TestTimers:
         out = capsys.readouterr().out
         assert "inner" in out
         assert p.stats.table()[0][1] == 2
+
+
+class TestProfilerEdgePaths:
+    def test_nested_profiler_contexts_restore_outer(self, capsys):
+        """An inner profiler() must collect its own events and hand the
+        outer profile back on exit (the _local.profile save/restore)."""
+        with profiler.profiler(print_report=False) as outer:
+            with profiler.record_event("outer_evt"):
+                pass
+            with profiler.profiler(print_report=False) as inner:
+                with profiler.record_event("inner_evt"):
+                    pass
+            # back in the outer context: events land in OUTER again
+            with profiler.record_event("outer_evt"):
+                pass
+        outer_names = [r[0] for r in outer.stats.table()]
+        inner_names = [r[0] for r in inner.stats.table()]
+        assert outer_names == ["outer_evt"]
+        assert outer.stats.table()[0][1] == 2
+        assert inner_names == ["inner_evt"]
+        # and leaving the outermost context disables collection
+        with profiler.record_event("orphan"):
+            pass
+        assert [r[0] for r in outer.stats.table()] == ["outer_evt"]
+
+    def test_timer_block_on_callable_resolved_at_exit(self):
+        """timer(block_on=lambda: outs) must resolve the callable AFTER
+        the body ran, so the with-block can assign what it returns."""
+        import jax.numpy as jnp
+
+        s = profiler.StatSet()
+        resolved = []
+
+        def block_on():
+            resolved.append(True)
+            return outs
+
+        with profiler.timer("step", stat_set=s, block_on=block_on):
+            outs = jnp.ones((4,)) * 2
+        assert resolved == [True]
+        assert s.table()[0][1] == 1
+
+    def test_timer_block_on_none_sync_path(self):
+        s = profiler.StatSet()
+        with profiler.timer("step", stat_set=s, sync=True):
+            pass  # effects_barrier path must not crash without outputs
+        assert s.table()[0][1] == 1
+
+    def test_metrics_registry_concurrent_writers(self):
+        """Quantiles/QPS under concurrent observe/inc: no lost updates,
+        no exceptions, reservoir stays bounded."""
+        import threading
+
+        from paddle_tpu.serving.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        n_threads, per_thread = 8, 600  # 4800 observations > reservoir
+        errs = []
+
+        def writer(tid):
+            try:
+                for i in range(per_thread):
+                    m.inc("completed")
+                    m.observe_latency(0.001 * (i % 100 + 1))
+                    m.set_gauge("depth", i)
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        snap = m.snapshot()
+        assert snap["counters"]["completed"] == n_threads * per_thread
+        lat = snap["latency"]["request_ms"]
+        assert lat["count"] == 4096  # reservoir cap, not unbounded
+        assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"] <= 100.5
+        assert snap["qps"] > 0
+
+
+class TestFrameworkOpStatsStubbed:
+    """Satellite: the xprof-table parser exercised WITHOUT a real TPU
+    capture, via a stubbed xprof.convert module."""
+
+    def _stub_xprof(self, monkeypatch, payload):
+        import types
+
+        rtd = types.ModuleType("xprof.convert.raw_to_tool_data")
+        rtd.xspace_to_tool_data = lambda paths, tool, params: (
+            json.dumps(payload), None)
+        convert = types.ModuleType("xprof.convert")
+        convert.raw_to_tool_data = rtd
+        xprof = types.ModuleType("xprof")
+        xprof.convert = convert
+        monkeypatch.setitem(sys.modules, "xprof", xprof)
+        monkeypatch.setitem(sys.modules, "xprof.convert", convert)
+        monkeypatch.setitem(sys.modules,
+                            "xprof.convert.raw_to_tool_data", rtd)
+
+    def _capture_dir(self, tmp_path):
+        d = tmp_path / "trace" / "plugins" / "profile" / "run1"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "host.xplane.pb").write_bytes(b"\x00")
+        return str(tmp_path / "trace")
+
+    def test_parses_stubbed_table(self, tmp_path, monkeypatch):
+        cols = [{"label": "Operation Name"}, {"label": "Operation Type"},
+                {"label": "#Occurrences"},
+                {"label": "Total self-time (us)"},
+                {"label": "Model FLOP Rate (GFLOP/s)"},
+                {"label": "Measured Memory BW (GBytes/Sec)"},
+                {"label": "Operational Intensity (FLOPs/Byte)"},
+                {"label": "Bound by"}]
+
+        def row(vals):
+            return {"c": [{"v": v} for v in vals]}
+
+        table = {"cols": cols, "rows": [
+            row(["fusion.1", "fusion", 10, 50.0, 900.0, 800.0, 1.1,
+                 "Compute"]),
+            row(["copy.2", "copy", 4, 120.0, 0.0, 400.0, 0.0, "Memory"]),
+        ]}
+        # the converter wraps the table in a [meta, table] list
+        self._stub_xprof(monkeypatch, [None, table])
+        rows = profiler.framework_op_stats(self._capture_dir(tmp_path))
+        assert [r["name"] for r in rows] == ["copy.2", "fusion.1"]
+        assert rows[0]["total_self_us"] == 120.0  # sorted by self time
+        assert rows[0]["bound_by"] == "Memory"
+        assert rows[1]["flop_rate_gflops"] == 900.0
+        top1 = profiler.framework_op_stats(self._capture_dir(tmp_path),
+                                           top=1)
+        assert len(top1) == 1 and top1[0]["name"] == "copy.2"
+
+    def test_missing_columns_default_to_none(self, tmp_path, monkeypatch):
+        table = {"cols": [{"label": "Operation Name"},
+                          {"label": "Total self-time (us)"}],
+                 "rows": [{"c": [{"v": "op.a"}, {"v": 7.0}]}]}
+        self._stub_xprof(monkeypatch, [None, table])
+        rows = profiler.framework_op_stats(self._capture_dir(tmp_path))
+        assert rows[0]["name"] == "op.a"
+        assert rows[0]["type"] is None and rows[0]["bound_by"] is None
+
+    def test_no_capture_raises_file_not_found(self, tmp_path,
+                                              monkeypatch):
+        self._stub_xprof(monkeypatch, [None, {"cols": [], "rows": []}])
+        with pytest.raises(FileNotFoundError):
+            profiler.framework_op_stats(str(tmp_path / "empty"))
 
 
 class TestCheckNanInf:

@@ -1,0 +1,581 @@
+"""paddle_tpu.trace: span tracer, exporters, interpret-mode executor,
+serving request spans, RunLog, Prometheus exposition, device gauges.
+
+The acceptance surface of the telemetry plane:
+- exported Chrome traces are valid trace-event JSON with correctly
+  nested request -> queue -> execute spans;
+- ``trace_level=2`` names the exact op and output var for an injected
+  NaN;
+- the ring buffer / sampling keep tracing bounded.
+"""
+import io
+import json
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler, trace
+from paddle_tpu.serving import DynamicBatcher, InferenceEngine
+from paddle_tpu.serving.metrics import MetricsRegistry
+from paddle_tpu.trace.tracer import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tracer():
+    """Tests share the process-global tracer: leave it off and empty."""
+    tracer = trace.get_tracer()
+    tracer.configure(level=0, sample_rate=1.0)
+    tracer.clear()
+    yield
+    tracer.configure(level=0, sample_rate=1.0)
+    tracer.clear()
+
+
+# ---------------------------------------------------------------------------
+# Tracer core
+# ---------------------------------------------------------------------------
+class TestTracer:
+    def test_nesting_and_parent_links(self):
+        t = Tracer(level=1)
+        with t.span("outer", k=1) as o:
+            with t.span("inner") as i:
+                assert i.parent_id == o.span_id
+                assert i.trace_id == o.trace_id
+            with t.span("inner2") as i2:
+                assert i2.parent_id == o.span_id
+        spans = t.spans()
+        assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+        outer = spans[-1]
+        assert outer.parent_id is None and outer.attrs == {"k": 1}
+        assert all(s.end is not None and s.end >= s.start for s in spans)
+        # sibling roots start new traces
+        with t.span("другой"):
+            pass
+        assert t.spans()[-1].trace_id != outer.trace_id
+
+    def test_disabled_is_noop(self):
+        t = Tracer(level=0)
+        with t.span("x") as sp:
+            assert sp is None
+        assert len(t) == 0 and t.start_span("y") is None
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer(level=1, capacity=8)
+        for i in range(20):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.spans()
+        assert len(spans) == 8
+        assert spans[0].name == "s12" and spans[-1].name == "s19"
+
+    def test_sampling_is_deterministic_and_suppresses_subtree(self):
+        t = Tracer(level=1, sample_rate=0.25)
+        kept = 0
+        for _ in range(100):
+            with t.span("root") as sp:
+                with t.span("child") as ch:
+                    # children of an unsampled root are suppressed
+                    assert (ch is None) == (sp is None)
+                if sp is not None:
+                    kept += 1
+        assert kept == 25
+        assert t.dropped == 75
+        # every recorded child still has its parent recorded
+        by_id = {s.span_id: s for s in t.spans()}
+        for s in t.spans():
+            if s.parent_id is not None:
+                assert s.parent_id in by_id
+
+    def test_detached_cross_thread_span(self):
+        t = Tracer(level=1)
+        root = t.start_span("request", detached=True)
+        out = {}
+
+        def worker():
+            # detached parent flows explicitly, not via the stack
+            assert t.current_span() is None
+            with t.span("work"):
+                pass
+            out["child"] = t.spans()[-1]
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        # thread-local span did NOT see the detached root as parent
+        assert out["child"].parent_id is None
+        root.finish(status="ok")
+        assert t.spans()[-1].name == "request"
+        assert t.spans()[-1].attrs["status"] == "ok"
+
+    def test_record_already_timed(self):
+        import time
+        t = Tracer(level=1)
+        root = t.start_span("r", detached=True)
+        t0 = time.perf_counter()
+        t1 = t0 + 0.5
+        sp = t.record("batchwork", t0, t1, parent=root, rows=4)
+        assert sp.parent_id == root.span_id
+        assert sp.duration == pytest.approx(0.5)
+        root.finish()
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+class TestExport:
+    def _traced(self):
+        t = Tracer(level=1)
+        with t.span("a"):
+            with t.span("b", x=2):
+                pass
+        return t
+
+    def test_chrome_trace_is_valid_trace_event_json(self, tmp_path):
+        t = self._traced()
+        path = str(tmp_path / "trace.json")
+        n = trace.export_chrome_trace(path, tracer=t)
+        doc = json.load(open(path))
+        events = doc["traceEvents"]
+        assert n == len(events) == 2
+        for e in events:
+            assert e["ph"] == "X"
+            assert set(e) >= {"name", "ts", "dur", "pid", "tid", "args"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        a = next(e for e in events if e["name"] == "a")
+        b = next(e for e in events if e["name"] == "b")
+        assert b["args"]["parent_id"] == a["args"]["span_id"]
+        assert b["args"]["x"] == 2
+        # child window inside parent window (nesting in the viewer)
+        assert a["ts"] <= b["ts"]
+        assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+
+    def test_jsonl_roundtrip_and_summary(self, tmp_path):
+        t = self._traced()
+        path = str(tmp_path / "spans.jsonl")
+        n = trace.export_jsonl(path, tracer=t)
+        assert n == 2
+        lines = [json.loads(x) for x in open(path)]
+        assert lines[0]["type"] == "trace_header"
+        events = trace.load_trace_events(path)
+        assert {e["name"] for e in events} == {"a", "b"}
+
+        sys.path.insert(0, "tools")
+        try:
+            import trace_summary
+        finally:
+            sys.path.pop(0)
+        rows = trace_summary.summarize(events)
+        assert [r[0] for r in rows][0] == "a"  # sorted by total desc
+        assert all(r[1] == 1 for r in rows)
+        out = trace_summary.format_rows(rows)
+        assert "a" in out and "calls" in out
+
+    def test_drain_clears(self):
+        t = self._traced()
+        buf = io.StringIO()
+        trace.export_chrome_trace(buf, tracer=t, drain=True)
+        assert len(t) == 0
+
+
+# ---------------------------------------------------------------------------
+# Executor integration
+# ---------------------------------------------------------------------------
+class TestExecutorTracing:
+    def _program(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            h = layers.fc(x, size=8, act="tanh")
+            y = layers.fc(h, size=2)
+        return main, startup, y
+
+    def test_compile_and_run_spans_with_cache_attrs(self):
+        main, startup, y = self._program()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        trace.enable(level=1)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        names = [(s.name, s.attrs.get("cache"))
+                 for s in trace.get_tracer().spans()]
+        assert ("executor/compile", "miss") in names
+        assert ("executor/run", "miss") in names
+        assert ("executor/run", "hit") in names
+        run_spans = [s for s in trace.get_tracer().spans()
+                     if s.name == "executor/run"]
+        assert all("key" in s.attrs for s in run_spans)
+
+    def test_interpret_mode_matches_compiled(self):
+        main, startup, y = self._program()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"x": np.random.RandomState(0)
+                .randn(3, 4).astype(np.float32)}
+        compiled, = exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        interp, = exe.run(main, feed=feed, fetch_list=[y], scope=scope,
+                          trace_level=2)
+        np.testing.assert_allclose(compiled, interp, atol=1e-5)
+
+    def test_interpret_mode_records_per_op_spans_with_stats(self):
+        main, startup, y = self._program()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        trace.enable(level=1)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[y], scope=scope, trace_level=2)
+        spans = trace.get_tracer().spans()
+        ops = [s for s in spans if s.name.startswith("op/")]
+        root = next(s for s in spans if s.name == "executor/interpret")
+        assert len(ops) == 5  # mul, add, tanh, mul, add
+        assert [s.attrs["op_index"] for s in ops] == list(range(5))
+        for s in ops:
+            assert s.parent_id == root.span_id
+            stats = s.attrs["outputs"]
+            out_stats = next(iter(stats.values()))
+            assert "shape" in out_stats and "dtype" in out_stats
+            assert out_stats.get("nonfinite", 0) == 0
+            assert "mean" in out_stats
+
+    def test_global_level2_switches_to_interpret(self):
+        main, startup, y = self._program()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        trace.enable(level=2)
+        exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                fetch_list=[y], scope=scope)
+        assert any(s.name == "executor/interpret"
+                   for s in trace.get_tracer().spans())
+
+    def test_injected_nan_names_exact_op_and_var(self):
+        """Acceptance: trace_level=2 upgrades 'a variable is bad' to a
+        located diagnosis naming op and output var."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[2])
+            h = layers.scale(x, bias=-10.0)  # healthy op
+            z = layers.log(h)                # log(negative) -> NaN HERE
+            out = layers.mean(z)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed={"x": np.array([[1.0, 2.0]], np.float32)},
+                    fetch_list=[out], scope=scope, trace_level=2)
+        msg = str(ei.value)
+        assert "'log'" in msg and "Out=" in msg
+        assert "log" in msg.split("output")[1]  # names the log output var
+
+    def test_interpret_writes_back_persistable_state(self):
+        """An optimizer step through the interpreter updates the scope
+        exactly like the compiled path (write-back contract)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        feed = {"x": np.ones((4, 4), np.float32),
+                "y": np.zeros((4, 1), np.float32)}
+        results = {}
+        for mode, lvl in (("compiled", None), ("interp", 2)):
+            scope = pt.Scope()
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup, scope=scope)
+            pname = main.all_parameters()[0].name
+            w0 = np.asarray(scope.get(pname)).copy()
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                    trace_level=lvl)
+            w1 = np.asarray(scope.get(pname))
+            assert not np.allclose(w0, w1), mode  # step happened
+            results[mode] = w1
+        np.testing.assert_allclose(results["compiled"],
+                                   results["interp"], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Serving request spans (acceptance: request -> queue -> execute nesting)
+# ---------------------------------------------------------------------------
+class TestServingSpans:
+    def _engine(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, size=2)
+        scope = pt.Scope()
+        pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+        return InferenceEngine(program=main, feed_names=["x"],
+                               fetch_names=[y.name], scope=scope,
+                               batch_buckets=[1, 2, 4], transpile=False)
+
+    def test_request_queue_execute_nesting_in_chrome_export(self, tmp_path):
+        trace.enable(level=1)
+        eng = self._engine()
+        batcher = DynamicBatcher(buckets=[1, 2, 4], max_wait_ms=1,
+                                 metrics=eng.metrics)
+        futs = [batcher.submit({"x": np.ones(4, np.float32) * i})
+                for i in range(3)]
+        while any(not f.done() for f in futs):
+            assert eng.serve_step(batcher, idle_wait_s=0.01)
+        for f in futs:
+            f.result(timeout=5)
+
+        path = str(tmp_path / "serving.json")
+        trace.export_chrome_trace(path)
+        events = json.load(open(path))["traceEvents"]
+        reqs = [e for e in events if e["name"] == "serving/request"]
+        assert len(reqs) == 3
+        for r in reqs:
+            kids = [e for e in events
+                    if e["args"].get("parent_id") == r["args"]["span_id"]]
+            kid_names = sorted(e["name"] for e in kids)
+            assert kid_names == ["serving/execute", "serving/queue"]
+            for k in kids:
+                # child windows nest inside the request window, and all
+                # three share the request's tid row (trace-id keyed)
+                assert k["tid"] == r["tid"]
+                assert k["ts"] >= r["ts"] - 1e-3
+                assert (k["ts"] + k["dur"]
+                        <= r["ts"] + r["dur"] + 1e-3)
+            q = next(e for e in kids if e["name"] == "serving/queue")
+            assert "queue_wait_s" in q["args"]
+            assert r["args"]["status"] == "ok"
+
+    def test_timeout_ends_span_with_status(self):
+        trace.enable(level=1)
+        batcher = DynamicBatcher(buckets=[4], max_wait_ms=1,
+                                 default_timeout_ms=1)
+        fut = batcher.submit({"x": np.ones(4, np.float32)})
+        import time as _t
+        _t.sleep(0.01)
+        assert batcher.next_batch(wait_s=0) == []
+        with pytest.raises(Exception):
+            fut.result(timeout=1)
+        spans = {s.name: s for s in trace.get_tracer().spans()}
+        assert spans["serving/request"].attrs["status"] == "timeout"
+
+    def test_tracing_off_leaves_requests_clean(self):
+        eng = self._engine()
+        batcher = DynamicBatcher(buckets=[1, 2, 4], max_wait_ms=1)
+        fut = batcher.submit({"x": np.ones(4, np.float32)})
+        eng.serve_step(batcher, idle_wait_s=0.01)
+        assert fut.result(timeout=5)
+        assert len(trace.get_tracer()) == 0
+
+
+# ---------------------------------------------------------------------------
+# RunLog
+# ---------------------------------------------------------------------------
+class TestRunLog:
+    def test_journals_iterations_and_statset_dump(self, tmp_path):
+        from paddle_tpu import reader as reader_mod
+        from paddle_tpu.trainer import SGD
+
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1], dtype="int64")
+        cost = layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(x, size=3), y))
+        trainer = SGD(cost=cost,
+                      optimizer=pt.optimizer.SGDOptimizer(0.2),
+                      feed_list=[x, y], place=pt.CPUPlace())
+        rng = np.random.RandomState(0)
+        xs = rng.rand(32, 8).astype("float32")
+        ys = rng.randint(0, 3, size=(32, 1)).astype("int64")
+
+        def r():
+            for i in range(32):
+                yield xs[i], ys[i]
+
+        stats = profiler.StatSet()
+        with profiler.timer("train/step", stat_set=stats):
+            pass
+        path = str(tmp_path / "run.jsonl")
+        with trace.RunLog(path, stat_set=stats) as rl:
+            trainer.train(reader_mod.batch(r, 8), num_passes=2,
+                          event_handler=lambda e: None, run_log=rl)
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["type"] == "run_header"
+        iters = [r_ for r_ in rows if r_["type"] == "iteration"]
+        ends = [r_ for r_ in rows if r_["type"] == "pass_end"]
+        assert len(iters) == 8 and len(ends) == 2
+        for it in iters:
+            assert {"pass", "batch", "cost", "wall_ms",
+                    "examples_per_sec", "batch_size"} <= set(it)
+            assert it["batch_size"] == 8
+        # EndPass dumps the StatSet (Trainer.cpp:449 parity)
+        assert "train/step" in ends[-1]["stat_set"]
+        assert ends[-1]["metrics"]["cost"] == pytest.approx(
+            np.mean([it["cost"] for it in iters[4:]]), rel=1e-6)
+        assert ends[-1]["examples_per_sec"] > 0
+
+        # trace_summary --runlog summarizes it
+        sys.path.insert(0, "tools")
+        try:
+            import trace_summary
+        finally:
+            sys.path.pop(0)
+        out = trace_summary.summarize_runlog(path)
+        assert "pass 0" in out and "pass 1" in out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + device gauges
+# ---------------------------------------------------------------------------
+class TestPrometheus:
+    def test_text_exposition_format(self):
+        m = MetricsRegistry()
+        m.inc("completed", 3)
+        m.set_gauge("queue_depth", 2)
+        m.set_gauge("compile_cache/e0_hits", 7)
+        for v in (0.01, 0.02, 0.03):
+            m.observe_latency(v)
+        text = m.prometheus_text(
+            timers={"serving/step": {"calls": 2, "total_ms": 10.0,
+                                     "min_ms": 4.0, "max_ms": 6.0,
+                                     "avg_ms": 5.0}})
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert "paddle_tpu_completed_total 3" in lines
+        assert "paddle_tpu_queue_depth 2" in lines
+        # illegal chars sanitized
+        assert "paddle_tpu_compile_cache_e0_hits 7" in lines
+        assert any(line.startswith(
+            'paddle_tpu_request_latency_seconds{quantile="0.5"}')
+            for line in lines)
+        assert "paddle_tpu_request_latency_seconds_count 3" in lines
+        assert ('paddle_tpu_timer_seconds_sum{name="serving/step"} 0.01'
+                in lines)
+        # every sample line parses as "name{labels} number"
+        for line in lines:
+            if line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            float(value)
+            assert name and " " not in name.split("{")[0]
+
+    def test_http_prom_endpoint(self):
+        import urllib.request
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            y = layers.fc(x, size=2)
+        scope = pt.Scope()
+        pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+        eng = InferenceEngine(program=main, feed_names=["x"],
+                              fetch_names=[y.name], scope=scope,
+                              batch_buckets=[1, 2], transpile=False)
+        from paddle_tpu.serving import Server
+        with Server(eng, batch_buckets=[1, 2], max_wait_ms=1) as srv:
+            srv.submit({"x": np.ones(4, np.float32)}).result(timeout=10)
+            port = srv.serve_http(port=0)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=prom",
+                    timeout=10) as resp:
+                assert "text/plain" in resp.headers["Content-Type"]
+                body = resp.read().decode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                snap = json.loads(resp.read())
+        assert "paddle_tpu_completed_total 1" in body
+        assert "paddle_tpu_qps" in body
+        assert "counters" in snap  # JSON flavor unchanged
+
+    def test_device_memory_stats_shape(self):
+        stats = trace.device_memory_stats()
+        assert isinstance(stats, dict)
+        for k, v in stats.items():
+            assert k.startswith("device") and isinstance(v, float)
+
+    def test_update_device_gauges_is_safe(self):
+        m = MetricsRegistry()
+        m.update_device_gauges()  # CPU backend: no-op or mem/ gauges
+        for k in m.snapshot()["gauges"]:
+            if k.startswith("mem/"):
+                assert "bytes" in k
+
+
+# ---------------------------------------------------------------------------
+# Satellites: publish high-water mark, StatSet count kind
+# ---------------------------------------------------------------------------
+class TestMetricsPublishIncremental:
+    def test_no_double_count_on_repeated_publish(self):
+        m = MetricsRegistry()
+        s = profiler.StatSet()
+        for v in (0.1, 0.2, 0.3):
+            m.observe_latency(v, name="step")
+        m.publish_to_profiler(stat_set=s)
+        assert s.as_dict()["serving/step"]["calls"] == 3
+        # repeat: nothing new -> nothing added
+        m.publish_to_profiler(stat_set=s)
+        assert s.as_dict()["serving/step"]["calls"] == 3
+        # one new observation -> exactly one more sample
+        m.observe_latency(0.4, name="step")
+        m.publish_to_profiler(stat_set=s)
+        d = s.as_dict()["serving/step"]
+        assert d["calls"] == 4
+        assert d["total_ms"] == pytest.approx(1000.0)
+
+    def test_independent_stat_sets_each_get_full_history(self):
+        # the high-water mark is per-registry, not per-target: a second
+        # target gets only post-mark samples (documented incremental
+        # contract), so publish to the long-lived set first
+        m = MetricsRegistry()
+        s1 = profiler.StatSet()
+        m.observe_latency(0.1)
+        m.publish_to_profiler(stat_set=s1)
+        m.observe_latency(0.2)
+        m.publish_to_profiler(stat_set=s1)
+        assert s1.as_dict()["serving/request"]["calls"] == 2
+
+
+class TestStatSetCountKind:
+    def test_counts_are_exact_integers(self):
+        s = profiler.StatSet()
+        s.add_count("transpiler/delta/x", -2)
+        s.add_count("transpiler/delta/x", 7)
+        d = s.as_dict()["transpiler/delta/x"]
+        assert d["kind"] == "count"
+        assert d["total_ms"] == 5  # exact, no 1e3 roundtrip
+        assert d["min_ms"] == -2 and d["max_ms"] == 7
+        assert d["calls"] == 2 and d["avg_ms"] == 2.5
+        # large counts stay exact (the old /1e3 trick lost integerness)
+        s.add_count("big", 123456789)
+        assert s.as_dict()["big"]["total_ms"] == 123456789
+
+    def test_single_negative_count_has_sane_min_max(self):
+        s = profiler.StatSet()
+        s.add_count("delta", -3)
+        d = s.as_dict()["delta"]
+        assert d["min_ms"] == -3 and d["max_ms"] == -3
+
+    def test_mixed_kind_converts_to_first_kind_display_plane(self):
+        s = profiler.StatSet()
+        s.add("t", 0.002)          # timer first: entry displays ms
+        s.add_count("t", 5)        # count converted into the ms plane
+        d = s.as_dict()["t"]
+        assert d["kind"] == "time"
+        assert d["calls"] == 2
+        assert d["total_ms"] == pytest.approx(7.0)
+        assert d["min_ms"] == pytest.approx(2.0)
+        assert d["max_ms"] == pytest.approx(5.0)
+
+    def test_timer_readback_shape_unchanged(self):
+        s = profiler.StatSet()
+        with profiler.timer("step", stat_set=s):
+            pass
+        name, calls, total, mn, mx, avg = s.table()[0]
+        assert name == "step" and calls == 1
+        assert {"calls", "total_ms", "min_ms", "max_ms",
+                "avg_ms"} <= set(s.as_dict()["step"])
+        assert s.kind_of("step") == "time"

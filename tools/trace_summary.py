@@ -1,0 +1,112 @@
+"""Aggregate a paddle_tpu trace file into a per-name table.
+
+Accepts either export format (Chrome trace-event JSON from
+``trace.export_chrome_trace`` or the JSONL journal from
+``trace.export_jsonl``) and prints calls/total/min/max/avg ms per span
+name, sorted by total — the offline analogue of
+``profiler.print_all_status`` for traces:
+
+    python tools/trace_summary.py /tmp/trace.json
+    python tools/trace_summary.py spans.jsonl --top 20 --prefix serving/
+    python tools/trace_summary.py run.jsonl --runlog   # RunLog journals
+
+``--runlog`` summarizes a trace.RunLog training journal instead:
+per-pass cost, examples/sec, and the pass-end StatSet highlights.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def summarize(events, prefix=""):
+    """Per-name rows (name, calls, total_ms, min_ms, max_ms, avg_ms)
+    from trace events (``load_trace_events`` output), sorted by total
+    descending."""
+    agg = {}
+    for e in events:
+        name = e.get("name", "?")
+        if not name.startswith(prefix):
+            continue
+        dur = float(e.get("dur", 0.0)) / 1e3  # us -> ms
+        row = agg.setdefault(name, [0, 0.0, float("inf"), float("-inf")])
+        row[0] += 1
+        row[1] += dur
+        row[2] = min(row[2], dur)
+        row[3] = max(row[3], dur)
+    rows = [(name, c, tot, mn, mx, tot / c)
+            for name, (c, tot, mn, mx) in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def format_rows(rows):
+    head = (f"{'name':<40}{'calls':>8}{'total ms':>12}{'min ms':>10}"
+            f"{'max ms':>10}{'avg ms':>10}")
+    lines = [head, "-" * len(head)]
+    for name, calls, total, mn, mx, avg in rows:
+        lines.append(f"{name:<40}{calls:>8}{total:>12.3f}{mn:>10.3f}"
+                     f"{mx:>10.3f}{avg:>10.3f}")
+    return "\n".join(lines) if rows else "(no spans)"
+
+
+def summarize_runlog(path):
+    """Condense a RunLog JSONL journal: per-pass cost / examples/sec and
+    iteration counts."""
+    passes = {}
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        t = row.get("type")
+        if t == "iteration":
+            p = passes.setdefault(row["pass"], {"iters": 0, "cost": None})
+            p["iters"] += 1
+            p["cost"] = row["cost"]
+        elif t == "pass_end":
+            p = passes.setdefault(row["pass"], {"iters": 0, "cost": None})
+            p["metrics"] = row.get("metrics")
+            p["examples_per_sec"] = row.get("examples_per_sec")
+    lines = []
+    for pid in sorted(passes):
+        p = passes[pid]
+        m = p.get("metrics") or {}
+        eps = p.get("examples_per_sec")
+        lines.append(
+            f"pass {pid}: {p['iters']} iters, last cost="
+            f"{p['cost'] if p['cost'] is not None else '?'}, "
+            f"mean cost={m.get('cost', '?')}"
+            + (f", {eps} examples/s" if eps else ""))
+    return "\n".join(lines) if lines else "(no passes)"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace file (chrome JSON or JSONL)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the top-N rows by total time")
+    ap.add_argument("--prefix", default="",
+                    help="only span names with this prefix")
+    ap.add_argument("--runlog", action="store_true",
+                    help="input is a trace.RunLog training journal")
+    args = ap.parse_args(argv)
+    if args.runlog:
+        print(summarize_runlog(args.trace))
+        return 0
+    from paddle_tpu.trace import load_trace_events
+
+    events = load_trace_events(args.trace)
+    rows = summarize(events, prefix=args.prefix)
+    if args.top:
+        rows = rows[:args.top]
+    print(format_rows(rows))
+    print(f"\n{len(events)} spans, {len(rows)} distinct names")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
