@@ -460,6 +460,85 @@ def bench_trace_overhead(jax, pt, layers, models, name="resnet50",
     }
 
 
+def bench_train_pipeline(jax, pt, layers, batch=256, dim=1024, depth=4,
+                         steps=30, warmup=5, rounds=3):
+    """Sync vs async trainer-loop A/B: the same SGD model trained through
+    ``train(async_depth=1)`` and ``train(async_depth=N)``, interleaved
+    rounds with medians (same drift defense as bench_trace_overhead).
+    Reports ms/step for both loops plus the host gap — dispatch-to-
+    dispatch wall time minus the pure-device step time (measured with a
+    device-resident feed, async dispatch, one closing fetch). The sync
+    loop pays batch stacking + a blocking fetch + numpy readback on every
+    step's critical path; the async loop hides them behind the device,
+    which is the tentpole contract (PERF.md 'overlapped training
+    pipeline')."""
+    import numpy as np
+
+    from paddle_tpu.trainer import SGD
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[dim])
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=dim, act="relu")
+        h = layers.fc(h, size=dim, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        trainer = SGD(cost=loss,
+                      optimizer=pt.optimizer.SGDOptimizer(learning_rate=0.1),
+                      feed_list=[x, y], place=pt.TPUPlace(),
+                      scope=pt.Scope())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(batch, dim).astype("float32")
+    ys = rng.randint(0, 10, size=(batch, 1)).astype("int64")
+    rows = [(xs[i], ys[i]) for i in range(batch)]
+
+    def reader():
+        for _ in range(steps):
+            yield rows
+
+    trainer._init_params()
+    quiet = lambda e: None  # noqa: E731 - no log spam in the bench
+
+    def measure(async_depth):
+        t0 = time.perf_counter()
+        trainer.train(reader, num_passes=1, event_handler=quiet,
+                      async_depth=async_depth)
+        return (time.perf_counter() - t0) / steps
+
+    # Pure-device step time: device-resident feed, async dispatch, one
+    # blocking fetch closing the window (the bench harness idiom) — the
+    # subtrahend for the host-gap numbers.
+    feed_dev = {"x": jax.device_put(xs), "y": jax.device_put(ys)}
+    for _ in range(warmup):
+        trainer.exe.run(main_prog, feed=feed_dev, fetch_list=[loss],
+                        scope=trainer.scope)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out, = trainer.exe.run(main_prog, feed=feed_dev, fetch_list=[loss],
+                               scope=trainer.scope, return_numpy=False)
+    np.asarray(out)
+    device_s = (time.perf_counter() - t0) / steps
+
+    measure(1)          # warm both loop paths (compiles already cached)
+    measure(depth)
+    sync_s, async_s = [], []
+    for _ in range(rounds):
+        sync_s.append(measure(1))
+        async_s.append(measure(depth))
+    sync = sorted(sync_s)[rounds // 2]
+    asynd = sorted(async_s)[rounds // 2]
+    return {
+        "sync_ms_per_step": round(sync * 1e3, 3),
+        "async_ms_per_step": round(asynd * 1e3, 3),
+        "device_ms_per_step": round(device_s * 1e3, 3),
+        "host_gap_sync_ms": round((sync - device_s) * 1e3, 3),
+        "host_gap_async_ms": round((asynd - device_s) * 1e3, 3),
+        "async_depth": depth,
+        "speedup_pct": round((sync - asynd) / sync * 100.0, 2),
+    }
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -617,6 +696,7 @@ def assemble(rows, parent_notes=None):
         "lstm_varlen": res("lstm_varlen"),
         "decode_kv_cache": res("decode"),
         "trace_overhead": res("trace_overhead"),
+        "train_pipeline": res("train_pipeline"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -773,6 +853,7 @@ def run_bench(platform):
              models, "resnet50")
         step("trace_overhead", bench_trace_overhead, jax, pt, layers,
              models)
+        step("train_pipeline", bench_train_pipeline, jax, pt, layers)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

@@ -19,7 +19,7 @@ from .core.enforce import (EnforceError, enforce, enforce_eq, enforce_ge,
                            enforce_not_none)
 from .flags import FLAGS, parse_flags, set_flags
 from .data_feeder import DataFeeder
-from .core import (CPUPlace, Executor, Program, Scope, TPUPlace,
+from .core import (CPUPlace, Executor, Program, RunHandle, Scope, TPUPlace,
                    recompute_guard,
                    default_main_program, default_startup_program, global_scope,
                    program_guard)

@@ -1,4 +1,4 @@
-from .executor import CPUPlace, Executor, TPUPlace
+from .executor import CPUPlace, Executor, RunHandle, TPUPlace
 from .program import (Block, Operator, Parameter, Program, Variable,
                       default_main_program, default_startup_program,
                       program_guard, recompute_guard)

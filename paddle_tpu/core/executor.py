@@ -145,6 +145,64 @@ class _Compiled:
         self.rw_shardings = rw_shardings
 
 
+class RunHandle:
+    """Deferred result of :meth:`Executor.run_async`.
+
+    Holds the fetched values as device arrays (jax's async dispatch means
+    the computation may still be in flight) plus the updated-state arrays
+    for deferred ``check_nan_inf``. Nothing touches the host until
+    :meth:`result` / :meth:`numpy`; the scope write-back already happened
+    at dispatch time with device arrays, so consecutive dispatches chain
+    on-device without a host round-trip.
+    """
+
+    __slots__ = ("fetch_names", "_fetches", "_state_pairs", "_check",
+                 "_dense")
+
+    def __init__(self, fetches, fetch_names, state_pairs=(), check_nan_inf=False):
+        self._fetches = list(fetches)
+        self.fetch_names = list(fetch_names)
+        self._state_pairs = list(state_pairs)
+        self._check = check_nan_inf
+        self._dense = None
+
+    def done(self) -> bool:
+        """Non-blocking readiness poll (True for host-resident values)."""
+        return all(v.is_ready() for v in self._fetches
+                   if isinstance(v, jax.Array))
+
+    def block(self) -> "RunHandle":
+        """Wait for device completion without transferring to host."""
+        for v in self._fetches:
+            if isinstance(v, jax.Array):
+                v.block_until_ready()
+        return self
+
+    def result(self, return_numpy: bool = True):
+        """Resolve the run: blocks on the device values, applies the
+        deferred ``check_nan_inf`` scan (fetches AND written-back state),
+        and returns the fetch list — numpy by default, device arrays with
+        ``return_numpy=False``."""
+        if self._dense is None:
+            if self._check:
+                for name, val in self._state_pairs:
+                    _check_nan_inf(name, val)
+                for name, val in zip(self.fetch_names, self._fetches):
+                    _check_nan_inf(name, val)
+            self._dense = [densify(v) for v in self._fetches]
+            self._state_pairs = []  # release refs to superseded state
+        if return_numpy:
+            return [Executor._fetch_numpy(v) for v in self._dense]
+        return list(self._dense)
+
+    def numpy(self):
+        return self.result(return_numpy=True)
+
+    def __repr__(self):
+        state = "done" if self.done() else "in-flight"
+        return f"RunHandle({self.fetch_names}, {state})"
+
+
 class Executor:
     """Compiles and runs Programs.
 
@@ -239,8 +297,76 @@ class Executor:
             return self._run_compiled(compiled, feed_vals, fetch_names,
                                       scope, program, return_numpy)
 
-    def _run_compiled(self, compiled: "_Compiled", feed_vals, fetch_names,
-                      scope: Scope, program: Program, return_numpy: bool):
+    # ------------------------------------------------------------------
+    def run_async(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        trace_level: Optional[int] = None,
+    ) -> RunHandle:
+        """Dispatch a run WITHOUT any host synchronisation and return a
+        :class:`RunHandle` of device arrays.
+
+        jax's async dispatch does the overlap: the call returns as soon as
+        the computation is enqueued; updated persistable state lands back
+        in the scope as (possibly still in-flight) device arrays, so the
+        next ``run_async`` chains on-device. ``check_nan_inf`` scans are
+        deferred to ``handle.result()`` — the only point that touches the
+        host. At trace level >= 2 the per-op interpret path runs eagerly
+        and the handle comes back already resolved.
+        """
+        program = program or prog_mod.default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in fetch_list]
+        block = program.global_block
+        feed_vals = self._normalize_feeds(block, feed)
+
+        level = trace.active_level() if trace_level is None else trace_level
+        if level >= 2 and self.mesh is None:
+            outs = self._run_interpreted(program, feed_vals, fetch_names,
+                                         scope, return_numpy=False)
+            return RunHandle(outs, fetch_names,
+                             check_nan_inf=self.check_nan_inf)
+
+        key = self._cache_key(program, feed_vals, fetch_names, scope)
+        compiled = self._cache.get(key)
+        cache_hit = compiled is not None
+        if compiled is None:
+            self.cache_misses += 1
+            with trace.span("executor/compile", cache="miss",
+                            key=f"{hash(key) & 0xffffffff:08x}",
+                            ops=len(block.ops), feeds=len(feed_vals),
+                            fetches=len(fetch_names)):
+                compiled = self._compile(program, feed_vals, fetch_names,
+                                         scope)
+            self._cache[key] = compiled
+        else:
+            self.cache_hits += 1
+        with trace.span("executor/dispatch",
+                        cache="hit" if cache_hit else "miss",
+                        key=f"{hash(key) & 0xffffffff:08x}",
+                        ops=len(block.ops)):
+            fetches, new_states, new_rng = self._call_compiled(
+                compiled, feed_vals, scope, program)
+            # Write-back of donated state WITHOUT materializing on host:
+            # the scope holds the in-flight device arrays directly.
+            if new_rng is not None:
+                scope.set(RNG_VAR, new_rng)
+            pairs = list(zip(compiled.out_state_names, new_states))
+            for name, val in pairs:
+                scope.set(name, val)
+        return RunHandle(fetches, fetch_names, state_pairs=pairs,
+                         check_nan_inf=self.check_nan_inf)
+
+    def _call_compiled(self, compiled: "_Compiled", feed_vals,
+                       scope: Scope, program: Program):
+        """Invoke the jitted callable (pure dispatch, no scope writes).
+        Returns ``(fetches, new_states, new_rng_or_None)``."""
         feed_args = [feed_vals[n] for n in compiled.feed_names]
         ro_args = [scope.get(n) for n in compiled.ro_state_names]
         rw_args = [scope.get(n) for n in compiled.rw_state_names]
@@ -261,11 +387,18 @@ class Executor:
                        for a, s in zip(rw_args, compiled.rw_shardings)]
         if compiled.uses_rng:
             rng = self._rng_state(program, scope)
-            fetches, new_states, new_rng = compiled.fn(feed_args, ro_args, rw_args, rng)
-            scope.set(RNG_VAR, new_rng)
-        else:
-            fetches, new_states = compiled.fn(feed_args, ro_args, rw_args)
+            fetches, new_states, new_rng = compiled.fn(
+                feed_args, ro_args, rw_args, rng)
+            return fetches, new_states, new_rng
+        fetches, new_states = compiled.fn(feed_args, ro_args, rw_args)
+        return fetches, new_states, None
 
+    def _run_compiled(self, compiled: "_Compiled", feed_vals, fetch_names,
+                      scope: Scope, program: Program, return_numpy: bool):
+        fetches, new_states, new_rng = self._call_compiled(
+            compiled, feed_vals, scope, program)
+        if new_rng is not None:
+            scope.set(RNG_VAR, new_rng)
         for name, val in zip(compiled.out_state_names, new_states):
             if self.check_nan_inf:
                 _check_nan_inf(name, val)
@@ -486,8 +619,13 @@ class Executor:
         # The data-flow classification depends on which names exist in the
         # scope (state inputs), so the set of scope keys is part of the key —
         # as are the global dtype policies (AMP / MXU precision) and the
-        # mesh/plan, all of which change the traced computation.
-        scope_keys = frozenset(self._all_scope_keys(scope))
+        # mesh/plan, all of which change the traced computation. The key
+        # set is memoized inside the Scope per key-set version: a training
+        # step rewrites existing names, which does not bump the version,
+        # so the steady-state path hashes a cached frozenset instead of
+        # rebuilding an O(#params) set every run.
+        scope_keys = scope.key_set() if hasattr(scope, "key_set") \
+            else frozenset(self._all_scope_keys(scope))
         return (id(program), program.version, feed_sig, tuple(fetch_names),
                 id(scope), scope_keys, ops_common.amp_enabled(),
                 ops_common.mxu_precision(),

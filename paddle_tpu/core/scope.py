@@ -19,6 +19,11 @@ class Scope:
         self._vars: Dict[str, jax.Array] = {}
         self.parent = parent
         self.kids = []
+        # Bumped only when the KEY SET changes (a new name, or a delete) —
+        # steady-state training rewrites existing names every step and must
+        # not invalidate the executor's memoized cache-key key-set.
+        self._keys_version = 0
+        self._keyset_cache: Optional[tuple] = None
         if parent is not None:
             parent.kids.append(self)
 
@@ -27,6 +32,8 @@ class Scope:
 
     # -- access ------------------------------------------------------------
     def set(self, name: str, value) -> None:
+        if name not in self._vars:
+            self._keys_version += 1
         self._vars[name] = value
 
     def get(self, name: str):
@@ -46,10 +53,40 @@ class Scope:
         return False
 
     def delete(self, name: str) -> None:
+        if name in self._vars:
+            self._keys_version += 1
         self._vars.pop(name, None)
 
     def keys(self) -> Iterator[str]:
         return iter(self._vars.keys())
+
+    def keys_version(self) -> tuple:
+        """Composed key-set version up the parent chain: equal tuples
+        guarantee the set of visible names is unchanged."""
+        out = []
+        s: Optional[Scope] = self
+        while s is not None:
+            out.append(s._keys_version)
+            s = s.parent
+        return tuple(out)
+
+    def key_set(self) -> frozenset:
+        """All names visible from this scope (self + ancestors), memoized
+        per :meth:`keys_version` — the executor hashes this every run
+        (core/executor.py _cache_key) so it must not rebuild an
+        O(#params) set per step."""
+        ver = self.keys_version()
+        cached = self._keyset_cache
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        names = set()
+        s: Optional[Scope] = self
+        while s is not None:
+            names.update(s._vars)
+            s = s.parent
+        out = frozenset(names)
+        self._keyset_cache = (ver, out)
+        return out
 
     def find_var_scope(self, name: str) -> Optional["Scope"]:
         s: Optional[Scope] = self

@@ -37,9 +37,20 @@ def _is_ragged(col) -> bool:
 
 
 class DataFeeder:
-    def __init__(self, feed_list: Sequence[Variable], place=None):
+    """``pad_to_multiple`` rounds every ragged column's padded length up
+    to the next multiple (serving-engine-style bucket padding): the
+    executor compiles one XLA computation per feed-shape signature, so
+    padding to the exact batch max means every distinct max length is a
+    fresh compile — bucketed padding caps the signature set. Pair with
+    ``reader.bucket_by_length(..., pad_to_multiple=m)`` so batches also
+    GROUP by the same buckets (occupancy)."""
+
+    def __init__(self, feed_list: Sequence[Variable], place=None,
+                 pad_to_multiple: int = None):
         self.feed_vars = list(feed_list)
         self.place = place
+        self.pad_to_multiple = (int(pad_to_multiple)
+                                if pad_to_multiple else None)
 
     def feed(self, data: Sequence[Sequence]) -> Dict[str, np.ndarray]:
         """Convert a minibatch (list of rows) into {name: array} feeds."""
@@ -72,6 +83,9 @@ class DataFeeder:
         seqs = [np.asarray(item, dtype=var.dtype) for item in col]
         lengths = np.asarray([s.shape[0] for s in seqs], dtype=np.int32)
         max_len = int(lengths.max()) if len(lengths) else 0
+        m = self.pad_to_multiple
+        if m and m > 1:
+            max_len = -(-max_len // m) * m
         tail = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
         padded = np.zeros((len(seqs), max_len) + tail, dtype=var.dtype)
         for i, s in enumerate(seqs):

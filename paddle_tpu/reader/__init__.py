@@ -1,4 +1,4 @@
-from .decorator import (PipeReader, bucket_by_length, buffered, cache,
-                        chain, compose, firstn, map_readers, shuffle,
-                        xmap_readers)
+from .decorator import (PipeReader, background_stage, bucket_by_length,
+                        buffered, cache, chain, compose, device_prefetch,
+                        firstn, map_readers, shuffle, xmap_readers)
 from .minibatch import batch

@@ -18,7 +18,73 @@ from typing import Any, Callable, Iterable, List
 __all__ = [
     "map_readers", "buffered", "bucket_by_length", "shuffle", "chain",
     "compose", "firstn", "xmap_readers", "cache", "PipeReader",
+    "background_stage", "device_prefetch",
 ]
+
+
+class _End:
+    """Fill-thread sentinel: normal end of stream."""
+
+
+class _Error:
+    """Fill-thread sentinel: the source raised; re-raise in the consumer."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def background_stage(source, depth: int, transform: Callable = None):
+    """Run ``source()`` (and optionally ``transform`` per item) on a
+    background thread, staying up to ``depth`` items ahead of the
+    consumer — the generic pipeline stage under ``buffered`` and
+    ``device_prefetch``.
+
+    Leak-safe: an abandoned consumer (early ``break``, GC of the
+    generator) closes the stage — a stop flag is set and the queue
+    drained so a fill thread parked on a full queue always unblocks and
+    exits; source errors propagate to the consumer instead of silently
+    truncating the stream.
+    """
+
+    def staged():
+        q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        stop = threading.Event()
+
+        def fill():
+            try:
+                for d in source():
+                    if stop.is_set():
+                        return
+                    q.put(transform(d) if transform is not None else d)
+                    if stop.is_set():
+                        return
+                q.put(_End)
+            except BaseException as exc:  # noqa: BLE001 - forwarded
+                q.put(_Error(exc))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    break
+                if isinstance(e, _Error):
+                    raise e.exc
+                yield e
+        finally:
+            stop.set()
+            # Unblock a fill() parked on a full queue: drain until the
+            # thread has observed the stop flag and exited.
+            while t.is_alive():
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+
+    return staged
 
 
 def map_readers(func: Callable, *readers):
@@ -87,7 +153,8 @@ def compose(*readers, check_alignment: bool = True):
 
 
 def bucket_by_length(reader, batch_size: int, key=None, buf_size: int = 1024,
-                     shuffle_buckets: bool = True, seed: int = None):
+                     shuffle_buckets: bool = True, seed: int = None,
+                     pad_to_multiple: int = None):
     """Batch variable-length samples with like-length neighbours.
 
     Sorts a sliding ``buf_size`` window by ``key`` (default: len of the
@@ -98,9 +165,19 @@ def bucket_by_length(reader, batch_size: int, key=None, buf_size: int = 1024,
     recovers most of what ragged data loses (the reference's RNN benchmark
     relies on the same sorted-bucket trick in its IMDB reader).
 
+    ``pad_to_multiple`` groups by length ROUNDED UP to the multiple (the
+    serving engine's bucket-padding trick applied to training): paired
+    with ``DataFeeder(pad_to_multiple=m)`` every batch pads to one of a
+    handful of bucket lengths instead of its exact max — each distinct
+    padded length is a fresh XLA compile signature, so this is what stops
+    steady-state varlen training from recompiling.
+
     Returns a reader of BATCHES (lists of samples), like ``paddle.batch``.
     """
     key = key or (lambda sample: len(sample[0]))
+    if pad_to_multiple and pad_to_multiple > 1:
+        raw_key, m = key, int(pad_to_multiple)
+        key = lambda sample: -(-raw_key(sample) // m) * m  # noqa: E731
     rng = random.Random(seed)
 
     def bucketed():
@@ -134,30 +211,10 @@ def bucket_by_length(reader, batch_size: int, key=None, buf_size: int = 1024,
 
 def buffered(reader, size: int):
     """Prefetch up to ``size`` samples on a background thread (the
-    DoubleBuffer analogue: reference DataProvider.h:249-271)."""
-
-    class _End:
-        pass
-
-    def buffered_reader():
-        q: queue.Queue = queue.Queue(maxsize=size)
-
-        def fill():
-            try:
-                for d in reader():
-                    q.put(d)
-            finally:
-                q.put(_End)
-
-        t = threading.Thread(target=fill, daemon=True)
-        t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                break
-            yield e
-
-    return buffered_reader
+    DoubleBuffer analogue: reference DataProvider.h:249-271). Built on
+    :func:`background_stage`, so abandoning the iterator early leaves no
+    live fill thread."""
+    return background_stage(reader, depth=size)
 
 
 def device_prefetch(feed_reader, depth: int = 2, device=None):
@@ -168,38 +225,22 @@ def device_prefetch(feed_reader, depth: int = 2, device=None):
     passes jax.Array feeds through without a host round-trip
     (core/executor.py _normalize_feeds), so this is the TPU-native
     replacement for the reference's double-buffered data providers feeding
-    pinned host memory to cudaMemcpyAsync.
+    pinned host memory to cudaMemcpyAsync. ``SGD.train(async_depth=N)``
+    runs its DataFeeder through this stage so batch stacking never blocks
+    dispatch.
 
     ``feed_reader()`` must yield {name: np.ndarray} dicts (e.g. a
     DataFeeder.feed applied to batches).
     """
     import jax
 
-    class _End:
-        pass
-
-    def prefetched():
+    def put(feed):
         dev = device or jax.devices()[0]
-        q: queue.Queue = queue.Queue(maxsize=depth)
+        return {k: (jax.device_put(v, dev)
+                    if not isinstance(v, jax.Array) else v)
+                for k, v in feed.items()}
 
-        def fill():
-            try:
-                for feed in feed_reader():
-                    q.put({k: (jax.device_put(v, dev)
-                               if not isinstance(v, jax.Array) else v)
-                           for k, v in feed.items()})
-            finally:
-                q.put(_End)
-
-        t = threading.Thread(target=fill, daemon=True)
-        t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                break
-            yield e
-
-    return prefetched
+    return background_stage(feed_reader, depth=depth, transform=put)
 
 
 def firstn(reader, n: int):

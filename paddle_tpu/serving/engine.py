@@ -33,6 +33,35 @@ from .metrics import MetricsRegistry
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
+class PendingInference:
+    """Deferred result of :meth:`InferenceEngine.run_async`: one or more
+    in-flight padded chunk dispatches (core.executor.RunHandle). The
+    engine's batch metrics (execute latency, occupancy) are observed at
+    resolve time, covering dispatch->completion of the whole request."""
+
+    def __init__(self, engine: "InferenceEngine", parts):
+        self._engine = engine
+        self._parts = parts  # [(RunHandle, bucket, rows, t0), ...]
+        self._result = None
+
+    def done(self) -> bool:
+        return all(h.done() for h, _, _, _ in self._parts)
+
+    def result(self) -> List[np.ndarray]:
+        """Block until every chunk completes; returns the fetch list
+        sliced back to the true batch."""
+        if self._result is None:
+            outs = [self._engine._resolve_padded(h, bucket, n, t0)
+                    for h, bucket, n, t0 in self._parts]
+            if len(outs) == 1:
+                self._result = outs[0]
+            else:
+                self._result = [
+                    np.concatenate([o[i] for o in outs], axis=0)
+                    for i in range(len(self._engine.fetch_names))]
+        return self._result
+
+
 def _round_buckets(buckets: Sequence[int], multiple: int) -> List[int]:
     """Round every bucket up to ``multiple`` (mesh data-parallel needs
     per-device batch divisibility) and dedup, keeping order."""
@@ -116,12 +145,7 @@ class InferenceEngine:
         return list(v.shape or []), v.dtype
 
     # ------------------------------------------------------------------
-    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
-        """Execute one user batch: pads the leading dim to the nearest
-        bucket (chunking batches beyond the largest), runs the compiled
-        program, and returns the fetches sliced back to the true batch.
-        Assumes every feed and fetch carries the batch on axis 0 — the
-        save_inference_model feed contract."""
+    def _validated_arrays(self, feed: Dict[str, np.ndarray]):
         missing = [n for n in self.feed_names if n not in feed]
         if missing:
             raise BadRequestError(f"missing feeds: {missing}")
@@ -132,6 +156,15 @@ class InferenceEngine:
         n = next(iter(ns.values()))
         if n == 0:
             raise BadRequestError("empty batch")
+        return arrays, n
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Execute one user batch: pads the leading dim to the nearest
+        bucket (chunking batches beyond the largest), runs the compiled
+        program, and returns the fetches sliced back to the true batch.
+        Assumes every feed and fetch carries the batch on axis 0 — the
+        save_inference_model feed contract."""
+        arrays, n = self._validated_arrays(feed)
         outs: List[List[np.ndarray]] = []
         start = 0
         while start < n:
@@ -145,7 +178,25 @@ class InferenceEngine:
         return [np.concatenate([o[i] for o in outs], axis=0)
                 for i in range(len(self.fetch_names))]
 
-    def _run_padded(self, arrays: Dict[str, np.ndarray], n: int):
+    def run_async(self, feed: Dict[str, np.ndarray]) -> PendingInference:
+        """Non-blocking :meth:`run`: dispatches every padded chunk via
+        ``Executor.run_async`` and returns a :class:`PendingInference`
+        handle. The batcher uses this to pipeline consecutive buckets —
+        bucket k+1's padding/stacking and dispatch overlap bucket k's
+        device execution — and ``serve_step`` resolves in dispatch
+        order."""
+        arrays, n = self._validated_arrays(feed)
+        parts = []
+        start = 0
+        while start < n:
+            chunk = min(n - start, self.batch_buckets[-1])
+            parts.append(self._dispatch_padded(
+                {k: a[start:start + chunk] for k, a in arrays.items()},
+                chunk))
+            start += chunk
+        return PendingInference(self, parts)
+
+    def _pad_feed(self, arrays: Dict[str, np.ndarray], n: int):
         bucket = self.bucket_for(n)
         pad = bucket - n
         fed = {}
@@ -155,6 +206,30 @@ class InferenceEngine:
                 # (an all-zeros row can hit log/div landmines)
                 a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
             fed[name] = a
+        return fed, bucket
+
+    def _dispatch_padded(self, arrays: Dict[str, np.ndarray], n: int):
+        fed, bucket = self._pad_feed(arrays, n)
+        t0 = time.perf_counter()
+        with self._device_ctx(), \
+                trace.span("serving/dispatch_batch", bucket=bucket, rows=n):
+            handle = self.executor.run_async(self.program, feed=fed,
+                                             fetch_list=self.fetch_names,
+                                             scope=self.scope)
+        return handle, bucket, n, t0
+
+    def _resolve_padded(self, handle, bucket: int, n: int, t0: float):
+        with profiler.timer("serving/infer_batch"), \
+                trace.span("serving/resolve_batch", bucket=bucket, rows=n):
+            res = handle.result()
+        self.metrics.observe_latency(
+            time.perf_counter() - t0, name="batch_execute")
+        self.metrics.inc("batches_executed")
+        self.metrics.set_gauge("batch_occupancy", n / bucket)
+        return [np.asarray(r)[:n] for r in res]
+
+    def _run_padded(self, arrays: Dict[str, np.ndarray], n: int):
+        fed, bucket = self._pad_feed(arrays, n)
         t0 = time.perf_counter()
         with self._device_ctx(), profiler.timer("serving/infer_batch"), \
                 trace.span("serving/infer_batch", bucket=bucket, rows=n):
@@ -217,7 +292,10 @@ class InferenceEngine:
     def serve_step(self, batcher, idle_wait_s: Optional[float] = None) -> bool:
         """Pull one batch from the batcher and execute it. Request
         payloads are per-row feed dicts (no batch dim); rows with
-        identical shapes coalesce into one padded run. Returns True when
+        identical shapes coalesce into one padded run. Shape groups are
+        dispatched non-blocking (``run_async``) before any is resolved,
+        so consecutive buckets pipeline: group k+1's stacking/padding and
+        dispatch overlap group k's device execution. Returns True when
         work was done."""
         reqs = batcher.next_batch(wait_s=idle_wait_s)
         if not reqs:
@@ -235,21 +313,33 @@ class InferenceEngine:
                 continue
             sig = tuple((n, rows[n].shape) for n in self.feed_names)
             groups.setdefault(sig, []).append((req, rows))
+
+        def fail(members, t0, exc):
+            t1 = time.perf_counter()
+            for req, _ in members:
+                if req.span is not None:  # keep sampling decisions
+                    trace.record("serving/execute", t0, t1,
+                                 parent=req.span, batch=len(members),
+                                 error=repr(exc)[:200])
+                req.end_trace(status="error", error=repr(exc)[:200])
+                req.future.set_exception(exc)
+
+        dispatched = []
         for _, members in groups.items():
             feed = {n: np.stack([rows[n] for _, rows in members])
                     for n in self.feed_names}
             t0 = time.perf_counter()
             try:
-                fetched = self.run(feed)
+                pending = self.run_async(feed)
             except Exception as exc:  # engine failure fails the batch
-                t1 = time.perf_counter()
-                for req, _ in members:
-                    if req.span is not None:  # keep sampling decisions
-                        trace.record("serving/execute", t0, t1,
-                                     parent=req.span, batch=len(members),
-                                     error=repr(exc)[:200])
-                    req.end_trace(status="error", error=repr(exc)[:200])
-                    req.future.set_exception(exc)
+                fail(members, t0, exc)
+                continue
+            dispatched.append((members, t0, pending))
+        for members, t0, pending in dispatched:
+            try:
+                fetched = pending.result()
+            except Exception as exc:
+                fail(members, t0, exc)
                 continue
             t1 = time.perf_counter()
             now = time.monotonic()

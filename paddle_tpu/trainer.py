@@ -38,7 +38,8 @@ class SGD:
                  metrics: Optional[Dict[str, Variable]] = None,
                  scope: Optional[Scope] = None,
                  check_nan_inf: Optional[bool] = None,
-                 transpile: bool = False):
+                 transpile: bool = False,
+                 pad_to_multiple: Optional[int] = None):
         self.cost = cost
         self.metrics = dict(metrics or {})
         self.main_program: Program = cost.block.program
@@ -60,7 +61,9 @@ class SGD:
                                     scope=scope or global_scope())
             prune_pipeline().run(self.test_program, feeds, fetches)
         optimizer.minimize(cost, startup_program=self.startup_program)
-        self.feeder = DataFeeder(feed_list)
+        # pad_to_multiple: bucket ragged columns (data_feeder.py) so varlen
+        # training pads to a bounded set of compile signatures.
+        self.feeder = DataFeeder(feed_list, pad_to_multiple=pad_to_multiple)
         self.scope = scope or global_scope()
         self.exe = Executor(place or TPUPlace(0), check_nan_inf=check_nan_inf,
                             mesh=mesh, plan=plan)
@@ -86,7 +89,7 @@ class SGD:
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               test_reader: Optional[Callable] = None,
-              run_log=None):
+              run_log=None, async_depth: int = 1):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
@@ -98,9 +101,20 @@ class SGD:
         callable) receives every event IN ADDITION to ``event_handler``:
         per-iteration cost/metrics/examples-per-sec land in its JSONL
         journal and the global StatSet is dumped at EndPass — the
-        Trainer.cpp:449 stat dump, machine-readable."""
-        from . import trace
+        Trainer.cpp:449 stat dump, machine-readable.
 
+        ``async_depth`` > 1 pipelines the loop: batch stacking +
+        host->device transfer run on a background thread
+        (reader.device_prefetch machinery), each step is dispatched with
+        ``Executor.run_async`` while up to ``async_depth`` prior steps
+        are still in flight, and cost/metrics resolve with that lag —
+        ``EndIteration`` fires (in batch order) when a step's fetches
+        RESOLVE, with a full drain before ``EndPass``, so a
+        ``BeginIteration`` for step k+1 can precede step k's
+        ``EndIteration``. Numerics are unchanged: the same programs run
+        in the same order on the same device state (async-vs-sync parity
+        is pinned bitwise by tests/test_async_training.py). The default
+        ``async_depth=1`` is the fully synchronous reference loop."""
         user_handler = event_handler or _default_log_handler()
         if run_log is not None:
             def event_handler(e, _h=user_handler, _r=run_log):
@@ -111,30 +125,12 @@ class SGD:
         self._init_params()
         for pass_id in range(num_passes):
             event_handler(evt.BeginPass(pass_id))
-            pass_costs, pass_metrics = [], []
-            for batch_id, batch in enumerate(reader()):
-                event_handler(evt.BeginIteration(pass_id, batch_id))
-                # REGISTER_TIMER("TrainBatch") parity: the step timer
-                # accumulates in the global StatSet, which RunLog dumps
-                # (and print_all_status prints) at pass end
-                with trace.span("trainer/iteration", pass_id=pass_id,
-                                batch_id=batch_id) as sp, \
-                        profiler.timer("trainer/step"):
-                    feed = self.feeder.feed(batch)
-                    fetched = self.exe.run(self.main_program, feed=feed,
-                                           fetch_list=self._fetch_list(),
-                                           scope=self.scope)
-                    cost, mvals = self._split(fetched)
-                    if sp is not None:
-                        sp.set_attr("cost", cost)
-                pass_costs.append(cost)
-                pass_metrics.append(mvals)
-                try:
-                    bs = len(batch)
-                except TypeError:
-                    bs = None
-                event_handler(evt.EndIteration(pass_id, batch_id, cost,
-                                               mvals, batch_size=bs))
+            if async_depth > 1:
+                pass_costs, pass_metrics = self._run_pass_async(
+                    pass_id, reader, event_handler, int(async_depth))
+            else:
+                pass_costs, pass_metrics = self._run_pass_sync(
+                    pass_id, reader, event_handler)
             summary = _mean_metrics(pass_metrics)
             summary["cost"] = float(np.mean(pass_costs)) if pass_costs else 0.0
             if test_reader is not None:
@@ -143,6 +139,106 @@ class SGD:
                 event_handler(result)
             else:
                 event_handler(evt.EndPass(pass_id, metrics=summary))
+
+    def _run_pass_sync(self, pass_id, reader, event_handler):
+        from . import trace
+
+        pass_costs, pass_metrics = [], []
+        for batch_id, batch in enumerate(reader()):
+            event_handler(evt.BeginIteration(pass_id, batch_id))
+            # REGISTER_TIMER("TrainBatch") parity: the step timer
+            # accumulates in the global StatSet, which RunLog dumps
+            # (and print_all_status prints) at pass end
+            with trace.span("trainer/iteration", pass_id=pass_id,
+                            batch_id=batch_id) as sp, \
+                    profiler.timer("trainer/step"):
+                feed = self.feeder.feed(batch)
+                fetched = self.exe.run(self.main_program, feed=feed,
+                                       fetch_list=self._fetch_list(),
+                                       scope=self.scope)
+                cost, mvals = self._split(fetched)
+                if sp is not None:
+                    sp.set_attr("cost", cost)
+            pass_costs.append(cost)
+            pass_metrics.append(mvals)
+            try:
+                bs = len(batch)
+            except TypeError:
+                bs = None
+            event_handler(evt.EndIteration(pass_id, batch_id, cost,
+                                           mvals, batch_size=bs))
+        return pass_costs, pass_metrics
+
+    def _run_pass_async(self, pass_id, reader, event_handler, depth):
+        """The overlapped pipeline: a background feeder stage keeps
+        device-resident batches ready, the dispatch loop enqueues step
+        k+1 while step k executes (bounded at ``depth`` in flight), and
+        the oldest step resolves — one host sync — only when the window
+        is full. Iteration spans split into ``trainer/dispatch`` and
+        ``trainer/resolve`` phases carrying a ``queue_depth`` attr, so
+        tools/trace_summary.py --pipeline shows host gap vs device
+        time."""
+        from collections import deque
+
+        import jax
+
+        from . import trace
+        from .reader.decorator import background_stage
+
+        feeder = self.feeder
+        dev = None if self.exe.mesh is not None \
+            else self.exe.place.device()
+
+        def feed_source():
+            for batch in reader():
+                try:
+                    bs = len(batch)
+                except TypeError:
+                    bs = None
+                yield bs, feeder.feed(batch)
+
+        def to_device(item):
+            bs, feed = item
+            if dev is None:  # mesh runs: the executor shards feeds itself
+                return bs, feed
+            return bs, {k: (jax.device_put(v, dev)
+                            if not isinstance(v, jax.Array) else v)
+                        for k, v in feed.items()}
+
+        pending = deque()  # (batch_id, batch_size, RunHandle)
+        pass_costs, pass_metrics = [], []
+
+        def resolve_oldest():
+            batch_id, bs, handle = pending.popleft()
+            with trace.span("trainer/resolve", pass_id=pass_id,
+                            batch_id=batch_id,
+                            queue_depth=len(pending) + 1) as sp, \
+                    profiler.timer("trainer/resolve"):
+                cost, mvals = self._split(handle.result())
+                if sp is not None:
+                    sp.set_attr("cost", cost)
+            pass_costs.append(cost)
+            pass_metrics.append(mvals)
+            event_handler(evt.EndIteration(pass_id, batch_id, cost,
+                                           mvals, batch_size=bs))
+
+        stream = background_stage(feed_source, depth=depth,
+                                  transform=to_device)
+        for batch_id, (bs, feed) in enumerate(stream()):
+            event_handler(evt.BeginIteration(pass_id, batch_id))
+            with trace.span("trainer/dispatch", pass_id=pass_id,
+                            batch_id=batch_id,
+                            queue_depth=len(pending)), \
+                    profiler.timer("trainer/dispatch"):
+                handle = self.exe.run_async(self.main_program, feed=feed,
+                                            fetch_list=self._fetch_list(),
+                                            scope=self.scope)
+            pending.append((batch_id, bs, handle))
+            while len(pending) >= depth:
+                resolve_oldest()
+        while pending:  # drain: every EndIteration precedes EndPass
+            resolve_oldest()
+        return pass_costs, pass_metrics
 
     def test(self, reader: Callable) -> "evt.TestResult":
         self._init_params()

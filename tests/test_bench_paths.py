@@ -177,6 +177,24 @@ def test_trace_overhead_bench_path_runs():
     assert not trace.enabled()
 
 
+def test_train_pipeline_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    res = _bench().bench_train_pipeline(jax, pt, layers, batch=8, dim=16,
+                                        depth=3, steps=4, warmup=1,
+                                        rounds=1)
+    assert res["sync_ms_per_step"] > 0
+    assert res["async_ms_per_step"] > 0
+    assert res["device_ms_per_step"] > 0
+    assert res["async_depth"] == 3
+    # host gap is a subtraction; both signs are legal on a noisy CPU
+    # smoke run, but the keys must exist for the PERF.md record
+    assert "host_gap_sync_ms" in res and "host_gap_async_ms" in res
+
+
 def test_transpiler_bench_path_runs():
     import jax
 

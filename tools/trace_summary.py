@@ -84,6 +84,45 @@ def summarize_runlog(path):
     return "\n".join(lines) if lines else "(no passes)"
 
 
+def summarize_pipeline(events):
+    """Host-gap view of an async training trace: aggregates the
+    ``trainer/dispatch`` / ``trainer/resolve`` phase spans the
+    ``SGD.train(async_depth=N)`` loop emits (trainer/iteration for the
+    sync loop), plus dispatch-to-dispatch cadence and queue depth — how
+    much of each step the host spends NOT overlapped with the device."""
+    dispatch = sorted((e for e in events if e["name"] == "trainer/dispatch"),
+                      key=lambda e: e["ts"])
+    resolve = [e for e in events if e["name"] == "trainer/resolve"]
+    sync_iters = [e for e in events if e["name"] == "trainer/iteration"]
+    if not dispatch:
+        return ("(no trainer/dispatch spans — sync loop?"
+                + (f" {len(sync_iters)} trainer/iteration spans,"
+                   f" avg {sum(e['dur'] for e in sync_iters) / len(sync_iters) / 1e3:.3f} ms"
+                   if sync_iters else "")
+                + ")")
+
+    def avg_ms(evs):
+        return sum(e["dur"] for e in evs) / len(evs) / 1e3 if evs else 0.0
+
+    gaps = [b["ts"] - a["ts"] for a, b in zip(dispatch, dispatch[1:])]
+    depths = [e["args"].get("queue_depth") for e in dispatch
+              if e.get("args", {}).get("queue_depth") is not None]
+    lines = [
+        f"steps dispatched:        {len(dispatch)}",
+        f"avg dispatch ms:         {avg_ms(dispatch):.3f}"
+        "   (host work on the critical path)",
+        f"avg resolve ms:          {avg_ms(resolve):.3f}"
+        "   (blocking fetch; large = device-bound, overlapped)",
+    ]
+    if gaps:
+        lines.append(f"avg dispatch-to-dispatch:"
+                     f" {sum(gaps) / len(gaps) / 1e3:.3f} ms")
+    if depths:
+        lines.append(f"avg queue depth:         "
+                     f"{sum(depths) / len(depths):.2f}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="trace file (chrome JSON or JSONL)")
@@ -93,6 +132,8 @@ def main(argv=None):
                     help="only span names with this prefix")
     ap.add_argument("--runlog", action="store_true",
                     help="input is a trace.RunLog training journal")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="host-gap view of trainer dispatch/resolve spans")
     args = ap.parse_args(argv)
     if args.runlog:
         print(summarize_runlog(args.trace))
@@ -100,6 +141,9 @@ def main(argv=None):
     from paddle_tpu.trace import load_trace_events
 
     events = load_trace_events(args.trace)
+    if args.pipeline:
+        print(summarize_pipeline(events))
+        return 0
     rows = summarize(events, prefix=args.prefix)
     if args.top:
         rows = rows[:args.top]
