@@ -73,13 +73,35 @@ def _nonfinite_counts(value) -> Optional[Tuple[int, int]]:
     return (n_nan, n_inf) if n_nan or n_inf else None
 
 
+def _raise_nonfinite(name: str, n_nan: int, n_inf: int) -> None:
+    raise FloatingPointError(
+        f"variable {name!r} contains NaN/Inf "
+        f"({n_nan} NaN, {n_inf} Inf); re-run with trace_level=2 "
+        f"(or --trace_level=2) to locate the producing op")
+
+
 def _check_nan_inf(name: str, value) -> None:
     bad = _nonfinite_counts(value)
     if bad is not None:
-        raise FloatingPointError(
-            f"variable {name!r} contains NaN/Inf "
-            f"({bad[0]} NaN, {bad[1]} Inf); re-run with trace_level=2 "
-            f"(or --trace_level=2) to locate the producing op")
+        _raise_nonfinite(name, bad[0], bad[1])
+
+
+# On-device (n_nan, n_inf) reduction for the deferred check_nan_inf scan:
+# written-back state is donated to the NEXT run_async dispatch, so the
+# RunHandle must not hold the raw state arrays — it holds these two
+# scalars per state instead (cheap, not donated, safe to read any time).
+_nonfinite_count_kernel = jax.jit(
+    lambda a: jnp.stack([jnp.isnan(a).sum(), jnp.isinf(a).sum()]))
+
+
+def _device_nonfinite_counts(value):
+    """Dispatch the non-finite count for a device array without any host
+    sync; returns None for non-float values (nothing to check)."""
+    if isinstance(value, SelectedRows):
+        value = value.values
+    if not np.issubdtype(np.dtype(value.dtype), np.floating):
+        return None
+    return _nonfinite_count_kernel(value)
 
 
 def _value_stats(value) -> dict:
@@ -149,20 +171,23 @@ class RunHandle:
     """Deferred result of :meth:`Executor.run_async`.
 
     Holds the fetched values as device arrays (jax's async dispatch means
-    the computation may still be in flight) plus the updated-state arrays
-    for deferred ``check_nan_inf``. Nothing touches the host until
-    :meth:`result` / :meth:`numpy`; the scope write-back already happened
-    at dispatch time with device arrays, so consecutive dispatches chain
-    on-device without a host round-trip.
+    the computation may still be in flight) plus per-state non-finite
+    COUNT scalars for deferred ``check_nan_inf`` — never the written-back
+    state arrays themselves, which are donated to the next dispatch and
+    deleted on platforms that honor donation. Nothing touches the host
+    until :meth:`result` / :meth:`numpy`; the scope write-back already
+    happened at dispatch time with device arrays, so consecutive
+    dispatches chain on-device without a host round-trip.
     """
 
-    __slots__ = ("fetch_names", "_fetches", "_state_pairs", "_check",
+    __slots__ = ("fetch_names", "_fetches", "_state_checks", "_check",
                  "_dense")
 
-    def __init__(self, fetches, fetch_names, state_pairs=(), check_nan_inf=False):
+    def __init__(self, fetches, fetch_names, state_checks=(),
+                 check_nan_inf=False):
         self._fetches = list(fetches)
         self.fetch_names = list(fetch_names)
-        self._state_pairs = list(state_pairs)
+        self._state_checks = list(state_checks)
         self._check = check_nan_inf
         self._dense = None
 
@@ -180,17 +205,20 @@ class RunHandle:
 
     def result(self, return_numpy: bool = True):
         """Resolve the run: blocks on the device values, applies the
-        deferred ``check_nan_inf`` scan (fetches AND written-back state),
-        and returns the fetch list — numpy by default, device arrays with
+        deferred ``check_nan_inf`` scan (fetches AND written-back state,
+        the latter via the count scalars computed at dispatch), and
+        returns the fetch list — numpy by default, device arrays with
         ``return_numpy=False``."""
         if self._dense is None:
             if self._check:
-                for name, val in self._state_pairs:
-                    _check_nan_inf(name, val)
+                for name, counts in self._state_checks:
+                    c = np.asarray(counts)
+                    if c[0] or c[1]:
+                        _raise_nonfinite(name, int(c[0]), int(c[1]))
                 for name, val in zip(self.fetch_names, self._fetches):
                     _check_nan_inf(name, val)
             self._dense = [densify(v) for v in self._fetches]
-            self._state_pairs = []  # release refs to superseded state
+            self._state_checks = []
         if return_numpy:
             return [Executor._fetch_numpy(v) for v in self._dense]
         return list(self._dense)
@@ -357,10 +385,17 @@ class Executor:
             # the scope holds the in-flight device arrays directly.
             if new_rng is not None:
                 scope.set(RNG_VAR, new_rng)
-            pairs = list(zip(compiled.out_state_names, new_states))
-            for name, val in pairs:
+            checks = []
+            for name, val in zip(compiled.out_state_names, new_states):
                 scope.set(name, val)
-        return RunHandle(fetches, fetch_names, state_pairs=pairs,
+                if self.check_nan_inf:
+                    # count non-finites on device NOW, while the array is
+                    # still ours: a later dispatch donates it, so the
+                    # handle may only keep these scalars
+                    counts = _device_nonfinite_counts(val)
+                    if counts is not None:
+                        checks.append((name, counts))
+        return RunHandle(fetches, fetch_names, state_checks=checks,
                          check_nan_inf=self.check_nan_inf)
 
     def _call_compiled(self, compiled: "_Compiled", feed_vals,

@@ -13,6 +13,7 @@ import queue
 import random
 import subprocess
 import threading
+import time
 from typing import Any, Callable, Iterable, List
 
 __all__ = [
@@ -42,7 +43,9 @@ def background_stage(source, depth: int, transform: Callable = None):
     Leak-safe: an abandoned consumer (early ``break``, GC of the
     generator) closes the stage — a stop flag is set and the queue
     drained so a fill thread parked on a full queue always unblocks and
-    exits; source errors propagate to the consumer instead of silently
+    exits (one blocked inside ``source()`` itself is abandoned after a
+    short deadline — closing must never hang on a stalled source);
+    source errors propagate to the consumer instead of silently
     truncating the stream.
     """
 
@@ -75,8 +78,13 @@ def background_stage(source, depth: int, transform: Callable = None):
         finally:
             stop.set()
             # Unblock a fill() parked on a full queue: drain until the
-            # thread has observed the stop flag and exited.
-            while t.is_alive():
+            # thread has observed the stop flag and exited. Bounded: a
+            # fill thread blocked inside source() itself (stalled pipe /
+            # socket / slow reader) can't be interrupted from here — past
+            # the deadline, abandon it (it's a daemon thread) rather than
+            # hang the consumer's close/GC path.
+            deadline = time.monotonic() + 0.5
+            while t.is_alive() and time.monotonic() < deadline:
                 try:
                     while True:
                         q.get_nowait()

@@ -42,6 +42,7 @@ class PendingInference:
     def __init__(self, engine: "InferenceEngine", parts):
         self._engine = engine
         self._parts = parts  # [(RunHandle, bucket, rows, t0), ...]
+        self._part_outs: List = [None] * len(parts)
         self._result = None
 
     def done(self) -> bool:
@@ -49,10 +50,15 @@ class PendingInference:
 
     def result(self) -> List[np.ndarray]:
         """Block until every chunk completes; returns the fetch list
-        sliced back to the true batch."""
+        sliced back to the true batch. Each chunk resolves exactly once —
+        a retry after one chunk's failure re-resolves only the failed
+        chunks, so the batch metrics observe each chunk once."""
         if self._result is None:
-            outs = [self._engine._resolve_padded(h, bucket, n, t0)
-                    for h, bucket, n, t0 in self._parts]
+            for i, (h, bucket, n, t0) in enumerate(self._parts):
+                if self._part_outs[i] is None:
+                    self._part_outs[i] = self._engine._resolve_padded(
+                        h, bucket, n, t0)
+            outs = self._part_outs
             if len(outs) == 1:
                 self._result = outs[0]
             else:

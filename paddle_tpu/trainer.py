@@ -224,20 +224,39 @@ class SGD:
 
         stream = background_stage(feed_source, depth=depth,
                                   transform=to_device)
-        for batch_id, (bs, feed) in enumerate(stream()):
-            event_handler(evt.BeginIteration(pass_id, batch_id))
-            with trace.span("trainer/dispatch", pass_id=pass_id,
-                            batch_id=batch_id,
-                            queue_depth=len(pending)), \
-                    profiler.timer("trainer/dispatch"):
-                handle = self.exe.run_async(self.main_program, feed=feed,
-                                            fetch_list=self._fetch_list(),
-                                            scope=self.scope)
-            pending.append((batch_id, bs, handle))
-            while len(pending) >= depth:
+        try:
+            for batch_id, (bs, feed) in enumerate(stream()):
+                event_handler(evt.BeginIteration(pass_id, batch_id))
+                with trace.span("trainer/dispatch", pass_id=pass_id,
+                                batch_id=batch_id,
+                                queue_depth=len(pending)), \
+                        profiler.timer("trainer/dispatch"):
+                    handle = self.exe.run_async(self.main_program, feed=feed,
+                                                fetch_list=self._fetch_list(),
+                                                scope=self.scope)
+                pending.append((batch_id, bs, handle))
+                while len(pending) >= depth:
+                    resolve_oldest()
+            while pending:  # drain: every EndIteration precedes EndPass
                 resolve_oldest()
-        while pending:  # drain: every EndIteration precedes EndPass
-            resolve_oldest()
+        except BaseException:
+            # In-flight steps' state writes have already landed in the
+            # scope; drain their handles (costs/metrics + EndIteration
+            # per step) so the event stream stays consistent with the
+            # scope before propagating. If the drain itself keeps
+            # failing (e.g. the handler raises), at least block the
+            # remaining handles instead of abandoning them mid-flight.
+            while pending:
+                try:
+                    resolve_oldest()
+                except BaseException:
+                    for _, _, h in pending:
+                        try:
+                            h.block()
+                        except Exception:
+                            pass
+                    pending.clear()
+            raise
         return pass_costs, pass_metrics
 
     def test(self, reader: Callable) -> "evt.TestResult":

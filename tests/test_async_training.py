@@ -212,6 +212,116 @@ def test_run_async_defers_nan_check_to_resolve():
         raise AssertionError("deferred check_nan_inf did not fire")
 
 
+def _training_program(extra_feed=None):
+    """fc+softmax training block (donated rw state); returns (feeds, cost,
+    and an optional extra finite fetch independent of the x path)."""
+    x = layers.data("x", shape=[4])
+    y = layers.data("y", shape=[1], dtype="int64")
+    cost = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(x, size=3), y))
+    extra = None
+    if extra_feed:
+        extra = layers.mean(layers.data(extra_feed, shape=[4]))
+    pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(cost)
+    return cost, extra
+
+
+def test_check_nan_inf_with_overlapped_run_async():
+    """check_nan_inf=True + overlapping dispatches: the second dispatch
+    DONATES the state the first wrote back (deleted on platforms that
+    honor donation — CPU included on this jax), so the first handle's
+    deferred check must not touch those arrays when it resolves late."""
+    cost, _ = _training_program()
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), check_nan_inf=True)
+    exe.run(pt.default_startup_program(), scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(4, 4).astype("float32"),
+            "y": np.zeros((4, 1), dtype="int64")}
+    h1 = exe.run_async(pt.default_main_program(), feed=feed,
+                       fetch_list=[cost], scope=scope)
+    h2 = exe.run_async(pt.default_main_program(), feed=feed,
+                       fetch_list=[cost], scope=scope)
+    # oldest resolves AFTER a newer dispatch — the overlapped steady state
+    c1 = float(h1.result()[0])
+    c2 = float(h2.result()[0])
+    assert np.isfinite(c1) and np.isfinite(c2) and c2 < c1
+
+
+def test_check_nan_inf_overlapped_still_catches_nan_state():
+    """The deferred state scan must still FIRE after its arrays were
+    donated away: NaN feeds poison the param update (state) while the
+    fetch stays finite, and the late resolve reports the bad state."""
+    cost, finite_fetch = _training_program(extra_feed="clean")
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace(), check_nan_inf=True)
+    exe.run(pt.default_startup_program(), scope=scope)
+    feed = {"x": np.full((4, 4), np.nan, dtype="float32"),
+            "y": np.zeros((4, 1), dtype="int64"),
+            "clean": np.ones((4, 4), dtype="float32")}
+    h1 = exe.run_async(pt.default_main_program(), feed=feed,
+                       fetch_list=[finite_fetch], scope=scope)
+    h2 = exe.run_async(pt.default_main_program(), feed=feed,
+                       fetch_list=[finite_fetch], scope=scope)
+    try:
+        h1.result()
+    except FloatingPointError as exc:
+        assert "NaN" in str(exc)
+    else:
+        raise AssertionError("NaN in donated state escaped the deferred "
+                             "check")
+    del h2
+
+
+def test_train_async_with_check_nan_inf():
+    """End to end: SGD.train(async_depth>1) with the NaN check on — every
+    overlapped resolve runs the deferred scan against superseded state."""
+    _fresh_programs()
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    cost = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(x, size=3), y))
+    trainer = SGD(cost=cost,
+                  optimizer=pt.optimizer.SGDOptimizer(learning_rate=0.1),
+                  feed_list=[x, y], place=pt.CPUPlace(), scope=pt.Scope(),
+                  check_nan_inf=True)
+    events = []
+    trainer.train(reader_mod.batch(_toy_rows(), 8), num_passes=1,
+                  event_handler=events.append, async_depth=3)
+    ends = [e for e in events if isinstance(e, event.EndIteration)]
+    assert len(ends) == 6 and all(np.isfinite(e.cost) for e in ends)
+
+
+def test_async_exception_drains_pending_handles():
+    """A handler raising mid-pass must not abandon in-flight steps: their
+    state writes already landed in the scope, so their EndIterations are
+    delivered (drain) before the exception propagates."""
+    _fresh_programs()
+    trainer = _build_trainer()
+    events = []
+
+    class Boom(RuntimeError):
+        pass
+
+    def handler(e):
+        events.append(e)
+        if isinstance(e, event.EndIteration) and e.batch_id == 0:
+            raise Boom("handler failure")
+
+    try:
+        trainer.train(reader_mod.batch(_toy_rows(), 8), num_passes=1,
+                      event_handler=handler, async_depth=3)
+    except Boom:
+        pass
+    else:
+        raise AssertionError("handler exception was swallowed")
+    ends = [e.batch_id for e in events if isinstance(e, event.EndIteration)]
+    begins = [e.batch_id for e in events
+              if isinstance(e, event.BeginIteration)]
+    # every dispatched step resolved: no BeginIteration without its End
+    assert ends == begins == sorted(begins) and len(ends) >= 2
+
+
 def test_run_async_interpret_mode_resolved_handle():
     x, out = _square_program()
     scope = pt.Scope()
@@ -272,6 +382,25 @@ def test_device_prefetch_early_break_leaves_no_fill_thread():
     consume()
     gc.collect()  # the abandoned generator finalizes -> close path
     assert _wait_threads_back_to(before) == []
+
+
+def test_background_stage_close_bounded_when_source_blocks():
+    """Abandoning a stage whose SOURCE is stalled (pipe/socket that never
+    returns) must not hang the consumer's close/GC path: the drain wait
+    is bounded and the daemon fill thread is abandoned past it."""
+    release = threading.Event()
+
+    def stuck():
+        yield 0
+        release.wait()  # a read that never completes
+        yield 1
+
+    it = decorator.background_stage(stuck, depth=2)()
+    assert next(it) == 0
+    t0 = time.monotonic()
+    it.close()
+    assert time.monotonic() - t0 < 2.0
+    release.set()  # let the abandoned daemon thread exit
 
 
 def test_background_stage_propagates_source_error():
@@ -440,3 +569,36 @@ def test_engine_async_pipeline_observes_metrics():
     pending.result()  # idempotent
     after = eng.metrics.snapshot()["counters"]["batches_executed"]
     assert after == before + 1
+
+
+def test_engine_retry_after_chunk_failure_counts_each_chunk_once():
+    """If one chunk's resolve fails, a retry must re-resolve ONLY the
+    failed chunks — already-resolved ones are memoized, so the batch
+    metrics observe each chunk exactly once."""
+    eng = _toy_engine()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(6, 4).astype("float32")}  # chunks of 4 + 2
+    pending = eng.run_async(feed)
+    orig, calls = eng._resolve_padded, []
+
+    def flaky(h, bucket, n, t0):
+        calls.append(n)
+        if len(calls) == 2:
+            raise RuntimeError("transient resolve failure")
+        return orig(h, bucket, n, t0)
+
+    eng._resolve_padded = flaky
+    try:
+        try:
+            pending.result()
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError("injected failure did not propagate")
+    finally:
+        eng._resolve_padded = orig
+    mid = eng.metrics.snapshot()["counters"]["batches_executed"]
+    res = pending.result()  # retry: resolves only the failed chunk
+    after = eng.metrics.snapshot()["counters"]["batches_executed"]
+    assert after == mid + 1 == 2
+    np.testing.assert_array_equal(res[0], eng.run(feed)[0])
