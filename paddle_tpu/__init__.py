@@ -11,7 +11,8 @@ mapping onto the reference.
 """
 from . import (checkpoint, clip, evaluator, event, initializer, layers,
                learning_rate_decay, master, models, nets, optimizer, parallel,
-               profiler, regularizer, serving, trace, trainer, transpiler)
+               profiler, regularizer, resilience, serving, trace, trainer,
+               transpiler)
 from . import flags
 from .checkgrad import check_gradients
 from .core.enforce import (EnforceError, enforce, enforce_eq, enforce_ge,

@@ -29,7 +29,8 @@ import hashlib
 import json
 import os
 import time
-from typing import Optional
+import warnings
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -185,8 +186,7 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
                 continue
             if idx >= nproc:
                 os.remove(os.path.join(dirname, f))
-    meta = {
-        "latest": os.path.basename(payload),
+    step_meta = {
         "step": step,
         "md5": _md5(payload),
         "timestamp": time.time(),
@@ -194,6 +194,15 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
         "shard_values": sorted(shard_meta),
         "extra": extra or {},
     }
+    # Per-step meta sidecar (ckpt-N.json): the single META_NAME file only
+    # records the LATEST checkpoint's md5/extra, but torn-latest fallback
+    # (load_checkpoint/latest_step walking back to an older intact
+    # checkpoint) needs integrity + resume position for older steps too.
+    sj_tmp = payload[:-4] + f".json.tmp{os.getpid()}"
+    with open(sj_tmp, "w") as f:
+        json.dump(step_meta, f)
+    os.replace(sj_tmp, payload[:-4] + ".json")
+    meta = {"latest": os.path.basename(payload), **step_meta}
     meta_tmp = os.path.join(dirname, META_NAME + f".tmp{os.getpid()}")
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)
@@ -214,28 +223,68 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
             os.remove(os.path.join(dirname, old))
             base = old[:-4]
             for sf in os.listdir(dirname):
-                if sf.startswith(base + ".shard"):
+                if sf.startswith(base + ".shard") \
+                        or sf == base + ".json":
                     os.remove(os.path.join(dirname, sf))
     _sync_processes(nproc, f"ckpt-{step}")
     return payload
 
 
-def load_checkpoint(dirname: str, scope=None, verify: bool = True) -> dict:
-    """Restore the latest checkpoint into the scope. Returns the meta dict.
-    Raises FileNotFoundError if none exists; ValueError on md5 mismatch
-    (torn/corrupt file — the reference's ErrCheckpointNotFound path)."""
-    scope = scope or global_scope()
-    meta_path = os.path.join(dirname, META_NAME)
-    if not os.path.exists(meta_path):
-        raise FileNotFoundError(f"no checkpoint meta in {dirname}")
-    with open(meta_path) as f:
-        meta = json.load(f)
-    payload = os.path.join(dirname, meta["latest"])
-    if verify and _md5(payload) != meta["md5"]:
+class _Stage:
+    """Staging target for a restore: values land here first so a load
+    that fails mid-way never leaves the real scope half-written."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def commit(self, scope):
+        for name, value in self._vars.items():
+            scope.set(name, value)
+
+
+def _step_of(payload_name: str) -> int:
+    return int(payload_name[5:-4])  # "ckpt-<step>.npz"
+
+
+def _step_info(dirname: str, payload_name: str) -> Optional[dict]:
+    """The per-step meta sidecar (md5/extra/shard manifest), or None for
+    checkpoints written before sidecars existed."""
+    try:
+        with open(os.path.join(dirname, payload_name[:-4] + ".json")) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _candidates(dirname: str, meta: dict) -> List[str]:
+    """Payload names to try, newest-first: the meta's latest, then every
+    OLDER step (a leftover higher-step file from an abandoned longer run
+    is not a fallback target — meta deliberately points below it)."""
+    latest = meta["latest"]
+    latest_step_no = _step_of(latest)
+    older = sorted(
+        (p for p in os.listdir(dirname)
+         if p.startswith("ckpt-") and p.endswith(".npz")
+         and ".shard" not in p and p != latest
+         and _step_of(p) < latest_step_no),
+        key=_step_of, reverse=True)
+    return [latest] + older
+
+
+def _restore_payload(dirname: str, payload_name: str, scope,
+                     verify: bool, expect_md5: Optional[str],
+                     expect_files, expect_values) -> None:
+    """Verify + load one payload (and its shard sidecars) into ``scope``
+    (any object with ``set``). Raises on any integrity problem."""
+    payload = os.path.join(dirname, payload_name)
+    if verify and expect_md5 is not None and _md5(payload) != expect_md5:
         raise ValueError(f"checkpoint {payload} md5 mismatch (corrupt)")
-    _load_shard_sidecars(dirname, meta["latest"][:-4], scope,
-                         expect_files=meta.get("shard_files"),
-                         expect_values=meta.get("shard_values"))
+    _load_shard_sidecars(dirname, payload_name[:-4], scope,
+                         expect_files=expect_files,
+                         expect_values=expect_values)
     with np.load(payload) as data:
         dtypes = {}
         if "__dtypes__" in data.files:
@@ -255,7 +304,61 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True) -> dict:
                 scope.set(key, jax.numpy.asarray(arr))
             else:
                 scope.set(key, arr)
-    return meta
+
+
+def load_checkpoint(dirname: str, scope=None, verify: bool = True,
+                    strict: bool = False) -> dict:
+    """Restore the latest *intact* checkpoint into the scope; returns its
+    meta dict. Raises FileNotFoundError if none exists.
+
+    When the latest checkpoint is torn (md5 mismatch, unreadable npz,
+    missing shard sidecars), the default walks BACK to the newest older
+    intact ``ckpt-*.npz`` — warning, and recording ``fallback``/
+    ``fallback_from``/``fallback_errors`` in the returned meta — because
+    an auto-resuming job must survive the checkpoint that was being
+    written when it died. ``strict=True`` keeps the hard ValueError (the
+    reference's ErrCheckpointNotFound path). If NO intact checkpoint
+    remains, the latest's original error is raised either way. A restore
+    stages into a buffer first, so the scope is never left half-written.
+    """
+    scope = scope or global_scope()
+    meta_path = os.path.join(dirname, META_NAME)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint meta in {dirname}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    errors: List[Tuple[str, BaseException]] = []
+    for payload_name in _candidates(dirname, meta):
+        is_latest = payload_name == meta["latest"]
+        info = meta if is_latest else _step_info(dirname, payload_name)
+        stage = _Stage()
+        try:
+            _restore_payload(
+                dirname, payload_name, stage, verify,
+                expect_md5=(info or {}).get("md5"),
+                expect_files=(info or {}).get("shard_files"),
+                expect_values=(info or {}).get("shard_values"))
+        except Exception as exc:  # noqa: BLE001 - walk back per candidate
+            errors.append((payload_name, exc))
+            if strict:
+                raise
+            continue
+        stage.commit(scope)
+        if is_latest:
+            return meta
+        out = dict(info or {})
+        out.setdefault("step", _step_of(payload_name))
+        out.setdefault("extra", {})
+        out["latest"] = payload_name
+        out["fallback"] = True
+        out["fallback_from"] = meta["latest"]
+        out["fallback_errors"] = [f"{n}: {e}" for n, e in errors]
+        warnings.warn(
+            f"checkpoint {meta['latest']} in {dirname} is corrupt "
+            f"({errors[0][1]}); fell back to intact {payload_name} "
+            f"(step {out['step']})", RuntimeWarning, stacklevel=2)
+        return out
+    raise errors[0][1]
 
 
 def _load_shard_sidecars(dirname: str, base: str, scope,
@@ -306,10 +409,36 @@ def _load_shard_sidecars(dirname: str, base: str, scope,
         scope.set(name, arr)
 
 
-def latest_step(dirname: str) -> Optional[int]:
-    """The step of the latest checkpoint, or None."""
+def _looks_intact(dirname: str, payload_name: str,
+                  expect_md5: Optional[str]) -> bool:
+    """Cheap integrity probe: md5 when the per-step sidecar recorded one,
+    else an npz directory read (a truncated zip fails to open)."""
+    payload = os.path.join(dirname, payload_name)
+    try:
+        if expect_md5 is not None:
+            return _md5(payload) == expect_md5
+        with np.load(payload) as data:
+            list(data.files)
+        return True
+    except Exception:  # noqa: BLE001 - any failure means not intact
+        return False
+
+
+def latest_step(dirname: str, verify: bool = True) -> Optional[int]:
+    """The step of the latest INTACT checkpoint, or None. A torn latest
+    is skipped the same way ``load_checkpoint`` falls back; pass
+    ``verify=False`` for the raw meta value."""
     try:
         with open(os.path.join(dirname, META_NAME)) as f:
-            return json.load(f)["step"]
-    except (FileNotFoundError, KeyError, json.JSONDecodeError):
+            meta = json.load(f)
+        if not verify:
+            return meta["step"]
+        for payload_name in _candidates(dirname, meta):
+            is_latest = payload_name == meta["latest"]
+            info = meta if is_latest else _step_info(dirname, payload_name)
+            if _looks_intact(dirname, payload_name,
+                             (info or {}).get("md5")):
+                return meta["step"] if is_latest else _step_of(payload_name)
+        return None
+    except (FileNotFoundError, KeyError, json.JSONDecodeError, ValueError):
         return None

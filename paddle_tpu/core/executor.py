@@ -181,7 +181,7 @@ class RunHandle:
     """
 
     __slots__ = ("fetch_names", "_fetches", "_state_checks", "_check",
-                 "_dense")
+                 "_dense", "__weakref__")  # weakref: serving drain registry
 
     def __init__(self, fetches, fetch_names, state_checks=(),
                  check_nan_inf=False):

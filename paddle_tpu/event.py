@@ -18,9 +18,13 @@ class BeginPass:
 
 
 class EndPass(WithMetric):
-    def __init__(self, pass_id, metrics=None):
+    def __init__(self, pass_id, metrics=None, interrupted=False):
         super().__init__(metrics)
         self.pass_id = pass_id
+        # True when the pass was cut short by a graceful shutdown
+        # (SIGTERM/SIGINT or a fault-plan preemption): metrics cover only
+        # the completed iterations and a final checkpoint was written
+        self.interrupted = interrupted
 
 
 class BeginIteration:

@@ -200,6 +200,11 @@ define_int32("trace_level", 0,
              "per-op interpret-mode debug runs (Executor.run walks the "
              "block op-by-op, locating NaN/Inf producers). Runtime flips "
              "go through trace.enable(level)")
+define_string("fault_plan", "",
+              "deterministic chaos plan for manual resilience drills, "
+              "e.g. 'preempt@5,torn_checkpoint@3': kind@step entries "
+              "(resilience/faults.py FAULT_KINDS) injected once each "
+              "into the next SGD.train run; empty = no injection")
 define_float("trace_sample_rate", 1.0,
              "fraction of trace roots kept by the span tracer "
              "(deterministic counter-based sampling, no RNG)")

@@ -135,6 +135,19 @@ class Master:
 # ---------------------------------------------------------------------------
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
+        # register so stop() can sever live connections: a stopped master
+        # must actually be DEAD to its clients (daemon handler threads
+        # would otherwise keep serving the old engine after "restart")
+        with self.server.conn_lock:  # type: ignore[attr-defined]
+            self.server.active_conns.add(self.connection)  # type: ignore
+        try:
+            self._serve()
+        finally:
+            with self.server.conn_lock:  # type: ignore[attr-defined]
+                self.server.active_conns.discard(  # type: ignore
+                    self.connection)
+
+    def _serve(self):
         master: Master = self.server.master  # type: ignore[attr-defined]
         snapshot_path = self.server.snapshot_path  # type: ignore
         for line in self.rfile:
@@ -193,6 +206,13 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.flush()
 
 
+class _ReusableTCPServer(socketserver.ThreadingTCPServer):
+    # A restarted master must be able to rebind its old port immediately
+    # (TIME_WAIT sockets from the dead instance's clients linger) so
+    # reconnecting trainers find it at the same address.
+    allow_reuse_address = True
+
+
 class MasterServer:
     """Threaded TCP front-end. ``with MasterServer(...) as addr:`` or
     ``.start()``/``.stop()``."""
@@ -203,13 +223,15 @@ class MasterServer:
         self.master = Master(timeout_s, max_failures)
         if snapshot_path and os.path.exists(snapshot_path):
             self.master.recover(snapshot_path)  # master fault tolerance
-        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler)
+        self._srv = _ReusableTCPServer((host, port), _Handler)
         self._srv.daemon_threads = True
         self._srv.master = self.master  # type: ignore[attr-defined]
         self._srv.snapshot_path = snapshot_path  # type: ignore
         self._srv.snapshot_every = snapshot_every  # type: ignore
         self._srv.mutations_since_snapshot = 0  # type: ignore
         self._srv.snapshot_lock = threading.Lock()  # type: ignore
+        self._srv.active_conns = set()  # type: ignore
+        self._srv.conn_lock = threading.Lock()  # type: ignore
         self._snapshot_path = snapshot_path
         self._thread: Optional[threading.Thread] = None
 
@@ -226,6 +248,20 @@ class MasterServer:
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        # sever live client connections: their next RPC fails like a real
+        # master death, and a reconnect-retrying client finds the
+        # replacement instead of a ghost handler thread on the old engine
+        with self._srv.conn_lock:  # type: ignore[attr-defined]
+            for conn in list(self._srv.active_conns):  # type: ignore
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._srv.active_conns.clear()  # type: ignore[attr-defined]
         if self._snapshot_path:
             # daemon handler threads may still be mid-request: take the same
             # lock they use so the final flush cannot interleave with theirs
@@ -240,19 +276,90 @@ class MasterServer:
 
 
 class MasterClient:
-    """Trainer-side client (reference client.py API shape)."""
+    """Trainer-side client (reference client.py API shape), with the Go
+    client's reconnect-and-retry transport semantics: a dropped socket, a
+    refused connect (master restarting), or a torn response triggers an
+    exponential-backoff reconnect through a
+    :class:`paddle_tpu.resilience.Retry` policy instead of killing the
+    trainer. Safe because the protocol is effectively idempotent: a
+    re-sent ``task_finished``/``task_failed`` with its epoch is rejected
+    as stale, and a ``get_task`` whose response was lost just leaves a
+    claim to expire back into the queue (service.go timeout semantics).
+    Pass ``retry=False`` for the old fail-fast behavior, or your own
+    policy via ``retry=Retry(...)``.
+    """
 
-    def __init__(self, addr):
-        self._sock = socket.create_connection(addr)
+    def __init__(self, addr, retry=None):
+        self.addr = tuple(addr)
+        if retry is None:
+            from ..resilience import Retry
+
+            retry = Retry(max_attempts=8, backoff=0.05, multiplier=2.0,
+                          max_backoff=1.0, name="master/rpc")
+        self._retry = retry or None  # retry=False disables
+        self._sock = None
+        self._f = None
+        self._ncalls = 0
+        if self._retry is not None:
+            self._retry.call(self._connect)
+        else:
+            self._connect()
+
+    def _connect(self):
+        self._teardown()
+        self._sock = socket.create_connection(self.addr)
         self._f = self._sock.makefile("rwb")
 
-    def _call(self, **req):
-        self._f.write((json.dumps(req) + "\n").encode())
-        self._f.flush()
-        resp = json.loads(self._f.readline())
+    def _teardown(self):
+        for obj in (self._f, self._sock):
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+        self._f = self._sock = None
+
+    def _call_once(self, req, call_idx):
+        from ..resilience import faults
+
+        plan = faults.active_plan()
+        if plan is not None \
+                and plan.fire("master_drop", call_idx) is not None:
+            # injected connection drop: this attempt fails like a real
+            # mid-RPC disconnect; the retry policy (or the caller's next
+            # call) reconnects
+            self._teardown()
+            raise ConnectionError("master connection dropped (injected)")
+        if self._sock is None:
+            self._connect()
+        try:
+            self._f.write((json.dumps(req) + "\n").encode())
+            self._f.flush()
+            line = self._f.readline()
+        except OSError as exc:
+            self._teardown()
+            raise ConnectionError(f"master connection lost: {exc}") from exc
+        if not line:
+            self._teardown()
+            raise ConnectionError("master closed the connection")
+        try:
+            resp = json.loads(line)
+        except ValueError as exc:  # torn mid-line response
+            self._teardown()
+            raise ConnectionError(
+                f"torn response from master: {exc}") from exc
         if not resp.get("ok", False) and "error" in resp:
+            # an application-level error is NOT retryable: the request
+            # reached the engine and was rejected
             raise RuntimeError(f"master error: {resp['error']}")
         return resp
+
+    def _call(self, **req):
+        self._ncalls += 1
+        call_idx = self._ncalls
+        if self._retry is not None:
+            return self._retry.call(self._call_once, req, call_idx)
+        return self._call_once(req, call_idx)
 
     def set_dataset(self, tasks: Sequence[str]):
         self._call(op="set_dataset", tasks=list(tasks))
@@ -279,8 +386,7 @@ class MasterClient:
         return self._call(op="counts")
 
     def close(self):
-        self._f.close()
-        self._sock.close()
+        self._teardown()
 
     def task_reader(self, make_reader: Callable[[str], Iterable],
                     stop_after_pass: bool = True):
@@ -308,4 +414,8 @@ class MasterClient:
                     continue
                 self.task_finished(tid, epoch)
 
+        # resume contract (trainer.SGD checkpoint auto-resume): the
+        # master already tracks consumed tasks, so a resumed trainer must
+        # NOT also skip batches from this stream
+        reader.master_backed = True
         return reader

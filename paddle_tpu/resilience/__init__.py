@@ -1,0 +1,35 @@
+"""paddle_tpu.resilience — preemption-safe training.
+
+The layer between "a demo that trains" and "a job that survives the
+cloud": periodic + on-signal checkpointing with auto-resume
+(:class:`CheckpointConfig` / :class:`CheckpointManager`, driven by
+``SGD.train(checkpoint=...)``), graceful SIGTERM/SIGINT shutdown
+(:func:`graceful_shutdown`), a generic bounded-retry policy
+(:class:`Retry`, applied to the reconnecting ``MasterClient``), and a
+deterministic fault-injection plan (:class:`FaultPlan`) powering the
+crash-matrix tests and ``--fault_plan`` chaos runs.
+
+Quick start::
+
+    from paddle_tpu.resilience import CheckpointConfig
+    trainer.train(reader, num_passes=10,
+                  checkpoint=CheckpointConfig("/ckpt/run1",
+                                              every_n_steps=200))
+
+Interrupt it (SIGTERM, preemption, crash) and run the same script again:
+it resumes from the latest intact checkpoint — parameters, optimizer
+slots, RNG stream, and data position — to the bit-identical end state.
+"""
+from .faults import (FAULT_KINDS, FaultPlan, SimulatedCrash, TransientFault,
+                     active_plan, clear_plan, install_plan)
+from .manager import (CheckpointConfig, CheckpointManager, TrainResilience)
+from .retry import DEFAULT_RETRYABLE, Retry
+from .signals import ShutdownFlag, graceful_shutdown
+
+__all__ = [
+    "FAULT_KINDS", "FaultPlan", "SimulatedCrash", "TransientFault",
+    "active_plan", "clear_plan", "install_plan",
+    "CheckpointConfig", "CheckpointManager", "TrainResilience",
+    "DEFAULT_RETRYABLE", "Retry",
+    "ShutdownFlag", "graceful_shutdown",
+]

@@ -1,0 +1,101 @@
+"""Generic bounded-retry policy with exponential backoff.
+
+The reference's Go master client retries every RPC in a backoff loop
+(/root/reference/go/master/client.go — ``for { err := backoff... }``) and
+the pserver client reconnects through etcd re-discovery; :class:`Retry`
+is that loop as a reusable policy object, applied to
+:class:`paddle_tpu.master.MasterClient` (auto-reconnect + idempotent-op
+retry) and available to serving dispatch and the trainer's transient-step
+path.
+
+Every failed attempt is visible: a ``retry/attempt`` trace span (with the
+error and attempt index) and ``retry/attempts`` / ``retry/recovered`` /
+``retry/exhausted`` StatSet counters, so ``tools/trace_summary.py
+--resilience`` shows retry pressure at a glance.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .faults import TransientFault
+
+#: Errors worth retrying by default: transport failures and injected
+#: transients. Deliberately NOT OSError at large — a FileNotFoundError is
+#: not a flaky network.
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    ConnectionError, TimeoutError, TransientFault)
+
+
+class Retry:
+    """``Retry(...).call(fn)`` runs ``fn`` until it succeeds, a
+    non-retryable error escapes, attempts are exhausted, or the deadline
+    passes (whichever first; the last error is re-raised).
+
+    Also usable as a decorator: ``@Retry(max_attempts=3)``.
+    """
+
+    def __init__(self, max_attempts: int = 5, backoff: float = 0.05,
+                 multiplier: float = 2.0, max_backoff: float = 2.0,
+                 jitter: float = 0.0, deadline: Optional[float] = None,
+                 retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+                 name: str = "retry", sleep: Callable[[float], None] = time.sleep):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.backoff = float(backoff)
+        self.multiplier = float(multiplier)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self.retryable = tuple(retryable)
+        self.name = name
+        self._sleep = sleep
+
+    def call(self, fn: Callable, *args,
+             on_retry: Optional[Callable] = None, **kwargs):
+        from .. import profiler, trace
+
+        t_start = time.monotonic()
+        delay = self.backoff
+        for attempt in range(1, self.max_attempts + 1):
+            t0 = time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            except self.retryable as exc:
+                t1 = time.perf_counter()
+                trace.record("retry/attempt", t0, t1, policy=self.name,
+                             attempt=attempt, error=repr(exc)[:200])
+                profiler.global_stat.add_count("retry/attempts", 1)
+                out_of_time = (
+                    self.deadline is not None
+                    and time.monotonic() - t_start >= self.deadline)
+                if attempt >= self.max_attempts or out_of_time:
+                    profiler.global_stat.add_count("retry/exhausted", 1)
+                    raise
+                if on_retry is not None:
+                    on_retry(exc, attempt)
+                sleep_s = min(delay, self.max_backoff)
+                if self.jitter:
+                    sleep_s += random.uniform(0.0, self.jitter * sleep_s)
+                self._sleep(sleep_s)
+                delay *= self.multiplier
+                continue
+            if attempt > 1:
+                profiler.global_stat.add_count("retry/recovered", 1)
+            return out
+
+    def wrap(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        return wrapped
+
+    __call__ = wrap
+
+    def __repr__(self):
+        return (f"Retry({self.name!r}, max_attempts={self.max_attempts}, "
+                f"backoff={self.backoff}, deadline={self.deadline})")

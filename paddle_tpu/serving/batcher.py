@@ -276,12 +276,16 @@ class DynamicBatcher:
         with self._cond:
             return len(self._q)
 
-    def close(self) -> None:
-        """Stop admitting; fail everything still queued."""
+    def close(self, drain: bool = False) -> None:
+        """Stop admitting. Default fails everything still queued;
+        ``drain=True`` keeps queued requests so the dispatch loop can
+        finish them (the graceful-shutdown path — call again without
+        ``drain`` to fail whatever could not be drained in time)."""
         with self._cond:
             self._closed = True
-            pending = list(self._q)
-            self._q.clear()
+            pending = [] if drain else list(self._q)
+            if not drain:
+                self._q.clear()
             self._cond.notify_all()
         for req in pending:
             req.end_trace(status="closed")

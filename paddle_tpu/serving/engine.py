@@ -19,7 +19,9 @@ cache).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -27,7 +29,7 @@ import numpy as np
 from .. import profiler, trace
 from ..core.executor import Executor, TPUPlace
 from ..core.scope import Scope
-from .errors import BadRequestError
+from .errors import BadRequestError, EngineClosedError
 from .metrics import MetricsRegistry
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
@@ -129,6 +131,16 @@ class InferenceEngine:
         self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
         self.seq_buckets = (sorted(set(int(s) for s in seq_buckets))
                             if seq_buckets else None)
+        # graceful-drain state: admissions stop at close(). Synchronous
+        # runs in other threads are counted; async dispatches register
+        # their RunHandles so close(drain=True) can block on DEVICE
+        # completion (never on host-side result(), which only the caller
+        # may trigger — waiting for it here would deadlock the closer).
+        self._closed = False
+        self._released = False
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._outstanding: "weakref.WeakSet" = weakref.WeakSet()
 
     # ------------------------------------------------------------------
     def _device_ctx(self):
@@ -164,12 +176,24 @@ class InferenceEngine:
             raise BadRequestError("empty batch")
         return arrays, n
 
+    def _admit(self):
+        if self._closed:
+            raise EngineClosedError(
+                "engine is closed (draining or released); no new batches")
+
+    def _track(self, delta: int) -> None:
+        with self._inflight_cond:
+            self._inflight += delta
+            if delta < 0:
+                self._inflight_cond.notify_all()
+
     def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
         """Execute one user batch: pads the leading dim to the nearest
         bucket (chunking batches beyond the largest), runs the compiled
         program, and returns the fetches sliced back to the true batch.
         Assumes every feed and fetch carries the batch on axis 0 — the
         save_inference_model feed contract."""
+        self._admit()
         arrays, n = self._validated_arrays(feed)
         outs: List[List[np.ndarray]] = []
         start = 0
@@ -191,6 +215,7 @@ class InferenceEngine:
         bucket k+1's padding/stacking and dispatch overlap bucket k's
         device execution — and ``serve_step`` resolves in dispatch
         order."""
+        self._admit()
         arrays, n = self._validated_arrays(feed)
         parts = []
         start = 0
@@ -218,10 +243,12 @@ class InferenceEngine:
         fed, bucket = self._pad_feed(arrays, n)
         t0 = time.perf_counter()
         with self._device_ctx(), \
-                trace.span("serving/dispatch_batch", bucket=bucket, rows=n):
-            handle = self.executor.run_async(self.program, feed=fed,
-                                             fetch_list=self.fetch_names,
-                                             scope=self.scope)
+                trace.span("serving/dispatch_batch", bucket=bucket,
+                           rows=n):
+            handle = self.executor.run_async(
+                self.program, feed=fed, fetch_list=self.fetch_names,
+                scope=self.scope)
+        self._outstanding.add(handle)
         return handle, bucket, n, t0
 
     def _resolve_padded(self, handle, bucket: int, n: int, t0: float):
@@ -237,11 +264,17 @@ class InferenceEngine:
     def _run_padded(self, arrays: Dict[str, np.ndarray], n: int):
         fed, bucket = self._pad_feed(arrays, n)
         t0 = time.perf_counter()
-        with self._device_ctx(), profiler.timer("serving/infer_batch"), \
-                trace.span("serving/infer_batch", bucket=bucket, rows=n):
-            res = self.executor.run(self.program, feed=fed,
-                                    fetch_list=self.fetch_names,
-                                    scope=self.scope)
+        self._track(+1)
+        try:
+            with self._device_ctx(), \
+                    profiler.timer("serving/infer_batch"), \
+                    trace.span("serving/infer_batch", bucket=bucket,
+                               rows=n):
+                res = self.executor.run(self.program, feed=fed,
+                                        fetch_list=self.fetch_names,
+                                        scope=self.scope)
+        finally:
+            self._track(-1)
         self.metrics.observe_latency(
             time.perf_counter() - t0, name="batch_execute")
         self.metrics.inc("batches_executed")
@@ -293,6 +326,44 @@ class InferenceEngine:
         return self.executor.cache_stats()
 
     # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        """``ready`` | ``draining`` (closed, in-flight work finishing) |
+        ``closed`` — the /healthz vocabulary."""
+        if not self._closed:
+            return "ready"
+        return "closed" if self._released else "draining"
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 30.0) -> None:
+        """Graceful release: stop admissions (``run``/``run_async``/
+        ``serve_step`` raise :class:`EngineClosedError` from now on),
+        then — with ``drain`` — wait for every in-flight batch before
+        releasing the compile cache: synchronous runs on other threads
+        finish, and async dispatches complete ON DEVICE (their callers
+        can still ``result()`` afterwards — the fetched arrays outlive
+        the engine). Idempotent."""
+        with self._inflight_cond:
+            self._closed = True
+        if drain:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            with self._inflight_cond:
+                while self._inflight > 0:  # sync runs in other threads
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        break  # bounded wait
+                    self._inflight_cond.wait(remaining)
+            for handle in list(self._outstanding):
+                try:
+                    handle.block()  # device completion, no host fetch
+                except Exception:  # noqa: BLE001 - failed batch: done too
+                    pass
+        self._released = True
+        self.executor.close()
+
+    # ------------------------------------------------------------------
     # Server-driver interface
     # ------------------------------------------------------------------
     def serve_step(self, batcher, idle_wait_s: Optional[float] = None) -> bool:
@@ -303,6 +374,7 @@ class InferenceEngine:
         so consecutive buckets pipeline: group k+1's stacking/padding and
         dispatch overlap group k's device execution. Returns True when
         work was done."""
+        self._admit()
         reqs = batcher.next_batch(wait_s=idle_wait_s)
         if not reqs:
             return False

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
@@ -46,7 +47,8 @@ class Server:
                  batch_buckets: Sequence[int] = (1, 2, 4, 8),
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  default_timeout_ms: Optional[float] = None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 serve_retry=None):
         self.engines = list(engine) if isinstance(
             engine, (list, tuple)) else [engine]
         self.metrics = metrics or self.engines[0].metrics
@@ -56,27 +58,61 @@ class Server:
             metrics=self.metrics)
         if self.batcher.metrics is None:
             self.batcher.metrics = self.metrics
+        # Optional resilience.Retry applied around each serve_step: a
+        # transient dispatch failure (ConnectionError/TimeoutError/
+        # injected TransientFault) retries with backoff instead of
+        # failing the whole formed batch.
+        self._serve_retry = serve_retry
         self._thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._running = False
+        self._state = "ready"
+
+    @property
+    def state(self) -> str:
+        """``ready`` | ``draining`` | ``closed`` — what /healthz reports
+        (load balancers pull a draining replica out of rotation)."""
+        return self._state
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Server":
         if self._thread is not None:
             return self
         self._running = True
+        self._state = "ready"
         self._thread = threading.Thread(target=self._loop,
                                         name="paddle-tpu-serving",
                                         daemon=True)
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
+        """Stop the server. Default fails queued requests immediately;
+        ``drain=True`` first stops admissions (submit raises
+        EngineClosedError, /healthz flips to ``draining``/503), lets the
+        dispatch loop finish the backlog (bounded by ``timeout``), and
+        gracefully releases engines that support ``close``."""
+        if drain:
+            self._state = "draining"
+            self.batcher.close(drain=True)
+            deadline = time.monotonic() + timeout
+            while self.batcher.depth > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
         self._running = False
-        self.batcher.close()
+        self.batcher.close()  # fail whatever remains (no-op when drained)
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if drain:
+            # graceful shutdown releases the engines too; the default
+            # stop() leaves them usable (tests restart servers on them)
+            for eng in self.engines:
+                if hasattr(eng, "close"):
+                    try:
+                        eng.close(drain=True)
+                    except TypeError:  # engines with a plain close()
+                        eng.close()
+        self._state = "closed"
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
@@ -93,8 +129,13 @@ class Server:
             engine = self.engines[idx % len(self.engines)]
             idx += 1
             try:
-                did = engine.serve_step(self.batcher,
-                                        idle_wait_s=_IDLE_WAIT_S)
+                if self._serve_retry is not None:
+                    did = self._serve_retry.call(
+                        engine.serve_step, self.batcher,
+                        idle_wait_s=_IDLE_WAIT_S)
+                else:
+                    did = engine.serve_step(self.batcher,
+                                            idle_wait_s=_IDLE_WAIT_S)
             except Exception:
                 # engine errors fail their requests individually; a crash
                 # here would silently stop dispatch — keep looping
@@ -177,11 +218,17 @@ class Server:
                         self.wfile.write(body)
                         return
                     self._send(200, server.metrics_snapshot())
-                elif self.path == "/healthz":
-                    self._send(200, {
-                        "ok": True,
+                elif path == "/healthz":
+                    # ready -> 200; draining/closed -> 503 so load
+                    # balancers stop routing while in-flight work finishes
+                    state = server.state
+                    self._send(200 if state == "ready" else 503, {
+                        "ok": state == "ready",
+                        "state": state,
                         "queue": server.batcher.depth,
                         "engines": len(server.engines),
+                        "engine_states": [getattr(e, "state", "ready")
+                                          for e in server.engines],
                     })
                 else:
                     self._send(404, {"error": "not found"})

@@ -89,7 +89,8 @@ class SGD:
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               test_reader: Optional[Callable] = None,
-              run_log=None, async_depth: int = 1):
+              run_log=None, async_depth: int = 1,
+              checkpoint=None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
@@ -102,6 +103,19 @@ class SGD:
         per-iteration cost/metrics/examples-per-sec land in its JSONL
         journal and the global StatSet is dumped at EndPass — the
         Trainer.cpp:449 stat dump, machine-readable.
+
+        ``checkpoint`` (a :class:`paddle_tpu.resilience.CheckpointConfig`)
+        makes the run preemption-safe: the scope (params, optimizer
+        slots, RNG stream) plus the training position is checkpointed
+        every ``every_n_steps`` completed steps (serialized off the
+        critical path with ``background=True``), SIGTERM/SIGINT drains
+        in-flight work, writes a final checkpoint and exits after
+        ``EndPass(interrupted=True)``, and the next ``train`` call
+        auto-resumes from the latest intact checkpoint — skipping the
+        already-consumed batches of the interrupted pass (unless the
+        reader is master-backed, whose task queue already tracks
+        consumption) so the end state is bit-identical to an
+        uninterrupted run.
 
         ``async_depth`` > 1 pipelines the loop: batch stacking +
         host->device transfer run on a background thread
@@ -123,28 +137,70 @@ class SGD:
         else:
             event_handler = user_handler
         self._init_params()
-        for pass_id in range(num_passes):
-            event_handler(evt.BeginPass(pass_id))
-            if async_depth > 1:
-                pass_costs, pass_metrics = self._run_pass_async(
-                    pass_id, reader, event_handler, int(async_depth))
-            else:
-                pass_costs, pass_metrics = self._run_pass_sync(
-                    pass_id, reader, event_handler)
-            summary = _mean_metrics(pass_metrics)
-            summary["cost"] = float(np.mean(pass_costs)) if pass_costs else 0.0
-            if test_reader is not None:
-                result = self.test(test_reader)
-                event_handler(evt.EndPass(pass_id, metrics=summary))
-                event_handler(result)
-            else:
-                event_handler(evt.EndPass(pass_id, metrics=summary))
+        rs = None
+        from .flags import FLAGS
+        from .resilience import TrainResilience, faults
+        if (checkpoint is not None or FLAGS.fault_plan
+                or faults.active_plan() is not None):
+            rs = TrainResilience(checkpoint, scope=self.scope)
+            rs.resume()  # restores scope + position from the latest ckpt
+        import contextlib
 
-    def _run_pass_sync(self, pass_id, reader, event_handler):
+        ctx = rs.signal_context() if rs is not None \
+            else contextlib.nullcontext()
+        try:
+            self._train_passes(ctx, rs, reader, num_passes, event_handler,
+                               test_reader, async_depth)
+        except BaseException:
+            if rs is not None:
+                # join (never mask) an in-flight background save so no
+                # thread keeps mutating the ckpt dir after the crash
+                rs.abort()
+            raise
+        if rs is not None:
+            rs.finalize()
+
+    def _train_passes(self, ctx, rs, reader, num_passes, event_handler,
+                      test_reader, async_depth):
+        with ctx:
+            for pass_id in range(rs.start_pass if rs else 0, num_passes):
+                event_handler(evt.BeginPass(pass_id))
+                skip_n = rs.skip_for_pass(pass_id, reader) if rs else 0
+                if async_depth > 1:
+                    pass_costs, pass_metrics = self._run_pass_async(
+                        pass_id, reader, event_handler, int(async_depth),
+                        rs=rs, skip_n=skip_n)
+                else:
+                    pass_costs, pass_metrics = self._run_pass_sync(
+                        pass_id, reader, event_handler, rs=rs,
+                        skip_n=skip_n)
+                summary = _mean_metrics(pass_metrics)
+                summary["cost"] = float(np.mean(pass_costs)) \
+                    if pass_costs else 0.0
+                if rs is not None and rs.interrupted:
+                    # graceful preemption: the final checkpoint is
+                    # already on disk (commit with wait=True); no test
+                    # pass on the way out
+                    event_handler(evt.EndPass(pass_id, metrics=summary,
+                                              interrupted=True))
+                    break
+                if test_reader is not None:
+                    result = self.test(test_reader)
+                    event_handler(evt.EndPass(pass_id, metrics=summary))
+                    event_handler(result)
+                else:
+                    event_handler(evt.EndPass(pass_id, metrics=summary))
+
+    def _run_pass_sync(self, pass_id, reader, event_handler, rs=None,
+                       skip_n=0):
         from . import trace
 
         pass_costs, pass_metrics = [], []
         for batch_id, batch in enumerate(reader()):
+            if batch_id < skip_n:
+                continue  # consumed before the interrupt (resume replay)
+            if rs is not None:
+                rs.before_step()
             event_handler(evt.BeginIteration(pass_id, batch_id))
             # REGISTER_TIMER("TrainBatch") parity: the step timer
             # accumulates in the global StatSet, which RunLog dumps
@@ -167,9 +223,12 @@ class SGD:
                 bs = None
             event_handler(evt.EndIteration(pass_id, batch_id, cost,
                                            mvals, batch_size=bs))
+            if rs is not None and rs.after_step(pass_id, batch_id, bs):
+                break  # graceful interrupt: checkpoint already written
         return pass_costs, pass_metrics
 
-    def _run_pass_async(self, pass_id, reader, event_handler, depth):
+    def _run_pass_async(self, pass_id, reader, event_handler, depth,
+                        rs=None, skip_n=0):
         """The overlapped pipeline: a background feeder stage keeps
         device-resident batches ready, the dispatch loop enqueues step
         k+1 while step k executes (bounded at ``depth`` in flight), and
@@ -190,20 +249,23 @@ class SGD:
             else self.exe.place.device()
 
         def feed_source():
-            for batch in reader():
+            for batch_id, batch in enumerate(reader()):
+                if batch_id < skip_n:
+                    continue  # consumed before the interrupt (resume)
                 try:
                     bs = len(batch)
                 except TypeError:
                     bs = None
-                yield bs, feeder.feed(batch)
+                yield batch_id, bs, feeder.feed(batch)
 
         def to_device(item):
-            bs, feed = item
+            batch_id, bs, feed = item
             if dev is None:  # mesh runs: the executor shards feeds itself
-                return bs, feed
-            return bs, {k: (jax.device_put(v, dev)
-                            if not isinstance(v, jax.Array) else v)
-                        for k, v in feed.items()}
+                return batch_id, bs, feed
+            return batch_id, bs, {k: (jax.device_put(v, dev)
+                                      if not isinstance(v, jax.Array)
+                                      else v)
+                                  for k, v in feed.items()}
 
         pending = deque()  # (batch_id, batch_size, RunHandle)
         pass_costs, pass_metrics = [], []
@@ -221,11 +283,19 @@ class SGD:
             pass_metrics.append(mvals)
             event_handler(evt.EndIteration(pass_id, batch_id, cost,
                                            mvals, batch_size=bs))
+            if rs is not None:
+                # defer: a snapshot here would race the in-flight window
+                # (donated state) — the dispatch loop drains, then
+                # commits at the safe point
+                rs.after_step(pass_id, batch_id, bs, defer=True)
 
         stream = background_stage(feed_source, depth=depth,
                                   transform=to_device)
+        stopped = False
         try:
-            for batch_id, (bs, feed) in enumerate(stream()):
+            for batch_id, bs, feed in stream():
+                if rs is not None:
+                    rs.before_step()
                 event_handler(evt.BeginIteration(pass_id, batch_id))
                 with trace.span("trainer/dispatch", pass_id=pass_id,
                                 batch_id=batch_id,
@@ -237,8 +307,18 @@ class SGD:
                 pending.append((batch_id, bs, handle))
                 while len(pending) >= depth:
                     resolve_oldest()
+                if rs is not None and rs.pause_requested:
+                    # checkpoint due / shutdown: drain the whole window so
+                    # resolved == dispatched == scope state, then save
+                    while pending:
+                        resolve_oldest()
+                    if rs.commit(pass_id):
+                        stopped = True
+                        break
             while pending:  # drain: every EndIteration precedes EndPass
                 resolve_oldest()
+            if not stopped and rs is not None and rs.pause_requested:
+                rs.commit(pass_id)
         except BaseException:
             # In-flight steps' state writes have already landed in the
             # scope; drain their handles (costs/metrics + EndIteration

@@ -48,6 +48,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: real-chip tier (runs in a child process owning "
         "the TPU; skips when no chip is reachable)")
+    config.addinivalue_line(
+        "markers", "slow: long-running sweeps excluded from tier-1 "
+        "(crash matrix, chaos drills); run with -m slow")
 
 
 # ---------------------------------------------------------------------------

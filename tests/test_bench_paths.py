@@ -206,3 +206,21 @@ def test_transpiler_bench_path_runs():
     assert res["transpiled_ops"] < res["raw_ops"]
     assert res["transpiled_ms_per_batch"] > 0
     assert res["pass_stats"], "per-pass stats must be recorded"
+
+
+def test_checkpoint_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    res = _bench().bench_checkpoint(jax, pt, layers, batch=8, dim=32,
+                                    steps=6, every=2, rounds=1)
+    assert res["base_ms_per_step"] > 0
+    assert res["sync_ms_per_step"] > 0
+    assert res["background_ms_per_step"] > 0
+    assert res["ckpt_bytes"] > 0
+    # the stall plane (the resilience acceptance metric) must exist, and
+    # background stall can never exceed the full synchronous save path
+    # by more than noise on a 1-core smoke box
+    assert "background_stall_pct" in res and "sync_stall_pct" in res

@@ -1,0 +1,541 @@
+"""paddle_tpu.resilience: preemption-safe training.
+
+The contract under test is the reference's whole fault-tolerance story
+(master re-queues tasks from dead trainers, pserver checkpoints make a
+restarted job RESUME — doc/design/cluster_train/checkpointing.md) carried
+onto the TPU port: kill-and-resume must reach the bit-identical end state
+of an uninterrupted run (dropout RNG included), a torn latest checkpoint
+must fall back to an older intact one automatically, and a master restart
+mid-pass must lose no task and double-count none (reconnecting client).
+All chaos is driven by the deterministic FaultPlan so every scenario is
+reproducible."""
+import os
+import shutil
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import event, layers
+from paddle_tpu.checkpoint import latest_step, load_checkpoint, \
+    save_checkpoint
+from paddle_tpu.resilience import (CheckpointConfig, FaultPlan, Retry,
+                                   ShutdownFlag, SimulatedCrash,
+                                   TransientFault, graceful_shutdown)
+from paddle_tpu.trainer import SGD
+
+def _quiet(e):
+    pass
+
+
+N_BATCHES = 8
+
+
+def _batches():
+    rng = np.random.RandomState(0)
+    return [[(rng.rand(6).astype("float32"),
+              rng.randint(0, 3, size=(1,)).astype("int64"))
+             for _ in range(8)] for _ in range(N_BATCHES)]
+
+
+BATCHES = _batches()
+
+
+def _reader():
+    return iter(BATCHES)
+
+
+def _build():
+    """Fresh programs with a FIXED name space: a restarted process
+    rebuilds the same program from scratch, so its unique-name counter
+    starts from zero — mirrored here by resetting the class counter."""
+    import paddle_tpu.core.program as prog_mod
+
+    prog_mod._main_program = pt.Program()
+    prog_mod._startup_program = pt.Program()
+    pt.Program._uid_counter = 0
+    x = layers.data("x", shape=[6])
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=12, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)  # RNG must survive resume
+    logits = layers.fc(h, size=3)
+    cost = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    pt.default_main_program().random_seed = 7
+    pt.default_startup_program().random_seed = 7
+    return SGD(cost=cost,
+               optimizer=pt.optimizer.AdamOptimizer(learning_rate=0.01),
+               feed_list=[x, y], place=pt.CPUPlace(), scope=pt.Scope())
+
+
+def _final_state(trainer):
+    return {k: np.asarray(trainer.scope.get(k)).copy()
+            for k in trainer.scope.keys()}
+
+
+def _assert_bitwise_equal(ref, got):
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+@pytest.fixture(scope="module")
+def uninterrupted_state():
+    """Final scope of a clean 1-pass run — the parity oracle."""
+    t = _build()
+    t.train(_reader, num_passes=1, event_handler=_quiet)
+    return _final_state(t)
+
+
+class TestKillAndResumeParity:
+    """ISSUE acceptance: interrupt at an arbitrary step, resume, final
+    params bitwise-equal to the uninterrupted run."""
+
+    @pytest.mark.parametrize("depth", [1, 3], ids=["sync", "async_depth3"])
+    def test_crash_resume_bitwise(self, tmp_path, uninterrupted_state,
+                                  depth):
+        d = str(tmp_path / "ck")
+        t1 = _build()
+        cfg = CheckpointConfig(d, every_n_steps=3, background=True,
+                               install_signal_handlers=False)
+        with FaultPlan().at(step=6, kind="crash").active():
+            with pytest.raises(SimulatedCrash):
+                t1.train(_reader, num_passes=1, event_handler=_quiet,
+                         async_depth=depth, checkpoint=cfg)
+        assert latest_step(d) is not None  # periodic ckpt survived
+
+        t2 = _build()
+        events = []
+        t2.train(_reader, num_passes=1, event_handler=events.append,
+                 async_depth=depth,
+                 checkpoint=CheckpointConfig(
+                     d, every_n_steps=3, install_signal_handlers=False))
+        _assert_bitwise_equal(uninterrupted_state, _final_state(t2))
+        # the resumed run replayed only the un-checkpointed tail
+        iters = [e.batch_id for e in events
+                 if isinstance(e, event.EndIteration)]
+        assert iters and iters[0] > 0 and iters[-1] == N_BATCHES - 1
+
+    def test_preempt_graceful_then_resume(self, tmp_path,
+                                          uninterrupted_state):
+        d = str(tmp_path / "ck")
+        t1 = _build()
+        events = []
+        with FaultPlan().at(step=5, kind="preempt").active():
+            t1.train(_reader, num_passes=1, event_handler=events.append,
+                     checkpoint=CheckpointConfig(
+                         d, every_n_steps=100,  # interrupt save only
+                         install_signal_handlers=False))
+        ends = [e for e in events if isinstance(e, event.EndPass)]
+        assert len(ends) == 1 and ends[0].interrupted
+        assert len([e for e in events
+                    if isinstance(e, event.EndIteration)]) == 5
+        meta = load_checkpoint(d, scope=pt.Scope())
+        assert meta["step"] == 5
+        assert meta["extra"]["reason"] == "interrupt"
+        assert meta["extra"]["samples_seen"] == 5 * 8
+
+        t2 = _build()
+        t2.train(_reader, num_passes=1, event_handler=_quiet,
+                 checkpoint=CheckpointConfig(
+                     d, every_n_steps=100, install_signal_handlers=False))
+        _assert_bitwise_equal(uninterrupted_state, _final_state(t2))
+
+    def test_sigterm_graceful(self, tmp_path):
+        """A real SIGTERM mid-training drains, checkpoints, and exits
+        with EndPass(interrupted=True) — no exception escapes."""
+        d = str(tmp_path / "ck")
+        events = []
+
+        def handler(e):
+            events.append(e)
+            if isinstance(e, event.EndIteration) and e.batch_id == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        t = _build()
+        t.train(_reader, num_passes=1, event_handler=handler,
+                checkpoint=CheckpointConfig(d, every_n_steps=100))
+        ends = [e for e in events if isinstance(e, event.EndPass)]
+        assert len(ends) == 1 and ends[0].interrupted
+        assert load_checkpoint(d, scope=pt.Scope())["step"] == 3
+
+    def test_resume_skips_finished_run(self, tmp_path):
+        """Resuming a COMPLETED run trains zero further steps and leaves
+        the scope exactly at the final checkpoint."""
+        d = str(tmp_path / "ck")
+        cfg = CheckpointConfig(d, every_n_steps=0,
+                               install_signal_handlers=False)
+        t1 = _build()
+        t1.train(_reader, num_passes=1, event_handler=_quiet,
+                 checkpoint=cfg)
+        ref = _final_state(t1)
+        t2 = _build()
+        events = []
+        t2.train(_reader, num_passes=1, event_handler=events.append,
+                 checkpoint=cfg)
+        assert not [e for e in events if isinstance(e, event.EndIteration)]
+        _assert_bitwise_equal(ref, _final_state(t2))
+
+
+class TestTornCheckpointFallback:
+    def test_corrupt_latest_falls_back_and_warns(self, tmp_path):
+        d = str(tmp_path / "ck")
+        s = pt.Scope()
+        s.set("w", np.arange(4, dtype=np.float32))
+        save_checkpoint(d, scope=s, step=2,
+                        extra={"pass_id": 0, "iteration": 1})
+        s.set("w", np.arange(4, dtype=np.float32) + 100)
+        payload = save_checkpoint(d, scope=s, step=4,
+                                  extra={"pass_id": 0, "iteration": 3})
+        with open(payload, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xff\xff")
+        s2 = pt.Scope()
+        with pytest.warns(RuntimeWarning, match="fell back"):
+            meta = load_checkpoint(d, scope=s2)
+        assert meta["step"] == 2 and meta["fallback"]
+        assert meta["fallback_from"] == "ckpt-4.npz"
+        assert meta["extra"]["iteration"] == 1  # older step's position
+        np.testing.assert_array_equal(np.asarray(s2.get("w")),
+                                      [0, 1, 2, 3])
+        # latest_step skips the torn file the same way
+        assert latest_step(d) == 2
+        # strict keeps today's hard failure
+        with pytest.raises(ValueError, match="md5 mismatch"):
+            load_checkpoint(d, scope=pt.Scope(), strict=True)
+
+    def test_no_intact_checkpoint_still_raises(self, tmp_path):
+        d = str(tmp_path / "ck")
+        s = pt.Scope()
+        s.set("w", np.ones(4, np.float32))
+        payload = save_checkpoint(d, scope=s, step=1)
+        with open(payload, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xff\xff")
+        with pytest.raises(ValueError, match="md5 mismatch"):
+            load_checkpoint(d, scope=pt.Scope())
+        assert latest_step(d) is None
+
+    def test_torn_write_fault_then_fallback_resume(self, tmp_path,
+                                                   uninterrupted_state):
+        """E2E: the checkpoint being written when the job dies is torn;
+        auto-resume walks back to the previous intact one and still
+        reaches the bit-identical end state."""
+        d = str(tmp_path / "ck")
+        t1 = _build()
+        plan = (FaultPlan().at(step=6, kind="torn_checkpoint")
+                .at(step=7, kind="crash"))
+        with plan.active():
+            with pytest.raises(SimulatedCrash):
+                t1.train(_reader, num_passes=1, event_handler=_quiet,
+                         checkpoint=CheckpointConfig(
+                             d, every_n_steps=3,
+                             install_signal_handlers=False))
+        assert plan.pending() == []  # both faults actually fired
+        assert latest_step(d) == 3  # 6 is torn, 3 intact
+
+        t2 = _build()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            t2.train(_reader, num_passes=1, event_handler=_quiet,
+                     checkpoint=CheckpointConfig(
+                         d, every_n_steps=3,
+                         install_signal_handlers=False))
+        _assert_bitwise_equal(uninterrupted_state, _final_state(t2))
+
+
+class TestRetryPolicy:
+    def test_recovers_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+            return 42
+
+        assert Retry(max_attempts=5, backoff=0.001).call(flaky) == 42
+        assert len(calls) == 3
+
+    def test_exhaustion_reraises_last_error(self):
+        with pytest.raises(ConnectionError, match="always"):
+            Retry(max_attempts=3, backoff=0.001).call(
+                lambda: (_ for _ in ()).throw(ConnectionError("always")))
+
+    def test_non_retryable_escapes_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise KeyError("not transport")
+
+        with pytest.raises(KeyError):
+            Retry(max_attempts=5, backoff=0.001).call(bad)
+        assert len(calls) == 1
+
+    def test_transient_fault_is_retryable_and_decorates(self):
+        state = {"n": 0}
+
+        @Retry(max_attempts=2, backoff=0.001)
+        def step():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise TransientFault("injected")
+            return "ok"
+
+        assert step() == "ok" and state["n"] == 2
+
+    def test_executor_error_fault_retried_in_training(self, tmp_path,
+                                                      uninterrupted_state):
+        """A transient executor error at step 4 is absorbed by the step
+        retry: training completes and the step still runs exactly once
+        (bitwise parity)."""
+        t = _build()
+        with FaultPlan().at(step=4, kind="executor_error").active() as plan:
+            t.train(_reader, num_passes=1, event_handler=_quiet)
+            assert ("executor_error", 4) in plan.fired_log
+        _assert_bitwise_equal(uninterrupted_state, _final_state(t))
+
+
+class TestSignals:
+    def test_graceful_shutdown_restores_handlers(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with graceful_shutdown() as flag:
+            assert not flag.is_set()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert flag.is_set() and flag.reason == "SIGTERM"
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_flag_latches_first_reason(self):
+        f = ShutdownFlag()
+        f.set("preempt")
+        f.set("second")
+        assert f.reason == "preempt"
+
+
+class TestServingDrain:
+    def _engine(self):
+        from paddle_tpu.serving import InferenceEngine
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            out = layers.fc(x, size=2)
+        scope = pt.Scope()
+        pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+        return InferenceEngine(program=main, feed_names=["x"],
+                               fetch_names=[out.name], scope=scope,
+                               batch_buckets=(2, 4), place=pt.CPUPlace())
+
+    def test_close_drains_inflight_then_rejects(self):
+        from paddle_tpu.serving import EngineClosedError
+
+        eng = self._engine()
+        assert eng.state == "ready"
+        pending = eng.run_async({"x": np.ones((3, 4), np.float32)})
+        eng.close(drain=True)
+        assert eng.state == "closed"
+        # the in-flight dispatch still resolves post-close
+        outs = pending.result()
+        assert outs[0].shape == (3, 2)
+        with pytest.raises(EngineClosedError):
+            eng.run({"x": np.ones((1, 4), np.float32)})
+        with pytest.raises(EngineClosedError):
+            eng.run_async({"x": np.ones((1, 4), np.float32)})
+
+    def test_server_drain_finishes_backlog_and_healthz_state(self):
+        import json
+        import urllib.request
+
+        from paddle_tpu.serving import EngineClosedError, Server
+
+        eng = self._engine()
+        srv = Server(eng, batch_buckets=(2, 4), max_wait_ms=1.0)
+        port = srv.serve_http()
+        with srv:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+            assert body["state"] == "ready" and body["ok"]
+            futs = [srv.submit({"x": np.ones(4, np.float32)})
+                    for _ in range(4)]
+            srv.stop(drain=True)
+            assert srv.state == "closed"
+            for f in futs:  # the backlog was finished, not failed
+                assert np.asarray(f.result(timeout=5)[0]).shape == (2,)
+            with pytest.raises(EngineClosedError):
+                srv.submit({"x": np.ones(4, np.float32)})
+
+    def test_healthz_503_while_draining(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        eng = self._engine()
+        from paddle_tpu.serving import Server
+
+        srv = Server(eng)
+        port = srv.serve_http()
+        srv.start()
+        try:
+            srv._state = "draining"  # the window stop(drain=True) opens
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["state"] == "draining"
+        finally:
+            srv._state = "ready"
+            srv.stop()
+
+
+class TestMasterResilience:
+    """Needs the C++ master engine."""
+
+    pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                    reason="no C++ toolchain")
+
+    def test_master_restart_mid_pass_with_reconnecting_client(
+            self, tmp_path):
+        """Kill the master halfway through a pass and restart it on the
+        SAME port from its auto-snapshot: the same client object rides
+        its retry policy across the outage, no task is lost, none is
+        double-served. Fails without both the reconnect-retry transport
+        and the snapshot recovery."""
+        from paddle_tpu.master import NO_TASK, PASS_DONE, MasterClient, \
+            MasterServer
+
+        snap = str(tmp_path / "master.snap")
+        n_tasks = 10
+        srv = MasterServer(timeout_s=60, snapshot_path=snap,
+                           snapshot_every=1)
+        host, port = srv.start()
+        c = MasterClient((host, port),
+                         retry=Retry(max_attempts=10, backoff=0.05,
+                                     max_backoff=0.2, name="master/rpc"))
+        c.set_dataset([f"t-{i}" for i in range(n_tasks)])
+        first_half = set()
+        for _ in range(n_tasks // 2):
+            tid, _desc, epoch = c.get_task()
+            assert c.task_finished(tid, epoch)
+            first_half.add(tid)
+        srv.stop()  # master dies (snapshot_every=1: state persisted)
+
+        srv2 = MasterServer(timeout_s=60, snapshot_path=snap, host=host,
+                            port=port)
+        srv2.start()
+        try:
+            second_half = set()
+            while True:
+                t = c.get_task()  # reconnects through the retry policy
+                if t == PASS_DONE:
+                    break
+                if t == NO_TASK:
+                    continue
+                tid, _desc, epoch = t
+                assert tid not in first_half  # no double-serve
+                assert c.task_finished(tid, epoch)
+                second_half.add(tid)
+            assert first_half | second_half == set(range(n_tasks))
+            assert len(first_half & second_half) == 0
+            counts = c.counts()
+            assert counts["done"] == n_tasks
+        finally:
+            c.close()
+            srv2.stop()
+
+    def test_master_drop_fault_reconnects_transparently(self):
+        from paddle_tpu.master import MasterClient, MasterServer
+
+        with MasterServer(timeout_s=60) as addr:
+            c = MasterClient(addr)
+            c.set_dataset(["a", "b", "c"])
+            # drop the connection right before the 3rd RPC: the retry
+            # transport reconnects and the call still succeeds
+            with FaultPlan().at(step=3, kind="master_drop").active() as p:
+                done = 0
+                while done < 3:
+                    t = c.get_task()
+                    if not isinstance(t, tuple):
+                        continue
+                    tid, _d, epoch = t
+                    c.task_finished(tid, epoch)
+                    done += 1
+                assert p.fired_log == [("master_drop", 3)]
+            assert c.counts()["done"] == 3
+            c.close()
+
+    def test_drop_without_retry_fails_fast(self):
+        from paddle_tpu.master import MasterClient, MasterServer
+
+        with MasterServer(timeout_s=60) as addr:
+            c = MasterClient(addr, retry=False)
+            c.set_dataset(["a"])
+            with FaultPlan().at(kind="master_drop").active():
+                # without a retry policy the injected drop surfaces as
+                # the transport error...
+                with pytest.raises(ConnectionError):
+                    c.get_task()
+            # ...and the next call reconnects lazily and succeeds
+            t = c.get_task()
+            assert isinstance(t, tuple) and t[1] == "a"
+            c.close()
+
+    def test_master_backed_reader_skips_no_batches_on_resume(self):
+        """The resume position must not ALSO skip batches when the
+        reader is a MasterClient task stream (its queue already tracks
+        consumption) — otherwise resumed runs drop tasks."""
+        from paddle_tpu.master import MasterClient, MasterServer
+        from paddle_tpu.resilience import TrainResilience
+
+        with MasterServer(timeout_s=60) as addr:
+            c = MasterClient(addr)
+            reader = c.task_reader(lambda desc: iter([desc]))
+            assert getattr(reader, "master_backed", False)
+            rs = TrainResilience(
+                CheckpointConfig("/tmp/unused-rs",
+                                 install_signal_handlers=False),
+                scope=pt.Scope())
+            rs.start_pass, rs.skip_iterations = 0, 5
+            assert rs.skip_for_pass(0, reader) == 0  # master-backed
+            assert rs.skip_for_pass(0, lambda: iter([])) == 5  # plain
+            c.close()
+
+
+@pytest.mark.slow
+class TestCrashMatrix:
+    """Chaos sweep: every fault kind, sync and async — training either
+    completes or resumes to the bitwise-identical end state."""
+
+    @pytest.mark.parametrize("depth", [1, 3], ids=["sync", "async3"])
+    @pytest.mark.parametrize("kind", ["crash", "preempt", "executor_error",
+                                      "torn_checkpoint"])
+    def test_kind_survives(self, tmp_path, uninterrupted_state, kind,
+                           depth):
+        d = str(tmp_path / "ck")
+        cfg = CheckpointConfig(d, every_n_steps=3, background=True,
+                               install_signal_handlers=False)
+        plan = FaultPlan().at(step=5, kind=kind)
+        if kind == "torn_checkpoint":
+            plan = (FaultPlan().at(step=6, kind="torn_checkpoint")
+                    .at(step=7, kind="crash"))
+        t1 = _build()
+        with plan.active():
+            try:
+                t1.train(_reader, num_passes=1, event_handler=_quiet,
+                         async_depth=depth, checkpoint=cfg)
+                crashed = False
+            except SimulatedCrash:
+                crashed = True
+        if kind in ("crash", "torn_checkpoint"):
+            assert crashed
+        if crashed or kind == "preempt":
+            t2 = _build()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                t2.train(_reader, num_passes=1, event_handler=_quiet,
+                         async_depth=depth, checkpoint=cfg)
+            final = _final_state(t2)
+        else:
+            final = _final_state(t1)
+        _assert_bitwise_equal(uninterrupted_state, final)

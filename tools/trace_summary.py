@@ -12,6 +12,9 @@ name, sorted by total — the offline analogue of
 
 ``--runlog`` summarizes a trace.RunLog training journal instead:
 per-pass cost, examples/sec, and the pass-end StatSet highlights.
+``--pipeline`` shows the async-trainer host-gap view; ``--resilience``
+shows checkpoint stall (ckpt/save vs ckpt/write) and retry pressure
+(retry/attempt spans per policy).
 """
 import argparse
 import json
@@ -123,6 +126,57 @@ def summarize_pipeline(events):
     return "\n".join(lines)
 
 
+def summarize_resilience(events):
+    """Checkpoint-stall and retry-pressure view of a trace: how long the
+    step loop blocked in ``ckpt/save`` (vs the background ``ckpt/write``
+    cost), restore/fallback activity, and ``retry/attempt`` spans grouped
+    by policy with their error samples."""
+
+    def by_name(name):
+        return [e for e in events if e.get("name") == name]
+
+    def tot_ms(evs):
+        return sum(float(e.get("dur", 0.0)) for e in evs) / 1e3
+
+    lines = []
+    saves = by_name("ckpt/save")
+    writes = by_name("ckpt/write")
+    restores = by_name("ckpt/restore")
+    if saves:
+        bg = [e for e in saves
+              if e.get("args", {}).get("mode") == "background"]
+        lines.append(
+            f"ckpt saves:              {len(saves)} "
+            f"({len(bg)} background), step-loop stall "
+            f"{tot_ms(saves):.3f} ms total "
+            f"({tot_ms(saves) / len(saves):.3f} avg)")
+    if writes:
+        bytes_ = [e.get("args", {}).get("bytes") for e in writes
+                  if e.get("args", {}).get("bytes") is not None]
+        lines.append(
+            f"ckpt writes:             {len(writes)}, "
+            f"{tot_ms(writes):.3f} ms total off-path"
+            + (f", {max(bytes_)} bytes/ckpt" if bytes_ else ""))
+    if restores:
+        fb = [e for e in restores if e.get("args", {}).get("fallback")]
+        lines.append(f"ckpt restores:           {len(restores)}"
+                     + (f" ({len(fb)} FELL BACK past a torn checkpoint)"
+                        if fb else ""))
+    retries = by_name("retry/attempt")
+    if retries:
+        pols = {}
+        for e in retries:
+            a = e.get("args", {})
+            p = pols.setdefault(a.get("policy", "?"), [0, None])
+            p[0] += 1
+            p[1] = a.get("error") or p[1]
+        for pol, (n, err) in sorted(pols.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"retry pressure [{pol}]:   {n} failed attempts"
+                         + (f"  last: {err}" if err else ""))
+    return "\n".join(lines) if lines else \
+        "(no ckpt/* or retry/* spans — resilience idle)"
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="trace file (chrome JSON or JSONL)")
@@ -134,6 +188,8 @@ def main(argv=None):
                     help="input is a trace.RunLog training journal")
     ap.add_argument("--pipeline", action="store_true",
                     help="host-gap view of trainer dispatch/resolve spans")
+    ap.add_argument("--resilience", action="store_true",
+                    help="checkpoint-stall + retry-pressure view")
     args = ap.parse_args(argv)
     if args.runlog:
         print(summarize_runlog(args.trace))
@@ -143,6 +199,9 @@ def main(argv=None):
     events = load_trace_events(args.trace)
     if args.pipeline:
         print(summarize_pipeline(events))
+        return 0
+    if args.resilience:
+        print(summarize_resilience(events))
         return 0
     rows = summarize(events, prefix=args.prefix)
     if args.top:
