@@ -1,0 +1,49 @@
+"""paddle_tpu.analysis — the static-analysis plane.
+
+The correctness backstop under every transpiler rewrite and sharding
+pass: a whole-program shape/dtype checker driven by the kernels
+themselves (``registry.infer_outputs`` / ``jax.eval_shape``), a
+structural program verifier, and an extensible lint-rule registry
+mirroring the transpiler's pass registry. Shape/dtype bugs, dangling
+variables, and broken rewrites fail at BUILD time with the op index,
+type, user callsite, and offending slot named — not as opaque JAX trace
+errors deep inside ``jit``.
+
+Typical use::
+
+    from paddle_tpu import analysis
+
+    # raise on any structural or shape/dtype violation
+    analysis.check_program(program, feed_names, fetch_names, scope=scope)
+
+    # collect findings instead (tools/proglint.py does this)
+    issues = analysis.run_lint(program, feed_names, fetch_names)
+
+    # blame the exact pass that broke a program
+    pm = transpiler.inference_pipeline(verify_each=True)
+
+``PassManager(verify_each=True)`` re-verifies after every pass (the
+pass sandwich); the ``--verify_program`` flag turns it on across the
+inference/training/deployment pipelines, the trainer, and the serving
+warmup path. ``tools/proglint.py`` runs the battery over built programs
+and saved inference models from the command line.
+"""
+from __future__ import annotations
+
+from .checker import (ProgramAnalysis, ProgramCheckError, SPECIAL_HANDLERS,
+                      check_program, infer_program)
+from .conformance import audit_op, audit_op_registry
+from .lint import (ERROR, WARNING, LintContext, LintIssue, LintRule,
+                   format_issues, get_rule, register_rule, registered_rules,
+                   run_lint)
+from .verifier import (ProgramVerifyError, check_async_overlap,
+                       verify_program, written_state_names)
+
+__all__ = [
+    "ProgramAnalysis", "ProgramCheckError", "ProgramVerifyError",
+    "LintIssue", "LintRule", "LintContext", "ERROR", "WARNING",
+    "check_program", "infer_program", "verify_program", "run_lint",
+    "register_rule", "get_rule", "registered_rules", "format_issues",
+    "audit_op", "audit_op_registry", "written_state_names",
+    "check_async_overlap", "SPECIAL_HANDLERS",
+]

@@ -1,0 +1,352 @@
+"""Whole-program static shape/dtype inference.
+
+Propagates ``jax.ShapeDtypeStruct``s from the feed/persistable frontier
+through every op via the registry's ``infer_outputs`` (the kernel itself
+under ``jax.eval_shape`` — one source of truth, no per-op InferShape to
+drift), understanding the ``-1`` batch sentinel (program.py
+BATCH_DIM_SENTINEL), optional inputs, and the executor's ``special``
+feed/fetch/recompute-segment ops. Inferred shapes/dtypes are annotated
+back onto the program's :class:`Variable`s, and any inconsistency —
+kernel rejection or an inferred shape contradicting the declared one —
+raises :class:`ProgramCheckError` naming the op index, type, user
+callsite, and offending slot at BUILD time, where the reference's per-op
+``InferShape`` would have fired, instead of surfacing as an opaque JAX
+trace error deep inside ``jit``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.enforce import EnforceError, format_input_sigs
+from ..core.program import BATCH_DIM_SENTINEL, Block, Operator, Program
+from ..core.registry import get_op, has_op, infer_outputs
+from ..core.scope import Scope
+from .lint import WARNING, LintIssue
+
+
+class ProgramCheckError(EnforceError):
+    """A program failed whole-program shape/dtype checking. Carries the
+    located context (op index/type/callsite, slot, var) as attributes so
+    tools can render it structurally."""
+
+    def __init__(self, message: str, *, block_idx: int = 0,
+                 op_index: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 callsite: Optional[str] = None,
+                 slot: Optional[str] = None, var: Optional[str] = None):
+        super().__init__(message)
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op_type = op_type
+        self.callsite = callsite
+        self.slot = slot
+        self.var = var
+
+
+class ProgramAnalysis:
+    """Result of :func:`infer_program`: every value name mapped to its
+    inferred ``ShapeDtypeStruct`` (batch dims carry the sentinel), plus
+    non-fatal findings (dtype drift) as :class:`LintIssue`s."""
+
+    def __init__(self):
+        self.types: Dict[str, jax.ShapeDtypeStruct] = {}
+        self.issues: List[LintIssue] = []
+
+    def shape_of(self, name: str) -> Optional[tuple]:
+        """Build-convention shape (sentinel rendered back as -1)."""
+        sds = self.types.get(name)
+        return None if sds is None else _build_shape(sds.shape)
+
+    def dtype_of(self, name: str):
+        sds = self.types.get(name)
+        return None if sds is None else sds.dtype
+
+
+def _build_shape(shape) -> tuple:
+    """Concrete abstract shape -> build convention (-1 batch dims)."""
+    return tuple(-1 if d == BATCH_DIM_SENTINEL else int(d) for d in shape)
+
+
+def _fmt_shape(shape) -> str:
+    return str(_build_shape(shape))
+
+
+def _op_loc(block: Block, op: Operator, op_index: int) -> str:
+    site = op.attrs.get("_callsite")
+    loc = f"block {block.idx} op #{op_index} {op.type!r}"
+    return loc + (f" (created at {site})" if site else "")
+
+
+def _sds_of_value(val) -> object:
+    """ShapeDtypeStruct (tree) for a runtime value without touching the
+    host: jax/numpy arrays expose shape+dtype; pytree state values
+    (SelectedRows) map leaf-wise; python scalars go through numpy."""
+    def leaf(a):
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:
+            a = np.asarray(a)
+            shape, dtype = a.shape, a.dtype
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    return jax.tree_util.tree_map(leaf, val)
+
+
+# --------------------------------------------------------------------------
+# Special-op abstract handlers
+#
+# ``special`` ops are executed by the tracer with an environment, not
+# called as pure kernels, so infer_outputs cannot evaluate them. Each one
+# gets an abstract interpretation here; new special ops must register a
+# handler or the checker rejects programs containing them.
+# --------------------------------------------------------------------------
+def _infer_seg_fwd(op: Operator, resolve, infer_op) -> Dict[str, list]:
+    """Composite recompute-segment forward: walk its serialized seg_ops
+    exactly like top-level ops, in a local environment seeded from the
+    external inputs (backward.py segment_forward contract)."""
+    local: Dict[str, object] = {}
+    for name in op.attrs["ext_in"]:
+        local[name] = resolve(name)
+    for j, sop in enumerate(op.attrs["seg_ops"]):
+        ins = {slot: [local[n] for n in names]
+               for slot, names in sop["ins"].items() if names}
+        outs = infer_op(sop["type"], sop["attrs"], ins,
+                        where=f"seg_ops[{j}]")
+        for slot, names in sop["outs"].items():
+            for n, sds in zip(names, (outs or {}).get(slot, [])):
+                local[n] = sds
+    return {"O": [local[n] for n in op.attrs["all_outs"]]}
+
+
+def _infer_grad_seg(op: Operator, resolve, infer_op) -> Dict[str, list]:
+    """Segment backward: one input-gradient per differentiated external
+    input, shaped like that input (backward.py segment_grad contract)."""
+    dnames = [n for n, d in zip(op.attrs["ext_in"], op.attrs["diff"]) if d]
+    return {"IG": [resolve(n) for n in dnames]}
+
+
+SPECIAL_HANDLERS = {
+    "seg_fwd": _infer_seg_fwd,
+    "grad_seg": _infer_grad_seg,
+}
+
+
+# --------------------------------------------------------------------------
+def infer_program(program: Program, feed_names: Sequence[str] = (),
+                  fetch_names: Sequence[str] = (),
+                  scope: Optional[Scope] = None,
+                  annotate: bool = True) -> ProgramAnalysis:
+    """Propagate shapes/dtypes through every op of every block.
+
+    The value frontier is exactly the executor's data-flow
+    classification (core/executor.py _compile): feeds, names resident in
+    ``scope``, and declared persistable/data variables; every other
+    input must be produced by an earlier op. Raises
+    :class:`ProgramCheckError` on an unresolvable input, a kernel that
+    rejects its abstract inputs, or an inferred shape contradicting the
+    declared one. Declared ``-1`` dims match the batch sentinel or any
+    concrete value. With ``annotate`` (default), inferred shapes/dtypes
+    are written back onto Variables whose declared shape was unknown.
+    """
+    result = ProgramAnalysis()
+    feeds = set(feed_names)
+    for block in program.blocks:
+        _infer_block(block, feeds, scope, annotate, result)
+    # fetches may legitimately live only in the scope (state fetches)
+    for name in fetch_names:
+        if name in result.types:
+            continue
+        if scope is not None and scope.has(name):
+            result.types[name] = _sds_of_value(scope.get(name))
+            continue
+        v = _lookup_var(program.global_block, name)
+        if v is None or not v.persistable:
+            raise ProgramCheckError(
+                f"fetch variable {name!r} is never produced by any op and "
+                f"is not scope-resident state", var=name)
+    return result
+
+
+def _lookup_var(block: Block, name: str):
+    b = block
+    while b is not None:
+        if name in b.vars:
+            return b.vars[name]
+        b = b.parent
+    return None
+
+
+def _infer_block(block: Block, feeds: set, scope: Optional[Scope],
+                 annotate: bool, result: ProgramAnalysis) -> None:
+    env = result.types  # shared across blocks: sub-blocks read outer names
+
+    def resolve(name: str, *, op=None, op_index=None, slot=None):
+        if name in env:
+            return env[name]
+        v = _lookup_var(block, name)
+        if scope is not None and scope.has(name):
+            sds = _sds_of_value(scope.get(name))
+        elif v is not None and v.shape is not None and (
+                v.persistable or v.is_data or name in feeds):
+            sds = jax.ShapeDtypeStruct(v.concrete_shape(), v.dtype)
+        else:
+            where = (_op_loc(block, op, op_index) + f" input {slot}="
+                     if op is not None else "")
+            if v is None:
+                kind = ("not declared in the program" +
+                        ("" if scope is not None else
+                         " (no scope given — pass the run-time scope to "
+                         "resolve state inputs)"))
+            elif v.persistable or v.is_data or name in feeds:
+                kind = ("a feed/persistable variable with no declared "
+                        "shape — declare the shape or provide a scope "
+                        "holding its value")
+            else:
+                kind = ("declared but produced by no earlier op (and not "
+                        "fed/persistable)")
+            raise ProgramCheckError(
+                f"{where}{name!r}: {kind}",
+                block_idx=block.idx,
+                op_index=op_index,
+                op_type=op.type if op is not None else None,
+                callsite=op.attrs.get("_callsite") if op is not None
+                else None,
+                slot=slot, var=name)
+        env[name] = sds
+        return sds
+
+    def infer_op(op_type, attrs, ins, *, where="", op=None, op_index=None):
+        try:
+            return infer_outputs(op_type, attrs, ins)
+        except ProgramCheckError:
+            raise
+        except Exception as exc:
+            loc = (_op_loc(block, op, op_index) if op is not None
+                   else f"op {op_type!r}")
+            sigs = format_input_sigs({
+                slot: [jax.ShapeDtypeStruct(
+                    _build_shape(getattr(a, "shape", ())),
+                    getattr(a, "dtype", None)) for a in arrs]
+                for slot, arrs in ins.items()})
+            raise ProgramCheckError(
+                f"shape inference failed at {loc}{' ' + where if where else ''}\n"
+                f"  inputs: {sigs}\n"
+                f"  cause: {type(exc).__name__}: {exc}",
+                block_idx=block.idx, op_index=op_index,
+                op_type=op_type,
+                callsite=(op.attrs.get("_callsite")
+                          if op is not None else None)) from exc
+
+    for op_index, op in enumerate(block.ops):
+        if not has_op(op.type):
+            raise ProgramCheckError(
+                f"{_op_loc(block, op, op_index)}: unknown op type",
+                block_idx=block.idx, op_index=op_index, op_type=op.type,
+                callsite=op.attrs.get("_callsite"))
+        opdef = get_op(op.type)
+        if opdef.special:
+            handler = SPECIAL_HANDLERS.get(op.type)
+            if handler is None:
+                raise ProgramCheckError(
+                    f"{_op_loc(block, op, op_index)}: special op has no "
+                    f"abstract handler registered in "
+                    f"analysis.checker.SPECIAL_HANDLERS",
+                    block_idx=block.idx, op_index=op_index,
+                    op_type=op.type, callsite=op.attrs.get("_callsite"))
+            outs = handler(
+                op,
+                lambda n: resolve(n, op=op, op_index=op_index, slot=None),
+                lambda t, a, i, where="": infer_op(
+                    t, a, i, where=where, op=op, op_index=op_index))
+        else:
+            ins = {}
+            for slot, names in op.inputs.items():
+                if not names:
+                    continue
+                ins[slot] = [resolve(n, op=op, op_index=op_index, slot=slot)
+                             for n in names]
+            outs = infer_op(op.type, op.attrs, ins, op=op,
+                            op_index=op_index)
+        if not outs:
+            continue
+        for slot, names in op.outputs.items():
+            inferred = outs.get(slot, []) if isinstance(outs, dict) else []
+            for name, sds_tree in zip(names, inferred):
+                env[name] = sds_tree
+                # structured values (SelectedRows sparse grads) carry a
+                # dense_shape of their own — the declared [V, D] var shape
+                # describes the dense view, not the pytree leaves
+                if isinstance(sds_tree, jax.ShapeDtypeStruct):
+                    _check_declared(block, op, op_index, slot, name,
+                                    sds_tree, annotate, result)
+
+
+def _shapes_compatible(declared, inferred) -> bool:
+    """Declared build shape vs inferred abstract shape. A declared -1
+    matches the sentinel or any concrete value (shape-polymorphic ops
+    may concretise a batch dim); an inferred sentinel matches a declared
+    -1 only — it IS the batch."""
+    if len(declared) != len(inferred):
+        return False
+    for d, i in zip(declared, inferred):
+        if d == -1 or d == BATCH_DIM_SENTINEL:
+            continue
+        if int(d) != int(i):
+            return False
+    return True
+
+
+def _check_declared(block: Block, op: Operator, op_index: int, slot: str,
+                    name: str, sds: jax.ShapeDtypeStruct, annotate: bool,
+                    result: ProgramAnalysis) -> None:
+    v = _lookup_var(block, name)
+    if v is None:
+        return
+    if v.shape is None:
+        if annotate:
+            v.shape = _build_shape(sds.shape)
+            v.dtype = sds.dtype
+        return
+    if not _shapes_compatible(v.shape, sds.shape):
+        raise ProgramCheckError(
+            f"shape mismatch at {_op_loc(block, op, op_index)}, output "
+            f"slot {slot!r} -> variable {name!r}: kernel infers shape "
+            f"{_fmt_shape(sds.shape)} but the variable declares "
+            f"{tuple(v.shape)}",
+            block_idx=block.idx, op_index=op_index, op_type=op.type,
+            callsite=op.attrs.get("_callsite"), slot=slot, var=name)
+    if np.dtype(v.dtype) != np.dtype(sds.dtype):
+        # dtype drift is reported, not fatal: the AMP policy legally
+        # changes kernel compute dtypes after a program was built
+        result.issues.append(LintIssue(
+            rule="dtype-drift", severity=WARNING,
+            message=f"output slot {slot!r} -> variable {name!r}: kernel "
+                    f"infers dtype {np.dtype(sds.dtype).name} but the "
+                    f"variable declares {np.dtype(v.dtype).name}",
+            block_idx=block.idx, op_index=op_index, op_type=op.type,
+            callsite=op.attrs.get("_callsite"), slot=slot, var=name))
+
+
+def check_program(program: Program, feed_names: Sequence[str] = (),
+                  fetch_names: Sequence[str] = (),
+                  scope: Optional[Scope] = None, annotate: bool = True,
+                  rules: Optional[Sequence] = None) -> ProgramAnalysis:
+    """The full static checker: structural verification (every error-
+    severity lint rule) followed by whole-program shape/dtype inference.
+
+    Raises :class:`~paddle_tpu.analysis.verifier.ProgramVerifyError` on
+    structural violations and :class:`ProgramCheckError` on shape/dtype
+    ones; returns the :class:`ProgramAnalysis` (inferred types + warning
+    issues, structural warnings included) when the program is clean.
+    """
+    from .verifier import verify_program
+
+    warnings = verify_program(program, feed_names, fetch_names,
+                              scope=scope, rules=rules)
+    analysis = infer_program(program, feed_names, fetch_names, scope=scope,
+                             annotate=annotate)
+    analysis.issues.extend(warnings)
+    return analysis

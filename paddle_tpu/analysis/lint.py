@@ -1,0 +1,139 @@
+"""Lint plane: LintIssue, LintRule base class, and the rule registry.
+
+Mirrors the transpiler's pass registry (transpiler/framework.py): rules
+are small named checks registered under a string key, instantiated per
+run, and composable into rule sets. The program verifier
+(analysis/verifier.py) and the whole-program shape checker
+(analysis/checker.py) are both surfaced as rules here, so
+``tools/proglint.py`` and ``PassManager(verify_each=True)`` run one
+shared battery.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from ..core.program import Program
+from ..core.scope import Scope
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass
+class LintIssue:
+    """One finding. ``severity`` is ``"error"`` (the program would fail
+    or silently miscompute at run time) or ``"warning"`` (suspicious but
+    executable)."""
+
+    rule: str
+    severity: str
+    message: str
+    block_idx: int = 0
+    op_index: Optional[int] = None
+    op_type: Optional[str] = None
+    callsite: Optional[str] = None
+    slot: Optional[str] = None
+    var: Optional[str] = None
+
+    def format(self) -> str:
+        loc = f"block {self.block_idx}"
+        if self.op_index is not None:
+            loc += f" op #{self.op_index}"
+        if self.op_type:
+            loc += f" {self.op_type!r}"
+        site = f" (created at {self.callsite})" if self.callsite else ""
+        return (f"[{self.severity}] {self.rule}: {loc}{site}: "
+                f"{self.message}")
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class LintContext:
+    """What a rule may consult: the feed/fetch contract and (optionally)
+    the scope holding run-time state — names resident in the scope count
+    as available inputs, exactly as the executor classifies them."""
+
+    def __init__(self, feed_names: Sequence[str] = (),
+                 fetch_names: Sequence[str] = (),
+                 scope: Optional[Scope] = None):
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.scope = scope
+
+
+class LintRule:
+    """Base class: subclass, set ``name``, implement ``check``.
+
+    ``check(program, ctx)`` returns/yields :class:`LintIssue`s and must
+    not mutate the program.
+    """
+
+    name: str = ""
+
+    def check(self, program: Program,
+              ctx: LintContext) -> Iterable[LintIssue]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+# --------------------------------------------------------------------------
+# Registry: name -> LintRule factory (zero-arg callable)
+# --------------------------------------------------------------------------
+_RULE_REGISTRY: Dict[str, Callable[[], LintRule]] = {}
+
+
+def register_rule(factory: Callable[[], LintRule] = None, *,
+                  name: Optional[str] = None):
+    """Register a LintRule class (or zero-arg factory) under its
+    ``name``. Usable as a decorator on LintRule subclasses."""
+
+    def _do(f):
+        key = name or getattr(f, "name", "") or getattr(f, "__name__", "")
+        if not key:
+            raise ValueError("lint rule factory needs a name")
+        if key in _RULE_REGISTRY:
+            raise ValueError(f"lint rule {key!r} already registered")
+        _RULE_REGISTRY[key] = f
+        return f
+
+    if factory is None:
+        return _do
+    return _do(factory)
+
+
+def get_rule(name: str) -> LintRule:
+    if name not in _RULE_REGISTRY:
+        raise KeyError(f"lint rule {name!r} is not registered "
+                       f"(known: {sorted(_RULE_REGISTRY)})")
+    return _RULE_REGISTRY[name]()
+
+
+def registered_rules() -> List[str]:
+    return sorted(_RULE_REGISTRY)
+
+
+def run_lint(program: Program, feed_names: Sequence[str] = (),
+             fetch_names: Sequence[str] = (),
+             scope: Optional[Scope] = None,
+             rules: Optional[Sequence] = None) -> List[LintIssue]:
+    """Run a rule battery (default: every registered rule) and return
+    every issue found, errors first."""
+    ctx = LintContext(feed_names, fetch_names, scope=scope)
+    battery = [get_rule(r) if isinstance(r, str) else r
+               for r in (rules if rules is not None else registered_rules())]
+    issues: List[LintIssue] = []
+    for rule in battery:
+        issues.extend(rule.check(program, ctx))
+    issues.sort(key=lambda i: (i.severity != ERROR, i.block_idx,
+                               -1 if i.op_index is None else i.op_index))
+    return issues
+
+
+def format_issues(issues: Sequence[LintIssue]) -> str:
+    if not issues:
+        return "(no issues)"
+    return "\n".join(i.format() for i in issues)

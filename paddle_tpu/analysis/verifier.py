@@ -1,0 +1,370 @@
+"""Program verifier: structural invariants every well-formed program obeys.
+
+The checks that need no abstract evaluation — they walk the op list once
+and catch the failure modes transpiler rewrites historically introduce:
+dangling inputs after a dropped producer, unknown op types after a
+rename, duplicate writes, dead outputs left behind by a partial rewrite,
+violated optional-input contracts, nondeterministic RNG draws, and
+async/donation hazards (fetching a state variable the executor donates
+to XLA on ``run_async``). Each invariant is a registered
+:class:`~paddle_tpu.analysis.lint.LintRule`, so ``tools/proglint.py``
+and custom rule sets compose them freely.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core.enforce import EnforceError
+from ..core.program import Block, Program
+from ..core.registry import get_op, has_op, op_uses_rng
+from ..core.scope import Scope
+from .lint import (ERROR, WARNING, LintContext, LintIssue, LintRule,
+                   register_rule, run_lint)
+
+
+class ProgramVerifyError(EnforceError):
+    """A program violates structural invariants. ``issues`` carries every
+    error-severity finding."""
+
+    def __init__(self, issues: Sequence[LintIssue]):
+        self.issues = list(issues)
+        lines = "\n".join("  " + i.format() for i in self.issues)
+        super().__init__(
+            f"program verification failed with {len(self.issues)} "
+            f"error(s):\n{lines}")
+
+
+def _issue(rule: str, severity: str, block: Block, op_index, op, message,
+           slot=None, var=None) -> LintIssue:
+    return LintIssue(
+        rule=rule, severity=severity, message=message, block_idx=block.idx,
+        op_index=op_index, op_type=op.type if op is not None else None,
+        callsite=op.attrs.get("_callsite") if op is not None else None,
+        slot=slot, var=var)
+
+
+def _lookup_var(block: Block, name: str):
+    b = block
+    while b is not None:
+        if name in b.vars:
+            return b.vars[name]
+        b = b.parent
+    return None
+
+
+def _frontier(block: Block, ctx: LintContext) -> Set[str]:
+    """Names available before the block's first op runs: feeds, scope
+    state, declared persistable/data vars — plus, for sub-blocks,
+    everything any ancestor block produces (a sub-block executes at its
+    parent op's position; order across blocks is not re-checked here)."""
+    avail = set(ctx.feed_names)
+    if ctx.scope is not None:
+        s = ctx.scope
+        while s is not None:
+            avail.update(s.keys())
+            s = s.parent
+    b = block
+    while b is not None:
+        for name, v in b.vars.items():
+            if v.persistable or v.is_data:
+                avail.add(name)
+        if b is not block:
+            for op in b.ops:
+                avail.update(op.output_names())
+        b = b.parent
+    return avail
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class UnknownOpRule(LintRule):
+    """Every op type must resolve in the kernel registry."""
+
+    name = "unknown-op"
+
+    def check(self, program, ctx):
+        for block in program.blocks:
+            for i, op in enumerate(block.ops):
+                if not has_op(op.type):
+                    yield _issue(self.name, ERROR, block, i, op,
+                                 "op type is not registered")
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class UseBeforeDefRule(LintRule):
+    """Every input must be produced by an earlier op, fed, persistable,
+    or resident in the scope — the executor's exact data-flow contract
+    (core/executor.py _compile). The canonical broken-rewrite symptom: a
+    pass drops a producer but leaves the consumers."""
+
+    name = "use-before-def"
+
+    def check(self, program, ctx):
+        for block in program.blocks:
+            avail = _frontier(block, ctx)
+            for i, op in enumerate(block.ops):
+                for slot, names in op.inputs.items():
+                    for name in names:
+                        if name in avail:
+                            continue
+                        v = _lookup_var(block, name)
+                        if v is not None:
+                            yield _issue(
+                                self.name, ERROR, block, i, op,
+                                f"input {slot}={name!r} is declared but "
+                                f"produced by no earlier op and is "
+                                f"neither fed, persistable, nor "
+                                f"scope-resident", slot=slot, var=name)
+                        elif ctx.scope is not None:
+                            yield _issue(
+                                self.name, ERROR, block, i, op,
+                                f"input {slot}={name!r} is not declared "
+                                f"in the program and not resident in the "
+                                f"scope", slot=slot, var=name)
+                        else:
+                            yield _issue(
+                                self.name, WARNING, block, i, op,
+                                f"input {slot}={name!r} is not declared "
+                                f"in the program; without a scope its "
+                                f"availability cannot be proven",
+                                slot=slot, var=name)
+                        avail.add(name)  # report each name once
+                avail.update(op.output_names())
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class DuplicateOutputRule(LintRule):
+    """One op writing the same name through two slots is a rewrite bug
+    (aliased state across DIFFERENT ops — batch_norm's MeanOut onto Mean
+    — is legal and untouched)."""
+
+    name = "duplicate-output"
+
+    def check(self, program, ctx):
+        for block in program.blocks:
+            for i, op in enumerate(block.ops):
+                seen: Dict[str, str] = {}
+                for slot, names in op.outputs.items():
+                    for name in names:
+                        if name in seen:
+                            yield _issue(
+                                self.name, ERROR, block, i, op,
+                                f"output {name!r} is written by both "
+                                f"slot {seen[name]!r} and slot {slot!r}",
+                                slot=slot, var=name)
+                        else:
+                            seen[name] = slot
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class DeadOutputRule(LintRule):
+    """An op NONE of whose outputs is read, fetched, or state does pure
+    dead work every step — DCE fodder a rewrite left behind. Fires per
+    op, not per output: an unconsumed auxiliary slot next to a live
+    primary (batch_norm's SavedMean, layer_norm's Mean) costs nothing —
+    the kernel computes it either way. Warning severity: dead ops
+    execute correctly."""
+
+    name = "dead-output"
+
+    def check(self, program, ctx):
+        from ..core.program import GRAD_SUFFIX
+
+        fetches = set(ctx.fetch_names)
+        consumed: Set[str] = set()
+        for block in program.blocks:
+            for op in block.ops:
+                consumed.update(op.input_names())
+
+        def live(block, name):
+            if name in consumed or name in fetches:
+                return True
+            if name.endswith(GRAD_SUFFIX):
+                # canonical @GRAD assigns are the fetchable gradient
+                # API surface, not dead work
+                return True
+            v = _lookup_var(block, name)
+            if v is not None and v.persistable:
+                return True
+            # unfetched state write (KV caches)
+            return ctx.scope is not None and ctx.scope.has(name)
+
+        for block in program.blocks:
+            for i, op in enumerate(block.ops):
+                names = op.output_names()
+                if not names:
+                    continue
+                if any(live(block, n) for n in names):
+                    continue
+                yield _issue(
+                    self.name, WARNING, block, i, op,
+                    f"no output of this op is consumed, fetched, or "
+                    f"persistable state (outputs: "
+                    f"{names[:4]}{'...' if len(names) > 4 else ''})")
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class OptionalInputContractRule(LintRule):
+    """An empty input slot is only legal when the op declares it in
+    ``optional_inputs`` — anything else would make the kernel see a slot
+    it requires vanish (the executor silently drops empty slots)."""
+
+    name = "optional-input-contract"
+
+    def check(self, program, ctx):
+        for block in program.blocks:
+            for i, op in enumerate(block.ops):
+                if not has_op(op.type):
+                    continue  # unknown-op already fires
+                opdef = get_op(op.type)
+                if opdef.special:
+                    continue
+                for slot, names in op.inputs.items():
+                    if not names and slot not in opdef.optional_inputs:
+                        yield _issue(
+                            self.name, WARNING, block, i, op,
+                            f"input slot {slot!r} is present but empty "
+                            f"and not declared optional "
+                            f"(optional_inputs="
+                            f"{list(opdef.optional_inputs)})", slot=slot)
+
+
+# --------------------------------------------------------------------------
+@register_rule
+class RngDeterminismRule(LintRule):
+    """Ops drawing randomness in a program with no ``random_seed`` fall
+    back to the process-global ``--seed`` flag: reproducible only if
+    every launcher pins it. Lint so training runs meant to be replayable
+    plumb an explicit seed."""
+
+    name = "rng-no-seed"
+
+    def check(self, program, ctx):
+        if program.random_seed is not None:
+            return
+        for block in program.blocks:
+            for i, op in enumerate(block.ops):
+                if not has_op(op.type):
+                    continue
+                if op_uses_rng(get_op(op.type), op.attrs):
+                    yield _issue(
+                        self.name, WARNING, block, i, op,
+                        "op draws randomness but the program sets no "
+                        "random_seed (falls back to the global --seed "
+                        "flag)")
+                    return  # one finding per program is enough
+
+
+# --------------------------------------------------------------------------
+def written_state_names(program: Program,
+                        scope: Optional[Scope] = None) -> Set[str]:
+    """Names the executor writes back to the scope after a run — declared
+    persistable outputs plus outputs of names resident in ``scope``.
+    These are DONATED to XLA on ``run_async`` dispatch (their previous
+    buffers are invalidated in flight)."""
+    written: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            for name in op.output_names():
+                v = _lookup_var(block, name)
+                if (v is not None and v.persistable) or (
+                        scope is not None and scope.has(name)):
+                    written.add(name)
+    return written
+
+
+@register_rule
+class DonatedFetchRule(LintRule):
+    """Fetching a variable the run also writes back as state is an async
+    hazard: ``run_async`` donates the written-back buffer to the next
+    dispatch, so the fetched handle may alias memory XLA reuses. The
+    sync path is safe; flag it so async pipelines don't inherit it."""
+
+    name = "fetch-donated-state"
+
+    def check(self, program, ctx):
+        written = written_state_names(program, ctx.scope)
+        for name in ctx.fetch_names:
+            if name in written:
+                yield LintIssue(
+                    rule=self.name, severity=WARNING,
+                    message=f"fetch {name!r} is also written-back state: "
+                            f"run_async donates its buffer to the next "
+                            f"dispatch (read the fetch via "
+                            f"handle.result() before dispatching again)",
+                    var=name)
+
+
+@register_rule
+class FetchProducedRule(LintRule):
+    """Every fetch target must be produced by some op, persistable, or
+    scope-resident."""
+
+    name = "fetch-never-produced"
+
+    def check(self, program, ctx):
+        produced: Set[str] = set()
+        for block in program.blocks:
+            for op in block.ops:
+                produced.update(op.output_names())
+        for name in ctx.fetch_names:
+            if name in produced:
+                continue
+            if ctx.scope is not None and ctx.scope.has(name):
+                continue
+            v = _lookup_var(program.global_block, name)
+            if v is not None and v.persistable:
+                continue
+            yield LintIssue(
+                rule=self.name, severity=ERROR,
+                message=f"fetch {name!r} is never produced by any op and "
+                        f"is not persistable/scope state", var=name)
+
+
+# --------------------------------------------------------------------------
+def check_async_overlap(
+        runs: Sequence[Tuple[Program, Sequence[str], Sequence[str]]],
+        scope: Optional[Scope] = None) -> List[LintIssue]:
+    """Async hazard check across programs meant to be in flight together
+    (``Executor.run_async`` chains): two dispatches whose write-back
+    state sets overlap race on donated buffers unless serialized.
+
+    ``runs`` is ``[(program, feed_names, fetch_names), ...]``; returns
+    one warning per overlapping pair.
+    """
+    issues: List[LintIssue] = []
+    writes = [written_state_names(p, scope) for p, _, _ in runs]
+    for a in range(len(runs)):
+        for b in range(a + 1, len(runs)):
+            overlap = writes[a] & writes[b]
+            if overlap:
+                names = ", ".join(repr(n) for n in sorted(overlap)[:6])
+                issues.append(LintIssue(
+                    rule="overlapping-state-writes", severity=WARNING,
+                    message=f"programs #{a} and #{b} both write state "
+                            f"{{{names}}}: overlapping run_async "
+                            f"dispatches race on donated buffers — "
+                            f"serialize them or split the state"))
+    return issues
+
+
+# --------------------------------------------------------------------------
+def verify_program(program: Program, feed_names: Sequence[str] = (),
+                   fetch_names: Sequence[str] = (),
+                   scope: Optional[Scope] = None,
+                   rules: Optional[Sequence] = None,
+                   raise_on_error: bool = True) -> List[LintIssue]:
+    """Run the structural rule battery. Error-severity findings raise
+    :class:`ProgramVerifyError` (unless ``raise_on_error=False``); the
+    warning-severity remainder is returned."""
+    issues = run_lint(program, feed_names, fetch_names, scope=scope,
+                      rules=rules)
+    errors = [i for i in issues if i.severity == ERROR]
+    if errors and raise_on_error:
+        raise ProgramVerifyError(errors)
+    return issues if not raise_on_error else [
+        i for i in issues if i.severity != ERROR]

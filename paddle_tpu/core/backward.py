@@ -292,7 +292,11 @@ def append_backward(
 
     # 3. Seed: d loss / d loss = 1.
     loss_grad_name = grad_var_name(loss.name)
-    block.create_var(name=loss_grad_name, shape=loss.shape or (1,),
+    # declared shape must match the fill_constant below exactly — a ()
+    # loss declares a () seed, not (1,) (the whole-program checker pins
+    # declared-vs-inferred agreement)
+    block.create_var(name=loss_grad_name,
+                     shape=loss.shape if loss.shape is not None else (),
                      dtype=loss.dtype, stop_gradient=True)
     block.append_op(
         "fill_constant",

@@ -142,6 +142,16 @@ def _maybe_enable_compilation_cache() -> None:
     # cache every compile, however small/fast
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax initialises the persistent cache once, on the first compile:
+    # if anything compiled before this flag was read (or a different
+    # cache dir was active), the dir change would silently not take —
+    # drop the initialised cache so the next compile re-inits at ``d``
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # cache never initialised / private API moved
+        pass
     _cache_enabled = True
 
 
@@ -486,7 +496,10 @@ class Executor:
                                 f"op {op.type!r} input {slot}={name!r} is "
                                 f"neither a feed, produced by a prior op, "
                                 f"nor present in the scope. Did you forget "
-                                f"to run the startup program?")
+                                f"to run the startup program? "
+                                f"(paddle_tpu.analysis.check_program / "
+                                f"tools/proglint.py locate dangling "
+                                f"inputs statically)")
                     ins[slot] = vals
                 t0 = time.perf_counter()
                 try:
@@ -709,7 +722,10 @@ class Executor:
                         raise RuntimeError(
                             f"op {op.type!r} input {slot}={name!r} is neither a feed, "
                             f"produced by a prior op, nor present in the scope. "
-                            f"Did you forget to run the startup program?"
+                            f"Did you forget to run the startup program? "
+                            f"(paddle_tpu.analysis.check_program / "
+                            f"tools/proglint.py locate dangling inputs "
+                            f"statically)"
                         )
             for name in op.output_names():
                 produced.add(name)

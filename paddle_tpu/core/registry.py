@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Arrays = Dict[str, List[jax.Array]]  # slot -> list of arrays
 
@@ -91,9 +92,20 @@ def register_op(
 
 
 def get_op(type: str) -> OpDef:
-    if type not in _REGISTRY:
-        raise KeyError(f"op {type!r} is not registered (known: {sorted(_REGISTRY)})")
-    return _REGISTRY[type]
+    opdef = _REGISTRY.get(type)
+    if opdef is None:
+        import difflib
+
+        known = sorted(_REGISTRY)
+        close = difflib.get_close_matches(type, known, n=3, cutoff=0.6)
+        hint = ("; did you mean " + " / ".join(repr(c) for c in close) + "?"
+                if close else "")
+        sample = ", ".join(known[:8])
+        raise KeyError(
+            f"op {type!r} is not registered{hint} "
+            f"({len(known)} ops registered, e.g. {sample}, ...; "
+            f"see registry.registered_ops() for the full list)")
+    return opdef
 
 
 def op_uses_rng(opdef: OpDef, attrs) -> bool:
@@ -111,12 +123,124 @@ def registered_ops() -> List[str]:
     return sorted(_REGISTRY)
 
 
+# ---------------------------------------------------------------------------
+# infer_outputs memoization
+#
+# Whole-program analysis (paddle_tpu.analysis) and repeated layer_helper
+# build-time calls evaluate identical (op_type, attrs, input-signature)
+# triples over and over — a ResNet block stamps the same conv/BN/relu
+# signatures dozens of times, and the pass-sandwich verifier re-checks a
+# mostly-unchanged program after every pass. jax.eval_shape is pure in
+# those inputs (plus the process-global AMP policy, which changes kernel
+# compute dtypes), so the result is cached. Hit/miss counters land in the
+# profiler StatSet as registry/infer_cache/{hit,miss}.
+# ---------------------------------------------------------------------------
+_INFER_CACHE: Dict[tuple, object] = {}
+_INFER_CACHE_MAX = 8192
+_INFER_HITS = 0
+_INFER_MISSES = 0
+
+
+class _Unfreezable(Exception):
+    """Attr value with no stable hashable form; skip memoization."""
+
+
+def _freeze(x):
+    """Stable hashable digest of an attr value. Keys starting with '_'
+    (``_callsite``, ``__fused_from__`` provenance, recompute-segment
+    tags) are metadata no kernel reads — excluding them is what lets two
+    ops built at different source lines share a cache entry."""
+    if isinstance(x, dict):
+        return tuple(sorted(
+            (k, _freeze(v)) for k, v in x.items()
+            if not (isinstance(k, str) and k.startswith("_"))))
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(v) for v in x)
+    if isinstance(x, (set, frozenset)):
+        return ("<set>",) + tuple(sorted(repr(_freeze(v)) for v in x))
+    if isinstance(x, np.ndarray):
+        return ("<ndarray>", x.shape, str(x.dtype), hash(x.tobytes()))
+    if isinstance(x, (str, int, float, bool, bytes, type(None))):
+        return x
+    raise _Unfreezable(repr(type(x)))
+
+
+def _signature_key(op_type: str, attrs, in_shapes) -> Optional[tuple]:
+    """Cache key, or None when any part has no stable digest."""
+    try:
+        frozen_attrs = _freeze(attrs or {})
+    except _Unfreezable:
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(in_shapes)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            return None
+        sig.append((tuple(shape), str(dtype)))
+    from ..ops import common as ops_common
+
+    return (op_type, frozen_attrs, tuple(sig), treedef,
+            ops_common.amp_enabled())
+
+
+def _copy_inferred(result):
+    """Callers consume the result as {slot: [ShapeDtypeStruct]}; hand each
+    one its own containers so a mutating caller can't poison the cache."""
+    if isinstance(result, dict):
+        return {k: (list(v) if isinstance(v, (list, tuple)) else v)
+                for k, v in result.items()}
+    return result
+
+
+def infer_cache_stats() -> Dict[str, int]:
+    """{'hits', 'misses', 'entries'} of the infer_outputs memo table."""
+    return {"hits": _INFER_HITS, "misses": _INFER_MISSES,
+            "entries": len(_INFER_CACHE)}
+
+
+def clear_infer_cache() -> None:
+    global _INFER_HITS, _INFER_MISSES
+    _INFER_CACHE.clear()
+    _INFER_HITS = 0
+    _INFER_MISSES = 0
+
+
+def _count_infer(kind: str) -> None:
+    from .. import profiler
+
+    profiler.global_stat.add_count(f"registry/infer_cache/{kind}", 1)
+
+
 def infer_outputs(op_type: str, attrs, in_shapes: Arrays) -> Dict[str, List[jax.ShapeDtypeStruct]]:
     """Abstractly evaluate an op to get output shapes/dtypes.
 
-    ``in_shapes`` maps slot -> list of ShapeDtypeStruct. Replaces the
-    reference's per-op InferShape implementations.
+    ``in_shapes`` maps slot -> list of ShapeDtypeStruct (concrete arrays
+    are accepted too — only shape/dtype are read). Replaces the
+    reference's per-op InferShape implementations. Results are memoized
+    on (op_type, attrs digest, input signature, AMP policy); see
+    ``infer_cache_stats``.
     """
+    global _INFER_HITS, _INFER_MISSES
+    key = _signature_key(op_type, attrs, in_shapes)
+    if key is not None:
+        cached = _INFER_CACHE.get(key)
+        if cached is not None:
+            _INFER_HITS += 1
+            _count_infer("hit")
+            return _copy_inferred(cached)
+    result = _infer_outputs_uncached(op_type, attrs, in_shapes)
+    if key is not None:
+        _INFER_MISSES += 1
+        _count_infer("miss")
+        if len(_INFER_CACHE) >= _INFER_CACHE_MAX:
+            _INFER_CACHE.clear()  # whole-table reset beats LRU bookkeeping
+        _INFER_CACHE[key] = _copy_inferred(result)
+    return result
+
+
+def _infer_outputs_uncached(op_type: str, attrs, in_shapes: Arrays):
     opdef = get_op(op_type)
     if op_uses_rng(opdef, attrs):
         def f(ins, rng):

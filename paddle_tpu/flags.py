@@ -200,6 +200,13 @@ define_int32("trace_level", 0,
              "per-op interpret-mode debug runs (Executor.run walks the "
              "block op-by-op, locating NaN/Inf producers). Runtime flips "
              "go through trace.enable(level)")
+define_bool("verify_program", False,
+            "run the paddle_tpu.analysis program verifier + whole-program "
+            "shape/dtype checker around every transpiler pass "
+            "(PassManager verify_each — the pass that breaks a program "
+            "is named), and on the programs the trainer, "
+            "save_inference_model, and the serving engines are about to "
+            "compile. Build-time cost only; on in CI")
 define_string("fault_plan", "",
               "deterministic chaos plan for manual resilience drills, "
               "e.g. 'preempt@5,torn_checkpoint@3': kind@step entries "

@@ -205,6 +205,15 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
         pruned = inference_pipeline().run(
             pruned, feeded_var_names, fetch_names, scope=work_scope)
         save_scope = work_scope
+    from .flags import FLAGS
+
+    if FLAGS.verify_program:
+        # never persist an artifact the verifier rejects: the saved model
+        # is the contract every serving replica loads
+        from . import analysis
+
+        analysis.check_program(pruned, feeded_var_names, fetch_names,
+                               scope=save_scope, annotate=False)
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump({

@@ -76,8 +76,13 @@ def adam(attrs, ins):
     g = single(ins, "Grad")
     m1 = single(ins, "Moment1")
     m2 = single(ins, "Moment2")
-    b1p = single(ins, "Beta1Pow").reshape(())
-    b2p = single(ins, "Beta2Pow").reshape(())
+    # keep the STORED beta-pow shape on write-back: emitting a ()-shaped
+    # update over the (1,)-declared accumulator would silently retrace
+    # the whole step on the second run (and trip the program checker)
+    b1p_acc = single(ins, "Beta1Pow")
+    b2p_acc = single(ins, "Beta2Pow")
+    b1p = b1p_acc.reshape(())
+    b2p = b2p_acc.reshape(())
     lr = single(ins, "LearningRate").reshape(())
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
@@ -102,8 +107,8 @@ def adam(attrs, ins):
             "ParamOut": [p.at[m.rows].add(-step, mode="drop")],
             "Moment1Out": [m1.at[m.rows].set(m1_rows, mode="drop")],
             "Moment2Out": [m2.at[m.rows].set(m2_rows, mode="drop")],
-            "Beta1PowOut": [b1p * b1],
-            "Beta2PowOut": [b2p * b2],
+            "Beta1PowOut": [b1p_acc * b1],
+            "Beta2PowOut": [b2p_acc * b2],
         }
     g = g.astype(jnp.float32)
     m1_out = b1 * m1 + (1 - b1) * g
@@ -115,8 +120,8 @@ def adam(attrs, ins):
         "ParamOut": [p_out],
         "Moment1Out": [m1_out],
         "Moment2Out": [m2_out],
-        "Beta1PowOut": [b1p * b1],
-        "Beta2PowOut": [b2p * b2],
+        "Beta1PowOut": [b1p_acc * b1],
+        "Beta2PowOut": [b2p_acc * b2],
     }
 
 
@@ -126,7 +131,8 @@ def adamax(attrs, ins):
     g = _densify_grad(single(ins, "Grad")).astype(jnp.float32)
     m = single(ins, "Moment")
     inf_norm = single(ins, "InfNorm")
-    b1p = single(ins, "Beta1Pow").reshape(())
+    b1p_acc = single(ins, "Beta1Pow")  # keep stored shape on write-back
+    b1p = b1p_acc.reshape(())
     lr = single(ins, "LearningRate").reshape(())
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
@@ -136,7 +142,7 @@ def adamax(attrs, ins):
     lr_t = lr / (1 - b1p)
     p_out = p - (lr_t * m_out / (inf_out + eps)).astype(p.dtype)
     return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [inf_out],
-            "Beta1PowOut": [b1p * b1]}
+            "Beta1PowOut": [b1p_acc * b1]}
 
 
 @register_op("adagrad")

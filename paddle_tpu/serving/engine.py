@@ -125,6 +125,17 @@ class InferenceEngine:
                                   preserve_state_writes=True)
             for k, v in pm.metrics_dict().items():
                 self.metrics.set_gauge(k, v)
+        from ..flags import FLAGS
+
+        if FLAGS.verify_program:
+            # verify the program actually served (transpiled or raw)
+            # before warmup compiles it — a corrupted artifact fails here
+            # with op/slot context instead of mid-warmup
+            from .. import analysis
+
+            analysis.check_program(self.program, self.feed_names,
+                                   self.fetch_names, scope=self.scope,
+                                   annotate=False)
         if mesh is not None:
             dp = int(np.prod(mesh.devices.shape))
             batch_buckets = _round_buckets(batch_buckets, dp)

@@ -61,6 +61,21 @@ class SGD:
                                     scope=scope or global_scope())
             prune_pipeline().run(self.test_program, feeds, fetches)
         optimizer.minimize(cost, startup_program=self.startup_program)
+        from .flags import FLAGS
+
+        if FLAGS.verify_program:
+            # static backstop before the first compile: structural verify
+            # + whole-program shape/dtype inference over the FULL step
+            # program (forward, backward, optimizer updates) — a broken
+            # layer/rewrite fails here naming op/callsite/slot, not as a
+            # JAX trace error inside jit
+            from . import analysis
+
+            feeds = [v.name for v in feed_list]
+            fetches = [cost.name] + [v.name for v in self.metrics.values()]
+            analysis.check_program(self.main_program, feeds, fetches,
+                                   scope=scope or global_scope())
+            analysis.check_program(self.startup_program)
         # pad_to_multiple: bucket ragged columns (data_feeder.py) so varlen
         # training pads to a bounded set of compile signatures.
         self.feeder = DataFeeder(feed_list, pad_to_multiple=pad_to_multiple)

@@ -20,20 +20,27 @@ Typical use::
 ``io.save_inference_model`` runs the inference pipeline by default; the
 serving engines transpile before warmup and publish the pass stats into
 their ``MetricsRegistry``.
+
+Every pipeline forwards ``verify_each=True`` (or the ``--verify_program``
+flag) to the PassManager pass sandwich: the paddle_tpu.analysis verifier
++ whole-program shape checker re-run after every pass, so the exact pass
+that breaks a program is named (``PassVerificationError``) instead of
+the breakage surfacing as a JAX trace error at the next compile.
 """
 from __future__ import annotations
 
 from typing import Optional
 
 from .framework import (Pass, PassContext, PassManager, PassResult,
-                        get_pass, ir_dump_hook, register_pass,
-                        registered_passes)
+                        PassVerificationError, get_pass, ir_dump_hook,
+                        register_pass, registered_passes)
 from .passes import (CanonicalizeIsTest, ConstantFolding,
                      DeadOpElimination, DropoutToScale,
                      ExpandRecomputeSegments, FoldBatchNorm, FusePatterns)
 
 __all__ = [
-    "Pass", "PassContext", "PassManager", "PassResult", "register_pass",
+    "Pass", "PassContext", "PassManager", "PassResult",
+    "PassVerificationError", "register_pass",
     "get_pass", "registered_passes", "ir_dump_hook",
     "ExpandRecomputeSegments", "CanonicalizeIsTest", "DropoutToScale",
     "DeadOpElimination", "ConstantFolding", "FoldBatchNorm",

@@ -52,12 +52,14 @@ class PassContext:
 
 @dataclasses.dataclass
 class PassResult:
-    """One pass application: wall time + op-count delta."""
+    """One pass application: wall time + op-count delta (+ the time the
+    pass-sandwich verifier spent re-checking the program afterwards)."""
 
     name: str
     seconds: float
     ops_before: int
     ops_after: int
+    verify_seconds: float = 0.0
 
     @property
     def op_delta(self) -> int:
@@ -66,6 +68,19 @@ class PassResult:
     @property
     def changed(self) -> bool:
         return self.ops_after != self.ops_before
+
+
+class PassVerificationError(RuntimeError):
+    """A pass broke the program: the pass-sandwich verifier found the
+    program invalid AFTER this pass ran (it was valid before). Carries
+    ``pass_name`` and the underlying verifier/checker error as
+    ``__cause__``."""
+
+    def __init__(self, pass_name: str, cause: BaseException):
+        self.pass_name = pass_name
+        super().__init__(
+            f"pass {pass_name!r} broke the program: "
+            f"{type(cause).__name__}: {cause}")
 
 
 class Pass:
@@ -156,11 +171,22 @@ class PassManager:
     - ``dump_hook(pass_name, before, after)`` receives full op listings
       around every pass that changed the program (and all passes when
       ``dump_all``); see ``ir_dump_hook`` for the write-to-dir variant.
+    - ``verify_each`` turns on the pass sandwich: the program is
+      verified (paddle_tpu.analysis structural rules + whole-program
+      shape/dtype inference with ``verify_shapes``) BEFORE the first
+      pass and after EVERY pass, so the exact pass that broke a program
+      is named in :class:`PassVerificationError` instead of the
+      breakage surfacing as a JAX trace error at the next compile.
+      ``None`` (default) follows the ``--verify_program`` flag.
+      Verification wall time lands in the pass stats
+      (``transpiler/verify/<name>``) and in each ``PassResult``.
     """
 
     def __init__(self, passes: Sequence, stat_set=None,
                  dump_hook: Optional[Callable[[str, str, str], None]] = None,
-                 dump_all: bool = False):
+                 dump_all: bool = False,
+                 verify_each: Optional[bool] = None,
+                 verify_shapes: bool = True):
         self.passes: List[Pass] = [
             get_pass(p) if isinstance(p, str) else p for p in passes
         ]
@@ -168,15 +194,44 @@ class PassManager:
             else profiler.global_stat
         self.dump_hook = dump_hook
         self.dump_all = dump_all
+        self.verify_each = verify_each
+        self.verify_shapes = verify_shapes
         self.results: List[PassResult] = []
 
     # ------------------------------------------------------------------
+    def _verify(self, program: Program, ctx: PassContext) -> float:
+        """One sandwich slice: structural verify (+ shape inference when
+        ``verify_shapes``). Returns the wall time spent; raises the
+        analysis error on an invalid program."""
+        from .. import analysis
+
+        t0 = time.perf_counter()
+        if self.verify_shapes:
+            analysis.check_program(program, ctx.feed_names,
+                                   ctx.fetch_names, scope=ctx.scope,
+                                   annotate=False)
+        else:
+            analysis.verify_program(program, ctx.feed_names,
+                                    ctx.fetch_names, scope=ctx.scope)
+        return time.perf_counter() - t0
+
     def run(self, program: Program, feed_names: Sequence[str],
             fetch_names: Sequence[str], scope: Optional[Scope] = None,
             **ctx_kw) -> Program:
         """Apply every pass in order (in place) and return the program."""
         ctx = PassContext(feed_names, fetch_names, scope=scope, **ctx_kw)
         self.results = []
+        verify = self.verify_each
+        if verify is None:
+            from ..flags import FLAGS
+
+            verify = FLAGS.verify_program
+        if verify:
+            # pre-verify so a broken INPUT program is not pinned on the
+            # first pass — this one propagates the analysis error as-is
+            dt = self._verify(program, ctx)
+            if self.stat_set is not None:
+                self.stat_set.add("transpiler/verify/<input>", dt)
         for p in self.passes:
             before = str(program) if self.dump_hook else ""
             n0 = _op_count(program)
@@ -184,11 +239,20 @@ class PassManager:
             p.apply(program, ctx)
             dt = time.perf_counter() - t0
             n1 = _op_count(program)
-            self.results.append(PassResult(p.name, dt, n0, n1))
+            vdt = 0.0
+            if verify:
+                try:
+                    vdt = self._verify(program, ctx)
+                except Exception as exc:
+                    self.results.append(PassResult(p.name, dt, n0, n1))
+                    raise PassVerificationError(p.name, exc) from exc
+            self.results.append(PassResult(p.name, dt, n0, n1, vdt))
             if self.stat_set is not None:
                 self.stat_set.add(f"transpiler/pass/{p.name}", dt)
                 self.stat_set.add_count(f"transpiler/delta/{p.name}",
                                         n1 - n0)
+                if verify:
+                    self.stat_set.add(f"transpiler/verify/{p.name}", vdt)
             if self.dump_hook and (self.dump_all or n1 != n0):
                 self.dump_hook(p.name, before, str(program))
         self.last_notes = list(ctx.notes)
@@ -200,7 +264,8 @@ class PassManager:
         return [
             {"pass": r.name, "ms": round(r.seconds * 1e3, 3),
              "ops_before": r.ops_before, "ops_after": r.ops_after,
-             "op_delta": r.op_delta}
+             "op_delta": r.op_delta,
+             "verify_ms": round(r.verify_seconds * 1e3, 3)}
             for r in self.results
         ]
 
@@ -215,17 +280,26 @@ class PassManager:
                 sum(r.seconds for r in self.results) * 1e3, 3)
             out[prefix + "ops_removed"] = (self.results[0].ops_before
                                            - self.results[-1].ops_after)
+            verify_s = sum(r.verify_seconds for r in self.results)
+            if verify_s:
+                out[prefix + "verify_ms"] = round(verify_s * 1e3, 3)
         return out
 
     def format_stats(self) -> str:
         """Human table of the last run (demo/debug output)."""
         if not self.results:
             return "(no passes run)"
+        verified = any(r.verify_seconds for r in self.results)
         head = f"{'pass':<28}{'ms':>10}{'ops before':>12}" \
                f"{'ops after':>11}{'delta':>8}"
+        if verified:
+            head += f"{'verify ms':>11}"
         lines = [head, "-" * len(head)]
         for r in self.results:
-            lines.append(f"{r.name:<28}{r.seconds * 1e3:>10.3f}"
-                         f"{r.ops_before:>12}{r.ops_after:>11}"
-                         f"{r.op_delta:>+8}")
+            row = (f"{r.name:<28}{r.seconds * 1e3:>10.3f}"
+                   f"{r.ops_before:>12}{r.ops_after:>11}"
+                   f"{r.op_delta:>+8}")
+            if verified:
+                row += f"{r.verify_seconds * 1e3:>11.3f}"
+            lines.append(row)
         return "\n".join(lines)

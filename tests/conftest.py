@@ -21,6 +21,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT wire a session-wide persistent XLA compilation cache here
+# (tempting for suite speed): on this jaxlib, CPU executables restored
+# from the on-disk cache mishandle donated/aliased buffers — training
+# steps that donate state (Executor donate_argnums) read freed memory
+# and return NaNs (reproduced via test_master_checkpoint
+# test_save_resume_bit_exact going NaN at step 3 with a warm cache).
+# The production --compilation_cache_dir flag stays opt-in per process.
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,415 @@
+"""paddle_tpu.analysis: whole-program shape/dtype checker, structural
+verifier, lint-rule registry, and the registry-plane satellites
+(memoized infer_outputs, get_op nearest-match errors)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, models
+from paddle_tpu.core import registry
+from paddle_tpu.core.program import BATCH_DIM_SENTINEL
+
+
+def _build(fn):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        out = fn()
+    return main, startup, out
+
+
+# ==========================================================================
+# Whole-program checker: model-zoo programs validate with zero errors
+# ==========================================================================
+class TestModelZooClean:
+    """Acceptance: every zoo program checks clean — zero false positives."""
+
+    def _check(self, main, startup, feeds, fetches):
+        res = analysis.check_program(main, feeds, fetches)
+        assert not [i for i in res.issues if i.severity == analysis.ERROR]
+        analysis.check_program(startup)
+        return res
+
+    def test_resnet50_training_program(self):
+        def build():
+            img = layers.data("img", shape=[32, 32, 3], dtype="float32")
+            logits = models.resnet_imagenet(img, num_classes=10, depth=50)
+            label = layers.data("label", shape=[1], dtype="int64")
+            loss = layers.mean(
+                layers.cross_entropy(layers.softmax(logits), label))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9).minimize(loss)
+            return loss
+
+        main, startup, loss = _build(build)
+        res = self._check(main, startup, ["img", "label"], [loss.name])
+        # inferred types cover the whole program, batch stays symbolic
+        assert res.shape_of(loss.name) == ()
+        assert res.types["img"].shape[0] == BATCH_DIM_SENTINEL
+
+    def test_transformer_training_program(self):
+        def build():
+            ids = layers.data("ids", shape=[16], dtype="int64")
+            tgt = layers.data("tgt", shape=[16], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=97, d_model=32, n_layers=2, num_heads=4,
+                max_len=32)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, 97]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+            return loss
+
+        main, startup, loss = _build(build)
+        self._check(main, startup, ["ids", "tgt"], [loss.name])
+
+    def test_rnn_lstm_training_program(self):
+        def build():
+            ids = layers.data("ids", shape=[12], dtype="int64")
+            emb = layers.embedding(ids, size=[50, 8])
+            proj = layers.fc(emb, size=4 * 16, num_flatten_dims=2)
+            h_seq, _ = layers.dynamic_lstm(proj, size=4 * 16)
+            pooled = layers.sequence_pool(h_seq, pool_type="max")
+            logits = layers.fc(pooled, size=2, act="softmax")
+            label = layers.data("label", shape=[1], dtype="int64")
+            loss = layers.mean(layers.cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            return loss
+
+        main, startup, loss = _build(build)
+        self._check(main, startup, ["ids", "label"], [loss.name])
+
+    def test_ctr_wide_deep_training_program(self):
+        def build():
+            ids = layers.data("ids", shape=[5], dtype="int64")
+            dense = layers.data("dense", shape=[4], dtype="float32")
+            label = layers.data("label", shape=[1], dtype="float32")
+            logit = models.wide_deep(ids, dense, vocab_size=1000,
+                                     embed_dim=8)
+            loss, prob = models.wide_deep_loss(logit, label)
+            pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+            return loss, prob
+
+        main, startup, (loss, prob) = _build(build)
+        self._check(main, startup, ["ids", "dense", "label"],
+                    [loss.name, prob.name])
+
+    def test_recompute_segment_program(self):
+        """seg_fwd/grad_seg special ops go through the abstract
+        handlers, not jax.eval_shape."""
+        def build():
+            img = layers.data("img", shape=[8, 8, 3], dtype="float32")
+            with pt.recompute_guard():
+                y = layers.fc(layers.reshape(img, shape=[-1, 192]),
+                              size=32, act="relu")
+            logits = layers.fc(y, size=10)
+            label = layers.data("label", shape=[1], dtype="int64")
+            loss = layers.mean(
+                layers.cross_entropy(layers.softmax(logits), label))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            return loss
+
+        main, startup, loss = _build(build)
+        assert any(op.type == "seg_fwd" for op in main.global_block.ops)
+        self._check(main, startup, ["img", "label"], [loss.name])
+
+    def test_generation_program(self):
+        def build():
+            prompt = layers.data("prompt", shape=[8], dtype="int64")
+            return models.transformer_lm_generate(
+                prompt, vocab_size=97, d_model=32, n_layers=2,
+                num_heads=4, max_len=32, max_new_tokens=8)
+
+        main, startup, out_ids = _build(build)
+        self._check(main, startup, ["prompt"], [out_ids.name])
+
+
+# ==========================================================================
+# Pinned failure modes: located build-time errors, not JAX trace errors
+# ==========================================================================
+class TestLocatedErrors:
+    def test_declared_shape_mismatch_names_op_slot_callsite(self):
+        """Acceptance pin: a shape-mismatched program fails at build
+        time with op index + callsite + slot in the message."""
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[-1, 4], dtype="float32",
+                     is_data=True)
+        b.create_parameter(name="w", shape=[4, 10], dtype="float32")
+        b.create_var(name="y", shape=[-1, 8], dtype="float32")  # wrong
+        b.append_op("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]},
+                    {"_callsite": "model.py:42"})
+        with pytest.raises(analysis.ProgramCheckError) as ei:
+            analysis.infer_program(main, ["x"], ["y"])
+        msg = str(ei.value)
+        assert "op #0" in msg and "'mul'" in msg
+        assert "model.py:42" in msg
+        assert "'Out'" in msg and "'y'" in msg
+        assert "(-1, 10)" in msg and "(-1, 8)" in msg
+        assert ei.value.op_index == 0 and ei.value.slot == "Out"
+
+    def test_kernel_rejection_is_located(self):
+        """An op whose kernel rejects its abstract inputs reports the op
+        context and input signatures, not a bare JAX error."""
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[-1, 4], dtype="float32",
+                     is_data=True)
+        b.create_parameter(name="w", shape=[5, 10], dtype="float32")
+        b.create_var(name="y", shape=None, dtype="float32")
+        b.append_op("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]},
+                    {"_callsite": "model.py:7"})
+        with pytest.raises(analysis.ProgramCheckError) as ei:
+            analysis.infer_program(main, ["x"], ["y"])
+        msg = str(ei.value)
+        assert "shape inference failed" in msg
+        assert "op #0" in msg and "model.py:7" in msg
+        assert "inputs:" in msg
+
+    def test_dangling_input_is_located(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="mid", shape=[-1, 4], dtype="float32")
+        b.create_var(name="y", shape=None, dtype="float32")
+        b.append_op("relu", {"X": ["mid"]}, {"Out": ["y"]})
+        with pytest.raises(analysis.ProgramCheckError) as ei:
+            analysis.infer_program(main, [], ["y"])
+        assert "produced by no earlier op" in str(ei.value)
+        assert ei.value.var == "mid"
+
+    def test_annotation_fills_unknown_shapes(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[-1, 4], dtype="float32",
+                     is_data=True)
+        y = b.create_var(name="y", shape=None, dtype="float32")
+        b.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+        analysis.infer_program(main, ["x"], ["y"], annotate=True)
+        assert y.shape == (-1, 4)
+
+
+# ==========================================================================
+# Structural verifier rules
+# ==========================================================================
+class TestVerifierRules:
+    def _lint(self, program, feeds=(), fetches=(), scope=None, rules=None):
+        return analysis.run_lint(program, feeds, fetches, scope=scope,
+                                 rules=rules)
+
+    def test_unknown_op(self):
+        main = pt.Program()
+        main.global_block.append_op("definitely_not_an_op", {}, {})
+        issues = self._lint(main, rules=["unknown-op"])
+        assert issues and issues[0].severity == analysis.ERROR
+
+    def test_use_before_def_error_for_declared_var(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="mid", shape=[4], dtype="float32")
+        b.create_var(name="y", shape=[4], dtype="float32")
+        b.append_op("relu", {"X": ["mid"]}, {"Out": ["y"]})
+        with pytest.raises(analysis.ProgramVerifyError) as ei:
+            analysis.verify_program(main, [], ["y"])
+        assert ei.value.issues[0].rule == "use-before-def"
+
+    def test_duplicate_output_within_one_op(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[4], dtype="float32")
+        b.append_op("topk", {"X": ["x"]}, {"Out": ["y"], "Indices": ["y"]})
+        issues = self._lint(main, ["x"], ["y"],
+                            rules=["duplicate-output"])
+        assert issues and issues[0].severity == analysis.ERROR
+
+    def test_dead_output_warns_only_when_whole_op_dead(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[4], dtype="float32")
+        b.create_var(name="z", shape=[4], dtype="float32")
+        b.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+        b.append_op("tanh", {"X": ["x"]}, {"Out": ["z"]})
+        issues = self._lint(main, ["x"], ["y"], rules=["dead-output"])
+        assert len(issues) == 1
+        assert issues[0].severity == analysis.WARNING
+        assert issues[0].op_type == "tanh"
+
+    def test_optional_input_contract(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[4], dtype="float32")
+        op = b.append_op("relu", {"X": ["x"]}, {"Out": ["y"]})
+        op.inputs["Mystery"] = []  # empty, undeclared-optional slot
+        issues = self._lint(main, ["x"], ["y"],
+                            rules=["optional-input-contract"])
+        assert issues and issues[0].slot == "Mystery"
+
+    def test_rng_determinism_lint(self):
+        def build():
+            x = layers.data("x", shape=[4], dtype="float32")
+            return layers.dropout(x, dropout_prob=0.5)
+
+        main, startup, y = _build(build)
+        issues = self._lint(main, ["x"], [y.name], rules=["rng-no-seed"])
+        assert issues and issues[0].severity == analysis.WARNING
+        main.random_seed = 7
+        assert not self._lint(main, ["x"], [y.name],
+                              rules=["rng-no-seed"])
+
+    def test_fetch_donated_state_hazard(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_parameter(name="p", shape=[4], dtype="float32")
+        b.create_var(name="g", shape=[4], dtype="float32", is_data=True)
+        b.append_op("elementwise_add", {"X": ["p"], "Y": ["g"]},
+                    {"Out": ["p"]})
+        issues = self._lint(main, ["g"], ["p"],
+                            rules=["fetch-donated-state"])
+        assert issues and "donat" in issues[0].message
+
+    def test_fetch_never_produced(self):
+        main = pt.Program()
+        with pytest.raises(analysis.ProgramVerifyError):
+            analysis.verify_program(main, [], ["ghost"])
+
+    def test_async_overlap_check(self):
+        def prog():
+            p = pt.Program()
+            b = p.global_block
+            b.create_parameter(name="shared", shape=[4], dtype="float32")
+            b.create_var(name="x", shape=[4], dtype="float32",
+                         is_data=True)
+            b.append_op("elementwise_add", {"X": ["shared"], "Y": ["x"]},
+                        {"Out": ["shared"]})
+            return p
+
+        issues = analysis.check_async_overlap(
+            [(prog(), ["x"], []), (prog(), ["x"], [])])
+        assert issues and "shared" in issues[0].message
+        assert not analysis.check_async_overlap([(prog(), ["x"], [])])
+
+    def test_custom_rule_registry_mirrors_pass_registry(self):
+        class NoTanh(analysis.LintRule):
+            name = "no-tanh-test-rule"
+
+            def check(self, program, ctx):
+                for block in program.blocks:
+                    for i, op in enumerate(block.ops):
+                        if op.type == "tanh":
+                            yield analysis.LintIssue(
+                                rule=self.name,
+                                severity=analysis.WARNING,
+                                message="tanh is banned here",
+                                op_index=i, op_type="tanh")
+
+        analysis.register_rule(NoTanh)
+        try:
+            assert "no-tanh-test-rule" in analysis.registered_rules()
+            main = pt.Program()
+            b = main.global_block
+            b.create_var(name="x", shape=[4], dtype="float32",
+                         is_data=True)
+            b.create_var(name="y", shape=[4], dtype="float32")
+            b.append_op("tanh", {"X": ["x"]}, {"Out": ["y"]})
+            issues = analysis.run_lint(main, ["x"], ["y"],
+                                       rules=["no-tanh-test-rule"])
+            assert len(issues) == 1 and issues[0].op_type == "tanh"
+        finally:
+            from paddle_tpu.analysis import lint as lint_mod
+
+            lint_mod._RULE_REGISTRY.pop("no-tanh-test-rule", None)
+
+    def test_verify_program_with_scope_accepts_scope_state(self):
+        """Scope-resident state (KV caches) resolves inputs the program
+        itself never declares."""
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[4], dtype="float32")
+        b.append_op("elementwise_add", {"X": ["x"], "Y": ["cache"]},
+                    {"Out": ["y"]})
+        scope = pt.Scope()
+        scope.set("cache", np.zeros([4], np.float32))
+        analysis.verify_program(main, ["x"], ["y"], scope=scope)
+        with pytest.raises(analysis.ProgramVerifyError):
+            analysis.verify_program(main, ["x"], ["y"], scope=pt.Scope())
+
+
+# ==========================================================================
+# Registry satellites
+# ==========================================================================
+class TestRegistrySatellites:
+    def test_get_op_error_truncates_and_suggests(self):
+        with pytest.raises(KeyError) as ei:
+            registry.get_op("softmax_with_crossentropy")
+        msg = str(ei.value)
+        assert "did you mean" in msg
+        assert "softmax_with_cross_entropy" in msg
+        # the full registry (hundreds of names) is NOT dumped
+        assert len(msg) < 600
+        assert "registered_ops()" in msg
+
+    def test_infer_outputs_memoized_with_counters(self):
+        import jax
+        import jax.numpy as jnp
+
+        registry.clear_infer_cache()
+        sds = jax.ShapeDtypeStruct((3, 5), jnp.float32)
+        r1 = registry.infer_outputs("relu", {}, {"X": [sds]})
+        r2 = registry.infer_outputs("relu", {}, {"X": [sds]})
+        assert r1["Out"][0].shape == (3, 5)
+        assert r2["Out"][0].shape == (3, 5)
+        stats = registry.infer_cache_stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+        # callsite metadata must not split cache entries
+        registry.infer_outputs("relu", {"_callsite": "a.py:1"},
+                               {"X": [sds]})
+        assert registry.infer_cache_stats()["hits"] == 2
+        # different signature is a distinct entry
+        registry.infer_outputs(
+            "relu", {}, {"X": [jax.ShapeDtypeStruct((7,), jnp.float32)]})
+        assert registry.infer_cache_stats()["misses"] == 2
+
+    def test_infer_cache_counters_in_profiler_statset(self):
+        from paddle_tpu import profiler
+
+        import jax
+        import jax.numpy as jnp
+
+        profiler.global_stat.reset()
+        registry.clear_infer_cache()
+        sds = jax.ShapeDtypeStruct((2, 2), jnp.float32)
+        registry.infer_outputs("tanh", {}, {"X": [sds]})
+        registry.infer_outputs("tanh", {}, {"X": [sds]})
+        names = [row[0] for row in profiler.global_stat.table()]
+        assert "registry/infer_cache/hit" in names
+        assert "registry/infer_cache/miss" in names
+        assert profiler.global_stat.kind_of(
+            "registry/infer_cache/hit") == "count"
+
+    def test_layer_build_reuses_cache(self):
+        registry.clear_infer_cache()
+
+        def build():
+            x = layers.data("x", shape=[16], dtype="float32")
+            h = x
+            for _ in range(4):  # identical signatures -> cache hits
+                h = layers.fc(h, size=16, act="relu")
+            return h
+
+        _build(build)
+        stats = registry.infer_cache_stats()
+        assert stats["hits"] > 0
+
+    def test_mutating_cached_result_does_not_poison_cache(self):
+        import jax
+        import jax.numpy as jnp
+
+        registry.clear_infer_cache()
+        sds = jax.ShapeDtypeStruct((3,), jnp.float32)
+        r1 = registry.infer_outputs("relu", {}, {"X": [sds]})
+        r1["Out"].append("garbage")
+        r1["Extra"] = ["junk"]
+        r2 = registry.infer_outputs("relu", {}, {"X": [sds]})
+        assert list(r2.keys()) == ["Out"] and len(r2["Out"]) == 1

@@ -1,0 +1,172 @@
+"""Pass-sandwich verification: PassManager(verify_each=True) re-verifies
+the program after every pass, naming the exact pass that broke it, and
+runs clean over every registered pipeline on the smoke models."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, flags, layers, models, profiler, transpiler
+
+
+@pytest.fixture(scope="module")
+def resnet_smoke():
+    """(program, scope, feeds, fetches) — built and initialized ONCE;
+    tests run pipelines on clones."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", shape=[16, 16, 3], dtype="float32")
+        logits = models.resnet_cifar10(img, num_classes=10, depth=20)
+        sm = layers.softmax(logits)
+    scope = pt.Scope()
+    pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+    return main, scope, ["img"], [sm.name]
+
+
+@pytest.fixture(scope="module")
+def transformer_smoke():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[8], dtype="int64")
+        logits = models.transformer_lm(ids, vocab_size=50, d_model=16,
+                                       n_layers=1, num_heads=2, max_len=16)
+    scope = pt.Scope()
+    pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+    return main, scope, ["ids"], [logits.name]
+
+
+class BrokenDropProducer(transpiler.Pass):
+    """Deliberately-broken rewrite: silently drops a producer op whose
+    output is still consumed downstream."""
+
+    name = "broken_drop_producer"
+
+    def apply(self, program, ctx):
+        b = program.global_block
+        consumed = set()
+        for op in b.ops:
+            consumed.update(op.input_names())
+        for op in b.ops:
+            if any(n in consumed for n in op.output_names()):
+                b.remove_ops([op])
+                return
+
+
+class TestPassSandwich:
+    def test_broken_pass_is_named(self, resnet_smoke):
+        main, scope, feeds, fetches = resnet_smoke
+        pm = transpiler.PassManager([BrokenDropProducer()],
+                                    verify_each=True)
+        with pytest.raises(transpiler.PassVerificationError) as ei:
+            pm.run(main.clone(), feeds, fetches,
+                   scope=pt.Scope(parent=scope))
+        assert "broken_drop_producer" in str(ei.value)
+        assert ei.value.pass_name == "broken_drop_producer"
+        assert isinstance(ei.value.__cause__,
+                          analysis.ProgramVerifyError)
+
+    def test_broken_input_program_not_blamed_on_first_pass(self):
+        main = pt.Program()
+        b = main.global_block
+        b.create_var(name="mid", shape=[4], dtype="float32")
+        b.create_var(name="y", shape=[4], dtype="float32")
+        b.append_op("relu", {"X": ["mid"]}, {"Out": ["y"]})
+        pm = transpiler.PassManager([transpiler.DeadOpElimination()],
+                                    verify_each=True)
+        with pytest.raises(analysis.ProgramVerifyError):
+            pm.run(main, [], ["y"])
+
+    @pytest.mark.parametrize("smoke", ["resnet", "transformer"])
+    def test_all_registered_pipelines_verify_clean(self, smoke,
+                                                   resnet_smoke,
+                                                   transformer_smoke):
+        """Acceptance: verify_each runs clean over every named pipeline
+        on the resnet and transformer smoke programs."""
+        main, scope, feeds, fetches = (
+            resnet_smoke if smoke == "resnet" else transformer_smoke)
+        pipelines = {
+            "prune": transpiler.prune_pipeline,
+            "inference": transpiler.inference_pipeline,
+            "training": transpiler.training_pipeline,
+            "deployment": transpiler.deployment_pipeline,
+        }
+        for name, pipe in pipelines.items():
+            pm = pipe(verify_each=True)
+            pm.run(main.clone(), feeds, fetches,
+                   scope=pt.Scope(parent=scope))
+            assert pm.results, name
+
+    def test_verify_walltime_in_pass_stats(self, transformer_smoke):
+        main, scope, feeds, fetches = transformer_smoke
+        stat = profiler.StatSet()
+        pm = transpiler.inference_pipeline(verify_each=True,
+                                           stat_set=stat)
+        pm.run(main.clone(), feeds, fetches, scope=pt.Scope(parent=scope))
+        assert all(r.verify_seconds > 0 for r in pm.results)
+        rows = pm.stats()
+        assert all("verify_ms" in r and r["verify_ms"] > 0 for r in rows)
+        names = [row[0] for row in stat.table()]
+        assert "transpiler/verify/<input>" in names
+        assert any(n.startswith("transpiler/verify/")
+                   and n != "transpiler/verify/<input>" for n in names)
+        assert "verify ms" in pm.format_stats()
+        assert pm.metrics_dict()["transpile/verify_ms"] > 0
+
+    def test_verify_off_by_default_and_costs_nothing(self,
+                                                      transformer_smoke):
+        main, scope, feeds, fetches = transformer_smoke
+        pm = transpiler.inference_pipeline()
+        pm.run(main.clone(), feeds, fetches, scope=pt.Scope(parent=scope))
+        assert all(r.verify_seconds == 0 for r in pm.results)
+
+    def test_verify_program_flag_turns_sandwich_on(self, resnet_smoke):
+        main, scope, feeds, fetches = resnet_smoke
+        flags.FLAGS.verify_program = True
+        try:
+            pm = transpiler.PassManager([BrokenDropProducer()])
+            with pytest.raises(transpiler.PassVerificationError):
+                pm.run(main.clone(), feeds, fetches,
+                       scope=pt.Scope(parent=scope))
+        finally:
+            flags.FLAGS.verify_program = False
+
+    def test_verify_program_flag_guards_sgd_build(self):
+        flags.FLAGS.verify_program = True
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", shape=[4], dtype="float32")
+                y = layers.fc(x, size=2)
+                label = layers.data("label", shape=[1], dtype="int64")
+                loss = layers.mean(
+                    layers.cross_entropy(layers.softmax(y), label))
+                # corrupt the program: drop the fc mul's producer chain
+                b = main.global_block
+                b.remove_ops([op for op in b.ops if op.type == "mul"])
+                with pytest.raises(analysis.ProgramVerifyError):
+                    pt.trainer.SGD(
+                        cost=loss,
+                        optimizer=pt.optimizer.SGDOptimizer(
+                            learning_rate=0.1),
+                        feed_list=[x, label], place=pt.CPUPlace(),
+                        scope=pt.Scope())
+        finally:
+            flags.FLAGS.verify_program = False
+
+    def test_save_inference_model_verifies_under_flag(self, tmp_path):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            out = layers.fc(x, size=3, act="softmax")
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        flags.FLAGS.verify_program = True
+        try:
+            pt.io.save_inference_model(
+                str(tmp_path / "m"), ["x"], [out], exe,
+                main_program=main, scope=scope)
+        finally:
+            flags.FLAGS.verify_program = False
+        prog, feeds, fetches = pt.io.load_inference_model(
+            str(tmp_path / "m"), exe, scope=scope)
+        assert feeds == ["x"]

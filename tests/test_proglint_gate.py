@@ -1,0 +1,103 @@
+"""CI lint gate: tools/proglint.py must run clean over the demo program
+topologies (quick_start, serving_lm) and the op-registry audit, exit
+nonzero on a corrupted saved inference model, and clean on a fresh one.
+New verifier errors in the demos fail tier-1 here."""
+import importlib.util
+import json
+import os
+import shutil
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _proglint():
+    spec = importlib.util.spec_from_file_location(
+        "proglint", os.path.join(_REPO, "tools", "proglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def proglint():
+    return _proglint()
+
+
+def _save_model(tmpdir):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=8, act="relu")
+        out = layers.fc(y, size=3, act="softmax")
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    model_dir = os.path.join(str(tmpdir), "model")
+    pt.io.save_inference_model(model_dir, ["x"], [out], exe,
+                               main_program=main, scope=scope)
+    return model_dir
+
+
+def test_demo_programs_lint_clean(proglint, capsys):
+    """The gate: new verifier ERRORS in the demo topologies fail tier-1.
+    (Warnings — e.g. unseeded random init — do not.)"""
+    rc = proglint.main(["--demo", "quick_start", "--demo", "serving_lm",
+                        "--audit", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["errors"] == 0
+    tags = [t["target"] for t in out["targets"]]
+    assert any("quick_start" in t for t in tags)
+    assert any("serving_lm" in t for t in tags)
+    assert "<op-registry-audit>" in tags
+
+
+def test_fresh_saved_model_lints_clean(proglint, tmp_path, capsys):
+    model_dir = _save_model(tmp_path)
+    rc = proglint.main([model_dir])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_corrupted_saved_model_exits_nonzero(proglint, tmp_path, capsys):
+    """Acceptance pin: proglint exits nonzero on a corrupted artifact."""
+    model_dir = _save_model(tmp_path)
+    bad_dir = os.path.join(str(tmp_path), "bad")
+    shutil.copytree(model_dir, bad_dir)
+    mpath = os.path.join(bad_dir, "__model__.json")
+    with open(mpath) as f:
+        payload = json.load(f)
+    del payload["program"]["blocks"][0]["ops"][0]  # drop a producer
+    with open(mpath, "w") as f:
+        json.dump(payload, f)
+    rc = proglint.main([bad_dir])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "use-before-def" in out
+
+
+def test_unknown_op_in_saved_model_exits_nonzero(proglint, tmp_path,
+                                                 capsys):
+    model_dir = _save_model(tmp_path)
+    bad_dir = os.path.join(str(tmp_path), "badop")
+    shutil.copytree(model_dir, bad_dir)
+    mpath = os.path.join(bad_dir, "__model__.json")
+    with open(mpath) as f:
+        payload = json.load(f)
+    payload["program"]["blocks"][0]["ops"][0]["type"] = "not_a_real_op"
+    with open(mpath, "w") as f:
+        json.dump(payload, f)
+    rc = proglint.main([bad_dir])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unknown-op" in out
+
+
+def test_unreadable_target_is_a_lint_failure(proglint, tmp_path, capsys):
+    rc = proglint.main([str(tmp_path / "does_not_exist")])
+    assert rc == 1
+    assert "load-failure" in capsys.readouterr().out

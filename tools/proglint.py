@@ -1,0 +1,218 @@
+"""proglint — static analysis over built programs and saved models.
+
+Runs the paddle_tpu.analysis battery (structural program verifier,
+whole-program shape/dtype inference, lint rules) over saved inference
+models and/or the demo program topologies, plus the op-registry
+conformance audit. Exits nonzero when any error-severity finding
+survives — the CI lint gate (tests/test_proglint_gate.py) pins this.
+
+Usage (repo root, CPU backend):
+
+    JAX_PLATFORMS=cpu python tools/proglint.py MODEL_DIR [MODEL_DIR ...]
+    JAX_PLATFORMS=cpu python tools/proglint.py --demo quick_start \
+                                               --demo serving_lm
+    JAX_PLATFORMS=cpu python tools/proglint.py --audit
+    ... [--no-shapes] [--json] [--warnings-as-errors] [--rules r1,r2]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEMOS = ("quick_start", "serving_lm")
+
+
+# --------------------------------------------------------------------------
+# Targets: each yields (tag, program, feed_names, fetch_names, scope)
+# --------------------------------------------------------------------------
+def load_saved_model(dirname: str):
+    from paddle_tpu import io as io_mod
+    from paddle_tpu.io import program_from_dict, read_inference_model_meta
+
+    payload = read_inference_model_meta(dirname)
+    program = program_from_dict(payload["program"])
+    scope = None
+    if os.path.isdir(os.path.join(dirname, "params")):
+        scope = io_mod._load_saved_params(dirname)
+    yield (dirname, program, payload["feed_names"], payload["fetch_names"],
+           scope)
+
+
+def _import_demo_module(name: str):
+    import importlib.util
+
+    path = os.path.join(REPO, "demos", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"demos.{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_demo(name: str):
+    """Build the named demo's program topologies (no training, no data)
+    and yield lint targets — the same graphs the demo scripts train and
+    serve, constructed through the demo's own builder where it has one."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    if name == "quick_start":
+        qs = _import_demo_module("quick_start")
+        for config in ("lr", "cnn", "lstm"):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                cost, _output = qs.build(config, word_dim=1000)
+            feeds = [v.name for v in main.global_block.vars.values()
+                     if v.is_data]
+            yield (f"quick_start[{config}]", main, feeds, [cost.name], None)
+            yield (f"quick_start[{config}]/startup", startup, [], [], None)
+    elif name == "serving_lm":
+        # the demo's two programs: the training step and the frozen
+        # KV-cache generation graph it saves for the serving engine
+        T = 16
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            tgt = layers.data("tgt", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=97, d_model=32, n_layers=2, num_heads=4,
+                max_len=64, pipeline_stack=True)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, 97]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(
+                loss, startup_program=startup)
+        yield ("serving_lm[train]", main, ["ids", "tgt"], [loss.name], None)
+        yield ("serving_lm[train]/startup", startup, [], [], None)
+        gen, gen_startup = pt.Program(), pt.Program()
+        with pt.program_guard(gen, gen_startup):
+            prompt = layers.data("prompt", shape=[8], dtype="int64")
+            out_ids = models.transformer_lm_generate(
+                prompt, vocab_size=97, d_model=32, n_layers=2, num_heads=4,
+                max_len=64, max_new_tokens=8)
+        yield ("serving_lm[generate]", gen, ["prompt"], [out_ids.name],
+               None)
+    else:
+        raise SystemExit(f"unknown --demo {name!r} (have: {DEMOS})")
+
+
+# --------------------------------------------------------------------------
+def lint_target(tag, program, feed_names, fetch_names, scope,
+                check_shapes: bool, rules: Optional[List[str]]):
+    """Returns (issues, fatal): lint findings plus any checker error
+    (already located) surfaced as an error-severity issue."""
+    from paddle_tpu import analysis
+
+    issues = analysis.run_lint(program, feed_names, fetch_names,
+                               scope=scope, rules=rules)
+    if check_shapes and not any(i.severity == analysis.ERROR
+                                for i in issues):
+        try:
+            result = analysis.infer_program(program, feed_names,
+                                            fetch_names, scope=scope,
+                                            annotate=False)
+            issues.extend(result.issues)
+        except analysis.ProgramCheckError as exc:
+            issues.append(analysis.LintIssue(
+                rule="shape-check", severity=analysis.ERROR,
+                message=str(exc), block_idx=exc.block_idx,
+                op_index=exc.op_index, op_type=exc.op_type,
+                callsite=exc.callsite, slot=exc.slot, var=exc.var))
+    return issues
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model_dirs", nargs="*",
+                    help="save_inference_model directories to lint")
+    ap.add_argument("--demo", action="append", default=[],
+                    choices=list(DEMOS),
+                    help="lint a demo's program topologies (repeatable)")
+    ap.add_argument("--audit", action="store_true",
+                    help="run the op-registry conformance audit")
+    ap.add_argument("--no-shapes", action="store_true",
+                    help="structural rules only (skip whole-program "
+                         "shape/dtype inference)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated lint rule subset (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--warnings-as-errors", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+    if not args.model_dirs and not args.demo and not args.audit:
+        ap.error("nothing to lint: give MODEL_DIR(s), --demo, or --audit")
+
+    from paddle_tpu import analysis
+
+    rules = args.rules.split(",") if args.rules else None
+    report = []
+    n_errors = n_warnings = 0
+
+    targets = []
+    for d in args.model_dirs:
+        targets.append(("model", d))
+    for d in args.demo:
+        targets.append(("demo", d))
+
+    for kind, name in targets:
+        try:
+            gen = (load_saved_model(name) if kind == "model"
+                   else build_demo(name))
+            entries = list(gen)
+        except Exception as exc:
+            # unreadable/corrupted artifact: that IS a lint failure
+            issue = analysis.LintIssue(
+                rule="load-failure", severity=analysis.ERROR,
+                message=f"{type(exc).__name__}: {exc}")
+            report.append((f"{name}", [issue]))
+            n_errors += 1
+            continue
+        for tag, program, feeds, fetches, scope in entries:
+            issues = lint_target(tag, program, feeds, fetches, scope,
+                                 check_shapes=not args.no_shapes,
+                                 rules=rules)
+            n_errors += sum(i.severity == analysis.ERROR for i in issues)
+            n_warnings += sum(i.severity == analysis.WARNING
+                              for i in issues)
+            report.append((tag, issues))
+
+    if args.audit:
+        issues = analysis.audit_op_registry()
+        n_errors += sum(i.severity == analysis.ERROR for i in issues)
+        n_warnings += sum(i.severity == analysis.WARNING for i in issues)
+        report.append(("<op-registry-audit>", issues))
+
+    if args.as_json:
+        print(json.dumps(
+            {"targets": [{"target": tag,
+                          "issues": [i.as_dict() for i in issues]}
+                         for tag, issues in report],
+             "errors": n_errors, "warnings": n_warnings}, indent=1))
+    else:
+        for tag, issues in report:
+            status = ("clean" if not issues else
+                      f"{sum(i.severity == analysis.ERROR for i in issues)}"
+                      f" error(s), "
+                      f"{sum(i.severity == analysis.WARNING for i in issues)}"
+                      f" warning(s)")
+            print(f"== {tag}: {status}")
+            for i in issues:
+                print("   " + i.format())
+        print(f"proglint: {n_errors} error(s), {n_warnings} warning(s) "
+              f"over {len(report)} target(s)")
+
+    if n_errors or (args.warnings_as_errors and n_warnings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
