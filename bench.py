@@ -646,6 +646,88 @@ def bench_checkpoint(jax, pt, layers, batch=64, dim=512, steps=24, every=4,
     }
 
 
+def bench_memplan(jax, pt, layers, models, batch=8, hw=32):
+    """Static memory/roofline estimator vs XLA ground truth: for the
+    resnet50 and transformer train-step programs, measure (a) the
+    analyzer's wall time (it must stay a build-time cost, not a compile-
+    scale one) and (b) estimated HBM bytes vs the compiled computation's
+    ``cost_analysis()['bytes accessed']`` — the drift metric that keeps
+    the cost model honest release over release (PERF.md pins the
+    ResNet-50 bs256 figure at 78.4 GB)."""
+    import numpy as np
+
+    from paddle_tpu import analysis
+
+    def cost_analysis_bytes(exe, prog, feed, fetches, scope):
+        fn, args = exe.as_function(prog, feed, fetches, scope=scope)
+        compiled = jax.jit(fn).lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("bytes accessed", 0.0))
+
+    def one(name, build):
+        prog, startup, loss, feed = build()
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup, scope=scope)
+        t0 = time.perf_counter()
+        mem = analysis.analyze_memory(prog, list(feed), [loss.name],
+                                      scope=scope, batch_size=batch)
+        est_wall = time.perf_counter() - t0
+        actual = cost_analysis_bytes(exe, prog, feed, [loss], scope)
+        est = mem.total_hbm_bytes
+        return {
+            "estimator_ms": round(est_wall * 1e3, 2),
+            "ops": len(prog.global_block.ops),
+            "est_bytes": round(est),
+            "cost_analysis_bytes": round(actual),
+            "est_over_actual": (round(est / actual, 3) if actual else None),
+            "peak_bytes": round(mem.peak_bytes),
+            "est_step_ms": round(mem.estimated_step_seconds() * 1e3, 3),
+        }
+
+    rng = np.random.RandomState(0)
+
+    def build_resnet():
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            images = layers.data("images", shape=[hw, hw, 3])
+            label = layers.data("label", shape=[1], dtype="int64")
+            logits = models.resnet_imagenet(images, num_classes=100,
+                                            depth=50)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9).minimize(
+                loss, startup_program=startup)
+        feed = {"images": rng.rand(batch, hw, hw, 3).astype("float32"),
+                "label": rng.randint(0, 100, size=(batch, 1))
+                .astype("int64")}
+        return prog, startup, loss, feed
+
+    def build_transformer():
+        T, V = 64, 512
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            ids = layers.data("ids", shape=[T], dtype="int64")
+            tgt = layers.data("tgt", shape=[T], dtype="int64")
+            logits = models.transformer_lm(
+                ids, vocab_size=V, d_model=128, n_layers=2, num_heads=4,
+                max_len=T)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, V]),
+                layers.reshape(tgt, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(
+                loss, startup_program=startup)
+        feed = {"ids": rng.randint(0, V, size=(batch, T)).astype("int64"),
+                "tgt": rng.randint(0, V, size=(batch, T)).astype("int64")}
+        return prog, startup, loss, feed
+
+    return {"resnet50": one("resnet50", build_resnet),
+            "transformer": one("transformer", build_transformer)}
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -805,6 +887,7 @@ def assemble(rows, parent_notes=None):
         "trace_overhead": res("trace_overhead"),
         "train_pipeline": res("train_pipeline"),
         "checkpoint": res("checkpoint"),
+        "memplan": res("memplan"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -963,6 +1046,10 @@ def run_bench(platform):
              models)
         step("train_pipeline", bench_train_pipeline, jax, pt, layers)
         step("checkpoint", bench_checkpoint, jax, pt, layers)
+    # static estimator vs cost_analysis: cheap enough to run everywhere
+    # (CPU row is the path-works witness, TPU row rides the sweep)
+    step("memplan", bench_memplan, jax, pt, layers, models,
+         batch=batch if on_tpu else 8, hw=hw if on_tpu else 32)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

@@ -33,9 +33,14 @@ from __future__ import annotations
 from .checker import (ProgramAnalysis, ProgramCheckError, SPECIAL_HANDLERS,
                       check_program, infer_program)
 from .conformance import audit_op, audit_op_registry
+from .costmodel import (OpCost, cost_exempt, has_cost, is_cost_exempt,
+                        op_cost, register_cost)
 from .lint import (ERROR, WARNING, LintContext, LintIssue, LintRule,
                    format_issues, get_rule, register_rule, registered_rules,
                    run_lint)
+from .memory import (LiveTensor, MemoryAnalysis, MemoryBudgetError,
+                     RematAdvice, advise_recompute, analyze_memory,
+                     check_memory_budget)
 from .verifier import (ProgramVerifyError, check_async_overlap,
                        verify_program, written_state_names)
 
@@ -46,4 +51,9 @@ __all__ = [
     "register_rule", "get_rule", "registered_rules", "format_issues",
     "audit_op", "audit_op_registry", "written_state_names",
     "check_async_overlap", "SPECIAL_HANDLERS",
+    # memory & roofline plane
+    "MemoryAnalysis", "MemoryBudgetError", "LiveTensor", "RematAdvice",
+    "analyze_memory", "check_memory_budget", "advise_recompute",
+    "OpCost", "register_cost", "cost_exempt", "has_cost",
+    "is_cost_exempt", "op_cost",
 ]

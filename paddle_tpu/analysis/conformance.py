@@ -92,6 +92,23 @@ def audit_op(op_type: str) -> List[LintIssue]:
             "asserts vjp-of-forward is still valid ALONGSIDE a custom "
             "grad — with no grad_fn it is meaningless)"))
 
+    # cost-model coverage contract (applies to special ops too): every
+    # op carries an analytical cost handler (costmodel.register_cost) or
+    # an explicit cost_exempt marker — the roofline/memory plane must
+    # never meet an op it silently cannot price
+    from . import costmodel
+
+    costmodel.ensure_registered()
+    if opdef.cost_fn is None and not opdef.cost_exempt:
+        issues.append(_op_issue(
+            op_type, ERROR,
+            "no cost-model handler registered and not cost_exempt: add "
+            "a handler via analysis.costmodel.register_cost (FLOPs + "
+            "HBM bytes from the abstract input/output shapes) or mark "
+            "it analysis.costmodel.cost_exempt with a reason"))
+    if opdef.cost_fn is not None and not callable(opdef.cost_fn):
+        issues.append(_op_issue(op_type, ERROR, "cost_fn is not callable"))
+
     if opdef.special:
         return issues  # executor-trace calling convention: nothing below
     # applies (special kernels take executor/env/op kwargs)

@@ -119,15 +119,34 @@ def registered_rules() -> List[str]:
 def run_lint(program: Program, feed_names: Sequence[str] = (),
              fetch_names: Sequence[str] = (),
              scope: Optional[Scope] = None,
-             rules: Optional[Sequence] = None) -> List[LintIssue]:
+             rules: Optional[Sequence] = None, *,
+             warnings_as_errors: bool = False,
+             severity: Optional[str] = None) -> List[LintIssue]:
     """Run a rule battery (default: every registered rule) and return
-    every issue found, errors first."""
+    every issue found, errors first.
+
+    Programmatic callers get the same contract as the ``tools/proglint``
+    CLI flags: ``warnings_as_errors`` promotes every warning finding to
+    error severity (the returned issues carry ``severity="error"``, so
+    downstream gates that branch on severity fail exactly as the CLI
+    would exit nonzero); ``severity`` filters the returned issues to one
+    level (``"error"`` or ``"warning"``, applied BEFORE promotion so
+    ``severity="warning"`` still selects the promoted findings).
+    """
+    if severity is not None and severity not in (ERROR, WARNING):
+        raise ValueError(
+            f"severity must be {ERROR!r} or {WARNING!r}, got {severity!r}")
     ctx = LintContext(feed_names, fetch_names, scope=scope)
     battery = [get_rule(r) if isinstance(r, str) else r
                for r in (rules if rules is not None else registered_rules())]
     issues: List[LintIssue] = []
     for rule in battery:
         issues.extend(rule.check(program, ctx))
+    if severity is not None:
+        issues = [i for i in issues if i.severity == severity]
+    if warnings_as_errors:
+        issues = [dataclasses.replace(i, severity=ERROR)
+                  if i.severity == WARNING else i for i in issues]
     issues.sort(key=lambda i: (i.severity != ERROR, i.block_idx,
                                -1 if i.op_index is None else i.op_index))
     return issues

@@ -1,0 +1,595 @@
+"""Liveness & peak-HBM analysis over program blocks.
+
+The static answer to "will this program fit, and if not, which tensors
+are holding the watermark" — computed at BUILD time from the checker's
+inferred ``ShapeDtypeStruct``s (PR 6), before XLA ever sees the program
+or a chip OOMs. The model follows the executor's actual residency rules:
+
+- **resident** values — persistable vars (parameters, optimizer slots),
+  scope state (KV caches), and the feeds — occupy HBM for the whole
+  step;
+- **transient** values live from their producing op to their last
+  consumer (fetches live to the end of the block);
+- **donation/aliasing**: the liveness map is keyed by NAME, so an op
+  writing onto its own input (momentum's in-place param update,
+  batch_norm's MeanOut onto Mean) replaces the buffer instead of
+  double-counting it — exactly what ``donate_argnums`` buys at run time;
+- **recompute segments** (``seg_fwd``/``grad_seg``): interior
+  activations are freed as soon as the forward consumes them; only the
+  checkpoint-policy residuals (matmul/conv outputs + ndim<=1 stats, the
+  ``backward.SEGMENT_SAVE_OPS`` contract) stay live until the paired
+  ``grad_seg``;
+- **stacked scans** (``pipelined_transformer_stack``): the scan body's
+  saved activation planes are ``[L, ...]``-shaped and invisible to
+  name-level liveness — the op's cost handler sizes them per its
+  ``remat`` policy (``residual_bytes``) and they are held live from the
+  forward op to its paired grad op.
+
+``check_memory_budget`` turns the analysis into a gate:
+``SGD.train(mem_budget=...)`` and the serving engines raise a located
+:class:`MemoryBudgetError` naming the peak set and the remat advisor's
+suggestions instead of letting XLA OOM at compile.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import numpy as np
+
+from ..core.enforce import EnforceError
+from ..core.program import BATCH_DIM_SENTINEL, Block, Operator, Program
+from ..core.registry import get_op, has_op, infer_outputs
+from ..core.scope import Scope
+from . import costmodel
+from .checker import infer_program
+from .costmodel import OpCost, V5E_HBM_BW, V5E_PEAK_FLOPS, op_cost
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.2f} TB"
+
+
+@dataclasses.dataclass
+class LiveTensor:
+    """One entry of the live set at the peak: what it is, how big, and
+    which op (and user line) produced it."""
+
+    name: str
+    bytes: float
+    shape: tuple
+    dtype: str
+    kind: str  # "resident" | "activation" | "residual"
+    producer_index: Optional[int] = None
+    producer_type: Optional[str] = None
+    callsite: Optional[str] = None
+
+    def format(self) -> str:
+        where = ""
+        if self.producer_type is not None:
+            where = f"  <- op #{self.producer_index} {self.producer_type!r}"
+            if self.callsite:
+                where += f" (created at {self.callsite})"
+        return (f"{_fmt_bytes(self.bytes):>12}  {self.name}  "
+                f"{tuple(self.shape)} {self.dtype} [{self.kind}]{where}")
+
+
+@dataclasses.dataclass
+class RematAdvice:
+    """One candidate ``recompute_guard`` span, ranked by the peak bytes
+    it would free against the extra HBM traffic + FLOPs the barriered
+    backward recompute would re-stream (the PERF.md round-3 lesson:
+    remat is a memory lever, NOT a bandwidth lever — the advisor prices
+    both sides instead of leaving it folklore)."""
+
+    start: int
+    end: int
+    op_types: List[str]
+    bytes_saved: float
+    extra_traffic_bytes: float
+    extra_flops: float
+    callsite: Optional[str] = None
+
+    @property
+    def net_memory_per_traffic(self) -> float:
+        return self.bytes_saved / max(self.extra_traffic_bytes, 1.0)
+
+    def format(self) -> str:
+        kinds = ", ".join(self.op_types[:5])
+        if len(self.op_types) > 5:
+            kinds += ", ..."
+        site = f" (around {self.callsite})" if self.callsite else ""
+        return (f"recompute_guard ops #{self.start}..#{self.end} "
+                f"[{kinds}]{site}: frees ~{_fmt_bytes(self.bytes_saved)} "
+                f"of peak at +{_fmt_bytes(self.extra_traffic_bytes)} HBM "
+                f"traffic / +{self.extra_flops / 1e9:.1f} GFLOP recompute")
+
+
+class MemoryBudgetError(EnforceError):
+    """The static peak-HBM estimate exceeds the configured budget.
+    Raised at build time — before XLA compiles, allocates, or OOMs —
+    with the peak live set and remat advice attached."""
+
+    def __init__(self, message: str, *, peak_bytes: float,
+                 budget_bytes: float, top: Sequence[LiveTensor] = (),
+                 advice: Sequence[RematAdvice] = ()):
+        super().__init__(message)
+        self.peak_bytes = peak_bytes
+        self.budget_bytes = budget_bytes
+        self.top = list(top)
+        self.advice = list(advice)
+
+
+class MemoryAnalysis:
+    """Result of :func:`analyze_memory`."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self.resident_bytes: float = 0.0
+        self.peak_bytes: float = 0.0
+        self.peak_op_index: Optional[int] = None
+        self.peak_op_type: Optional[str] = None
+        self.peak_live: List[LiveTensor] = []
+        # live bytes DURING each op (outputs allocated, dead inputs not
+        # yet freed) — the watermark curve
+        self.live_at_op: List[float] = []
+        self.op_costs: List[Optional[OpCost]] = []
+        self.op_types: List[str] = []
+        self.total_cost: OpCost = OpCost()
+        self.uncosted_ops: List[str] = []
+
+    # -- summary -----------------------------------------------------------
+    def top(self, n: int = 10) -> List[LiveTensor]:
+        return sorted(self.peak_live, key=lambda t: -t.bytes)[:n]
+
+    @property
+    def total_flops(self) -> float:
+        return self.total_cost.flops
+
+    @property
+    def total_hbm_bytes(self) -> float:
+        return self.total_cost.bytes
+
+    @property
+    def intensity(self) -> float:
+        return self.total_cost.intensity
+
+    def estimated_step_seconds(self, peak_flops: float = V5E_PEAK_FLOPS,
+                               hbm_bw: float = V5E_HBM_BW) -> float:
+        """Sum of per-op roofline times — each op bound by compute or
+        bandwidth, whichever binds it (PERF.md's per-op-group method)."""
+        return sum(c.step_seconds(peak_flops, hbm_bw)
+                   for c in self.op_costs if c is not None)
+
+    def roofline_rows(self) -> List[dict]:
+        """Per-op-type aggregate: FLOPs, bytes, intensity, bound, est ms
+        — the shape of PERF.md's round-3 table, derived statically."""
+        agg: Dict[str, OpCost] = {}
+        counts: Dict[str, int] = {}
+        for t, c in zip(self.op_types, self.op_costs):
+            if c is None:
+                continue
+            agg[t] = agg.get(t, OpCost()) + c
+            counts[t] = counts.get(t, 0) + 1
+        rows = []
+        for t, c in agg.items():
+            rows.append({
+                "op": t, "count": counts[t], "flops": c.flops,
+                "bytes": c.bytes, "intensity": round(c.intensity, 2),
+                "bound": ("compute" if c.intensity >= (
+                    V5E_PEAK_FLOPS / V5E_HBM_BW) else "HBM"),
+                "est_ms": round(c.step_seconds() * 1e3, 3)})
+        rows.sort(key=lambda r: -r["est_ms"])
+        return rows
+
+    def format_report(self, top_n: int = 10) -> str:
+        lines = [
+            f"peak HBM watermark: {_fmt_bytes(self.peak_bytes)} at op "
+            f"#{self.peak_op_index} {self.peak_op_type!r} "
+            f"(batch={self.batch_size})",
+            f"  resident (params/state/feeds): "
+            f"{_fmt_bytes(self.resident_bytes)}",
+            f"  transient at peak: "
+            f"{_fmt_bytes(self.peak_bytes - self.resident_bytes)}",
+            f"top {top_n} live tensors at the peak:",
+        ]
+        lines += ["  " + t.format() for t in self.top(top_n)]
+        lines += [
+            f"roofline: {self.total_flops / 1e9:.1f} GFLOP, "
+            f"{_fmt_bytes(self.total_hbm_bytes)} HBM, intensity "
+            f"{self.intensity:.1f} F/B vs ridge "
+            f"{V5E_PEAK_FLOPS / V5E_HBM_BW:.0f} -> est "
+            f"{self.estimated_step_seconds() * 1e3:.2f} ms/step (v5e)",
+        ]
+        if self.uncosted_ops:
+            lines.append(
+                f"  (no cost model for: "
+                f"{sorted(set(self.uncosted_ops))[:8]})")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+def _concrete(sds, batch_size: int):
+    """Replace the batch sentinel with the given batch in a ShapeDtype
+    tree (shapes from infer_program carry BATCH_DIM_SENTINEL). The
+    sentinel is PRIME, so dims the program derived by flattening or
+    concatenating the batch axis (``reshape([-1, V])`` -> tokens =
+    sentinel * T) are recovered by divisibility: any multiple of the
+    sentinel rescales by batch/sentinel."""
+    def dim(d):
+        d = int(d)
+        if d == BATCH_DIM_SENTINEL:
+            return batch_size
+        if d and d % BATCH_DIM_SENTINEL == 0:
+            return (d // BATCH_DIM_SENTINEL) * batch_size
+        return d
+
+    def leaf(s):
+        if not hasattr(s, "shape"):
+            return s
+        return jax.ShapeDtypeStruct(tuple(dim(d) for d in s.shape),
+                                    s.dtype)
+
+    return jax.tree_util.tree_map(leaf, sds)
+
+
+def _lookup_var(block: Block, name: str):
+    b = block
+    while b is not None:
+        if name in b.vars:
+            return b.vars[name]
+        b = b.parent
+    return None
+
+
+def _segment_residual_bytes(op: Operator, env: Dict[str, object]) -> float:
+    """Bytes the checkpoint policy keeps live across a recompute segment
+    (seg_fwd -> grad_seg): outputs of SEGMENT_SAVE_OPS plus ndim<=1
+    stats — backward.segment_forward's save-only-named-residuals set."""
+    from ..core.backward import SEGMENT_SAVE_OPS
+
+    local: Dict[str, object] = {}
+    for name in op.attrs["ext_in"]:
+        if name in env:
+            local[name] = env[name]
+    saved = 0.0
+    for sop in op.attrs["seg_ops"]:
+        try:
+            ins = {slot: [local[n] for n in names]
+                   for slot, names in sop["ins"].items() if names}
+            outs = infer_outputs(sop["type"], sop["attrs"], ins)
+        except Exception:
+            continue
+        save_all = sop["type"] in SEGMENT_SAVE_OPS
+        for slot, names in sop["outs"].items():
+            for n, sds in zip(names, (outs or {}).get(slot, [])):
+                local[n] = sds
+                nd = len(getattr(sds, "shape", ()))
+                if save_all or nd <= 1:
+                    saved += costmodel._nbytes(sds)
+    return saved
+
+
+def _paired_grad_index(block: Block, i: int, op: Operator) -> Optional[int]:
+    """Index of the grad op that consumes op's forward residuals: for
+    seg_fwd the grad_seg sharing its vjp_key; for plain ops the first
+    later grad/grad_custom with fwd_type == op.type reading one of op's
+    outputs (or their @PRE snapshots)."""
+    if op.type == "seg_fwd":
+        key = op.attrs.get("vjp_key")
+        for j in range(i + 1, len(block.ops)):
+            o = block.ops[j]
+            if o.type == "grad_seg" and o.attrs.get("vjp_key") == key:
+                return j
+        return None
+    out_names = set(op.output_names())
+    for j in range(i + 1, len(block.ops)):
+        o = block.ops[j]
+        if o.type not in ("grad", "grad_custom"):
+            continue
+        if o.attrs.get("fwd_type") != op.type:
+            continue
+        reads = set(o.input_names())
+        if out_names & reads:
+            return j
+    return None
+
+
+# --------------------------------------------------------------------------
+def analyze_memory(program: Program, feed_names: Sequence[str] = (),
+                   fetch_names: Sequence[str] = (),
+                   scope: Optional[Scope] = None,
+                   batch_size: int = 1,
+                   include_costs: bool = True) -> MemoryAnalysis:
+    """Compute per-op live-byte sets, the peak-HBM watermark, and (with
+    ``include_costs``) the per-op roofline costs for the global block.
+
+    ``batch_size`` concretises every ``-1`` batch dim. Shapes come from
+    :func:`~paddle_tpu.analysis.checker.infer_program`, so anything that
+    fails whole-program inference raises the same located
+    ``ProgramCheckError`` this plane is built on.
+    """
+    costmodel.ensure_registered()
+    analysis = infer_program(program, feed_names, fetch_names, scope=scope,
+                             annotate=False)
+    block = program.global_block
+    ops = list(block.ops)
+    mem = MemoryAnalysis(batch_size)
+
+    types: Dict[str, object] = {
+        name: _concrete(sds, batch_size)
+        for name, sds in analysis.types.items()}
+
+    def bytes_of(name: str) -> float:
+        sds = types.get(name)
+        return costmodel._nbytes(sds) if sds is not None else 0.0
+
+    # ---- residency classification ------------------------------------
+    feeds = set(feed_names)
+    fetches = set(fetch_names)
+    resident: Set[str] = set()
+    scope_names: Set[str] = set()
+    if scope is not None:
+        s = scope
+        while s is not None:
+            scope_names.update(s.keys())
+            s = s.parent
+    for name in types:
+        v = _lookup_var(block, name)
+        if (name in feeds or name in scope_names
+                or (v is not None and (v.persistable or v.is_data))):
+            resident.add(name)
+    mem.resident_bytes = sum(bytes_of(n) for n in resident)
+
+    # ---- last-use map -------------------------------------------------
+    last_use: Dict[str, int] = {}
+    producer: Dict[str, Tuple[int, Operator]] = {}
+    for i, op in enumerate(ops):
+        for name in op.input_names():
+            last_use[name] = i
+        for name in op.output_names():
+            producer[name] = (i, op)
+    horizon = len(ops)
+    for name in fetches:
+        last_use[name] = horizon  # fetches survive the block
+
+    # ---- residual intervals (fwd->bwd footprints liveness can't see) --
+    # [(start, end, bytes, label)]
+    residuals: List[Tuple[int, int, float, str]] = []
+
+    # ---- the walk -----------------------------------------------------
+    live: Dict[str, float] = {}
+    peak = mem.resident_bytes
+    peak_i: Optional[int] = None
+    peak_live_names: List[str] = []
+    peak_residuals: List[Tuple[float, str, int]] = []
+    active_residuals: List[Tuple[int, float, str, int]] = []  # (end, b, lbl, i)
+
+    for i, op in enumerate(ops):
+        cost = None
+        if include_costs and has_op(op.type):
+            opdef = get_op(op.type)
+            if opdef.cost_fn is not None:
+                ins = {slot: [types[n] for n in names if n in types]
+                       for slot, names in op.inputs.items() if names}
+                outs = {slot: [types[n] for n in names if n in types]
+                        for slot, names in op.outputs.items() if names}
+                cost = op_cost(op.type, op.attrs, ins, outs)
+            elif not opdef.cost_exempt:
+                mem.uncosted_ops.append(op.type)
+        mem.op_costs.append(cost)
+        mem.op_types.append(op.type)
+        if cost is not None:
+            mem.total_cost = mem.total_cost + cost
+
+        # residual footprint: seg_fwd's checkpoint saves, or the cost
+        # handler's declared residual (stacked-scan activation planes)
+        res_bytes = 0.0
+        if op.type == "seg_fwd":
+            res_bytes = _segment_residual_bytes(op, types)
+        elif cost is not None and cost.residual_bytes:
+            res_bytes = cost.residual_bytes
+        if res_bytes:
+            j = _paired_grad_index(block, i, op)
+            if j is not None:
+                residuals.append((i, j, res_bytes, op.type))
+                active_residuals.append((j, res_bytes, op.type, i))
+
+        # allocate outputs (name-keyed: an in-place rewrite of a live
+        # name — donated state, aliased BN stats — replaces, not adds)
+        for name in op.output_names():
+            if name in resident:
+                continue
+            live[name] = bytes_of(name)
+        running_transient = sum(live.values())
+        res_active = sum(b for (end, b, _, _) in active_residuals
+                         if end >= i)
+        now = mem.resident_bytes + running_transient + res_active
+        mem.live_at_op.append(now)
+        if now > peak:
+            peak = now
+            peak_i = i
+            peak_live_names = list(live)
+            peak_residuals = [(b, lbl, src) for (end, b, lbl, src)
+                              in active_residuals if end >= i]
+
+        # free transients whose last consumer this was
+        for name in list(live):
+            if last_use.get(name, -1) <= i and name not in fetches:
+                del live[name]
+        active_residuals = [(end, b, lbl, src)
+                            for (end, b, lbl, src) in active_residuals
+                            if end > i]
+
+    mem.peak_bytes = peak
+    mem.peak_op_index = peak_i
+    mem.peak_op_type = ops[peak_i].type if peak_i is not None else None
+
+    # ---- the peak's named live set ------------------------------------
+    peak_set: List[LiveTensor] = []
+    for name in resident:
+        sds = types.get(name)
+        if sds is None:
+            continue
+        leaves = costmodel._leaves(sds)
+        shape = tuple(leaves[0].shape) if leaves else ()
+        dt = str(leaves[0].dtype) if leaves else "?"
+        pi, pop = producer.get(name, (None, None))
+        peak_set.append(LiveTensor(
+            name=name, bytes=bytes_of(name), shape=shape, dtype=dt,
+            kind="resident", producer_index=pi,
+            producer_type=pop.type if pop is not None else None,
+            callsite=(pop.attrs.get("_callsite")
+                      if pop is not None else None)))
+    for name in peak_live_names:
+        sds = types.get(name)
+        if sds is None:
+            continue
+        leaves = costmodel._leaves(sds)
+        shape = tuple(leaves[0].shape) if leaves else ()
+        dt = str(leaves[0].dtype) if leaves else "?"
+        pi, pop = producer.get(name, (None, None))
+        peak_set.append(LiveTensor(
+            name=name, bytes=bytes_of(name), shape=shape, dtype=dt,
+            kind="activation", producer_index=pi,
+            producer_type=pop.type if pop is not None else None,
+            callsite=(pop.attrs.get("_callsite")
+                      if pop is not None else None)))
+    for b, lbl, src in peak_residuals:
+        sop = ops[src]
+        peak_set.append(LiveTensor(
+            name=f"<{lbl} residuals #{src}>", bytes=b, shape=(),
+            dtype="-", kind="residual", producer_index=src,
+            producer_type=lbl, callsite=sop.attrs.get("_callsite")))
+    mem.peak_live = peak_set
+    return mem
+
+
+# --------------------------------------------------------------------------
+# Remat advisor
+# --------------------------------------------------------------------------
+def advise_recompute(program: Program, mem: MemoryAnalysis,
+                     min_ops: int = 3,
+                     top_n: int = 5) -> List[RematAdvice]:
+    """Rank candidate ``recompute_guard`` spans in the forward region by
+    peak bytes they would free vs the traffic/FLOPs their barriered
+    backward recompute would add. Only meaningful for training programs
+    (a program with no grad ops holds no fwd->bwd activations — advice
+    is empty there, remat cannot help inference)."""
+    from ..core.backward import NON_DIFFERENTIABLE, SEGMENT_SAVE_OPS
+
+    block = program.global_block
+    ops = list(block.ops)
+    first_grad = next(
+        (i for i, op in enumerate(ops)
+         if op.type in ("grad", "grad_custom", "grad_seg")), None)
+    if first_grad is None:
+        return []
+
+    # names the backward actually reads (these are the pinned activations)
+    bwd_reads: Set[str] = set()
+    for op in ops[first_grad:]:
+        bwd_reads.update(op.input_names())
+
+    def eligible(op: Operator) -> bool:
+        if not has_op(op.type) or op.type in NON_DIFFERENTIABLE:
+            return False
+        opdef = get_op(op.type)
+        return not (opdef.special or opdef.needs_rng is True
+                    or op.attrs.get("__recompute_seg__") is not None)
+
+    types_cache: Dict[str, object] = {}
+
+    def _bytes(name: str) -> float:
+        # sizes via the recorded peak set / live curve are name-free;
+        # re-derive from the op costs' slot shapes is overkill — use the
+        # analysis peak tensors where available, else 0
+        if not types_cache:
+            for t in mem.peak_live:
+                types_cache[t.name] = t.bytes
+        return types_cache.get(name, 0.0)
+
+    advice: List[RematAdvice] = []
+    i = 0
+    while i < first_grad:
+        if not eligible(ops[i]):
+            i += 1
+            continue
+        j = i
+        while j + 1 < first_grad and eligible(ops[j + 1]):
+            j += 1
+        if j - i + 1 >= min_ops:
+            saved = 0.0
+            traffic = 0.0
+            flops = 0.0
+            op_types: List[str] = []
+            site = None
+            for k in range(i, j + 1):
+                op = ops[k]
+                op_types.append(op.type)
+                if site is None:
+                    site = op.attrs.get("_callsite")
+                c = mem.op_costs[k] if k < len(mem.op_costs) else None
+                if c is not None:
+                    traffic += c.bytes
+                    flops += c.flops
+                save_all = op.type in SEGMENT_SAVE_OPS
+                for name in op.output_names():
+                    if save_all:
+                        continue  # checkpoint policy keeps these anyway
+                    if name in bwd_reads:
+                        saved += _bytes(name)
+            if saved > 0:
+                advice.append(RematAdvice(
+                    start=i, end=j, op_types=op_types, bytes_saved=saved,
+                    extra_traffic_bytes=traffic, extra_flops=flops,
+                    callsite=site))
+        i = j + 1
+    advice.sort(key=lambda a: -a.bytes_saved)
+    return advice[:top_n]
+
+
+# --------------------------------------------------------------------------
+# Budget gating
+# --------------------------------------------------------------------------
+def check_memory_budget(program: Program, feed_names: Sequence[str],
+                        fetch_names: Sequence[str], budget_bytes: float,
+                        scope: Optional[Scope] = None,
+                        batch_size: int = 1,
+                        what: str = "program") -> MemoryAnalysis:
+    """Raise :class:`MemoryBudgetError` when the static peak-HBM
+    watermark exceeds ``budget_bytes``; returns the analysis otherwise."""
+    mem = analyze_memory(program, feed_names, fetch_names, scope=scope,
+                         batch_size=batch_size)
+    if mem.peak_bytes <= budget_bytes:
+        return mem
+    top = mem.top(8)
+    advice = advise_recompute(program, mem)
+    lines = [
+        f"{what}: static peak-HBM estimate "
+        f"{_fmt_bytes(mem.peak_bytes)} exceeds mem_budget "
+        f"{_fmt_bytes(budget_bytes)} (batch={batch_size}; "
+        f"resident {_fmt_bytes(mem.resident_bytes)} + transient "
+        f"{_fmt_bytes(mem.peak_bytes - mem.resident_bytes)} at op "
+        f"#{mem.peak_op_index} {mem.peak_op_type!r})",
+        "top live tensors at the peak:",
+    ]
+    lines += ["  " + t.format() for t in top]
+    if advice:
+        lines.append("remat advisor (bytes-saved vs bytes-re-streamed):")
+        lines += ["  " + a.format() for a in advice]
+        lines.append(
+            "  (remat trades HBM traffic for peak memory — PERF.md "
+            "round 3: a memory lever, not a bandwidth lever)")
+    else:
+        lines.append("no recompute_guard candidates found (inference "
+                     "program or segments already in place) — reduce "
+                     "batch, shard state, or raise the budget")
+    raise MemoryBudgetError("\n".join(lines), peak_bytes=mem.peak_bytes,
+                            budget_bytes=budget_bytes, top=top,
+                            advice=advice)

@@ -53,6 +53,14 @@ class OpDef:
     optional_inputs: tuple = ()
     # If set, only these input slots get gradients even if others are float.
     stop_gradient_inputs: tuple = ()
+    # Analytical cost handler fn(attrs, ins, outs) -> analysis.costmodel
+    # OpCost, attached post-registration by paddle_tpu.analysis.costmodel
+    # (register_cost) — the FLOP/HBM-byte twin of infer_outputs. Ops whose
+    # cost is structurally meaningless (feed/fetch/unbounded decode loops)
+    # set cost_exempt instead; the registry conformance audit requires one
+    # of the two for every op.
+    cost_fn: Optional[Callable] = None
+    cost_exempt: bool = False
 
 
 _REGISTRY: Dict[str, OpDef] = {}

@@ -207,6 +207,12 @@ define_bool("verify_program", False,
             "is named), and on the programs the trainer, "
             "save_inference_model, and the serving engines are about to "
             "compile. Build-time cost only; on in CI")
+define_bool("reduce_peak_memory", False,
+            "append the memory-aware op-scheduling pass "
+            "(transpiler.ReducePeakMemory) to the inference/deployment "
+            "pipelines: topologically reorders ops to shrink the static "
+            "peak-HBM watermark (bit-exact outputs; analysis.memory "
+            "computes the watermark)")
 define_string("fault_plan", "",
               "deterministic chaos plan for manual resilience drills, "
               "e.g. 'preempt@5,torn_checkpoint@3': kind@step entries "

@@ -91,7 +91,8 @@ class InferenceEngine:
                  seq_buckets: Optional[Sequence[int]] = None,
                  mesh=None, plan=None, place=None,
                  metrics: Optional[MetricsRegistry] = None,
-                 transpile: Optional[bool] = None):
+                 transpile: Optional[bool] = None,
+                 mem_budget: Optional[float] = None):
         self.metrics = metrics or MetricsRegistry()
         self.scope = scope or Scope()
         self.mesh = mesh
@@ -142,6 +143,22 @@ class InferenceEngine:
         self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
         self.seq_buckets = (sorted(set(int(s) for s in seq_buckets))
                             if seq_buckets else None)
+        if mem_budget is not None:
+            # build-time gate at the WORST bucket (largest batch the
+            # warmup will compile): a model that cannot fit raises a
+            # located MemoryBudgetError here, before any compile/OOM
+            from .. import analysis
+
+            mem = analysis.check_memory_budget(
+                self.program, self.feed_names, self.fetch_names,
+                mem_budget, scope=self.scope,
+                batch_size=self.batch_buckets[-1],
+                what=f"InferenceEngine (bucket "
+                     f"{self.batch_buckets[-1]})")
+            self.metrics.set_gauge("mem/static_peak_bytes",
+                                   mem.peak_bytes)
+            self.metrics.set_gauge("mem/resident_bytes",
+                                   mem.resident_bytes)
         # graceful-drain state: admissions stop at close(). Synchronous
         # runs in other threads are counted; async dispatches register
         # their RunHandles so close(drain=True) can block on DEVICE

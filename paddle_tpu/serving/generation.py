@@ -133,7 +133,8 @@ class GenerationEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  default_max_new_tokens: int = 16,
                  eos_id: Optional[int] = None, pad_id: int = 0,
-                 place=None, metrics: Optional[MetricsRegistry] = None):
+                 place=None, metrics: Optional[MetricsRegistry] = None,
+                 mem_budget: Optional[float] = None):
         if slots < 1:
             raise ValueError("need at least one decode slot")
         self.spec = spec
@@ -170,6 +171,8 @@ class GenerationEngine:
         self._init_cache()
         self._prefill_progs: Dict[int, tuple] = {}
         self._decode_prog = self._build_decode()
+        if mem_budget is not None:
+            self._check_mem_budget(mem_budget)
 
     # -- program/scope construction ------------------------------------
     @classmethod
@@ -292,6 +295,35 @@ class GenerationEngine:
         if tp not in self._prefill_progs:
             self._prefill_progs[tp] = self._build_prefill(tp)
         return self._prefill_progs[tp]
+
+    def _check_mem_budget(self, budget: float) -> None:
+        """Build-time budget gate over the decode step AND the largest
+        prefill bucket. The KV-cache slot table ([L, slots+1, Hkv, Tmax,
+        dh] x2, scope-resident since _init_cache) is counted as resident
+        state, so an over-provisioned slot/Tmax configuration raises a
+        located MemoryBudgetError before warmup compiles anything."""
+        from .. import analysis
+
+        prog, nxt = self._decode_prog
+        mem = analysis.check_memory_budget(
+            prog, ["serving.tok", "serving.pos"], [nxt.name], budget,
+            scope=self.scope, batch_size=self._nslots,
+            what=f"GenerationEngine decode step (slots={self.slots}, "
+                 f"tmax={self.tmax})")
+        tp = self.prompt_buckets[-1]
+        pprog, pnxt = self._prefill_prog(tp)
+        pmem = analysis.check_memory_budget(
+            pprog, ["serving.prompt", "serving.slot_ids",
+                    "serving.lengths"], [pnxt.name], budget,
+            scope=self.scope,
+            batch_size=self.prefill_batch_buckets[-1],
+            what=f"GenerationEngine prefill (bucket {tp})")
+        self.metrics.set_gauge("mem/static_peak_bytes",
+                               max(mem.peak_bytes, pmem.peak_bytes))
+        self.metrics.set_gauge("mem/kv_cache_bytes", 2.0 * float(
+            np.prod([self.spec.n_layers, self._nslots,
+                     self.spec.kv_heads, self.tmax,
+                     self.spec.head_dim])) * 4)
 
     # -- bucket helpers -------------------------------------------------
     def prompt_bucket_for(self, n: int) -> int:

@@ -23,12 +23,12 @@ from .tracer import (DEFAULT_CAPACITY, Span, Tracer, active_level,
 from .export import (export_chrome_trace, export_jsonl, load_trace_events,
                      spans_to_chrome_events)
 from .runlog import RunLog
-from .device import device_memory_stats
+from .device import device_memory_stats, live_bytes
 
 __all__ = [
     "DEFAULT_CAPACITY", "Span", "Tracer", "RunLog",
     "active_level", "current_span", "disable", "enable", "enabled",
     "get_tracer", "record", "span", "start_span",
     "export_chrome_trace", "export_jsonl", "load_trace_events",
-    "spans_to_chrome_events", "device_memory_stats",
+    "spans_to_chrome_events", "device_memory_stats", "live_bytes",
 ]

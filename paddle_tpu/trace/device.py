@@ -32,3 +32,25 @@ def device_memory_stats() -> Dict[str, float]:
             if src in ms:
                 out[f"device{d.id}_{dst}"] = float(ms[src])
     return out
+
+
+def live_bytes() -> float:
+    """Total bytes of live jax arrays on this process's devices.
+
+    Prefers the allocator's ``bytes_in_use`` gauges (TPU); falls back to
+    summing ``jax.live_arrays()`` where the backend reports no stats
+    (CPU) — the witness plane the static peak-HBM estimator
+    (analysis.memory) is cross-checked against in tier-1.
+    """
+    stats = device_memory_stats()
+    in_use = [v for k, v in stats.items() if k.endswith("bytes_in_use")
+              and not k.endswith("peak_bytes_in_use")]
+    if in_use:
+        return float(sum(in_use))
+    try:
+        import jax
+
+        return float(sum(a.size * a.dtype.itemsize
+                         for a in jax.live_arrays()))
+    except Exception:
+        return 0.0

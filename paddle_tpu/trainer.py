@@ -105,7 +105,7 @@ class SGD:
               event_handler: Optional[Callable] = None,
               test_reader: Optional[Callable] = None,
               run_log=None, async_depth: int = 1,
-              checkpoint=None):
+              checkpoint=None, mem_budget: Optional[float] = None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
@@ -132,6 +132,15 @@ class SGD:
         consumption) so the end state is bit-identical to an
         uninterrupted run.
 
+        ``mem_budget`` (bytes) gates the step program on the static
+        peak-HBM estimate (paddle_tpu.analysis.memory): at the first
+        batch — when the batch size is known but BEFORE the first
+        compile — the whole step program (forward, backward, optimizer)
+        is analyzed against the budget, and a
+        :class:`~paddle_tpu.analysis.MemoryBudgetError` naming the peak
+        live set and the remat advisor's suggestions is raised instead
+        of letting XLA OOM at compile or first run.
+
         ``async_depth`` > 1 pipelines the loop: batch stacking +
         host->device transfer run on a background thread
         (reader.device_prefetch machinery), each step is dispatched with
@@ -152,6 +161,8 @@ class SGD:
         else:
             event_handler = user_handler
         self._init_params()
+        self._mem_budget = mem_budget
+        self._mem_checked = False
         rs = None
         from .flags import FLAGS
         from .resilience import TrainResilience, faults
@@ -206,6 +217,27 @@ class SGD:
                 else:
                     event_handler(evt.EndPass(pass_id, metrics=summary))
 
+    def _maybe_check_mem_budget(self, feed):
+        """One-shot build-time budget gate, run at the first batch (batch
+        size now known) BEFORE the first compile/dispatch."""
+        if getattr(self, "_mem_budget", None) is None or self._mem_checked:
+            return
+        self._mem_checked = True
+        from . import analysis
+
+        batch = 1
+        for v in feed.values():
+            shape = getattr(v, "shape", None)
+            if shape:
+                batch = int(shape[0])
+                break
+        fetches = [self.cost.name] + [v.name for v in
+                                      self.metrics.values()]
+        analysis.check_memory_budget(
+            self.main_program, list(feed), fetches, self._mem_budget,
+            scope=self.scope, batch_size=batch,
+            what="SGD.train step program")
+
     def _run_pass_sync(self, pass_id, reader, event_handler, rs=None,
                        skip_n=0):
         from . import trace
@@ -224,6 +256,7 @@ class SGD:
                             batch_id=batch_id) as sp, \
                     profiler.timer("trainer/step"):
                 feed = self.feeder.feed(batch)
+                self._maybe_check_mem_budget(feed)
                 fetched = self.exe.run(self.main_program, feed=feed,
                                        fetch_list=self._fetch_list(),
                                        scope=self.scope)
@@ -311,6 +344,7 @@ class SGD:
             for batch_id, bs, feed in stream():
                 if rs is not None:
                     rs.before_step()
+                self._maybe_check_mem_budget(feed)
                 event_handler(evt.BeginIteration(pass_id, batch_id))
                 with trace.span("trainer/dispatch", pass_id=pass_id,
                                 batch_id=batch_id,

@@ -37,6 +37,7 @@ from .framework import (Pass, PassContext, PassManager, PassResult,
 from .passes import (CanonicalizeIsTest, ConstantFolding,
                      DeadOpElimination, DropoutToScale,
                      ExpandRecomputeSegments, FoldBatchNorm, FusePatterns)
+from .schedule import ReducePeakMemory
 
 __all__ = [
     "Pass", "PassContext", "PassManager", "PassResult",
@@ -44,9 +45,19 @@ __all__ = [
     "get_pass", "registered_passes", "ir_dump_hook",
     "ExpandRecomputeSegments", "CanonicalizeIsTest", "DropoutToScale",
     "DeadOpElimination", "ConstantFolding", "FoldBatchNorm",
-    "FusePatterns", "inference_pipeline", "training_pipeline",
-    "deployment_pipeline", "prune_pipeline",
+    "FusePatterns", "ReducePeakMemory", "inference_pipeline",
+    "training_pipeline", "deployment_pipeline", "prune_pipeline",
 ]
+
+
+def _maybe_reduce_peak(reduce_peak):
+    """Pipeline knob: None follows --reduce_peak_memory, True forces the
+    memory-aware scheduling pass on."""
+    if reduce_peak is None:
+        from ..flags import FLAGS
+
+        reduce_peak = FLAGS.reduce_peak_memory
+    return [ReducePeakMemory()] if reduce_peak else []
 
 
 def prune_pipeline(for_test: bool = True, **pm_kw) -> PassManager:
@@ -64,6 +75,7 @@ def inference_pipeline(*, constant_fold: bool = True,
                        fold_batch_norm: bool = True,
                        fuse: bool = True,
                        epilogue: Optional[bool] = None,
+                       reduce_peak: Optional[bool] = None,
                        **pm_kw) -> PassManager:
     """The deploy-time pipeline (``save_inference_model`` default):
     flatten → is_test → dropout→scale → DCE → constant-fold →
@@ -88,6 +100,9 @@ def inference_pipeline(*, constant_fold: bool = True,
     if fold_batch_norm:
         passes.append(FoldBatchNorm())
     passes.append(DeadOpElimination())
+    # memory-aware scheduling LAST: it reorders whatever the rewrites
+    # left, bit-exact (``reduce_peak=None`` follows --reduce_peak_memory)
+    passes.extend(_maybe_reduce_peak(reduce_peak))
     return PassManager(passes, **pm_kw)
 
 
@@ -102,7 +117,8 @@ def training_pipeline(*, epilogue: Optional[bool] = None,
                         FusePatterns(epilogue=epilogue)], **pm_kw)
 
 
-def deployment_pipeline(**pm_kw) -> PassManager:
+def deployment_pipeline(reduce_peak: Optional[bool] = None,
+                        **pm_kw) -> PassManager:
     """The portable-artifact pipeline (int8 quantization, the native C
     machine): like ``inference_pipeline`` but with NO fused ops — fused
     ``conv1x1_bn_act`` is lowered back to folded conv2d + bias add so
@@ -110,4 +126,5 @@ def deployment_pipeline(**pm_kw) -> PassManager:
     return PassManager(
         [ExpandRecomputeSegments(), CanonicalizeIsTest(),
          DeadOpElimination(), DropoutToScale(), ConstantFolding(),
-         FoldBatchNorm(lower_fused=True), DeadOpElimination()], **pm_kw)
+         FoldBatchNorm(lower_fused=True), DeadOpElimination()]
+        + _maybe_reduce_peak(reduce_peak), **pm_kw)

@@ -1,0 +1,231 @@
+"""Memory-aware op scheduling: the ``reduce_peak_memory`` pass.
+
+A topological reorder of the global block that shrinks the live-byte
+watermark the memory analyzer (analysis/memory.py) computes — the
+program-level lever the reference era shipped as its "memory transpiler".
+Model builders naturally emit breadth-first programs (every branch of a
+fork built before any is consumed); a depth-first schedule runs each
+branch to its consumer before materializing the next, so fewer big
+tensors overlap.
+
+Semantics are preserved exactly:
+
+- every data dependency (read-after-write, write-after-read,
+  write-after-write — the IR is not SSA) becomes a scheduling edge, so
+  every op sees bit-identical inputs;
+- RNG-drawing ops keep their relative order (the executor splits the
+  PRNG key in op order — reordering them would change the stream);
+- ``special`` ops (seg_fwd/grad_seg env stashes, control flow) and
+  unknown ops are chained in program order.
+
+The pass commits a new order only when it strictly lowers the estimated
+peak; ties keep the original order (idempotent re-runs). Registered in
+the pass registry; opt into pipelines with ``--reduce_peak_memory`` or
+by constructing the pass directly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..core.program import Program
+from ..core.registry import get_op, has_op, op_uses_rng
+from .framework import Pass, PassContext, register_pass
+
+
+def _sizes(program: Program, ctx: PassContext, batch_size: int):
+    """name -> bytes via whole-program inference; None when the program
+    cannot be inferred (the pass then declines to touch it)."""
+    from ..analysis import costmodel
+    from ..analysis.checker import infer_program
+    from ..analysis.memory import _concrete
+
+    try:
+        analysis = infer_program(program, ctx.feed_names, ctx.fetch_names,
+                                 scope=ctx.scope, annotate=False)
+    except Exception:
+        return None
+    return {name: costmodel._nbytes(_concrete(sds, batch_size))
+            for name, sds in analysis.types.items()}
+
+
+def _resident_names(program: Program, ctx: PassContext) -> Set[str]:
+    block = program.global_block
+    names = set(ctx.feed_names)
+    if ctx.scope is not None:
+        s = ctx.scope
+        while s is not None:
+            names.update(s.keys())
+            s = s.parent
+    for name, v in block.vars.items():
+        if v.persistable or v.is_data:
+            names.add(name)
+    return names
+
+
+def _peak_of(order: Sequence, sizes: Dict[str, float], resident: Set[str],
+             fetches: Set[str]) -> float:
+    """Transient live-byte watermark of an op order (resident excluded —
+    it is order-invariant)."""
+    last_use: Dict[str, int] = {}
+    for i, op in enumerate(order):
+        for n in op.input_names():
+            last_use[n] = i
+    live: Dict[str, float] = {}
+    peak = 0.0
+    for i, op in enumerate(order):
+        for n in op.output_names():
+            if n not in resident:
+                live[n] = sizes.get(n, 0.0)
+        peak = max(peak, sum(live.values()))
+        for n in list(live):
+            if last_use.get(n, -1) <= i and n not in fetches:
+                del live[n]
+    return peak
+
+
+def _build_deps(ops: List) -> List[Set[int]]:
+    """deps[i] = set of op indices that must run before op i."""
+    deps: List[Set[int]] = [set() for _ in ops]
+    last_writer: Dict[str, int] = {}
+    readers_since_write: Dict[str, List[int]] = {}
+    prev_chained: Optional[int] = None
+    for i, op in enumerate(ops):
+        chained = True
+        if has_op(op.type):
+            opdef = get_op(op.type)
+            chained = opdef.special or op_uses_rng(opdef, op.attrs)
+        if chained:
+            if prev_chained is not None:
+                deps[i].add(prev_chained)
+            prev_chained = i
+        for n in op.input_names():
+            if n in last_writer:
+                deps[i].add(last_writer[n])  # RAW
+            readers_since_write.setdefault(n, []).append(i)
+        for n in op.output_names():
+            if n in last_writer:
+                deps[i].add(last_writer[n])  # WAW
+            for r in readers_since_write.get(n, ()):
+                if r != i:
+                    deps[i].add(r)  # WAR
+            last_writer[n] = i
+            readers_since_write[n] = []
+        deps[i].discard(i)
+    return deps
+
+
+def _greedy_schedule(ops: List, deps: List[Set[int]],
+                     sizes: Dict[str, float], resident: Set[str],
+                     fetches: Set[str]) -> List[int]:
+    """List-schedule minimizing the live-byte delta at every step: among
+    ready ops pick the one whose (bytes allocated - bytes freed) is
+    smallest, tie-broken by original position (deterministic, stable)."""
+    n = len(ops)
+    succs: List[List[int]] = [[] for _ in range(n)]
+    indeg = [0] * n
+    for i, ds in enumerate(deps):
+        indeg[i] = len(ds)
+        for d in ds:
+            succs[d].append(i)
+    remaining_readers: Dict[str, int] = {}
+    for op in ops:
+        seen = set()
+        for m in op.input_names():
+            if m in seen:
+                continue
+            seen.add(m)
+            remaining_readers[m] = remaining_readers.get(m, 0) + 1
+    live: Set[str] = set()
+
+    def delta(i: int) -> float:
+        op = ops[i]
+        alloc = sum(sizes.get(m, 0.0) for m in set(op.output_names())
+                    if m not in resident and m not in live)
+        freed = 0.0
+        seen = set()
+        for m in op.input_names():
+            if m in seen or m not in live:
+                continue
+            seen.add(m)
+            if remaining_readers.get(m, 0) <= 1 and m not in fetches:
+                freed += sizes.get(m, 0.0)
+        for m in set(op.output_names()):
+            # never-read outputs die immediately
+            if (m not in resident and m not in fetches
+                    and remaining_readers.get(m, 0) == 0):
+                freed += sizes.get(m, 0.0)
+        return alloc - freed
+
+    ready = [i for i in range(n) if indeg[i] == 0]
+    order: List[int] = []
+    while ready:
+        # evaluate delta for every ready op; ready sets stay small (the
+        # dependency chains of real programs bound the frontier)
+        best = min(ready, key=lambda i: (delta(i), i))
+        ready.remove(best)
+        order.append(best)
+        op = ops[best]
+        for m in set(op.output_names()):
+            if m not in resident:
+                live.add(m)
+        seen = set()
+        for m in op.input_names():
+            if m in seen:
+                continue
+            seen.add(m)
+            c = remaining_readers.get(m, 0) - 1
+            remaining_readers[m] = c
+            if c <= 0 and m in live and m not in fetches:
+                live.discard(m)
+        for m in set(op.output_names()):
+            if (m in live and m not in fetches
+                    and remaining_readers.get(m, 0) == 0):
+                live.discard(m)
+        for s in succs[best]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                ready.append(s)
+    return order
+
+
+@register_pass
+class ReducePeakMemory(Pass):
+    """Reorder the global block to shrink the peak live-byte watermark.
+
+    ``batch_size`` concretises ``-1`` batch dims for sizing (relative
+    sizes drive the schedule, so the nominal default is fine). Outputs
+    are bit-exact: only the op ORDER changes, never any op's inputs, and
+    RNG/special/state orderings are pinned by dependency edges.
+    """
+
+    name = "reduce_peak_memory"
+
+    def __init__(self, batch_size: int = 8):
+        self.batch_size = int(batch_size)
+
+    def apply(self, program: Program, ctx: PassContext) -> None:
+        block = program.global_block
+        ops = list(block.ops)
+        if len(ops) < 3:
+            return
+        sizes = _sizes(program, ctx, self.batch_size)
+        if sizes is None:
+            ctx.note("reduce_peak_memory: program not inferable; skipped")
+            return
+        resident = _resident_names(program, ctx)
+        fetches = set(ctx.fetch_names)
+        deps = _build_deps(ops)
+        order = _greedy_schedule(ops, deps, sizes, resident, fetches)
+        new_ops = [ops[i] for i in order]
+        before = _peak_of(ops, sizes, resident, fetches)
+        after = _peak_of(new_ops, sizes, resident, fetches)
+        if after < before:
+            block.ops = new_ops
+            program._bump()
+            ctx.note(
+                f"reduce_peak_memory: transient peak "
+                f"{before / 1e6:.2f} MB -> {after / 1e6:.2f} MB "
+                f"({(1 - after / max(before, 1e-9)) * 100:.1f}% lower) "
+                f"at batch={self.batch_size}")
+        else:
+            ctx.note("reduce_peak_memory: no better order found")

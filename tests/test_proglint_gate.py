@@ -101,3 +101,26 @@ def test_unreadable_target_is_a_lint_failure(proglint, tmp_path, capsys):
     rc = proglint.main([str(tmp_path / "does_not_exist")])
     assert rc == 1
     assert "load-failure" in capsys.readouterr().out
+
+
+def test_mem_gate_tiny_budget_fails_sane_budget_passes(proglint, capsys):
+    """CI pin for ``proglint --mem --budget``: a deliberately tiny
+    budget fails nonzero on a demo topology naming the peak; a sane one
+    passes with the watermark reported as an informational finding."""
+    rc = proglint.main(["--demo", "quick_start", "--mem",
+                        "--budget", "64", "--batch", "8", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    findings = [i for t in out["targets"] for i in t["issues"]
+                if i["rule"] == "memory-budget"]
+    assert findings and any(i["severity"] == "error" for i in findings)
+    assert any("EXCEEDS" in i["message"] for i in findings)
+
+    rc = proglint.main(["--demo", "quick_start", "--mem",
+                        "--budget", "8e9", "--batch", "8", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    findings = [i for t in out["targets"] for i in t["issues"]
+                if i["rule"] == "memory-budget"]
+    assert findings and all(i["severity"] == "warning" for i in findings)
+    assert all("static peak HBM" in i["message"] for i in findings)

@@ -58,3 +58,46 @@ def test_audit_catches_rng_kernel_without_rng_kwarg():
         assert any("rng" in i.message for i in issues)
     finally:
         registry._REGISTRY.pop("conformance_test_rng_op", None)
+
+
+# --------------------------------------------------------------------------
+# cost-model coverage contract
+# --------------------------------------------------------------------------
+def test_every_op_has_cost_handler_or_exempt_marker():
+    """Every registered op is priced by the roofline cost model or
+    explicitly exempted — audited over the full registry (the audit
+    itself is pinned clean by test_every_registered_op_conforms)."""
+    from paddle_tpu.analysis import costmodel
+
+    for op_type in registry.registered_ops():
+        assert costmodel.has_cost(op_type) or costmodel.is_cost_exempt(
+            op_type), f"op {op_type!r} has no cost handler and no " \
+                      f"cost_exempt marker"
+
+
+def test_audit_catches_op_without_cost_handler():
+    registry.register_op("conformance_test_uncosted_op", _identity_kernel)
+    try:
+        issues = analysis.audit_op("conformance_test_uncosted_op")
+        assert any("cost-model handler" in i.message for i in issues)
+        assert all(i.severity == analysis.ERROR for i in issues)
+        # either remedy clears the finding: a handler ...
+        from paddle_tpu.analysis import costmodel
+
+        costmodel.register_cost(
+            "conformance_test_uncosted_op",
+            lambda attrs, ins, outs: costmodel.OpCost())
+        assert not analysis.audit_op("conformance_test_uncosted_op")
+    finally:
+        registry._REGISTRY.pop("conformance_test_uncosted_op", None)
+
+
+def test_audit_accepts_cost_exempt_marker():
+    registry.register_op("conformance_test_exempt_op", _identity_kernel)
+    try:
+        from paddle_tpu.analysis import costmodel
+
+        costmodel.cost_exempt("conformance_test_exempt_op")
+        assert not analysis.audit_op("conformance_test_exempt_op")
+    finally:
+        registry._REGISTRY.pop("conformance_test_exempt_op", None)

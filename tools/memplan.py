@@ -1,0 +1,162 @@
+"""memplan — static memory planning & roofline analysis for programs.
+
+Runs the paddle_tpu.analysis.memory liveness/peak-HBM analyzer and the
+per-op cost model over saved inference models and/or the demo program
+topologies: prints the peak watermark, the top-N live tensors at the
+peak (with producing op + user callsite), the per-op-type roofline table
+(FLOPs, HBM bytes, arithmetic intensity vs the v5e ridge, estimated
+time), and — for training programs — the remat advisor's ranked
+``recompute_guard`` candidates. With ``--budget`` it exits nonzero when
+the static peak exceeds the budget (the same gate
+``SGD.train(mem_budget=...)`` applies at build time).
+
+Usage (repo root, CPU backend):
+
+    JAX_PLATFORMS=cpu python tools/memplan.py MODEL_DIR [--batch 16]
+    JAX_PLATFORMS=cpu python tools/memplan.py --demo quick_start \
+        --batch 32 --top 12 --budget 8e9
+    ... [--json] [--no-roofline] [--no-advice]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_proglint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proglint", os.path.join(REPO, "tools", "proglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def plan_target(tag, program, feed_names, fetch_names, scope, args):
+    """Analyze one target; returns a JSON-safe dict."""
+    from paddle_tpu import analysis
+
+    entry = {"target": tag, "batch": args.batch}
+    try:
+        mem = analysis.analyze_memory(program, feed_names, fetch_names,
+                                      scope=scope, batch_size=args.batch)
+    except Exception as exc:
+        entry["error"] = f"{type(exc).__name__}: {exc}"
+        return entry
+    entry.update({
+        "peak_bytes": mem.peak_bytes,
+        "resident_bytes": mem.resident_bytes,
+        "peak_op_index": mem.peak_op_index,
+        "peak_op_type": mem.peak_op_type,
+        "total_flops": mem.total_flops,
+        "total_hbm_bytes": mem.total_hbm_bytes,
+        "intensity": mem.intensity,
+        "est_step_ms": mem.estimated_step_seconds() * 1e3,
+        "top": [dataclasses_asdict(t) for t in mem.top(args.top)],
+    })
+    if not args.no_roofline:
+        entry["roofline"] = mem.roofline_rows()
+    if not args.no_advice:
+        entry["advice"] = [a.format() for a in
+                           analysis.advise_recompute(program, mem)]
+    if args.budget is not None:
+        entry["budget_bytes"] = args.budget
+        entry["over_budget"] = mem.peak_bytes > args.budget
+    entry["_report"] = mem.format_report(args.top)
+    return entry
+
+
+def dataclasses_asdict(t):
+    import dataclasses
+
+    return dataclasses.asdict(t)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="memplan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model_dirs", nargs="*",
+                    help="save_inference_model directories to analyze")
+    ap.add_argument("--demo", action="append", default=[],
+                    help="analyze a demo's program topologies "
+                         "(quick_start, serving_lm; repeatable)")
+    ap.add_argument("--batch", type=int, default=16,
+                    help="batch size substituted for -1 dims (default 16)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="top-N live tensors to list (default 10)")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="peak-HBM budget in bytes; exit nonzero when any "
+                         "target's static peak exceeds it")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--no-roofline", action="store_true")
+    ap.add_argument("--no-advice", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.model_dirs and not args.demo:
+        ap.error("nothing to analyze: give MODEL_DIR(s) or --demo")
+
+    proglint = _load_proglint()
+    targets = []
+    failures = 0
+    for d in args.model_dirs:
+        try:
+            targets.extend(proglint.load_saved_model(d))
+        except Exception as exc:
+            print(f"== {d}: load failure: {type(exc).__name__}: {exc}",
+                  file=sys.stderr)
+            failures += 1
+    for d in args.demo:
+        targets.extend(proglint.build_demo(d))
+
+    report = []
+    over = 0
+    for tag, program, feeds, fetches, scope in targets:
+        entry = plan_target(tag, program, feeds, fetches, scope, args)
+        report.append(entry)
+        if entry.get("error"):
+            failures += 1
+        if entry.get("over_budget"):
+            over += 1
+
+    if args.as_json:
+        slim = [{k: v for k, v in e.items() if k != "_report"}
+                for e in report]
+        print(json.dumps({"targets": slim, "over_budget": over,
+                          "failures": failures}, indent=1))
+    else:
+        for e in report:
+            print(f"== {e['target']}")
+            if e.get("error"):
+                print(f"   analysis failed: {e['error']}")
+                continue
+            for line in e["_report"].splitlines():
+                print("   " + line)
+            if not args.no_roofline and e.get("roofline"):
+                print("   hottest op groups (static roofline):")
+                for r in e["roofline"][:6]:
+                    print(f"     {r['op']:<26} x{r['count']:<4} "
+                          f"{r['flops'] / 1e9:>10.2f} GF "
+                          f"{r['bytes'] / 1e9:>8.3f} GB  "
+                          f"int {r['intensity']:>8.1f}  [{r['bound']}] "
+                          f"~{r['est_ms']:.3f} ms")
+            if e.get("advice"):
+                print("   remat advisor:")
+                for a in e["advice"]:
+                    print("     " + a)
+            if e.get("over_budget"):
+                print(f"   OVER BUDGET: peak "
+                      f"{e['peak_bytes'] / 1e9:.3f} GB > "
+                      f"{e['budget_bytes'] / 1e9:.3f} GB")
+        print(f"memplan: {len(report)} target(s), {over} over budget, "
+              f"{failures} failure(s)")
+    return 1 if (over or failures) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

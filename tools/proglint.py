@@ -12,6 +12,8 @@ Usage (repo root, CPU backend):
     JAX_PLATFORMS=cpu python tools/proglint.py --demo quick_start \
                                                --demo serving_lm
     JAX_PLATFORMS=cpu python tools/proglint.py --audit
+    JAX_PLATFORMS=cpu python tools/proglint.py --demo quick_start \
+                                               --mem --budget 8e9
     ... [--no-shapes] [--json] [--warnings-as-errors] [--rules r1,r2]
 """
 from __future__ import annotations
@@ -103,7 +105,9 @@ def build_demo(name: str):
 
 # --------------------------------------------------------------------------
 def lint_target(tag, program, feed_names, fetch_names, scope,
-                check_shapes: bool, rules: Optional[List[str]]):
+                check_shapes: bool, rules: Optional[List[str]],
+                mem: bool = False, budget: Optional[float] = None,
+                batch: int = 16):
     """Returns (issues, fatal): lint findings plus any checker error
     (already located) surfaced as an error-severity issue."""
     from paddle_tpu import analysis
@@ -123,6 +127,33 @@ def lint_target(tag, program, feed_names, fetch_names, scope,
                 message=str(exc), block_idx=exc.block_idx,
                 op_index=exc.op_index, op_type=exc.op_type,
                 callsite=exc.callsite, slot=exc.slot, var=exc.var))
+    if mem and not any(i.severity == analysis.ERROR for i in issues):
+        # peak-HBM plane: informational watermark per target; an
+        # exceeded --budget is an error-severity finding (nonzero exit)
+        try:
+            m = analysis.analyze_memory(program, feed_names, fetch_names,
+                                        scope=scope, batch_size=batch)
+        except Exception as exc:
+            issues.append(analysis.LintIssue(
+                rule="memory-analysis", severity=analysis.ERROR,
+                message=f"{type(exc).__name__}: {exc}"))
+        else:
+            top = ", ".join(
+                f"{t.name} ({t.bytes / 1e6:.1f} MB)" for t in m.top(3))
+            severity = analysis.WARNING
+            verdict = ""
+            if budget is not None and m.peak_bytes > budget:
+                severity = analysis.ERROR
+                verdict = (f" EXCEEDS budget {budget / 1e9:.3f} GB;"
+                           f" top live: {top}")
+            issues.append(analysis.LintIssue(
+                rule="memory-budget", severity=severity,
+                message=f"static peak HBM {m.peak_bytes / 1e9:.3f} GB "
+                        f"at batch={batch} (resident "
+                        f"{m.resident_bytes / 1e9:.3f} GB, est "
+                        f"{m.estimated_step_seconds() * 1e3:.2f} ms/step"
+                        f"){verdict}",
+                op_index=m.peak_op_index, op_type=m.peak_op_type))
     return issues
 
 
@@ -146,6 +177,15 @@ def main(argv=None) -> int:
                     help="machine-readable findings on stdout")
     ap.add_argument("--warnings-as-errors", action="store_true",
                     help="exit nonzero on warnings too")
+    ap.add_argument("--mem", action="store_true",
+                    help="run the static peak-HBM/liveness analyzer per "
+                         "target (reported as a memory-budget finding)")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="with --mem: peak-HBM budget in bytes — a "
+                         "target whose static peak exceeds it is an "
+                         "error (nonzero exit)")
+    ap.add_argument("--batch", type=int, default=16,
+                    help="with --mem: batch size for -1 dims (default 16)")
     args = ap.parse_args(argv)
     if not args.model_dirs and not args.demo and not args.audit:
         ap.error("nothing to lint: give MODEL_DIR(s), --demo, or --audit")
@@ -178,7 +218,8 @@ def main(argv=None) -> int:
         for tag, program, feeds, fetches, scope in entries:
             issues = lint_target(tag, program, feeds, fetches, scope,
                                  check_shapes=not args.no_shapes,
-                                 rules=rules)
+                                 rules=rules, mem=args.mem,
+                                 budget=args.budget, batch=args.batch)
             n_errors += sum(i.severity == analysis.ERROR for i in issues)
             n_warnings += sum(i.severity == analysis.WARNING
                               for i in issues)
