@@ -728,6 +728,167 @@ def bench_memplan(jax, pt, layers, models, batch=8, hw=32):
             "transformer": one("transformer", build_transformer)}
 
 
+_COLD_START_CHILD = r'''
+import json, os, sys, time
+T0 = time.perf_counter()
+mode, workdir, cache_dir = sys.argv[1:4]
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers
+if cache_dir != "-":
+    pt.set_flags({"compilation_cache_dir": cache_dir})
+t_import = time.perf_counter() - T0
+
+if mode == "serve":
+    from paddle_tpu.serving import GenerationEngine
+
+    eng = GenerationEngine.from_saved(
+        os.path.join(workdir, "lm"), slots=2, prompt_buckets=(8,),
+        prefill_batch_buckets=(1, 2))
+    warmed = eng.warm_start()
+    t_ready = time.perf_counter() - T0
+    prompt = (np.arange(5) % 7).astype("int64")
+    out = eng.generate_all([prompt], max_new_tokens=1)
+    t_first = time.perf_counter() - T0
+    print(json.dumps({
+        "t_import_s": t_import, "t_ready_s": t_ready,
+        "t_first_token_s": t_first, "warmed": warmed,
+        "first_token": int(np.asarray(out[0])[-1]),
+        **eng.cache_stats()}))
+else:  # train: manual checkpoint/resume loop (boot-to-first-step)
+    from paddle_tpu.core import manifest as man
+
+    ckdir = os.path.join(workdir, "ck")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[64])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=64, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        pt.optimizer.MomentumOptimizer(
+            learning_rate=0.05, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    resumed = os.path.exists(os.path.join(ckdir, "checkpoint.meta"))
+    if resumed:
+        pt.checkpoint.load_checkpoint(ckdir, scope=scope)
+        m = pt.checkpoint.load_manifest(ckdir)
+        if m is not None:
+            man.replay(exe, [main], scope=scope, manifest=m)
+    rng = np.random.RandomState(3)
+    batches = [(rng.randn(16, 64).astype(np.float32),
+                rng.randn(16, 1).astype(np.float32)) for _ in range(4)]
+    losses, t_first = [], None
+    for bx, by in batches:
+        (lo,) = exe.run(main, feed={"x": bx, "y": by}, fetch_list=[loss],
+                        scope=scope)
+        if t_first is None:
+            t_first = time.perf_counter() - T0
+        losses.append(float(lo))
+    if not resumed:
+        pt.checkpoint.save_checkpoint(ckdir, scope=scope, step=len(batches))
+        pt.checkpoint.save_manifest(ckdir, exe)
+    print(json.dumps({
+        "t_import_s": t_import, "t_first_step_s": t_first,
+        "resumed": resumed, "losses": losses,
+        "finite": bool(np.all(np.isfinite(losses))),
+        **exe.cache_stats()}))
+'''
+
+
+def bench_cold_start(jax, pt, layers):
+    """Boot-to-first-token / boot-to-first-step, cold vs
+    manifest+cache-warm — the tentpole metric of the cold-start plane.
+
+    A fresh subprocess boots (a) a saved stacked-LM GenerationEngine
+    through ``warm_start()`` and serves one token, and (b) a checkpointed
+    train loop through manifest replay and runs its first step. The first
+    boot of each is the COLD leg (empty persistent cache, no manifest —
+    it populates both); the second boot is the WARM leg. The warm leg
+    must reach its first token/step with zero fresh compiles (every
+    executable restores from ``--compilation_cache_dir``), and the warm
+    train leg must stay finite — the restored-executable donation guard
+    (core/executor.py) in action. Entirely host-side: runs on the CPU
+    witness and rides the TPU sweep unchanged."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.xla_env import cpu_env
+
+    workdir = tempfile.mkdtemp(prefix="ptcold_")
+    cache_dir = os.path.join(workdir, "xla_cache")
+    os.makedirs(cache_dir)
+    child_py = os.path.join(workdir, "cold_child.py")
+    with open(child_py, "w") as f:
+        f.write(_COLD_START_CHILD)
+
+    # the serving artifact (built in-process; the children only load it)
+    from paddle_tpu import models as _models
+
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        prompt = layers.data("p_save", shape=[8], dtype="int64")
+        out_ids = _models.transformer_lm_generate(
+            prompt, vocab_size=64, d_model=32, n_layers=2, num_heads=2,
+            max_len=32, max_new_tokens=4)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    startup.random_seed = 5
+    exe.run(startup, scope=scope)
+    pt.io.save_inference_model(os.path.join(workdir, "lm"), ["p_save"],
+                               [out_ids], exe, main_program=prog,
+                               scope=scope)
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    if jax.devices()[0].platform == "cpu":
+        env = cpu_env(env)
+    env["PYTHONPATH"] = repo_dir + os.pathsep + env.get("PYTHONPATH", "")
+
+    def boot(mode):
+        proc = subprocess.run(
+            [sys.executable, child_py, mode, workdir, cache_dir],
+            env=env, cwd=repo_dir,
+            capture_output=True, text=True, timeout=600)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"cold-start child ({mode}) produced no record: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+
+    try:
+        serve_cold = boot("serve")
+        serve_warm = boot("serve")
+        train_cold = boot("train")
+        train_warm = boot("train")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    assert serve_cold["first_token"] == serve_warm["first_token"], \
+        "warm boot must serve the identical first token"
+    return {
+        "serve_cold_first_token_s": round(serve_cold["t_first_token_s"], 3),
+        "serve_warm_first_token_s": round(serve_warm["t_first_token_s"], 3),
+        "serve_speedup": round(serve_cold["t_first_token_s"]
+                               / serve_warm["t_first_token_s"], 2),
+        "serve_cold_fresh_compiles": serve_cold["fresh_compiles"],
+        "serve_warm_fresh_compiles": serve_warm["fresh_compiles"],
+        "serve_warm_persistent_hits": serve_warm["persistent_hits"],
+        "train_cold_first_step_s": round(train_cold["t_first_step_s"], 3),
+        "train_warm_first_step_s": round(train_warm["t_first_step_s"], 3),
+        "train_speedup": round(train_cold["t_first_step_s"]
+                               / train_warm["t_first_step_s"], 2),
+        "train_cold_fresh_compiles": train_cold["fresh_compiles"],
+        "train_warm_fresh_compiles": train_warm["fresh_compiles"],
+        "train_warm_donation_fallbacks": train_warm["donation_fallbacks"],
+        "train_warm_finite": train_warm["finite"],
+        "import_s": round(serve_warm["t_import_s"], 3),
+    }
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -888,6 +1049,7 @@ def assemble(rows, parent_notes=None):
         "train_pipeline": res("train_pipeline"),
         "checkpoint": res("checkpoint"),
         "memplan": res("memplan"),
+        "cold_start": res("cold_start"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -1050,6 +1212,10 @@ def run_bench(platform):
     # (CPU row is the path-works witness, TPU row rides the sweep)
     step("memplan", bench_memplan, jax, pt, layers, models,
          batch=batch if on_tpu else 8, hw=hw if on_tpu else 32)
+    # cold-start is host-side (compile plane): the CPU row IS the witness
+    # for the zero-fresh-compile warm-boot contract; the TPU row prices
+    # real first-compile seconds
+    step("cold_start", bench_cold_start, jax, pt, layers)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

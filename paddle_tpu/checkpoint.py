@@ -424,6 +424,28 @@ def _looks_intact(dirname: str, payload_name: str,
         return False
 
 
+def save_manifest(dirname: str, executor) -> Optional[str]:
+    """Persist ``executor``'s recorded compile signatures next to the
+    checkpoints (``warmup_manifest.json``) so a resuming process can
+    AOT-replay them before its first step — the cold-start half of the
+    resume contract (``SGD.train`` does both ends automatically when a
+    ``CheckpointConfig`` is passed). Returns the path, or None when
+    nothing compiled yet."""
+    if len(executor.manifest) == 0:
+        return None
+    return executor.manifest.save(dirname)
+
+
+def load_manifest(dirname: str):
+    """Load the checkpoint directory's warmup manifest (a
+    ``core.manifest.SignatureManifest``), or None when absent; raises
+    ``ManifestError`` naming the file on an unreadable version. Replay
+    it with ``core.manifest.replay(executor, [program], scope=...)``."""
+    from .core import manifest as manifest_mod
+
+    return manifest_mod.try_load(dirname)
+
+
 def latest_step(dirname: str, verify: bool = True) -> Optional[int]:
     """The step of the latest INTACT checkpoint, or None. A torn latest
     is skipped the same way ``load_checkpoint`` falls back; pass

@@ -183,7 +183,21 @@ define_bool("fused_conv_epilogue", False,
 define_string("compilation_cache_dir", "",
               "persist XLA compilations here (jax persistent cache): "
               "repeat runs of the same program skip the 20-40s "
-              "first-compile; empty = in-memory only")
+              "first-compile; empty = in-memory only. Pair with a "
+              "warmup manifest (core.manifest / tools/warmup.py) for "
+              "zero-fresh-compile boots")
+define_bool("verify_restored_donation", True,
+            "verify donated-state write-back the first time an "
+            "executable RESTORED from --compilation_cache_dir executes "
+            "(vs its no-donation twin), falling back to the twin on "
+            "mismatch — guards the jaxlib defect where deserialized CPU "
+            "executables read freed donated buffers and NaN training "
+            "state; the verdict persists in the cache dir so a fleet "
+            "pays the check once per backend")
+define_int32("warmup_concurrency", 4,
+             "thread-pool width for AOT manifest replay "
+             "(core.manifest.replay): XLA compilation is host-side and "
+             "releases the GIL, so boot-time signature compiles overlap")
 define_int32("seed", 0,
              "global graph RNG seed used when a program sets no "
              "random_seed of its own (ThreadLocalRand analogue); runs "

@@ -215,6 +215,7 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
         analysis.check_program(pruned, feeded_var_names, fetch_names,
                                scope=save_scope, annotate=False)
     os.makedirs(dirname, exist_ok=True)
+    _drop_stale_manifest(dirname)
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump({
             "program": program_to_dict(pruned),
@@ -224,6 +225,19 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     save_vars(executor, os.path.join(dirname, "params"),
               main_program=pruned, predicate=_is_persistable,
               scope=save_scope)
+
+
+def _drop_stale_manifest(dirname: str) -> None:
+    """Re-saving an artifact invalidates its warmup manifest: the old
+    signatures reference the previous program's digest, and leaving them
+    would make every future boot skip-replay (or merge-accumulate stale
+    records forever). The next warmup writes a fresh one."""
+    from .core.manifest import MANIFEST_NAME
+
+    try:
+        os.remove(os.path.join(dirname, MANIFEST_NAME))
+    except OSError:
+        pass
 
 
 def _load_saved_params(dirname: str) -> Scope:

@@ -95,6 +95,7 @@ class InferenceEngine:
                  mem_budget: Optional[float] = None):
         self.metrics = metrics or MetricsRegistry()
         self.scope = scope or Scope()
+        self.model_dir = model_dir  # manifest home (save_manifest/warm_start)
         self.mesh = mesh
         if mesh is not None and plan is None:
             from ..parallel import data_parallel_plan
@@ -348,7 +349,69 @@ class InferenceEngine:
                                       scope=self.scope)
                 combos += 1
         self.metrics.inc("warmup_compiles", combos)
+        self.save_manifest()
         return combos
+
+    # -- cold-start plane ----------------------------------------------
+    def save_manifest(self, dirname: Optional[str] = None) -> Optional[str]:
+        """Persist the executor's recorded compile signatures next to the
+        saved model (``warmup_manifest.json``) so the next replica can
+        AOT-replay them (:meth:`warm_from_manifest`) instead of paying
+        fresh compiles. No-op (returns None) without a model directory or
+        before anything compiled."""
+        dirname = dirname or self.model_dir
+        if dirname is None or len(self.executor.manifest) == 0:
+            return None
+        try:
+            return self.executor.manifest.save(dirname)
+        except OSError:  # read-only artifact volume: serving still works
+            return None
+
+    def warm_from_manifest(self,
+                           dirname: Optional[str] = None) -> Optional[int]:
+        """AOT-replay a saved warmup manifest: ``.lower().compile()`` of
+        every recorded signature of this engine's program, concurrently,
+        WITHOUT executing anything. Returns the number of signatures now
+        warm, or None when no manifest exists (caller falls back to the
+        execute-based :meth:`warmup`). With ``--compilation_cache_dir``
+        the compiles are disk restores and the first request is a pure
+        in-process cache hit."""
+        from ..core import manifest as manifest_mod
+
+        dirname = dirname or self.model_dir
+        if dirname is None:
+            return None
+        manifest = manifest_mod.try_load(dirname)
+        if manifest is None:
+            return None
+        stats = manifest_mod.replay(
+            self.executor, [self.program], scope=self.scope,
+            manifest=manifest, device_ctx=self._device_ctx)
+        self.metrics.inc("warmup_replayed", stats["compiled"])
+        if stats["skipped"]:
+            self.metrics.inc("warmup_manifest_skipped", stats["skipped"])
+        return stats["compiled"] + stats["already"]
+
+    def warm_start(self) -> int:
+        """Boot path: manifest replay when available (AOT, concurrent, no
+        execution), else execute-based :meth:`warmup`; either way a fresh
+        manifest lands next to the model so the NEXT replica boots warm.
+        A stale/foreign manifest degrades into ``warmup()`` instead of
+        failing the boot."""
+        import warnings as warnings_mod
+
+        from ..core.manifest import ManifestError
+
+        warmed = None
+        try:
+            warmed = self.warm_from_manifest()
+        except ManifestError as exc:
+            warnings_mod.warn(f"ignoring warmup manifest: {exc}",
+                              RuntimeWarning, stacklevel=2)
+        if warmed is None:
+            warmed = self.warmup()
+        self.save_manifest()
+        return warmed
 
     def cache_stats(self) -> dict:
         return self.executor.cache_stats()

@@ -151,6 +151,7 @@ class GenerationEngine:
         self.pad_id = int(pad_id)
         self._place = place
         self.metrics = metrics or MetricsRegistry()
+        self.model_dir: Optional[str] = None  # set by from_saved
         self.executor = Executor(place or TPUPlace(0))
         self.prompt_buckets = sorted(set(
             min(int(b), self.tmax) for b in
@@ -188,6 +189,7 @@ class GenerationEngine:
         scope = kw.pop("scope", None) or Scope()
         eng = cls(spec, scope, max_seq_len=max_seq_len, **kw)
         load_inference_model(model_dir, eng.executor, scope=scope)
+        eng.model_dir = model_dir  # manifest home for warm_start
         return eng
 
     def _init_cache(self):
@@ -236,8 +238,10 @@ class GenerationEngine:
             helper = LayerHelper("serving_prefill", main_program=prog,
                                  startup_program=startup)
             ck, cv = self._cache_vars(helper)
+            # fixed name (not unique_name): the serving programs must be
+            # bit-identical across boots so warmup-manifest digests match
             nxt = helper.block.create_var(
-                name=prog.unique_name("serving.next_tok"), shape=[-1],
+                name="serving.next_tok", shape=[-1],
                 dtype="int64", stop_gradient=True)
             ins = {"Prompt": [prompt], "SlotIds": [slot_ids],
                    "Lengths": [lengths], "CacheK": [ck], "CacheV": [cv]}
@@ -262,7 +266,7 @@ class GenerationEngine:
                                  startup_program=startup)
             ck, cv = self._cache_vars(helper)
             nxt = helper.block.create_var(
-                name=prog.unique_name("serving.next_tok"),
+                name="serving.next_tok",
                 shape=[self._nslots], dtype="int64", stop_gradient=True)
             ins = {"Tok": [tok], "Pos": [pos], "CacheK": [ck],
                    "CacheV": [cv]}
@@ -385,7 +389,73 @@ class GenerationEngine:
             self._run_decode()
         combos += 1
         self.metrics.inc("warmup_compiles", combos)
+        self.save_manifest()
         return combos
+
+    # -- cold-start plane -------------------------------------------------
+    def _warm_programs(self):
+        """Every program this engine compiles: the decode step plus one
+        prefill program per prompt bucket (built on demand — program
+        construction is cheap; compilation is what the manifest saves)."""
+        progs = [self._decode_prog[0]]
+        progs.extend(self._prefill_prog(tp)[0] for tp in self.prompt_buckets)
+        return progs
+
+    def save_manifest(self, dirname: Optional[str] = None) -> Optional[str]:
+        """Persist the compiled (prefill x batch bucket, decode)
+        signature set next to the saved model for AOT replay on the next
+        boot. No-op without a model directory."""
+        dirname = dirname or self.model_dir
+        if dirname is None or len(self.executor.manifest) == 0:
+            return None
+        try:
+            return self.executor.manifest.save(dirname)
+        except OSError:  # read-only artifact volume: serving still works
+            return None
+
+    def warm_from_manifest(self,
+                           dirname: Optional[str] = None) -> Optional[int]:
+        """AOT-replay the saved warmup manifest against the engine-built
+        decode/prefill programs (concurrent ``.lower().compile()``, no
+        execution, live slots untouched). Returns signatures warm, or
+        None when no manifest exists."""
+        from ..core import manifest as manifest_mod
+
+        dirname = dirname or self.model_dir
+        if dirname is None:
+            return None
+        manifest = manifest_mod.try_load(dirname)
+        if manifest is None:
+            return None
+        if self.temperature > 0:
+            # same contract as warmup(): seed the RNG plane first so the
+            # scope key set matches live traffic
+            self.executor._rng_state(self._decode_prog[0], self.scope)
+        stats = manifest_mod.replay(
+            self.executor, self._warm_programs(), scope=self.scope,
+            manifest=manifest, device_ctx=self._device_ctx)
+        self.metrics.inc("warmup_replayed", stats["compiled"])
+        if stats["skipped"]:
+            self.metrics.inc("warmup_manifest_skipped", stats["skipped"])
+        return stats["compiled"] + stats["already"]
+
+    def warm_start(self) -> int:
+        """Boot path: manifest replay when available, else execute-based
+        :meth:`warmup`; re-persists the manifest either way."""
+        import warnings as warnings_mod
+
+        from ..core.manifest import ManifestError
+
+        warmed = None
+        try:
+            warmed = self.warm_from_manifest()
+        except ManifestError as exc:
+            warnings_mod.warn(f"ignoring warmup manifest: {exc}",
+                              RuntimeWarning, stacklevel=2)
+        if warmed is None:
+            warmed = self.warmup()
+        self.save_manifest()
+        return warmed
 
     def _validate(self, req: Request):
         try:

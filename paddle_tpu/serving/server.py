@@ -16,7 +16,10 @@ the container bakes none), JSON in/out:
     GET  /metrics      -> MetricsRegistry snapshot + serving timers
     GET  /metrics?format=prom -> Prometheus text exposition (v0.0.4),
                        also selected by an Accept: text/plain header
-    GET  /healthz      -> {"ok": true, "active": ..., "queue": ...}
+    GET  /healthz      -> {"ok": true, "active": ..., "queue": ...};
+                       503 while ``warming`` (boot-time manifest replay /
+                       warmup) or ``draining``, so routers only send
+                       traffic to ready replicas
 
 Typed errors map onto status codes: QueueFullError -> 429,
 RequestTimeoutError -> 504, BadRequestError -> 400.
@@ -48,7 +51,7 @@ class Server:
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  default_timeout_ms: Optional[float] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 serve_retry=None):
+                 serve_retry=None, warmup=False):
         self.engines = list(engine) if isinstance(
             engine, (list, tuple)) else [engine]
         self.metrics = metrics or self.engines[0].metrics
@@ -63,6 +66,12 @@ class Server:
         # injected TransientFault) retries with backoff instead of
         # failing the whole formed batch.
         self._serve_retry = serve_retry
+        # warmup=True runs each engine's warm_start()/warmup() on the
+        # dispatch thread before serving; a callable runs instead of the
+        # default. While it runs, /healthz reports ``warming`` (503) so a
+        # router never sends traffic to a cold replica — the boot-side
+        # mirror of the drain machinery.
+        self._warmup = warmup
         self._thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._running = False
@@ -70,8 +79,10 @@ class Server:
 
     @property
     def state(self) -> str:
-        """``ready`` | ``draining`` | ``closed`` — what /healthz reports
-        (load balancers pull a draining replica out of rotation)."""
+        """``warming`` | ``ready`` | ``draining`` | ``closed`` — what
+        /healthz reports (load balancers route to ``ready`` only:
+        ``warming`` covers boot exactly like ``draining`` covers
+        shutdown)."""
         return self._state
 
     # -- lifecycle ---------------------------------------------------------
@@ -79,7 +90,7 @@ class Server:
         if self._thread is not None:
             return self
         self._running = True
-        self._state = "ready"
+        self._state = "warming" if self._warmup else "ready"
         self._thread = threading.Thread(target=self._loop,
                                         name="paddle-tpu-serving",
                                         daemon=True)
@@ -123,7 +134,34 @@ class Server:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def _do_warmup(self) -> None:
+        """Manifest replay / warmup on the dispatch thread, before the
+        first batch is pulled. Requests submitted meanwhile queue in the
+        batcher; /healthz says ``warming`` so routers hold traffic. A
+        warmup failure downgrades to lazy compiles instead of killing the
+        replica."""
+        t0 = time.monotonic()
+        try:
+            if callable(self._warmup):
+                self._warmup()
+            else:
+                for eng in self.engines:
+                    if not self._running:
+                        break
+                    warm = (getattr(eng, "warm_start", None)
+                            or getattr(eng, "warmup", None))
+                    if warm is not None:
+                        warm()
+        except Exception:  # noqa: BLE001 - cold replica beats dead replica
+            self.metrics.inc("warmup_errors")
+        self.metrics.set_gauge("warmup/boot_s",
+                               round(time.monotonic() - t0, 6))
+        if self._state == "warming":  # stop() during warmup wins
+            self._state = "ready"
+
     def _loop(self) -> None:
+        if self._warmup:
+            self._do_warmup()
         idx = 0
         while self._running:
             engine = self.engines[idx % len(self.engines)]
@@ -219,8 +257,9 @@ class Server:
                         return
                     self._send(200, server.metrics_snapshot())
                 elif path == "/healthz":
-                    # ready -> 200; draining/closed -> 503 so load
-                    # balancers stop routing while in-flight work finishes
+                    # ready -> 200; warming/draining/closed -> 503 so load
+                    # balancers route neither to a cold replica still
+                    # compiling nor to one finishing in-flight work
                     state = server.state
                     self._send(200 if state == "ready" else 503, {
                         "ok": state == "ready",

@@ -170,6 +170,13 @@ class SGD:
                 or faults.active_plan() is not None):
             rs = TrainResilience(checkpoint, scope=self.scope)
             rs.resume()  # restores scope + position from the latest ckpt
+            if checkpoint is not None and getattr(checkpoint, "dirname",
+                                                  None):
+                # cold-start replay: AOT-compile the step signatures the
+                # previous run recorded next to its checkpoints, BEFORE
+                # the first batch — with --compilation_cache_dir these
+                # are disk restores and resume pays zero fresh compiles
+                self._replay_manifest(checkpoint.dirname)
         import contextlib
 
         ctx = rs.signal_context() if rs is not None \
@@ -185,6 +192,47 @@ class SGD:
             raise
         if rs is not None:
             rs.finalize()
+            if checkpoint is not None and getattr(checkpoint, "dirname",
+                                                  None):
+                self._save_manifest(checkpoint.dirname)
+
+    def _replay_manifest(self, dirname: str):
+        """Resume-time warmup: AOT-replay the signature manifest saved
+        next to the checkpoints (see core.manifest). A missing manifest
+        is a normal first boot; a version-rejected one warns and falls
+        back to compile-on-first-step — resume must never die on a
+        warmup artifact."""
+        import warnings
+
+        from . import trace
+        from .core import manifest as manifest_mod
+
+        try:
+            manifest = manifest_mod.try_load(dirname)
+        except manifest_mod.ManifestError as exc:
+            warnings.warn(f"ignoring warmup manifest: {exc}",
+                          RuntimeWarning, stacklevel=2)
+            return None
+        if manifest is None:
+            return None
+        with trace.span("trainer/manifest_replay", dirname=dirname) as sp:
+            stats = manifest_mod.replay(
+                self.exe, [self.main_program, self.test_program],
+                scope=self.scope, manifest=manifest)
+            if sp is not None:
+                sp.set_attrs(**stats)
+        self._last_replay = stats
+        return stats
+
+    def _save_manifest(self, dirname: str) -> None:
+        """Persist the compile signatures of this run next to the
+        checkpoints so the next resume replays them."""
+        if len(self.exe.manifest) == 0:
+            return
+        try:
+            self.exe.manifest.save(dirname)
+        except OSError:
+            pass  # checkpoint volume gone: the run itself still succeeded
 
     def _train_passes(self, ctx, rs, reader, num_passes, event_handler,
                       test_reader, async_depth):

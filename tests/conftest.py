@@ -21,13 +21,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# NOTE: do NOT wire a session-wide persistent XLA compilation cache here
-# (tempting for suite speed): on this jaxlib, CPU executables restored
-# from the on-disk cache mishandle donated/aliased buffers — training
-# steps that donate state (Executor donate_argnums) read freed memory
-# and return NaNs (reproduced via test_master_checkpoint
-# test_save_resume_bit_exact going NaN at step 3 with a warm cache).
-# The production --compilation_cache_dir flag stays opt-in per process.
+# Persistent-cache note: on this jaxlib, CPU executables RESTORED from
+# the on-disk compilation cache mishandle donated/aliased buffers
+# (use-after-free: NaN'd training state, occasional heap aborts). The
+# executor now guards this — restored donating executables run their
+# no-donation twin (core/executor.py donation verdict plane), pinned by
+# tests/test_cold_start.py (save/resume is bit-exact with a warm cache).
+# The suite still runs without a session-wide cache dir simply because
+# tests don't need one; --compilation_cache_dir is safe to opt into.
 
 import numpy as np
 import pytest

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Pre-warm a saved model artifact from its signature manifest.
+
+Boots the right serving engine for a ``save_inference_model`` directory
+(GenerationEngine for stacked-LM decode programs, InferenceEngine
+otherwise), replays the artifact's ``warmup_manifest.json`` — AOT
+``.lower().compile()`` of every recorded signature, no execution — and
+(re)persists the manifest. Point ``--compilation_cache_dir`` at the
+volume your replicas mount and every compile lands on disk: the replicas
+then boot with ZERO fresh compiles (bench.py bench_cold_start measures
+the win; PERF.md records it).
+
+    python tools/warmup.py MODEL_DIR [--compilation_cache_dir DIR]
+        [--batch-buckets 1,2,4,8] [--seq-buckets 64,128]
+        [--slots N] [--prompt-buckets 8,16] [--max-seq-len N]
+
+Without a manifest (first warmup of a fresh artifact) the engine falls
+back to execute-based warmup and WRITES the manifest, so running this
+tool once per artifact is enough to make every later boot warm. Prints
+one JSON report line.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _csv_ints(s):
+    return tuple(int(x) for x in s.split(",") if x)
+
+
+def main(argv):
+    import paddle_tpu as pt
+
+    rest = pt.parse_flags(list(argv))
+    opts = {"batch-buckets": None, "seq-buckets": None, "slots": "8",
+            "prompt-buckets": None, "max-seq-len": None}
+    args = []
+    i = 0
+    while i < len(rest):
+        tok = rest[i]
+        if tok.startswith("--") and tok[2:].split("=")[0] in opts:
+            body = tok[2:]
+            name, eq, val = body.partition("=")
+            if not eq:
+                i += 1
+                val = rest[i]
+            opts[name] = val
+        else:
+            args.append(tok)
+        i += 1
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    model_dir = args[0]
+    if not os.path.isdir(model_dir):
+        print(f"error: {model_dir!r} is not a saved-model directory",
+              file=sys.stderr)
+        return 2
+
+    from paddle_tpu.io import read_inference_model_meta
+    from paddle_tpu.serving import InferenceEngine
+    from paddle_tpu.serving.generation import (_DECODE_OPS,
+                                               GenerationEngine)
+
+    t0 = time.perf_counter()
+    meta = read_inference_model_meta(model_dir)
+    ops = meta["program"]["blocks"][0]["ops"]
+    is_generation = any(op["type"] in _DECODE_OPS for op in ops)
+    if is_generation:
+        kw = {"slots": int(opts["slots"])}
+        if opts["prompt-buckets"]:
+            kw["prompt_buckets"] = _csv_ints(opts["prompt-buckets"])
+        if opts["max-seq-len"]:
+            kw["max_seq_len"] = int(opts["max-seq-len"])
+        engine = GenerationEngine.from_saved(model_dir, **kw)
+    else:
+        kw = {}
+        if opts["batch-buckets"]:
+            kw["batch_buckets"] = _csv_ints(opts["batch-buckets"])
+        if opts["seq-buckets"]:
+            kw["seq_buckets"] = _csv_ints(opts["seq-buckets"])
+        engine = InferenceEngine(model_dir, **kw)
+    warmed = engine.warm_start()
+    stats = engine.cache_stats()
+    report = {
+        "model_dir": model_dir,
+        "kind": "generation" if is_generation else "inference",
+        "signatures_warm": warmed,
+        "fresh_compiles": stats["fresh_compiles"],
+        "persistent_hits": stats["persistent_hits"],
+        "compilation_cache_dir": pt.FLAGS.compilation_cache_dir or None,
+        "manifest": os.path.join(model_dir, "warmup_manifest.json"),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
