@@ -889,6 +889,108 @@ def bench_cold_start(jax, pt, layers):
     }
 
 
+def bench_fleet(jax, pt, layers, n_replicas=3, n_requests=96,
+                slow_delay_s=0.06, storm_threads=4):
+    """Fleet availability + tail latency under injected chaos, hedging
+    A/B. Each leg builds a fresh 3-replica fleet over a small warmed
+    classifier, installs a FaultPlan that hard-crashes replica 1 and
+    slow-injects replica 2, and storms it; reports availability (ok
+    fraction), client P50/P99, and the absorb counters. The hedged leg
+    must hold P99 near the healthy baseline while the unhedged leg eats
+    the slow replica's delay — the A/B that prices hedging. Host-side
+    (router/thread plane): the CPU row is the witness."""
+    import threading
+
+    from paddle_tpu.resilience import FaultPlan
+    from paddle_tpu.serving import Fleet, InferenceEngine
+
+    main_prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[16])
+        out = layers.fc(layers.fc(x, size=32, act="relu"), size=4)
+    exe = pt.Executor(pt.CPUPlace())
+
+    def engine():
+        scope = pt.Scope()
+        exe.run(startup, scope=scope)
+        return InferenceEngine(
+            program=main_prog, feed_names=["x"], fetch_names=[out.name],
+            scope=scope, batch_buckets=(2, 4, 8), place=pt.CPUPlace())
+
+    def leg(hedge):
+        plan = (FaultPlan()
+                .at(step=1, kind="replica_crash")
+                .at(step=2, kind="slow_replica", delay_s=slow_delay_s))
+        fleet = Fleet([engine() for _ in range(n_replicas)],
+                      hedge=hedge, hedge_delay_ms=20,
+                      breaker={"failure_threshold": 2,
+                               "recovery_s": 0.5})
+        lat, errors = [], []
+        lock = threading.Lock()
+        rng = np.random.RandomState(0)
+        feeds = [rng.rand(16).astype(np.float32)
+                 for _ in range(n_requests)]
+
+        def storm(rows):
+            for row in rows:
+                t0 = time.perf_counter()
+                try:
+                    fleet.submit({"x": row}, timeout_ms=15_000).result(
+                        timeout=20)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat.append(dt)
+                except Exception as exc:  # noqa: BLE001 - availability
+                    with lock:
+                        errors.append(repr(exc)[:100])
+
+        with plan.active(), fleet:
+            storm(feeds[:2 * n_replicas])  # warm every replica
+            lat.clear()
+            t0 = time.perf_counter()
+            work = feeds[2 * n_replicas:]
+            per = max(1, len(work) // storm_threads)
+            threads = [threading.Thread(
+                target=storm, args=(work[i * per:(i + 1) * per],))
+                for i in range(storm_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            counters = fleet.metrics.snapshot()["counters"]
+        lat.sort()
+
+        def pq(q):
+            return (lat[min(len(lat) - 1, int(round(q * (len(lat) - 1))))]
+                    * 1e3 if lat else None)
+
+        total = len(lat) + len(errors)
+        return {
+            "availability": round(len(lat) / max(1, total), 4),
+            "ok": len(lat), "failed": len(errors),
+            "p50_ms": round(pq(0.50), 2), "p99_ms": round(pq(0.99), 2),
+            "wall_s": round(wall, 3),
+            "hedges": counters.get("hedges", 0),
+            "hedge_wins": counters.get("hedge_wins", 0),
+            "retries": counters.get("retries", 0),
+            "breaker_opens": counters.get("breaker_opens", 0),
+            "sheds": counters.get("sheds", 0),
+        }
+
+    hedged = leg(hedge=True)
+    unhedged = leg(hedge=False)
+    return {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "slow_delay_ms": round(slow_delay_s * 1e3, 1),
+        "hedged": hedged,
+        "unhedged": unhedged,
+        "p99_speedup": (round(unhedged["p99_ms"] / hedged["p99_ms"], 2)
+                        if hedged["p99_ms"] else None),
+    }
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -1050,6 +1152,7 @@ def assemble(rows, parent_notes=None):
         "checkpoint": res("checkpoint"),
         "memplan": res("memplan"),
         "cold_start": res("cold_start"),
+        "fleet": res("fleet"),
         "degraded": degraded or None,
         "image_zoo_train_bs128": zoo or None,
         "infer_bs16": infer_zoo or None,
@@ -1216,6 +1319,9 @@ def run_bench(platform):
     # for the zero-fresh-compile warm-boot contract; the TPU row prices
     # real first-compile seconds
     step("cold_start", bench_cold_start, jax, pt, layers)
+    # fleet chaos A/B is host-side too (router/thread plane): availability
+    # + hedging-vs-tail under injected replica crash/slowness
+    step("fleet", bench_fleet, jax, pt, layers)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary

@@ -22,6 +22,13 @@ points:
 - ``master_drop``     MasterClient, at RPC #k: the client socket is torn
                       down right before the call (a dropped connection the
                       retry policy must survive).
+- ``replica_crash``   serving fleet, replica #k: the replica goes hard-down
+                      (every attempt raises ConnectionError until
+                      ``revive()``) — the router's breaker must open and
+                      traffic must flow around it.
+- ``slow_replica``    serving fleet, replica #k: every attempt on the
+                      replica is delayed by ``delay_s`` (default 0.05) —
+                      the tail-latency case hedging must absorb.
 
 Manual chaos runs go through ``--fault_plan`` (flags.py), e.g.
 ``--fault_plan=preempt@5,torn_checkpoint@3`` — the trainer parses it when
@@ -34,7 +41,7 @@ import threading
 from typing import List, Optional, Tuple
 
 FAULT_KINDS = ("crash", "preempt", "executor_error", "torn_checkpoint",
-               "master_drop")
+               "master_drop", "replica_crash", "slow_replica")
 
 
 class SimulatedCrash(RuntimeError):

@@ -41,6 +41,8 @@ class Retry:
                  multiplier: float = 2.0, max_backoff: float = 2.0,
                  jitter: float = 0.0, deadline: Optional[float] = None,
                  retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+                 retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
+                 give_up_on: Tuple[Type[BaseException], ...] = (),
                  name: str = "retry", sleep: Callable[[float], None] = time.sleep):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
@@ -50,9 +52,22 @@ class Retry:
         self.max_backoff = float(max_backoff)
         self.jitter = float(jitter)
         self.deadline = deadline
-        self.retryable = tuple(retryable)
+        # ``retry_on`` is the explicit filter spelling (and wins over the
+        # legacy ``retryable`` default); ``give_up_on`` carves exceptions
+        # OUT of the retryable set — a ConnectionRefusedError subclass a
+        # caller knows is permanent must escape on the first attempt.
+        self.retryable = tuple(retry_on if retry_on is not None
+                               else retryable)
+        self.give_up_on = tuple(give_up_on)
         self.name = name
         self._sleep = sleep
+
+    def remaining(self, t_start: float) -> Optional[float]:
+        """Seconds left of the absolute deadline measured from
+        ``t_start`` (None = unbounded)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.monotonic() - t_start)
 
     def call(self, fn: Callable, *args,
              on_retry: Optional[Callable] = None, **kwargs):
@@ -65,14 +80,15 @@ class Retry:
             try:
                 out = fn(*args, **kwargs)
             except self.retryable as exc:
+                if self.give_up_on and isinstance(exc, self.give_up_on):
+                    raise
                 t1 = time.perf_counter()
                 trace.record("retry/attempt", t0, t1, policy=self.name,
                              attempt=attempt, error=repr(exc)[:200])
                 profiler.global_stat.add_count("retry/attempts", 1)
-                out_of_time = (
-                    self.deadline is not None
-                    and time.monotonic() - t_start >= self.deadline)
-                if attempt >= self.max_attempts or out_of_time:
+                remaining = self.remaining(t_start)
+                if attempt >= self.max_attempts or (
+                        remaining is not None and remaining <= 0):
                     profiler.global_stat.add_count("retry/exhausted", 1)
                     raise
                 if on_retry is not None:
@@ -80,6 +96,12 @@ class Retry:
                 sleep_s = min(delay, self.max_backoff)
                 if self.jitter:
                     sleep_s += random.uniform(0.0, self.jitter * sleep_s)
+                if remaining is not None and sleep_s >= remaining:
+                    # the backoff would overshoot the caller's remaining
+                    # budget — exhaust NOW instead of sleeping past the
+                    # deadline and retrying into certain failure
+                    profiler.global_stat.add_count("retry/exhausted", 1)
+                    raise
                 self._sleep(sleep_s)
                 delay *= self.multiplier
                 continue

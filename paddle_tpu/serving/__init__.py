@@ -15,22 +15,37 @@ model becomes a production server loop with
   API and a stdlib JSON HTTP endpoint;
 - :class:`MetricsRegistry` — QPS / queue depth / batch occupancy /
   latency quantiles / compile-cache hits as a plain dict snapshot,
-  publishable into :mod:`paddle_tpu.profiler`.
+  publishable into :mod:`paddle_tpu.profiler`;
+- :class:`Fleet` — the layer above one server: N replicas (in-process
+  or remote HTTP) behind a :class:`Router` with per-replica circuit
+  breakers, deadline-propagating retries to a different replica,
+  tail-latency hedging, typed load shedding, and zero-downtime rolling
+  weight updates (``Fleet.update_weights``).
 
-See demos/serving_lm.py for the end-to-end walkthrough.
+See demos/serving_lm.py and demos/serving_fleet.py for the end-to-end
+walkthroughs.
 """
 from .batcher import DynamicBatcher, Future, Request
-from .engine import InferenceEngine
-from .errors import (BadRequestError, EngineClosedError, QueueFullError,
-                     RequestTimeoutError, ServingError)
+from .engine import InferenceEngine, load_param_arrays, swap_scope_params
+from .errors import (BadRequestError, EngineClosedError,
+                     FleetOverloadedError, QueueFullError,
+                     ReplicaUnavailableError, RequestTimeoutError,
+                     ServingError)
+from .fleet import Fleet, HttpReplica, LocalReplica, Replica
 from .generation import GenerationEngine, LMSpec, spec_from_program_dict
 from .metrics import MetricsRegistry
+from .router import (CircuitBreaker, LeastLoadedPolicy, RoundRobinPolicy,
+                     Router, SessionAffinityPolicy)
 from .server import Server
 
 __all__ = [
     "DynamicBatcher", "Future", "Request",
     "InferenceEngine", "GenerationEngine", "LMSpec",
     "spec_from_program_dict", "MetricsRegistry", "Server",
+    "Fleet", "Replica", "LocalReplica", "HttpReplica",
+    "Router", "CircuitBreaker", "RoundRobinPolicy", "LeastLoadedPolicy",
+    "SessionAffinityPolicy", "load_param_arrays", "swap_scope_params",
     "ServingError", "QueueFullError", "RequestTimeoutError",
-    "BadRequestError", "EngineClosedError",
+    "BadRequestError", "EngineClosedError", "ReplicaUnavailableError",
+    "FleetOverloadedError",
 ]

@@ -77,6 +77,105 @@ def _round_buckets(buckets: Sequence[int], multiple: int) -> List[int]:
                    for b in buckets})
 
 
+def load_param_arrays(source) -> Dict[str, object]:
+    """``name -> array`` from any weight source a rolling update can
+    publish: a resilience checkpoint directory (the trainer's
+    ``CheckpointConfig`` output), a ``save_inference_model`` directory,
+    a Scope, or a plain dict of arrays."""
+    import os
+
+    from ..core.scope import Scope
+
+    if isinstance(source, dict):
+        return dict(source)
+    if isinstance(source, Scope):
+        return {k: source.get(k) for k in source.keys()}
+    dirname = str(source)
+    from .. import checkpoint as ckpt_mod
+
+    if os.path.exists(os.path.join(dirname, ckpt_mod.META_NAME)):
+        staging = Scope()
+        ckpt_mod.load_checkpoint(dirname, scope=staging)
+        return {k: staging.get(k) for k in staging.keys()}
+    if os.path.exists(os.path.join(dirname, "params", "MANIFEST.json")):
+        from ..io import _load_saved_params
+
+        staging = _load_saved_params(dirname)
+        return {k: staging.get(k) for k in staging.keys()}
+    raise ValueError(
+        f"{dirname!r} is neither a checkpoint directory "
+        f"({ckpt_mod.META_NAME}) nor a saved inference model "
+        f"(params/MANIFEST.json)")
+
+
+def swap_scope_params(scope, source, *, skip=(), strict: bool = True,
+                      device_ctx=None, metrics=None) -> Dict[str, int]:
+    """Hot-swap parameter values in a live serving scope.
+
+    Every value whose name exists in both ``source`` and ``scope`` is
+    replaced, but ONLY when shape and dtype match exactly — the compile
+    caches key on the scope's key set and the program signatures, so a
+    same-shape swap costs zero recompiles, and a mismatch (which WOULD
+    silently retrace every warm executable) raises instead of degrading
+    (``strict=False`` skips mismatches). Donation-safe: old arrays stay
+    alive for any outstanding RunHandle that captured them at dispatch;
+    new values are fresh device buffers.
+
+    Returns counters: swapped / skipped (not in scope, or in ``skip``) /
+    mismatched (strict=False only) / kept (scope keys the source lacks).
+    """
+    import contextlib
+
+    from ..core.program import RNG_VAR
+
+    skip = set(skip) | {RNG_VAR}
+    new = load_param_arrays(source)
+    scope_keys = set(scope.keys())
+    staged = []
+    stats = {"swapped": 0, "skipped": 0, "mismatched": 0, "kept": 0}
+    for name in sorted(new):
+        if name in skip or name not in scope_keys:
+            stats["skipped"] += 1
+            continue
+        old = scope.get(name)
+        arr = new[name]
+        old_sig = (tuple(np.shape(old)), str(getattr(old, "dtype", "?")))
+        new_sig = (tuple(np.shape(arr)), str(getattr(arr, "dtype", "?")))
+        if old_sig != new_sig:
+            if strict:
+                raise ValueError(
+                    f"swap_params: {name!r} is {new_sig} in the source "
+                    f"but {old_sig} live — a mismatched swap would "
+                    f"retrace every warm executable; publish a "
+                    f"same-architecture checkpoint (or pass "
+                    f"strict=False to skip)")
+            stats["mismatched"] += 1
+            continue
+        staged.append((name, arr))
+    if not staged and strict:
+        raise ValueError(
+            "swap_params: the source shares no parameter names with the "
+            f"live scope (source has {sorted(new)[:5]}..., scope has "
+            f"{sorted(scope_keys)[:5]}...) — wrong artifact? (pass "
+            "strict=False to no-op)")
+    stats["kept"] = len(scope_keys - skip - {n for n, _ in staged})
+    # stage fully, then install: a half-applied swap (mid-list error)
+    # must not leave the scope serving a chimera of old and new weights
+    import jax
+
+    with (device_ctx() if device_ctx is not None
+          else contextlib.nullcontext()):
+        staged = [(name, jax.device_put(np.asarray(arr)))
+                  for name, arr in staged]
+    for name, arr in staged:
+        scope.set(name, arr)
+    stats["swapped"] = len(staged)
+    if metrics is not None:
+        metrics.inc("param_swaps")
+        metrics.set_gauge("param_swap/last_swapped", stats["swapped"])
+    return stats
+
+
 class InferenceEngine:
     """Loads a saved inference model and serves padded-bucket batches.
 
@@ -415,6 +514,18 @@ class InferenceEngine:
 
     def cache_stats(self) -> dict:
         return self.executor.cache_stats()
+
+    def swap_params(self, source, *, strict: bool = True) -> Dict[str, int]:
+        """Zero-recompile param hot-swap (the rolling-update payload
+        step): replace this engine's weights in place from ``source`` (a
+        trainer checkpoint dir, a saved-model dir, a Scope, or a dict).
+        Shapes/dtypes must match the live values — the compile cache
+        keys on the scope's key set, so a same-signature swap keeps
+        every warm executable. Outstanding async dispatches keep the old
+        arrays alive until they resolve (donation-safe)."""
+        return swap_scope_params(self.scope, source, strict=strict,
+                                 device_ctx=self._device_ctx,
+                                 metrics=self.metrics)
 
     # ------------------------------------------------------------------
     @property

@@ -33,3 +33,22 @@ class BadRequestError(ServingError):
 
 class EngineClosedError(ServingError):
     """Submit after the server/engine was stopped."""
+
+
+class ReplicaUnavailableError(ServingError):
+    """No replica could be routed to for an attempt: every candidate is
+    draining, crashed, or behind an open circuit breaker. Retryable —
+    the fleet's retry loop backs off and re-routes."""
+
+
+class FleetOverloadedError(ServingError):
+    """Fleet admission rejected: the fleet-wide pending queue is at
+    capacity, or every replica's breaker is open (shed-before-queue).
+
+    Carries ``retry_after_s`` — the backoff hint clients should honor;
+    the HTTP front end maps it to 503 with a ``Retry-After`` header.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)

@@ -1,0 +1,927 @@
+"""Multi-replica serving fleet: retries, hedging, breakers, rolling updates.
+
+One :class:`Server` is one replica; this module is the layer above — the
+reference's pserver fleet behind etcd leases, rebuilt for the request
+path. A :class:`Fleet` owns N replicas behind one :class:`Replica`
+interface (:class:`LocalReplica` wraps an in-process Server/engine,
+:class:`HttpReplica` a remote ``Server.serve_http`` endpoint) and routes
+every request through the robustness stack:
+
+- **deadline propagation** — the request's remaining budget travels
+  router -> replica batcher -> engine, so no layer waits past the
+  caller's deadline;
+- **retries** — a failed attempt resubmits to a *different* replica with
+  :class:`paddle_tpu.resilience.Retry` backoff/jitter (idempotent
+  requests only; the absolute deadline is never overshot);
+- **hedging** — a request still unanswered after the P99-derived hedge
+  delay fires a second attempt on another replica; first answer wins,
+  the loser is abandoned and counted;
+- **circuit breakers** — per-replica closed/open/half-open driven by
+  outcome stats + ``/healthz`` probes (:mod:`.router`);
+- **load shedding** — bounded fleet-wide admission; over capacity (or
+  every breaker open) rejects with a typed
+  :class:`FleetOverloadedError` carrying Retry-After, *before* queueing;
+- **rolling weight updates** — :meth:`Fleet.update_weights` walks
+  replicas one at a time through drain (healthz 503) -> param hot-swap
+  (``swap_params``: same shapes/dtypes, no recompile) -> warm-start
+  verify (manifest replay) -> rejoin, so the fleet serves throughout.
+
+Chaos-testable end to end: the ``replica_crash`` / ``slow_replica``
+fault kinds (:mod:`paddle_tpu.resilience.faults`) fire per replica
+index, deterministically.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import trace
+from ..resilience.faults import TransientFault, active_plan
+from ..resilience.retry import Retry
+from .batcher import Future
+from .errors import (BadRequestError, EngineClosedError,
+                     FleetOverloadedError, QueueFullError,
+                     ReplicaUnavailableError, RequestTimeoutError,
+                     ServingError)
+from .metrics import MetricsRegistry
+from .router import Router
+
+#: attempt errors worth resubmitting to a different replica
+FLEET_RETRYABLE = (ConnectionError, TimeoutError, TransientFault,
+                   QueueFullError, EngineClosedError,
+                   ReplicaUnavailableError)
+#: errors that must escape immediately (bad input, expired deadline)
+FLEET_GIVE_UP = (BadRequestError, RequestTimeoutError)
+
+#: fleet-control meta keys never forwarded to the replica's batcher
+_FLEET_META = ("session", "idempotent")
+
+_POLL_S = 0.001  # attempt-completion poll (local futures have no waitset)
+
+
+class _Attempt:
+    """One in-flight try of a request on one replica. ``not_before``
+    implements the ``slow_replica`` fault: the result exists but is not
+    VISIBLE until the injected delay elapses — exactly how a slow remote
+    looks to the router."""
+
+    __slots__ = ("future", "replica", "hedge", "not_before", "t0")
+
+    def __init__(self, future: Future, replica: "Replica",
+                 hedge: bool = False, not_before: Optional[float] = None):
+        self.future = future
+        self.replica = replica
+        self.hedge = hedge
+        self.not_before = not_before
+        self.t0 = time.perf_counter()
+
+    def done(self) -> bool:
+        if self.not_before is not None \
+                and time.monotonic() < self.not_before:
+            return False
+        return self.future.done()
+
+
+class Replica:
+    """The one interface the router sees. Subclasses provide transport."""
+
+    name: str = "?"
+    index: int = 0
+    fleet_size: int = 1
+
+    @property
+    def routable(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def inflight(self) -> int:
+        return 0
+
+    def begin(self, payload, meta: dict,
+              timeout_ms: Optional[float]) -> _Attempt:
+        raise NotImplementedError
+
+    def healthz(self) -> dict:
+        raise NotImplementedError
+
+    def drain(self, wait: bool = True, timeout: float = 30.0) -> None:
+        raise NotImplementedError
+
+    def rejoin(self) -> None:
+        raise NotImplementedError
+
+    def swap_params(self, source) -> dict:
+        raise NotImplementedError
+
+    def warm_verify(self) -> Optional[int]:
+        return None
+
+    def metrics_snapshot(self) -> dict:
+        return {}
+
+    def close(self, drain: bool = False) -> None:
+        pass
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name!r})"
+
+
+class LocalReplica(Replica):
+    """An in-process engine (or prebuilt Server) as a fleet replica.
+
+    The ``replica_crash`` / ``slow_replica`` fault kinds fire against the
+    replica *index* (``plan.at(step=1, kind="replica_crash")`` kills
+    replica 1); a crashed replica raises ConnectionError on every attempt
+    until :meth:`revive`.
+    """
+
+    def __init__(self, target, name: Optional[str] = None, **server_kwargs):
+        from .server import Server
+
+        if isinstance(target, Server):
+            self.server = target
+            self._owns_server = False
+        else:
+            engines = target if isinstance(target, (list, tuple)) \
+                else [target]
+            self.server = Server(list(engines), **server_kwargs)
+            self._owns_server = True
+        if name is not None:
+            self.name = name
+        self._crashed = False
+        self._slow_s: Optional[float] = None
+
+    # -- chaos ----------------------------------------------------------
+    def _fault_gate(self) -> None:
+        plan = active_plan()
+        if plan is not None:
+            if not self._crashed \
+                    and plan.fire("replica_crash", self.index) is not None:
+                self._crashed = True
+            if self._slow_s is None:
+                p = plan.fire("slow_replica", self.index)
+                if p is not None:
+                    self._slow_s = float(p.get("delay_s", 0.05))
+        if self._crashed:
+            raise ConnectionError(
+                f"replica {self.name}: injected crash (fault plan)")
+
+    def revive(self) -> None:
+        """Clear injected crash/slowness — the 'operator replaced the
+        pod' step of a chaos run."""
+        self._crashed = False
+        self._slow_s = None
+
+    # -- Replica interface ----------------------------------------------
+    @property
+    def routable(self) -> bool:
+        # deliberately blind to the injected crash: a dead replica looks
+        # routable until its failures trip the breaker — exactly like a
+        # remote whose process died. Drain state IS control-plane
+        # knowledge (we initiated it), so it short-circuits here.
+        return self.server.state == "ready"
+
+    @property
+    def inflight(self) -> int:
+        eng_active = sum(getattr(e, "active", 0)
+                         + getattr(e, "_inflight", 0)
+                         for e in self.server.engines)
+        return self.server.batcher.depth + eng_active
+
+    def begin(self, payload, meta: dict,
+              timeout_ms: Optional[float]) -> _Attempt:
+        self._fault_gate()
+        fwd = {k: v for k, v in meta.items() if k not in _FLEET_META}
+        fut = self.server.submit(payload, timeout_ms=timeout_ms, **fwd)
+        not_before = (time.monotonic() + self._slow_s
+                      if self._slow_s else None)
+        return _Attempt(fut, self, not_before=not_before)
+
+    def healthz(self) -> dict:
+        if self._crashed:
+            return {"state": "dead", "ok": False}
+        return {"state": self.server.state,
+                "ok": self.server.state == "ready",
+                "queue": self.server.batcher.depth}
+
+    def drain(self, wait: bool = True, timeout: float = 30.0) -> None:
+        self.server.pause(wait=wait, timeout=timeout)
+
+    def rejoin(self) -> None:
+        self.server.resume()
+
+    def swap_params(self, source) -> dict:
+        stats: Dict[str, int] = {}
+        for eng in self.server.engines:
+            for k, v in eng.swap_params(source).items():
+                stats[k] = stats.get(k, 0) + v
+        return stats
+
+    def warm_verify(self) -> Optional[int]:
+        warmed = None
+        for eng in self.server.engines:
+            warm = getattr(eng, "warm_from_manifest", None)
+            if warm is None:
+                continue
+            try:
+                n = warm()
+            except Exception:  # noqa: BLE001 - verify is best-effort
+                n = None
+            if n is not None:
+                warmed = (warmed or 0) + n
+        return warmed
+
+    def metrics_snapshot(self) -> dict:
+        return self.server.metrics.snapshot()
+
+    def cache_stats(self) -> dict:
+        out: Dict[str, int] = {}
+        for eng in self.server.engines:
+            if hasattr(eng, "cache_stats"):
+                for k, v in eng.cache_stats().items():
+                    if isinstance(v, (int, float)):
+                        out[k] = out.get(k, 0) + v
+        return out
+
+    def close(self, drain: bool = False) -> None:
+        self.server.stop(drain=drain)
+
+
+class HttpReplica(Replica):
+    """A remote ``Server.serve_http`` endpoint as a fleet replica.
+
+    Data plane: POST /v1/generate | /v1/infer (picked by payload shape).
+    Control plane: GET /healthz, POST /admin/drain | /admin/resume |
+    /admin/swap — the endpoints ``tools/fleetctl.py`` also drives.
+    HTTP statuses map back onto the typed serving errors, so the router
+    treats a remote exactly like a local replica.
+    """
+
+    def __init__(self, base_url: str, name: Optional[str] = None,
+                 connect_timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        if name is not None:
+            self.name = name
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._draining = False
+
+    # -- transport -------------------------------------------------------
+    def _http(self, method: str, path: str, body: Optional[dict] = None,
+              timeout_s: Optional[float] = None) -> dict:
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.connect_timeout_s) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            try:
+                detail = json.loads(exc.read() or b"{}").get("error", "")
+            except (ValueError, OSError):
+                detail = ""
+            msg = f"{self.name} {path} -> {exc.code}: {detail}"
+            if exc.code == 429:
+                raise QueueFullError(msg) from None
+            if exc.code in (503, 502):
+                raise EngineClosedError(msg) from None
+            if exc.code in (504, 408):
+                raise RequestTimeoutError(msg) from None
+            if exc.code == 400:
+                raise BadRequestError(msg) from None
+            raise ServingError(msg) from None
+        except urllib.error.URLError as exc:
+            raise ConnectionError(
+                f"{self.name} unreachable: {exc.reason}") from None
+        except TimeoutError:
+            raise RequestTimeoutError(
+                f"{self.name} {path} timed out") from None
+
+    # -- Replica interface ----------------------------------------------
+    @property
+    def routable(self) -> bool:
+        return not self._draining
+
+    def begin(self, payload, meta: dict,
+              timeout_ms: Optional[float]) -> _Attempt:
+        fut = Future()
+        if isinstance(payload, dict) and "prompt" in payload:
+            path = "/v1/generate"
+            body = {"prompt": np.asarray(payload["prompt"]).tolist()}
+            for k in ("max_new_tokens", "eos_id"):
+                if meta.get(k) is not None:
+                    body[k] = meta[k]
+        else:
+            path = "/v1/infer"
+            body = {"inputs": {k: np.asarray(v).tolist()
+                               for k, v in payload.items()}}
+        if timeout_ms is not None:
+            body["timeout_ms"] = timeout_ms
+            body["timeout_s"] = timeout_ms / 1e3
+        timeout_s = (timeout_ms / 1e3 + 1.0) if timeout_ms is not None \
+            else None
+
+        def run():
+            try:
+                out = self._http("POST", path, body, timeout_s=timeout_s)
+                fut.set_result(np.asarray(out["ids"])
+                               if "ids" in out
+                               else [np.asarray(o)
+                                     for o in out["outputs"]])
+            except BaseException as exc:  # noqa: BLE001 - typed above
+                fut.set_exception(exc)
+
+        threading.Thread(target=run, name=f"fleet-http-{self.name}",
+                         daemon=True).start()
+        return _Attempt(fut, self)
+
+    def healthz(self) -> dict:
+        import urllib.error
+
+        try:
+            return self._http("GET", "/healthz")
+        except EngineClosedError:
+            # 503 carries the state body; re-read it as health, not error
+            try:
+                import urllib.request
+
+                with urllib.request.urlopen(
+                        self.base_url + "/healthz",
+                        timeout=self.connect_timeout_s):
+                    pass
+            except urllib.error.HTTPError as exc:
+                try:
+                    return json.loads(exc.read() or b"{}")
+                except ValueError:
+                    pass
+            except Exception:  # noqa: BLE001
+                pass
+            return {"state": "draining", "ok": False}
+        except Exception:  # noqa: BLE001 - unreachable == dead
+            return {"state": "unreachable", "ok": False}
+
+    def drain(self, wait: bool = True, timeout: float = 30.0) -> None:
+        self._http("POST", "/admin/drain",
+                   {"wait": wait, "timeout": timeout},
+                   timeout_s=timeout + 5.0)
+        self._draining = True
+
+    def rejoin(self) -> None:
+        self._http("POST", "/admin/resume", {})
+        self._draining = False
+
+    def swap_params(self, source) -> dict:
+        return self._http("POST", "/admin/swap",
+                          {"checkpoint_dir": str(source)},
+                          timeout_s=120.0)
+
+    def warm_verify(self) -> Optional[int]:
+        out = self._http("POST", "/admin/warm", {}, timeout_s=300.0)
+        return out.get("warmed")
+
+    def metrics_snapshot(self) -> dict:
+        try:
+            return self._http("GET", "/metrics")
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            return {}
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+class Fleet:
+    """N replicas + a Router, behind one ``submit()``.
+
+    replicas:        Replica instances, engines, or Servers (the latter
+                     two are wrapped in LocalReplica).
+    policy:          router pick policy (default LeastLoadedPolicy).
+    retry:           a ``resilience.Retry`` carrying the backoff/jitter/
+                     max_attempts knobs for per-request resubmission
+                     (its ``deadline`` is ignored — each request's own
+                     deadline governs).
+    hedge:           fire a second attempt on another replica when the
+                     first is still unanswered after the hedge delay.
+    hedge_delay_ms:  fixed hedge delay; None derives it from the P99 of
+                     observed attempt latency (>= ``hedge_min_ms``).
+    max_pending:     fleet-wide admission bound — beyond it submits shed
+                     with FleetOverloadedError (Retry-After attached).
+    breaker:         kwargs for each replica's CircuitBreaker.
+    """
+
+    def __init__(self, replicas: Sequence, *, policy=None,
+                 retry: Optional[Retry] = None, hedge: bool = True,
+                 hedge_delay_ms: Optional[float] = None,
+                 hedge_min_ms: float = 20.0, max_pending: int = 256,
+                 default_timeout_ms: Optional[float] = 30_000.0,
+                 breaker: Optional[dict] = None, workers: Optional[int] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.metrics = metrics or MetricsRegistry()
+        self.replicas: List[Replica] = []
+        for i, rep in enumerate(replicas):
+            if not isinstance(rep, Replica):
+                rep = LocalReplica(rep)
+            if rep.name == "?":
+                rep.name = f"r{i}"
+            rep.index = i
+            rep.fleet_size = len(replicas)
+            self.replicas.append(rep)
+        self.router = Router(self.replicas, policy=policy,
+                             breaker_kwargs=breaker, metrics=self.metrics)
+        self.retry = retry or Retry(max_attempts=3, backoff=0.01,
+                                    multiplier=2.0, jitter=0.25,
+                                    name="fleet")
+        self.hedge = bool(hedge)
+        self.hedge_delay_ms = hedge_delay_ms
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.max_pending = int(max_pending)
+        self.default_timeout_ms = default_timeout_ms
+        # materialize the headline counters at 0 so dashboards (and the
+        # Prometheus text) show them before the first shed/hedge happens
+        for counter in ("requests", "completed", "failed", "attempts",
+                        "retries", "hedges", "hedge_wins", "sheds",
+                        "breaker_opens"):
+            self.metrics.inc(counter, 0)
+        self._attempt_lat: deque = deque(maxlen=512)  # hedge-delay source
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._closed = False
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._workers = workers or max(8, 4 * len(self.replicas))
+        self._httpd = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Fleet":
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix="paddle-tpu-fleet")
+            for rep in self.replicas:
+                if isinstance(rep, LocalReplica) \
+                        and rep.server._thread is None:
+                    rep.server.start()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        self._closed = True
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        for rep in self.replicas:
+            rep.close(drain=drain)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission -------------------------------------------------------
+    def submit(self, payload, timeout_ms: Optional[float] = None,
+               **meta) -> Future:
+        """Route one request through the fleet; returns a Future.
+
+        Sheds (typed FleetOverloadedError, Retry-After attached) when the
+        fleet queue is at capacity or no replica can take traffic —
+        *before* queueing, so overload degrades into fast typed failures.
+        ``meta['session']`` keys session affinity;
+        ``meta['idempotent']=False`` disables retries/hedging for
+        requests that must execute at most once.
+        """
+        if self._closed:
+            raise EngineClosedError("fleet is stopped")
+        self.start()
+        if not self.router.any_routable():
+            self.metrics.inc("sheds")
+            raise FleetOverloadedError(
+                "every replica is down or breaker-open; shedding before "
+                "queueing", retry_after_s=max(
+                    0.05, self.router.min_recovery_s()))
+        with self._lock:
+            if self._pending >= self.max_pending:
+                self.metrics.inc("sheds")
+                raise FleetOverloadedError(
+                    f"fleet queue at capacity ({self.max_pending})",
+                    retry_after_s=0.5)
+            self._pending += 1
+        self.metrics.inc("requests")
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        fut = Future()
+        span = trace.start_span("fleet/request", detached=True,
+                                timeout_ms=timeout_ms)
+        self._pool.submit(self._run, fut, payload, dict(meta), deadline,
+                          span)
+        return fut
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout_s: Optional[float] = 60.0, **meta) -> np.ndarray:
+        """Blocking convenience wrapper for LM fleets."""
+        fut = self.submit({"prompt": prompt},
+                          timeout_ms=None if timeout_s is None
+                          else timeout_s * 1e3,
+                          max_new_tokens=max_new_tokens, eos_id=eos_id,
+                          **meta)
+        return fut.result(timeout=None if timeout_s is None
+                          else timeout_s + 5.0)
+
+    # -- request execution ----------------------------------------------
+    def _remaining_ms(self, deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(1.0, (deadline - time.monotonic()) * 1e3)
+
+    def _hedge_delay_s(self) -> float:
+        if self.hedge_delay_ms is not None:
+            return self.hedge_delay_ms / 1e3
+        lat = sorted(self._attempt_lat)
+        if len(lat) < 16:
+            return self.hedge_min_ms / 1e3
+        p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+        return max(self.hedge_min_ms / 1e3, p99)
+
+    def _run(self, fut: Future, payload, meta: dict,
+             deadline: Optional[float], span) -> None:
+        t0 = time.monotonic()
+        try:
+            result = self._execute(payload, meta, deadline, span)
+        except BaseException as exc:  # noqa: BLE001 - typed, re-raised
+            self.metrics.inc("failed")
+            if span is not None:
+                span.finish(status="error", error=repr(exc)[:200])
+            fut.set_exception(exc)
+        else:
+            self.metrics.inc("completed")
+            self.metrics.observe_latency(time.monotonic() - t0)
+            if span is not None:
+                span.finish(status="ok")
+            fut.set_result(result)
+        finally:
+            with self._lock:
+                self._pending -= 1
+
+    def _execute(self, payload, meta: dict, deadline: Optional[float],
+                 span):
+        """The retry loop: each attempt routes to a replica not yet
+        tried (falling back to re-tries when the fleet is smaller than
+        max_attempts), with resilience.Retry supplying backoff/jitter
+        and the deadline-clamp semantics."""
+        tried: List[str] = []
+        idempotent = meta.get("idempotent", True)
+        remaining = (None if deadline is None
+                     else deadline - time.monotonic())
+        policy = Retry(
+            max_attempts=self.retry.max_attempts if idempotent else 1,
+            backoff=self.retry.backoff, multiplier=self.retry.multiplier,
+            max_backoff=self.retry.max_backoff, jitter=self.retry.jitter,
+            deadline=remaining, retry_on=FLEET_RETRYABLE,
+            give_up_on=FLEET_GIVE_UP, name="fleet",
+            sleep=self.retry._sleep)
+
+        def one_attempt():
+            replica = self.router.route(meta, exclude=tried) \
+                or self.router.route(meta)
+            if replica is None:
+                raise ReplicaUnavailableError(
+                    "no routable replica (all draining, dead, or "
+                    "breaker-open)")
+            if replica.name not in tried:
+                tried.append(replica.name)
+            if len(tried) > 1:
+                self.metrics.inc("retries")
+            return self._attempt_with_hedge(replica, payload, meta,
+                                            deadline, span,
+                                            hedge=idempotent and self.hedge)
+
+        return policy.call(one_attempt)
+
+    def _begin(self, replica: Replica, payload, meta: dict,
+               deadline: Optional[float], span, hedge: bool) -> _Attempt:
+        self.metrics.inc("attempts")
+        att = replica.begin(payload, meta, self._remaining_ms(deadline))
+        att.hedge = hedge
+        if span is not None:
+            span.set_attrs(replica=replica.name)
+        return att
+
+    def _attempt_with_hedge(self, replica: Replica, payload, meta: dict,
+                            deadline: Optional[float], span,
+                            hedge: bool):
+        """Run one attempt; optionally fire a hedge on another replica
+        after the hedge delay. First SUCCESS wins (a fast failure lets
+        the surviving attempt keep going); raises when every in-flight
+        attempt has failed — the caller's Retry decides what's next."""
+        try:
+            attempts = [self._begin(replica, payload, meta, deadline,
+                                    span, hedge=False)]
+        except FLEET_RETRYABLE as exc:
+            # a synchronous begin() failure (dead transport, closed
+            # server) is an outcome too — the breaker must see it
+            self.router.record(replica, ok=False,
+                               reason=type(exc).__name__)
+            now = time.perf_counter()
+            trace.record("fleet/attempt", now, now, parent=span,
+                         replica=replica.name, hedge=False,
+                         status="begin_error", error=repr(exc)[:200])
+            raise
+        hedge_at = (time.monotonic() + self._hedge_delay_s()
+                    if hedge and len(self.replicas) > 1 else None)
+        last_exc: Optional[BaseException] = None
+        while True:
+            for att in list(attempts):
+                if not att.done():
+                    continue
+                t1 = time.perf_counter()
+                try:
+                    value = att.future.result(timeout=0)
+                except BaseException as exc:  # noqa: BLE001 - outcome
+                    attempts.remove(att)
+                    last_exc = exc
+                    self.router.record(att.replica, ok=False,
+                                       reason=type(exc).__name__)
+                    self._attempt_lat.append(t1 - att.t0)
+                    self.metrics.observe_latency(t1 - att.t0,
+                                                 name="attempt")
+                    trace.record("fleet/attempt", att.t0, t1,
+                                 parent=span, replica=att.replica.name,
+                                 hedge=att.hedge, status="error",
+                                 error=repr(exc)[:200])
+                    continue
+                # success — first answer wins
+                self.router.record(att.replica, ok=True)
+                self._attempt_lat.append(t1 - att.t0)
+                self.metrics.observe_latency(t1 - att.t0, name="attempt")
+                trace.record("fleet/attempt", att.t0, t1, parent=span,
+                             replica=att.replica.name, hedge=att.hedge,
+                             status="ok")
+                if att.hedge:
+                    self.metrics.inc("hedge_wins")
+                for loser in attempts:
+                    if loser is not att:
+                        self.metrics.inc("hedge_cancelled")
+                        self.router.release(loser.replica)
+                return value
+            if not attempts:
+                raise last_exc or ReplicaUnavailableError(
+                    "attempt vanished without an outcome")
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                for att in attempts:  # abandoned without an outcome
+                    self.router.release(att.replica)
+                raise RequestTimeoutError(
+                    "fleet deadline expired with attempts still in "
+                    f"flight on {[a.replica.name for a in attempts]}")
+            if hedge_at is not None and now >= hedge_at:
+                hedge_at = None
+                exclude = [a.replica.name for a in attempts]
+                backup = self.router.route(meta, exclude=exclude)
+                if backup is not None:
+                    self.metrics.inc("hedges")
+                    trace.record("fleet/hedge", time.perf_counter(),
+                                 time.perf_counter(), parent=span,
+                                 primary=replica.name,
+                                 backup=backup.name)
+                    try:
+                        att = self._begin(backup, payload, meta,
+                                          deadline, span, hedge=True)
+                    except FLEET_RETRYABLE as exc:
+                        self.router.record(backup, ok=False,
+                                           reason=type(exc).__name__)
+                    else:
+                        attempts.append(att)
+            time.sleep(_POLL_S)
+
+    # -- rolling weight updates ------------------------------------------
+    def update_weights(self, checkpoint_dir: str, *, verify: bool = True,
+                       drain_timeout: float = 30.0) -> dict:
+        """Zero-downtime rolling param swap: one replica at a time is
+        drained (healthz flips to 503, the router stops sending, in-
+        flight work finishes), hot-swapped from ``checkpoint_dir`` (a
+        resilience checkpoint dir or a ``save_inference_model`` dir —
+        same shapes/dtypes, so the warm compile caches survive),
+        warm-verified (manifest replay), and rejoined before the next
+        one drains. The rest of the fleet serves throughout."""
+        results = []
+        for rep in self.replicas:
+            t0 = time.monotonic()
+            with trace.span("fleet/rolling_update", replica=rep.name,
+                            checkpoint_dir=str(checkpoint_dir)):
+                rep.drain(wait=True, timeout=drain_timeout)
+                try:
+                    swap = rep.swap_params(checkpoint_dir)
+                    warmed = rep.warm_verify() if verify else None
+                finally:
+                    rep.rejoin()
+            self.metrics.inc("weight_updates")
+            results.append({"replica": rep.name, "swap": swap,
+                            "warm_verified": warmed,
+                            "seconds": round(time.monotonic() - t0, 6)})
+        self.metrics.inc("rolling_updates")
+        return {"checkpoint_dir": str(checkpoint_dir),
+                "replicas": results}
+
+    # -- observability ----------------------------------------------------
+    def _replica_by(self, key) -> Replica:
+        for rep in self.replicas:
+            if rep.name == key or rep.index == key:
+                return rep
+        raise KeyError(f"no replica {key!r}; have "
+                       f"{[r.name for r in self.replicas]}")
+
+    def _refresh_labels(self) -> None:
+        for rep in self.replicas:
+            health = rep.healthz()
+            self.metrics.set_labeled(
+                "fleet_replica_health",
+                1.0 if health.get("state") == "ready" else 0.0,
+                replica=rep.name, state=health.get("state", "?"))
+            self.metrics.set_labeled("fleet_replica_inflight",
+                                     rep.inflight, replica=rep.name)
+        from .router import BREAKER_GAUGE
+
+        for name, state in self.router.breaker_states().items():
+            self.metrics.set_labeled("fleet_breaker_state",
+                                     BREAKER_GAUGE[state], replica=name)
+
+    def status(self) -> dict:
+        self._refresh_labels()
+        return {
+            "replicas": [{
+                "name": rep.name,
+                "index": rep.index,
+                "health": rep.healthz(),
+                "inflight": rep.inflight,
+                "breaker": self.router.breakers[rep.name].state,
+            } for rep in self.replicas],
+            "pending": self._pending,
+            "max_pending": self.max_pending,
+            "hedge": self.hedge,
+            "hedge_delay_ms": round(self._hedge_delay_s() * 1e3, 3),
+            "counters": self.metrics.snapshot()["counters"],
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """Fleet registry + MetricsRegistry.merge() of every replica's
+        snapshot — the /metrics body."""
+        self._refresh_labels()
+        snap = self.metrics.snapshot()
+        snap["fleet"] = MetricsRegistry.merge(
+            {rep.name: rep.metrics_snapshot() for rep in self.replicas})
+        return snap
+
+    def metrics_prometheus(self) -> str:
+        self._refresh_labels()
+        return self.metrics.prometheus_text()
+
+    # -- HTTP front end ---------------------------------------------------
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """The fleet's own JSON endpoint: /v1/* data plane routed through
+        the fleet, /fleet/* control plane for ``tools/fleetctl.py``."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        fleet = self
+        self.start()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj, headers=()) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    ok = fleet.router.any_routable() \
+                        and not fleet._closed
+                    self._send(200 if ok else 503, {
+                        "ok": ok,
+                        "state": "ready" if ok else "unavailable",
+                        "replicas": {
+                            r.name: r.healthz().get("state")
+                            for r in fleet.replicas},
+                    })
+                elif path == "/metrics":
+                    if "format=prom" in query:
+                        body = fleet.metrics_prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._send(200, fleet.metrics_snapshot())
+                elif path == "/fleet/status":
+                    self._send(200, fleet.status())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, TypeError) as exc:
+                    self._send(400, {"error": f"bad JSON: {exc}"})
+                    return
+                try:
+                    self._route_post(req)
+                except KeyError as exc:
+                    self._send(400, {"error": f"missing field {exc}"})
+                except BadRequestError as exc:
+                    self._send(400, {"error": str(exc)})
+                except FleetOverloadedError as exc:
+                    self._send(503, {"error": str(exc),
+                                     "retry_after_s": exc.retry_after_s},
+                               headers=[("Retry-After", str(max(
+                                   1, int(round(exc.retry_after_s)))))])
+                except QueueFullError as exc:
+                    self._send(429, {"error": str(exc)})
+                except (RequestTimeoutError, TimeoutError) as exc:
+                    self._send(504, {"error": str(exc) or "timed out"})
+                except (EngineClosedError, ServingError) as exc:
+                    self._send(503, {"error": str(exc)})
+                except ConnectionError as exc:
+                    # retries exhausted against dead replicas
+                    self._send(502, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 - don't drop conn
+                    self._send(500, {"error": repr(exc)[:300]})
+
+            def _route_post(self, req):
+                meta = {k: req[k] for k in ("session", "idempotent")
+                        if k in req}
+                if self.path == "/v1/generate":
+                    fut = fleet.submit(
+                        {"prompt": req["prompt"]},
+                        timeout_ms=req.get("timeout_ms"),
+                        max_new_tokens=req.get("max_new_tokens"),
+                        eos_id=req.get("eos_id"), **meta)
+                    ids = fut.result(timeout=req.get("timeout_s", 60))
+                    self._send(200, {"ids": np.asarray(ids).tolist()})
+                elif self.path == "/v1/infer":
+                    inputs = {k: np.asarray(v)
+                              for k, v in req["inputs"].items()}
+                    fut = fleet.submit(inputs,
+                                       timeout_ms=req.get("timeout_ms"),
+                                       **meta)
+                    outs = fut.result(timeout=req.get("timeout_s", 60))
+                    self._send(200, {"outputs": [np.asarray(o).tolist()
+                                                 for o in outs]})
+                elif self.path == "/fleet/drain":
+                    rep = fleet._replica_by(req["replica"])
+                    rep.drain(wait=req.get("wait", True),
+                              timeout=req.get("timeout", 30.0))
+                    self._send(200, {"ok": True,
+                                     "state": rep.healthz()})
+                elif self.path == "/fleet/resume":
+                    rep = fleet._replica_by(req["replica"])
+                    rep.rejoin()
+                    self._send(200, {"ok": True,
+                                     "state": rep.healthz()})
+                elif self.path == "/fleet/update_weights":
+                    out = fleet.update_weights(
+                        req["checkpoint_dir"],
+                        verify=req.get("verify", True))
+                    self._send(200, out)
+                elif self.path == "/fleet/chaos":
+                    from ..resilience.faults import (FaultPlan,
+                                                     install_plan)
+
+                    plan = FaultPlan.parse(req["plan"])
+                    install_plan(plan)
+                    self._send(200, {"ok": True,
+                                     "pending": plan.pending()})
+                else:
+                    self._send(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="paddle-tpu-fleet-http",
+                         daemon=True).start()
+        return self._httpd.server_address[1]

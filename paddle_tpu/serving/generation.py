@@ -591,6 +591,21 @@ class GenerationEngine:
     def cache_stats(self) -> dict:
         return self.executor.cache_stats()
 
+    def swap_params(self, source, *, strict: bool = True):
+        """Zero-recompile param hot-swap for rolling weight updates:
+        replace the LM weights in place from a trainer checkpoint dir /
+        saved-model dir / Scope / dict. The slot KV cache and the RNG
+        stream are never touched (a checkpoint taken from another
+        serving scope must not clobber live decode state) — call at a
+        drained point so already-admitted requests finish on consistent
+        weights."""
+        from .engine import swap_scope_params
+
+        return swap_scope_params(self.scope, source,
+                                 skip=(CACHE_K, CACHE_V), strict=strict,
+                                 device_ctx=self._device_ctx,
+                                 metrics=self.metrics)
+
     # -- server-driver interface -----------------------------------------
     def serve_step(self, batcher, idle_wait_s: Optional[float] = None) -> bool:
         """One engine tick: admit queued requests into free slots (a

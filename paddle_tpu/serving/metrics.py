@@ -43,6 +43,10 @@ class MetricsRegistry:
         self._observed: Dict[str, int] = defaultdict(int)
         self._published: Dict[str, int] = defaultdict(int)
         self._completions = deque()  # timestamps for the QPS window
+        # labeled gauge series: name -> {(("k","v"),...) -> value}. The
+        # fleet plane's per-replica health/breaker/inflight live here and
+        # export as proper labeled Prometheus series.
+        self._labeled: Dict[str, Dict[tuple, float]] = defaultdict(dict)
         self._t0 = time.monotonic()
 
     # -- write side --------------------------------------------------------
@@ -53,6 +57,13 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+
+    def set_labeled(self, name: str, value: float, **labels) -> None:
+        """Set one sample of a labeled gauge series, e.g.
+        ``set_labeled("fleet_replica_health", 1, replica="r0")``."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._labeled[name][key] = float(value)
 
     def observe_latency(self, seconds: float, name: str = "request") -> None:
         now = time.monotonic()
@@ -87,13 +98,48 @@ class MetricsRegistry:
                 }
             cutoff = now - _QPS_WINDOW_S
             qps_n = sum(1 for t in self._completions if t >= cutoff)
-            return {
+            labeled = {name: {"{" + ",".join(f'{k}="{v}"'
+                                             for k, v in key) + "}": val
+                              for key, val in series.items()}
+                       for name, series in self._labeled.items()}
+            snap = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "latency": lat,
                 "qps": qps_n / min(max(now - self._t0, 1e-9), _QPS_WINDOW_S),
                 "uptime_s": now - self._t0,
             }
+            if labeled:
+                snap["labeled"] = labeled
+            return snap
+
+    @staticmethod
+    def merge(snapshots: Dict[str, dict]) -> dict:
+        """Fleet-level aggregation over per-replica :meth:`snapshot`
+        payloads (keyed by replica name): counters sum, gauges and
+        latency quantiles keep a per-replica ``<replica>/<name>`` key
+        (quantiles cannot be merged exactly from summaries), qps sums.
+        The result has the same shape as :meth:`snapshot`, so it nests
+        into the fleet /metrics body verbatim."""
+        counters: Dict[str, int] = defaultdict(int)
+        gauges: Dict[str, float] = {}
+        latency: Dict[str, dict] = {}
+        qps = 0.0
+        uptime = 0.0
+        for rname, snap in sorted(snapshots.items()):
+            if not isinstance(snap, dict):
+                continue
+            for k, v in (snap.get("counters") or {}).items():
+                counters[k] += int(v)
+            for k, v in (snap.get("gauges") or {}).items():
+                gauges[f"{rname}/{k}"] = v
+            for k, v in (snap.get("latency") or {}).items():
+                latency[f"{rname}/{k}"] = v
+            qps += float(snap.get("qps") or 0.0)
+            uptime = max(uptime, float(snap.get("uptime_s") or 0.0))
+        return {"counters": dict(counters), "gauges": gauges,
+                "latency": latency, "qps": qps, "uptime_s": uptime,
+                "replicas": sorted(snapshots.keys())}
 
     def publish_to_profiler(self, stat_set=None, prefix: str = "serving/"):
         """Push the latency reservoirs into a profiler StatSet (the global
@@ -166,6 +212,10 @@ class MetricsRegistry:
         for gname in sorted(snap["gauges"]):
             emit(f"{namespace}_{_prom_name(gname)}", "gauge",
                  [("", snap["gauges"][gname])])
+        for lname in sorted(snap.get("labeled", {})):
+            series = snap["labeled"][lname]
+            emit(f"{namespace}_{_prom_name(lname)}", "gauge",
+                 [(labels, series[labels]) for labels in sorted(series)])
         for lname in sorted(snap["latency"]):
             base = _prom_name(lname[:-3] if lname.endswith("_ms")
                               else lname)

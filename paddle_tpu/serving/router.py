@@ -1,0 +1,301 @@
+"""Routing policies + per-replica circuit breakers for the serving fleet.
+
+The reference's Go master routes work around dead pservers by lease
+expiry; a serving fleet needs the request-path analogue: a
+:class:`Router` that picks a replica per attempt (round-robin, least
+loaded, or session-affine) and a :class:`CircuitBreaker` per replica
+that converts an outcome stream into an availability decision:
+
+    closed ──consecutive failures / error rate──► open
+    open ──recovery timer + /healthz probe──► half_open
+    half_open ──probe success──► closed   (probe failure ──► open)
+
+The breaker is driven from BOTH ends: request outcomes
+(``record_success``/``record_failure``) and the replica's ``/healthz``
+(a not-ready probe keeps an open breaker open without burning a real
+request). Every state transition emits a ``fleet/breaker`` trace record
+and a labeled gauge, so Prometheus shows exactly when each replica
+tripped and recovered.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .. import trace
+
+#: breaker state -> the value exported as the labeled Prometheus gauge
+BREAKER_GAUGE = {"closed": 0.0, "open": 1.0, "half_open": 2.0}
+
+
+class CircuitBreaker:
+    """Availability state machine for one replica.
+
+    failure_threshold:  consecutive failures that trip closed -> open.
+    error_rate:         alternative trip: failure fraction over the last
+                        ``window`` outcomes (needs >= ``min_outcomes``).
+    recovery_s:         open -> half-open probe eligibility delay.
+    on_transition:      ``fn(old_state, new_state, reason)`` hook (the
+                        router wires metrics + trace through it).
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 error_rate: float = 0.5, window: int = 20,
+                 min_outcomes: int = 10, recovery_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable] = None):
+        self.failure_threshold = int(failure_threshold)
+        self.error_rate = float(error_rate)
+        self.min_outcomes = int(min_outcomes)
+        self.recovery_s = float(recovery_s)
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._outcomes: deque = deque(maxlen=int(window))
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, new: str, reason: str) -> None:
+        old, self._state = self._state, new
+        if new == self.OPEN:
+            self._opened_at = self._clock()
+            self._probe_inflight = False
+        if old != new and self._on_transition is not None:
+            self._on_transition(old, new, reason)
+
+    # -- request path ------------------------------------------------------
+    def allow(self) -> bool:
+        """May a request be sent to this replica right now? In half-open
+        exactly ONE in-flight probe is allowed; in open, the recovery
+        timer promotes to half-open (the caller should then healthz-gate
+        the probe via :meth:`probe_eligible`)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.recovery_s:
+                    return False
+                self._transition(self.HALF_OPEN, "recovery timer")
+            # half-open: one probe at a time
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def probe_eligible(self) -> bool:
+        """True when the breaker is open and the recovery delay has
+        elapsed — the moment a /healthz check is worth making."""
+        with self._lock:
+            return (self._state == self.OPEN
+                    and self._clock() - self._opened_at >= self.recovery_s)
+
+    # -- outcome stream ----------------------------------------------------
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._outcomes.append(True)
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED, "probe success")
+
+    def record_failure(self, reason: str = "error") -> None:
+        with self._lock:
+            self._consecutive += 1
+            self._outcomes.append(False)
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN:
+                self._transition(self.OPEN, f"probe failed: {reason}")
+                return
+            if self._state != self.CLOSED:
+                return
+            n = len(self._outcomes)
+            failures = sum(1 for ok in self._outcomes if not ok)
+            if self._consecutive >= self.failure_threshold:
+                self._transition(
+                    self.OPEN, f"{self._consecutive} consecutive failures")
+            elif n >= self.min_outcomes \
+                    and failures / n > self.error_rate:
+                self._transition(
+                    self.OPEN, f"error rate {failures}/{n}")
+
+    def release_probe(self) -> None:
+        """An attempt admitted as the half-open probe was ABANDONED
+        without an outcome (hedge loser, deadline expiry): free the
+        probe slot so the breaker doesn't wedge waiting for a verdict
+        that will never arrive."""
+        with self._lock:
+            self._probe_inflight = False
+
+    def force_open(self, reason: str = "healthz") -> None:
+        """Trip the breaker from the health prober (a dead /healthz must
+        stop traffic without burning ``failure_threshold`` requests)."""
+        with self._lock:
+            if self._state != self.OPEN:
+                self._transition(self.OPEN, reason)
+            else:
+                self._opened_at = self._clock()  # restart recovery timer
+
+    def seconds_until_probe(self) -> float:
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.recovery_s
+                       - (self._clock() - self._opened_at))
+
+
+# ---------------------------------------------------------------------------
+# pick policies
+# ---------------------------------------------------------------------------
+class RoundRobinPolicy:
+    """Rotate through the candidates — the baseline fair spread."""
+
+    def __init__(self):
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def pick(self, candidates: Sequence, meta: dict):
+        with self._lock:
+            self._i += 1
+            return candidates[(self._i - 1) % len(candidates)]
+
+
+class LeastLoadedPolicy:
+    """Pick the candidate with the fewest in-flight requests (ties break
+    round-robin) — absorbs heterogeneous replicas better than rotation."""
+
+    def __init__(self):
+        self._rr = RoundRobinPolicy()
+
+    def pick(self, candidates: Sequence, meta: dict):
+        loads = [getattr(c, "inflight", 0) for c in candidates]
+        low = min(loads)
+        best = [c for c, l in zip(candidates, loads) if l == low]
+        return self._rr.pick(best, meta)
+
+
+class SessionAffinityPolicy:
+    """Hash ``meta["session"]`` to a stable preferred replica (KV-cache /
+    prefix locality); sessions fall back to ``base`` when their preferred
+    replica is not a candidate (drained, crashed, breaker-open) — and so
+    do requests without a session."""
+
+    def __init__(self, base=None):
+        self.base = base or LeastLoadedPolicy()
+
+    def pick(self, candidates: Sequence, meta: dict):
+        session = (meta or {}).get("session")
+        if session is not None:
+            # stable across processes (hash() is salted): FNV-1a
+            h = 2166136261
+            for byte in str(session).encode():
+                h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+            preferred = [c for c in candidates
+                         if getattr(c, "index", 0) == h % max(
+                             1, getattr(c, "fleet_size", len(candidates)))]
+            if preferred:
+                return preferred[0]
+        return self.base.pick(candidates, meta)
+
+
+class Router:
+    """Replica picker + breaker bank for one fleet.
+
+    ``route(meta, exclude)`` returns a replica that is routable (not
+    draining/crashed) and whose breaker admits traffic, or None; the
+    half-open probe is /healthz-gated — an open breaker whose recovery
+    timer elapsed first asks the replica's healthz, and only a ready
+    answer lets the probe request through.
+    """
+
+    def __init__(self, replicas: Sequence, policy=None,
+                 breaker_kwargs: Optional[dict] = None, metrics=None):
+        self.replicas = list(replicas)
+        self.policy = policy or LeastLoadedPolicy()
+        self.metrics = metrics
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        for r in self.replicas:
+            self.breakers[r.name] = CircuitBreaker(
+                on_transition=self._transition_hook(r.name),
+                **(breaker_kwargs or {}))
+
+    def _transition_hook(self, name: str):
+        def hook(old: str, new: str, reason: str) -> None:
+            now = time.perf_counter()
+            trace.record("fleet/breaker", now, now, replica=name,
+                         from_state=old, to_state=new, reason=reason)
+            if self.metrics is not None:
+                if new == CircuitBreaker.OPEN:
+                    self.metrics.inc("breaker_opens")
+                elif new == CircuitBreaker.CLOSED and old != new:
+                    self.metrics.inc("breaker_closes")
+                self.metrics.set_labeled("fleet_breaker_state",
+                                         BREAKER_GAUGE[new], replica=name)
+        return hook
+
+    # ------------------------------------------------------------------
+    def route(self, meta: Optional[dict] = None,
+              exclude: Sequence[str] = ()):
+        """Pick a replica for one attempt. ``exclude`` lists replica
+        names already tried for this request (retries go to a DIFFERENT
+        replica)."""
+        exclude = set(exclude)
+        candidates = []
+        for r in self.replicas:
+            if r.name in exclude or not r.routable:
+                continue
+            br = self.breakers[r.name]
+            if br.state == CircuitBreaker.CLOSED:
+                candidates.append(r)
+                continue
+            # open/half-open: /healthz-gated probe admission
+            if br.probe_eligible():
+                health = r.healthz()
+                if health.get("state") != "ready":
+                    br.force_open("healthz not ready")
+                    continue
+            if br.allow():
+                return r  # the probe request — route it immediately
+        if not candidates:
+            return None
+        return self.policy.pick(candidates, meta or {})
+
+    def record(self, replica, ok: bool, reason: str = "error") -> None:
+        br = self.breakers[replica.name]
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure(reason)
+
+    def release(self, replica) -> None:
+        """Abandoned attempt (no outcome): free a possible probe slot."""
+        self.breakers[replica.name].release_probe()
+
+    def any_routable(self) -> bool:
+        """At least one replica could accept traffic now (or is due a
+        probe) — False means admission should shed before queueing."""
+        return any(
+            r.routable and (self.breakers[r.name].state
+                            != CircuitBreaker.OPEN
+                            or self.breakers[r.name].probe_eligible())
+            for r in self.replicas)
+
+    def min_recovery_s(self) -> float:
+        """Soonest any open breaker becomes probe-eligible — the
+        Retry-After hint for shed responses."""
+        waits = [self.breakers[r.name].seconds_until_probe()
+                 for r in self.replicas]
+        return min(waits) if waits else 1.0
+
+    def breaker_states(self) -> Dict[str, str]:
+        return {name: br.state for name, br in self.breakers.items()}

@@ -75,6 +75,7 @@ class Server:
         self._thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._running = False
+        self._paused = False
         self._state = "ready"
 
     @property
@@ -90,6 +91,7 @@ class Server:
         if self._thread is not None:
             return self
         self._running = True
+        self._paused = False
         self._state = "warming" if self._warmup else "ready"
         self._thread = threading.Thread(target=self._loop,
                                         name="paddle-tpu-serving",
@@ -133,6 +135,46 @@ class Server:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- reversible drain (rolling updates) --------------------------------
+    def pause(self, wait: bool = True, timeout: float = 30.0) -> None:
+        """REVERSIBLE drain — the per-replica step of a rolling weight
+        update. Admissions stop (submit raises EngineClosedError,
+        /healthz flips to ``draining``/503 so routers hold traffic) but
+        the dispatch loop keeps running and finishes the backlog;
+        ``wait=True`` blocks (bounded by ``timeout``) until the queue is
+        empty and every engine is idle — the safe point for
+        ``swap_params``. :meth:`resume` rejoins. Unlike :meth:`stop`,
+        nothing is closed."""
+        self._paused = True
+        if self._state == "ready":
+            self._state = "draining"
+        if not wait:
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = self.batcher.depth > 0 or any(
+                getattr(eng, "active", 0) or getattr(eng, "_inflight", 0)
+                for eng in self.engines)
+            if not busy:
+                break
+            time.sleep(0.005)
+
+    def resume(self) -> None:
+        """Rejoin after :meth:`pause`: admissions reopen and /healthz
+        reports ``ready`` again."""
+        self._paused = False
+        if self._state == "draining" and self._running:
+            self._state = "ready"
+
+    def swap_params(self, source, *, strict: bool = True) -> dict:
+        """Hot-swap every engine's params (see engine.swap_params);
+        call between :meth:`pause` and :meth:`resume`."""
+        stats: dict = {}
+        for eng in self.engines:
+            for k, v in eng.swap_params(source, strict=strict).items():
+                stats[k] = stats.get(k, 0) + v
+        return stats
 
     def _do_warmup(self) -> None:
         """Manifest replay / warmup on the dispatch thread, before the
@@ -189,6 +231,10 @@ class Server:
         backpressure. For generation engines the payload is a prompt (or
         {"prompt": ids}) with max_new_tokens/eos_id in ``meta``; for
         inference engines it is a per-row feed dict."""
+        if self._paused:
+            raise EngineClosedError(
+                "server is draining (paused for a rolling update); "
+                "route to another replica")
         return self.batcher.submit(payload, timeout_ms=timeout_ms, **meta)
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
@@ -222,14 +268,31 @@ class Server:
             timers=profiler.global_stat.as_dict(prefix="serving/"))
 
     # -- HTTP front end ----------------------------------------------------
-    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0,
+                   socket_timeout_s: Optional[float] = 30.0) -> int:
         """Start the JSON endpoint on a daemon thread; returns the bound
-        port (pass port=0 for an ephemeral one)."""
+        port (pass port=0 for an ephemeral one).
+
+        ``socket_timeout_s`` bounds how long a stalled client may hold a
+        handler thread: the per-connection socket timeout covers both
+        the request line and the body read — a client that stops sending
+        mid-request gets 408 (when addressable) and the connection is
+        closed, counted as ``http_408_timeouts`` in the
+        MetricsRegistry. Without it, one dead client per thread is a
+        slow-loris outage."""
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            timeout = socket_timeout_s  # socketserver: settimeout per conn
+
             def log_message(self, *a):  # quiet: metrics carry the signal
                 pass
+
+            def log_error(self, fmt, *args):
+                # stdlib handle_one_request swallows a request-line
+                # timeout after logging it — the only seam to count it
+                if fmt.startswith("Request timed out"):
+                    server.metrics.inc("http_408_timeouts")
 
             def _send(self, code: int, obj) -> None:
                 body = json.dumps(obj).encode()
@@ -275,12 +338,30 @@ class Server:
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    raw = self.rfile.read(n)
+                except TimeoutError:
+                    # stalled client mid-body: free the thread with 408
+                    # instead of holding it for the connection's lifetime
+                    server.metrics.inc("http_408_timeouts")
+                    self.close_connection = True
+                    try:
+                        self._send(408, {"error": "client stalled; "
+                                         "request body timed out"})
+                    except OSError:
+                        pass  # peer already gone
+                    return
+                except (ValueError, TypeError) as exc:
+                    self._send(400, {"error": f"bad length: {exc}"})
+                    return
+                try:
+                    req = json.loads(raw or b"{}")
                 except (ValueError, TypeError) as exc:
                     self._send(400, {"error": f"bad JSON: {exc}"})
                     return
                 try:
-                    if self.path == "/v1/generate":
+                    if self.path.startswith("/admin/"):
+                        self._admin(req)
+                    elif self.path == "/v1/generate":
                         fut = server.submit(
                             {"prompt": req["prompt"]},
                             timeout_ms=req.get("timeout_ms"),
@@ -300,6 +381,8 @@ class Server:
                         self._send(404, {"error": "not found"})
                 except KeyError as exc:
                     self._send(400, {"error": f"missing field {exc}"})
+                except ValueError as exc:  # e.g. swap shape mismatch
+                    self._send(400, {"error": str(exc)})
                 except BadRequestError as exc:
                     self._send(400, {"error": str(exc)})
                 except QueueFullError as exc:
@@ -308,6 +391,31 @@ class Server:
                     self._send(504, {"error": str(exc) or "timed out"})
                 except (EngineClosedError, ServingError) as exc:
                     self._send(503, {"error": str(exc)})
+
+            def _admin(self, req):
+                """Replica control plane — what HttpReplica and
+                tools/fleetctl.py drive during rolling updates."""
+                if self.path == "/admin/drain":
+                    server.pause(wait=req.get("wait", True),
+                                 timeout=req.get("timeout", 30.0))
+                    self._send(200, {"ok": True, "state": server.state})
+                elif self.path == "/admin/resume":
+                    server.resume()
+                    self._send(200, {"ok": True, "state": server.state})
+                elif self.path == "/admin/swap":
+                    stats = server.swap_params(
+                        req["checkpoint_dir"],
+                        strict=req.get("strict", True))
+                    self._send(200, stats)
+                elif self.path == "/admin/warm":
+                    warmed = 0
+                    for eng in server.engines:
+                        warm = getattr(eng, "warm_from_manifest", None)
+                        if warm is not None:
+                            warmed += warm() or 0
+                    self._send(200, {"ok": True, "warmed": warmed})
+                else:
+                    self._send(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._httpd.serve_forever,

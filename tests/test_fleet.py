@@ -1,0 +1,756 @@
+"""paddle_tpu.serving.fleet: routers, breakers, hedging, rolling updates.
+
+Pins the fleet-robustness contracts:
+
+1. the CHAOS pin — a 3-replica fleet under a deterministic FaultPlan
+   that hard-crashes one replica and slow-injects another sustains a
+   concurrent storm with ZERO failed client requests (retries re-route
+   around the crash, hedging outruns the slowness), the crashed
+   replica's breaker opens, and the breaker/hedge/shed counters are
+   visible as labeled Prometheus series;
+2. the ROLLING-UPDATE pin — ``Fleet.update_weights`` drains each
+   replica (healthz 'draining'), hot-swaps params with zero recompiles,
+   and rejoins, with traffic flowing throughout and token-exact
+   post-swap outputs;
+3. drain-under-load — a submit storm during ``Server.stop(drain=True)``
+   and during a one-replica drain drops nothing: every future resolves
+   or fails TYPED;
+4. the satellites: Retry filters + absolute deadline (no backoff
+   overshoot), MetricsRegistry.merge + labeled exposition, the HTTP
+   handler's stalled-client 408, and the fleetctl CLI.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.resilience import FaultPlan, Retry, TransientFault
+from paddle_tpu.serving import (CircuitBreaker, EngineClosedError, Fleet,
+                                FleetOverloadedError, GenerationEngine,
+                                HttpReplica, InferenceEngine,
+                                LeastLoadedPolicy, LMSpec, LocalReplica,
+                                MetricsRegistry, QueueFullError,
+                                ReplicaUnavailableError, RoundRobinPolicy,
+                                Router, Server, SessionAffinityPolicy)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures: a tiny classifier program with STABLE param names
+# ---------------------------------------------------------------------------
+def _fc_bundle():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        out = layers.fc(x, size=2)
+    return main, startup, out
+
+
+def _fc_scope(startup, seed=1):
+    scope = pt.Scope()
+    startup.random_seed = seed
+    pt.Executor(pt.CPUPlace()).run(startup, scope=scope)
+    return scope
+
+
+def _fc_engine(bundle, seed=1, **kw):
+    main, startup, out = bundle
+    return InferenceEngine(program=main, feed_names=["x"],
+                           fetch_names=[out.name],
+                           scope=_fc_scope(startup, seed),
+                           batch_buckets=(2, 4), place=pt.CPUPlace(),
+                           **kw)
+
+
+def _row(rng=None):
+    return (rng or np.random).rand(4).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (unit)
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_consecutive_failures_open_halfopen_probe_close(self):
+        clock = [0.0]
+        seen = []
+        br = CircuitBreaker(failure_threshold=3, recovery_s=1.0,
+                            clock=lambda: clock[0],
+                            on_transition=lambda o, n, r: seen.append(
+                                (o, n)))
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()                 # recovery not elapsed
+        clock[0] = 1.5
+        assert br.probe_eligible()
+        assert br.allow()                     # the single probe
+        assert br.state == "half_open"
+        assert not br.allow()                 # probe already in flight
+        br.record_success()
+        assert br.state == "closed"
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+
+    def test_halfopen_probe_failure_reopens(self):
+        clock = [0.0]
+        br = CircuitBreaker(failure_threshold=1, recovery_s=0.5,
+                            clock=lambda: clock[0])
+        br.record_failure()
+        clock[0] = 1.0
+        assert br.allow()
+        br.record_failure("still down")
+        assert br.state == "open"
+        assert not br.allow()                 # timer restarted
+
+    def test_abandoned_probe_releases_slot(self):
+        """A hedge loser / deadline-abandoned probe must not wedge the
+        breaker: release_probe frees the half-open slot so the NEXT
+        request can probe."""
+        clock = [0.0]
+        br = CircuitBreaker(failure_threshold=1, recovery_s=0.5,
+                            clock=lambda: clock[0])
+        br.record_failure()
+        clock[0] = 1.0
+        assert br.allow()          # probe admitted
+        assert not br.allow()      # slot held by the in-flight probe
+        br.release_probe()         # probe abandoned without an outcome
+        assert br.allow()          # a new probe may go
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_error_rate_opens_without_consecutive_run(self):
+        br = CircuitBreaker(failure_threshold=100, error_rate=0.5,
+                            window=10, min_outcomes=10)
+        for _ in range(5):
+            br.record_failure()
+            br.record_success()
+        # 5/10 failures == 0.5: not yet over the > threshold
+        assert br.state == "closed"
+        br.record_failure()   # window: drops an old F, adds F -> 5/10
+        assert br.state == "closed"
+        br.record_failure()   # drops an old S, adds F -> 6/10 > 0.5
+        assert br.state == "open"
+
+
+# ---------------------------------------------------------------------------
+# Retry satellite: filters + absolute deadline
+# ---------------------------------------------------------------------------
+class TestRetrySatellite:
+    def test_retry_on_filter_overrides_default(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise KeyError("not usually retryable")
+
+        r = Retry(max_attempts=3, backoff=0.001, retry_on=(KeyError,))
+        with pytest.raises(KeyError):
+            r.call(flaky)
+        assert len(calls) == 3
+
+    def test_give_up_on_escapes_first_attempt(self):
+        class FatalConnError(ConnectionError):
+            pass
+
+        calls = []
+
+        def fatal():
+            calls.append(1)
+            raise FatalConnError("permanent")
+
+        # ConnectionError is retryable by default; the give-up carve-out
+        # must win over the superclass match
+        r = Retry(max_attempts=5, backoff=0.001,
+                  give_up_on=(FatalConnError,))
+        with pytest.raises(FatalConnError):
+            r.call(fatal)
+        assert len(calls) == 1
+
+    def test_deadline_never_overshoots_backoff(self):
+        sleeps = []
+        r = Retry(max_attempts=10, backoff=0.2, multiplier=2.0,
+                  deadline=0.3, sleep=sleeps.append)
+
+        def always():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            r.call(always)
+        # first backoff (0.2) fits the 0.3 budget; the second (0.4)
+        # would overshoot -> exhausted WITHOUT sleeping it
+        assert sleeps == [pytest.approx(0.2)]
+
+    def test_recovery_still_counts(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise TransientFault("blip")
+            return "ok"
+
+        assert Retry(max_attempts=5, backoff=0.001).call(flaky) == "ok"
+        assert len(attempts) == 3
+
+
+# ---------------------------------------------------------------------------
+# router policies (unit, dummy replicas)
+# ---------------------------------------------------------------------------
+class _Dummy:
+    def __init__(self, name, index, fleet_size, inflight=0):
+        self.name, self.index, self.fleet_size = name, index, fleet_size
+        self.inflight = inflight
+        self.routable = True
+
+    def healthz(self):
+        return {"state": "ready"}
+
+
+class TestRouterPolicies:
+    def _reps(self, n=3):
+        return [_Dummy(f"r{i}", i, n) for i in range(n)]
+
+    def test_round_robin_rotates(self):
+        reps = self._reps()
+        rr = RoundRobinPolicy()
+        picks = [rr.pick(reps, {}).name for _ in range(6)]
+        assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+    def test_least_loaded_prefers_idle(self):
+        reps = self._reps()
+        reps[0].inflight = 5
+        reps[2].inflight = 5
+        assert LeastLoadedPolicy().pick(reps, {}).name == "r1"
+
+    def test_session_affinity_stable_and_falls_back(self):
+        reps = self._reps()
+        pol = SessionAffinityPolicy()
+        first = pol.pick(reps, {"session": "user-42"}).name
+        for _ in range(5):
+            assert pol.pick(reps, {"session": "user-42"}).name == first
+        # preferred replica gone from the candidate set -> base policy
+        rest = [r for r in reps if r.name != first]
+        assert pol.pick(rest, {"session": "user-42"}).name != first
+
+    def test_router_skips_excluded_and_open_breakers(self):
+        reps = self._reps()
+        router = Router(reps, breaker_kwargs={"failure_threshold": 1,
+                                              "recovery_s": 60.0})
+        for _ in range(3):
+            router.record(reps[1], ok=False)
+        names = {router.route({}, exclude=["r0"]).name for _ in range(8)}
+        assert names == {"r2"}
+        assert router.breaker_states()["r1"] == "open"
+        assert router.any_routable()
+        for rep in reps:
+            router.record(rep, ok=False)
+        assert not router.any_routable()
+        assert router.min_recovery_s() > 0
+
+
+# ---------------------------------------------------------------------------
+# metrics satellite: merge + labeled exposition
+# ---------------------------------------------------------------------------
+class TestMetricsSatellite:
+    def test_merge_sums_counters_and_prefixes_the_rest(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.inc("completed", 3)
+        b.inc("completed", 4)
+        a.set_gauge("queue_depth", 2)
+        a.observe_latency(0.01)
+        merged = MetricsRegistry.merge({"r0": a.snapshot(),
+                                        "r1": b.snapshot()})
+        assert merged["counters"]["completed"] == 7
+        assert merged["gauges"]["r0/queue_depth"] == 2
+        assert "r0/request_ms" in merged["latency"]
+        assert merged["replicas"] == ["r0", "r1"]
+
+    def test_labeled_series_in_snapshot_and_prometheus(self):
+        m = MetricsRegistry()
+        m.set_labeled("fleet_replica_health", 1, replica="r0")
+        m.set_labeled("fleet_replica_health", 0, replica="r1")
+        snap = m.snapshot()
+        assert snap["labeled"]["fleet_replica_health"][
+            '{replica="r0"}'] == 1
+        text = m.prometheus_text()
+        assert 'paddle_tpu_fleet_replica_health{replica="r0"} 1' in text
+        assert 'paddle_tpu_fleet_replica_health{replica="r1"} 0' in text
+
+
+# ---------------------------------------------------------------------------
+# the chaos pin
+# ---------------------------------------------------------------------------
+class TestFleetChaos:
+    def test_crash_and_slow_replica_zero_failed_requests(self):
+        """ACCEPTANCE PIN: replica 1 hard-crashes and replica 2 runs
+        60 ms slow, deterministically; a 4-thread storm still completes
+        every request (retries + hedging absorb both), r1's breaker
+        opens, and the counters land in the Prometheus text."""
+        bundle = _fc_bundle()
+        plan = (FaultPlan()
+                .at(step=1, kind="replica_crash")
+                .at(step=2, kind="slow_replica", delay_s=0.06))
+        fleet = Fleet([_fc_engine(bundle) for _ in range(3)],
+                      hedge=True, hedge_delay_ms=20,
+                      breaker={"failure_threshold": 2,
+                               "recovery_s": 30.0})
+        ok, failed = [], []
+        rng = np.random.RandomState(0)
+        rows = [_row(rng) for _ in range(48)]
+
+        def storm(chunk):
+            for row in chunk:
+                try:
+                    fut = fleet.submit({"x": row}, timeout_ms=15_000)
+                    ok.append(np.asarray(fut.result(timeout=20)[0]))
+                except Exception as exc:  # noqa: BLE001 - the pin
+                    failed.append(repr(exc))
+
+        with plan.active(), fleet:
+            storm(rows[:6])  # warm all three replicas
+            threads = [threading.Thread(target=storm,
+                                        args=(rows[6 + 10 * i:
+                                              6 + 10 * (i + 1)],))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert failed == []          # ZERO failed client requests
+            assert len(ok) == 46
+            assert plan.pending() == []  # both faults actually fired
+            states = fleet.router.breaker_states()
+            assert states["r1"] == "open"
+            counters = fleet.metrics.snapshot()["counters"]
+            assert counters["hedge_wins"] >= 1   # slowness absorbed
+            assert counters["breaker_opens"] >= 1
+            assert counters.get("sheds", 0) == 0
+            prom = fleet.metrics_prometheus()
+        assert 'paddle_tpu_fleet_breaker_state{replica="r1"} 1' in prom
+        assert 'paddle_tpu_fleet_breaker_state{replica="r0"} 0' in prom
+        assert "paddle_tpu_hedge_wins_total" in prom
+        assert "paddle_tpu_sheds_total 0" in prom  # visible even at 0
+        assert 'fleet_replica_health{replica="r1"' in prom
+
+    def test_all_breakers_open_sheds_before_queue(self):
+        bundle = _fc_bundle()
+        plan = (FaultPlan()
+                .at(step=0, kind="replica_crash")
+                .at(step=1, kind="replica_crash"))
+        fleet = Fleet([_fc_engine(bundle) for _ in range(2)],
+                      hedge=False,
+                      retry=Retry(max_attempts=2, backoff=0.001,
+                                  name="fleet"),
+                      breaker={"failure_threshold": 1,
+                               "recovery_s": 60.0})
+        with plan.active(), fleet:
+            with pytest.raises((ConnectionError,
+                                ReplicaUnavailableError)):
+                fleet.submit({"x": _row()},
+                             timeout_ms=5000).result(timeout=10)
+            assert set(fleet.router.breaker_states().values()) == {"open"}
+            with pytest.raises(FleetOverloadedError) as ei:
+                fleet.submit({"x": _row()})
+            assert ei.value.retry_after_s > 0
+            assert fleet.metrics.counter("sheds") >= 1
+
+    def test_fleet_queue_capacity_sheds_typed(self):
+        bundle = _fc_bundle()
+        plan = FaultPlan().at(step=0, kind="slow_replica", delay_s=0.3)
+        fleet = Fleet([_fc_engine(bundle)], hedge=False, max_pending=1)
+        with plan.active(), fleet:
+            first = fleet.submit({"x": _row()}, timeout_ms=10_000)
+            with pytest.raises(FleetOverloadedError):
+                fleet.submit({"x": _row()})
+            assert fleet.metrics.counter("sheds") == 1
+            assert np.asarray(first.result(timeout=10)[0]).shape == (2,)
+
+    def test_non_idempotent_never_retries(self):
+        bundle = _fc_bundle()
+        plan = FaultPlan().at(step=0, kind="replica_crash")
+        fleet = Fleet([_fc_engine(bundle) for _ in range(2)],
+                      policy=RoundRobinPolicy(), hedge=True,
+                      breaker={"failure_threshold": 10})
+        with plan.active(), fleet:
+            # route until the crashed replica (r0) takes the request
+            with pytest.raises(ConnectionError):
+                for _ in range(4):
+                    fleet.submit({"x": _row()}, timeout_ms=5000,
+                                 idempotent=False).result(timeout=10)
+            assert fleet.metrics.counter("retries") == 0
+            assert fleet.metrics.counter("hedges") == 0
+
+    def test_deadline_propagates_to_replica_batcher(self):
+        """The router hands each attempt only the REMAINING budget: a
+        request whose deadline expires while queued behind a slow
+        replica fails typed, not late."""
+        bundle = _fc_bundle()
+        plan = FaultPlan().at(step=0, kind="slow_replica", delay_s=0.5)
+        fleet = Fleet([_fc_engine(bundle)], hedge=False,
+                      retry=Retry(max_attempts=1, name="fleet"))
+        from paddle_tpu.serving import RequestTimeoutError
+
+        with plan.active(), fleet:
+            fut = fleet.submit({"x": _row()}, timeout_ms=60)
+            with pytest.raises(RequestTimeoutError):
+                fut.result(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# rolling weight updates
+# ---------------------------------------------------------------------------
+class TestRollingUpdate:
+    def test_rolling_update_zero_downtime_exact_and_healthz(self, tmp_path):
+        """ACCEPTANCE PIN: update_weights drains one replica at a time
+        (healthz 'draining' DURING its swap), traffic keeps succeeding
+        throughout, post-swap outputs equal a from-scratch engine on the
+        new weights, and no recompile happened."""
+        bundle = _fc_bundle()
+        main, startup, out = bundle
+        ckpt = str(tmp_path / "w2")
+        pt.checkpoint.save_checkpoint(ckpt, scope=_fc_scope(startup, 9),
+                                      step=7)
+        engines = [_fc_engine(bundle, seed=3) for _ in range(3)]
+        fleet = Fleet(engines, hedge=False)
+        x1 = np.ones((1, 4), np.float32)
+        for eng in engines:  # warm every bucket: compiles settle NOW
+            eng.run({"x": np.ones((2, 4), np.float32)})
+            eng.run({"x": np.ones((4, 4), np.float32)})
+        old = np.asarray(engines[0].run({"x": x1})[0])
+
+        states_during_swap = {}
+        for rep in fleet.replicas:
+            def spy(src, _rep=rep, _orig=rep.swap_params):
+                states_during_swap[_rep.name] = \
+                    _rep.healthz()["state"]
+                assert fleet.router.route({}) is not _rep
+                return _orig(src)
+
+            rep.swap_params = spy
+
+        stop, failed = threading.Event(), []
+
+        def storm():
+            while not stop.is_set():
+                try:
+                    fleet.submit({"x": _row()},
+                                 timeout_ms=10_000).result(timeout=15)
+                except Exception as exc:  # noqa: BLE001 - the pin
+                    failed.append(repr(exc))
+
+        with fleet:
+            compiles_before = sum(
+                e.cache_stats()["fresh_compiles"] for e in engines)
+            threads = [threading.Thread(target=storm) for _ in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            result = fleet.update_weights(ckpt)
+            time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join()
+            assert failed == []                       # zero downtime
+            assert states_during_swap == {"r0": "draining",
+                                          "r1": "draining",
+                                          "r2": "draining"}
+            for rep in fleet.replicas:                # all rejoined
+                assert rep.healthz()["state"] == "ready"
+            assert [r["swap"]["swapped"]
+                    for r in result["replicas"]] == [2, 2, 2]
+        want = np.asarray(_fc_engine(bundle, seed=9).run({"x": x1})[0])
+        got = np.asarray(engines[0].run({"x": x1})[0])
+        assert not np.allclose(old, want)
+        np.testing.assert_array_equal(got, want)
+        compiles_after = sum(e.cache_stats()["fresh_compiles"]
+                             for e in engines)
+        assert compiles_after == compiles_before      # zero recompiles
+
+    def test_generation_swap_token_exact(self, tmp_path):
+        """The LM rolling-update payload: swap a GenerationEngine's
+        weights from a checkpoint and decode TOKEN-EXACTLY what an
+        engine built directly on the new weights decodes."""
+        VOCAB, D, L, H, MAXLEN = 32, 16, 2, 2, 32
+
+        def lm_scope(seed):
+            scope = pt.Scope()
+            prog, startup = pt.Program(), pt.Program()
+            with pt.program_guard(prog, startup):
+                p = layers.data(f"p_init{seed}", shape=[8], dtype="int64")
+                models.transformer_lm_generate(
+                    p, vocab_size=VOCAB, d_model=D, n_layers=L,
+                    num_heads=H, max_len=MAXLEN, max_new_tokens=1)
+            startup.random_seed = seed
+            pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+            return scope
+
+        spec = LMSpec(vocab_size=VOCAB, d_model=D, n_layers=L,
+                      num_heads=H, max_len=MAXLEN)
+        ckpt = str(tmp_path / "lm_v2")
+        pt.checkpoint.save_checkpoint(ckpt, scope=lm_scope(9), step=1)
+
+        eng_a = GenerationEngine(spec, lm_scope(3), slots=4)
+        eng_b = GenerationEngine(spec, lm_scope(9), slots=4)
+        prompts = [[1, 2, 3], [4, 5], [7]]
+        before = eng_a.generate_all(prompts, max_new_tokens=4)
+        stats = eng_a.swap_params(ckpt)
+        assert stats["swapped"] > 0 and stats["mismatched"] == 0
+        got = eng_a.generate_all(prompts, max_new_tokens=4)
+        want = eng_b.generate_all(prompts, max_new_tokens=4)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        assert any(not np.array_equal(b, w)
+                   for b, w in zip(before, want))
+
+    def test_swap_mismatch_raises_located(self, tmp_path):
+        bundle = _fc_bundle()
+        eng = _fc_engine(bundle, seed=3)
+        name = next(k for k in eng.scope.keys() if k.startswith("fc"))
+        bad = {name: np.zeros((3, 3), np.float32)}
+        with pytest.raises(ValueError, match=name.replace(".", r"\.")):
+            eng.swap_params(bad)
+        assert eng.swap_params(bad, strict=False)["mismatched"] == 1
+
+    def test_swap_no_overlap_raises(self):
+        bundle = _fc_bundle()
+        eng = _fc_engine(bundle, seed=3)
+        with pytest.raises(ValueError, match="no parameter names"):
+            eng.swap_params({"not_a_param": np.zeros(2, np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# drain under load (satellite 4)
+# ---------------------------------------------------------------------------
+class TestDrainUnderLoad:
+    def test_server_stop_drain_drops_nothing_typed(self):
+        """Storm during Server.stop(drain=True): every accepted future
+        RESOLVES (the backlog is finished, not failed) and every
+        post-drain submit fails with typed EngineClosedError."""
+        bundle = _fc_bundle()
+        srv = Server(_fc_engine(bundle), batch_buckets=(2, 4),
+                     max_wait_ms=1.0)
+        accepted, rejected, outcomes = [], [], []
+        lock = threading.Lock()
+        go = threading.Event()
+
+        def storm():
+            go.wait()
+            for _ in range(2000):  # submit until the drain rejects us
+                try:
+                    fut = srv.submit({"x": _row()})
+                    with lock:
+                        accepted.append(fut)
+                except EngineClosedError:
+                    with lock:
+                        rejected.append(1)
+                    return
+                except QueueFullError:
+                    time.sleep(0.001)  # typed backpressure: back off
+                except Exception as exc:  # noqa: BLE001 - must be typed
+                    outcomes.append(("BAD_SUBMIT", repr(exc)))
+                    return
+
+        with srv:
+            threads = [threading.Thread(target=storm) for _ in range(4)]
+            for t in threads:
+                t.start()
+            go.set()
+            time.sleep(0.02)
+            srv.stop(drain=True)
+            for t in threads:
+                t.join()
+            for fut in accepted:
+                outcomes.append(
+                    np.asarray(fut.result(timeout=10)[0]).shape)
+        assert all(o == (2,) for o in outcomes), outcomes[:5]
+        assert accepted and rejected  # the storm straddled the drain
+
+    def test_pause_resume_healthz_transitions(self):
+        bundle = _fc_bundle()
+        srv = Server(_fc_engine(bundle))
+        port = srv.serve_http()
+
+        def health_code():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=5) as r:
+                    return r.status, json.loads(r.read())["state"]
+            except urllib.error.HTTPError as exc:
+                return exc.code, json.loads(exc.read())["state"]
+
+        with srv:
+            assert health_code() == (200, "ready")
+            srv.pause()
+            assert health_code() == (503, "draining")
+            with pytest.raises(EngineClosedError):
+                srv.submit({"x": _row()})
+            srv.resume()
+            assert health_code() == (200, "ready")
+            fut = srv.submit({"x": _row()})
+            assert np.asarray(fut.result(timeout=10)[0]).shape == (2,)
+
+    def test_fleet_storm_while_one_replica_drains(self):
+        """The rolling-update window: requests racing a replica's
+        pause() re-route (typed EngineClosedError is retryable) — the
+        client sees zero failures."""
+        bundle = _fc_bundle()
+        fleet = Fleet([_fc_engine(bundle) for _ in range(2)],
+                      hedge=False)
+        failed = []
+
+        def storm(n):
+            for _ in range(n):
+                try:
+                    fleet.submit({"x": _row()},
+                                 timeout_ms=10_000).result(timeout=15)
+                except Exception as exc:  # noqa: BLE001 - the pin
+                    failed.append(repr(exc))
+
+        with fleet:
+            storm(4)  # warm
+            rep = fleet.replicas[0]
+            threads = [threading.Thread(target=storm, args=(10,))
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            rep.drain(wait=True, timeout=10)
+            assert rep.healthz()["state"] == "draining"
+            time.sleep(0.05)
+            rep.rejoin()
+            for t in threads:
+                t.join()
+            assert failed == []
+            assert rep.healthz()["state"] == "ready"
+
+
+# ---------------------------------------------------------------------------
+# HTTP plane: socket timeout, HttpReplica, admin endpoints, fleetctl
+# ---------------------------------------------------------------------------
+class TestHttpPlane:
+    def test_stalled_client_gets_408_and_is_counted(self):
+        bundle = _fc_bundle()
+        srv = Server(_fc_engine(bundle))
+        port = srv.serve_http(socket_timeout_s=0.3)
+        with srv:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            # request line + headers complete, body never arrives
+            s.sendall(b"POST /v1/infer HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Length: 64\r\n\r\n{")
+            t0 = time.monotonic()
+            resp = s.recv(4096).decode()
+            waited = time.monotonic() - t0
+            s.close()
+            assert "408" in resp.splitlines()[0]
+            assert waited < 5.0  # the thread was freed by the timeout
+            assert srv.metrics.counter("http_408_timeouts") == 1
+
+    def test_http_replica_roundtrip_admin_and_swap(self, tmp_path):
+        bundle = _fc_bundle()
+        main, startup, out = bundle
+        ckpt = str(tmp_path / "w2")
+        pt.checkpoint.save_checkpoint(ckpt, scope=_fc_scope(startup, 9),
+                                      step=1)
+        eng = _fc_engine(bundle, seed=3)
+        srv = Server(eng, max_wait_ms=1.0)
+        port = srv.serve_http()
+        with srv:
+            rep = HttpReplica(f"http://127.0.0.1:{port}", name="remote")
+            fleet = Fleet([rep], hedge=False)
+            with fleet:
+                x1 = np.ones((1, 4), np.float32)
+                old = np.asarray(eng.run({"x": x1})[0])
+                r = fleet.submit({"x": _row()},
+                                 timeout_ms=10_000).result(timeout=15)
+                assert np.asarray(r[0]).shape == (2,)
+                upd = fleet.update_weights(ckpt)  # over HTTP /admin/*
+                assert upd["replicas"][0]["swap"]["swapped"] == 2
+                assert rep.healthz()["state"] == "ready"
+                got = np.asarray(eng.run({"x": x1})[0])
+                want = np.asarray(
+                    _fc_engine(bundle, seed=9).run({"x": x1})[0])
+                np.testing.assert_array_equal(got, want)
+                assert not np.allclose(old, got)
+
+    def test_fleetctl_cli_status_drain_resume(self):
+        bundle = _fc_bundle()
+        fleet = Fleet([_fc_engine(bundle) for _ in range(2)],
+                      hedge=False)
+        with fleet:
+            port = fleet.serve_http()
+            url = f"http://127.0.0.1:{port}"
+
+            def ctl(*args):
+                proc = subprocess.run(
+                    [sys.executable,
+                     os.path.join(_REPO, "tools", "fleetctl.py"),
+                     "--url", url, *args],
+                    capture_output=True, text=True, timeout=60)
+                assert proc.returncode == 0, proc.stderr
+                return proc.stdout
+
+            status = json.loads(ctl("status"))
+            assert [r["name"] for r in status["replicas"]] == ["r0", "r1"]
+            out = json.loads(ctl("drain", "r1"))
+            assert out["state"]["state"] == "draining"
+            assert json.loads(ctl("status"))["replicas"][1][
+                "health"]["state"] == "draining"
+            out = json.loads(ctl("resume", "r1"))
+            assert out["state"]["state"] == "ready"
+            prom = ctl("metrics", "--prom")
+            assert "paddle_tpu_fleet_replica_health" in prom
+
+    def test_fleet_http_sheds_with_retry_after(self):
+        bundle = _fc_bundle()
+        plan = FaultPlan().at(step=0, kind="replica_crash")
+        fleet = Fleet([_fc_engine(bundle)], hedge=False,
+                      retry=Retry(max_attempts=1, name="fleet"),
+                      breaker={"failure_threshold": 1,
+                               "recovery_s": 60.0})
+        with plan.active(), fleet:
+            port = fleet.serve_http()
+            body = json.dumps(
+                {"inputs": {"x": [1.0, 1.0, 1.0, 1.0]}}).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/infer", data=body,
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=10)
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post()  # crash, retries exhausted -> 502, breaker opens
+            assert ei.value.code == 502
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post()  # now sheds before queueing
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+            assert fleet.metrics.counter("sheds") >= 1
+
+
+class TestBenchPath:
+    def test_fleet_bench_path_runs(self):
+        import jax
+
+        import bench
+
+        out = bench.bench_fleet(jax, pt, layers, n_replicas=2,
+                                n_requests=12, slow_delay_s=0.03,
+                                storm_threads=2)
+        assert out["hedged"]["availability"] == 1.0
+        assert out["unhedged"]["availability"] == 1.0
+        assert out["hedged"]["p99_ms"] > 0

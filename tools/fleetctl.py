@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""fleetctl — operate a running paddle_tpu serving fleet over HTTP.
+
+Talks to ``Fleet.serve_http`` (or, with ``--replica-url``, directly to a
+single ``Server.serve_http`` replica's /admin plane). Deliberately
+stdlib-only — no paddle_tpu import — so it runs from any box that can
+reach the fleet.
+
+    fleetctl.py --url http://host:port status
+    fleetctl.py --url http://host:port drain r1
+    fleetctl.py --url http://host:port resume r1
+    fleetctl.py --url http://host:port update-weights /ckpt/run1
+    fleetctl.py --url http://host:port chaos 'replica_crash@1,slow_replica@2'
+    fleetctl.py --url http://host:port metrics [--prom]
+
+Exit status: 0 on success, 1 on an HTTP/transport error (the body's
+``error`` field is printed to stderr).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def call(url: str, method: str = "GET", body: dict | None = None,
+         timeout: float = 120.0, raw: bool = False):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        payload = r.read()
+    return payload.decode() if raw else json.loads(payload or b"{}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleetctl", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", required=True,
+                    help="fleet base URL (Fleet.serve_http)")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="replica health, breakers, counters")
+    p = sub.add_parser("drain", help="drain one replica (healthz -> 503)")
+    p.add_argument("replica", help="replica name (r0) or index")
+    p.add_argument("--no-wait", action="store_true",
+                   help="return before in-flight work finishes")
+    p = sub.add_parser("resume", help="rejoin a drained replica")
+    p.add_argument("replica")
+    p = sub.add_parser("update-weights",
+                       help="rolling swap from a checkpoint directory")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("--no-verify", action="store_true",
+                   help="skip the warm-start manifest verify step")
+    p = sub.add_parser("chaos",
+                       help="install a fault plan, e.g. replica_crash@1")
+    p.add_argument("plan")
+    p = sub.add_parser("metrics", help="fleet metrics snapshot")
+    p.add_argument("--prom", action="store_true",
+                   help="Prometheus text exposition instead of JSON")
+    args = ap.parse_args(argv)
+
+    def _replica(value):
+        return int(value) if value.isdigit() else value
+
+    try:
+        if args.cmd == "status":
+            out = call(args.url + "/fleet/status", timeout=args.timeout)
+        elif args.cmd == "drain":
+            out = call(args.url + "/fleet/drain", "POST",
+                       {"replica": _replica(args.replica),
+                        "wait": not args.no_wait}, timeout=args.timeout)
+        elif args.cmd == "resume":
+            out = call(args.url + "/fleet/resume", "POST",
+                       {"replica": _replica(args.replica)},
+                       timeout=args.timeout)
+        elif args.cmd == "update-weights":
+            out = call(args.url + "/fleet/update_weights", "POST",
+                       {"checkpoint_dir": args.checkpoint_dir,
+                        "verify": not args.no_verify},
+                       timeout=args.timeout)
+        elif args.cmd == "chaos":
+            out = call(args.url + "/fleet/chaos", "POST",
+                       {"plan": args.plan}, timeout=args.timeout)
+        elif args.cmd == "metrics":
+            if args.prom:
+                print(call(args.url + "/metrics?format=prom",
+                           timeout=args.timeout, raw=True))
+                return 0
+            out = call(args.url + "/metrics", timeout=args.timeout)
+        else:  # unreachable (required=True)
+            return 2
+    except urllib.error.HTTPError as exc:
+        try:
+            detail = json.loads(exc.read() or b"{}").get("error", "")
+        except ValueError:
+            detail = ""
+        print(f"fleetctl: HTTP {exc.code}: {detail}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as exc:
+        print(f"fleetctl: {args.url} unreachable: {exc.reason}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
