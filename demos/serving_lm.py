@@ -128,6 +128,11 @@ def main():
             np.testing.assert_array_equal(ids[:p.shape[0]], p)
         print(f"served {len(results)} concurrent generate requests in "
               f"{wall:.2f}s through {SLOTS} decode slots")
+        stats = engine.cache_stats()
+        print(f"paged KV cache: {stats.get('kv_pages_in_use', 0)} of "
+              f"{stats.get('kv_pages_n_pages', 0)} pages in use, "
+              f"{engine.metrics.counter('prefix_hit_tokens')} prompt "
+              "tokens served from the prefix cache")
 
         stats = engine.cache_stats()
         fresh = stats["misses"] - misses_after_warmup

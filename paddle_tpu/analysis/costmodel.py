@@ -466,6 +466,25 @@ def _slot_cache_cost(attrs, ins, outs):
     return _io_cost(flops, ins, outs)
 
 
+def _paged_cache_cost(attrs, ins, outs):
+    """transformer_stack_paged_prefill/decode: the slot-cache cost plus
+    the per-row gathered context — every row streams its table-width
+    [Hkv, P*ps, dh] K/V block per layer (x2 for K and V), which is the
+    decode plane's dominant HBM term and what the dense path reads as
+    contiguous slot rows."""
+    base = _slot_cache_cost(attrs, ins, outs)
+    table = _first(ins, "BlockTable")
+    pool = _first(ins, "CacheK")
+    gathered = 0.0
+    if table is not None and pool is not None and len(pool.shape) == 5:
+        L, _, hkv, ps, dh = pool.shape
+        rows, P = table.shape
+        itemsize = np.dtype(pool.dtype).itemsize
+        gathered = 2.0 * float(L) * float(rows) * float(hkv) \
+            * float(P) * float(ps) * float(dh) * itemsize
+    return OpCost(flops=base.flops, bytes=base.bytes + gathered)
+
+
 # --------------------------------------------------------------------------
 # Coverage: every registered op gets a handler or an exempt marker.
 # (tests/test_registry_conformance.py pins the audit clean — a new op
@@ -598,6 +617,9 @@ def _register_all() -> None:
     reg(("pipelined_transformer_stack",), _stack_cost)
     reg(("transformer_stack_slot_prefill", "transformer_stack_slot_decode"),
         _slot_cache_cost)
+    reg(("transformer_stack_paged_prefill", "transformer_stack_paged_decode"),
+        _paged_cache_cost)
+    reg(("kv_cache_page_copy",), _movement)
     cost_exempt(*[n for n in _EXEMPT if has_op(n)])
 
 

@@ -87,7 +87,9 @@ def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
 
     ``q_pos0`` offsets the queries' GLOBAL positions for causal masking —
     a window of w queries starting at cache position p attends key j iff
-    j <= p + i (the block-causal mask incremental verify needs)."""
+    j <= p + i (the block-causal mask incremental verify needs). It may
+    be a [B] array of PER-ROW offsets (the paged chunked-prefill path,
+    where every batch row resumes at its own context length)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     H, Hkv = q.shape[1], k.shape[1]
@@ -103,9 +105,16 @@ def reference_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)             * sm_scale
     T = q.shape[2], k.shape[2]
     if causal:
-        qi = q_pos0 + jnp.arange(T[0])[:, None]
-        kj = jnp.arange(T[1])[None, :]
-        s = jnp.where(qi >= kj, s, -jnp.inf)
+        p0 = jnp.asarray(q_pos0)
+        if p0.ndim:  # per-row offsets: [B] -> mask [B, 1, Tq, Tk]
+            qi = p0[:, None] + jnp.arange(T[0])[None, :]
+            kj = jnp.arange(T[1])
+            s = jnp.where(qi[:, None, :, None] >= kj[None, None, None, :],
+                          s, -jnp.inf)
+        else:
+            qi = q_pos0 + jnp.arange(T[0])[:, None]
+            kj = jnp.arange(T[1])[None, :]
+            s = jnp.where(qi >= kj, s, -jnp.inf)
     if lengths is not None:
         kj = jnp.arange(T[1])[None, None, None, :]
         s = jnp.where(kj < lengths[:, None, None, None], s, -jnp.inf)

@@ -745,3 +745,196 @@ def transformer_stack_slot_decode(attrs, ins, rng=None):
     nxt = pick(_logits_fn(ln_s, ln_b, head_w)(h1[:, 0]), 0)
     return out(NextTok=nxt.astype(tok.dtype),
                CacheK=cache_k, CacheV=cache_v)
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache decode ops: the block-table serving path (vLLM's
+# PagedAttention layout on the slot-op machinery). The KV cache is a PAGE
+# POOL [L, N, Hkv, page_size, dh] living in the scope; a per-row int32
+# block table maps logical positions to physical pages, so a sequence
+# holds exactly ceil(len / page_size) pages instead of a dense Tmax row —
+# and a page shared by several sequences (a common system prompt) is
+# stored ONCE, each sharer's table pointing at the same physical page.
+# Page 0 is the scrap page: padding rows and vacant decode slots write
+# there and nothing ever attends to it. Both ops read AND write the pool,
+# so the executor threads it as donated read-write state exactly like the
+# dense slot table. (The gather materialises each row's table-width
+# context per layer — same decode HBM traffic as the dense path; the win
+# is CAPACITY. A Pallas per-page-DMA kernel is the follow-on TPU lever.)
+# ---------------------------------------------------------------------------
+
+def _gather_pages(pool_l, table):
+    """pool_l [N, Hkv, ps, dh] gathered by table [b, P] -> the flattened
+    context [b, Hkv, P*ps, dh]: flattened position j holds the token at
+    sequence position j (table entry i covers positions i*ps..(i+1)*ps-1,
+    so position order survives the transpose/reshape)."""
+    b, P = table.shape
+    _, hkv, ps, dh = pool_l.shape
+    ctx = pool_l[table]  # [b, P, Hkv, ps, dh]
+    return ctx.transpose(0, 2, 1, 3, 4).reshape(b, hkv, P * ps, dh)
+
+
+@register_op("transformer_stack_paged_prefill", optional_inputs=("PosEmb",),
+             needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
+def transformer_stack_paged_prefill(attrs, ins, rng=None):
+    """Prefill ONE CHUNK of each row's prompt into its block-table pages.
+
+    Chunk [b, Tc] int (right-padded), StartPos [b] int32 (absolute
+    sequence position of each row's first chunk token — 0 for a plain
+    prefill, the shared-prefix length for a prefix-cache hit, k*chunk for
+    the k-th chunk of a streaming long prompt), Lengths [b] int32 (valid
+    tokens in THIS chunk, 0..Tc; 0 marks a padding row), BlockTable
+    [b, P] int32 (the row's full logical->physical page map; padding
+    entries 0), CacheK/CacheV [L, N, Hkv, ps, dh] page pools, plus the
+    shared LM weights. attrs carry ``page_size`` next to the decode-op
+    set. Returns NextTok [b] — argmax/sample from each row's LAST VALID
+    chunk position (the first generated token when this chunk completes
+    the prompt; garbage otherwise) — and the pools with the chunk's K/V
+    scattered into rows StartPos..StartPos+Lengths-1 of each row's pages.
+
+    Queries attend the row's WHOLE gathered context block-causally (chunk
+    token at absolute position p sees cached position j iff j <= p), so a
+    later chunk attends every earlier chunk's pages and a shared-prefix
+    row attends the shared pages it never prefilled — token-exact vs the
+    dense one-shot prefill. Pages beyond a row's extent sit at flattened
+    positions > p and are masked by the same rule.
+    """
+    chunk = single(ins, "Chunk")
+    start = single(ins, "StartPos").astype(jnp.int32)
+    lengths = single(ins, "Lengths").astype(jnp.int32)
+    table = single(ins, "BlockTable").astype(jnp.int32)
+    cache_k = single(ins, "CacheK")
+    cache_v = single(ins, "CacheV")
+    tok_emb = single(ins, "TokEmb")
+    pos_emb = maybe(ins, "PosEmb")
+    ln_s, ln_b = single(ins, "FinalLnS"), single(ins, "FinalLnB")
+    head_w = single(ins, "HeadW")
+    params = {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()}
+    num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
+    b, Tc = chunk.shape
+    ps = cache_k.shape[3]
+    P = table.shape[1]
+    d = params["ln1_s"].shape[1]
+    # absolute positions + per-token page targets (padding -> scrap 0)
+    pos = start[:, None] + jnp.arange(Tc, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(Tc, dtype=jnp.int32)[None, :] < lengths[:, None]
+    entry = jnp.clip(pos // ps, 0, P - 1)
+    page_id = jnp.where(
+        valid, jnp.take_along_axis(table, entry, axis=1), 0)
+    page_row = jnp.where(valid, pos % ps, 0)
+    x = tok_emb[chunk]
+    if pos_emb is not None:
+        x = x + pos_emb[jnp.clip(pos, 0, pos_emb.shape[0] - 1)]
+    pick = _make_pick(attrs.get("temperature") or 0.0,
+                      attrs.get("top_k") or 0, head_w.shape[1], rng)
+    from ..kernels.flash_attention import reference_attention
+
+    def layer(h, inp):
+        layer_p, ck_l, cv_l = inp  # pools [N, Hkv, ps, dh]
+        q, k, v = _attn_proj(layer_p, h, num_heads, num_kv_heads,
+                             use_rope, pos0=start)
+        # k/v [b, Hkv, Tc, dh] -> page (page_id, page_row) per token
+        ck_l = ck_l.at[page_id, :, page_row, :].set(k.transpose(0, 2, 1, 3))
+        cv_l = cv_l.at[page_id, :, page_row, :].set(v.transpose(0, 2, 1, 3))
+        ctx = reference_attention(q, _gather_pages(ck_l, table),
+                                  _gather_pages(cv_l, table),
+                                  causal=True, q_pos0=start)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, Tc, d)
+        return _attn_out_ffn(layer_p, h, ctx), (ck_l, cv_l)
+
+    h, (cache_k, cache_v) = jax.lax.scan(layer, x,
+                                         (params, cache_k, cache_v))
+    last = h[jnp.arange(b), jnp.clip(lengths, 1, Tc) - 1]  # [b, d]
+    nxt = pick(_logits_fn(ln_s, ln_b, head_w)(last), 0)
+    return out(NextTok=nxt.astype(chunk.dtype),
+               CacheK=cache_k, CacheV=cache_v)
+
+
+@register_op("transformer_stack_paged_decode", optional_inputs=("PosEmb",),
+             needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
+def transformer_stack_paged_decode(attrs, ins, rng=None):
+    """One decode step over every slot's paged context.
+
+    Tok [S] int (the pending token per slot), Pos [S] int32 (its sequence
+    position == rows already cached for the slot), BlockTable [S, P]
+    int32 (per-slot page map; vacant slots feed all-zeros + Pos 0, so
+    their write lands in the scrap page), CacheK/CacheV [L, N, Hkv, ps,
+    dh] page pools, plus the shared LM weights. Returns NextTok [S] and
+    the pools with each slot's token K/V written at page
+    BlockTable[s, Pos//ps] row Pos%ps.
+
+    The slot axis is the batch axis and the table width is static, so the
+    compiled shape never depends on occupancy or sequence lengths — the
+    same one-compile steady state as the dense slot decode, over a pool
+    sized by TOKENS IN FLIGHT instead of slots*Tmax.
+    """
+    tok = single(ins, "Tok")
+    pos = single(ins, "Pos").astype(jnp.int32)
+    table = single(ins, "BlockTable").astype(jnp.int32)
+    cache_k = single(ins, "CacheK")
+    cache_v = single(ins, "CacheV")
+    tok_emb = single(ins, "TokEmb")
+    pos_emb = maybe(ins, "PosEmb")
+    ln_s, ln_b = single(ins, "FinalLnS"), single(ins, "FinalLnB")
+    head_w = single(ins, "HeadW")
+    params = {key: single(ins, slot) for slot, key in _STACK_SLOTS.items()}
+    num_heads = attrs["num_heads"]
+    num_kv_heads = attrs.get("num_kv_heads") or num_heads
+    use_rope = attrs.get("use_rope", False)
+    S = tok.shape[0]
+    if S != table.shape[0]:
+        raise ValueError(f"Tok has {S} slots but the block table holds "
+                         f"{table.shape[0]}")
+    ps = cache_k.shape[3]
+    P = table.shape[1]
+    d = params["ln1_s"].shape[1]
+    pos = jnp.clip(pos, 0, P * ps - 1)
+    x = tok_emb[tok]
+    if pos_emb is not None:
+        x = x + pos_emb[jnp.clip(pos, 0, pos_emb.shape[0] - 1)]
+    h1 = x[:, None, :]  # [S, 1, d]
+    pick = _make_pick(attrs.get("temperature") or 0.0,
+                      attrs.get("top_k") or 0, head_w.shape[1], rng)
+    srange = jnp.arange(S)
+    page_id = table[srange, pos // ps]  # [S]
+    page_row = pos % ps
+    from ..kernels.flash_attention import reference_attention
+
+    def layer(h1, inp):
+        layer_p, ck_l, cv_l = inp  # pools [N, Hkv, ps, dh]
+        q, k, v = _attn_proj(layer_p, h1, num_heads, num_kv_heads,
+                             use_rope, pos0=pos)
+        ck_l = ck_l.at[page_id, :, page_row, :].set(k[:, :, 0, :])
+        cv_l = cv_l.at[page_id, :, page_row, :].set(v[:, :, 0, :])
+        ctx = reference_attention(q, _gather_pages(ck_l, table),
+                                  _gather_pages(cv_l, table),
+                                  lengths=pos + 1)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(S, 1, d)
+        return _attn_out_ffn(layer_p, h1, ctx), (ck_l, cv_l)
+
+    h1, (cache_k, cache_v) = jax.lax.scan(layer, h1,
+                                          (params, cache_k, cache_v))
+    nxt = pick(_logits_fn(ln_s, ln_b, head_w)(h1[:, 0]), 0)
+    return out(NextTok=nxt.astype(tok.dtype),
+               CacheK=cache_k, CacheV=cache_v)
+
+
+@register_op("kv_cache_page_copy")
+def kv_cache_page_copy(attrs, ins):
+    """Copy whole KV pages inside the pools: the copy-on-write step.
+
+    Src [n] int32, Dst [n] int32 (distinct destination pages),
+    CacheK/CacheV [L, N, Hkv, ps, dh]. Writes pool[:, Dst[i]] =
+    pool[:, Src[i]] for both pools and echoes Dst as Ok [n] (a fetchable
+    witness — the real outputs are the donated pool updates). The serving
+    engine runs this when a sequence is about to write into a page whose
+    refcount > 1 (a shared prefix page it is diverging from)."""
+    src = single(ins, "Src").astype(jnp.int32)
+    dst = single(ins, "Dst").astype(jnp.int32)
+    cache_k = single(ins, "CacheK")
+    cache_v = single(ins, "CacheV")
+    cache_k = cache_k.at[:, dst].set(cache_k[:, src])
+    cache_v = cache_v.at[:, dst].set(cache_v[:, src])
+    return out(Ok=dst, CacheK=cache_k, CacheV=cache_v)

@@ -27,25 +27,28 @@ walkthroughs.
 """
 from .batcher import DynamicBatcher, Future, Request
 from .engine import InferenceEngine, load_param_arrays, swap_scope_params
-from .errors import (BadRequestError, EngineClosedError,
-                     FleetOverloadedError, QueueFullError,
-                     ReplicaUnavailableError, RequestTimeoutError,
-                     ServingError)
+from .errors import (BadRequestError, CacheExhaustedError,
+                     EngineClosedError, FleetOverloadedError,
+                     QueueFullError, ReplicaUnavailableError,
+                     RequestTimeoutError, ServingError)
 from .fleet import Fleet, HttpReplica, LocalReplica, Replica
-from .generation import GenerationEngine, LMSpec, spec_from_program_dict
+from .generation import (GenerationEngine, LMSpec, PagedGenerationEngine,
+                         spec_from_program_dict)
 from .metrics import MetricsRegistry
+from .paging import PagePool, PrefixIndex
 from .router import (CircuitBreaker, LeastLoadedPolicy, RoundRobinPolicy,
                      Router, SessionAffinityPolicy)
 from .server import Server
 
 __all__ = [
     "DynamicBatcher", "Future", "Request",
-    "InferenceEngine", "GenerationEngine", "LMSpec",
-    "spec_from_program_dict", "MetricsRegistry", "Server",
+    "InferenceEngine", "GenerationEngine", "PagedGenerationEngine",
+    "LMSpec", "spec_from_program_dict", "MetricsRegistry", "Server",
+    "PagePool", "PrefixIndex",
     "Fleet", "Replica", "LocalReplica", "HttpReplica",
     "Router", "CircuitBreaker", "RoundRobinPolicy", "LeastLoadedPolicy",
     "SessionAffinityPolicy", "load_param_arrays", "swap_scope_params",
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "BadRequestError", "EngineClosedError", "ReplicaUnavailableError",
-    "FleetOverloadedError",
+    "FleetOverloadedError", "CacheExhaustedError",
 ]

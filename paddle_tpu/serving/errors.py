@@ -35,6 +35,25 @@ class EngineClosedError(ServingError):
     """Submit after the server/engine was stopped."""
 
 
+class CacheExhaustedError(ServingError):
+    """The paged KV cache cannot hold this request: the pages its prompt
+    + max_new_tokens need exceed what the pool can EVER free for it.
+
+    Carries ``pages_needed`` and ``pages_free`` so callers can size
+    retries or shrink the request. Transient pressure (pages held by
+    in-flight requests) is NOT this error — the engine defers admission
+    and the queue exerts backpressure instead; this fires only when the
+    request can never fit. Maps to HTTP 503 with Retry-After.
+    """
+
+    def __init__(self, message: str, pages_needed: int = 0,
+                 pages_free: int = 0, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.pages_needed = int(pages_needed)
+        self.pages_free = int(pages_free)
+        self.retry_after_s = float(retry_after_s)
+
+
 class ReplicaUnavailableError(ServingError):
     """No replica could be routed to for an attempt: every candidate is
     draining, crashed, or behind an open circuit breaker. Retryable —

@@ -3,19 +3,29 @@
 The one-shot ``transformer_stack_generate`` op decodes a fixed batch to a
 fixed horizon: a 64-token request and a 4-token request pay the same loop,
 and nobody can join until the whole batch drains. This engine replaces
-that with ITERATION-LEVEL scheduling over a slot table: the KV cache is a
-persistable scope tensor ``[L, slots+1, Hkv, Tmax, dh]``; each request
-claims a slot, a bucketed prefill scatters its prompt K/V into it
-(``transformer_stack_slot_prefill``), and ONE compiled decode step
-(``transformer_stack_slot_decode``) advances every occupied slot each
-tick — finished sequences vacate between ticks and queued requests join
-mid-flight. The decode step's shape depends only on the slot count, so
-the steady state is a single compile-cache entry; prefill compiles once
-per (batch-bucket, prompt-bucket) pair, all warmed up front.
+that with ITERATION-LEVEL scheduling over a KV cache: each request claims
+a slot, a prefill writes its prompt K/V, and ONE compiled decode step
+advances every occupied slot each tick — finished sequences vacate
+between ticks and queued requests join mid-flight. The decode step's
+shape depends only on the slot count, so the steady state is a single
+compile-cache entry; prefill compiles once per (batch-bucket,
+prompt-bucket) pair, all warmed up front.
 
-The extra slot (index ``slots``) is a scrap slot: padding rows of a
-partially-filled prefill bucket scatter their K/V there, keeping every
-compiled shape independent of how many requests actually arrived.
+Two cache layouts share that loop, selected by ``kv_cache=``:
+
+- ``"paged"`` (default, :class:`PagedGenerationEngine`) — a page pool
+  ``[L, n_pages, Hkv, page_size, dh]`` plus per-slot block tables
+  (vLLM's PagedAttention layout): a sequence holds ``ceil(len/page_size)``
+  pages instead of a dense ``Tmax`` row, a shared page-aligned prompt
+  prefix is stored ONCE (radix-style prefix index, copy-on-write on
+  divergence), and long prompts stream in page-budgeted chunks
+  interleaved with decode ticks (Sarathi-style chunked prefill) so a
+  ``Tmax`` admission never stalls the decode plane.
+- ``"dense"`` — the original slot table ``[L, slots+1, Hkv, Tmax, dh]``;
+  every slot pays ``Tmax`` rows regardless of true length. The extra
+  slot (index ``slots``) is a scrap slot: padding rows of a partially
+  filled prefill bucket scatter their K/V there, keeping every compiled
+  shape independent of how many requests actually arrived.
 """
 from __future__ import annotations
 
@@ -38,11 +48,16 @@ from .metrics import MetricsRegistry
 CACHE_K = "serving.cache_k"
 CACHE_V = "serving.cache_v"
 
+PAGED_CACHE_K = "serving.paged_cache_k"
+PAGED_CACHE_V = "serving.paged_cache_v"
+
 # decode-family op types whose attrs + shared weights describe a stacked LM
 _DECODE_OPS = ("transformer_stack_generate", "transformer_stack_beam_search",
                "transformer_stack_speculative_generate",
                "transformer_stack_slot_prefill",
-               "transformer_stack_slot_decode")
+               "transformer_stack_slot_decode",
+               "transformer_stack_paged_prefill",
+               "transformer_stack_paged_decode")
 
 
 @dataclasses.dataclass
@@ -124,7 +139,21 @@ class _Slot:
 
 
 class GenerationEngine:
-    """Slot-table continuous batcher over the stacked-LM decode ops."""
+    """Continuous batcher over the stacked-LM decode ops.
+
+    ``kv_cache="paged"`` (the default) constructs a
+    :class:`PagedGenerationEngine`; ``kv_cache="dense"`` keeps the
+    original contiguous slot table. Both serve the same API.
+    """
+
+    # scope tensors swap_params must never clobber (live decode state)
+    _cache_names = (CACHE_K, CACHE_V)
+
+    def __new__(cls, *args, **kw):
+        if cls is GenerationEngine and \
+                (kw.get("kv_cache") or "paged") == "paged":
+            cls = PagedGenerationEngine
+        return object.__new__(cls)
 
     def __init__(self, spec: LMSpec, scope: Optional[Scope] = None, *,
                  slots: int = 8, max_seq_len: Optional[int] = None,
@@ -134,7 +163,11 @@ class GenerationEngine:
                  default_max_new_tokens: int = 16,
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  place=None, metrics: Optional[MetricsRegistry] = None,
-                 mem_budget: Optional[float] = None):
+                 mem_budget: Optional[float] = None,
+                 kv_cache: Optional[str] = None):
+        if kv_cache not in (None, "dense", "paged"):
+            raise ValueError(f"kv_cache must be 'paged' or 'dense', "
+                             f"got {kv_cache!r}")
         if slots < 1:
             raise ValueError("need at least one decode slot")
         self.spec = spec
@@ -474,9 +507,16 @@ class GenerationEngine:
             raise BadRequestError(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
                 f"exceeds the serving context ({self.tmax})")
-        self.prompt_bucket_for(prompt.size)  # raises when over-long
+        self._check_prompt_fits(prompt)
         eos = req.meta.get("eos_id")
         return prompt, max_new, self.eos_id if eos is None else eos
+
+    def _check_prompt_fits(self, prompt: np.ndarray) -> None:
+        """Layout-specific admission bound: the dense table serves a
+        prompt only if a single prefill bucket covers it; the paged
+        engine overrides this (chunked prefill takes any length the
+        context allows)."""
+        self.prompt_bucket_for(prompt.size)  # raises when over-long
 
     def admit(self, requests: List[Request]) -> int:
         """Prefill a group of requests into free slots (one bucketed
@@ -602,7 +642,7 @@ class GenerationEngine:
         from .engine import swap_scope_params
 
         return swap_scope_params(self.scope, source,
-                                 skip=(CACHE_K, CACHE_V), strict=strict,
+                                 skip=self._cache_names, strict=strict,
                                  device_ctx=self._device_ctx,
                                  metrics=self.metrics)
 
@@ -639,5 +679,743 @@ class GenerationEngine:
                 k = min(len(pending), self.free_slots)
                 self.admit(pending[:k])
                 pending = pending[k:]
+            self.decode_tick()
+        return [r.future.result(timeout=0.1) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache: block-table slots over a shared page pool
+# ---------------------------------------------------------------------------
+class _PagedSlot(_Slot):
+    __slots__ = ("pages", "shared_tokens", "cow_reserve", "prefill_done",
+                 "state")
+
+    def __init__(self, request, prompt, max_new, eos_id):
+        super().__init__(request, prompt, max_new, eos_id)
+        self.pages: List[int] = []       # physical page per table entry
+        self.shared_tokens = 0           # prefix-cache hit length
+        self.cow_reserve = 0             # pages held for copy-on-write
+        self.prefill_done = 0            # prompt tokens whose K/V is cached
+        self.state = "decode"            # "prefill" while chunks stream in
+
+
+class PagedGenerationEngine(GenerationEngine):
+    """Continuous batcher over a PAGED KV cache with prefix sharing and
+    chunked prefill.
+
+    The cache is a page pool ``[L, n_pages, Hkv, page_size, dh]`` (scope-
+    resident, donated in place like the dense table) plus a host-side
+    per-slot block table: a sequence holds ``ceil(len/page_size)``
+    physical pages, so HBM holds TOKENS IN FLIGHT, not slots x Tmax.
+    Three levers ride on the allocator:
+
+    - **Prefix sharing** (``prefix_sharing=True``): a radix-style index
+      over page-aligned prompt prefixes maps a shared system prompt to
+      refcounted pages stored once; admission of a request whose prefix
+      is cached skips that prefill entirely (``prefix_hit_tokens``
+      counts the skipped tokens). A shared page about to be written
+      (full-prompt hit diverging into generation) is copied first —
+      copy-on-write via ``kv_cache_page_copy``, one page reserved at
+      admission so decode never allocates.
+    - **Chunked prefill**: a prompt longer than ``prefill_chunk`` tokens
+      streams in page-budgeted chunks, one chunk per engine tick,
+      INTERLEAVED with decode ticks — a Tmax admission no longer stalls
+      every in-flight stream (Sarathi-style stall-free batching).
+    - **Typed backpressure**: a request whose prompt + max_new_tokens can
+      NEVER fit the pool fails with
+      :class:`~paddle_tpu.serving.errors.CacheExhaustedError`; transient
+      pressure defers admission (the batcher queue backs up and sheds)
+      instead of failing mid-decode.
+
+    Everything else — warmup manifests, ``swap_params`` rolling updates,
+    drain, fleet membership, metrics names — is inherited unchanged.
+    """
+
+    _cache_names = (PAGED_CACHE_K, PAGED_CACHE_V)
+
+    def __init__(self, spec: LMSpec, scope: Optional[Scope] = None, *,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_sharing: bool = True,
+                 kv_cache: Optional[str] = None, **kw):
+        if kv_cache not in (None, "paged"):
+            raise ValueError(
+                f"PagedGenerationEngine is kv_cache='paged' (got "
+                f"{kv_cache!r}); use GenerationEngine(kv_cache='dense') "
+                "for the dense slot table")
+        if page_size is not None and page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self._page_size_arg = page_size
+        self._n_pages_arg = n_pages
+        self._prefill_chunk_arg = prefill_chunk
+        self._prefix_sharing = bool(prefix_sharing)
+        super().__init__(spec, scope, **kw)
+
+    # -- cache / program construction -----------------------------------
+    def _init_cache(self):
+        import jax.numpy as jnp
+
+        from collections import deque
+
+        from .paging import PagePool, PrefixIndex
+
+        s = self.spec
+        self.page_size = int(self._page_size_arg or min(64, self.tmax))
+        # table width: enough entries for a full-context sequence
+        self.pmax = -(-self.tmax // self.page_size)
+        self.n_pages = int(self._n_pages_arg
+                           or self.slots * self.pmax + 1)
+        if self.n_pages < 2:
+            raise ValueError("need at least 2 pages (one is scrap)")
+        chunk = self._prefill_chunk_arg
+        if chunk is None:
+            chunk = min(self.prompt_buckets[-1],
+                        max(2 * self.page_size, 128))
+        self.prefill_chunk = max(1, min(int(chunk), self.tmax))
+        self._chunk_widths = sorted(
+            {b for b in self.prompt_buckets if b <= self.prefill_chunk}
+            | {self.prefill_chunk})
+        self.pool = PagePool(self.n_pages, self.page_size)
+        self.prefix_index = (PrefixIndex(self.pool)
+                             if self._prefix_sharing else None)
+        # no scrap SLOT here — padding/vacant rows write the scrap PAGE,
+        # so the decode batch is exactly the slot count
+        self._nslots = self.slots
+        self._tok = np.zeros(self._nslots, np.int64)
+        self._pos = np.zeros(self._nslots, np.int32)
+        self._deferred = deque()  # pool-blocked validated admissions
+        self._pf_cursor = 0       # round-robin over prefilling slots
+        shape = (s.n_layers, self.n_pages, s.kv_heads, self.page_size,
+                 s.head_dim)
+        self.scope.set(PAGED_CACHE_K, jnp.zeros(shape, jnp.float32))
+        self.scope.set(PAGED_CACHE_V, jnp.zeros(shape, jnp.float32))
+        self._page_copy_prog_cache = None
+        self.metrics.set_gauge("mem/kv_cache_bytes",
+                               2.0 * float(np.prod(shape)) * 4)
+        self.metrics.set_gauge("mem/kv_block_table_bytes",
+                               float(self.slots * self.pmax * 4))
+        self._gauges()
+
+    def _cache_vars(self, helper):
+        s = self.spec
+        shape = [s.n_layers, self.n_pages, s.kv_heads, self.page_size,
+                 s.head_dim]
+        ck = helper.create_global_variable(name=PAGED_CACHE_K, shape=shape,
+                                           dtype="float32")
+        cv = helper.create_global_variable(name=PAGED_CACHE_V, shape=shape,
+                                           dtype="float32")
+        return ck, cv
+
+    def _decode_attrs(self):
+        attrs = super()._decode_attrs()
+        attrs["page_size"] = self.page_size
+        return attrs
+
+    _PREFILL_FEEDS = ("serving.chunk", "serving.start", "serving.chunk_len",
+                      "serving.block_table")
+
+    def _build_prefill(self, tc: int):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            chunk = data_layer("serving.chunk", shape=[tc], dtype="int64")
+            start = data_layer("serving.start", shape=[], dtype="int32")
+            length = data_layer("serving.chunk_len", shape=[],
+                                dtype="int32")
+            table = data_layer("serving.block_table", shape=[self.pmax],
+                               dtype="int32")
+            helper = LayerHelper("serving_paged_prefill", main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            nxt = helper.block.create_var(
+                name="serving.next_tok", shape=[-1],
+                dtype="int64", stop_gradient=True)
+            ins = {"Chunk": [chunk], "StartPos": [start],
+                   "Lengths": [length], "BlockTable": [table],
+                   "CacheK": [ck], "CacheV": [cv]}
+            ins.update(self._lm_ins(helper))
+            helper.append_op(
+                "transformer_stack_paged_prefill", ins,
+                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
+                self._decode_attrs())
+        self._transpile(prog, list(self._PREFILL_FEEDS), [nxt.name],
+                        f"transpile/prefill{tc}/")
+        return prog, nxt
+
+    def _build_decode(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            tok = data_layer("serving.tok", shape=[self._nslots],
+                             dtype="int64", append_batch_size=False)
+            pos = data_layer("serving.pos", shape=[self._nslots],
+                             dtype="int32", append_batch_size=False)
+            table = data_layer("serving.block_table",
+                               shape=[self._nslots, self.pmax],
+                               dtype="int32", append_batch_size=False)
+            helper = LayerHelper("serving_paged_decode", main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            nxt = helper.block.create_var(
+                name="serving.next_tok",
+                shape=[self._nslots], dtype="int64", stop_gradient=True)
+            ins = {"Tok": [tok], "Pos": [pos], "BlockTable": [table],
+                   "CacheK": [ck], "CacheV": [cv]}
+            ins.update(self._lm_ins(helper))
+            helper.append_op(
+                "transformer_stack_paged_decode", ins,
+                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
+                self._decode_attrs())
+        self._transpile(prog, ["serving.tok", "serving.pos",
+                               "serving.block_table"], [nxt.name],
+                        "transpile/decode/")
+        return prog, nxt
+
+    @property
+    def _page_copy_prog(self):
+        if self._page_copy_prog_cache is None:
+            prog, startup = Program(), Program()
+            with program_guard(prog, startup):
+                src = data_layer("serving.cow_src", shape=[1],
+                                 dtype="int32", append_batch_size=False)
+                dst = data_layer("serving.cow_dst", shape=[1],
+                                 dtype="int32", append_batch_size=False)
+                helper = LayerHelper("serving_page_copy",
+                                     main_program=prog,
+                                     startup_program=startup)
+                ck, cv = self._cache_vars(helper)
+                ok = helper.block.create_var(
+                    name="serving.cow_ok", shape=[1], dtype="int32",
+                    stop_gradient=True)
+                helper.append_op(
+                    "kv_cache_page_copy",
+                    {"Src": [src], "Dst": [dst],
+                     "CacheK": [ck], "CacheV": [cv]},
+                    {"Ok": [ok], "CacheK": [ck], "CacheV": [cv]}, {})
+            self._transpile(prog, ["serving.cow_src", "serving.cow_dst"],
+                            [ok.name], "transpile/page_copy/")
+            self._page_copy_prog_cache = (prog, ok)
+        return self._page_copy_prog_cache
+
+    # -- admission bounds ------------------------------------------------
+    def _check_prompt_fits(self, prompt: np.ndarray) -> None:
+        # chunked prefill serves ANY prompt the context admits — the
+        # prompt + max_new_tokens <= tmax check already ran
+        pass
+
+    def _chunk_bucket_for(self, n: int) -> int:
+        for b in self._chunk_widths:
+            if n <= b:
+                return b
+        return self._chunk_widths[-1]
+
+    def _entries_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    # -- warmup / manifests ----------------------------------------------
+    def warmup(self) -> int:
+        """Compile every (chunk-width x batch-bucket) prefill shape, the
+        decode step, and the copy-on-write page copy. All warmup rows
+        write the scrap page, so live pages are never touched."""
+        combos = 0
+        if self.temperature > 0:
+            self.executor._rng_state(self._decode_prog[0], self.scope)
+        for tc in self._chunk_widths:
+            prog, nxt = self._prefill_prog(tc)
+            for b in self.prefill_batch_buckets:
+                feed = {
+                    "serving.chunk": np.full((b, tc), self.pad_id,
+                                             np.int64),
+                    "serving.start": np.zeros(b, np.int32),
+                    "serving.chunk_len": np.ones(b, np.int32),
+                    "serving.block_table": np.zeros((b, self.pmax),
+                                                    np.int32),
+                }
+                with self._device_ctx():
+                    self.executor.run(prog, feed=feed, fetch_list=[nxt],
+                                      scope=self.scope)
+                combos += 1
+        with self._device_ctx():
+            self._run_decode()
+        combos += 1
+        self._run_page_copy(0, 0)  # scrap onto itself: harmless
+        combos += 1
+        self.metrics.inc("warmup_compiles", combos)
+        self.save_manifest()
+        return combos
+
+    def _warm_programs(self):
+        progs = [self._decode_prog[0], self._page_copy_prog[0]]
+        progs.extend(self._prefill_prog(tc)[0]
+                     for tc in self._chunk_widths)
+        return progs
+
+    def _check_mem_budget(self, budget: float) -> None:
+        """Budget gate with the PAGE POOL (+ block tables) counted as the
+        resident KV state — the pool lives in the scope, so the analyzer
+        prices what is actually allocated, not the dense slots x Tmax
+        formula."""
+        from .. import analysis
+
+        prog, nxt = self._decode_prog
+        mem = analysis.check_memory_budget(
+            prog, ["serving.tok", "serving.pos", "serving.block_table"],
+            [nxt.name], budget, scope=self.scope, batch_size=self._nslots,
+            what=f"PagedGenerationEngine decode step (slots={self.slots}, "
+                 f"pages={self.n_pages}x{self.page_size})")
+        tc = self._chunk_widths[-1]
+        pprog, pnxt = self._prefill_prog(tc)
+        pmem = analysis.check_memory_budget(
+            pprog, list(self._PREFILL_FEEDS), [pnxt.name], budget,
+            scope=self.scope,
+            batch_size=self.prefill_batch_buckets[-1],
+            what=f"PagedGenerationEngine prefill (chunk {tc})")
+        self.metrics.set_gauge("mem/static_peak_bytes",
+                               max(mem.peak_bytes, pmem.peak_bytes))
+
+    # -- page bookkeeping -------------------------------------------------
+    def _run_page_copy(self, src: int, dst: int) -> None:
+        prog, ok = self._page_copy_prog
+        with self._device_ctx():
+            self.executor.run(
+                prog, feed={"serving.cow_src": np.asarray([src], np.int32),
+                            "serving.cow_dst": np.asarray([dst], np.int32)},
+                fetch_list=[ok], scope=self.scope)
+
+    def _cow_guard(self, decoding) -> None:
+        """Before a decode tick writes position ``pos`` for each slot,
+        any target page still shared (refcount > 1 — a prefix-cache page
+        this sequence is diverging from) is copied to a fresh page from
+        the slot's admission-time reserve and the block table redirected.
+        Runs at page-boundary granularity: at most one copy per shared
+        prefix per sequence lifetime."""
+        for slot in decoding:
+            st = self._slots[slot]
+            entry = int(self._pos[slot]) // self.page_size
+            pid = st.pages[entry]
+            if self.pool.refcount(pid) <= 1:
+                continue
+            if st.cow_reserve > 0:
+                st.cow_reserve -= 1
+                new = self.pool.alloc(reserved=True)
+            else:  # defensive: never expected, but never corrupt a share
+                if self.pool.available() < 1 and self.prefix_index:
+                    self.prefix_index.evict_until(1)
+                new = self.pool.alloc()
+            self._run_page_copy(pid, new)
+            self.pool.decref(pid)
+            st.pages[entry] = new
+            self.metrics.inc("kv_cow_copies")
+
+    def _register_prefix(self, st: _PagedSlot,
+                         include_tail: bool = False) -> None:
+        """Publish the slot's fully-written prompt pages into the prefix
+        index (idempotent: existing keys no-op). Full pages register once
+        their content is prefilled; the partial tail page only at finish
+        (an index reference on a page the request still writes would
+        force a pointless self-copy-on-write)."""
+        if self.prefix_index is None or st.prefill_done < st.prompt.size:
+            return
+        ps = self.page_size
+        prompt = st.prompt
+        n_full = prompt.size // ps
+        key = b""
+        for i in range(n_full):
+            key = self.prefix_index.insert(
+                key, prompt[i * ps:(i + 1) * ps], st.pages[i])
+        tail = prompt[n_full * ps:]
+        if include_tail and tail.size:
+            self.prefix_index.insert(key, tail, st.pages[n_full])
+
+    def _release_pages(self, st: _PagedSlot) -> None:
+        if self._prefix_sharing:
+            self._register_prefix(st, include_tail=True)
+        for pid in st.pages:
+            self.pool.decref(pid)
+        st.pages = []
+        if st.cow_reserve:
+            self.pool.release_reservation(st.cow_reserve)
+            st.cow_reserve = 0
+
+    def _finish(self, slot: int) -> None:
+        self._release_pages(self._slots[slot])
+        super()._finish(slot)
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, requests: List[Request]) -> int:
+        """Admit a group of requests: prefix-cache lookup + page
+        allocation per request, then ONE bucketed prefill over everyone
+        whose (unshared) prompt remainder fits ``prefill_chunk``; longer
+        prompts claim their slot and stream in via :meth:`prefill_tick`.
+        Requests the pool cannot hold right now are DEFERRED (retried
+        each tick as pages free) — only a request that can never fit
+        fails, typed. Returns the number admitted to a slot."""
+        todo = []
+        for req in requests:
+            try:
+                todo.append((req, *self._validate(req)))
+            except BadRequestError as exc:
+                self.metrics.inc("bad_requests")
+                req.end_trace(status="bad_request")
+                req.future.set_exception(exc)
+        if not todo:
+            return 0
+        if len(todo) > self.free_slots:
+            raise RuntimeError(f"admit() got {len(todo)} requests for "
+                               f"{self.free_slots} free slots")
+        group: list = []
+        admitted = 0
+        for item in todo:
+            if self._deferred:  # keep FIFO order behind blocked work
+                self._deferred.append(item)
+                continue
+            r = self._admit_one(*item, group=group)
+            if r == "ok":
+                admitted += 1
+            elif r == "defer":
+                self._deferred.append(item)
+        if group:
+            self._run_prefill_group(group)
+        self._gauges()
+        return admitted
+
+    def _admit_one(self, req, prompt, max_new, eos, group) -> str:
+        """Claim a slot + pages for one validated request. Returns "ok"
+        (slot taken; short prefills appended to ``group``), "defer"
+        (transient pool pressure), or "failed" (future completed with
+        CacheExhaustedError — the request can NEVER fit)."""
+        from .errors import CacheExhaustedError
+
+        plen = int(prompt.size)
+        entries_total = self._entries_for(plen + max_new)
+        # worst-case pages: entries_total when unshared; a shared prefix
+        # trades >=1 allocated page for <=1 copy-on-write spare, so the
+        # bound never grows — entries_total > capacity can NEVER fit
+        if entries_total > self.pool.capacity:
+            exc = CacheExhaustedError(
+                f"prompt ({plen}) + max_new_tokens ({max_new}) needs "
+                f"{entries_total} pages but the pool holds only "
+                f"{self.pool.capacity} allocatable pages of "
+                f"{self.page_size} tokens — shrink the request or grow "
+                f"n_pages",
+                pages_needed=entries_total,
+                pages_free=self.pool.capacity)
+            self.metrics.inc("cache_exhausted")
+            req.end_trace(status="cache_exhausted")
+            req.future.set_exception(exc)
+            return "failed"
+        shared, spages = 0, []
+        if self.prefix_index is not None:
+            shared, spages, _ = self.prefix_index.lookup(prompt)
+        own = entries_total - len(spages)
+        cow = 1 if shared == plen else 0  # generation writes a shared page
+        need = own + cow
+        for pid in spages:  # hold the prefix before any eviction runs
+            self.pool.incref(pid)
+        if self.pool.available() < need:
+            if self.prefix_index is not None:
+                self.prefix_index.evict_until(need)
+            if self.pool.available() < need:
+                for pid in spages:
+                    self.pool.decref(pid)
+                self.metrics.inc("admission_deferred")
+                return "defer"
+        owned = [self.pool.alloc() for _ in range(own)]
+        if cow:
+            self.pool.reserve(cow)
+        slot = self._slots.index(None)
+        st = _PagedSlot(req, prompt, max_new, eos)
+        st.pages = list(spages) + owned
+        st.shared_tokens = shared
+        st.cow_reserve = cow
+        st.prefill_done = shared
+        self._slots[slot] = st
+        if shared:
+            self.metrics.inc("prefix_hits")
+            self.metrics.inc("prefix_hit_tokens", shared)
+        if req.span is not None:
+            req.span.set_attrs(slot=slot, prompt_len=plen,
+                               prefix_hit_tokens=shared)
+        remaining = plen - shared
+        if remaining == 0:
+            # full prefix hit: skip prefill entirely and enter the decode
+            # loop one step behind — re-feeding the last prompt token at
+            # its own position re-derives (bit-identically) the K/V the
+            # shared page already holds and yields the first generated
+            # token on the first tick. The rewrite goes through the
+            # copy-on-write guard, so the shared page itself stays intact.
+            st.state = "decode"
+            self._tok[slot] = prompt[-1]
+            self._pos[slot] = plen - 1
+        elif remaining <= self.prefill_chunk:
+            st.state = "prefill"
+            group.append((req, st, slot))
+        else:
+            st.state = "prefill"  # streams via prefill_tick
+        return "ok"
+
+    def _run_prefill_group(self, group) -> None:
+        """One bucketed prefill call over freshly-admitted requests whose
+        unshared remainder fits a single chunk (mixed prefix offsets ride
+        the per-row StartPos plane). A group beyond the largest warm
+        batch bucket splits into bucket-sized calls."""
+        cap = self.prefill_batch_buckets[-1]
+        if len(group) > cap:
+            for i in range(0, len(group), cap):
+                self._run_prefill_group(group[i:i + cap])
+            return
+        rem = [st.prompt.size - st.prefill_done for _, st, _ in group]
+        tc = self._chunk_bucket_for(max(rem))
+        bucket = self._batch_bucket_for(len(group))
+        chunk = np.full((bucket, tc), self.pad_id, np.int64)
+        start = np.zeros(bucket, np.int32)
+        length = np.zeros(bucket, np.int32)
+        table = np.zeros((bucket, self.pmax), np.int32)
+        for row, (req, st, slot) in enumerate(group):
+            r = rem[row]
+            chunk[row, :r] = st.prompt[st.prefill_done:]
+            start[row] = st.prefill_done
+            length[row] = r
+            table[row, :len(st.pages)] = st.pages
+        prog, nxt = self._prefill_prog(tc)
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/prefill"):
+            first, = self.executor.run(
+                prog, feed={"serving.chunk": chunk,
+                            "serving.start": start,
+                            "serving.chunk_len": length,
+                            "serving.block_table": table},
+                fetch_list=[nxt], scope=self.scope)
+        t1 = time.perf_counter()
+        self.metrics.observe_latency(t1 - t0, name="prefill")
+        self.metrics.inc("prefills")
+        self.metrics.set_gauge("prefill_occupancy", len(group) / bucket)
+        first = np.asarray(first)
+        for row, (req, st, slot) in enumerate(group):
+            if req.span is not None:
+                trace.record("serving/execute", t0, t1, parent=req.span,
+                             phase="prefill", slot=slot,
+                             prompt_len=int(st.prompt.size),
+                             prompt_bucket=tc, batch_bucket=bucket)
+            st.prefill_done = st.prompt.size
+            st.state = "decode"
+            self._tok[slot] = first[row]
+            self._pos[slot] = st.prompt.size
+            self._register_prefix(st)
+            self._emit(slot, int(first[row]))
+
+    def _admit_deferred(self) -> int:
+        """Retry pool-blocked admissions in arrival order. Expired ones
+        time out; when the engine is COMPLETELY idle and the head still
+        cannot fit, nothing will ever free the pages it needs — fail it
+        typed rather than park it forever."""
+        from .errors import CacheExhaustedError
+        from .errors import RequestTimeoutError as _Timeout
+
+        admitted = 0
+        while self._deferred:
+            req, prompt, max_new, eos = self._deferred[0]
+            if req.expired():
+                self._deferred.popleft()
+                self.metrics.inc("timeouts")
+                req.end_trace(status="timeout")
+                req.future.set_exception(_Timeout(
+                    "request deadline expired while deferred on the KV "
+                    "page pool"))
+                continue
+            if self.free_slots == 0:
+                break
+            group: list = []
+            r = self._admit_one(req, prompt, max_new, eos, group=group)
+            if r == "defer":
+                if self.active == 0 and admitted == 0:
+                    self._deferred.popleft()
+                    need = self._entries_for(prompt.size + max_new)
+                    self.metrics.inc("cache_exhausted")
+                    req.end_trace(status="cache_exhausted")
+                    req.future.set_exception(CacheExhaustedError(
+                        f"KV page pool cannot free the {need} pages this "
+                        f"request needs ({self.pool.available()} "
+                        "available and no requests in flight)",
+                        pages_needed=need,
+                        pages_free=self.pool.available()))
+                    continue
+                break
+            self._deferred.popleft()
+            if group:
+                self._run_prefill_group(group)
+            if r == "ok":
+                admitted += 1
+        if admitted:
+            self._gauges()
+        return admitted
+
+    # -- the tick loop ----------------------------------------------------
+    @property
+    def prefilling(self) -> int:
+        return sum(1 for s in self._slots
+                   if s is not None and s.state == "prefill")
+
+    def prefill_tick(self) -> bool:
+        """Advance ONE prefilling slot by one chunk (<= prefill_chunk
+        tokens): the tokens-per-tick budget that keeps decode latency
+        flat while a long prompt streams in. Round-robin across
+        prefilling slots; returns True when a chunk ran."""
+        order = [(self._pf_cursor + i) % self.slots
+                 for i in range(self.slots)]
+        slot = next((i for i in order if self._slots[i] is not None
+                     and self._slots[i].state == "prefill"), None)
+        if slot is None:
+            return False
+        self._pf_cursor = (slot + 1) % self.slots
+        st = self._slots[slot]
+        plen = int(st.prompt.size)
+        start0 = st.prefill_done
+        k = min(self.prefill_chunk, plen - start0)
+        tc = self._chunk_bucket_for(k)
+        bucket = self._batch_bucket_for(1)
+        chunk = np.full((bucket, tc), self.pad_id, np.int64)
+        start = np.zeros(bucket, np.int32)
+        length = np.zeros(bucket, np.int32)
+        table = np.zeros((bucket, self.pmax), np.int32)
+        chunk[0, :k] = st.prompt[start0:start0 + k]
+        start[0] = start0
+        length[0] = k
+        table[0, :len(st.pages)] = st.pages
+        prog, nxt = self._prefill_prog(tc)
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/prefill"), \
+                trace.span("serving/prefill_chunk", slot=slot,
+                           start=start0, tokens=k):
+            first, = self.executor.run(
+                prog, feed={"serving.chunk": chunk,
+                            "serving.start": start,
+                            "serving.chunk_len": length,
+                            "serving.block_table": table},
+                fetch_list=[nxt], scope=self.scope)
+        self.metrics.observe_latency(time.perf_counter() - t0,
+                                     name="prefill_chunk")
+        self.metrics.inc("prefill_chunks")
+        st.prefill_done = start0 + k
+        if st.prefill_done >= plen:
+            self.metrics.inc("prefills")
+            st.state = "decode"
+            self._tok[slot] = np.asarray(first)[0]
+            self._pos[slot] = plen
+            self._register_prefix(st)
+            self._emit(slot, int(np.asarray(first)[0]))
+            self._gauges()
+        return True
+
+    def _run_decode(self):
+        table = np.zeros((self._nslots, self.pmax), np.int32)
+        tok = np.zeros(self._nslots, np.int64)
+        pos = np.zeros(self._nslots, np.int32)
+        for s in range(self.slots):
+            st = self._slots[s]
+            if st is not None and st.state == "decode":
+                tok[s] = self._tok[s]
+                pos[s] = self._pos[s]
+                table[s, :len(st.pages)] = st.pages
+        prog, nxt = self._decode_prog
+        res, = self.executor.run(
+            prog, feed={"serving.tok": tok, "serving.pos": pos,
+                        "serving.block_table": table},
+            fetch_list=[nxt], scope=self.scope)
+        return np.asarray(res)
+
+    def decode_tick(self) -> bool:
+        """Advance every DECODING slot one token (prefilling slots sit
+        out — their block tables are mid-write). One compiled step, same
+        shape regardless of occupancy."""
+        decoding = [s for s in range(self.slots)
+                    if self._slots[s] is not None
+                    and self._slots[s].state == "decode"]
+        if not decoding:
+            return False
+        self._cow_guard(decoding)
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/decode_step"), \
+                trace.span("serving/decode_step", active=len(decoding)):
+            nxt = self._run_decode()
+        self.metrics.observe_latency(time.perf_counter() - t0,
+                                     name="decode_step")
+        self.metrics.inc("decode_steps")
+        self.metrics.set_gauge("batch_occupancy",
+                               len(decoding) / self.slots)
+        for slot in decoding:
+            if self._slots[slot] is None:
+                continue
+            self._pos[slot] += 1
+            self._tok[slot] = nxt[slot]
+            self._emit(slot, int(nxt[slot]))
+        self._gauges()
+        return True
+
+    def _gauges(self):
+        super()._gauges()
+        self.metrics.set_gauge("mem/kv_pages_in_use",
+                               self.pool.pages_in_use())
+        self.metrics.set_gauge("mem/kv_pages_free",
+                               self.pool.available())
+        if self.prefix_index is not None:
+            self.metrics.set_gauge("kv_prefix_entries",
+                                   len(self.prefix_index))
+
+    def cache_stats(self) -> dict:
+        """Compile-cache counters (base contract) plus the page pool and
+        prefix index, flattened to numbers so the server can export
+        every key as a gauge."""
+        stats = dict(super().cache_stats())
+        for k, v in self.pool.stats().items():
+            stats[f"kv_pages_{k}"] = v
+        if self.prefix_index is not None:
+            for k, v in self.prefix_index.stats().items():
+                stats[f"kv_prefix_{k}"] = v
+        return stats
+
+    def swap_params(self, source, *, strict: bool = True):
+        """Rolling weight update (see the base contract) PLUS prefix-
+        cache invalidation: cached prefix pages hold K/V computed with
+        the OLD weights — serving them after a swap would be silently
+        stale, so every index entry is dropped (pages still referenced
+        by in-flight slots stay resident until those requests finish)."""
+        stats = super().swap_params(source, strict=strict)
+        if self.prefix_index is not None:
+            dropped = self.prefix_index.clear()
+            if dropped:
+                self.metrics.inc("prefix_entries_invalidated", dropped)
+            self._gauges()
+        return stats
+
+    # -- server-driver interface ------------------------------------------
+    def serve_step(self, batcher,
+                   idle_wait_s: Optional[float] = None) -> bool:
+        did = self._admit_deferred() > 0
+        free = self.free_slots
+        if free and not self._deferred:
+            wait = 0 if (self.active or did) else idle_wait_s
+            reqs = batcher.next_batch(max_n=free, wait_s=wait)
+            if reqs:
+                did = self.admit(reqs) > 0 or did
+        did = self.prefill_tick() or did
+        did = self.decode_tick() or did
+        return did
+
+    def generate_all(self, prompts: Sequence[Sequence[int]],
+                     max_new_tokens: Optional[int] = None,
+                     eos_id: Optional[int] = None) -> List[np.ndarray]:
+        max_new = max_new_tokens or self.default_max_new_tokens
+        reqs = [Request({"prompt": p},
+                        {"max_new_tokens": max_new, "eos_id": eos_id},
+                        None)
+                for p in prompts]
+        pending = list(reqs)
+        while pending or self.active or self._deferred:
+            if pending and self.free_slots and not self._deferred:
+                k = min(len(pending), self.free_slots)
+                self.admit(pending[:k])
+                pending = pending[k:]
+            self._admit_deferred()
+            self.prefill_tick()
             self.decode_tick()
         return [r.future.result(timeout=0.1) for r in reqs]

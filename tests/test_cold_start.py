@@ -436,7 +436,8 @@ class TestEngineWarmStart:
 
         eng2 = GenerationEngine.from_saved(d, slots=2, prompt_buckets=(8,),
                                            prefill_batch_buckets=(1, 2))
-        assert eng2.warm_from_manifest() == 3  # 2 prefill buckets + decode
+        # 2 prefill batch buckets + decode + the copy-on-write page copy
+        assert eng2.warm_from_manifest() == 4
         misses0 = eng2.cache_stats()["misses"]
         got = np.stack(eng2.generate_all(list(prompts), max_new_tokens=4))
         np.testing.assert_array_equal(got, ref)

@@ -501,10 +501,11 @@ class TestRollingUpdate:
         spec = LMSpec(vocab_size=VOCAB, d_model=D, n_layers=L,
                       num_heads=H, max_len=MAXLEN)
         ckpt = str(tmp_path / "lm_v2")
-        pt.checkpoint.save_checkpoint(ckpt, scope=lm_scope(9), step=1)
+        s9 = lm_scope(9)  # checkpoint source AND eng_b weights
+        pt.checkpoint.save_checkpoint(ckpt, scope=s9, step=1)
 
         eng_a = GenerationEngine(spec, lm_scope(3), slots=4)
-        eng_b = GenerationEngine(spec, lm_scope(9), slots=4)
+        eng_b = GenerationEngine(spec, s9, slots=4)
         prompts = [[1, 2, 3], [4, 5], [7]]
         before = eng_a.generate_all(prompts, max_new_tokens=4)
         stats = eng_a.swap_params(ckpt)

@@ -92,6 +92,50 @@ def test_audit_catches_op_without_cost_handler():
         registry._REGISTRY.pop("conformance_test_uncosted_op", None)
 
 
+def test_paged_cache_ops_conform():
+    """The paged-KV serving ops carry the full registry contract:
+    optional-input declarations, cost handlers, and working
+    infer_outputs (shape inference straight off the kernel)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.analysis import costmodel
+
+    for op in ("transformer_stack_paged_prefill",
+               "transformer_stack_paged_decode", "kv_cache_page_copy"):
+        assert not analysis.audit_op(op), op
+        assert costmodel.has_cost(op), op
+    for op in ("transformer_stack_paged_prefill",
+               "transformer_stack_paged_decode"):
+        assert "PosEmb" in registry.get_op(op).optional_inputs
+
+    L, Hkv, dh, d, V, ps, N, P, S = 2, 1, 8, 16, 32, 4, 6, 3, 2
+    sds = jax.ShapeDtypeStruct
+    stack = {
+        "Ln1S": (L, d), "Ln1B": (L, d), "QkvW": (L, d, d + 2 * Hkv * dh),
+        "OutW": (L, d, d), "Ln2S": (L, d), "Ln2B": (L, d),
+        "FfW1": (L, d, 4 * d), "FfB1": (L, 4 * d),
+        "FfW2": (L, 4 * d, d), "FfB2": (L, d),
+        "TokEmb": (V, d), "FinalLnS": (d,), "FinalLnB": (d,),
+        "HeadW": (d, V),
+    }
+    ins = {k: [sds(s, np.float32)] for k, s in stack.items()}
+    ins.update({
+        "Tok": [sds((S,), np.int64)], "Pos": [sds((S,), np.int32)],
+        "BlockTable": [sds((S, P), np.int32)],
+        "CacheK": [sds((L, N, Hkv, ps, dh), np.float32)],
+        "CacheV": [sds((L, N, Hkv, ps, dh), np.float32)],
+    })
+    attrs = {"num_heads": 2, "num_kv_heads": Hkv, "page_size": ps}
+    outs = registry.infer_outputs("transformer_stack_paged_decode",
+                                  attrs, ins)
+    assert tuple(outs["NextTok"][0].shape) == (S,)
+    assert tuple(outs["CacheK"][0].shape) == (L, N, Hkv, ps, dh)
+    cost = registry.get_op("transformer_stack_paged_decode").cost_fn(
+        attrs, ins, outs)
+    assert cost.flops > 0 and cost.bytes > 0
+
+
 def test_audit_accepts_cost_exempt_marker():
     registry.register_op("conformance_test_exempt_op", _identity_kernel)
     try:

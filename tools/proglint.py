@@ -99,6 +99,19 @@ def build_demo(name: str):
                 max_len=64, max_new_tokens=8)
         yield ("serving_lm[generate]", gen, ["prompt"], [out_ids.name],
                None)
+        # the continuous-batching engine's PAGED decode step, WITH its
+        # scope: memplan/proglint --mem price the page pool + block
+        # tables as the resident KV state (what the engine's
+        # mem_budget gate checks at build time)
+        from paddle_tpu.serving import GenerationEngine, LMSpec
+
+        eng = GenerationEngine(
+            LMSpec(vocab_size=97, d_model=32, n_layers=2, num_heads=4,
+                   max_len=64), slots=4, page_size=16)
+        dprog, dnxt = eng._decode_prog
+        yield ("serving_lm[paged_decode]", dprog,
+               ["serving.tok", "serving.pos", "serving.block_table"],
+               [dnxt.name], eng.scope)
     else:
         raise SystemExit(f"unknown --demo {name!r} (have: {DEMOS})")
 
