@@ -1113,6 +1113,164 @@ def bench_paged_kv(jax, pt, layers, models, tmax=2048, page_size=64,
     }
 
 
+def _sharding_measure(jax, pt, layers, batch=64, dim=256, steps=12,
+                      rounds=3, warmup=2):
+    """The one-sharding-plane A/B, run on whatever devices this process
+    owns: single-device vs dp=N vs dp(N/2) x mp2, interleaved rounds with
+    medians (the drift defense every bench here uses). Per leg: step
+    wall, per-device parameter bytes (live shard sizes), the static
+    per-device peak-HBM + collective-bytes estimate
+    (analysis.analyze_memory(plan=...)), steady-state fresh compiles
+    (must be 0 after warmup — the plan-digest cache-key contract), and
+    the final loss for cross-leg parity."""
+    import numpy as np
+
+    from paddle_tpu import analysis
+    from paddle_tpu.parallel import (data_parallel_plan, make_mesh,
+                                     megatron_plan)
+
+    n = len(jax.devices())
+    plans = [("single", None)]
+    if n >= 2:
+        plans.append((f"dp{n}", data_parallel_plan(make_mesh({"dp": n}))))
+    if n >= 4:
+        plans.append((f"dp{n // 2}xmp2",
+                      megatron_plan(make_mesh({"dp": n // 2, "mp": 2}))))
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(batch, dim).astype("float32")
+    ys = rng.randint(0, 16, size=(batch, 1)).astype("int64")
+
+    legs = []
+    for tag, plan in plans:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[dim])
+            y = layers.data("y", shape=[1], dtype="int64")
+            h = layers.fc(x, size=dim, act="relu")
+            h = layers.fc(h, size=dim, act="relu")
+            logits = layers.fc(h, size=16)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            pt.optimizer.MomentumOptimizer(
+                learning_rate=0.05, momentum=0.9).minimize(
+                loss, startup_program=startup)
+        scope = pt.Scope()
+        if plan is None:
+            exe = pt.Executor(pt.TPUPlace())
+        else:
+            from paddle_tpu.transpiler import shard_program
+
+            shard_program(main, plan, ["x", "y"], [loss.name],
+                          scope=scope)
+            exe = pt.Executor(plan=plan)
+        exe.run(startup, scope=scope)
+        legs.append({"tag": tag, "plan": plan, "exe": exe, "scope": scope,
+                     "main": main, "loss": loss, "walls": []})
+
+    def step_leg(leg):
+        out, = leg["exe"].run(leg["main"], feed={"x": xs, "y": ys},
+                              fetch_list=[leg["loss"]], scope=leg["scope"],
+                              return_numpy=False)
+        return out
+
+    for leg in legs:
+        for _ in range(warmup):
+            out = step_leg(leg)
+        np.asarray(out)
+        leg["warm_fresh"] = leg["exe"].fresh_compiles
+
+    for _ in range(rounds):  # interleaved: drift hits every leg equally
+        for leg in legs:
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = step_leg(leg)
+            np.asarray(out)
+            leg["walls"].append((time.perf_counter() - t0) / steps)
+
+    def per_device_param_bytes(scope):
+        total = 0.0
+        for k in scope.keys():
+            v = scope.get(k)
+            if isinstance(v, jax.Array) and v.addressable_shards:
+                sh = v.addressable_shards[0].data
+                total += float(np.prod(sh.shape) or 1) * v.dtype.itemsize
+        return total
+
+    report = {}
+    final_losses = {}
+    for leg in legs:
+        tag, plan = leg["tag"], leg["plan"]
+        final = float(np.asarray(step_leg(leg)))
+        final_losses[tag] = final
+        row = {
+            "ms_per_step": round(sorted(leg["walls"])[rounds // 2] * 1e3,
+                                 3),
+            "per_device_param_bytes": round(
+                per_device_param_bytes(leg["scope"])),
+            "steady_state_fresh_compiles":
+                leg["exe"].fresh_compiles - leg["warm_fresh"],
+            "final_loss": final,
+        }
+        mem = analysis.analyze_memory(
+            leg["main"], ["x", "y"], [leg["loss"].name],
+            scope=leg["scope"], batch_size=batch, plan=plan)
+        row["static_peak_bytes"] = round(mem.peak_bytes)
+        if plan is not None:
+            row["mesh"] = plan.mesh_axes()
+            row["collective_bytes_est"] = round(mem.collective_bytes)
+        report[tag] = row
+    single = final_losses.get("single")
+    report["loss_parity_max_abs"] = (
+        max(abs(v - single) for v in final_losses.values())
+        if single is not None else None)
+    report["config"] = {"batch": batch, "dim": dim, "steps": steps,
+                        "devices": n}
+    return report
+
+
+def bench_sharding(jax, pt, layers, batch=64, dim=256, steps=12,
+                   rounds=3, warmup=2, timeout=900):
+    """One-sharding-plane A/B (single vs dp vs dp x tp). Needs a multi-
+    device backend: with >= 4 devices it measures inline (real TPU
+    slice, or a test process already on the virtual mesh); otherwise it
+    re-runs itself in a child on the 8-device virtual CPU mesh — the
+    ROADMAP-mandated witness pattern while the TPU tunnel is down."""
+    if len(jax.devices()) >= 4:
+        return _sharding_measure(jax, pt, layers, batch=batch, dim=dim,
+                                 steps=steps, rounds=rounds, warmup=warmup)
+    from paddle_tpu.xla_env import cpu_mesh_env
+
+    env = cpu_mesh_env(dict(os.environ), 8)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharding-child",
+         json.dumps({"batch": batch, "dim": dim, "steps": steps,
+                     "rounds": rounds, "warmup": warmup})],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=timeout)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"sharding child produced no record: {proc.stderr[-800:]}")
+
+
+def run_sharding_child(params_json: str) -> None:
+    """--sharding-child entry: claim the 8-device virtual CPU mesh (must
+    happen before backend init) and print the measurement JSON."""
+    from paddle_tpu.xla_env import claim_cpu_mesh
+
+    claim_cpu_mesh(8)
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    params = json.loads(params_json) if params_json else {}
+    print(json.dumps(_sharding_measure(jax, pt, layers, **params)),
+          flush=True)
+
+
 def bench_image_model(jax, pt, layers, models, name, batch=128, hw=224,
                       steps=8):
     """img/s for one zoo model's train step (benchmark/paddle/image/*)."""
@@ -1448,6 +1606,11 @@ def run_bench(platform):
     # paged-vs-dense KV cache at equal HBM budget (capacity + prefix
     # sharing): cache-layout/scheduling plane, CPU row is the witness
     step("paged_kv", bench_paged_kv, jax, pt, layers, models)
+    # one-sharding-plane A/B (single vs dp vs dp x tp): on CPU it spawns
+    # the 8-device virtual-mesh child (the witness); the TPU row waits
+    # for a multi-chip window — single-chip children skip it
+    if not on_tpu or len(jax.devices()) >= 4:
+        step("sharding", bench_sharding, jax, pt, layers)
     if "result" not in rows.get("resnet", {}):
         # Without the headline this child must NOT print a plausible final
         # record (a value-0.0 line would be parsed as success); secondary
@@ -1654,6 +1817,9 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--sharding-child":
+        run_sharding_child(sys.argv[2] if len(sys.argv) > 2 else "")
+        sys.exit(0)
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         if sys.argv[2] == "tpu-probe":
             run_probe()

@@ -41,8 +41,22 @@ def main():
                                        momentum=0.9).minimize(
             loss, startup_program=startup)
 
+    # One sharding plane: the ShardProgram pass annotates every var with
+    # its plan-resolved PartitionSpec; the executor (plan carries the
+    # mesh) lowers the whole block with in/out_shardings + donation and
+    # the analysis plane prices the result PER DEVICE.
+    from paddle_tpu import analysis
+    from paddle_tpu.transpiler import shard_program
+
+    plan = megatron_plan(mesh)
+    shard_program(main_prog, plan, ["x", "y"], [loss.name])
+    mem = analysis.analyze_memory(main_prog, ["x", "y"], [loss.name],
+                                  batch_size=8 * n)
+    print(f"per-device static peak: {mem.peak_bytes / 1e6:.2f} MB; "
+          f"collectives {mem.collective_bytes / 1e6:.2f} MB/step")
+
     scope = pt.Scope()
-    exe = pt.Executor(mesh=mesh, plan=megatron_plan(mesh))
+    exe = pt.Executor(plan=plan)
     exe.run(startup, scope=scope)
 
     rng = np.random.RandomState(0)

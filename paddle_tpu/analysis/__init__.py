@@ -41,6 +41,8 @@ from .lint import (ERROR, WARNING, LintContext, LintIssue, LintRule,
 from .memory import (LiveTensor, MemoryAnalysis, MemoryBudgetError,
                      RematAdvice, advise_recompute, analyze_memory,
                      check_memory_budget)
+from .sharding import (CollectiveRow, ShardingCost, V5E_ICI_BW,
+                       estimate_collectives)
 from .verifier import (ProgramVerifyError, check_async_overlap,
                        verify_program, written_state_names)
 
@@ -56,4 +58,7 @@ __all__ = [
     "analyze_memory", "check_memory_budget", "advise_recompute",
     "OpCost", "register_cost", "cost_exempt", "has_cost",
     "is_cost_exempt", "op_cost",
+    # sharding plane
+    "ShardingCost", "CollectiveRow", "estimate_collectives",
+    "V5E_ICI_BW",
 ]

@@ -39,7 +39,8 @@ import jax
 import numpy as np
 
 from ..core.enforce import EnforceError
-from ..core.program import BATCH_DIM_SENTINEL, Block, Operator, Program
+from ..core.program import (BATCH_DIM_SENTINEL, GRAD_SUFFIX, Block,
+                            Operator, Program)
 from ..core.registry import get_op, has_op, infer_outputs
 from ..core.scope import Scope
 from . import costmodel
@@ -126,10 +127,19 @@ class MemoryBudgetError(EnforceError):
 
 
 class MemoryAnalysis:
-    """Result of :func:`analyze_memory`."""
+    """Result of :func:`analyze_memory`.
+
+    With a sharding plan (``analyze_memory(plan=...)`` or a
+    ShardProgram-annotated program) every byte figure is PER DEVICE:
+    sharded dims divide each tensor by its mesh-axis product, and
+    ``collectives`` prices the in-graph psum/all-gather traffic the plan
+    implies (``mesh_axes`` records the mesh; both are None single-chip).
+    """
 
     def __init__(self, batch_size: int):
         self.batch_size = batch_size
+        self.mesh_axes = None
+        self.collectives = None  # analysis.sharding.ShardingCost
         self.resident_bytes: float = 0.0
         self.peak_bytes: float = 0.0
         self.peak_op_index: Optional[int] = None
@@ -187,10 +197,18 @@ class MemoryAnalysis:
         rows.sort(key=lambda r: -r["est_ms"])
         return rows
 
+    @property
+    def collective_bytes(self) -> float:
+        return self.collectives.total_bytes if self.collectives else 0.0
+
     def format_report(self, top_n: int = 10) -> str:
+        scope_note = ""
+        if self.mesh_axes:
+            axes = "x".join(f"{a}={s}" for a, s in self.mesh_axes.items())
+            scope_note = f" PER DEVICE over mesh [{axes}]"
         lines = [
-            f"peak HBM watermark: {_fmt_bytes(self.peak_bytes)} at op "
-            f"#{self.peak_op_index} {self.peak_op_type!r} "
+            f"peak HBM watermark: {_fmt_bytes(self.peak_bytes)}{scope_note}"
+            f" at op #{self.peak_op_index} {self.peak_op_type!r} "
             f"(batch={self.batch_size})",
             f"  resident (params/state/feeds): "
             f"{_fmt_bytes(self.resident_bytes)}",
@@ -210,6 +228,8 @@ class MemoryAnalysis:
             lines.append(
                 f"  (no cost model for: "
                 f"{sorted(set(self.uncosted_ops))[:8]})")
+        if self.collectives is not None:
+            lines.append(self.collectives.format_report())
         return "\n".join(lines)
 
 
@@ -301,11 +321,119 @@ def _paired_grad_index(block: Block, i: int, op: Operator) -> Optional[int]:
 
 
 # --------------------------------------------------------------------------
+# elementwise-class ops that keep their input's last-dim sharding (the
+# mini GSPMD propagation below); contractions and everything else stop
+# the chain — conservative, in the cost model's ~20% honesty class
+_TP_INHERIT_OPS = frozenset((
+    "gelu", "relu", "sigmoid", "tanh", "elementwise_add",
+    "elementwise_mul", "elementwise_sub", "dropout", "scale",
+    "layer_norm", "softmax", "addto", "cast"))
+
+
+def _tp_activation_divisors(block, plan, axis_sizes, data_axis):
+    """Megatron's column-parallel activations, statically: an op
+    contracting a weight sharded on its LAST (output) dim produces an
+    activation sharded the same way, and elementwise consumers inherit
+    — until the next contraction combines the partials. Returns
+    name -> tp divisor for those activations (1 implied elsewhere)."""
+    from ..parallel.plan import spec_axes
+    from .sharding import _contract_like
+
+    div: Dict[str, int] = {}
+    for op in block.ops:
+        d = 1
+        if _contract_like(op):
+            for name in op.input_names():
+                v = _lookup_var(block, name)
+                if v is None or not v.persistable:
+                    continue
+                spec = getattr(v, "sharding", None)
+                if spec is None and v.shape is not None:
+                    spec = plan.spec_for_state(name, len(v.shape),
+                                               shape=v.shape)
+                if spec is None or not tuple(spec):
+                    continue
+                last = tuple(spec)[-1]
+                axes = last if isinstance(last, tuple) else (last,)
+                for ax in axes:
+                    if ax is not None and ax != data_axis:
+                        d *= axis_sizes.get(ax, 1)
+        elif op.type in _TP_INHERIT_OPS:
+            d = max((div.get(n, 1) for n in op.input_names()), default=1)
+        if d > 1:
+            for out in op.output_names():
+                div[out] = d
+    return div
+
+
+def _make_shard_divisor(plan, block, types, feeds, batch_size):
+    """name -> how many ways that tensor's bytes split per device under
+    the plan: state/feeds by their resolved PartitionSpec (ShardProgram
+    annotations win), transient activations by the ``dp`` axis when
+    their leading dim is batch-derived (the sharding GSPMD propagates);
+    1 without a plan."""
+    if plan is None:
+        return lambda name: 1
+    from ..parallel.plan import spec_axes
+
+    axis_sizes = plan.mesh_axes()
+    n_dp = axis_sizes.get(plan.data_axis, 1) if plan.data_axis else 1
+    tp_div = _tp_activation_divisors(block, plan, axis_sizes,
+                                     plan.data_axis)
+    cache: Dict[str, int] = {}
+
+    def leaf_shape(name):
+        sds = types.get(name)
+        leaves = costmodel._leaves(sds) if sds is not None else []
+        return tuple(leaves[0].shape) if leaves else ()
+
+    def div(name: str) -> int:
+        if name in cache:
+            return cache[name]
+        base = name
+        if GRAD_SUFFIX in name:
+            # a weight's gradient shards exactly like the weight (GSPMD
+            # propagates the spec through the cotangent)
+            cand = name.split(GRAD_SUFFIX, 1)[0]
+            cv = _lookup_var(block, cand)
+            if cv is not None and cv.persistable:
+                base = cand
+        v = _lookup_var(block, base)
+        ann = getattr(v, "sharding", None) if v is not None else None
+        shape = leaf_shape(base if base != name else name)
+        spec = None
+        if ann is not None:
+            spec = ann
+        elif base in feeds:
+            spec = plan.spec_for_feed(base, len(shape))
+        elif v is not None and (v.persistable or v.is_data):
+            spec = plan.spec_for_state(base, len(shape), shape=shape)
+        if spec is None:
+            d = n_dp if (n_dp > 1 and shape
+                         and (shape[0] == batch_size
+                              or (batch_size > 1
+                                  and shape[0] % batch_size == 0))) else 1
+            # column-parallel tp sharding composes with the dp split; an
+            # activation's GRADIENT mirrors the forward activation
+            act = name.split(GRAD_SUFFIX, 1)[0] \
+                if GRAD_SUFFIX in name else name
+            d *= tp_div.get(act, 1)
+        else:
+            d = 1
+            for ax in spec_axes(spec):
+                d *= axis_sizes.get(ax, 1)
+        cache[name] = max(int(d), 1)
+        return cache[name]
+
+    return div
+
+
 def analyze_memory(program: Program, feed_names: Sequence[str] = (),
                    fetch_names: Sequence[str] = (),
                    scope: Optional[Scope] = None,
                    batch_size: int = 1,
-                   include_costs: bool = True) -> MemoryAnalysis:
+                   include_costs: bool = True,
+                   plan=None) -> MemoryAnalysis:
     """Compute per-op live-byte sets, the peak-HBM watermark, and (with
     ``include_costs``) the per-op roofline costs for the global block.
 
@@ -313,8 +441,17 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
     :func:`~paddle_tpu.analysis.checker.infer_program`, so anything that
     fails whole-program inference raises the same located
     ``ProgramCheckError`` this plane is built on.
+
+    ``plan`` (a :class:`paddle_tpu.parallel.ShardingPlan`; defaults to a
+    ShardProgram-annotated program's own plan) switches the analysis to
+    PER-DEVICE accounting: state/feed tensors divide by the mesh-axis
+    product of their plan-resolved spec, batch-led activations divide by
+    the ``dp`` axis (the sharding GSPMD propagates), and
+    ``mem.collectives`` prices the plan's psum/all-to-all wire bytes.
     """
     costmodel.ensure_registered()
+    if plan is None:
+        plan = getattr(program, "sharding_plan", None)
     analysis = infer_program(program, feed_names, fetch_names, scope=scope,
                              annotate=False)
     block = program.global_block
@@ -325,9 +462,16 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
         name: _concrete(sds, batch_size)
         for name, sds in analysis.types.items()}
 
+    shard_div = _make_shard_divisor(plan, block, types, set(feed_names),
+                                    batch_size)
+    if plan is not None:
+        mem.mesh_axes = plan.mesh_axes()
+
     def bytes_of(name: str) -> float:
         sds = types.get(name)
-        return costmodel._nbytes(sds) if sds is not None else 0.0
+        if sds is None:
+            return 0.0
+        return costmodel._nbytes(sds) / shard_div(name)
 
     # ---- residency classification ------------------------------------
     feeds = set(feed_names)
@@ -370,6 +514,10 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
     peak_residuals: List[Tuple[float, str, int]] = []
     active_residuals: List[Tuple[int, float, str, int]] = []  # (end, b, lbl, i)
 
+    dp_div = 1
+    if plan is not None and plan.data_axis:
+        dp_div = plan.mesh_axes().get(plan.data_axis, 1)
+
     for i, op in enumerate(ops):
         cost = None
         if include_costs and has_op(op.type):
@@ -380,6 +528,14 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
                 outs = {slot: [types[n] for n in names if n in types]
                         for slot, names in op.outputs.items() if names}
                 cost = op_cost(op.type, op.attrs, ins, outs)
+                if cost is not None and plan is not None:
+                    # per-device roofline: this op computes 1/d of the
+                    # global work (its output's shard count)
+                    out_names = op.output_names()
+                    d = shard_div(out_names[0]) if out_names else 1
+                    if d > 1:
+                        cost = OpCost(cost.flops / d, cost.bytes / d,
+                                      cost.residual_bytes / d)
             elif not opdef.cost_exempt:
                 mem.uncosted_ops.append(op.type)
         mem.op_costs.append(cost)
@@ -388,12 +544,17 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
             mem.total_cost = mem.total_cost + cost
 
         # residual footprint: seg_fwd's checkpoint saves, or the cost
-        # handler's declared residual (stacked-scan activation planes)
+        # handler's declared residual (stacked-scan activation planes;
+        # batch-carried, so per-device they divide by dp)
         res_bytes = 0.0
         if op.type == "seg_fwd":
-            res_bytes = _segment_residual_bytes(op, types)
+            res_bytes = _segment_residual_bytes(op, types) / dp_div
         elif cost is not None and cost.residual_bytes:
             res_bytes = cost.residual_bytes
+            if plan is not None and shard_div(op.output_names()[0]
+                                              if op.output_names()
+                                              else "") <= 1:
+                res_bytes /= dp_div
         if res_bytes:
             j = _paired_grad_index(block, i, op)
             if j is not None:
@@ -467,6 +628,17 @@ def analyze_memory(program: Program, feed_names: Sequence[str] = (),
             dtype="-", kind="residual", producer_index=src,
             producer_type=lbl, callsite=sop.attrs.get("_callsite")))
     mem.peak_live = peak_set
+
+    # ---- the plan's collective wire bytes (psum/all-reduce/all-to-all) -
+    if plan is not None and include_costs:
+        from .sharding import estimate_collectives
+
+        try:
+            mem.collectives = estimate_collectives(
+                program, feed_names, fetch_names, plan=plan, scope=scope,
+                batch_size=batch_size, types=types)
+        except Exception:  # noqa: BLE001 - pricing must never break lint
+            mem.collectives = None
     return mem
 
 
@@ -561,11 +733,15 @@ def check_memory_budget(program: Program, feed_names: Sequence[str],
                         fetch_names: Sequence[str], budget_bytes: float,
                         scope: Optional[Scope] = None,
                         batch_size: int = 1,
-                        what: str = "program") -> MemoryAnalysis:
+                        what: str = "program",
+                        plan=None) -> MemoryAnalysis:
     """Raise :class:`MemoryBudgetError` when the static peak-HBM
-    watermark exceeds ``budget_bytes``; returns the analysis otherwise."""
+    watermark exceeds ``budget_bytes``; returns the analysis otherwise.
+    With a plan (argument or ShardProgram-annotated program) the budget
+    gates the PER-DEVICE watermark — sharding state IS the remedy the
+    advisor can't suggest, so it is priced in before the gate fires."""
     mem = analyze_memory(program, feed_names, fetch_names, scope=scope,
-                         batch_size=batch_size)
+                         batch_size=batch_size, plan=plan)
     if mem.peak_bytes <= budget_bytes:
         return mem
     top = mem.top(8)

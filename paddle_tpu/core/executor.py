@@ -38,6 +38,19 @@ from .. import trace
 
 logger = logging.getLogger("paddle_tpu")
 
+# Sharding-invariant RNG: with the legacy (non-partitionable) threefry,
+# XLA partitions a random op whose output lands sharded (GSPMD
+# out_shardings — e.g. a vocab-sharded embedding table's uniform init)
+# and produces DIFFERENT bits than the single-device run of the same
+# program+seed. The partitionable implementation is invariant to
+# sharding, which is the whole reproducibility contract of the one
+# sharding plane: dp/tp runs must match their single-device reference.
+# (No-op on jax versions where partitionable is already the default.)
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:  # flag retired: partitionable is the only mode
+    pass
+
 
 class TPUPlace:
     """Device handle, analogue of platform::Place (place.h:53)."""
@@ -473,6 +486,8 @@ class Executor:
         self.place = place or TPUPlace(0)
         self.check_nan_inf = (FLAGS.check_nan_inf if check_nan_inf is None
                               else check_nan_inf)
+        if mesh is None and plan is not None:
+            mesh = plan.mesh  # Executor(plan=...) — the plan carries it
         self.mesh = mesh
         if mesh is not None and plan is None:
             from ..parallel import data_parallel_plan
@@ -536,7 +551,7 @@ class Executor:
         feed_vals = self._normalize_feeds(block, feed)
 
         level = trace.active_level() if trace_level is None else trace_level
-        if level >= 2 and self.mesh is None:
+        if level >= 2 and self._mesh_plan_for(program)[0] is None:
             return self._run_interpreted(program, feed_vals, fetch_names,
                                          scope, return_numpy)
 
@@ -594,7 +609,7 @@ class Executor:
         feed_vals = self._normalize_feeds(block, feed)
 
         level = trace.active_level() if trace_level is None else trace_level
-        if level >= 2 and self.mesh is None:
+        if level >= 2 and self._mesh_plan_for(program)[0] is None:
             outs = self._run_interpreted(program, feed_vals, fetch_names,
                                          scope, return_numpy=False)
             return RunHandle(outs, fetch_names,
@@ -647,7 +662,7 @@ class Executor:
         feed_args = [feed_vals[n] for n in compiled.feed_names]
         ro_args = [scope.get(n) for n in compiled.ro_state_names]
         rw_args = [scope.get(n) for n in compiled.rw_state_names]
-        if self.mesh is not None:
+        if compiled.feed_shardings is not None:
             # device_put is a no-op when the array already has the target
             # sharding; otherwise it reshards (e.g. state initialised by a
             # single-device startup run). On a multi-process mesh (DCN
@@ -1183,7 +1198,35 @@ class Executor:
         return (id(program), program.version, feed_sig, tuple(fetch_names),
                 id(scope), scope_keys, ops_common.amp_enabled(),
                 ops_common.mxu_precision(),
-                id(self.mesh), id(self.plan))
+                self._sharding_key(program))
+
+    # ------------------------------------------------------------------
+    def _mesh_plan_for(self, program: Program):
+        """(mesh, plan) for one program: the executor's own mesh/plan
+        wins; otherwise a ShardProgram-annotated program
+        (``program.sharding_plan`` over a real device mesh) makes ANY
+        executor lower it sharded — the one-sharding-plane contract."""
+        if self.mesh is not None:
+            return self.mesh, self.plan
+        plan = getattr(program, "sharding_plan", None)
+        if plan is not None and getattr(plan.mesh, "devices", None) \
+                is not None:
+            return plan.mesh, plan
+        return None, None
+
+    def _sharding_key(self, program: Program):
+        """Content key of the (mesh, plan) pair: mesh axes + device ids
+        + the plan's rule digest. Two equivalent plans built
+        independently (a fresh ``megatron_plan(mesh)`` per boot/request)
+        key identically, so serving steady state stays at zero
+        recompiles — ``id(plan)`` would thrash the cache."""
+        mesh, plan = self._mesh_plan_for(program)
+        if mesh is None:
+            return None
+        return (tuple(mesh.axis_names),
+                tuple(int(s) for s in mesh.devices.shape),
+                tuple(int(d.id) for d in mesh.devices.flat),
+                plan.digest() if plan is not None else None)
 
     @staticmethod
     def _all_scope_keys(scope: Scope):
@@ -1255,11 +1298,12 @@ class Executor:
         ro_state = [n for n in state_names if n not in written_set]
 
         ops = list(block.ops)
+        mesh, plan = self._mesh_plan_for(program)
 
         def run_traced(feed_args, ro_args, rw_args, rng=None):
             from ..parallel.context import mesh_context
 
-            with mesh_context(self.mesh):
+            with mesh_context(mesh):
                 return _run_body(feed_args, ro_args, rw_args, rng)
 
         def _run_body(feed_args, ro_args, rw_args, rng=None):
@@ -1305,28 +1349,64 @@ class Executor:
             return fetches, new_states, rng
 
         feed_sh = ro_sh = rw_sh = None
-        if self.mesh is not None:
+        if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            def _nd(name):
+            from ..parallel.plan import spec_axes
+
+            mesh_axes = set(mesh.axis_names)
+
+            def _shape_of(name):
                 v = block.var(name) if block.has_var(name) else None
                 if v is not None and v.shape is not None:
-                    return len(v.shape)
-                val = scope.get(name) if scope.has(name) else feed_vals.get(name)
-                return np.ndim(val)
+                    return tuple(v.shape)
+                val = scope.get(name) if scope.has(name) \
+                    else feed_vals.get(name)
+                try:
+                    return tuple(np.shape(val))
+                except Exception:  # SelectedRows-class pytrees
+                    return None
 
-            feed_sh = [self.plan.feed_sharding(n, _nd(n)) for n in feed_names]
-            ro_sh = [self.plan.state_sharding(n, _nd(n)) for n in ro_state]
-            rw_sh = [self.plan.state_sharding(n, _nd(n)) for n in rw_state]
-            replicated = NamedSharding(self.mesh, PartitionSpec())
+            def _annotated(name):
+                # a ShardProgram annotation wins over the plan rules —
+                # but only when every axis it names exists on THIS mesh
+                # (stale annotations from another plan never leak in)
+                v = block.var(name) if block.has_var(name) else None
+                sp = getattr(v, "sharding", None) if v is not None else None
+                if sp is not None and all(ax in mesh_axes
+                                          for ax in spec_axes(sp)):
+                    return NamedSharding(mesh, sp)
+                return None
+
+            def _feed_sharding(name):
+                sp = _annotated(name)
+                if sp is not None:
+                    return sp
+                shape = _shape_of(name)
+                return plan.feed_sharding(
+                    name, len(shape) if shape is not None else 0)
+
+            def _state_sharding(name):
+                sp = _annotated(name)
+                if sp is not None:
+                    return sp
+                shape = _shape_of(name)
+                ndim = len(shape) if shape is not None else 0
+                if shape is not None and any(int(d) < 0 for d in shape):
+                    shape = None  # symbolic batch: no divisibility check
+                return plan.state_sharding(name, ndim, shape=shape)
+
+            feed_sh = [_feed_sharding(n) for n in feed_names]
+            ro_sh = [_state_sharding(n) for n in ro_state]
+            rw_sh = [_state_sharding(n) for n in rw_state]
+            replicated = NamedSharding(mesh, PartitionSpec())
             in_shardings = (feed_sh, ro_sh, rw_sh)
             # written-back state must LAND with the plan's shardings (not
             # whatever GSPMD propagates — e.g. a ZeRO-sharded accumulator
             # feeding a momentum update would otherwise leak its dp
             # sharding into the updated parameter); fetches stay
             # unconstrained (None = compiler's choice)
-            ws_sh = [self.plan.state_sharding(n, _nd(n))
-                     for n in written_persist]
+            ws_sh = [_state_sharding(n) for n in written_persist]
             out_shardings = ([None] * len(fetch_names), ws_sh)
             if uses_rng:
                 in_shardings = in_shardings + (replicated,)

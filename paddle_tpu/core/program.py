@@ -366,6 +366,9 @@ class Program:
         """
         p = Program()
         p.random_seed = self.random_seed
+        if getattr(self, "sharding_plan", None) is not None:
+            # the ShardProgram plan rides along with its annotations
+            p.sharding_plan = self.sharding_plan
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)

@@ -14,18 +14,19 @@ block with those shardings and XLA GSPMD inserts all-reduce / all-gather /
 reduce-scatter where the data flow demands them. There is no parameter-server
 process, no gradient RPC, and no explicit communication op in user programs.
 """
-from .mesh import make_mesh, mesh_axis_size
+from .mesh import make_abstract_mesh, make_mesh, mesh_axis_size
 from .multihost import (initialize as initialize_multihost,
                         local_batch_slice, make_hybrid_mesh, process_info)
 from .ring_attention import ring_attention
-from .plan import (ShardingPlan, data_parallel_plan, expert_parallel_plan,
-                   megatron_plan, pipeline_plan, vocab_sharded_plan,
-                   zero_plan)
+from .plan import (ShardingPlan, ShardingPlanError, data_parallel_plan,
+                   expert_parallel_plan, megatron_plan, pipeline_plan,
+                   vocab_sharded_plan, zero_plan)
 
 __all__ = [
-    "make_mesh", "mesh_axis_size", "ring_attention",
-    "ShardingPlan", "data_parallel_plan", "expert_parallel_plan",
-    "megatron_plan", "pipeline_plan", "vocab_sharded_plan", "zero_plan",
+    "make_mesh", "make_abstract_mesh", "mesh_axis_size", "ring_attention",
+    "ShardingPlan", "ShardingPlanError", "data_parallel_plan",
+    "expert_parallel_plan", "megatron_plan", "pipeline_plan",
+    "vocab_sharded_plan", "zero_plan",
     "initialize_multihost", "make_hybrid_mesh", "process_info",
     "local_batch_slice",
 ]

@@ -1,0 +1,45 @@
+"""jax-version compatibility for the manual-SPMD escape hatches.
+
+The ring-attention / GPipe / vocab-parallel islands are written against
+the modern surface (``jax.shard_map`` + the varying-manual-axes type
+system's ``jax.lax.pcast``). Older jaxlibs (the 0.4.x line this tree
+pins while the TPU tunnel is down) ship shard_map under
+``jax.experimental.shard_map`` and have no vma typing at all — there the
+pcast calls are identity and the per-eqn replication checker predates
+the loop shapes these kernels use, so it is disabled. One import site
+(`from ..parallel.compat import shard_map, pvary`) keeps every island
+running on both lines instead of five copies of the same try/except.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # modern surface: vma typing, check_vma semantics
+    from jax import shard_map as _shard_map
+
+    _MODERN = True
+except ImportError:  # 0.4.x: experimental namespace, rep checker off
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    _MODERN = False
+
+    def _shard_map(f=None, /, *, mesh, in_specs, out_specs, **kw):
+        kw.pop("check_vma", None)
+        kw.setdefault("check_rep", False)
+        if f is None:  # pragma: no cover - decorator-without-fn form
+            return lambda g: _exp_shard_map(g, mesh=mesh, in_specs=in_specs,
+                                            out_specs=out_specs, **kw)
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+
+shard_map = _shard_map
+
+
+def pvary(x, axes):
+    """Type a shard_map carry as device-varying over ``axes`` where the
+    vma type system exists; identity on jaxlibs that predate it."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, tuple(axes), to="varying")

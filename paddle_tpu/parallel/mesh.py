@@ -65,3 +65,20 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     """Size of a named axis, 1 if the axis is absent."""
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
+def make_abstract_mesh(axes: Dict[str, int]):
+    """A devices-free mesh skeleton (jax.sharding.AbstractMesh) for the
+    analysis plane: ShardingPlans built over it resolve specs, divide
+    per-device bytes, and price collectives without the process owning
+    ``dp*mp*...`` real devices — how ``tools/proglint.py --mesh dp=4,mp=2``
+    lints a sharded program on a 1-device box. Not executable: hand the
+    executor a plan over a real :func:`make_mesh` mesh instead."""
+    from jax.sharding import AbstractMesh
+
+    pairs = tuple((str(k), int(v)) for k, v in axes.items())
+    try:
+        return AbstractMesh(pairs)
+    except TypeError:  # newer signature: (axis_sizes, axis_names)
+        return AbstractMesh(tuple(v for _, v in pairs),
+                            tuple(k for k, _ in pairs))

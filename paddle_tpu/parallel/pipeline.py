@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .compat import pvary, shard_map
+
 
 def gpipe(stage_fn, stage_params, x, mesh, axis="pp", n_microbatches=None,
           data_axis=None, remat=False):
@@ -56,7 +58,7 @@ def gpipe(stage_fn, stage_params, x, mesh, axis="pp", n_microbatches=None,
     xspec = P(None, data_axis, *([None] * (x.ndim - 1)))
     pspec = jax.tree.map(lambda _: P(axis), stage_params)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(pspec, xspec), out_specs=xspec)
     def run(params, xl):
         r = jax.lax.axis_index(axis)
@@ -65,8 +67,7 @@ def gpipe(stage_fn, stage_params, x, mesh, axis="pp", n_microbatches=None,
         outbuf = jnp.zeros_like(xl)
         # device-varying carries so the loop types stay fixed once
         # ppermuted activations mix in (shard_map vma typing)
-        state, outbuf = (jax.lax.pcast(a, (axis,), to="varying")
-                         for a in (state, outbuf))
+        state, outbuf = (pvary(a, (axis,)) for a in (state, outbuf))
 
         def step(t, carry):
             state, outbuf = carry

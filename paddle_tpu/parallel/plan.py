@@ -18,6 +18,7 @@ blocks (ParameterServer2.h:57-72).
 """
 from __future__ import annotations
 
+import hashlib
 import re
 from typing import Callable, List, Optional, Sequence, Tuple, Union
 
@@ -26,12 +27,63 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SpecLike = Union[P, Callable[[str, int], P]]
 
 
+class ShardingPlanError(ValueError):
+    """Nothing in the plan fits a variable: every matching rule's spec
+    rank exceeds the variable's ndim AND the plan default is
+    rank-incompatible too. Located at plan-application time
+    (ShardProgram / executor lowering) naming the variable and the
+    rules tried — not as a GSPMD shape error deep inside jit."""
+
+
+def _spec_rank_fits(spec: P, ndim: int) -> bool:
+    return len(spec) <= ndim
+
+
+def spec_axes(spec: P) -> List[str]:
+    """The mesh axis names a PartitionSpec references (flattened)."""
+    axes: List[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, tuple):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return axes
+
+
+def _spec_shape_fits(spec: P, shape, axis_sizes) -> bool:
+    """Divisibility check: every sharded dim must divide by the product
+    of its mesh axes. ``-1`` (the symbolic batch dim) is exempt — its
+    concrete size is validated by GSPMD at lowering."""
+    if shape is None:
+        return True
+    for dim, entry in zip(shape, tuple(spec)):
+        if entry is None or int(dim) == -1:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        div = 1
+        for ax in axes:
+            div *= axis_sizes.get(ax, 1)
+        if div > 1 and int(dim) % div:
+            return False
+    return True
+
+
 class ShardingPlan:
     """Ordered rule list mapping variable names to PartitionSpecs.
 
     rules: sequence of (regex, spec) — first match wins. ``spec`` is either a
-    PartitionSpec (applied only if its rank fits the variable's ndim) or a
-    callable (name, ndim) -> PartitionSpec.
+    PartitionSpec or a callable (name, ndim) -> PartitionSpec. A matched
+    rule whose spec rank exceeds the variable's ndim falls through to the
+    next rule — low-rank optimizer scalars that match their parameter's
+    rule by substring land on the (replicated) default this way; when the
+    default itself is rank-incompatible, a located
+    :class:`ShardingPlanError` names the variable and the rules tried.
+    A spec whose sharded dims do not divide the variable's concrete shape
+    (``shape=`` given) also falls through quietly: that is the
+    (1,)-shaped beta-pow-accumulator case every Megatron-style bias rule
+    hits.
     data_axis: mesh axis the leading (batch) dim of feed variables shards on.
     """
 
@@ -45,30 +97,103 @@ class ShardingPlan:
         ]
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.default = default
+        self._digest: Optional[str] = None
 
     # ------------------------------------------------------------------
-    def spec_for_state(self, name: str, ndim: int) -> P:
+    def _axis_sizes(self):
+        return dict(zip(self.mesh.axis_names,
+                        tuple(self.mesh.shape[a]
+                              for a in self.mesh.axis_names)))
+
+    def spec_for_state(self, name: str, ndim: int,
+                       shape: Optional[Sequence[int]] = None) -> P:
+        axis_sizes = self._axis_sizes()
+        rank_misfits: List[Tuple[str, P]] = []
         for pat, spec in self.rules:
-            if pat.search(name):
-                if callable(spec):
-                    return spec(name, ndim)
-                if len(spec) <= ndim:
-                    return spec
-        return self.default
+            if not pat.search(name):
+                continue
+            cand = spec(name, ndim) if callable(spec) else spec
+            if cand is None:
+                continue
+            if not _spec_rank_fits(cand, ndim):
+                # rank misfit: fall through to the next rule instead of
+                # returning a spec that only errors at lowering
+                rank_misfits.append((pat.pattern, cand))
+                continue
+            if not _spec_shape_fits(cand, shape, axis_sizes):
+                continue  # non-divisible dim (e.g. a (1,) accumulator)
+            return cand
+        if _spec_rank_fits(self.default, ndim):
+            # a rank-misfit rule falls through all the way to the
+            # default: low-rank optimizer scalars matching their
+            # parameter's rule by substring replicate silently
+            return self.default
+        tried = "; ".join(f"rule {pat!r} -> {tuple(s)}"
+                          for pat, s in rank_misfits) or "(no rule matched)"
+        raise ShardingPlanError(
+            f"nothing in the plan fits variable {name!r} (ndim={ndim}"
+            + (f", shape={tuple(shape)}" if shape is not None else "")
+            + f"): {tried}; default {tuple(self.default)} also exceeds "
+            f"the variable's rank. Make the rule a callable (name, ndim) "
+            f"-> PartitionSpec that degrades for low-rank variables, or "
+            f"use a rank-compatible default.")
 
     def spec_for_feed(self, name: str, ndim: int) -> P:
         for pat, spec in self.rules:
             if pat.search(name):
-                return spec(name, ndim) if callable(spec) else spec
+                cand = spec(name, ndim) if callable(spec) else spec
+                if cand is not None and _spec_rank_fits(cand, ndim):
+                    return cand
         if self.data_axis is None or ndim == 0:
             return P()
         return P(self.data_axis, *([None] * (ndim - 1)))
 
-    def state_sharding(self, name: str, ndim: int) -> NamedSharding:
-        return NamedSharding(self.mesh, self.spec_for_state(name, ndim))
+    def state_sharding(self, name: str, ndim: int,
+                       shape: Optional[Sequence[int]] = None
+                       ) -> NamedSharding:
+        return NamedSharding(self.mesh,
+                             self.spec_for_state(name, ndim, shape=shape))
 
     def feed_sharding(self, name: str, ndim: int) -> NamedSharding:
         return NamedSharding(self.mesh, self.spec_for_feed(name, ndim))
+
+    # ------------------------------------------------------------------
+    def mesh_axes(self) -> dict:
+        """{axis name: size} of the plan's mesh (works for AbstractMesh
+        too — the analysis plane prices plans without real devices)."""
+        return self._axis_sizes()
+
+    def digest(self) -> str:
+        """Stable content digest of (mesh shape, rules, data_axis): two
+        independently constructed but equivalent plans — e.g. a fresh
+        ``megatron_plan(mesh)`` per serving request — digest identically,
+        so the executor compile-cache key they feed stays warm. Callable
+        specs hash by qualname + bytecode + closure reprs (two different
+        lambdas never collide; the same factory's closure always
+        matches)."""
+        if self._digest is not None:
+            return self._digest
+        h = hashlib.sha256()
+        h.update(repr(sorted(self._axis_sizes().items())).encode())
+        h.update(repr(self.data_axis).encode())
+        h.update(repr(tuple(self.default)).encode())
+        for pat, spec in self.rules:
+            h.update(pat.pattern.encode())
+            if callable(spec):
+                h.update(getattr(spec, "__module__", "?").encode())
+                h.update(getattr(spec, "__qualname__", repr(spec)).encode())
+                code = getattr(spec, "__code__", None)
+                if code is not None:
+                    h.update(code.co_code)
+                for cell in (getattr(spec, "__closure__", None) or ()):
+                    try:
+                        h.update(repr(cell.cell_contents).encode())
+                    except ValueError:  # pragma: no cover - empty cell
+                        pass
+            else:
+                h.update(repr(tuple(spec)).encode())
+        self._digest = h.hexdigest()[:16]
+        return self._digest
 
 
 # ----------------------------------------------------------------------

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .compat import pvary, shard_map
+
 
 def _block_attn(q, k, v, m, l, acc, q_off, k_off, causal, sm_scale):
     """One blockwise-attention accumulation step (online softmax).
@@ -64,7 +66,7 @@ def ring_attention(q, k, v, mesh, seq_axis="sp", causal=False, sm_scale=None):
     spec = P(None, None, seq_axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec)
     def ring(ql, kl, vl):
         idx = jax.lax.axis_index(seq_axis)
@@ -74,8 +76,7 @@ def ring_attention(q, k, v, mesh, seq_axis="sp", causal=False, sm_scale=None):
         acc = jnp.zeros(ql.shape, jnp.float32)
         # type the carries as device-varying so the fori_loop carry types
         # stay fixed once ppermuted K/V mix in (shard_map vma typing)
-        m, l, acc = (jax.lax.pcast(a, (seq_axis,), to="varying")
-                     for a in (m, l, acc))
+        m, l, acc = (pvary(a, (seq_axis,)) for a in (m, l, acc))
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def attend(c, kc, vc, m, l, acc):

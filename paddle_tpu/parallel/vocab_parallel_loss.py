@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .compat import pvary, shard_map
+
 
 def _axes(mesh, data_axis, vp_axis):
     """(x2 spec, w spec, per-row spec, fori-carry varying axes)."""
@@ -54,7 +56,7 @@ def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
     chunk_l, n_chunks_l = _fhce_chunks(vl, chunk)
     xs, ws, vs, varying = _axes(mesh, data_axis, vp_axis)
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(xs, ws, vs),
+    @functools.partial(shard_map, mesh=mesh, in_specs=(xs, ws, vs),
                        out_specs=(vs, vs, vs))
     def run(x2l, wl, labl):
         base = jax.lax.axis_index(vp_axis) * vl
@@ -65,7 +67,7 @@ def vp_fused_head_lse(x2, w, lab, chunk, mesh, vp_axis, data_axis):
         # (shard_map vma typing) — pcast them up front
         zeros = jnp.zeros((n,), jnp.float32)
         carry = tuple(
-            jax.lax.pcast(a, varying, to="varying")
+            pvary(a, varying)
             for a in (jnp.full((n,), -jnp.inf, jnp.float32),
                       zeros, zeros, zeros))
         m, s, ll, rs = jax.lax.fori_loop(
@@ -95,7 +97,7 @@ def vp_fused_head_grad(x2, w, lab, dl, lse, chunk, mesh, vp_axis,
     chunk_l, n_chunks_l = _fhce_chunks(vl, chunk)
     xs, ws, vs, varying = _axes(mesh, data_axis, vp_axis)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(xs, ws, vs, vs, vs),
                        out_specs=(xs, ws))
     def run(x2l, wl, labl, dll, lseg):
@@ -118,7 +120,7 @@ def vp_fused_head_grad(x2, w, lab, dl, lse, chunk, mesh, vp_axis,
                                                         axis=1))
 
         carry = tuple(
-            jax.lax.pcast(a, varying, to="varying")
+            pvary(a, varying)
             for a in (jnp.zeros((n, d), jnp.float32),
                       jnp.zeros((d, n_chunks_l, chunk_l), jnp.float32)))
         dx, dw = jax.lax.fori_loop(0, n_chunks_l, body, carry)

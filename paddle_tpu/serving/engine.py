@@ -195,6 +195,8 @@ class InferenceEngine:
         self.metrics = metrics or MetricsRegistry()
         self.scope = scope or Scope()
         self.model_dir = model_dir  # manifest home (save_manifest/warm_start)
+        if mesh is None and plan is not None:
+            mesh = plan.mesh  # InferenceEngine(plan=...) — plan carries it
         self.mesh = mesh
         if mesh is not None and plan is None:
             from ..parallel import data_parallel_plan
@@ -226,6 +228,15 @@ class InferenceEngine:
                                   preserve_state_writes=True)
             for k, v in pm.metrics_dict().items():
                 self.metrics.set_gauge(k, v)
+        if plan is not None:
+            # one sharding plane: annotate the served program's vars with
+            # the plan's PartitionSpecs (ShardProgram pass) so lowering,
+            # verification, and the memory analysis all read the same
+            # per-var specs the executor jits with
+            from ..transpiler import shard_program
+
+            shard_program(self.program, plan, self.feed_names,
+                          self.fetch_names, scope=self.scope)
         from ..flags import FLAGS
 
         if FLAGS.verify_program:
@@ -254,7 +265,7 @@ class InferenceEngine:
                 mem_budget, scope=self.scope,
                 batch_size=self.batch_buckets[-1],
                 what=f"InferenceEngine (bucket "
-                     f"{self.batch_buckets[-1]})")
+                     f"{self.batch_buckets[-1]})", plan=plan)
             self.metrics.set_gauge("mem/static_peak_bytes",
                                    mem.peak_bytes)
             self.metrics.set_gauge("mem/resident_bytes",

@@ -79,12 +79,37 @@ class SGD:
         # pad_to_multiple: bucket ragged columns (data_feeder.py) so varlen
         # training pads to a bounded set of compile signatures.
         self.feeder = DataFeeder(feed_list, pad_to_multiple=pad_to_multiple)
+        self._feed_names = [v.name for v in feed_list]
         self.scope = scope or global_scope()
+        if mesh is None and plan is not None:
+            mesh = plan.mesh
         self.exe = Executor(place or TPUPlace(0), check_nan_inf=check_nan_inf,
                             mesh=mesh, plan=plan)
         self._initialized = False
+        if plan is not None:
+            self._apply_plan(plan)
 
     # ------------------------------------------------------------------
+    def _apply_plan(self, plan):
+        """One sharding plane: run the ShardProgram pass over the step,
+        test, and startup programs (every var annotated with its
+        plan-resolved PartitionSpec; located ShardingPlanError on a rule
+        set that cannot fit) and point the executor at the plan's mesh —
+        parameters then INITIALIZE sharded (the startup run lands each
+        shard on its device; no replicated staging copy) and every step
+        lowers through ``jax.jit(in_shardings/out_shardings,
+        donate_argnums)`` with GSPMD inserting the collectives."""
+        from .transpiler import shard_program
+
+        fetches = [self.cost.name] + [v.name for v in
+                                      self.metrics.values()]
+        for prog in (self.main_program, self.test_program,
+                     self.startup_program):
+            shard_program(prog, plan, self._feed_names, fetches,
+                          scope=self.scope)
+        self.exe.mesh = plan.mesh
+        self.exe.plan = plan
+
     def _init_params(self):
         if not self._initialized:
             self.exe.run(self.startup_program, scope=self.scope)
@@ -105,7 +130,8 @@ class SGD:
               event_handler: Optional[Callable] = None,
               test_reader: Optional[Callable] = None,
               run_log=None, async_depth: int = 1,
-              checkpoint=None, mem_budget: Optional[float] = None):
+              checkpoint=None, mem_budget: Optional[float] = None,
+              plan=None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
@@ -141,6 +167,15 @@ class SGD:
         live set and the remat advisor's suggestions is raised instead
         of letting XLA OOM at compile or first run.
 
+        ``plan`` (a :class:`paddle_tpu.parallel.ShardingPlan`) turns the
+        run SPMD over the plan's mesh: the ShardProgram pass annotates
+        every program var with its PartitionSpec, parameters initialize
+        sharded, and the whole step lowers through one
+        ``jax.jit(in_shardings/out_shardings, donate_argnums)`` — dp, tp
+        (and sp/ep through the mesh-aware op kernels) compose on ONE
+        mesh. Equivalent to constructing ``SGD(..., plan=plan)``; must
+        be given before the first step initializes parameters.
+
         ``async_depth`` > 1 pipelines the loop: batch stacking +
         host->device transfer run on a background thread
         (reader.device_prefetch machinery), each step is dispatched with
@@ -160,6 +195,11 @@ class SGD:
                 _r(e)
         else:
             event_handler = user_handler
+        if plan is not None:
+            # a mid-life plan swap is legal: params already initialized
+            # under the previous layout are resharded by the executor's
+            # device_put at the next step
+            self._apply_plan(plan)
         self._init_params()
         self._mem_budget = mem_budget
         self._mem_checked = False
@@ -284,7 +324,7 @@ class SGD:
         analysis.check_memory_budget(
             self.main_program, list(feed), fetches, self._mem_budget,
             scope=self.scope, batch_size=batch,
-            what="SGD.train step program")
+            what="SGD.train step program", plan=self.exe.plan)
 
     def _run_pass_sync(self, pass_id, reader, event_handler, rs=None,
                        skip_n=0):

@@ -38,6 +38,7 @@ from .passes import (CanonicalizeIsTest, ConstantFolding,
                      DeadOpElimination, DropoutToScale,
                      ExpandRecomputeSegments, FoldBatchNorm, FusePatterns)
 from .schedule import ReducePeakMemory
+from .shard import ShardProgram, shard_program
 
 __all__ = [
     "Pass", "PassContext", "PassManager", "PassResult",
@@ -45,8 +46,9 @@ __all__ = [
     "get_pass", "registered_passes", "ir_dump_hook",
     "ExpandRecomputeSegments", "CanonicalizeIsTest", "DropoutToScale",
     "DeadOpElimination", "ConstantFolding", "FoldBatchNorm",
-    "FusePatterns", "ReducePeakMemory", "inference_pipeline",
-    "training_pipeline", "deployment_pipeline", "prune_pipeline",
+    "FusePatterns", "ReducePeakMemory", "ShardProgram", "shard_program",
+    "inference_pipeline", "training_pipeline", "deployment_pipeline",
+    "prune_pipeline",
 ]
 
 

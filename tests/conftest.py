@@ -53,6 +53,38 @@ def fresh_programs():
     yield
 
 
+# ---------------------------------------------------------------------------
+# Shared virtual-mesh fixtures: ONE mesh object per session instead of a
+# per-test rebuild — sharding tests that only need "the 8 CPU devices,
+# named" share these (and skip with a known reason when the virtual
+# device plane is absent, e.g. under a real single-chip backend).
+# ---------------------------------------------------------------------------
+
+def _mesh_or_skip(axes):
+    import jax
+
+    from paddle_tpu.parallel import make_mesh
+
+    need = 1
+    for s in axes.values():
+        need *= s
+    if len(jax.devices()) < need:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(axes, devices=jax.devices()[:need])
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh8():
+    """The full 8-device data-parallel mesh: {'dp': 8}."""
+    return _mesh_or_skip({"dp": 8})
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_dp_mp():
+    """The hybrid dp x tp mesh: {'dp': 4, 'mp': 2}."""
+    return _mesh_or_skip({"dp": 4, "mp": 2})
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: real-chip tier (runs in a child process owning "
@@ -74,6 +106,10 @@ KNOWN_SKIP_REASONS = (
     "no C++ toolchain",          # capi / native builds
     "xprof converter unavailable",
     "needs 4 virtual devices",
+    "needs 8 virtual devices",   # the shared cpu_mesh fixtures below
+    # two-process DCN tests: the compiler itself rejects multi-process
+    # CPU computations on this jaxlib line — true multi-process required
+    "true multi-process unsupported on this jaxlib CPU backend",
 )
 
 

@@ -49,6 +49,7 @@ def test_lstm_varlen_bench_path_runs():
     assert res["max_len"] <= 12
 
 
+@pytest.mark.slow  # tier-1 budget: heaviest bench path; transpiler path stays tier-1
 def test_inference_bench_path_runs():
     import jax
 
@@ -163,6 +164,7 @@ def test_sidecar_device_filtering(tmp_path, monkeypatch):
     assert "resnet" not in b._sidecar_load("aaaa")
 
 
+@pytest.mark.slow  # tier-1 budget: overhead A/B is a sweep row, not a correctness gate
 def test_trace_overhead_bench_path_runs():
     import jax
 
@@ -246,3 +248,26 @@ def test_checkpoint_bench_path_runs():
     # background stall can never exceed the full synchronous save path
     # by more than noise on a 1-core smoke box
     assert "background_stall_pct" in res and "sync_stall_pct" in res
+
+
+def test_sharding_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    # this test process already owns the 8-device virtual mesh, so the
+    # bench measures inline (no child spawn)
+    res = _bench().bench_sharding(jax, pt, layers, batch=16, dim=64,
+                                  steps=2, rounds=1, warmup=1)
+    assert res["single"]["ms_per_step"] > 0
+    assert "dp8" in res and "dp4xmp2" in res
+    # the tp axis halves per-device parameter bytes; dp leaves them full
+    assert (res["dp4xmp2"]["per_device_param_bytes"]
+            < 0.7 * res["single"]["per_device_param_bytes"])
+    assert res["dp8"]["collective_bytes_est"] > 0
+    # losses across all three legs agree (the correctness witness)
+    assert res["loss_parity_max_abs"] < 1e-5
+    # plan-digest cache key: the timed rounds never recompile
+    for leg in ("single", "dp8", "dp4xmp2"):
+        assert res[leg]["steady_state_fresh_compiles"] == 0

@@ -26,8 +26,16 @@ def _run_demo(path, *argv):
     assert proc.stdout.strip(), "demo produced no output"
 
 
-@pytest.mark.parametrize("path", _DEMOS, ids=[os.path.basename(p)
-                                              for p in _DEMOS])
+# tier-1 budget: the heaviest demo rides the slow tier; every other
+# demo stays a tier-1 integration guard
+_SLOW_DEMOS = ("traffic_prediction.py",)
+
+
+@pytest.mark.parametrize(
+    "path",
+    [pytest.param(p, marks=pytest.mark.slow)
+     if os.path.basename(p) in _SLOW_DEMOS else p for p in _DEMOS],
+    ids=[os.path.basename(p) for p in _DEMOS])
 def test_demo_runs(path):
     _run_demo(path)
 

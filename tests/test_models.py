@@ -44,6 +44,7 @@ def test_vgg16_forward():
                  (64, 64, 3), batch=1)
 
 
+@pytest.mark.slow  # tier-1 budget: zoo coverage rides vgg/alexnet/mobilenet/cifar
 def test_googlenet_forward():
     _run_forward(lambda im: models.googlenet(im, num_classes=10),
                  (224, 224, 3), batch=1)
@@ -54,6 +55,7 @@ def test_mobilenet_forward():
                  (64, 64, 3), batch=1)
 
 
+@pytest.mark.slow  # tier-1 budget: resnet50 train path covered by transpiler/bench tests
 def test_resnet50_imagenet_forward():
     _run_forward(lambda im: models.resnet_imagenet(im, num_classes=10,
                                                    depth=50),

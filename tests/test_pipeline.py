@@ -42,6 +42,7 @@ class TestGpipeFunctional:
         y = gpipe(_mlp_stage, params, x, mesh, axis="pp", n_microbatches=4)
         np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow  # tier-1 budget: redundant axis combination (pp core stays tier-1)
     def test_composes_with_dp(self):
         params, x, ref = self._setup()
         mesh = make_mesh({"dp": 2, "pp": 4})
@@ -49,6 +50,7 @@ class TestGpipeFunctional:
                   data_axis="dp")
         np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow  # tier-1 budget: redundant schedule variant
     def test_more_microbatches_than_stages(self):
         params, x, ref = self._setup()
         mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
@@ -117,6 +119,7 @@ class TestPipelinedStackLayer:
         assert np.isfinite(last).all()
         assert float(last) < float(first)
 
+    @pytest.mark.slow  # tier-1 budget: redundant axis combination (pp core stays tier-1)
     def test_trains_on_dp_pp_mesh(self):
         mesh = make_mesh({"dp": 2, "pp": 4})
         main, startup, loss = _build_lm(True)

@@ -1,0 +1,406 @@
+"""One sharding plane: program-level GSPMD lowering tests.
+
+The acceptance pins for the ShardProgram tentpole, on the 8-device
+virtual CPU mesh (conftest.py): dp=8, tp=4, and dp2 x tp4 training
+through ``SGD.train(plan=...)`` match the single-device run (dp to
+reduction-order ulps, tp to fp32 tolerance), per-device parameter and
+static peak-HBM bytes shrink ~tp-fold under tensor parallelism, the
+compile-cache key is plan-CONTENT-based (recreated plans: zero fresh
+compiles), and the pass sandwich stays clean through the annotation
+pass on three reference topologies.
+
+Budget note: training legs are built once per module (the PR 10
+weight-caching pattern) and shared across tests; redundant
+axis-combination variants are @pytest.mark.slow.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, models, transpiler
+from paddle_tpu.parallel import (ShardingPlan, ShardingPlanError,
+                                 data_parallel_plan, make_mesh,
+                                 megatron_plan, zero_plan)
+from paddle_tpu.transpiler import PassManager, ShardProgram, shard_program
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+D_MODEL, N_LAYERS, HEADS, T, VOCAB, BATCH, STEPS = 32, 2, 4, 16, 64, 8, 3
+
+
+def _build_transformer():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[T], dtype="int64")
+        tgt = layers.data("tgt", shape=[T], dtype="int64")
+        logits = models.transformer_lm(
+            ids, vocab_size=VOCAB, d_model=D_MODEL, n_layers=N_LAYERS,
+            num_heads=HEADS, max_len=T)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, VOCAB]),
+            layers.reshape(tgt, shape=[-1, 1])))
+        opt = pt.optimizer.AdamOptimizer(learning_rate=3e-3)
+    return main, startup, loss, opt
+
+
+def _batches():
+    rng = np.random.RandomState(7)
+    return [(rng.randint(0, VOCAB, size=(T,)).astype("int64"),
+             rng.randint(0, VOCAB, size=(T,)).astype("int64"))
+            for _ in range(BATCH)]
+
+
+# Module-level leg cache (PR 10's pattern): each (mesh, plan) leg trains
+# once; every test reads the cached losses/scope/trainer.
+_LEGS = {}
+
+
+def _train_leg(key, plan):
+    if key in _LEGS:
+        return _LEGS[key]
+    main, startup, loss, opt = _build_transformer()
+    with pt.program_guard(main, startup):
+        feed_list = [main.global_block.var("ids"),
+                     main.global_block.var("tgt")]
+        sgd = pt.trainer.SGD(loss, opt, feed_list, scope=pt.Scope())
+    losses = []
+
+    def handler(e):
+        if hasattr(e, "cost"):
+            losses.append(e.cost)
+
+    rows = _batches()
+    sgd.train(lambda: iter([rows] * STEPS), num_passes=1,
+              event_handler=handler, plan=plan)
+    _LEGS[key] = (losses, sgd)
+    return _LEGS[key]
+
+
+def _per_device_param_bytes(scope):
+    total = 0.0
+    for k in scope.keys():
+        v = scope.get(k)
+        if isinstance(v, jax.Array) and v.addressable_shards:
+            sh = v.addressable_shards[0].data
+            total += float(np.prod(sh.shape) or 1) * v.dtype.itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# The acceptance pins: dp / tp / dp x tp vs single device
+# ---------------------------------------------------------------------------
+class TestPlanTraining:
+    def test_dp8_matches_single_device(self, cpu_mesh8):
+        ref, _ = _train_leg("single", None)
+        got, sgd = _train_leg("dp8", data_parallel_plan(cpu_mesh8))
+        assert len(ref) == len(got) == STEPS
+        # same math, 8-way batch split: identical up to the psum's
+        # reduction order (single-ulp) — GSPMD inserts the collectives,
+        # the program never changed
+        np.testing.assert_allclose(got, ref, rtol=2e-6, atol=0)
+        assert sgd.exe.mesh is cpu_mesh8
+
+    def test_tp4_matches_single_device(self):
+        ref, ref_sgd = _train_leg("single", None)
+        mesh = make_mesh({"mp": 4}, devices=jax.devices()[:4])
+        got, sgd = _train_leg("tp4", megatron_plan(mesh))
+        # tp reshards every contraction: fp32 tolerance, not bit-exact
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+        # the tp axis actually cut per-device parameter bytes: fc/attn
+        # weights (the bulk of this model) hold 1/4 shards per device
+        full = _per_device_param_bytes(ref_sgd.scope)
+        shard = _per_device_param_bytes(sgd.scope)
+        assert shard < 0.55 * full, (shard, full)
+
+    def test_dp2_tp4_compose_on_one_mesh(self):
+        ref, _ = _train_leg("single", None)
+        mesh = make_mesh({"dp": 2, "mp": 4})
+        got, sgd = _train_leg("dp2mp4", megatron_plan(mesh))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+        # ONE mesh carries both axes; no second entry point involved
+        assert sgd.exe.mesh.axis_names == ("dp", "mp")
+
+    def test_zero_recompiles_across_recreated_plans(self):
+        """The cache key hashes mesh shape + plan digest, not object
+        identity: a freshly constructed equivalent plan (new mesh object
+        over the same devices, new rule closures) re-enters warm."""
+        _, sgd = _train_leg("dp8", data_parallel_plan(make_mesh({"dp": 8})))
+        before = sgd.exe.cache_stats()
+        rows = _batches()
+        sgd.train(lambda: iter([rows]), num_passes=1,
+                  event_handler=lambda e: None,
+                  plan=data_parallel_plan(make_mesh({"dp": 8})))
+        after = sgd.exe.cache_stats()
+        assert after["fresh_compiles"] == before["fresh_compiles"]
+        assert after["misses"] == before["misses"]
+        assert after["hits"] > before["hits"]
+
+    @pytest.mark.slow
+    def test_zero_plan_transformer(self, cpu_mesh8):
+        """Redundant axis-combination variant: ZeRO accumulator sharding
+        trains to the same losses as single-device."""
+        ref, _ = _train_leg("single", None)
+        got, _ = _train_leg("zero8", zero_plan(cpu_mesh8))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_tp2_variant(self):
+        ref, _ = _train_leg("single", None)
+        mesh = make_mesh({"mp": 2}, devices=jax.devices()[:2])
+        got, _ = _train_leg("tp2", megatron_plan(mesh))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# The pass: registry, sandwich, annotations
+# ---------------------------------------------------------------------------
+class TestShardProgramPass:
+    def test_registered_in_pass_registry(self):
+        assert "shard_program" in transpiler.registered_passes()
+        p = transpiler.get_pass("shard_program")
+        assert isinstance(p, ShardProgram)
+        # zero-arg registry form is a no-op on unsharded programs
+        prog = pt.Program()
+        p.apply(prog, transpiler.PassContext([], []))
+        assert getattr(prog, "sharding_plan", None) is None
+
+    def test_pass_sandwich_clean_on_reference_topologies(self,
+                                                         cpu_mesh_dp_mp):
+        """verify_each=True through ShardProgram on resnet50,
+        transformer, and Wide&Deep: the annotation pass must never break
+        a program (it changes no ops) and the verifier must accept the
+        annotated result."""
+        plan = megatron_plan(cpu_mesh_dp_mp)
+
+        def resnet():
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                images = layers.data("images", shape=[32, 32, 3])
+                label = layers.data("label", shape=[1], dtype="int64")
+                logits = models.resnet_imagenet(images, num_classes=10,
+                                                depth=50)
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, label))
+                pt.optimizer.MomentumOptimizer(
+                    learning_rate=0.1, momentum=0.9).minimize(
+                    loss, startup_program=startup)
+            return main, ["images", "label"], [loss.name]
+
+        def transformer():
+            main, _, loss, opt = _build_transformer()
+            with pt.program_guard(main):
+                opt.minimize(loss)
+            return main, ["ids", "tgt"], [loss.name]
+
+        def wide_deep():
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                ids = layers.data("ids", shape=[4], dtype="int64")
+                dense = layers.data("dense", shape=[3])
+                label = layers.data("label", shape=[1])
+                logit = models.wide_deep(ids, dense, vocab_size=256,
+                                         embed_dim=4, hidden_sizes=(16,))
+                loss, _ = models.wide_deep_loss(logit, label)
+                pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                    loss, startup_program=startup)
+            return main, ["ids", "dense", "label"], [loss.name]
+
+        for build in (resnet, transformer, wide_deep):
+            prog, feeds, fetches = build()
+            pm = PassManager([ShardProgram(plan)], verify_each=True,
+                             verify_shapes=True)
+            pm.run(prog, feeds, fetches)  # PassVerificationError = fail
+            assert prog.sharding_plan is plan
+            annotated = [v for v in prog.global_block.vars.values()
+                         if getattr(v, "sharding", None) is not None]
+            assert annotated, "no vars annotated"
+            assert any(tuple(v.sharding) for v in annotated), \
+                "nothing sharded"
+            assert pm.last_notes and "shard_program" in pm.last_notes[0]
+
+    def test_annotations_survive_clone_and_feed_specs(self, cpu_mesh8):
+        main, _, loss, opt = _build_transformer()
+        with pt.program_guard(main):
+            opt.minimize(loss)
+        plan = data_parallel_plan(cpu_mesh8)
+        shard_program(main, plan, ["ids", "tgt"], [loss.name])
+        clone = main.clone()
+        v = clone.global_block.var("ids")
+        from jax.sharding import PartitionSpec as P
+
+        assert v.sharding == P("dp", None)
+
+    def test_donation_hazard_caught_on_sharded_program(self, cpu_mesh8):
+        """The existing fetch-of-donated-state verifier rule keeps
+        firing through the new pass: a sharded training program that
+        fetches a donated (written-back) parameter is still rejected."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[8])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square(
+                layers.elementwise_sub(pred, y)))
+            pt.optimizer.SGDOptimizer(learning_rate=0.1).minimize(
+                loss, startup_program=startup)
+        shard_program(main, data_parallel_plan(cpu_mesh8),
+                      ["x", "y"], [loss.name])
+        written = analysis.written_state_names(main)
+        param = next(n for n in written if ".w" in n)
+        issues = analysis.run_lint(main, ["x", "y"], [loss.name, param])
+        assert any(i.rule == "fetch-donated-state" and i.var == param
+                   for i in issues), [i.rule for i in issues]
+
+
+# ---------------------------------------------------------------------------
+# Plan rules: rank fall-through, located error, digest
+# ---------------------------------------------------------------------------
+class TestPlanRules:
+    def test_rank_misfit_falls_through_to_next_rule(self, cpu_mesh_dp_mp):
+        from jax.sharding import PartitionSpec as P
+
+        plan = ShardingPlan(cpu_mesh_dp_mp, rules=[
+            (r"\.w", P(None, "mp")),     # rank 2: misfits rank-1 vars
+            (r"\.w", P("mp")),           # the fall-through target
+        ])
+        assert plan.spec_for_state("fc.w_0", 2) == P(None, "mp")
+        assert plan.spec_for_state("fc.w_0_moment_acc", 1) == P("mp")
+
+    def test_low_rank_accumulator_inherits_default(self, cpu_mesh_dp_mp):
+        from jax.sharding import PartitionSpec as P
+
+        plan = megatron_plan(cpu_mesh_dp_mp)
+        # (1,)-shaped beta-pow accumulator: rank fits the bias rule but
+        # 1 is not divisible by mp — silently replicates
+        assert plan.spec_for_state("fc.b_0_beta1_pow_acc", 1,
+                                   shape=(1,)) == P()
+
+    def test_located_error_when_nothing_fits(self, cpu_mesh_dp_mp):
+        from jax.sharding import PartitionSpec as P
+
+        plan = ShardingPlan(cpu_mesh_dp_mp,
+                            rules=[(r"\.w", P(None, "mp"))],
+                            default=P("dp", None))
+        with pytest.raises(ShardingPlanError) as exc:
+            plan.spec_for_state("fc.w_0_beta1_pow_acc", 1, shape=(1,))
+        msg = str(exc.value)
+        assert "fc.w_0_beta1_pow_acc" in msg and "\\.w" in msg
+
+    def test_digest_content_based(self, cpu_mesh_dp_mp):
+        a = megatron_plan(cpu_mesh_dp_mp)
+        b = megatron_plan(make_mesh({"dp": 4, "mp": 2}))
+        assert a.digest() == b.digest()
+        assert a.digest() != data_parallel_plan(cpu_mesh_dp_mp).digest()
+        assert a.digest() != megatron_plan(
+            make_mesh({"dp": 2, "mp": 4})).digest()
+
+
+# ---------------------------------------------------------------------------
+# Analysis plane: per-device bytes + collective pricing
+# ---------------------------------------------------------------------------
+class TestShardedAnalysis:
+    def test_per_device_peak_cut_under_tp(self):
+        main, _, loss, opt = _build_transformer()
+        with pt.program_guard(main):
+            opt.minimize(loss)
+        mesh = make_mesh({"mp": 4}, devices=jax.devices()[:4])
+        plan = megatron_plan(mesh)
+        m0 = analysis.analyze_memory(main, ["ids", "tgt"], [loss.name],
+                                     batch_size=BATCH)
+        m1 = analysis.analyze_memory(main, ["ids", "tgt"], [loss.name],
+                                     batch_size=BATCH, plan=plan)
+        assert m1.mesh_axes == {"mp": 4}
+        # fc/attention weights + their Adam moments dominate this
+        # model's resident set; tp=4 must cut the per-device watermark
+        # by well over 2x (~tp-fold on the sharded fraction)
+        assert m1.resident_bytes < 0.5 * m0.resident_bytes
+        assert m1.peak_bytes < 0.6 * m0.peak_bytes
+
+    def test_collectives_priced_from_plan(self):
+        main, _, loss, opt = _build_transformer()
+        with pt.program_guard(main):
+            opt.minimize(loss)
+        mesh = make_mesh({"dp": 4, "mp": 2})
+        plan = megatron_plan(mesh)
+        m = analysis.analyze_memory(main, ["ids", "tgt"], [loss.name],
+                                    batch_size=BATCH, plan=plan)
+        assert m.collectives is not None
+        kinds = m.collectives.bytes_by_kind()
+        # dp: replicated trainables psum grads; mp: sharded contractions
+        # all-reduce activations — both families must be priced
+        assert kinds.get("grad_allreduce", 0) > 0
+        assert kinds.get("tp_allreduce", 0) > 0
+        assert m.collective_bytes == sum(kinds.values())
+        assert m.collectives.time_seconds() > 0
+        report = m.format_report()
+        assert "PER DEVICE" in report and "collectives" in report
+
+    def test_annotated_program_defaults_its_plan(self, cpu_mesh8):
+        """analyze_memory picks up program.sharding_plan when no plan
+        argument is given — the ShardProgram annotation IS the plan."""
+        main, _, loss, opt = _build_transformer()
+        with pt.program_guard(main):
+            opt.minimize(loss)
+        shard_program(main, data_parallel_plan(cpu_mesh8),
+                      ["ids", "tgt"], [loss.name])
+        m = analysis.analyze_memory(main, ["ids", "tgt"], [loss.name],
+                                    batch_size=BATCH)
+        assert m.mesh_axes == {"dp": 8}
+
+
+# ---------------------------------------------------------------------------
+# Serving: InferenceEngine(plan=...)
+# ---------------------------------------------------------------------------
+class TestEnginePlan:
+    def test_engine_plan_parity_and_zero_recompiles(self, cpu_mesh8):
+        from paddle_tpu.serving import InferenceEngine
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", shape=[16])
+            h = layers.fc(x, size=32, act="relu")
+            out = layers.fc(h, size=4)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(8, 16).astype("float32")
+        ref, = exe.run(main, feed={"x": xs}, fetch_list=[out],
+                       scope=scope)
+
+        eng = InferenceEngine(program=main, feed_names=["x"],
+                              fetch_names=[out.name], scope=scope,
+                              plan=data_parallel_plan(cpu_mesh8),
+                              batch_buckets=(8,), transpile=False)
+        got = eng.run({"x": xs})[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert eng.executor.mesh is cpu_mesh8
+        stats0 = eng.executor.cache_stats()
+        eng.run({"x": xs})
+        stats1 = eng.executor.cache_stats()
+        assert stats1["fresh_compiles"] == stats0["fresh_compiles"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: the --mesh flag (slow: subprocess)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_memplan_mesh_cli():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "memplan.py"),
+         "--demo", "quick_start", "--mesh", "dp=4,mp=2", "--json"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    payload = json.loads(proc.stdout)
+    sharded = [t for t in payload["targets"] if t.get("per_device")]
+    assert sharded and sharded[0]["mesh"] == {"dp": 4, "mp": 2}

@@ -2,6 +2,7 @@
 are two implementations of the same block; with identical weights they
 must produce identical logits. Guards the pair against silent drift."""
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers, models
@@ -73,6 +74,7 @@ def test_stacked_matches_per_layer_with_copied_weights():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: "dots" remat numerics also pinned by test_norm_grads per-layer remat
 def test_stack_remat_policies_match_numerically():
     """remat=False / True / "dots" (selective save-dots policy) are pure
     memory-schedule choices — identical losses through training steps."""

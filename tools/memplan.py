@@ -38,17 +38,27 @@ def _load_proglint():
     return mod
 
 
-def plan_target(tag, program, feed_names, fetch_names, scope, args):
+def plan_target(tag, program, feed_names, fetch_names, scope, args,
+                plan=None):
     """Analyze one target; returns a JSON-safe dict."""
     from paddle_tpu import analysis
 
     entry = {"target": tag, "batch": args.batch}
     try:
         mem = analysis.analyze_memory(program, feed_names, fetch_names,
-                                      scope=scope, batch_size=args.batch)
+                                      scope=scope, batch_size=args.batch,
+                                      plan=plan)
     except Exception as exc:
         entry["error"] = f"{type(exc).__name__}: {exc}"
         return entry
+    if mem.mesh_axes:
+        entry["mesh"] = mem.mesh_axes
+        entry["per_device"] = True
+        if mem.collectives is not None:
+            entry["collective_bytes"] = mem.collective_bytes
+            entry["collectives_by_kind"] = mem.collectives.bytes_by_kind()
+            entry["per_device_state_bytes"] = \
+                mem.collectives.per_device_state_bytes
     entry.update({
         "peak_bytes": mem.peak_bytes,
         "resident_bytes": mem.resident_bytes,
@@ -94,6 +104,16 @@ def main(argv=None) -> int:
     ap.add_argument("--budget", type=float, default=None,
                     help="peak-HBM budget in bytes; exit nonzero when any "
                          "target's static peak exceeds it")
+    ap.add_argument("--mesh", default=None,
+                    help="price the program PER DEVICE over a named mesh "
+                         "(e.g. --mesh dp=4,mp=2): sharded dims divide, "
+                         "plan collectives (psum/all-to-all wire bytes) "
+                         "are added to the report")
+    ap.add_argument("--plan", default="auto",
+                    choices=("auto", "dp", "megatron", "zero", "vocab",
+                             "expert"),
+                    help="with --mesh: the canned ShardingPlan to price "
+                         "under (auto = megatron when mp>1, else dp)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--no-roofline", action="store_true")
     ap.add_argument("--no-advice", action="store_true")
@@ -102,6 +122,8 @@ def main(argv=None) -> int:
         ap.error("nothing to analyze: give MODEL_DIR(s) or --demo")
 
     proglint = _load_proglint()
+    plan = proglint.build_plan(proglint.parse_mesh(args.mesh),
+                               args.plan) if args.mesh else None
     targets = []
     failures = 0
     for d in args.model_dirs:
@@ -117,7 +139,8 @@ def main(argv=None) -> int:
     report = []
     over = 0
     for tag, program, feeds, fetches, scope in targets:
-        entry = plan_target(tag, program, feeds, fetches, scope, args)
+        entry = plan_target(tag, program, feeds, fetches, scope, args,
+                            plan=plan)
         report.append(entry)
         if entry.get("error"):
             failures += 1

@@ -31,6 +31,45 @@ DEMOS = ("quick_start", "serving_lm")
 
 
 # --------------------------------------------------------------------------
+# --mesh dp=4,mp=2: lint/price a SHARDED program per-device. The mesh is
+# ABSTRACT (no real devices needed — static analysis only), so a 1-CPU
+# box lints the dp=256 program it will deploy.
+# --------------------------------------------------------------------------
+def parse_mesh(spec: str):
+    """``dp=4,mp=2`` -> {"dp": 4, "mp": 2} (the --mesh flag grammar)."""
+    axes = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit(f"--mesh: bad axis {part!r} "
+                             f"(want name=size,name=size)")
+        axes[name.strip()] = int(size)
+    if not axes:
+        raise SystemExit("--mesh: no axes given")
+    return axes
+
+
+def build_plan(mesh_axes, plan_kind: str = "auto"):
+    """A canned ShardingPlan over an abstract mesh. ``auto`` picks
+    megatron when a model axis exists, pure data-parallel otherwise."""
+    from paddle_tpu import parallel
+
+    mesh = parallel.make_abstract_mesh(mesh_axes)
+    if plan_kind == "auto":
+        plan_kind = "megatron" if mesh_axes.get("mp", 1) > 1 else "dp"
+    builders = {
+        "dp": parallel.data_parallel_plan,
+        "megatron": parallel.megatron_plan,
+        "zero": parallel.zero_plan,
+        "vocab": parallel.vocab_sharded_plan,
+        "expert": parallel.expert_parallel_plan,
+    }
+    return builders[plan_kind](mesh)
+
+
+# --------------------------------------------------------------------------
 # Targets: each yields (tag, program, feed_names, fetch_names, scope)
 # --------------------------------------------------------------------------
 def load_saved_model(dirname: str):
@@ -120,13 +159,28 @@ def build_demo(name: str):
 def lint_target(tag, program, feed_names, fetch_names, scope,
                 check_shapes: bool, rules: Optional[List[str]],
                 mem: bool = False, budget: Optional[float] = None,
-                batch: int = 16):
+                batch: int = 16, plan=None):
     """Returns (issues, fatal): lint findings plus any checker error
     (already located) surfaced as an error-severity issue."""
     from paddle_tpu import analysis
 
     issues = analysis.run_lint(program, feed_names, fetch_names,
                                scope=scope, rules=rules)
+    if plan is not None and not any(i.severity == analysis.ERROR
+                                    for i in issues):
+        # sharding plane: resolve every persistable var through the plan
+        # — a rule set that cannot fit a var (ShardingPlanError) is an
+        # error-severity finding naming var + rules, at lint time
+        from paddle_tpu.parallel import ShardingPlanError
+        from paddle_tpu.transpiler import shard_program
+
+        try:
+            shard_program(program, plan, feed_names, fetch_names,
+                          scope=scope)
+        except ShardingPlanError as exc:
+            issues.append(analysis.LintIssue(
+                rule="sharding-plan", severity=analysis.ERROR,
+                message=str(exc)))
     if check_shapes and not any(i.severity == analysis.ERROR
                                 for i in issues):
         try:
@@ -145,7 +199,8 @@ def lint_target(tag, program, feed_names, fetch_names, scope,
         # exceeded --budget is an error-severity finding (nonzero exit)
         try:
             m = analysis.analyze_memory(program, feed_names, fetch_names,
-                                        scope=scope, batch_size=batch)
+                                        scope=scope, batch_size=batch,
+                                        plan=plan)
         except Exception as exc:
             issues.append(analysis.LintIssue(
                 rule="memory-analysis", severity=analysis.ERROR,
@@ -159,9 +214,19 @@ def lint_target(tag, program, feed_names, fetch_names, scope,
                 severity = analysis.ERROR
                 verdict = (f" EXCEEDS budget {budget / 1e9:.3f} GB;"
                            f" top live: {top}")
+            scope_note = ""
+            if m.mesh_axes:
+                axes = "x".join(f"{a}={s}"
+                                for a, s in m.mesh_axes.items())
+                scope_note = f" PER DEVICE over [{axes}]"
+                if m.collectives is not None:
+                    scope_note += (f", collectives "
+                                   f"{m.collective_bytes / 1e6:.1f} "
+                                   f"MB/step")
             issues.append(analysis.LintIssue(
                 rule="memory-budget", severity=severity,
-                message=f"static peak HBM {m.peak_bytes / 1e9:.3f} GB "
+                message=f"static peak HBM {m.peak_bytes / 1e9:.3f} GB"
+                        f"{scope_note} "
                         f"at batch={batch} (resident "
                         f"{m.resident_bytes / 1e9:.3f} GB, est "
                         f"{m.estimated_step_seconds() * 1e3:.2f} ms/step"
@@ -199,9 +264,21 @@ def main(argv=None) -> int:
                          "error (nonzero exit)")
     ap.add_argument("--batch", type=int, default=16,
                     help="with --mem: batch size for -1 dims (default 16)")
+    ap.add_argument("--mesh", default=None,
+                    help="lint the program as SHARDED over a named mesh "
+                         "(e.g. --mesh dp=4,mp=2): plan rules resolved "
+                         "per var (misfits are error findings), --mem "
+                         "prices per-device bytes + collectives")
+    ap.add_argument("--plan", default="auto", dest="plan_kind",
+                    choices=("auto", "dp", "megatron", "zero", "vocab",
+                             "expert"),
+                    help="with --mesh: canned ShardingPlan (auto = "
+                         "megatron when mp>1, else dp)")
     args = ap.parse_args(argv)
     if not args.model_dirs and not args.demo and not args.audit:
         ap.error("nothing to lint: give MODEL_DIR(s), --demo, or --audit")
+    plan = build_plan(parse_mesh(args.mesh), args.plan_kind) \
+        if args.mesh else None
 
     from paddle_tpu import analysis
 
@@ -232,7 +309,8 @@ def main(argv=None) -> int:
             issues = lint_target(tag, program, feeds, fetches, scope,
                                  check_shapes=not args.no_shapes,
                                  rules=rules, mem=args.mem,
-                                 budget=args.budget, batch=args.batch)
+                                 budget=args.budget, batch=args.batch,
+                                 plan=plan)
             n_errors += sum(i.severity == analysis.ERROR for i in issues)
             n_warnings += sum(i.severity == analysis.WARNING
                               for i in issues)
