@@ -33,7 +33,7 @@ from .errors import (BadRequestError, CacheExhaustedError,
                      RequestTimeoutError, ServingError)
 from .fleet import Fleet, HttpReplica, LocalReplica, Replica
 from .generation import (GenerationEngine, LMSpec, PagedGenerationEngine,
-                         spec_from_program_dict)
+                         RequestTimeline, spec_from_program_dict)
 from .metrics import MetricsRegistry
 from .paging import PagePool, PrefixIndex
 from .router import (CircuitBreaker, LeastLoadedPolicy, RoundRobinPolicy,
@@ -43,7 +43,8 @@ from .server import Server
 __all__ = [
     "DynamicBatcher", "Future", "Request",
     "InferenceEngine", "GenerationEngine", "PagedGenerationEngine",
-    "LMSpec", "spec_from_program_dict", "MetricsRegistry", "Server",
+    "LMSpec", "RequestTimeline", "spec_from_program_dict",
+    "MetricsRegistry", "Server",
     "PagePool", "PrefixIndex",
     "Fleet", "Replica", "LocalReplica", "HttpReplica",
     "Router", "CircuitBreaker", "RoundRobinPolicy", "LeastLoadedPolicy",

@@ -83,9 +83,14 @@ class Request:
 
     def begin_trace(self) -> None:
         """Open the request + queue spans (detached: they cross threads
-        and are ended explicitly by the dispatch/completion path)."""
+        and are ended explicitly by the dispatch/completion path). A
+        ``traceparent`` key in ``meta`` (injected by the fleet router or
+        an upstream HTTP client) RESUMES that trace — the request's
+        spans join the caller's trace id instead of starting a fresh
+        one; malformed headers fall back to a fresh trace."""
+        parent = trace.extract((self.meta or {}).get("traceparent"))
         self.span = trace.start_span(
-            "serving/request", detached=True,
+            "serving/request", detached=True, parent=parent,
             timeout_ms=(None if self.deadline is None
                         else round((self.deadline - self.enqueue_t) * 1e3)))
         if self.span is not None:

@@ -280,6 +280,24 @@ class InferenceEngine:
         self._inflight = 0
         self._inflight_cond = threading.Condition()
         self._outstanding: "weakref.WeakSet" = weakref.WeakSet()
+        # flight recorder: live engine state rides every crash/SIGUSR1/
+        # admin dump (weak registration — never keeps the engine alive)
+        from ..trace import flight as trace_flight
+
+        trace_flight.get_recorder().add_source(type(self).__name__,
+                                               self.flight_state)
+
+    # ------------------------------------------------------------------
+    def flight_state(self) -> dict:
+        """Live state for the flight recorder bundle."""
+        return {
+            "engine": type(self).__name__,
+            "closed": self._closed,
+            "inflight": self._inflight,
+            "batch_buckets": list(self.batch_buckets),
+            "feed_names": list(self.feed_names),
+            "cache_stats": dict(self.cache_stats()),
+        }
 
     # ------------------------------------------------------------------
     def _device_ctx(self):

@@ -273,14 +273,25 @@ class HttpReplica(Replica):
 
     # -- transport -------------------------------------------------------
     def _http(self, method: str, path: str, body: Optional[dict] = None,
-              timeout_s: Optional[float] = None) -> dict:
+              timeout_s: Optional[float] = None,
+              headers: Optional[dict] = None) -> dict:
         import urllib.error
         import urllib.request
 
         data = json.dumps(body).encode() if body is not None else None
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update({k: v for k, v in headers.items()
+                         if v is not None})
+        if "traceparent" not in hdrs:
+            # control-plane calls (drain/swap/warm) ride the caller's
+            # open span (e.g. fleet/rolling_update) when there is one
+            tp = trace.inject()
+            if tp is not None:
+                hdrs["traceparent"] = tp
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=hdrs)
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout_s or self.connect_timeout_s) as r:
@@ -330,10 +341,12 @@ class HttpReplica(Replica):
             body["timeout_s"] = timeout_ms / 1e3
         timeout_s = (timeout_ms / 1e3 + 1.0) if timeout_ms is not None \
             else None
+        headers = {"traceparent": meta.get("traceparent")}
 
         def run():
             try:
-                out = self._http("POST", path, body, timeout_s=timeout_s)
+                out = self._http("POST", path, body, timeout_s=timeout_s,
+                                 headers=headers)
                 fut.set_result(np.asarray(out["ids"])
                                if "ids" in out
                                else [np.asarray(o)
@@ -424,10 +437,16 @@ class Fleet:
                  hedge_min_ms: float = 20.0, max_pending: int = 256,
                  default_timeout_ms: Optional[float] = 30_000.0,
                  breaker: Optional[dict] = None, workers: Optional[int] = None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None, slo=None):
+        from ..trace.slo import SLOTracker
+
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.metrics = metrics or MetricsRegistry()
+        # fleet-level SLO: evaluated over the MERGED replica histograms
+        # (bucket sums), so attainment/burn are correct fleet-wide
+        self.slo_tracker = SLOTracker(slo) if slo is not None else None
+        self.flight = trace.get_recorder()
         self.replicas: List[Replica] = []
         for i, rep in enumerate(replicas):
             if not isinstance(rep, Replica):
@@ -501,6 +520,10 @@ class Fleet:
         ``meta['session']`` keys session affinity;
         ``meta['idempotent']=False`` disables retries/hedging for
         requests that must execute at most once.
+        ``meta['traceparent']`` (a W3C header from an upstream caller)
+        resumes that trace; every attempt then re-injects the fleet
+        span's own context, so router attempts, hedges, and the winning
+        replica's spans all share ONE trace id.
         """
         if self._closed:
             raise EngineClosedError("fleet is stopped")
@@ -524,9 +547,11 @@ class Fleet:
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
         fut = Future()
-        span = trace.start_span("fleet/request", detached=True,
-                                timeout_ms=timeout_ms)
-        self._pool.submit(self._run, fut, payload, dict(meta), deadline,
+        meta = dict(meta)
+        span = trace.start_span(
+            "fleet/request", detached=True, timeout_ms=timeout_ms,
+            parent=trace.extract(meta.pop("traceparent", None)))
+        self._pool.submit(self._run, fut, payload, meta, deadline,
                           span)
         return fut
 
@@ -615,6 +640,9 @@ class Fleet:
     def _begin(self, replica: Replica, payload, meta: dict,
                deadline: Optional[float], span, hedge: bool) -> _Attempt:
         self.metrics.inc("attempts")
+        header = trace.inject(span)
+        if header is not None:  # the replica resumes THIS trace
+            meta = dict(meta, traceparent=header)
         att = replica.begin(payload, meta, self._remaining_ms(deadline))
         att.hedge = hedge
         if span is not None:
@@ -760,34 +788,81 @@ class Fleet:
             self.metrics.set_labeled("fleet_breaker_state",
                                      BREAKER_GAUGE[state], replica=name)
 
+    @staticmethod
+    def _decode_latency_cols(snap: dict) -> dict:
+        """Per-replica TTFT/TPOT columns for /fleet/status, read from a
+        replica's snapshot histograms (None until it has decoded)."""
+        hist = (snap or {}).get("hist") or {}
+        out = {}
+        for metric in ("ttft", "tpot"):
+            h = hist.get(metric) or {}
+            for q in ("p50_ms", "p99_ms"):
+                val = h.get(q)
+                out[f"{metric}_{q}"] = (None if not h.get("count")
+                                        else round(float(val), 3))
+        return out
+
     def status(self) -> dict:
         self._refresh_labels()
-        return {
-            "replicas": [{
+        rep_snaps = {rep.name: rep.metrics_snapshot()
+                     for rep in self.replicas}
+        merged = MetricsRegistry.merge(rep_snaps)
+        status = {
+            "replicas": [dict({
                 "name": rep.name,
                 "index": rep.index,
                 "health": rep.healthz(),
                 "inflight": rep.inflight,
                 "breaker": self.router.breakers[rep.name].state,
-            } for rep in self.replicas],
+            }, **self._decode_latency_cols(rep_snaps.get(rep.name)))
+                for rep in self.replicas],
             "pending": self._pending,
             "max_pending": self.max_pending,
             "hedge": self.hedge,
             "hedge_delay_ms": round(self._hedge_delay_s() * 1e3, 3),
             "counters": self.metrics.snapshot()["counters"],
+            "fleet": self._decode_latency_cols(merged),
+            # always present so fleetctl renders a stable schema: null
+            # when no SLO is configured
+            "slo": (self.slo_tracker.status(self._slo_view(merged))
+                    if self.slo_tracker is not None else None),
         }
+        return status
+
+    def _slo_view(self, merged: dict) -> dict:
+        """What the SLO evaluates: the fleet-merged decode histograms +
+        the FLEET's own completed/failed counters (availability is a
+        property of the fleet's answers, retries/hedges included — a
+        replica-level failure the router absorbed doesn't burn
+        budget)."""
+        return {"hist": merged.get("hist") or {},
+                "counters": self.metrics.snapshot()["counters"]}
 
     def metrics_snapshot(self) -> dict:
         """Fleet registry + MetricsRegistry.merge() of every replica's
         snapshot — the /metrics body."""
         self._refresh_labels()
-        snap = self.metrics.snapshot()
-        snap["fleet"] = MetricsRegistry.merge(
+        merged = MetricsRegistry.merge(
             {rep.name: rep.metrics_snapshot() for rep in self.replicas})
+        if self.slo_tracker is not None:
+            self.slo_tracker.publish_gauges(
+                self.metrics,
+                self.slo_tracker.status(self._slo_view(merged)))
+        snap = self.metrics.snapshot()
+        snap["fleet"] = merged
+        if self.slo_tracker is not None:
+            snap["slo"] = self.slo_tracker.status()
         return snap
 
     def metrics_prometheus(self) -> str:
         self._refresh_labels()
+        if self.slo_tracker is not None:
+            merged = MetricsRegistry.merge(
+                {rep.name: rep.metrics_snapshot()
+                 for rep in self.replicas})
+            self.slo_tracker.publish_gauges(
+                self.metrics,
+                self.slo_tracker.status(self._slo_view(merged)))
         return self.metrics.prometheus_text()
 
     # -- HTTP front end ---------------------------------------------------
@@ -798,6 +873,9 @@ class Fleet:
 
         fleet = self
         self.start()
+        # operator poke: SIGUSR1 dumps a flight bundle (best-effort —
+        # a no-op off the main thread)
+        trace.install_signal_handler(recorder=self.flight)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -840,6 +918,8 @@ class Fleet:
                         self._send(200, fleet.metrics_snapshot())
                 elif path == "/fleet/status":
                     self._send(200, fleet.status())
+                elif path == "/fleet/flightdump":
+                    self._send(200, fleet.flight.bundle("admin"))
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -876,6 +956,9 @@ class Fleet:
             def _route_post(self, req):
                 meta = {k: req[k] for k in ("session", "idempotent")
                         if k in req}
+                tp = self.headers.get("traceparent")
+                if tp:
+                    meta["traceparent"] = tp
                 if self.path == "/v1/generate":
                     fut = fleet.submit(
                         {"prompt": req["prompt"]},

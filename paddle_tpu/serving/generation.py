@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .. import profiler, trace
+from ..trace import flight as trace_flight
 from ..core.executor import Executor, TPUPlace
 from ..core.program import Program, program_guard
 from ..core.scope import Scope
@@ -126,8 +128,79 @@ def _default_prompt_buckets(tmax: int) -> List[int]:
     return sorted(set(buckets))
 
 
+class RequestTimeline:
+    """Per-request decode timeline: admission, prefill chunk spans, the
+    first-token timestamp, and per-token decode deltas — the raw record
+    behind the TTFT / TPOT histograms and the flight recorder's
+    last-N-requests ring. Timestamps are ``time.monotonic`` seconds (the
+    request deadline clock)."""
+
+    __slots__ = ("enqueue_t", "admitted_t", "prompt_len",
+                 "prefix_hit_tokens", "chunks", "first_token_t",
+                 "last_token_t", "n_tokens", "deltas_s")
+
+    def __init__(self, enqueue_t: float, prompt_len: int,
+                 prefix_hit_tokens: int = 0):
+        self.enqueue_t = enqueue_t
+        self.admitted_t = time.monotonic()
+        self.prompt_len = int(prompt_len)
+        self.prefix_hit_tokens = int(prefix_hit_tokens)
+        self.chunks: List[tuple] = []   # (start_t, end_t, tokens)
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.n_tokens = 0
+        self.deltas_s: List[float] = []
+
+    @property
+    def queue_wait_s(self) -> float:
+        return max(0.0, self.admitted_t - self.enqueue_t)
+
+    def chunk(self, start_t: float, end_t: float, tokens: int) -> None:
+        self.chunks.append((start_t, end_t, int(tokens)))
+
+    def mark_token(self, now: float) -> Optional[float]:
+        """Record one emitted token; returns the inter-token delta
+        (None for the first token — that one is the TTFT sample)."""
+        self.n_tokens += 1
+        if self.first_token_t is None:
+            self.first_token_t = self.last_token_t = now
+            return None
+        delta = now - self.last_token_t
+        self.last_token_t = now
+        self.deltas_s.append(delta)
+        return delta
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return (None if self.first_token_t is None
+                else self.first_token_t - self.enqueue_t)
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        return (sum(self.deltas_s) / len(self.deltas_s)
+                if self.deltas_s else None)
+
+    def to_dict(self) -> dict:
+        return {
+            "prompt_len": self.prompt_len,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "ttft_s": (None if self.ttft_s is None
+                       else round(self.ttft_s, 6)),
+            "tpot_s": (None if self.tpot_s is None
+                       else round(self.tpot_s, 6)),
+            "tokens": self.n_tokens,
+            "prefill_chunks": [
+                {"start_s": round(t0, 6), "dur_s": round(t1 - t0, 6),
+                 "tokens": n} for t0, t1, n in self.chunks],
+            "decode_deltas_ms": [round(d * 1e3, 3)
+                                 for d in self.deltas_s],
+        }
+
+
 class _Slot:
-    __slots__ = ("request", "generated", "max_new", "eos_id", "prompt")
+    __slots__ = ("request", "generated", "max_new", "eos_id", "prompt",
+                 "timeline")
 
     def __init__(self, request: Request, prompt: np.ndarray,
                  max_new: int, eos_id: Optional[int]):
@@ -136,6 +209,7 @@ class _Slot:
         self.generated: List[int] = []
         self.max_new = max_new
         self.eos_id = eos_id
+        self.timeline = RequestTimeline(request.enqueue_t, prompt.size)
 
 
 class GenerationEngine:
@@ -184,6 +258,11 @@ class GenerationEngine:
         self.pad_id = int(pad_id)
         self._place = place
         self.metrics = metrics or MetricsRegistry()
+        # flight recorder: live engine state + last-N request timelines
+        # become part of every crash/SIGUSR1/admin dump (weak
+        # registration — the recorder never keeps an engine alive)
+        self._flight = trace_flight.get_recorder()
+        self._flight.add_source(type(self).__name__, self.flight_state)
         self.model_dir: Optional[str] = None  # set by from_saved
         self.executor = Executor(place or TPUPlace(0))
         self.prompt_buckets = sorted(set(
@@ -197,6 +276,9 @@ class GenerationEngine:
                 b *= 2
             nb.append(self.slots)
         self.prefill_batch_buckets = sorted(set(int(b) for b in nb))
+        # last-N completed request timelines — the flight recorder's
+        # per-engine "what was in flight when it fell over" ring
+        self._recent: "deque" = deque(maxlen=64)
         # slot table: index `slots` is the scrap slot (prefill padding)
         self._nslots = self.slots + 1
         self._slots: List[Optional[_Slot]] = [None] * self.slots
@@ -568,6 +650,9 @@ class GenerationEngine:
                              batch_bucket=bucket)
                 req.span.set_attrs(slot=slot, prompt_len=int(p.size))
             st = _Slot(req, p, max_new, eos)
+            st.timeline.chunk(t0, t1, int(p.size))
+            self.metrics.observe_hist("queue_wait",
+                                      st.timeline.queue_wait_s)
             self._slots[slot] = st
             self._tok[slot] = first[row]
             self._pos[slot] = p.size
@@ -577,6 +662,11 @@ class GenerationEngine:
 
     def _emit(self, slot: int, token: int) -> None:
         st = self._slots[slot]
+        delta = st.timeline.mark_token(time.monotonic())
+        if delta is None:  # first token: the TTFT sample
+            self.metrics.observe_hist("ttft", st.timeline.ttft_s)
+        else:              # every later token: one TPOT sample
+            self.metrics.observe_hist("tpot", delta)
         st.generated.append(token)
         if (len(st.generated) >= st.max_new
                 or (st.eos_id is not None and token == st.eos_id)):
@@ -588,6 +678,16 @@ class GenerationEngine:
         ids = np.concatenate([st.prompt,
                               np.asarray(st.generated, np.int64)])
         latency = time.monotonic() - st.request.enqueue_t
+        tl = st.timeline
+        if st.request.span is not None and tl.n_tokens > 1:
+            # decode residency as ONE span per request (token-level cost
+            # rides the timeline, not 1 span/token)
+            trace.record("serving/decode", tl.first_token_t,
+                         tl.last_token_t, parent=st.request.span,
+                         tokens=tl.n_tokens,
+                         tpot_ms=round((tl.tpot_s or 0.0) * 1e3, 3))
+        self._recent.append(dict(tl.to_dict(), status="ok",
+                                 latency_s=round(latency, 6)))
         st.request.future.set_result(ids)
         st.request.end_trace(status="ok",
                              tokens_generated=len(st.generated),
@@ -627,6 +727,32 @@ class GenerationEngine:
 
     def _gauges(self):
         self.metrics.set_gauge("active_slots", self.active)
+        # throttled time-series sampling: the flight bundle's metric
+        # ring sees occupancy/pages/prefix counters EVOLVE, not just
+        # their value at dump time
+        self._flight.maybe_sample(self.metrics)
+
+    def flight_state(self) -> dict:
+        """Live engine state for the flight recorder: per-slot decode
+        progress plus the last-N completed request timelines."""
+        slots = []
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            slots.append({
+                "slot": i,
+                "state": getattr(st, "state", "decode"),
+                "prompt_len": int(st.prompt.size),
+                "generated": len(st.generated),
+                "max_new": st.max_new,
+                "pos": int(self._pos[i]),
+            })
+        return {
+            "engine": type(self).__name__,
+            "slots_total": self.slots,
+            "slots": slots,
+            "recent_requests": list(self._recent),
+        }
 
     def cache_stats(self) -> dict:
         return self.executor.cache_stats()
@@ -755,8 +881,6 @@ class PagedGenerationEngine(GenerationEngine):
     # -- cache / program construction -----------------------------------
     def _init_cache(self):
         import jax.numpy as jnp
-
-        from collections import deque
 
         from .paging import PagePool, PrefixIndex
 
@@ -1128,6 +1252,8 @@ class PagedGenerationEngine(GenerationEngine):
         st.shared_tokens = shared
         st.cow_reserve = cow
         st.prefill_done = shared
+        st.timeline.prefix_hit_tokens = shared
+        self.metrics.observe_hist("queue_wait", st.timeline.queue_wait_s)
         self._slots[slot] = st
         if shared:
             self.metrics.inc("prefix_hits")
@@ -1196,6 +1322,7 @@ class PagedGenerationEngine(GenerationEngine):
                              phase="prefill", slot=slot,
                              prompt_len=int(st.prompt.size),
                              prompt_bucket=tc, batch_bucket=bucket)
+            st.timeline.chunk(t0, t1, rem[row])
             st.prefill_done = st.prompt.size
             st.state = "decode"
             self._tok[slot] = first[row]
@@ -1292,9 +1419,14 @@ class PagedGenerationEngine(GenerationEngine):
                             "serving.chunk_len": length,
                             "serving.block_table": table},
                 fetch_list=[nxt], scope=self.scope)
-        self.metrics.observe_latency(time.perf_counter() - t0,
-                                     name="prefill_chunk")
+        t1 = time.perf_counter()
+        self.metrics.observe_latency(t1 - t0, name="prefill_chunk")
         self.metrics.inc("prefill_chunks")
+        st.timeline.chunk(t0, t1, k)
+        if st.request.span is not None:
+            trace.record("serving/execute", t0, t1,
+                         parent=st.request.span, phase="prefill_chunk",
+                         slot=slot, start=start0, tokens=k)
         st.prefill_done = start0 + k
         if st.prefill_done >= plen:
             self.metrics.inc("prefills")
@@ -1360,6 +1492,14 @@ class PagedGenerationEngine(GenerationEngine):
         if self.prefix_index is not None:
             self.metrics.set_gauge("kv_prefix_entries",
                                    len(self.prefix_index))
+
+    def flight_state(self) -> dict:
+        state = super().flight_state()
+        state["pool"] = self.pool.stats()
+        state["deferred"] = len(self._deferred)
+        if self.prefix_index is not None:
+            state["prefix_index"] = self.prefix_index.stats()
+        return state
 
     def cache_stats(self) -> dict:
         """Compile-cache counters (base contract) plus the page pool and

@@ -9,10 +9,11 @@ training timers.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import defaultdict, deque
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 # Latency reservoir size: enough for stable p99 at demo scale without
 # unbounded growth under sustained traffic (oldest samples fall off).
@@ -20,12 +21,44 @@ _RESERVOIR = 4096
 # Sliding window for the QPS gauge.
 _QPS_WINDOW_S = 10.0
 
+#: Fixed log-spaced histogram bucket bounds (seconds): 100 µs .. 100 s,
+#: four buckets per decade (upper/lower ratio ~1.78, so any quantile read
+#: from the buckets is within ~33% of the true value). FIXED and shared
+#: by every registry on purpose: cross-replica aggregation then SUMS
+#: bucket counts, which — unlike merging per-replica quantile summaries —
+#: is mathematically exact, so fleet-level P99s are correct.
+HIST_BUCKET_BOUNDS: List[float] = [
+    round(1e-4 * 10 ** (k / 4.0), 10) for k in range(25)]
+
 
 def _quantile(sorted_vals, q):
     if not sorted_vals:
         return 0.0
     i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[i]
+
+
+def hist_quantile(counts: Sequence[int], q: float,
+                  bounds: Sequence[float] = None) -> float:
+    """Quantile (seconds) from per-bucket counts, linearly interpolated
+    inside the owning bucket. ``counts`` has ``len(bounds) + 1`` entries
+    (the last is the overflow bucket, read as its lower bound)."""
+    bounds = HIST_BUCKET_BOUNDS if bounds is None else bounds
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            frac = min(1.0, max(0.0, (target - cum) / c))
+            return lo + frac * (hi - lo)
+        cum += c
+    return bounds[-1]
 
 
 class MetricsRegistry:
@@ -37,6 +70,11 @@ class MetricsRegistry:
         self._gauges: Dict[str, float] = {}
         self._latencies: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=_RESERVOIR))
+        # cumulative fixed-bucket histograms (HIST_BUCKET_BOUNDS + one
+        # overflow bucket): never truncated, mergeable by summation —
+        # the fleet-correct twin of the bounded quantile reservoirs
+        self._hist: Dict[str, List[int]] = {}
+        self._hist_sum: Dict[str, float] = defaultdict(float)
         # total observations ever pushed per reservoir (reservoirs drop
         # old samples; this never decreases) + the publish high-water
         # mark, so publish_to_profiler is incremental across calls.
@@ -65,11 +103,26 @@ class MetricsRegistry:
         with self._lock:
             self._labeled[name][key] = float(value)
 
+    def _hist_observe_locked(self, name: str, seconds: float) -> None:
+        counts = self._hist.get(name)
+        if counts is None:
+            counts = self._hist[name] = [0] * (len(HIST_BUCKET_BOUNDS) + 1)
+        counts[bisect.bisect_left(HIST_BUCKET_BOUNDS, seconds)] += 1
+        self._hist_sum[name] += seconds
+
+    def observe_hist(self, name: str, seconds: float) -> None:
+        """Observe one duration into the fixed-bucket histogram plane
+        (TTFT / TPOT / queue-wait land here without joining the
+        ``request`` QPS window)."""
+        with self._lock:
+            self._hist_observe_locked(name, float(seconds))
+
     def observe_latency(self, seconds: float, name: str = "request") -> None:
         now = time.monotonic()
         with self._lock:
             self._latencies[name].append(float(seconds))
             self._observed[name] += 1
+            self._hist_observe_locked(name, float(seconds))
             if name == "request":
                 self._completions.append(now)
                 cutoff = now - _QPS_WINDOW_S
@@ -96,6 +149,19 @@ class MetricsRegistry:
                     "p95": _quantile(vals, 0.95) * 1e3,
                     "p99": _quantile(vals, 0.99) * 1e3,
                 }
+            hist = {}
+            for name, counts in self._hist.items():
+                n = sum(counts)
+                hist[name] = {
+                    "bounds_ms": [round(b * 1e3, 6)
+                                  for b in HIST_BUCKET_BOUNDS],
+                    "counts": list(counts),
+                    "count": n,
+                    "sum_ms": round(self._hist_sum[name] * 1e3, 6),
+                    "p50_ms": round(hist_quantile(counts, 0.50) * 1e3, 6),
+                    "p95_ms": round(hist_quantile(counts, 0.95) * 1e3, 6),
+                    "p99_ms": round(hist_quantile(counts, 0.99) * 1e3, 6),
+                }
             cutoff = now - _QPS_WINDOW_S
             qps_n = sum(1 for t in self._completions if t >= cutoff)
             labeled = {name: {"{" + ",".join(f'{k}="{v}"'
@@ -106,6 +172,7 @@ class MetricsRegistry:
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "latency": lat,
+                "hist": hist,
                 "qps": qps_n / min(max(now - self._t0, 1e-9), _QPS_WINDOW_S),
                 "uptime_s": now - self._t0,
             }
@@ -116,14 +183,19 @@ class MetricsRegistry:
     @staticmethod
     def merge(snapshots: Dict[str, dict]) -> dict:
         """Fleet-level aggregation over per-replica :meth:`snapshot`
-        payloads (keyed by replica name): counters sum, gauges and
-        latency quantiles keep a per-replica ``<replica>/<name>`` key
-        (quantiles cannot be merged exactly from summaries), qps sums.
-        The result has the same shape as :meth:`snapshot`, so it nests
-        into the fleet /metrics body verbatim."""
+        payloads (keyed by replica name): counters sum, histogram BUCKET
+        COUNTS sum (quantiles are then re-derived from the merged
+        buckets — the only statistically correct way to get a fleet P99;
+        averaging or overwriting per-replica quantile summaries is
+        provably wrong for replicas with different latency
+        distributions), gauges and per-replica latency summaries keep a
+        ``<replica>/<name>`` key, qps sums. The result has the same
+        shape as :meth:`snapshot`, so it nests into the fleet /metrics
+        body verbatim."""
         counters: Dict[str, int] = defaultdict(int)
         gauges: Dict[str, float] = {}
         latency: Dict[str, dict] = {}
+        hists: Dict[str, dict] = {}
         qps = 0.0
         uptime = 0.0
         for rname, snap in sorted(snapshots.items()):
@@ -135,11 +207,35 @@ class MetricsRegistry:
                 gauges[f"{rname}/{k}"] = v
             for k, v in (snap.get("latency") or {}).items():
                 latency[f"{rname}/{k}"] = v
+            for k, h in (snap.get("hist") or {}).items():
+                if not isinstance(h, dict) or "counts" not in h:
+                    continue
+                agg = hists.get(k)
+                if agg is None:
+                    hists[k] = {"bounds_ms": list(h.get("bounds_ms") or []),
+                                "counts": list(h["counts"]),
+                                "sum_ms": float(h.get("sum_ms") or 0.0)}
+                elif len(agg["counts"]) == len(h["counts"]) \
+                        and agg["bounds_ms"] == (h.get("bounds_ms") or []):
+                    agg["counts"] = [a + int(b) for a, b in
+                                     zip(agg["counts"], h["counts"])]
+                    agg["sum_ms"] += float(h.get("sum_ms") or 0.0)
+                else:  # incompatible bounds: keep it per-replica
+                    hists[f"{rname}/{k}"] = dict(h)
             qps += float(snap.get("qps") or 0.0)
             uptime = max(uptime, float(snap.get("uptime_s") or 0.0))
+        for k, h in hists.items():
+            counts = h["counts"]
+            bounds = [b / 1e3 for b in h["bounds_ms"]] or None
+            h["count"] = sum(counts)
+            h["sum_ms"] = round(h["sum_ms"], 6)
+            for q, key in ((0.50, "p50_ms"), (0.95, "p95_ms"),
+                           (0.99, "p99_ms")):
+                h[key] = round(
+                    hist_quantile(counts, q, bounds=bounds) * 1e3, 6)
         return {"counters": dict(counters), "gauges": gauges,
-                "latency": latency, "qps": qps, "uptime_s": uptime,
-                "replicas": sorted(snapshots.keys())}
+                "latency": latency, "hist": hists, "qps": qps,
+                "uptime_s": uptime, "replicas": sorted(snapshots.keys())}
 
     def publish_to_profiler(self, stat_set=None, prefix: str = "serving/"):
         """Push the latency reservoirs into a profiler StatSet (the global
@@ -172,12 +268,20 @@ class MetricsRegistry:
         return target
 
     def update_device_gauges(self) -> None:
-        """Refresh the device-memory gauge plane (jax live-bytes per
-        local device) — a no-op on backends without allocator stats."""
-        from ..trace import device_memory_stats
+        """Refresh the device-memory gauge plane: the legacy flat
+        ``mem/device<N>_*`` gauges plus a PROPERLY LABELED
+        ``device_memory_bytes{device=...,stat=...}`` series, so sharded
+        runs show per-device HBM in ``/metrics?format=prom`` — the
+        serving-side twin of ``analyze_memory(plan=...)``'s static
+        per-device estimate. No-op on backends reporting nothing."""
+        from ..trace import device_memory_stats, per_device_memory_stats
 
         for name, value in device_memory_stats().items():
             self.set_gauge("mem/" + name, value)
+        for dev, stats in per_device_memory_stats().items():
+            for stat, value in stats.items():
+                self.set_labeled("device_memory_bytes", value,
+                                 device=dev, stat=stat)
 
     def merge_timer_dict(self, timers: Optional[dict]) -> dict:
         """snapshot() + a profiler StatSet.as_dict() payload in one dict
@@ -229,6 +333,20 @@ class MetricsRegistry:
             lines.append(f"{metric}_sum "
                          f"{_prom_num(d['mean'] / 1e3 * d['count'])}")
             lines.append(f"{metric}_count {d['count']}")
+        for hname in sorted(snap.get("hist", {})):
+            h = snap["hist"][hname]
+            metric = f"{namespace}_{_prom_name(hname)}_seconds"
+            lines.append(f"# HELP {metric} {hname} fixed log-spaced "
+                         "bucket histogram (cumulative, mergeable)")
+            lines.append(f"# TYPE {metric} histogram")
+            cum = 0
+            for bound_ms, c in zip(h.get("bounds_ms", []), h["counts"]):
+                cum += c
+                lines.append(f'{metric}_bucket{{le="'
+                             f'{_prom_num(bound_ms / 1e3)}"}} {cum}')
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {h["count"]}')
+            lines.append(f"{metric}_sum {_prom_num(h['sum_ms'] / 1e3)}")
+            lines.append(f"{metric}_count {h['count']}")
         emit(f"{namespace}_qps", "gauge", [("", snap["qps"])],
              help_str="completions per second (sliding window)")
         emit(f"{namespace}_uptime_seconds", "gauge",

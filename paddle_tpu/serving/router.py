@@ -234,6 +234,11 @@ class Router:
             now = time.perf_counter()
             trace.record("fleet/breaker", now, now, replica=name,
                          from_state=old, to_state=new, reason=reason)
+            # breaker trips are exactly the events a 3am flight bundle
+            # needs — record them even when span tracing is off
+            trace.get_recorder().note("breaker", replica=name,
+                                      from_state=old, to_state=new,
+                                      reason=reason)
             if self.metrics is not None:
                 if new == CircuitBreaker.OPEN:
                     self.metrics.inc("breaker_opens")

@@ -34,7 +34,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .. import profiler
+from .. import profiler, trace
+from ..resilience.faults import TransientFault, active_plan
+from ..trace import flight as trace_flight
+from ..trace.slo import SLOTracker
 from .batcher import DynamicBatcher, Future
 from .errors import (BadRequestError, EngineClosedError, QueueFullError,
                      RequestTimeoutError, ServingError)
@@ -51,7 +54,7 @@ class Server:
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  default_timeout_ms: Optional[float] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 serve_retry=None, warmup=False):
+                 serve_retry=None, warmup=False, slo=None):
         self.engines = list(engine) if isinstance(
             engine, (list, tuple)) else [engine]
         self.metrics = metrics or self.engines[0].metrics
@@ -72,6 +75,14 @@ class Server:
         # router never sends traffic to a cold replica — the boot-side
         # mirror of the drain machinery.
         self._warmup = warmup
+        # declarative SLO (trace.SLO): evaluated from the TTFT/TPOT/
+        # request histograms on every metrics render; burn-rate gauges
+        # land on /metrics?format=prom
+        self.slo_tracker = (SLOTracker(slo) if slo is not None else None)
+        # flight recorder: dispatch-loop errors capture a bundle
+        # (throttled); /admin/flightdump serves it on demand
+        self.flight = trace_flight.get_recorder()
+        self._dispatch_step = 0
         self._thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._running = False
@@ -209,6 +220,12 @@ class Server:
             engine = self.engines[idx % len(self.engines)]
             idx += 1
             try:
+                plan = active_plan()
+                if plan is not None and plan.fire(
+                        "executor_error", self._dispatch_step) is not None:
+                    raise TransientFault(
+                        "injected executor_error (fault plan) in the "
+                        "serving dispatch loop")
                 if self._serve_retry is not None:
                     did = self._serve_retry.call(
                         engine.serve_step, self.batcher,
@@ -216,11 +233,17 @@ class Server:
                 else:
                     did = engine.serve_step(self.batcher,
                                             idle_wait_s=_IDLE_WAIT_S)
-            except Exception:
+            except Exception as exc:  # noqa: BLE001 - keep dispatching
                 # engine errors fail their requests individually; a crash
-                # here would silently stop dispatch — keep looping
+                # here would silently stop dispatch — keep looping, but
+                # FIRST capture the flight bundle: spans, metric history
+                # and engine state at the moment it fell over
                 self.metrics.inc("dispatch_errors")
+                self.flight.auto_dump("dispatch_error", error=exc)
                 did = False
+            else:
+                if did:
+                    self._dispatch_step += 1
             if not did and len(self.engines) > 1:
                 continue  # try the next replica before idling
 
@@ -253,17 +276,25 @@ class Server:
             if hasattr(eng, "cache_stats"):
                 snap[f"compile_cache/engine{i}"] = eng.cache_stats()
         snap["queue_depth"] = self.batcher.depth
+        if self.slo_tracker is not None:
+            snap["slo"] = self.slo_tracker.publish_gauges(
+                self.metrics, self.slo_tracker.status(snap))
         return snap
 
     def metrics_prometheus(self) -> str:
         """The /metrics?format=prom body: Prometheus text exposition of
-        the registry + serving timers + compile-cache/queue gauges."""
+        the registry + serving timers + compile-cache/queue gauges +
+        TTFT/TPOT histograms and SLO burn-rate gauges."""
         self.metrics.update_device_gauges()
         self.metrics.set_gauge("queue_depth", self.batcher.depth)
         for i, eng in enumerate(self.engines):
             if hasattr(eng, "cache_stats"):
                 for k, v in eng.cache_stats().items():
                     self.metrics.set_gauge(f"compile_cache/e{i}_{k}", v)
+        if self.slo_tracker is not None:
+            self.slo_tracker.publish_gauges(
+                self.metrics,
+                self.slo_tracker.status(self.metrics.snapshot()))
         return self.metrics.prometheus_text(
             timers=profiler.global_stat.as_dict(prefix="serving/"))
 
@@ -281,6 +312,10 @@ class Server:
         MetricsRegistry. Without it, one dead client per thread is a
         slow-loris outage."""
         server = self
+        # operator poke: SIGUSR1 dumps a flight bundle (written to
+        # $PADDLE_TPU_FLIGHT_DIR when set; in-memory last_bundle
+        # always). Best-effort — a no-op off the main thread.
+        trace_flight.install_signal_handler(recorder=self.flight)
 
         class Handler(BaseHTTPRequestHandler):
             timeout = socket_timeout_s  # socketserver: settimeout per conn
@@ -319,6 +354,9 @@ class Server:
                         self.wfile.write(body)
                         return
                     self._send(200, server.metrics_snapshot())
+                elif path == "/admin/flightdump":
+                    # GET = read-only: assemble and return the bundle
+                    self._send(200, server.flight.bundle("admin"))
                 elif path == "/healthz":
                     # ready -> 200; warming/draining/closed -> 503 so load
                     # balancers route neither to a cold replica still
@@ -359,6 +397,13 @@ class Server:
                     self._send(400, {"error": f"bad JSON: {exc}"})
                     return
                 try:
+                    # resume the caller's trace across the HTTP hop: the
+                    # request's queue/prefill/decode spans join the
+                    # router's trace id instead of starting a fresh one
+                    tmeta = {}
+                    tp = self.headers.get("traceparent")
+                    if tp:
+                        tmeta["traceparent"] = tp
                     if self.path.startswith("/admin/"):
                         self._admin(req)
                     elif self.path == "/v1/generate":
@@ -366,14 +411,15 @@ class Server:
                             {"prompt": req["prompt"]},
                             timeout_ms=req.get("timeout_ms"),
                             max_new_tokens=req.get("max_new_tokens"),
-                            eos_id=req.get("eos_id"))
+                            eos_id=req.get("eos_id"), **tmeta)
                         ids = fut.result(timeout=req.get("timeout_s", 60))
                         self._send(200, {"ids": np.asarray(ids).tolist()})
                     elif self.path == "/v1/infer":
                         inputs = {k: np.asarray(v)
                                   for k, v in req["inputs"].items()}
                         fut = server.submit(inputs,
-                                            timeout_ms=req.get("timeout_ms"))
+                                            timeout_ms=req.get("timeout_ms"),
+                                            **tmeta)
                         outs = fut.result(timeout=req.get("timeout_s", 60))
                         self._send(200, {"outputs": [
                             np.asarray(o).tolist() for o in outs]})
@@ -414,6 +460,29 @@ class Server:
                         if warm is not None:
                             warmed += warm() or 0
                     self._send(200, {"ok": True, "warmed": warmed})
+                elif self.path == "/admin/flightdump":
+                    # POST {"path": ...} writes the bundle to disk on
+                    # the SERVER box and returns where; without a path
+                    # it returns the bundle itself (the GET twin)
+                    if req.get("path"):
+                        written = server.flight.dump(
+                            req.get("reason", "admin"),
+                            path=req["path"])
+                        self._send(200, {"ok": written is not None,
+                                         "path": written})
+                    else:
+                        self._send(200, server.flight.bundle(
+                            req.get("reason", "admin")))
+                elif self.path == "/admin/trace_export":
+                    # write this process's span journal (JSONL) so a
+                    # fleet operator can stitch replica traces with
+                    # tools/trace_summary.py --distributed
+                    from ..trace import export_jsonl
+
+                    n = export_jsonl(req["path"],
+                                     drain=req.get("drain", False))
+                    self._send(200, {"ok": True, "spans": n,
+                                     "path": req["path"]})
                 else:
                     self._send(404, {"error": "not found"})
 

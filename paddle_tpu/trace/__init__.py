@@ -17,18 +17,26 @@ Quick start::
 
 Summarize offline with ``python tools/trace_summary.py trace.json``.
 """
-from .tracer import (DEFAULT_CAPACITY, Span, Tracer, active_level,
-                     current_span, disable, enable, enabled, get_tracer,
-                     record, span, start_span)
-from .export import (export_chrome_trace, export_jsonl, load_trace_events,
-                     spans_to_chrome_events)
+from .tracer import (DEFAULT_CAPACITY, Span, SpanContext, Tracer,
+                     active_level, current_span, disable, enable, enabled,
+                     extract, get_tracer, inject, record, span, start_span)
+from .export import (export_chrome_trace, export_jsonl, load_jsonl_spans,
+                     load_trace_events, spans_to_chrome_events)
 from .runlog import RunLog
-from .device import device_memory_stats, live_bytes
+from .device import (device_memory_stats, live_bytes,
+                     per_device_memory_stats)
+from .slo import SLO, SLOTracker
+from .flight import (FlightRecorder, get_recorder,
+                     install_signal_handler)
 
 __all__ = [
-    "DEFAULT_CAPACITY", "Span", "Tracer", "RunLog",
+    "DEFAULT_CAPACITY", "Span", "SpanContext", "Tracer", "RunLog",
     "active_level", "current_span", "disable", "enable", "enabled",
-    "get_tracer", "record", "span", "start_span",
-    "export_chrome_trace", "export_jsonl", "load_trace_events",
+    "extract", "get_tracer", "inject", "record", "span", "start_span",
+    "export_chrome_trace", "export_jsonl", "load_jsonl_spans",
+    "load_trace_events",
     "spans_to_chrome_events", "device_memory_stats", "live_bytes",
+    "per_device_memory_stats",
+    "SLO", "SLOTracker",
+    "FlightRecorder", "get_recorder", "install_signal_handler",
 ]

@@ -10,6 +10,57 @@ from __future__ import annotations
 from typing import Dict
 
 
+def per_device_memory_stats() -> Dict[str, Dict[str, float]]:
+    """Nested stats keyed by device id: allocator gauges when the
+    backend reports them (TPU), else live-array bytes grouped by the
+    device each array resides on — so sharded runs show PER-DEVICE HBM
+    instead of one global number. Keys are string device ids ("0", "1",
+    ...); values map stat name -> bytes."""
+    out: Dict[str, Dict[str, float]] = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # backend not initializable here
+        return out
+    have_alloc_stats = False
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        row = {}
+        for stat in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            if stat in ms:
+                row[stat] = float(ms[stat])
+        if row:
+            have_alloc_stats = True
+            out[str(d.id)] = row
+    if have_alloc_stats:
+        return out
+    # CPU fallback: attribute jax.live_arrays() to their devices — the
+    # serving-side twin of analyze_memory(plan=...)'s static per-device
+    # estimate, measured instead of predicted
+    try:
+        import jax
+
+        for a in jax.live_arrays():
+            try:
+                devs = list(a.devices())
+            except Exception:
+                continue
+            if not devs:
+                continue
+            nbytes = float(a.size * a.dtype.itemsize) / len(devs)
+            for d in devs:
+                row = out.setdefault(str(d.id), {"live_bytes": 0.0})
+                row["live_bytes"] = row.get("live_bytes", 0.0) + nbytes
+    except Exception:
+        pass
+    return out
+
+
 def device_memory_stats() -> Dict[str, float]:
     """Flat gauge dict keyed ``device<N>_<stat>`` (bytes): live bytes,
     limit and peak per local device, when the backend reports them."""

@@ -96,6 +96,43 @@ def export_jsonl(path_or_file: Union[str, IO],
     return _write(path_or_file)
 
 
+def load_jsonl_spans(path: str) -> List[dict]:
+    """Read a JSONL journal back as span rows with ABSOLUTE wall-clock
+    times (the header's ``epoch_unix`` plus each span's relative
+    seconds) and the source file tagged — the unit
+    ``tools/trace_summary.py --distributed`` stitches across processes
+    by trace id."""
+    import os as _os
+
+    epoch = 0.0
+    rows: List[dict] = []
+    src = _os.path.basename(path)
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = row.get("type")
+            if kind == "trace_header":
+                epoch = float(row.get("epoch_unix") or 0.0)
+            elif kind == "span" and row.get("end_s") is not None:
+                rows.append({
+                    "name": row.get("name", "?"),
+                    "trace_id": row.get("trace_id") or 0,
+                    "span_id": row.get("span_id"),
+                    "parent_id": row.get("parent_id"),
+                    "start": epoch + float(row["start_s"]),
+                    "end": epoch + float(row["end_s"]),
+                    "attrs": row.get("attrs") or {},
+                    "source": src,
+                })
+    return rows
+
+
 def load_trace_events(path: str) -> List[dict]:
     """Read either export format back into a flat list of event dicts
     with ``name``/``ts``/``dur``(us)/``args`` keys — the

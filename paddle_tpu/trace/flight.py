@@ -1,0 +1,242 @@
+"""Crash-safe flight recorder: a bounded, always-on black box.
+
+Production postmortems die on "it fell over at 3am and the logs rotated".
+The :class:`FlightRecorder` keeps three bounded rings that cost almost
+nothing while everything is healthy:
+
+- **events** — notable moments (completed requests, dispatch errors,
+  breaker trips) appended via :meth:`note`;
+- **metric snapshots** — time-series samples of a
+  :class:`~paddle_tpu.serving.metrics.MetricsRegistry` (pages in use,
+  prefix-hit tokens, COW copies, deferred admissions — gauges that were
+  only ever point-in-time) via the throttled :meth:`maybe_sample`;
+- **sources** — live state callbacks (engine slot tables, pool stats,
+  last-N request timelines) registered weakly via :meth:`add_source`, so
+  a dump captures the state AT the moment of failure.
+
+:meth:`bundle` assembles those rings plus the global tracer's recent
+spans into one JSON document; :meth:`dump` writes it to disk. Dumps are
+produced automatically by the serving dispatch loop on an unhandled
+executor/serving error (throttled), on ``SIGUSR1``
+(:func:`install_signal_handler`), and on demand via the servers'
+``/admin/flightdump`` endpoint — "attach the flight bundle" replaces
+"try to reproduce it".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, Optional
+
+#: auto-dump throttle: one error-triggered dump per window, so a
+#: crash-looping dispatch thread records the FIRST failure instead of
+#: grinding the disk with thousands of identical bundles
+DEFAULT_MIN_DUMP_INTERVAL_S = 30.0
+
+
+class FlightRecorder:
+    """Bounded always-on recorder; one process-global instance
+    (:func:`get_recorder`) serves the stack, tests build private ones."""
+
+    def __init__(self, events: int = 512, snapshots: int = 256,
+                 spans: int = 2048,
+                 min_dump_interval_s: float = DEFAULT_MIN_DUMP_INTERVAL_S):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=int(events))
+        self._snapshots: deque = deque(maxlen=int(snapshots))
+        self._span_tail = int(spans)
+        self._sources: Dict[str, object] = {}
+        self._source_ids = 0
+        self._min_dump_interval_s = float(min_dump_interval_s)
+        self._last_auto_dump = 0.0
+        self._last_sample: Dict[int, float] = {}
+        self.last_bundle: Optional[dict] = None
+        self.dumps = 0
+
+    # -- write side --------------------------------------------------------
+    def note(self, kind: str, **data) -> None:
+        """Append one event to the ring (cheap: a dict + deque append)."""
+        if not self.enabled:
+            return
+        row = {"t_unix": time.time(), "kind": kind}
+        row.update(data)
+        with self._lock:
+            self._events.append(row)
+
+    def maybe_sample(self, registry, tag: str = "serving",
+                     min_interval_s: float = 0.5) -> bool:
+        """Sample a MetricsRegistry snapshot into the time-series ring,
+        at most once per ``min_interval_s`` per registry — called from
+        the engine tick loop, so gauges that were only ever
+        point-in-time (pages in use, prefix hits, COW copies, deferred
+        admissions) become a bounded history."""
+        if not self.enabled:
+            return False
+        key = id(registry)
+        now = time.monotonic()
+        last = self._last_sample.get(key, 0.0)
+        if now - last < min_interval_s:
+            return False
+        self._last_sample[key] = now
+        snap = registry.snapshot()
+        with self._lock:
+            self._snapshots.append({
+                "t_unix": time.time(), "tag": tag,
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+                "qps": snap.get("qps"),
+            })
+        return True
+
+    def add_source(self, name: str, fn: Callable[[], dict],
+                   weak: bool = True) -> str:
+        """Register a live-state callback captured at dump time. Bound
+        methods are held via ``weakref.WeakMethod`` by default so a
+        registered engine can still be garbage collected; dead sources
+        are pruned silently. Returns the (uniquified) source name."""
+        with self._lock:
+            self._source_ids += 1
+            key = f"{name}#{self._source_ids}"
+            if weak:
+                try:
+                    fn = weakref.WeakMethod(fn)  # type: ignore[assignment]
+                except TypeError:
+                    pass  # plain function: hold it strongly
+            self._sources[key] = fn
+        return key
+
+    def remove_source(self, key: str) -> None:
+        with self._lock:
+            self._sources.pop(key, None)
+
+    # -- read side ---------------------------------------------------------
+    def bundle(self, reason: str, error: Optional[BaseException] = None,
+               tracer=None) -> dict:
+        """Assemble the flight bundle: recent spans (tail of the global
+        tracer ring), the event + metric-snapshot rings, and every live
+        source's state. Source failures are captured, never raised — a
+        recorder must not crash the thing it is recording."""
+        from .tracer import get_tracer
+
+        tracer = tracer or get_tracer()
+        spans = tracer.spans()[-self._span_tail:]
+        with self._lock:
+            events = list(self._events)
+            snapshots = list(self._snapshots)
+            sources = dict(self._sources)
+        state = {}
+        dead = []
+        for key, fn in sources.items():
+            target = fn() if isinstance(fn, weakref.WeakMethod) else fn
+            if target is None:
+                dead.append(key)
+                continue
+            try:
+                state[key] = target()
+            except Exception as exc:  # noqa: BLE001 - never crash a dump
+                state[key] = {"error": repr(exc)[:200]}
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._sources.pop(key, None)
+        doc = {
+            "reason": reason,
+            "t_unix": time.time(),
+            "pid": os.getpid(),
+            "error": repr(error)[:500] if error is not None else None,
+            "trace": {
+                "epoch_unix": tracer.epoch_unix,
+                "level": tracer.level,
+                "spans": [sp.to_dict() for sp in spans
+                          if sp.end is not None],
+            },
+            "events": events,
+            "metric_snapshots": snapshots,
+            "state": state,
+        }
+        self.last_bundle = doc
+        return doc
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             error: Optional[BaseException] = None,
+             tracer=None) -> Optional[str]:
+        """Write a bundle to ``path`` (default:
+        ``$PADDLE_TPU_FLIGHT_DIR/flight-<pid>-<reason>-<n>.json``, or
+        the in-memory ``last_bundle`` only when no directory is
+        configured). Returns the written path, or None."""
+        doc = self.bundle(reason, error=error, tracer=tracer)
+        self.dumps += 1
+        if path is None:
+            dirname = os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+            if not dirname:
+                return None
+            os.makedirs(dirname, exist_ok=True)
+            path = os.path.join(
+                dirname, f"flight-{os.getpid()}-"
+                f"{''.join(c if c.isalnum() else '_' for c in reason)}"
+                f"-{self.dumps}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            return None
+        return path
+
+    def auto_dump(self, reason: str,
+                  error: Optional[BaseException] = None) -> Optional[str]:
+        """The error-path entry point: throttled (one per
+        ``min_dump_interval_s``) so a crash loop records its first
+        failure instead of flooding. Always refreshes ``last_bundle``;
+        writes a file only when a flight dir is configured."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        if now - self._last_auto_dump < self._min_dump_interval_s:
+            return None
+        self._last_auto_dump = now
+        self.note("auto_dump", reason=reason,
+                  error=repr(error)[:200] if error else None)
+        return self.dump(reason, error=error)
+
+
+# ---------------------------------------------------------------------------
+# process-global recorder + SIGUSR1
+# ---------------------------------------------------------------------------
+_global_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _global_recorder
+
+
+def install_signal_handler(dirname: Optional[str] = None,
+                           recorder: Optional[FlightRecorder] = None,
+                           signum: Optional[int] = None) -> bool:
+    """Dump a flight bundle on ``SIGUSR1`` — the operator's "tell me
+    what you are doing RIGHT NOW" poke for a live process. Returns False
+    (instead of raising) off the main thread or on platforms without the
+    signal, so servers can call it unconditionally."""
+    import signal as signal_mod
+
+    recorder = recorder or _global_recorder
+    signum = signum if signum is not None \
+        else getattr(signal_mod, "SIGUSR1", None)
+    if signum is None:
+        return False
+    if dirname:
+        os.environ.setdefault("PADDLE_TPU_FLIGHT_DIR", dirname)
+
+    def _handler(sig, frame):
+        recorder.note("signal", signum=int(sig))
+        recorder.dump("sigusr1")
+
+    try:
+        signal_mod.signal(signum, _handler)
+    except ValueError:  # not the main thread
+        return False
+    return True

@@ -1,0 +1,221 @@
+"""Declarative serving SLOs with multi-window burn-rate alerting.
+
+An :class:`SLO` states the objectives a serving fleet is operated
+against — "99% of requests see their first token within ``ttft_ms``,
+per-token decode latency under ``tpot_ms``, availability at least
+``availability``" — and an :class:`SLOTracker` evaluates them
+continuously from the :class:`~paddle_tpu.serving.metrics.MetricsRegistry`
+histogram/counter plane (the fixed-bucket TTFT/TPOT histograms make the
+attainment fraction exact up to bucket resolution, and — because bucket
+counts merge by summation — the SAME evaluation is correct fleet-wide).
+
+Alerting follows the SRE-workbook multi-window burn-rate recipe: the
+error-budget burn rate (bad fraction divided by the budget fraction
+``1 - target``) is computed over a short and a long sliding window; an
+objective *alerts* only when BOTH windows burn above their thresholds —
+the short window makes the alert fast, the long window keeps a brief
+blip from paging. ``burn == 1`` means "spending exactly the budget";
+``budget_remaining`` is the fraction of the lifetime error budget left.
+
+    slo = SLO(ttft_ms=250.0, tpot_ms=50.0, availability=0.999)
+    tracker = SLOTracker(slo)
+    tracker.sample(registry.snapshot())   # each /metrics scrape
+    tracker.status()                      # attainment / burn / alerts
+
+Surfaced on ``/metrics`` (labeled gauges), ``/fleet/status`` (the
+``slo`` key), and rendered by ``tools/fleetctl.py status``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """A serving service-level objective set. Latency objectives
+    (``ttft_ms`` / ``tpot_ms``) are met when at least ``target`` of the
+    observations fall under the threshold; ``availability`` is its own
+    target (completed / (completed + failed)). Unset objectives are
+    simply not evaluated."""
+
+    ttft_ms: Optional[float] = None
+    tpot_ms: Optional[float] = None
+    availability: Optional[float] = None
+    target: float = 0.99
+    #: (short, long) sliding burn-rate windows, seconds
+    windows_s: Tuple[float, float] = (60.0, 300.0)
+    #: burn-rate thresholds per window (both must exceed to alert)
+    burn_thresholds: Tuple[float, float] = (14.4, 6.0)
+    name: str = "serving"
+
+    def objectives(self) -> Dict[str, dict]:
+        out = {}
+        if self.ttft_ms is not None:
+            out["ttft"] = {"kind": "hist", "metric": "ttft",
+                           "threshold_ms": float(self.ttft_ms),
+                           "target": self.target}
+        if self.tpot_ms is not None:
+            out["tpot"] = {"kind": "hist", "metric": "tpot",
+                           "threshold_ms": float(self.tpot_ms),
+                           "target": self.target}
+        if self.availability is not None:
+            out["availability"] = {"kind": "counter",
+                                   "target": float(self.availability)}
+        return out
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ttft_ms": self.ttft_ms,
+                "tpot_ms": self.tpot_ms,
+                "availability": self.availability, "target": self.target,
+                "windows_s": list(self.windows_s),
+                "burn_thresholds": list(self.burn_thresholds)}
+
+
+def _hist_good_total(hist: Optional[dict],
+                     threshold_ms: float) -> Tuple[int, int]:
+    """(observations under threshold, total) from a snapshot histogram.
+    The threshold is resolved to the smallest bucket bound >= it, so the
+    answer is deterministic and, with thresholds chosen on (or near)
+    bucket bounds, exact."""
+    if not hist or not hist.get("counts"):
+        return 0, 0
+    bounds = hist.get("bounds_ms") or []
+    counts = hist["counts"]
+    good = 0.0
+    for i, (bound, c) in enumerate(zip(bounds, counts)):
+        if bound > threshold_ms * (1 + 1e-9):
+            # partial credit for the straddling bucket keeps attainment
+            # monotonic in the threshold even off bucket bounds
+            prev = bounds[i - 1] if i > 0 else 0.0
+            if threshold_ms > prev:
+                good += c * (threshold_ms - prev) / (bound - prev)
+            break
+        good += c
+    total = sum(counts)
+    return int(round(good)), total
+
+
+class SLOTracker:
+    """Evaluates an :class:`SLO` over time from metrics snapshots.
+
+    ``sample()`` appends cumulative (good, total) checkpoints per
+    objective; ``status()`` differences them against the checkpoint
+    nearest each window edge to get windowed burn rates. Sampling is
+    driven by whoever scrapes metrics (every ``/metrics`` or
+    ``/fleet/status`` render) — there is no thread of its own.
+    """
+
+    def __init__(self, slo: SLO, clock=time.monotonic,
+                 max_samples: int = 4096):
+        self.slo = slo
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=max_samples)
+
+    def _extract(self, snapshot: dict) -> Dict[str, Tuple[int, int]]:
+        out = {}
+        hists = snapshot.get("hist") or {}
+        counters = snapshot.get("counters") or {}
+        for name, obj in self.slo.objectives().items():
+            if obj["kind"] == "hist":
+                out[name] = _hist_good_total(hists.get(obj["metric"]),
+                                             obj["threshold_ms"])
+            else:
+                good = int(counters.get("completed", 0))
+                out[name] = (good, good + int(counters.get("failed", 0)))
+        return out
+
+    def sample(self, snapshot: dict) -> None:
+        """Checkpoint cumulative good/total per objective from a
+        :meth:`MetricsRegistry.snapshot` (or fleet-merged) payload."""
+        row = self._extract(snapshot)
+        with self._lock:
+            self._samples.append((self._clock(), row))
+
+    def _window_rates(self, name: str, target: float,
+                      now: float) -> Dict[str, dict]:
+        """Per-window burn rates by differencing cumulative counts
+        against the newest sample at or before the window edge."""
+        newest_t, newest = self._samples[-1]
+        g1, t1 = newest.get(name, (0, 0))
+        out = {}
+        budget = max(1e-9, 1.0 - target)
+        for win in self.slo.windows_s:
+            edge = now - win
+            g0, t0 = 0, 0
+            for ts, row in self._samples:
+                if ts > edge:
+                    break
+                g0, t0 = row.get(name, (0, 0))
+            good, total = g1 - g0, t1 - t0
+            bad_frac = ((total - good) / total) if total > 0 else 0.0
+            out[f"{int(win)}s"] = {
+                "total": total,
+                "bad_fraction": round(bad_frac, 6),
+                "burn_rate": round(bad_frac / budget, 4),
+            }
+        return out
+
+    def status(self, snapshot: Optional[dict] = None) -> dict:
+        """Evaluate every objective: overall attainment, lifetime error
+        budget remaining, windowed burn rates, and the multi-window
+        alert verdict. Pass a fresh ``snapshot`` to sample-and-evaluate
+        in one call (what the HTTP endpoints do)."""
+        if snapshot is not None:
+            self.sample(snapshot)
+        now = self._clock()
+        objectives = {}
+        alerting = False
+        with self._lock:
+            have = len(self._samples) > 0
+            for name, obj in self.slo.objectives().items():
+                target = obj["target"]
+                good, total = (self._samples[-1][1].get(name, (0, 0))
+                               if have else (0, 0))
+                attainment = (good / total) if total > 0 else 1.0
+                budget = max(1e-9, 1.0 - target)
+                consumed = (1.0 - attainment) / budget
+                windows = (self._window_rates(name, target, now)
+                           if have else {})
+                burns = [w["burn_rate"] for w in windows.values()]
+                obj_alert = (len(burns) == len(self.slo.burn_thresholds)
+                             and all(b > thr for b, thr in
+                                     zip(burns, self.slo.burn_thresholds)))
+                alerting = alerting or obj_alert
+                objectives[name] = {
+                    "target": target,
+                    "threshold_ms": obj.get("threshold_ms"),
+                    "total": total,
+                    "attainment": round(attainment, 6),
+                    "error_budget_remaining": round(1.0 - consumed, 4),
+                    "burn": windows,
+                    "alerting": obj_alert,
+                }
+        return {"slo": self.slo.to_dict(), "objectives": objectives,
+                "alerting": alerting}
+
+    def publish_gauges(self, registry, status: Optional[dict] = None) -> dict:
+        """Export the evaluation as labeled gauges on a MetricsRegistry
+        (``slo_attainment{objective=...}``,
+        ``slo_error_budget_remaining{...}``,
+        ``slo_burn_rate{objective=...,window=...}``,
+        ``slo_alerting{...}``) so ``/metrics?format=prom`` carries the
+        whole SLO plane. Returns the status dict it published."""
+        st = status or self.status()
+        for name, obj in st["objectives"].items():
+            registry.set_labeled("slo_attainment", obj["attainment"],
+                                 objective=name)
+            registry.set_labeled("slo_error_budget_remaining",
+                                 obj["error_budget_remaining"],
+                                 objective=name)
+            registry.set_labeled("slo_alerting",
+                                 1.0 if obj["alerting"] else 0.0,
+                                 objective=name)
+            for win, w in obj["burn"].items():
+                registry.set_labeled("slo_burn_rate", w["burn_rate"],
+                                     objective=name, window=win)
+        return st

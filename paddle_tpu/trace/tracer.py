@@ -26,11 +26,22 @@ Levels (the ``--trace_level`` flag / ``trace.enable(level=...)``):
   2  per-op debug: ``Executor.run`` additionally switches to the
      interpret-mode path (op-by-op host dispatch with per-op spans,
      output stats, and located NaN/Inf diagnosis).
+
+Cross-process context: ``Tracer.inject()`` renders the current (or a
+given) span as a W3C ``traceparent`` header value and
+``Tracer.extract()`` parses one back into a :class:`SpanContext` usable
+as ``parent=`` — the seam the serving fleet uses to carry ONE trace id
+across router attempt -> HTTP hop -> remote replica. Trace ids are
+128-bit random (globally unique without coordination, never a
+per-process counter) and span ids carry a per-process salt, so journals
+from N processes stitch without collisions
+(``tools/trace_summary.py --distributed``).
 """
 from __future__ import annotations
 
 import contextlib
 import itertools
+import secrets
 import threading
 import time
 from collections import deque
@@ -39,6 +50,28 @@ from typing import Dict, Iterator, List, Optional
 # Default ring-buffer capacity: generous for a debug session, bounded for
 # a long-lived traced server (at ~200 B/span this is ~3 MB).
 DEFAULT_CAPACITY = 16384
+
+
+def _new_trace_id() -> int:
+    """Globally-unique 128-bit trace id (W3C forbids all-zero)."""
+    return secrets.randbits(128) | 1
+
+
+class SpanContext:
+    """A span reference without the span — what ``extract()`` returns
+    for a parent living in ANOTHER process. Carries exactly the two
+    fields ``start_span(parent=...)``/``record(parent=...)`` read, so a
+    remote parent and a local one are interchangeable."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+
+    def __repr__(self):
+        return (f"SpanContext(trace={self.trace_id:032x}, "
+                f"span={self.span_id:016x})")
 
 
 class Span:
@@ -110,8 +143,11 @@ class Tracer:
         self.sample_rate = float(sample_rate)
         self._buf: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
+        # span ids: per-process random salt in the high bits + a counter
+        # in the low 33, so ids from different processes never collide
+        # when their journals are stitched by trace id
+        self._span_salt = secrets.randbits(30) << 33
         self._ids = itertools.count(1)
-        self._trace_ids = itertools.count(1)
         self._local = threading.local()
         self._sample_acc = 0.0
         self._epoch = time.perf_counter()
@@ -187,8 +223,8 @@ class Tracer:
                 self._stack().append(None)  # suppress the subtree
             return None
         trace_id = parent.trace_id if parent is not None \
-            else next(self._trace_ids)
-        sp = Span(name, next(self._ids),
+            else _new_trace_id()
+        sp = Span(name, self._span_salt | next(self._ids),
                   parent.span_id if parent is not None else None,
                   trace_id, self._now(), threading.get_ident(), self)
         if attrs:
@@ -235,8 +271,8 @@ class Tracer:
         if not self.enabled:
             return None
         trace_id = parent.trace_id if parent is not None \
-            else next(self._trace_ids)
-        sp = Span(name, next(self._ids),
+            else _new_trace_id()
+        sp = Span(name, self._span_salt | next(self._ids),
                   parent.span_id if parent is not None else None,
                   trace_id, start - self._epoch,
                   threading.get_ident(), self)
@@ -245,6 +281,48 @@ class Tracer:
         with self._lock:
             self._buf.append(sp)
         return sp
+
+    # -- cross-process context (W3C trace context) ------------------------
+    def inject(self, span: Optional[Span] = None) -> Optional[str]:
+        """Render ``span`` (default: this thread's current span) as a
+        W3C ``traceparent`` header value, e.g.
+        ``00-<32-hex trace id>-<16-hex span id>-01``. Returns None when
+        tracing is off or there is no span to propagate — callers simply
+        omit the header then."""
+        sp = span if span is not None else self.current_span()
+        if sp is None:
+            return None
+        return (f"00-{sp.trace_id & ((1 << 128) - 1):032x}"
+                f"-{sp.span_id & ((1 << 64) - 1):016x}-01")
+
+    @staticmethod
+    def extract(header: Optional[str]) -> Optional[SpanContext]:
+        """Parse a ``traceparent`` header into a :class:`SpanContext`
+        usable as ``parent=``. An absent, malformed, all-zero, or
+        explicitly-unsampled header yields None (start a fresh local
+        trace) — this NEVER raises: a bad header from an arbitrary
+        client must not fail the request carrying it."""
+        if not header or not isinstance(header, str):
+            return None
+        parts = header.strip().split("-")
+        if len(parts) < 4:
+            return None
+        ver, tid, sid, flags = parts[0], parts[1], parts[2], parts[3]
+        if len(ver) != 2 or len(tid) != 32 or len(sid) != 16 \
+                or len(flags) < 2:
+            return None
+        try:
+            ver_i = int(ver, 16)
+            tid_i = int(tid, 16)
+            sid_i = int(sid, 16)
+            flags_i = int(flags[:2], 16)
+        except ValueError:
+            return None
+        if ver_i == 0xFF or tid_i == 0 or sid_i == 0:
+            return None
+        if not flags_i & 0x01:  # upstream sampled it out: fresh trace
+            return None
+        return SpanContext(tid_i, sid_i)
 
     # -- read side ---------------------------------------------------------
     def spans(self) -> List[Span]:
@@ -327,3 +405,15 @@ def record(name: str, start: float, end: float,
 
 def current_span() -> Optional[Span]:
     return _global_tracer.current_span()
+
+
+def inject(span: Optional[Span] = None) -> Optional[str]:
+    """``traceparent`` header for ``span`` (default: the current span)
+    against the global tracer; None when there is nothing to carry."""
+    return _global_tracer.inject(span)
+
+
+def extract(header: Optional[str]) -> Optional[SpanContext]:
+    """Parse a ``traceparent`` header into a parent handle (or None) —
+    never raises on malformed input."""
+    return Tracer.extract(header)

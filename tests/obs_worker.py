@@ -1,0 +1,54 @@
+"""Subprocess worker for the distributed-tracing fleet tests: one remote
+replica — a tiny paged GenerationEngine behind ``Server.serve_http`` with
+level-1 tracing on — whose span journal the parent fetches via
+``/admin/trace_export`` and stitches with its own using
+``tools/trace_summary.py --distributed``.
+
+Prints the bound HTTP port on stdout, then serves until stdin closes.
+``--slow-ms`` pads the batcher wait so the parent's hedge reliably fires
+while this replica is still working (the deterministic "slow remote").
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slow-ms", type=float, default=150.0)
+    ap.add_argument("--vocab", type=int, default=32)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models, trace
+    from paddle_tpu.serving import GenerationEngine, LMSpec, Server
+
+    trace.enable(level=1)
+    vocab, d, n_layers, heads, maxlen = args.vocab, 16, 2, 2, 64
+    scope = pt.Scope()
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        p = layers.data("p_init", shape=[8], dtype="int64")
+        models.transformer_lm_generate(
+            p, vocab_size=vocab, d_model=d, n_layers=n_layers,
+            num_heads=heads, max_len=maxlen, max_new_tokens=1)
+    startup.random_seed = 7
+    pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+    spec = LMSpec(vocab_size=vocab, d_model=d, n_layers=n_layers,
+                  num_heads=heads, max_len=maxlen)
+    eng = GenerationEngine(spec, scope, slots=2, page_size=8,
+                           prompt_buckets=(4, 8, 16))
+    srv = Server(eng, max_wait_ms=args.slow_ms)
+    srv.start()
+    port = srv.serve_http()
+    print(port, flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,7 +49,7 @@ def test_lstm_varlen_bench_path_runs():
     assert res["max_len"] <= 12
 
 
-@pytest.mark.slow  # tier-1 budget: heaviest bench path; transpiler path stays tier-1
+@pytest.mark.slow  # tier-1 budget: heaviest bench path
 def test_inference_bench_path_runs():
     import jax
 
@@ -180,6 +180,29 @@ def test_trace_overhead_bench_path_runs():
     assert not trace.enabled()
 
 
+def test_obs_overhead_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models, trace
+
+    res = _bench().bench_obs_overhead(jax, pt, layers, models, d=16,
+                                      L=2, H=2, tmax=64, slots=4,
+                                      page_size=8, n_requests=6,
+                                      max_new=4, rounds=1)
+    assert res["baseline_ms_per_token"] > 0
+    assert res["full_plane_ms_per_token"] > 0
+    assert res["spans_recorded"] > 0
+    assert res["new_tokens"] == 6 * 4
+    assert res["ttft_p50_ms"] > 0 and res["tpot_p50_ms"] > 0
+    assert res["flight_bundle_spans"] > 0
+    # measurement must leave the global planes restored for later tests
+    assert not trace.enabled()
+    from paddle_tpu.trace import get_recorder
+
+    assert get_recorder().enabled
+
+
 def test_train_pipeline_bench_path_runs():
     import jax
 
@@ -198,6 +221,9 @@ def test_train_pipeline_bench_path_runs():
     assert "host_gap_sync_ms" in res and "host_gap_async_ms" in res
 
 
+@pytest.mark.slow  # tier-1 budget (PR 12): 31s — two resnet50 compiles;
+# the op-cut + pass-stats contracts are pinned tier-1 in
+# test_transpiler.py, so only the bench-path crash guard rides here
 def test_transpiler_bench_path_runs():
     import jax
 

@@ -688,9 +688,12 @@ class TestHttpPlane:
                 assert not np.allclose(old, got)
 
     def test_fleetctl_cli_status_drain_resume(self):
+        from paddle_tpu.trace import SLO
+
         bundle = _fc_bundle()
         fleet = Fleet([_fc_engine(bundle) for _ in range(2)],
-                      hedge=False)
+                      hedge=False,
+                      slo=SLO(ttft_ms=250.0, availability=0.999))
         with fleet:
             port = fleet.serve_http()
             url = f"http://127.0.0.1:{port}"
@@ -706,6 +709,20 @@ class TestHttpPlane:
 
             status = json.loads(ctl("status"))
             assert [r["name"] for r in status["replicas"]] == ["r0", "r1"]
+            # PR 12 schema: per-replica TTFT/TPOT columns + the SLO/
+            # burn-rate block ride /fleet/status
+            for rep in status["replicas"]:
+                for col in ("ttft_p50_ms", "ttft_p99_ms",
+                            "tpot_p50_ms", "tpot_p99_ms"):
+                    assert col in rep
+            assert "fleet" in status and "ttft_p99_ms" in status["fleet"]
+            slo = status["slo"]
+            assert set(slo["objectives"]) == {"ttft", "availability"}
+            ttft = slo["objectives"]["ttft"]
+            assert {"attainment", "error_budget_remaining", "burn",
+                    "alerting"} <= set(ttft)
+            table = ctl("status", "--table")
+            assert "ttft p99" in table and "SLO" in table
             out = json.loads(ctl("drain", "r1"))
             assert out["state"]["state"] == "draining"
             assert json.loads(ctl("status"))["replicas"][1][

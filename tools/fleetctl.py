@@ -6,12 +6,20 @@ single ``Server.serve_http`` replica's /admin plane). Deliberately
 stdlib-only — no paddle_tpu import — so it runs from any box that can
 reach the fleet.
 
-    fleetctl.py --url http://host:port status
+    fleetctl.py --url http://host:port status [--table]
     fleetctl.py --url http://host:port drain r1
     fleetctl.py --url http://host:port resume r1
     fleetctl.py --url http://host:port update-weights /ckpt/run1
     fleetctl.py --url http://host:port chaos 'replica_crash@1,slow_replica@2'
     fleetctl.py --url http://host:port metrics [--prom]
+    fleetctl.py --url http://host:port flightdump [--out bundle.json]
+
+``status`` reports, per replica, health/breaker/inflight plus the decode
+latency columns (TTFT/TPOT p50/p99 from the replica's histograms) and,
+when the fleet declares an SLO, per-objective attainment, error-budget
+remaining, and multi-window burn rates (``--table`` renders the same
+data as a terminal table). ``flightdump`` fetches the fleet's flight
+recorder bundle (recent spans + metric history + engine state).
 
 Exit status: 0 on success, 1 on an HTTP/transport error (the body's
 ``error`` field is printed to stderr).
@@ -36,6 +44,55 @@ def call(url: str, method: str = "GET", body: dict | None = None,
     return payload.decode() if raw else json.loads(payload or b"{}")
 
 
+def _fmt_ms(v):
+    return "-" if v is None else f"{v:.1f}"
+
+
+def render_status_table(status: dict) -> str:
+    """Human view of /fleet/status: one row per replica with the
+    TTFT/TPOT columns, then the SLO/burn-rate block."""
+    head = (f"{'replica':<10}{'state':<12}{'breaker':<10}{'inflight':>9}"
+            f"{'ttft p50':>10}{'ttft p99':>10}{'tpot p50':>10}"
+            f"{'tpot p99':>10}")
+    lines = [head, "-" * len(head)]
+    for rep in status.get("replicas", []):
+        lines.append(
+            f"{rep.get('name', '?'):<10}"
+            f"{(rep.get('health') or {}).get('state', '?'):<12}"
+            f"{rep.get('breaker', '?'):<10}"
+            f"{rep.get('inflight', 0):>9}"
+            f"{_fmt_ms(rep.get('ttft_p50_ms')):>10}"
+            f"{_fmt_ms(rep.get('ttft_p99_ms')):>10}"
+            f"{_fmt_ms(rep.get('tpot_p50_ms')):>10}"
+            f"{_fmt_ms(rep.get('tpot_p99_ms')):>10}")
+    fleet_row = status.get("fleet") or {}
+    lines.append(
+        f"{'FLEET':<10}{'':<12}{'':<10}{status.get('pending', 0):>9}"
+        f"{_fmt_ms(fleet_row.get('ttft_p50_ms')):>10}"
+        f"{_fmt_ms(fleet_row.get('ttft_p99_ms')):>10}"
+        f"{_fmt_ms(fleet_row.get('tpot_p50_ms')):>10}"
+        f"{_fmt_ms(fleet_row.get('tpot_p99_ms')):>10}")
+    slo = status.get("slo")
+    if slo:
+        lines.append("")
+        lines.append("SLO " + ("** ALERTING **" if slo.get("alerting")
+                               else "(healthy)"))
+        for name, obj in sorted((slo.get("objectives") or {}).items()):
+            burns = ", ".join(
+                f"{win}={w.get('burn_rate')}x"
+                for win, w in sorted((obj.get("burn") or {}).items()))
+            thr = obj.get("threshold_ms")
+            lines.append(
+                f"  {name:<14}"
+                + (f"<{thr:g}ms " if thr is not None else "")
+                + f"target={obj.get('target')} "
+                  f"attainment={obj.get('attainment')} "
+                  f"budget_remaining={obj.get('error_budget_remaining')} "
+                  f"burn[{burns}]"
+                + ("  << ALERT" if obj.get("alerting") else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleetctl", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -43,7 +100,10 @@ def main(argv=None) -> int:
                     help="fleet base URL (Fleet.serve_http)")
     ap.add_argument("--timeout", type=float, default=120.0)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    sub.add_parser("status", help="replica health, breakers, counters")
+    p = sub.add_parser("status", help="replica health, breakers, "
+                       "TTFT/TPOT columns, SLO burn rates")
+    p.add_argument("--table", action="store_true",
+                   help="render a terminal table instead of JSON")
     p = sub.add_parser("drain", help="drain one replica (healthz -> 503)")
     p.add_argument("replica", help="replica name (r0) or index")
     p.add_argument("--no-wait", action="store_true",
@@ -61,6 +121,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("metrics", help="fleet metrics snapshot")
     p.add_argument("--prom", action="store_true",
                    help="Prometheus text exposition instead of JSON")
+    p = sub.add_parser("flightdump",
+                       help="fetch the fleet's flight-recorder bundle")
+    p.add_argument("--out", default=None,
+                   help="write the bundle here instead of stdout")
     args = ap.parse_args(argv)
 
     def _replica(value):
@@ -69,6 +133,9 @@ def main(argv=None) -> int:
     try:
         if args.cmd == "status":
             out = call(args.url + "/fleet/status", timeout=args.timeout)
+            if args.table:
+                print(render_status_table(out))
+                return 0
         elif args.cmd == "drain":
             out = call(args.url + "/fleet/drain", "POST",
                        {"replica": _replica(args.replica),
@@ -91,6 +158,16 @@ def main(argv=None) -> int:
                            timeout=args.timeout, raw=True))
                 return 0
             out = call(args.url + "/metrics", timeout=args.timeout)
+        elif args.cmd == "flightdump":
+            out = call(args.url + "/fleet/flightdump",
+                       timeout=args.timeout)
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(out, f)
+                print(f"wrote {args.out} "
+                      f"({len(out.get('trace', {}).get('spans', []))} "
+                      "spans)")
+                return 0
         else:  # unreachable (required=True)
             return 2
     except urllib.error.HTTPError as exc:

@@ -15,6 +15,18 @@ per-pass cost, examples/sec, and the pass-end StatSet highlights.
 ``--pipeline`` shows the async-trainer host-gap view; ``--resilience``
 shows checkpoint stall (ckpt/save vs ckpt/write) and retry pressure
 (retry/attempt spans per policy).
+
+``--distributed`` stitches N JSONL journals from DIFFERENT processes
+(the fleet router's + each replica's, written via
+``trace.export_jsonl`` or the servers' ``/admin/trace_export``) by
+trace id — the 128-bit ids are globally unique and every journal header
+carries its process's wall-clock epoch, so spans align on one absolute
+timeline — and prints the chosen request's cross-process tree plus its
+critical-path budget (where did the request spend its time: queue,
+hedge wait, prefill, decode?):
+
+    python tools/trace_summary.py --distributed router.jsonl r0.jsonl \\
+        r1.jsonl [--trace-id <32-hex>]
 """
 import argparse
 import json
@@ -177,9 +189,148 @@ def summarize_resilience(events):
         "(no ckpt/* or retry/* spans — resilience idle)"
 
 
+def load_journal(path):
+    """One JSONL span journal -> rows with ABSOLUTE wall-clock times
+    (header epoch + relative span seconds), tagged with the source
+    file — the unit ``--distributed`` stitches."""
+    from paddle_tpu.trace import load_jsonl_spans
+
+    return load_jsonl_spans(path)
+
+
+#: critical-path categories: first matching (prefix, label) claims the
+#: span's self-time in the budget table
+_BUDGET_BINS = (
+    ("serving/queue", "queue"),
+    ("fleet/hedge", "hedge fired"),
+    ("serving/execute", "prefill"),
+    ("serving/prefill", "prefill"),
+    ("serving/decode", "decode"),
+    ("fleet/attempt", "attempt (transport + replica)"),
+    ("fleet/request", "router"),
+    ("serving/request", "replica overhead"),
+)
+
+
+def _pick_trace(by_trace, want=None):
+    if want is not None:
+        tid = int(want, 16) if isinstance(want, str) else int(want)
+        if tid not in by_trace:
+            raise SystemExit(f"trace {want} not found; have "
+                             f"{[f'{t:032x}' for t in by_trace]}")
+        return tid
+    # default: the longest-running REQUEST trace (the one a P99
+    # investigation is after) — compile/background traces only win when
+    # no request trace exists; ties break toward more spans
+    def score(tid):
+        spans = by_trace[tid]
+        is_request = any(s["name"] in ("fleet/request", "serving/request")
+                         for s in spans)
+        roots = [s for s in spans if s["parent_id"] is None]
+        dur = max((s["end"] - s["start"] for s in roots), default=0.0)
+        return (is_request, dur, len(spans))
+    return max(by_trace, key=score)
+
+
+def summarize_distributed(paths, trace_id=None):
+    """Stitch journals, pick one trace, print the cross-process span
+    tree + the critical-path budget."""
+    rows = [r for p in paths for r in load_journal(p)]
+    if not rows:
+        return "(no spans in any journal)"
+    by_trace = {}
+    for r in rows:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+    tid = _pick_trace(by_trace, trace_id)
+    spans = sorted(by_trace[tid], key=lambda r: (r["start"], -r["end"]))
+    t0 = min(s["start"] for s in spans)
+    t_end = max(s["end"] for s in spans)
+    total_ms = (t_end - t0) * 1e3
+    by_id = {s["span_id"]: s for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        if s["parent_id"] in by_id and s["parent_id"] != s["span_id"]:
+            children.setdefault(s["parent_id"], []).append(s)
+        else:
+            roots.append(s)  # true root OR parent still open/unsampled
+
+    lines = [f"trace {tid:032x}: {len(spans)} spans from "
+             f"{len(set(s['source'] for s in spans))} journal(s) "
+             f"({', '.join(sorted(set(s['source'] for s in spans)))}), "
+             f"{total_ms:.3f} ms end to end"]
+
+    def key_attrs(s):
+        a = s["attrs"]
+        keep = [(k, a[k]) for k in ("replica", "status", "phase", "slot",
+                                    "hedge", "tokens", "queue_wait_s",
+                                    "prompt_len") if k in a]
+        return (" {" + ", ".join(f"{k}={v}" for k, v in keep) + "}"
+                if keep else "")
+
+    def walk(s, depth):
+        off = (s["start"] - t0) * 1e3
+        dur = (s["end"] - s["start"]) * 1e3
+        lines.append(f"  {'  ' * depth}{s['name']:<{max(1, 38 - 2 * depth)}}"
+                     f" +{off:9.3f}ms {dur:9.3f}ms  [{s['source']}]"
+                     f"{key_attrs(s)}")
+        for c in sorted(children.get(s["span_id"], []),
+                        key=lambda r: r["start"]):
+            walk(c, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+
+    # critical path: every instant of the trace is attributed to the
+    # DEEPEST span covering it (flame-graph attribution, but across
+    # processes), then binned — so queue/hedge/prefill/decode
+    # percentages PARTITION the request's wall time instead of
+    # double-counting overlapping parent/sibling spans
+    depth = {}
+
+    def _depth(s):
+        sid = s["span_id"]
+        if sid in depth:
+            return depth[sid]
+        d = 0
+        seen = set()
+        cur = s
+        while cur["parent_id"] in by_id and cur["span_id"] not in seen:
+            seen.add(cur["span_id"])
+            cur = by_id[cur["parent_id"]]
+            d += 1
+        depth[sid] = d
+        return d
+
+    for s in spans:
+        _depth(s)
+    bounds = sorted({s["start"] for s in spans}
+                    | {s["end"] for s in spans})
+    budget = {}
+    covered_ms = 0.0
+    for a, b in zip(bounds, bounds[1:]):
+        cover = [s for s in spans if s["start"] <= a and s["end"] >= b]
+        if not cover:
+            continue
+        s = max(cover, key=lambda s: (depth[s["span_id"]], s["start"]))
+        label = next((lab for prefix, lab in _BUDGET_BINS
+                      if s["name"].startswith(prefix)), s["name"])
+        ms = (b - a) * 1e3
+        budget[label] = budget.get(label, 0.0) + ms
+        covered_ms += ms
+    lines.append("")
+    lines.append("critical path (exclusive time per category):")
+    for label, ms in sorted(budget.items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * ms / covered_ms if covered_ms > 0 else 0.0
+        lines.append(f"  {label:<36}{ms:10.3f} ms  {pct:5.1f}%")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="trace file (chrome JSON or JSONL)")
+    ap.add_argument("trace", nargs="+",
+                    help="trace file(s): chrome JSON or JSONL (multiple "
+                         "JSONL journals with --distributed)")
     ap.add_argument("--top", type=int, default=None,
                     help="show only the top-N rows by total time")
     ap.add_argument("--prefix", default="",
@@ -190,13 +341,24 @@ def main(argv=None):
                     help="host-gap view of trainer dispatch/resolve spans")
     ap.add_argument("--resilience", action="store_true",
                     help="checkpoint-stall + retry-pressure view")
+    ap.add_argument("--distributed", action="store_true",
+                    help="stitch N process journals by trace id; print "
+                         "the cross-process tree + critical path")
+    ap.add_argument("--trace-id", default=None,
+                    help="with --distributed: the 32-hex trace id to "
+                         "show (default: the longest-running trace)")
     args = ap.parse_args(argv)
+    if args.distributed:
+        print(summarize_distributed(args.trace, trace_id=args.trace_id))
+        return 0
+    if len(args.trace) != 1:
+        ap.error("multiple trace files need --distributed")
     if args.runlog:
-        print(summarize_runlog(args.trace))
+        print(summarize_runlog(args.trace[0]))
         return 0
     from paddle_tpu.trace import load_trace_events
 
-    events = load_trace_events(args.trace)
+    events = load_trace_events(args.trace[0])
     if args.pipeline:
         print(summarize_pipeline(events))
         return 0
