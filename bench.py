@@ -991,6 +991,120 @@ def bench_fleet(jax, pt, layers, n_replicas=3, n_requests=96,
     }
 
 
+def bench_online(jax, pt, layers, vocab=1_000_000, embed_dim=16, slots=8,
+                 batch=128, steps=8, warmup=3, n_replicas=2,
+                 storm_threads=3, storm_s=0.15):
+    """Online-learning plane witness (ISSUE 13): (a) dense-vs-sparse
+    optimizer step time at V=1e6 with a batch touching <=1% of rows,
+    plus rows-touched scaling (quarter batch -> sparse step cost falls,
+    dense stays flat) and the static-memory evidence that the sparse
+    step never materializes a [V, D] gradient; (b) publish-swap latency
+    of one rolling weight update under live traffic (zero failed
+    requests is part of the record)."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import analysis
+
+    def build(is_sparse):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[slots], dtype="int64")
+            emb = layers.embedding(ids, size=[vocab, embed_dim],
+                                   is_sparse=is_sparse)
+            loss = layers.mean(emb)
+            pt.optimizer.AdagradOptimizer(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+
+    def measure(is_sparse, b):
+        main, startup, loss = build(is_sparse)
+        feed = {"ids": rng.randint(0, vocab,
+                                   size=(b, slots)).astype("int64")}
+        sec = _time_train_steps(jax, pt, main, startup, loss, feed,
+                                warmup=warmup, steps=steps)
+        mem = analysis.analyze_memory(main, ["ids"], [loss.name],
+                                      batch_size=b)
+        return sec, mem.peak_bytes
+
+    dense_sec, dense_peak = measure(False, batch)
+    sparse_sec, sparse_peak = measure(True, batch)
+    sparse_quarter_sec, _ = measure(True, max(batch // 4, 1))
+
+    # (b) publish-swap latency under live traffic
+    import tempfile
+
+    from paddle_tpu.online import Publisher
+    from paddle_tpu.serving import InferenceEngine
+    from paddle_tpu.serving.fleet import Fleet
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        out_v = layers.fc(layers.fc(x, size=32, act="relu"), size=4)
+
+    def engine(seed):
+        scope = pt.Scope()
+        startup.random_seed = seed
+        pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+        return InferenceEngine(program=main, feed_names=["x"],
+                               fetch_names=[out_v.name], scope=scope,
+                               batch_buckets=(4,), place=pt.CPUPlace())
+
+    ckdir = tempfile.mkdtemp(prefix="bench-online-ck")
+    src_scope = pt.Scope()
+    startup.random_seed = 99
+    pt.Executor(pt.TPUPlace()).run(startup, scope=src_scope)
+    pt.checkpoint.save_checkpoint(ckdir, scope=src_scope, step=1)
+
+    engines = [engine(s) for s in range(n_replicas)]
+    fleet = Fleet(engines, hedge=False)
+    pub = Publisher(fleet, ckdir)
+    stop, failed, served = threading.Event(), [], [0]
+
+    def storm():
+        while not stop.is_set():
+            try:
+                fleet.submit({"x": np.random.rand(8).astype(np.float32)},
+                             timeout_ms=10_000).result(timeout=15)
+                served[0] += 1
+            except Exception as exc:  # noqa: BLE001 - the record
+                failed.append(repr(exc))
+
+    with fleet:
+        for eng in engines:
+            eng.run({"x": np.ones((1, 8), np.float32)})
+        threads = [threading.Thread(target=storm)
+                   for _ in range(storm_threads)]
+        for t in threads:
+            t.start()
+        time.sleep(storm_s)
+        published = pub.poll_once()
+        time.sleep(storm_s)
+        stop.set()
+        for t in threads:
+            t.join()
+
+    return {
+        "vocab": vocab,
+        "rows_touched_fraction": round(batch * slots / vocab, 5),
+        "dense_step_ms": round(dense_sec * 1e3, 3),
+        "sparse_step_ms": round(sparse_sec * 1e3, 3),
+        "sparse_speedup": round(dense_sec / sparse_sec, 2),
+        "sparse_quarter_batch_ms": round(sparse_quarter_sec * 1e3, 3),
+        "dense_peak_mb": round(dense_peak / 1e6, 2),
+        "sparse_peak_mb": round(sparse_peak / 1e6, 2),
+        "publish_generation": published,
+        "publish_swap_s": (round(pub.last_publish_s, 4)
+                           if pub.last_publish_s else None),
+        "storm_served": served[0],
+        "storm_failed": len(failed),
+    }
+
+
 def bench_paged_kv(jax, pt, layers, models, tmax=2048, page_size=64,
                    dense_slots=4, prompt_len=48, max_new=8,
                    n_requests=24, d=32, L=2, H=4, vocab=128,
@@ -1724,6 +1838,11 @@ def run_bench(platform):
     # on the paged decode path: host-side span cost, CPU row is the
     # witness for the <1% budget
     step("obs_overhead", bench_obs_overhead, jax, pt, layers, models)
+    # online-learning plane: dense-vs-sparse V=1e6 optimizer step +
+    # rows-touched scaling + publish-swap latency under live traffic
+    # (sparse update + publisher are host/HBM-stream planes; the CPU
+    # row is the witness, the TPU row prices real HBM scatter rates)
+    step("online", bench_online, jax, pt, layers)
     # one-sharding-plane A/B (single vs dp vs dp x tp): on CPU it spawns
     # the 8-device virtual-mesh child (the witness); the TPU row waits
     # for a multi-chip window — single-chip children skip it

@@ -10,9 +10,9 @@ instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
 from . import (analysis, checkpoint, clip, evaluator, event, initializer,
-               layers, learning_rate_decay, master, models, nets, optimizer,
-               parallel, profiler, regularizer, resilience, serving, trace,
-               trainer, transpiler)
+               layers, learning_rate_decay, master, models, nets, online,
+               optimizer, parallel, profiler, regularizer, resilience,
+               serving, trace, trainer, transpiler)
 from . import flags
 from .checkgrad import check_gradients
 from .core.enforce import (EnforceError, enforce, enforce_eq, enforce_ge,

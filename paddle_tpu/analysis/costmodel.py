@@ -312,9 +312,30 @@ def _fused_head_ce_cost(attrs, ins, outs):
 
 
 def _embedding_cost(attrs, ins, outs):
-    # O(batch) random gathers: touched table rows = output bytes
+    # O(batch) random gathers: touched table rows = output bytes. The
+    # [V, D] table is NOT a stream operand — a V=1e6 lookup costs its
+    # rows-touched bytes (id stream + row reads + output write), which
+    # is what the chip actually DMAs.
+    ids = _first(ins, "Ids")
     return OpCost(flops=0.0,
-                  bytes=_slot_bytes(ins) + 2.0 * _slot_bytes(outs))
+                  bytes=(_nbytes(ids) if ids is not None else 0.0)
+                  + 2.0 * _slot_bytes(outs))
+
+
+def _sparse_optimizer(attrs, ins, outs):
+    """Row-granular scatter-apply updates (sparse_sgd/sparse_adagrad):
+    price by ROWS TOUCHED — the SelectedRows grad's (ids + row values)
+    stream plus a read+write of the touched rows per dense state tensor
+    — never the [V, D] table (rows-touched bytes are what the update
+    DMAs; the table only pays for rows it owns in the batch)."""
+    g = _first(ins, "Grad")
+    if g is None:
+        return _optimizer(attrs, ins, outs)
+    grad_bytes = _nbytes(g)  # id stream + row grads (dense on fan-in)
+    row_bytes = max((_nbytes(l) for l in _leaves(g)), default=0.0)
+    n_state = sum(1 for slot in ("Param", "Moment") if (ins or {}).get(slot))
+    return OpCost(flops=6.0 * _elems(g),  # dedup sort + update arithmetic
+                  bytes=grad_bytes + 2.0 * n_state * row_bytes)
 
 
 def _rnn_cost(attrs, ins, outs):
@@ -611,6 +632,7 @@ def _register_all() -> None:
     reg(("scaled_dot_product_attention",), _sdpa_cost)
     reg(("fused_head_cross_entropy",), _fused_head_ce_cost)
     reg(("lookup_table",), _embedding_cost)
+    reg(("sparse_sgd", "sparse_adagrad"), _sparse_optimizer)
     reg(("grad", "grad_custom"), _grad_cost)
     reg(("seg_fwd",), _seg_fwd_cost)
     reg(("grad_seg",), _grad_seg_cost)

@@ -54,6 +54,18 @@ class SelectedRows:
     def dense_shape(self):
         return (self.height,) + tuple(self.values.shape[1:])
 
+    @property
+    def shape(self):
+        """Dense-view shape: generic elementwise kernels (the gradient-
+        accumulation ``acc += grad`` add) treat a SelectedRows like the
+        dense tensor it represents; the arithmetic then densifies
+        through ``__radd__``."""
+        return self.dense_shape
+
+    @property
+    def ndim(self):
+        return len(self.dense_shape)
+
     def astype(self, dtype):
         return SelectedRows(self.rows, self.values.astype(dtype), self.height)
 

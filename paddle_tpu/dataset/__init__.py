@@ -1,7 +1,7 @@
 """Datasets (reference python/paddle/v2/dataset package API)."""
-from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+from . import (cifar, common, conll05, ctr, flowers, imdb, imikolov, mnist,
                movielens, mq2007, sentiment, uci_housing, voc2012, wmt14)
 
-__all__ = ["cifar", "common", "conll05", "flowers", "imdb", "imikolov",
-           "mnist", "movielens", "mq2007", "sentiment", "uci_housing",
-           "voc2012", "wmt14"]
+__all__ = ["cifar", "common", "conll05", "ctr", "flowers", "imdb",
+           "imikolov", "mnist", "movielens", "mq2007", "sentiment",
+           "uci_housing", "voc2012", "wmt14"]

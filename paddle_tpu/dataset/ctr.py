@@ -1,0 +1,89 @@
+"""Synthetic CTR click-stream for the online-learning plane.
+
+The Wide&Deep flagship's data side (BASELINE.json configs[5]): an
+endless stream of (sparse slot ids, dense features, click label)
+impressions with the statistics real CTR traffic has — Zipf-ish id
+popularity (most lookups hit a small hot set while the vocabulary stays
+huge, which is exactly what makes the row-sparse update path matter)
+and a click probability driven by a few "magic" id buckets plus one
+dense feature, so AUC is learnable and improves measurably within a
+short run.
+
+Deterministic by (shard, pass): ``task_descs(n)`` names the shards a
+master task queue serves (``ctr:<shard>:<n_records>``), and
+``task_reader(desc)`` regenerates a shard's records from its name alone
+— a preempted trainer that gets the task re-served replays byte-
+identical data, the contract the streaming resume tests pin.
+
+Samples: (ids int64[SLOTS], dense float32[DENSE_DIM], label float32[1]).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["SLOTS", "DENSE_DIM", "VOCAB_SIZE", "train", "task_descs",
+           "task_reader", "make_batch"]
+
+SLOTS = 8
+DENSE_DIM = 4
+VOCAB_SIZE = 100_000
+HOT_IDS = 200          # the hot set most impressions hit
+HOT_FRACTION = 0.9
+
+
+def _impressions(rng: np.random.RandomState, n: int, vocab: int):
+    """n impressions as (ids [n, SLOTS], dense [n, DENSE_DIM],
+    label [n, 1]) — vectorized; callers slice rows out."""
+    hot = rng.randint(0, min(HOT_IDS, vocab), size=(n, SLOTS))
+    cold = rng.randint(0, vocab, size=(n, SLOTS))
+    ids = np.where(rng.rand(n, SLOTS) < HOT_FRACTION, hot,
+                   cold).astype(np.int64)
+    dense = rng.rand(n, DENSE_DIM).astype(np.float32)
+    # clickiness: a few magic id buckets + one dense feature
+    signal = (ids % 7 == 3).sum(1) * 0.8 + dense[:, 0] * 2.0 - 2.2
+    prob = 1.0 / (1.0 + np.exp(-signal))
+    label = (rng.rand(n) < prob).astype(np.float32)[:, None]
+    return ids, dense, label
+
+
+def train(n: int = 4096, vocab: int = VOCAB_SIZE, seed: str = "ctr-train"):
+    """Plain bounded reader: ``n`` (ids, dense, label) samples."""
+
+    def reader():
+        ids, dense, label = _impressions(common.synthetic_rng(seed), n,
+                                         vocab)
+        for i in range(n):
+            yield ids[i], dense[i], label[i]
+
+    return reader
+
+
+def task_descs(n_shards: int, records_per_shard: int = 256,
+               vocab: int = VOCAB_SIZE):
+    """Shard names for a master task queue: ``ctr:<shard>:<n>:<vocab>``.
+    Each desc fully determines its records (deterministic replay on
+    task re-serve)."""
+    return [f"ctr:{i}:{int(records_per_shard)}:{int(vocab)}"
+            for i in range(n_shards)]
+
+
+def task_reader(desc: str):
+    """Records of one task desc (the ``make_reader`` a
+    MasterClient.task_reader wants)."""
+    tag, shard, n, vocab = desc.split(":")
+    if tag != "ctr":
+        raise ValueError(f"not a ctr task desc: {desc!r}")
+    n, vocab = int(n), int(vocab)
+    ids, dense, label = _impressions(
+        common.synthetic_rng(f"ctr-shard-{shard}"), n, vocab)
+    return ((ids[i], dense[i], label[i]) for i in range(n))
+
+
+def make_batch(rows):
+    """Stack a list of (ids, dense, label) rows into the feed arrays a
+    wide_deep program wants: {'ids', 'dense', 'label'}."""
+    return {"ids": np.stack([r[0] for r in rows]),
+            "dense": np.stack([r[1] for r in rows]),
+            "label": np.stack([r[2] for r in rows])}

@@ -1,0 +1,22 @@
+"""paddle_tpu.online — the streaming online-learning plane.
+
+The loop real CTR systems run, assembled from planes earlier PRs built:
+train forever on a click-stream (the master's fault-tolerant task queue,
+PR 5's checkpoint/resume), on mesh-sharded sparse embeddings (PR 11's
+one sharding plane lowering ``vocab_sharded_plan`` through the shard_map
+gather/scatter islands), and publish fresh weights into a live serving
+fleet with zero downtime (PR 9's ``Fleet.update_weights``), judged by a
+freshness SLO on the PR 12 observability plane.
+
+- :class:`StreamingTrainer` — endless-pass training off a master task
+  queue; preemption-safe (graceful stop at task boundaries, checkpoint
+  resume, deterministic task replay) so a preempted trainer rejoins the
+  stream without losing or double-counting tasks.
+- :class:`Publisher` — watches the trainer's checkpoint directory and
+  drives rolling ``Fleet.update_weights`` swaps; exports weight-version
+  and staleness gauges and the ``freshness`` SLO objective.
+"""
+from .publisher import Publisher
+from .trainer import StreamingTrainer
+
+__all__ = ["StreamingTrainer", "Publisher"]

@@ -1,0 +1,212 @@
+"""Publisher: roll fresh trainer checkpoints into a live serving fleet.
+
+The last hop of the online-learning loop: watch the
+:class:`StreamingTrainer`'s checkpoint directory and, whenever a new
+intact generation lands, drive :meth:`Fleet.update_weights` — the PR 9
+rolling swap (drain -> same-signature hot-swap -> warm-verify ->
+rejoin), so the fleet serves throughout, pays zero recompiles, and KV
+caches are invalidated where they must be.
+
+One generation is published CONSISTENTLY: the checkpoint is loaded once
+into a pinned array source and every replica swaps from that same dict
+— a trainer save landing mid-roll cannot split the fleet across two
+generations (it publishes on the next poll). Fleets with remote
+(HttpReplica) members fall back to passing the directory path, which
+their ``/admin/swap`` loads server-side.
+
+Freshness is a first-class signal: ``weights_version`` /
+``weights_staleness_s`` / ``weights_age_s`` gauges land in the fleet's
+MetricsRegistry (→ ``/metrics``, ``/fleet/status``, ``fleetctl
+status``), and an :class:`~paddle_tpu.trace.slo.SLO` with
+``freshness_s`` set turns seconds-behind-trainer into a burn-rate-
+tracked objective next to TTFT/availability.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from .. import checkpoint as ckpt_mod
+from .. import trace
+
+
+class _PinnedGeneration(dict):
+    """One checkpoint generation as an array dict (what swap_params
+    consumes), with a readable repr for spans/results."""
+
+    def __init__(self, arrays, dirname: str, step: int):
+        super().__init__(arrays)
+        self.dirname = dirname
+        self.step = step
+
+    def __str__(self):
+        return f"{self.dirname}@step-{self.step}"
+
+    __repr__ = __str__
+
+
+class Publisher:
+    """Watch a checkpoint dir; publish new generations into a fleet.
+
+    fleet:       a :class:`paddle_tpu.serving.fleet.Fleet` (the
+                 publisher attaches itself as ``fleet.publisher`` so
+                 ``/fleet/status`` grows the ``weights`` block).
+    dirname:     the trainer's checkpoint directory.
+    poll_s:      watch cadence of the background thread (:meth:`start`);
+                 :meth:`poll_once` is the same logic inline.
+    verify:      forward to ``update_weights`` (warm-manifest verify).
+    min_interval_s: publish rate limit — generations landing faster
+                 than this coalesce (the newest wins).
+    """
+
+    def __init__(self, fleet, dirname: str, poll_s: float = 0.25,
+                 verify: bool = True, min_interval_s: float = 0.0):
+        self.fleet = fleet
+        self.dirname = str(dirname)
+        self.poll_s = float(poll_s)
+        self.verify = bool(verify)
+        self.min_interval_s = float(min_interval_s)
+        self.published_step: Optional[int] = None
+        self.published_ckpt_time: Optional[float] = None
+        self.generations = 0          # successful publishes
+        self.last_publish_s: Optional[float] = None  # roll wall time
+        self.last_error: Optional[str] = None
+        self._published_at: Optional[float] = None   # monotonic-ish
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        fleet.publisher = self
+
+    # -- watching --------------------------------------------------------
+    def _ckpt_time(self, step: int) -> Optional[float]:
+        """Wall-clock time the generation was written (the save's meta
+        sidecar; payload mtime as fallback)."""
+        payload = f"ckpt-{step}.npz"
+        info = ckpt_mod._step_info(self.dirname, payload)
+        if info and info.get("timestamp"):
+            return float(info["timestamp"])
+        try:
+            return os.path.getmtime(os.path.join(self.dirname, payload))
+        except OSError:
+            return None
+
+    def latest_step(self) -> Optional[int]:
+        return ckpt_mod.latest_step(self.dirname)
+
+    def staleness_s(self) -> float:
+        """Seconds the SERVED weights are behind the trainer's newest
+        intact generation: 0 while caught up, else the age of the
+        newest checkpoint the fleet is not serving yet."""
+        latest = self.latest_step()
+        if latest is None or latest == self.published_step:
+            return 0.0
+        ts = self._ckpt_time(latest)
+        return max(0.0, time.time() - ts) if ts else 0.0
+
+    # -- publishing ------------------------------------------------------
+    def _pinned_source(self, step: int):
+        """Load the generation ONCE so every replica swaps identical
+        arrays; remote replicas can only take a path (their /admin/swap
+        loads server-side)."""
+        from ..serving.fleet import HttpReplica
+
+        if any(isinstance(rep, HttpReplica)
+               for rep in self.fleet.replicas):
+            return self.dirname
+        from ..core.scope import Scope
+
+        staging = Scope()
+        meta = ckpt_mod.load_checkpoint(self.dirname, scope=staging)
+        return _PinnedGeneration(
+            {k: staging.get(k) for k in staging.keys()},
+            self.dirname, int(meta.get("step", step)))
+
+    def poll_once(self) -> Optional[int]:
+        """Publish the newest unpublished generation, if any; returns
+        the published step (None when already fresh / rate-limited /
+        failed — failures land in ``last_error`` and the error counter,
+        the fleet keeps serving the old weights)."""
+        latest = self.latest_step()
+        if latest is None or latest == self.published_step:
+            self.refresh_gauges()
+            return None
+        if (self.min_interval_s and self._published_at is not None
+                and time.monotonic() - self._published_at
+                < self.min_interval_s):
+            self.refresh_gauges()
+            return None
+        with self._lock:  # one roll at a time (thread + manual callers)
+            t0 = time.monotonic()
+            try:
+                source = self._pinned_source(latest)
+                step = getattr(source, "step", latest)
+                with trace.span("online/publish", step=step,
+                                dirname=self.dirname):
+                    self.fleet.update_weights(source, verify=self.verify)
+            except Exception as exc:  # noqa: BLE001 - keep serving old
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                self.fleet.metrics.inc("weight_publish_errors")
+                self.refresh_gauges()
+                return None
+            self.last_publish_s = time.monotonic() - t0
+            self.published_step = step
+            self.published_ckpt_time = self._ckpt_time(step)
+            self._published_at = time.monotonic()
+            self.generations += 1
+            self.last_error = None
+            self.fleet.metrics.inc("weight_generations")
+            self.refresh_gauges()
+            return step
+
+    # -- observability ---------------------------------------------------
+    def refresh_gauges(self) -> None:
+        m = self.fleet.metrics
+        m.set_gauge("weights_version", float(self.published_step or 0))
+        m.set_gauge("weights_staleness_s", round(self.staleness_s(), 6))
+        if self.published_ckpt_time is not None:
+            m.set_gauge("weights_age_s",
+                        round(time.time() - self.published_ckpt_time, 6))
+
+    def status(self) -> dict:
+        """The ``weights`` block of ``/fleet/status``."""
+        return {
+            "dirname": self.dirname,
+            "published_step": self.published_step,
+            "latest_step": self.latest_step(),
+            "staleness_s": round(self.staleness_s(), 6),
+            "generations": self.generations,
+            "last_publish_s": self.last_publish_s,
+            "last_error": self.last_error,
+            "watching": self._thread is not None,
+        }
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Publisher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watch, name="paddle-tpu-publisher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the watch must survive
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30.0)
+
+    def __enter__(self) -> "Publisher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

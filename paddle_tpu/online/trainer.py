@@ -1,0 +1,215 @@
+"""StreamingTrainer: endless-pass training off a master task queue.
+
+The trainer side of the online-learning loop. One ``SGD`` step program
+(typically Wide&Deep CTR with ``is_sparse`` embeddings) runs forever
+over a click-stream served by the fault-tolerant master
+(:mod:`paddle_tpu.master`): tasks are pulled, their records batched and
+trained, and the task acked (``task_finished``) only after every one of
+its batches has been handed to the step loop — so the ack horizon
+trails training, never leads it. When a pass drains, ``new_pass()``
+recycles the queue and the stream continues (the reference's endless
+cluster training, service.go pass recycling).
+
+Preemption contract (pinned by tests/test_online.py):
+
+- **graceful stop** (:meth:`stop`, SIGTERM/SIGINT) latches a flag the
+  stream checks at TASK boundaries: the in-flight task finishes
+  training and is acked, the pass ends early, ``SGD.train`` writes its
+  final checkpoint — every acked task is covered by the checkpoint, no
+  task is lost and none is double-counted when a successor resumes.
+- **hard crash**: unacked claims time out on the master and re-queue
+  (service.go:313); the successor auto-resumes the newest intact
+  checkpoint and replays re-served tasks — at-least-once, exactly the
+  reference's semantics.
+
+The checkpoint cadence (``CheckpointConfig.every_n_steps``) is the
+weight-generation cadence: every periodic save is a publishable
+generation the :class:`~paddle_tpu.online.Publisher` can roll into a
+serving fleet.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from .. import event as evt
+from ..master import NO_TASK, PASS_DONE, MasterClient
+from ..resilience.signals import ShutdownFlag, graceful_shutdown
+
+
+class StreamingTrainer:
+    """Drive an ``SGD`` trainer from a master task queue, endlessly.
+
+    sgd:              a built :class:`paddle_tpu.trainer.SGD` (its
+                      feed_list names must match the task records'
+                      column order).
+    master_addr:      (host, port) of a running MasterServer.
+    make_task_reader: desc -> record iterator (e.g.
+                      ``paddle_tpu.dataset.ctr.task_reader``).
+    task_descs:       the dataset; seeded into the master ONLY when its
+                      queue is empty — a restarted trainer joining a
+                      live master must not reset consumed state.
+    batch_size:       records per training step; a task's trailing
+                      partial batch trains (short batch) so task ack
+                      horizons stay exact.
+    checkpoint:       a :class:`~paddle_tpu.resilience.CheckpointConfig`
+                      — required for resume and for publishing (its
+                      ``every_n_steps`` is the generation cadence).
+                      Signal handling moves HERE (task-boundary stop),
+                      so the config's ``install_signal_handlers`` is
+                      forced off.
+    max_steps / max_passes: bound the run (None = endless; ``stop()``
+                      or a signal ends it).
+    """
+
+    def __init__(self, sgd, master_addr, make_task_reader: Callable,
+                 task_descs: Optional[Sequence[str]] = None,
+                 batch_size: int = 64, checkpoint=None,
+                 max_steps: Optional[int] = None,
+                 max_passes: Optional[int] = None,
+                 client_retry=None, install_signal_handlers: bool = True):
+        self.sgd = sgd
+        self.master_addr = tuple(master_addr)
+        self.make_task_reader = make_task_reader
+        self.task_descs = list(task_descs) if task_descs else None
+        self.batch_size = int(batch_size)
+        self.checkpoint = checkpoint
+        if checkpoint is not None:
+            # the trainer owns signal handling (task-boundary stop);
+            # SGD's own handler would stop mid-task and break the
+            # no-double-count contract
+            checkpoint.install_signal_handlers = False
+        self.max_steps = max_steps
+        self.max_passes = max_passes
+        self._client_retry = client_retry
+        self._install_signals = bool(install_signal_handlers)
+        self._flag = ShutdownFlag()
+        self.steps = 0
+        self.passes = 0
+        self.tasks_finished = 0
+        self.last_cost: Optional[float] = None
+        self._started_at: Optional[float] = None
+
+    # -- control --------------------------------------------------------
+    def stop(self, reason: str = "stop() called") -> None:
+        """Latch graceful stop: the stream ends at the next task
+        boundary, the final checkpoint covers everything acked."""
+        self._flag.set(reason=reason)
+
+    @property
+    def stopping(self) -> bool:
+        return self._flag.is_set()
+
+    def state(self) -> dict:
+        """Operator view: progress counters + the master's queue."""
+        out = {"steps": self.steps, "passes": self.passes,
+               "tasks_finished": self.tasks_finished,
+               "last_cost": self.last_cost,
+               "uptime_s": (time.monotonic() - self._started_at
+                            if self._started_at else 0.0)}
+        try:
+            client = MasterClient(self.master_addr,
+                                  retry=self._client_retry)
+            out["queue"] = client.counts()
+            client.close()
+        except Exception:  # noqa: BLE001 - state() must not die
+            out["queue"] = None
+        return out
+
+    # -- the stream -----------------------------------------------------
+    def _maybe_seed(self, client: MasterClient) -> None:
+        if not self.task_descs:
+            return
+        counts = client.counts()
+        if (counts["todo"] + counts["pending"] + counts["done"]
+                + counts["discarded"]) == 0:
+            client.set_dataset(self.task_descs)
+
+    def _budget_left(self) -> bool:
+        if self._flag.is_set():
+            return False
+        if self.max_steps is not None and self.steps >= self.max_steps:
+            return False
+        if self.max_passes is not None and self.passes >= self.max_passes:
+            return False
+        return True
+
+    def _stream_reader(self):
+        """The endless batched reader ``SGD.train`` consumes: one
+        "pass" from SGD's perspective, internally recycling master
+        passes. Tasks ack AFTER their last batch is yielded (the step
+        loop trains a yielded batch before pulling the next — sync
+        loop), and the stop flag is honored only at task boundaries."""
+
+        def reader():
+            client = MasterClient(self.master_addr,
+                                  retry=self._client_retry)
+            try:
+                self._maybe_seed(client)
+                while self._budget_left():
+                    t = client.get_task()
+                    if t == PASS_DONE:
+                        self.passes += 1
+                        # recycle BEFORE the budget check so a bounded
+                        # run always leaves the queue at a fresh pass
+                        # boundary for its successor (new_pass is a
+                        # no-op while another trainer holds tasks)
+                        client.new_pass()
+                        continue
+                    if t == NO_TASK:
+                        # another trainer holds the pending tail
+                        time.sleep(0.02)
+                        continue
+                    tid, desc, epoch = t
+                    try:
+                        rows = []
+                        for rec in self.make_task_reader(desc):
+                            rows.append(rec)
+                            if len(rows) == self.batch_size:
+                                yield rows
+                                self.steps += 1
+                                rows = []
+                        if rows:  # trailing partial batch still trains
+                            yield rows
+                            self.steps += 1
+                    except GeneratorExit:
+                        # consumer torn down mid-task (trainer crash /
+                        # interpreter exit): leave the claim to expire
+                        # back into the queue
+                        raise
+                    except Exception:  # noqa: BLE001 - task retry
+                        client.task_failed(tid, epoch)
+                        continue
+                    client.task_finished(tid, epoch)
+                    self.tasks_finished += 1
+            finally:
+                client.close()
+
+        # the master tracks consumption; a checkpoint-resumed run must
+        # not ALSO skip batches from this stream
+        reader.master_backed = True
+        return reader
+
+    # -- run ------------------------------------------------------------
+    def run(self, event_handler: Optional[Callable] = None,
+            run_log=None, **train_kw) -> dict:
+        """Train until the budget/stop flag ends the stream; returns the
+        final :meth:`state`. Extra kwargs forward to ``SGD.train``
+        (e.g. ``mem_budget``, ``plan``)."""
+        self._started_at = time.monotonic()
+
+        def handler(e):
+            if isinstance(e, evt.EndIteration):
+                self.last_cost = e.cost
+            if event_handler is not None:
+                event_handler(e)
+
+        import contextlib
+
+        ctx = (graceful_shutdown(flag=self._flag)
+               if self._install_signals else contextlib.nullcontext())
+        with ctx:
+            self.sgd.train(self._stream_reader(), num_passes=1,
+                           event_handler=handler, run_log=run_log,
+                           checkpoint=self.checkpoint, **train_kw)
+        return self.state()

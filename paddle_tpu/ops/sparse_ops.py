@@ -1,0 +1,109 @@
+"""Row-granular sparse optimizer updates as first-class ops.
+
+The scatter-apply half of the streaming CTR plane
+(:mod:`paddle_tpu.online`): ``sparse_sgd`` / ``sparse_adagrad`` consume a
+SelectedRows gradient and touch ONLY the looked-up rows — unique ids via
+the segment-sum dedup (SelectedRows.merged), then one scatter per state
+tensor. A [V, D] gradient never materializes (the reference's
+sgd_op.cc / adagrad_op.cc SelectedRows kernels, originally applied on
+the sparse parameter server, /root/reference/go/pserver/optimizer.go).
+
+Touched rows follow the dense formula BITWISE (pinned by
+tests/test_online.py): dedup first, then the same f32 arithmetic the
+dense kernel runs per element, so sparse-vs-dense differ only in which
+rows get written.
+
+Mesh-aware: when the executor's mesh carries the plan's vocab axis (attr
+``vocab_axis``, default 'mp') and the table divides, the scatters lower
+through :mod:`paddle_tpu.parallel.sharded_embedding`'s shard_map islands
+— each device applies the rows of ITS [V/n, D] block, the row exchange
+riding the same ICI collectives as the forward gather. Otherwise (single
+device, dp-only mesh, or a densified fan-in gradient) the serial path
+runs; both paths share the formulas above.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
+from .common import out, single
+
+
+def _vocab_mesh(attrs, vocab: int):
+    """The executor mesh when this op instance should scatter through
+    the shard_map island (vocab axis present, size > 1, table divides);
+    None selects the serial path — the SAME program runs on one
+    device (and under abstract shape inference, where no mesh is
+    published)."""
+    from ..parallel.context import current_mesh
+    from ..parallel.sharded_embedding import rows_per_shard
+
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    axis = attrs.get("vocab_axis", "mp")
+    if not rows_per_shard(vocab, mesh, axis):
+        return None
+    return mesh
+
+
+@register_op("sparse_sgd")
+def sparse_sgd(attrs, ins):
+    """SGD over a SelectedRows gradient: dedup the touched rows, then
+    ``param[rows] -= lr * grad_rows`` — never a [V, D] buffer. A dense
+    gradient (sparse+dense fan-in densified by the sum op) falls back to
+    the dense formula."""
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    lr = single(ins, "LearningRate").astype(p.dtype).reshape(())
+    if not isinstance(g, SelectedRows):
+        return out(ParamOut=p - lr * g.astype(p.dtype))
+    m = g.merged()  # unique ids + segment-sum of duplicate rows
+    step = -(lr * m.values.astype(p.dtype))
+    mesh = _vocab_mesh(attrs, p.shape[0])
+    if mesh is not None:
+        from ..parallel.sharded_embedding import vp_scatter_add
+
+        return out(ParamOut=vp_scatter_add(
+            p, m.rows, step, mesh,
+            vocab_axis=attrs.get("vocab_axis", "mp")))
+    return out(ParamOut=p.at[m.rows].add(step, mode="drop"))
+
+
+@register_op("sparse_adagrad")
+def sparse_adagrad(attrs, ins):
+    """Row-sparse adagrad (adagrad_op.cc SelectedRows kernel): the
+    moment accumulates and the parameter steps only on touched rows —
+    both scatters row-granular, both bitwise the dense formula on the
+    rows they touch."""
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    if not isinstance(g, SelectedRows):
+        g = g.astype(jnp.float32)
+        mom_out = mom + jnp.square(g)
+        p_out = p - (lr * g / (jnp.sqrt(mom_out) + eps)).astype(p.dtype)
+        return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+    m = g.merged()
+    gv = m.values.astype(jnp.float32)
+    mesh = _vocab_mesh(attrs, p.shape[0])
+    if mesh is not None:
+        from ..parallel.sharded_embedding import (vp_rows_pull,
+                                                  vp_scatter_add)
+
+        axis = attrs.get("vocab_axis", "mp")
+        mom_rows = vp_rows_pull(mom, m.rows, mesh, vocab_axis=axis) \
+            + jnp.square(gv)
+        step = (lr * gv / (jnp.sqrt(mom_rows) + eps)).astype(p.dtype)
+        return {"ParamOut": [vp_scatter_add(p, m.rows, -step, mesh,
+                                            vocab_axis=axis)],
+                "MomentOut": [vp_scatter_add(mom, m.rows, mom_rows, mesh,
+                                             vocab_axis=axis,
+                                             mode="set")]}
+    mom_rows = mom[m.rows] + jnp.square(gv)
+    step = (lr * gv / (jnp.sqrt(mom_rows) + eps)).astype(p.dtype)
+    return {"ParamOut": [p.at[m.rows].add(-step, mode="drop")],
+            "MomentOut": [mom.at[m.rows].set(mom_rows, mode="drop")]}

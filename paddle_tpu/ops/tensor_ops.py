@@ -201,8 +201,15 @@ def crop(attrs, ins):
 # --- elementwise binary (broadcast semantics per elementwise_op.h) ----------
 def _elementwise(op):
     def fn(attrs, ins):
-        x = single(ins, "X")
-        y = broadcast_to_x(x, single(ins, "Y"), attrs.get("axis", -1))
+        from ..core.selected_rows import densify
+
+        # a SelectedRows operand (sparse grad flowing into a dense
+        # elementwise consumer, e.g. the gradient-accumulation
+        # ``acc += grad``) takes its dense view — the row-granular
+        # fast path belongs to the sparse_* optimizer ops only
+        x = densify(single(ins, "X"))
+        y = broadcast_to_x(x, densify(single(ins, "Y")),
+                           attrs.get("axis", -1))
         return out(Out=op(x, y))
 
     return fn
@@ -393,17 +400,40 @@ def _lookup_table_grad(attrs, ins, outs, ogs):
     return {"W": [dw], "Ids": [None]}
 
 
+def _vocab_sharded_gather(attrs, w, flat):
+    """The shard_map gather when the executor mesh carries the plan's
+    vocab axis and the table divides (the vocab_sharded_plan path —
+    each device owns a [V/n, D] row block and one psum exchanges the
+    looked-up rows); None selects the serial gather — the SAME program
+    runs on one device (and under abstract shape inference, where no
+    mesh is published)."""
+    if not attrs.get("is_sparse", False):
+        return None
+    from ..parallel.context import current_mesh
+    from ..parallel.sharded_embedding import rows_per_shard, vp_lookup
+
+    mesh = current_mesh()
+    axis = attrs.get("vocab_axis", "mp")
+    if mesh is None or not rows_per_shard(w.shape[0], mesh, axis):
+        return None
+    return vp_lookup(w, flat, mesh, vocab_axis=axis,
+                     data_axis=attrs.get("data_axis", "dp"))
+
+
 @register_op("lookup_table", grad_fn=_lookup_table_grad)
 def lookup_table(attrs, ins):
     w = single(ins, "W")
     ids = single(ins, "Ids")
     squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
     flat = ids.reshape(-1)
+    rows = _vocab_sharded_gather(attrs, w, flat)
+    if rows is None:
+        rows = w[flat]
     if attrs.get("padding_idx") is not None and attrs.get("padding_idx", -1) >= 0:
         pad_idx = attrs["padding_idx"]
-        emb = jnp.where((flat == pad_idx)[:, None], 0.0, w[flat])
+        emb = jnp.where((flat == pad_idx)[:, None], 0.0, rows)
     else:
-        emb = w[flat]
+        emb = rows
     shape = (ids.shape[:-1] if squeeze_last else ids.shape) + (w.shape[-1],)
     return out(Out=emb.reshape(shape))
 

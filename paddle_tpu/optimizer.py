@@ -19,6 +19,19 @@ from .layers.layer_helper import LayerHelper
 from .regularizer import append_regularization_ops
 
 
+def _sparse_grad_params(block) -> set:
+    """Parameters whose gradient arrives as a SelectedRows: the weights
+    of ``is_sparse=True`` lookup_table ops (lookup_table_op.cc:59 emits
+    the row-sparse grad). Optimizers with a row-granular update rule
+    emit their ``sparse_*`` op for these, so the step never materializes
+    a [V, D] gradient."""
+    names = set()
+    for op in block.ops:
+        if op.type == "lookup_table" and op.attrs.get("is_sparse", False):
+            names.update(op.inputs.get("W", ()))
+    return names
+
+
 class Optimizer:
     op_type: str = None
 
@@ -29,6 +42,7 @@ class Optimizer:
         self.regularization = regularization
         self._lr_var: Optional[Variable] = None
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._sparse_params: set = set()
 
     # -- learning rate -----------------------------------------------------
     def _create_lr_var(self, program: Program, startup: Program) -> Variable:
@@ -97,6 +111,7 @@ class Optimizer:
         startup = startup_program or default_startup_program()
         params_grads = append_backward(loss, parameter_list, no_grad_set)
         block = loss.block
+        self._sparse_params = _sparse_grad_params(block)
         lr_var = self._create_lr_var(block.program, startup)
         if accumulate_steps and int(accumulate_steps) > 1:
             # clip/reg must see the accumulated MEAN gradient (clipping a
@@ -258,8 +273,9 @@ class SGDOptimizer(Optimizer):
 
     def _append_optimize_op(self, block, pg, lr_var):
         p, g = pg
+        op_type = "sparse_sgd" if p.name in self._sparse_params else "sgd"
         block.append_op(
-            "sgd",
+            op_type,
             inputs={"Param": [p.name], "Grad": [g.name],
                     "LearningRate": [lr_var.name]},
             outputs={"ParamOut": [p.name]})
@@ -383,8 +399,10 @@ class AdagradOptimizer(Optimizer):
     def _append_optimize_op(self, block, pg, lr_var):
         p, g = pg
         m = self._get_accumulator("moment", p)
+        op_type = ("sparse_adagrad" if p.name in self._sparse_params
+                   else "adagrad")
         block.append_op(
-            "adagrad",
+            op_type,
             inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
                     "LearningRate": [lr_var.name]},
             outputs={"ParamOut": [p.name], "MomentOut": [m.name]},

@@ -1,0 +1,154 @@
+"""Vocab-sharded embedding islands: shard_map gather + row-exchange.
+
+The manual-SPMD half of the Wide&Deep CTR plan
+(:func:`paddle_tpu.parallel.vocab_sharded_plan`): the [V, D] table lives
+row-sharded over the mesh's vocab axis — each device holds its
+contiguous [V/n, D] block, the in-graph form of the reference's sparse
+parameter server owning embedding rows by parameter block
+(/root/reference/paddle/pserver/ParameterServer2.h:94-100,
+/root/reference/paddle/math/SparseRowMatrix.h). Three islands:
+
+- :func:`vp_lookup` — the forward gather. Every shard gathers the rows
+  it owns (foreign ids contribute zeros) and one psum over the vocab
+  axis exchanges the rows — the "pserver -> trainer" pull as ICI
+  all-reduce traffic. Batch stays sharded on the data axis when it
+  divides, so dp parallelism survives the island.
+- :func:`vp_scatter_add` — the row-granular optimizer write: global
+  (rows, values) broadcast to every shard; each shard applies only the
+  rows in its block (out-of-range ids — including the SelectedRows
+  height sentinel — drop). The "trainer -> pserver" push.
+- :func:`vp_rows_pull` — gather a row-subset of sharded per-row state
+  (adagrad moments) back to every device for the update formula.
+
+All three are exact: each global row is owned by exactly one shard, so
+the psum adds one real value to zeros — bitwise identical to the
+unsharded gather/scatter (pinned by the sparse-vs-dense parity tests).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .compat import shard_map
+
+
+def rows_per_shard(vocab: int, mesh, vocab_axis: str) -> int:
+    """Rows per device block, or 0 when the table cannot shard (axis
+    absent / size 1 / vocab not divisible) — callers fall back to the
+    serial path."""
+    if mesh is None or vocab_axis not in mesh.axis_names:
+        return 0
+    n = mesh.shape[vocab_axis]
+    if n <= 1 or vocab % n:
+        return 0
+    return vocab // n
+
+
+def _data_spec(n_rows: int, mesh, data_axis):
+    """Shard the id/value stream on the data axis when it divides;
+    replicated otherwise (shard_map blocks must tile exactly)."""
+    if (data_axis and data_axis in mesh.axis_names
+            and n_rows % mesh.shape[data_axis] == 0):
+        return data_axis
+    return None
+
+
+def vp_lookup(w, flat_ids, mesh, vocab_axis: str = "mp",
+              data_axis: str = "dp"):
+    """Gather ``w[flat_ids]`` with ``w`` row-sharded over ``vocab_axis``.
+
+    w: [V, D] (annotated P(vocab_axis, None) by the plan); flat_ids: [n]
+    int. Returns [n, D] sharded over ``data_axis`` when n divides.
+    """
+    vl = rows_per_shard(w.shape[0], mesh, vocab_axis)
+    if not vl:
+        return w[flat_ids]
+    da = _data_spec(flat_ids.shape[0], mesh, data_axis)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(vocab_axis, None), P(da)),
+                       out_specs=P(da, None))
+    def run(wl, ids):
+        base = jax.lax.axis_index(vocab_axis) * vl
+        local = ids - base
+        owned = (local >= 0) & (local < vl)
+        rows = jnp.where(owned[:, None],
+                         wl[jnp.clip(local, 0, vl - 1)],
+                         jnp.zeros((), wl.dtype))
+        # the row exchange: each id is owned by exactly ONE shard, so
+        # the all-reduce adds its row to zeros — exact, and it IS the
+        # ICI traffic replacing the pserver round-trip
+        return jax.lax.psum(rows, vocab_axis)
+
+    return run(w, flat_ids)
+
+
+def vp_scatter_add(p, rows, values, mesh, vocab_axis: str = "mp",
+                   mode: str = "add"):
+    """``p.at[rows].add(values)`` (or ``.set`` with ``mode='set'`` —
+    rows must then be deduplicated) with ``p`` row-sharded over
+    ``vocab_axis``. rows may carry the SelectedRows height sentinel
+    (== p.shape[0]) — it lands outside every shard's block and drops.
+    rows/values are broadcast to all shards (in_specs P()): with dp in
+    the mesh each data group carries a distinct slice of the global row
+    stream, so the implied all-gather is the cross-replica gradient
+    exchange."""
+    vl = rows_per_shard(p.shape[0], mesh, vocab_axis)
+    if not vl:
+        upd = p.at[rows]
+        return (upd.set(values, mode="drop") if mode == "set"
+                else upd.add(values, mode="drop"))
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(vocab_axis, None), P(), P()),
+                       out_specs=P(vocab_axis, None))
+    def run(pl, rows_g, vals_g):
+        base = jax.lax.axis_index(vocab_axis) * vl
+        local = rows_g - base
+        owned = (local >= 0) & (local < vl)
+        # disowned rows point past the block; mode='drop' ignores them
+        idx = jnp.where(owned, local, vl)
+        upd = pl.at[idx]
+        if mode == "set":
+            # deduped rows: each local slot is set at most once; foreign
+            # rows all alias index vl and drop
+            return upd.set(jnp.where(owned[:, None], vals_g,
+                                     jnp.zeros((), vals_g.dtype)),
+                           mode="drop")
+        return upd.add(
+            jnp.where(owned[:, None], vals_g,
+                      jnp.zeros((), vals_g.dtype)), mode="drop")
+
+    return run(p, rows, values)
+
+
+def vp_rows_pull(state, rows, mesh, vocab_axis: str = "mp"):
+    """``state[rows]`` with ``state`` row-sharded over ``vocab_axis``:
+    every device gets the full [n, D] row subset (psum-exchange, exactly
+    like :func:`vp_lookup` but replicated — optimizer formulas need the
+    same values on every shard). Sentinel rows read as zero."""
+    vl = rows_per_shard(state.shape[0], mesh, vocab_axis)
+    if not vl:
+        # mode='fill' semantics by hand: sentinel rows read zero
+        n = state.shape[0]
+        safe = jnp.clip(rows, 0, n - 1)
+        return jnp.where((rows < n)[:, None] if state.ndim > 1
+                         else (rows < n), state[safe],
+                         jnp.zeros((), state.dtype))
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(vocab_axis, None), P()),
+                       out_specs=P())
+    def run(sl, rows_g):
+        base = jax.lax.axis_index(vocab_axis) * vl
+        local = rows_g - base
+        owned = (local >= 0) & (local < vl)
+        vals = jnp.where(owned[:, None] if sl.ndim > 1 else owned,
+                         sl[jnp.clip(local, 0, vl - 1)],
+                         jnp.zeros((), sl.dtype))
+        return jax.lax.psum(vals, vocab_axis)
+
+    return run(state, rows)

@@ -446,6 +446,9 @@ class Fleet:
         # fleet-level SLO: evaluated over the MERGED replica histograms
         # (bucket sums), so attainment/burn are correct fleet-wide
         self.slo_tracker = SLOTracker(slo) if slo is not None else None
+        # a paddle_tpu.online.Publisher attaches itself here; /fleet/
+        # status then grows the weights/freshness block
+        self.publisher = None
         self.flight = trace.get_recorder()
         self.replicas: List[Replica] = []
         for i, rep in enumerate(replicas):
@@ -774,6 +777,8 @@ class Fleet:
                        f"{[r.name for r in self.replicas]}")
 
     def _refresh_labels(self) -> None:
+        if self.publisher is not None:
+            self.publisher.refresh_gauges()
         for rep in self.replicas:
             health = rep.healthz()
             self.metrics.set_labeled(
@@ -823,9 +828,11 @@ class Fleet:
             "counters": self.metrics.snapshot()["counters"],
             "fleet": self._decode_latency_cols(merged),
             # always present so fleetctl renders a stable schema: null
-            # when no SLO is configured
+            # when no SLO is configured / no publisher attached
             "slo": (self.slo_tracker.status(self._slo_view(merged))
                     if self.slo_tracker is not None else None),
+            "weights": (self.publisher.status()
+                        if self.publisher is not None else None),
         }
         return status
 
@@ -834,9 +841,12 @@ class Fleet:
         the FLEET's own completed/failed counters (availability is a
         property of the fleet's answers, retries/hedges included — a
         replica-level failure the router absorbed doesn't burn
-        budget)."""
+        budget) + the fleet's own gauges (the publisher's
+        weights-staleness freshness signal)."""
+        snap = self.metrics.snapshot()
         return {"hist": merged.get("hist") or {},
-                "counters": self.metrics.snapshot()["counters"]}
+                "counters": snap["counters"],
+                "gauges": snap.get("gauges") or {}}
 
     def metrics_snapshot(self) -> dict:
         """Fleet registry + MetricsRegistry.merge() of every replica's

@@ -39,12 +39,18 @@ class SLO:
     """A serving service-level objective set. Latency objectives
     (``ttft_ms`` / ``tpot_ms``) are met when at least ``target`` of the
     observations fall under the threshold; ``availability`` is its own
-    target (completed / (completed + failed)). Unset objectives are
-    simply not evaluated."""
+    target (completed / (completed + failed)); ``freshness_s`` is the
+    online-learning objective — the ``weights_staleness_s`` gauge (how
+    many seconds the served weights trail the trainer's newest
+    checkpoint, exported by :class:`paddle_tpu.online.Publisher`) must
+    be under the threshold at ``target`` of the scrape samples, so a
+    stalled publisher burns error budget exactly like a slow decode.
+    Unset objectives are simply not evaluated."""
 
     ttft_ms: Optional[float] = None
     tpot_ms: Optional[float] = None
     availability: Optional[float] = None
+    freshness_s: Optional[float] = None
     target: float = 0.99
     #: (short, long) sliding burn-rate windows, seconds
     windows_s: Tuple[float, float] = (60.0, 300.0)
@@ -65,12 +71,18 @@ class SLO:
         if self.availability is not None:
             out["availability"] = {"kind": "counter",
                                    "target": float(self.availability)}
+        if self.freshness_s is not None:
+            out["freshness"] = {"kind": "gauge",
+                                "metric": "weights_staleness_s",
+                                "threshold_s": float(self.freshness_s),
+                                "target": self.target}
         return out
 
     def to_dict(self) -> dict:
         return {"name": self.name, "ttft_ms": self.ttft_ms,
                 "tpot_ms": self.tpot_ms,
-                "availability": self.availability, "target": self.target,
+                "availability": self.availability,
+                "freshness_s": self.freshness_s, "target": self.target,
                 "windows_s": list(self.windows_s),
                 "burn_thresholds": list(self.burn_thresholds)}
 
@@ -115,15 +127,30 @@ class SLOTracker:
         self._clock = clock
         self._lock = threading.Lock()
         self._samples: deque = deque(maxlen=max_samples)
+        # gauge objectives (freshness) are instantaneous per scrape, so
+        # the tracker itself accumulates the cumulative good/total the
+        # windowed differencing needs
+        self._gauge_cum: Dict[str, list] = {}
 
     def _extract(self, snapshot: dict) -> Dict[str, Tuple[int, int]]:
+        """Cumulative (good, total) per objective. Caller holds the
+        lock (gauge accumulation mutates tracker state)."""
         out = {}
         hists = snapshot.get("hist") or {}
         counters = snapshot.get("counters") or {}
+        gauges = snapshot.get("gauges") or {}
         for name, obj in self.slo.objectives().items():
             if obj["kind"] == "hist":
                 out[name] = _hist_good_total(hists.get(obj["metric"]),
                                              obj["threshold_ms"])
+            elif obj["kind"] == "gauge":
+                cum = self._gauge_cum.setdefault(name, [0, 0])
+                val = gauges.get(obj["metric"])
+                if val is not None:  # absent until a publisher exports
+                    cum[1] += 1
+                    if float(val) <= obj["threshold_s"] * (1 + 1e-9):
+                        cum[0] += 1
+                out[name] = (cum[0], cum[1])
             else:
                 good = int(counters.get("completed", 0))
                 out[name] = (good, good + int(counters.get("failed", 0)))
@@ -132,8 +159,8 @@ class SLOTracker:
     def sample(self, snapshot: dict) -> None:
         """Checkpoint cumulative good/total per objective from a
         :meth:`MetricsRegistry.snapshot` (or fleet-merged) payload."""
-        row = self._extract(snapshot)
         with self._lock:
+            row = self._extract(snapshot)
             self._samples.append((self._clock(), row))
 
     def _window_rates(self, name: str, target: float,
@@ -189,6 +216,7 @@ class SLOTracker:
                 objectives[name] = {
                     "target": target,
                     "threshold_ms": obj.get("threshold_ms"),
+                    "threshold_s": obj.get("threshold_s"),
                     "total": total,
                     "attainment": round(attainment, 6),
                     "error_budget_remaining": round(1.0 - consumed, 4),

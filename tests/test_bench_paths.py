@@ -297,3 +297,19 @@ def test_sharding_bench_path_runs():
     # plan-digest cache key: the timed rounds never recompile
     for leg in ("single", "dp8", "dp4xmp2"):
         assert res[leg]["steady_state_fresh_compiles"] == 0
+
+
+@pytest.mark.slow  # tier-1 budget: the V=1e6 legs are heavy on 1 core
+def test_online_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    res = _bench().bench_online(jax, pt, layers, vocab=20_000, batch=16,
+                                steps=2, warmup=1, storm_s=0.05)
+    assert res["dense_step_ms"] > 0 and res["sparse_step_ms"] > 0
+    # the sparse step's static peak excludes the [V, D] gradient plane
+    assert res["sparse_peak_mb"] < res["dense_peak_mb"]
+    assert res["publish_generation"] == 1
+    assert res["storm_failed"] == 0

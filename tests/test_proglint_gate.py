@@ -1,7 +1,7 @@
 """CI lint gate: tools/proglint.py must run clean over the demo program
-topologies (quick_start, serving_lm) and the op-registry audit, exit
-nonzero on a corrupted saved inference model, and clean on a fresh one.
-New verifier errors in the demos fail tier-1 here."""
+topologies (quick_start, serving_lm, wide_deep) and the op-registry
+audit, exit nonzero on a corrupted saved inference model, and clean on
+a fresh one. New verifier errors in the demos fail tier-1 here."""
 import importlib.util
 import json
 import os
@@ -55,6 +55,32 @@ def test_demo_programs_lint_clean(proglint, capsys):
     assert any("quick_start" in t for t in tags)
     assert any("serving_lm" in t for t in tags)
     assert "<op-registry-audit>" in tags
+
+
+def test_wide_deep_sparse_demo_lints_and_prices_sharded(proglint, capsys):
+    """The online-CTR topology gate: ``--demo wide_deep --mesh dp=4,mp=2
+    --plan vocab --mem`` lints clean (the sparse_* optimizer ops pass
+    the checker) and the memory finding prices the [V, D] tables PER
+    DEVICE under vocab_sharded_plan."""
+    rc = proglint.main(["--demo", "wide_deep", "--mesh", "dp=4,mp=2",
+                        "--plan", "vocab", "--mem", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["errors"] == 0
+    tags = [t["target"] for t in out["targets"]]
+    assert any("wide_deep[train]" in t for t in tags)
+    assert any("wide_deep[serve]" in t for t in tags)
+    mem = [i for t in out["targets"] for i in t["issues"]
+           if i["rule"] == "memory-budget"
+           and "wide_deep[train]" == t["target"]]
+    assert mem and "PER DEVICE" in mem[0]["message"]
+    # per-device peak must be well under the UNSHARDED table footprint:
+    # the [100000, 16] + [100000, 1] tables alone are ~6.8 MB x2 (param
+    # + moment) unsharded; vocab-sharded over mp=2 the peak halves
+    unsharded = 2 * (100_000 * 17 * 4)
+    peak_gb = float(mem[0]["message"].split("static peak HBM ")[1]
+                    .split(" GB")[0])
+    assert peak_gb * 1e9 < 0.75 * unsharded, mem[0]["message"]
 
 
 def test_fresh_saved_model_lints_clean(proglint, tmp_path, capsys):

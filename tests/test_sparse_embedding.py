@@ -164,6 +164,34 @@ def test_sparse_padding_idx_gets_no_update():
     assert not np.allclose(w1[3], w0[3])
 
 
+def test_sparse_grad_accumulation_densifies():
+    """Gradient accumulation over a sparse param: the ``acc += grad``
+    elementwise add takes the SelectedRows' dense view (regression: it
+    used to crash on y.ndim), off-step runs leave the param untouched,
+    and the k-th run applies the mean."""
+    vocab, dim = 32, 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[3], dtype="int64")
+        emb = layers.embedding(ids, size=[vocab, dim], is_sparse=True)
+        loss = layers.mean(emb)
+        pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(
+            loss, startup_program=startup, accumulate_steps=2)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    exe.run(startup, scope=scope)
+    emb_name = [k for k in scope.keys()
+                if "embedding" in k and ".w" in k and "_acc" not in k][0]
+    w0 = np.asarray(scope.get(emb_name)).copy()
+    feed = {"ids": np.array([[1, 2, 3]], np.int64)}
+    exe.run(main, feed=feed, scope=scope)
+    np.testing.assert_array_equal(np.asarray(scope.get(emb_name)), w0)
+    exe.run(main, feed=feed, scope=scope)  # k-th run: the mean applies
+    w2 = np.asarray(scope.get(emb_name))
+    assert not np.allclose(w2[[1, 2, 3]], w0[[1, 2, 3]])
+    np.testing.assert_array_equal(w2[0], w0[0])
+
+
 def test_sum_op_mixes_sparse_and_dense():
     """Grad fan-out: embedding used twice -> sum of two SelectedRows stays
     sparse; mixing with a dense contribution densifies."""

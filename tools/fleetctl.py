@@ -72,6 +72,16 @@ def render_status_table(status: dict) -> str:
         f"{_fmt_ms(fleet_row.get('ttft_p99_ms')):>10}"
         f"{_fmt_ms(fleet_row.get('tpot_p50_ms')):>10}"
         f"{_fmt_ms(fleet_row.get('tpot_p99_ms')):>10}")
+    weights = status.get("weights")
+    if weights:
+        lines.append("")
+        lines.append(
+            f"WEIGHTS    version={weights.get('published_step')} "
+            f"latest={weights.get('latest_step')} "
+            f"staleness={weights.get('staleness_s')}s "
+            f"generations={weights.get('generations')}"
+            + (f"  last_error={weights['last_error']}"
+               if weights.get("last_error") else ""))
     slo = status.get("slo")
     if slo:
         lines.append("")
@@ -82,9 +92,11 @@ def render_status_table(status: dict) -> str:
                 f"{win}={w.get('burn_rate')}x"
                 for win, w in sorted((obj.get("burn") or {}).items()))
             thr = obj.get("threshold_ms")
+            thr_s = obj.get("threshold_s")
             lines.append(
                 f"  {name:<14}"
-                + (f"<{thr:g}ms " if thr is not None else "")
+                + (f"<{thr:g}ms " if thr is not None else
+                   f"<{thr_s:g}s " if thr_s is not None else "")
                 + f"target={obj.get('target')} "
                   f"attainment={obj.get('attainment')} "
                   f"budget_remaining={obj.get('error_budget_remaining')} "

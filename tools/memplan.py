@@ -96,7 +96,8 @@ def main(argv=None) -> int:
                     help="save_inference_model directories to analyze")
     ap.add_argument("--demo", action="append", default=[],
                     help="analyze a demo's program topologies "
-                         "(quick_start, serving_lm; repeatable)")
+                         "(quick_start, serving_lm, wide_deep; "
+                         "repeatable)")
     ap.add_argument("--batch", type=int, default=16,
                     help="batch size substituted for -1 dims (default 16)")
     ap.add_argument("--top", type=int, default=10,

@@ -27,7 +27,7 @@ from typing import List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DEMOS = ("quick_start", "serving_lm")
+DEMOS = ("quick_start", "serving_lm", "wide_deep")
 
 
 # --------------------------------------------------------------------------
@@ -151,6 +151,32 @@ def build_demo(name: str):
         yield ("serving_lm[paged_decode]", dprog,
                ["serving.tok", "serving.pos", "serving.block_table"],
                [dnxt.name], eng.scope)
+    elif name == "wide_deep":
+        # the online-CTR topology (demos/online_ctr.py): sparse high-dim
+        # embeddings whose SelectedRows grads feed the row-granular
+        # sparse_* optimizer ops — with --mesh dp=4,mp=2 --plan vocab
+        # the [V, D] tables price PER DEVICE (vocab_sharded_plan)
+        from paddle_tpu.dataset import ctr
+
+        vocab = 100_000
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[ctr.SLOTS], dtype="int64")
+            dense = layers.data("dense", shape=[ctr.DENSE_DIM])
+            label = layers.data("label", shape=[1])
+            logit = models.wide_deep(ids, dense, vocab_size=vocab,
+                                     embed_dim=16, hidden_sizes=(64, 32))
+            loss, prob = models.wide_deep_loss(logit, label)
+            pt.optimizer.AdagradOptimizer(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+        yield ("wide_deep[train]", main, ["ids", "dense", "label"],
+               [loss.name, prob.name], None)
+        yield ("wide_deep[train]/startup", startup, [], [], None)
+        from paddle_tpu import io as io_mod
+
+        serve = io_mod.prune_program(main, ["ids", "dense"], [prob.name])
+        yield ("wide_deep[serve]", serve, ["ids", "dense"], [prob.name],
+               None)
     else:
         raise SystemExit(f"unknown --demo {name!r} (have: {DEMOS})")
 
