@@ -1227,6 +1227,113 @@ def bench_paged_kv(jax, pt, layers, models, tmax=2048, page_size=64,
     }
 
 
+def bench_decode_platform(jax, pt, layers, models, tmax=512, page_size=16,
+                          slots=8, prompt_len=24, max_new=16,
+                          n_requests=16, d=32, L=2, H=4, vocab=128,
+                          beam_k=4, beam_new=12):
+    """Decode-platform A/Bs on the paged engine.
+
+    (a) **Sampled-vs-greedy overhead**: the same workload served all-
+    greedy vs a mixed batch (greedy + temperature + top-p + top-k rows)
+    through the per-request sampling plane — the delta prices the
+    per-row sort/filter/categorical inside the one compiled step (and
+    pins that the mix adds ZERO fresh compiles).
+    (b) **Beam-K page bytes**: beam search as refcounted paged forks vs
+    the dense K-copy baseline (K independent sequences of the same
+    horizon) — forked beams share the prompt's pages, so the pool
+    high-water is sub-linear in K.
+    CPU row is the witness; the TPU row prices the same config on HBM.
+    """
+    from paddle_tpu.decoding import SamplingParams
+    from paddle_tpu.serving import GenerationEngine, LMSpec
+
+    spec = LMSpec(vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+                  max_len=tmax)
+
+    def lm_scope(seed=7):
+        scope = pt.Scope()
+        exe = pt.Executor(pt.TPUPlace())
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            p = layers.data("p_init", shape=[8], dtype="int64")
+            models.transformer_lm_generate(
+                p, vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+                max_len=tmax, max_new_tokens=1)
+        startup.random_seed = seed
+        exe.run(startup, scope=scope)
+        return scope
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, vocab, (prompt_len,)).astype("int64")
+               for _ in range(n_requests)]
+    policies = [None,
+                SamplingParams(temperature=0.8, seed=11),
+                SamplingParams(temperature=1.0, top_p=0.9, seed=12),
+                SamplingParams(temperature=0.7, top_k=16, seed=13)]
+    mixed = [policies[i % len(policies)] for i in range(n_requests)]
+
+    def serve(sampling):
+        eng = GenerationEngine(spec, lm_scope(), slots=slots,
+                               max_seq_len=tmax, page_size=page_size,
+                               prefix_sharing=False,
+                               prompt_buckets=(prompt_len,))
+        eng.warmup()
+        misses0 = eng.cache_stats()["misses"]
+        t0 = time.perf_counter()
+        outs = eng.generate_all(prompts, max_new_tokens=max_new,
+                                sampling=sampling)
+        wall = time.perf_counter() - t0
+        toks = sum(len(o) for o in outs) - n_requests * prompt_len
+        return {"wall_s": round(wall, 3),
+                "ms_per_token": round(1e3 * wall / toks, 3),
+                "fresh_compiles": eng.cache_stats()["misses"] - misses0}
+
+    greedy_leg = serve(None)
+    mixed_leg = serve(mixed)
+
+    # beam forks vs the dense K-copy baseline (pool high-water)
+    prompt = prompts[0]
+    entries = -(-(prompt_len + beam_new) // page_size)
+    dense_copy_pages = beam_k * entries  # K independent full copies
+    eng = GenerationEngine(spec, lm_scope(), slots=beam_k + 1,
+                           max_seq_len=tmax, page_size=page_size,
+                           beam_width=beam_k, prefix_sharing=False,
+                           prompt_buckets=(prompt_len,))
+    hwm = [0]
+    orig = eng._gauges
+
+    def gauged():
+        orig()
+        hwm[0] = max(hwm[0], eng.pool.pages_in_use())
+    eng._gauges = gauged
+    t0 = time.perf_counter()
+    ids, scores = eng.generate_beam(prompt, beam_size=beam_k,
+                                    max_new_tokens=beam_new)
+    beam_wall = time.perf_counter() - t0
+    beam_leg = {
+        "beam_size": beam_k, "max_new": beam_new,
+        "wall_s": round(beam_wall, 3),
+        "pages_hwm": hwm[0],
+        "dense_copy_pages": dense_copy_pages,
+        "page_bytes_ratio": round(hwm[0] / dense_copy_pages, 3),
+        "forks": eng.metrics.counter("beam_forks"),
+        "cow_copies": eng.metrics.counter("kv_cow_copies"),
+    }
+    return {
+        "config": {"tmax": tmax, "page_size": page_size, "slots": slots,
+                   "prompt_len": prompt_len, "max_new": max_new,
+                   "n_requests": n_requests,
+                   "model": f"d{d} L{L} h{H} V{vocab}"},
+        "greedy": greedy_leg,
+        "mixed_sampling": mixed_leg,
+        "sampling_overhead": round(
+            mixed_leg["ms_per_token"] / max(1e-9,
+                                            greedy_leg["ms_per_token"])
+            - 1.0, 3),
+        "beam": beam_leg,
+    }
+
+
 def _sharding_measure(jax, pt, layers, batch=64, dim=256, steps=12,
                       rounds=3, warmup=2):
     """The one-sharding-plane A/B, run on whatever devices this process
@@ -1838,6 +1945,11 @@ def run_bench(platform):
     # on the paged decode path: host-side span cost, CPU row is the
     # witness for the <1% budget
     step("obs_overhead", bench_obs_overhead, jax, pt, layers, models)
+    # decode platform: sampled-vs-greedy overhead through the per-row
+    # sampling plane + beam-as-paged-forks page bytes vs a dense K-copy
+    # (host/cache-layout plane; the CPU row is the witness)
+    step("decode_platform", bench_decode_platform, jax, pt, layers,
+         models)
     # online-learning plane: dense-vs-sparse V=1e6 optimizer step +
     # rows-touched scaling + publish-swap latency under live traffic
     # (sparse update + publisher are host/HBM-stream planes; the CPU

@@ -9,7 +9,8 @@ jax.vjp, and every distributed path is in-graph collectives over ICI/DCN
 instead of parameter servers. See SURVEY.md at the repo root for the full
 mapping onto the reference.
 """
-from . import (analysis, checkpoint, clip, evaluator, event, initializer,
+from . import (analysis, checkpoint, clip, decoding, evaluator, event,
+               initializer,
                layers, learning_rate_decay, master, models, nets, online,
                optimizer, parallel, profiler, regularizer, resilience,
                serving, trace, trainer, transpiler)

@@ -487,6 +487,27 @@ def _slot_cache_cost(attrs, ins, outs):
     return _io_cost(flops, ins, outs)
 
 
+def _encdec_cost(attrs, ins, outs):
+    """transformer_encdec_* family (seq2seq): stacked encoder/decoder
+    passes — FLOPs from every [L, in, out] weight plane applied to the
+    op's token count (source tokens for encode, source + target for the
+    teacher, slot rows for the cross decode), bytes from the full I/O
+    stream, which prices the cross-KV planes ``[L, S+1, Hkv, Ts, dh]``
+    as read state — the memplan gate sees the encoder-decoder config's
+    extra resident bytes."""
+    toks = 0.0
+    for slot in ("SrcIds", "TgtIn", "Chunk", "Tok"):
+        x = _first(ins, slot)
+        if x is not None:
+            toks += float(np.prod(x.shape))
+    flops = 0.0
+    for arrs in (ins or {}).values():
+        for w in arrs:
+            if len(w.shape) == 3:
+                flops += 2.0 * toks * float(w.shape[1]) * float(w.shape[2])
+    return _io_cost(flops, ins, outs)
+
+
 def _paged_cache_cost(attrs, ins, outs):
     """transformer_stack_paged_prefill/decode: the slot-cache cost plus
     the per-row gathered context — every row streams its table-width
@@ -641,6 +662,9 @@ def _register_all() -> None:
         _slot_cache_cost)
     reg(("transformer_stack_paged_prefill", "transformer_stack_paged_decode"),
         _paged_cache_cost)
+    reg(("transformer_encdec_encode", "transformer_encdec_teacher",
+         "transformer_stack_cross_prefill",
+         "transformer_stack_cross_decode"), _encdec_cost)
     reg(("kv_cache_page_copy",), _movement)
     cost_exempt(*[n for n in _EXEMPT if has_op(n)])
 

@@ -1,0 +1,43 @@
+"""paddle_tpu.decoding — the decode platform.
+
+What turns "an LM server" into a decode platform: policy moves from the
+engine to the REQUEST, and every policy rides the same compiled step.
+
+- :class:`SamplingParams` — per-request temperature / top-k / top-p /
+  seed / max_tokens / stop sequences, carried as device arrays gathered
+  per slot inside the one decode computation: mixed greedy-and-sampled
+  batches keep the zero-recompile steady state, and sampled tokens are a
+  pure function of (request, seed) — invariant to batch composition,
+  tick interleaving, and fleet hedging.
+- :class:`LogitsProcessor` / :class:`JsonSchemaMask` — the per-step
+  token-mask hook (host-computed [vocab] rows fed per tick):
+  grammar-constrained decoding is a mask away once the hook exists.
+- :class:`StopMatcher` — token-sequence stops with mid-page truncation.
+- :class:`BeamJob` (``engine.generate_beam`` / ``beam_size`` request
+  meta) — beam search as paged-cache forks: a hypothesis fork is a
+  refcounted block-table copy with copy-on-write on divergence, so beams
+  share their whole common prefix in HBM; token-exact against the fused
+  ``transformer_stack_beam_search`` reference.
+- :class:`Seq2SeqGenerationEngine` — the encoder-decoder (NMT) config:
+  cross-attention K/V computed ONCE at admission into a slot-resident
+  cache alongside the self-attention page pool; beam forks SHARE the
+  parent's cross-KV row (it is read-only after admission).
+"""
+from .beam import BeamJob
+from .masks import JsonSchemaMask, LogitsProcessor, TokenBanMask
+from .params import BeamParams, SamplingParams
+from .stops import StopMatcher
+
+__all__ = [
+    "SamplingParams", "BeamParams", "BeamJob", "StopMatcher",
+    "LogitsProcessor", "TokenBanMask", "JsonSchemaMask",
+    "Seq2SeqSpec", "Seq2SeqGenerationEngine",
+]
+
+
+def __getattr__(name):  # lazy: seq2seq imports serving (cycle-free)
+    if name in ("Seq2SeqSpec", "Seq2SeqGenerationEngine"):
+        from . import seq2seq
+
+        return getattr(seq2seq, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

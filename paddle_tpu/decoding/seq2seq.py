@@ -1,0 +1,445 @@
+"""Seq2seq (NMT) serving: the encoder-decoder GenerationEngine config.
+
+:class:`Seq2SeqGenerationEngine` extends the paged continuous batcher
+with the encoder-decoder split:
+
+- **Admission runs the encoder once.** A request carries a SOURCE
+  sentence; admission buckets it, runs ``transformer_encdec_encode``,
+  and parks the per-layer cross-attention K/V in a slot-resident cache
+  ``[L, slots+1, Hkv, Ts, dh]`` (row ``slots`` is scrap) next to the
+  self-attention page pool — the analysis plane prices both.
+- **Decode is the paged loop plus one cross read per layer.** The
+  decoder is the stacked LM (same weight contract) whose
+  ``transformer_stack_cross_decode`` step additionally attends the
+  request's parked encoder rows via a per-slot ``XSlot`` index.
+- **Beam forks share the source.** The cross cache is read-only after
+  admission, so a hypothesis fork bumps a refcount on its parent's
+  cross row instead of copying [L, Hkv, Ts, dh] bytes — K beams of one
+  translation carry ONE copy of the source K/V (and share their target
+  prefix pages through the usual copy-on-write fork).
+
+Prefix sharing is force-disabled: decoder K/V depend on the source
+through cross-attention, so pages are NOT reusable across requests with
+different sources (the sharing contract would silently serve another
+sentence's translation state).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.program import Program, program_guard
+from ..layers import data as data_layer
+from ..layers.layer_helper import LayerHelper
+from ..serving.batcher import Request
+from ..serving.errors import BadRequestError
+from ..serving.generation import (LMSpec, PAGED_CACHE_K, PAGED_CACHE_V,
+                                  PagedGenerationEngine)
+
+CROSS_K = "serving.cross_k"
+CROSS_V = "serving.cross_v"
+
+
+@dataclasses.dataclass
+class Seq2SeqSpec:
+    """Hyperparameters of the transformer NMT model (the
+    ``models.shared_nmt_params`` weight contract)."""
+
+    src_vocab_size: int
+    tgt_vocab_size: int
+    d_model: int
+    n_layers: int
+    num_heads: int
+    num_kv_heads: Optional[int] = None
+    max_src_len: int = 64
+    max_tgt_len: int = 64
+    d_ff: Optional[int] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    def lm_spec(self) -> LMSpec:
+        """The decoder viewed as a stacked LM (what the base engine
+        machinery sizes its programs and pools by)."""
+        return LMSpec(vocab_size=self.tgt_vocab_size,
+                      d_model=self.d_model, n_layers=self.n_layers,
+                      num_heads=self.num_heads,
+                      num_kv_heads=self.num_kv_heads,
+                      max_len=self.max_tgt_len, d_ff=self.d_ff)
+
+
+def _default_src_buckets(tsmax: int) -> List[int]:
+    buckets, b = [], 8
+    while b < tsmax:
+        buckets.append(b)
+        b *= 2
+    buckets.append(tsmax)
+    return sorted(set(buckets))
+
+
+class Seq2SeqGenerationEngine(PagedGenerationEngine):
+    """Continuous batching for encoder-decoder generation; see the
+    module docstring. Payloads are ``{"src": [ids]}`` with an optional
+    ``"prompt"`` target prefix (default ``[bos_id]``); everything else —
+    per-request SamplingParams, stop sequences, token masks, beam
+    requests, warmup manifests, metrics — is inherited from the decode
+    platform."""
+
+    _cache_names = (PAGED_CACHE_K, PAGED_CACHE_V, CROSS_K, CROSS_V)
+
+    def __init__(self, spec: Seq2SeqSpec, scope=None, *,
+                 bos_id: int = 0,
+                 src_buckets: Optional[Sequence[int]] = None,
+                 beam_width: int = 4, **kw):
+        self.seq2seq = spec
+        self.bos_id = int(bos_id)
+        self.src_buckets = sorted(set(
+            min(int(b), spec.max_src_len)
+            for b in (src_buckets
+                      or _default_src_buckets(spec.max_src_len))))
+        kw.pop("prefix_sharing", None)  # unsound across sources
+        super().__init__(spec.lm_spec(), scope, beam_width=beam_width,
+                         prefix_sharing=False, **kw)
+
+    # -- cross-KV cache ----------------------------------------------------
+    def _init_cache(self):
+        import jax.numpy as jnp
+
+        super()._init_cache()
+        s = self.seq2seq
+        # row `slots` is the scrap row (vacant decode slots attend it)
+        shape = (s.n_layers, self.slots + 1, s.kv_heads, s.max_src_len,
+                 s.head_dim)
+        self.scope.set(CROSS_K, jnp.zeros(shape, jnp.float32))
+        self.scope.set(CROSS_V, jnp.zeros(shape, jnp.float32))
+        # host-side cross-row accounting: a request takes one row at
+        # admission; beam forks share it by refcount
+        self._xrow_free = list(range(self.slots - 1, -1, -1))
+        self._xrow_ref = np.zeros(self.slots, np.int32)
+        self._xrow_len = np.ones(self.slots, np.int32)
+        self._encode_progs: Dict[int, tuple] = {}
+        self.metrics.set_gauge(
+            "mem/cross_kv_bytes", 2.0 * float(np.prod(shape)) * 4)
+
+    def _cross_cache_vars(self, helper):
+        s = self.seq2seq
+        shape = [s.n_layers, self.slots + 1, s.kv_heads, s.max_src_len,
+                 s.head_dim]
+        xk = helper.create_global_variable(name=CROSS_K, shape=shape,
+                                           dtype="float32")
+        xv = helper.create_global_variable(name=CROSS_V, shape=shape,
+                                           dtype="float32")
+        return xk, xv
+
+    def _cross_weight_ins(self, helper):
+        from ..models.seq2seq import _cross_params
+
+        ins = _cross_params(helper, self.seq2seq.n_layers,
+                            self.seq2seq.d_model,
+                            self.seq2seq.kv_heads * self.seq2seq.head_dim)
+        ins.pop("XKvW")  # encode-time only
+        return ins
+
+    # -- program construction ---------------------------------------------
+    @property
+    def _prefill_feed_names(self):
+        return super()._prefill_feed_names + ["serving.xslot",
+                                              "serving.src_len"]
+
+    @property
+    def _decode_feed_names(self):
+        return super()._decode_feed_names + ["serving.xslot",
+                                             "serving.src_len"]
+
+    def _sampling_vars(self, rows):
+        ins = super()._sampling_vars(rows)
+        if rows is None:  # prefill: batch-dim scalars
+            xs = data_layer("serving.xslot", shape=[], dtype="int32")
+            sl = data_layer("serving.src_len", shape=[], dtype="int32")
+        else:
+            xs = data_layer("serving.xslot", shape=[rows], dtype="int32",
+                            append_batch_size=False)
+            sl = data_layer("serving.src_len", shape=[rows],
+                            dtype="int32", append_batch_size=False)
+        ins["XSlot"] = [xs]
+        ins["SrcLen"] = [sl]
+        return ins
+
+    def _neutral_sampling_feed(self, rows: int):
+        feed = super()._neutral_sampling_feed(rows)
+        # vacant rows attend the scrap cross row, one position deep
+        feed["serving.xslot"] = np.full(rows, self.slots, np.int32)
+        feed["serving.src_len"] = np.ones(rows, np.int32)
+        return feed
+
+    def _slot_sampling_feed(self, row, st, feed, step):
+        super()._slot_sampling_feed(row, st, feed, step)
+        if st.xrow is not None:
+            feed["serving.xslot"][row] = st.xrow
+            feed["serving.src_len"][row] = self._xrow_len[st.xrow]
+
+    def _build_prefill(self, tc: int):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            chunk = data_layer("serving.chunk", shape=[tc], dtype="int64")
+            start = data_layer("serving.start", shape=[], dtype="int32")
+            length = data_layer("serving.chunk_len", shape=[],
+                                dtype="int32")
+            table = data_layer("serving.block_table", shape=[self.pmax],
+                               dtype="int32")
+            helper = LayerHelper("serving_cross_prefill",
+                                 main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            xk, xv = self._cross_cache_vars(helper)
+            nxt = helper.block.create_var(
+                name="serving.next_tok", shape=[-1],
+                dtype="int64", stop_gradient=True)
+            ins = {"Chunk": [chunk], "StartPos": [start],
+                   "Lengths": [length], "BlockTable": [table],
+                   "CacheK": [ck], "CacheV": [cv],
+                   "CrossK": [xk], "CrossV": [xv]}
+            ins.update(self._sampling_vars(None))
+            ins.update(self._lm_ins(helper))
+            ins.update(self._cross_weight_ins(helper))
+            outs = {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]}
+            outs.update(self._beam_out_vars(helper, 0, "serving.pf"))
+            helper.append_op("transformer_stack_cross_prefill", ins,
+                             outs, self._decode_attrs())
+        fetches = [nxt.name] + [v[0].name for k, v in sorted(outs.items())
+                                if k in ("TopV", "TopI")]
+        self._transpile(prog, list(self._prefill_feed_names), fetches,
+                        f"transpile/prefill{tc}/")
+        return prog, outs
+
+    def _build_decode(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            tok = data_layer("serving.tok", shape=[self._nslots],
+                             dtype="int64", append_batch_size=False)
+            pos = data_layer("serving.pos", shape=[self._nslots],
+                             dtype="int32", append_batch_size=False)
+            table = data_layer("serving.block_table",
+                               shape=[self._nslots, self.pmax],
+                               dtype="int32", append_batch_size=False)
+            helper = LayerHelper("serving_cross_decode",
+                                 main_program=prog,
+                                 startup_program=startup)
+            ck, cv = self._cache_vars(helper)
+            xk, xv = self._cross_cache_vars(helper)
+            nxt = helper.block.create_var(
+                name="serving.next_tok",
+                shape=[self._nslots], dtype="int64", stop_gradient=True)
+            ins = {"Tok": [tok], "Pos": [pos], "BlockTable": [table],
+                   "CacheK": [ck], "CacheV": [cv],
+                   "CrossK": [xk], "CrossV": [xv]}
+            ins.update(self._sampling_vars(self._nslots))
+            ins.update(self._lm_ins(helper))
+            ins.update(self._cross_weight_ins(helper))
+            outs = {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]}
+            outs.update(self._beam_out_vars(helper, self._nslots,
+                                            "serving.dec"))
+            helper.append_op("transformer_stack_cross_decode", ins,
+                             outs, self._decode_attrs())
+        fetches = [nxt.name] + [v[0].name for k, v in sorted(outs.items())
+                                if k in ("TopV", "TopI")]
+        self._transpile(prog, list(self._decode_feed_names), fetches,
+                        "transpile/decode/")
+        return prog, outs
+
+    def _build_encode(self, ts: int):
+        from ..models.seq2seq import _cross_params, _encoder_params
+
+        s = self.seq2seq
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            src = data_layer("serving.src", shape=[ts], dtype="int64")
+            n = data_layer("serving.src_n", shape=[], dtype="int32")
+            row = data_layer("serving.src_row", shape=[], dtype="int32")
+            helper = LayerHelper("serving_encode", main_program=prog,
+                                 startup_program=startup)
+            xk, xv = self._cross_cache_vars(helper)
+            ok = helper.block.create_var(
+                name="serving.enc_ok", shape=[-1], dtype="int32",
+                stop_gradient=True)
+            ins = {"SrcIds": [src], "SrcLen": [n], "SlotIds": [row],
+                   "CrossK": [xk], "CrossV": [xv]}
+            ins.update(_encoder_params(
+                helper, s.src_vocab_size, s.d_model,
+                s.d_ff or 4 * s.d_model, s.max_src_len, s.n_layers,
+                s.num_heads, s.num_kv_heads))
+            ins["XKvW"] = _cross_params(
+                helper, s.n_layers, s.d_model,
+                s.kv_heads * s.head_dim)["XKvW"]
+            helper.append_op(
+                "transformer_encdec_encode", ins,
+                {"Ok": [ok], "CrossK": [xk], "CrossV": [xv]},
+                {"num_heads": s.num_heads,
+                 "num_kv_heads": s.num_kv_heads})
+        self._transpile(prog, ["serving.src", "serving.src_n",
+                               "serving.src_row"], [ok.name],
+                        f"transpile/encode{ts}/")
+        return prog, ok
+
+    def _encode_prog(self, ts: int):
+        if ts not in self._encode_progs:
+            self._encode_progs[ts] = self._build_encode(ts)
+        return self._encode_progs[ts]
+
+    def _src_bucket_for(self, n: int) -> int:
+        for b in self.src_buckets:
+            if n <= b:
+                return b
+        raise BadRequestError(
+            f"source length {n} exceeds the largest source bucket "
+            f"{self.src_buckets[-1]}")
+
+    # -- admission ---------------------------------------------------------
+    def _validate(self, req: Request):
+        payload = req.payload
+        if not isinstance(payload, dict) or payload.get("src") is None:
+            raise BadRequestError(
+                "seq2seq request needs {'src': [ids]} (+ optional "
+                "'prompt' target prefix)")
+        try:
+            src = np.asarray(payload["src"], np.int64).reshape(-1)
+        except (TypeError, ValueError) as exc:
+            raise BadRequestError(f"bad src payload: {exc}")
+        if src.size < 1:
+            raise BadRequestError("empty src")
+        self._src_bucket_for(src.size)  # raises when over-long
+        if payload.get("prompt") is None:
+            req.payload = dict(payload,
+                               prompt=np.asarray([self.bos_id], np.int64))
+        parsed = super()._validate(req)
+        req.meta["_src"] = src
+        return parsed
+
+    def _take_xrow(self, src: np.ndarray) -> int:
+        if not self._xrow_free:  # slots >= requests, so rows suffice
+            raise RuntimeError("cross-KV rows exhausted (engine bug)")
+        row = self._xrow_free.pop()
+        self._xrow_ref[row] = 1
+        self._xrow_len[row] = src.size
+        return row
+
+    def _release_pages(self, st) -> None:
+        super()._release_pages(st)
+        if getattr(st, "xrow", None) is not None:
+            row = st.xrow
+            st.xrow = None
+            self._xrow_ref[row] -= 1
+            if self._xrow_ref[row] == 0:
+                self._xrow_free.append(row)
+
+    def _encode_src(self, row: int, src: np.ndarray) -> None:
+        """The once-per-request encoder pass: bucket the source, run
+        transformer_encdec_encode into cross row ``row``."""
+        import time
+
+        from .. import profiler, trace
+
+        ts = self._src_bucket_for(src.size)
+        prog, ok = self._encode_prog(ts)
+        feed = {
+            "serving.src": np.full((1, ts), 0, np.int64),
+            "serving.src_n": np.asarray([src.size], np.int32),
+            "serving.src_row": np.asarray([row], np.int32),
+        }
+        feed["serving.src"][0, :src.size] = src
+        t0 = time.perf_counter()
+        with self._device_ctx(), profiler.timer("serving/encode"), \
+                trace.span("serving/encode", src_len=int(src.size),
+                           bucket=ts):
+            self.executor.run(prog, feed=feed, fetch_list=[ok],
+                              scope=self.scope)
+        self.metrics.observe_latency(time.perf_counter() - t0,
+                                     name="encode")
+        self.metrics.inc("encodes")
+
+    def _admit_one(self, req, prompt, max_new, eos, sampling, beam,
+                   group) -> str:
+        r = super()._admit_one(req, prompt, max_new, eos, sampling, beam,
+                               group=group)
+        if r != "ok":
+            return r
+        slot = next(i for i, st in enumerate(self._slots)
+                    if st is not None and st.request is req
+                    and st.role in ("normal", "beam_parent"))
+        src = req.meta["_src"]
+        row = self._take_xrow(src)
+        self._slots[slot].xrow = row
+        self._encode_src(row, src)
+        return r
+
+    # -- beam forks share the cross row ------------------------------------
+    def _beam_fork(self, src_slot: int, hold_slot: int,
+                   n_written: int) -> int:
+        slot = super()._beam_fork(src_slot, hold_slot, n_written)
+        row = self._slots[src_slot].xrow
+        self._slots[slot].xrow = row
+        self._xrow_ref[row] += 1
+        return slot
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self) -> int:
+        combos = super().warmup()
+        for ts in self.src_buckets:
+            prog, ok = self._encode_prog(ts)
+            feed = {"serving.src": np.zeros((1, ts), np.int64),
+                    "serving.src_n": np.ones(1, np.int32),
+                    "serving.src_row": np.full(1, self.slots, np.int32)}
+            with self._device_ctx():
+                self.executor.run(prog, feed=feed, fetch_list=[ok],
+                                  scope=self.scope)
+            combos += 1
+        self.metrics.inc("warmup_compiles", len(self.src_buckets))
+        return combos
+
+    def _warm_programs(self):
+        progs = super()._warm_programs()
+        progs.extend(self._encode_prog(ts)[0] for ts in self.src_buckets)
+        return progs
+
+    # -- convenience -------------------------------------------------------
+    def translate(self, sources: Sequence[Sequence[int]],
+                  max_new_tokens: Optional[int] = None,
+                  eos_id: Optional[int] = None,
+                  sampling=None) -> List[np.ndarray]:
+        """Greedy/sampled translation of a source batch; returns
+        [bos + generated target ids] per source."""
+        from .params import SamplingParams
+
+        max_new = max_new_tokens or self.default_max_new_tokens
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling] * len(list(sources))
+        reqs = [Request({"src": s},
+                        {"max_new_tokens": max_new, "eos_id": eos_id,
+                         "sampling_params": sp}, None)
+                for s, sp in zip(sources, sampling)]
+        self._drive(reqs)
+        return [r.future.result(timeout=0.1) for r in reqs]
+
+    def translate_beam(self, src: Sequence[int], beam_size: int = 4,
+                       max_new_tokens: Optional[int] = None,
+                       eos_id: Optional[int] = None,
+                       length_penalty: float = 0.0,
+                       return_all: bool = True):
+        """Beam-search translation of ONE source sentence: the NMT
+        config's fused story — encoder at admission, beams as paged
+        forks sharing the source's cross-KV row."""
+        req = Request({"src": src},
+                      {"max_new_tokens": (max_new_tokens
+                                          or self.default_max_new_tokens),
+                       "eos_id": eos_id, "beam_size": int(beam_size),
+                       "length_penalty": float(length_penalty),
+                       "return_beams": bool(return_all)}, None)
+        self._drive([req])
+        return req.future.result(timeout=0.1)

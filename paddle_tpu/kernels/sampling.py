@@ -1,0 +1,103 @@
+"""Per-row token selection for the decode platform.
+
+One batched computation selects the next token for EVERY decode row at
+once, with each row carrying its OWN sampling policy as device scalars:
+temperature (0 = greedy argmax), top-k (0 = off), top-p (1.0 = off), a
+per-row seed, and the row's sampling step. Randomness derives from
+``fold_in(PRNGKey(seed), step)`` alone — never from a shared stream — so
+a row's token is a pure function of (logits, policy, seed, step),
+invariant to batch composition, tick interleaving, and which other
+requests happen to be co-scheduled. That is the property that makes
+mixed greedy/sampled continuous batches safe under one compile and lets
+hedged fleet attempts reproduce each other's tokens.
+
+``masked_logprobs``/``top_logprobs`` are the beam-search twins: the
+per-row log-softmax (mask applied first) and its top-K — computed inside
+the same decode computation so a beam fork never re-runs the model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+# on rows whose mask bans everything (the host validates masks, but the
+# device math must not poison the batch if one slips through)
+
+
+def apply_mask(logits, mask):
+    """Ban tokens where ``mask`` <= 0 (mask is [rows, V] float, 1 = allowed).
+    None = no constraint."""
+    if mask is None:
+        return logits
+    return jnp.where(mask > 0, logits, _NEG_INF)
+
+
+def masked_logprobs(logits, mask=None):
+    """Per-row log-softmax with the token mask applied first — the
+    scoring plane beam search expands on."""
+    z = apply_mask(logits.astype(jnp.float32), mask)
+    return jax.nn.log_softmax(z, axis=-1)
+
+
+def top_logprobs(logits, k: int, mask=None):
+    """(values [rows, k], ids [rows, k]) — each row's top-k masked
+    log-probs, descending (lax.top_k tie-break: lower token id wins)."""
+    lp = masked_logprobs(logits, mask)
+    vals, ids = jax.lax.top_k(lp, k)
+    return vals, ids.astype(jnp.int32)
+
+
+def _topk_filter(z, top_k):
+    """Per-row top-k: keep each row's k largest logits (k = 0 disables).
+    Rows carry DIFFERENT k, so the static lax.top_k is replaced by a
+    sort + per-row threshold."""
+    V = z.shape[-1]
+    kk = jnp.where(top_k <= 0, V, jnp.clip(top_k, 1, V)).astype(jnp.int32)
+    sorted_desc = -jnp.sort(-z, axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
+    return jnp.where(z >= kth, z, _NEG_INF)
+
+
+def _topp_filter(z, top_p):
+    """Per-row nucleus filter over the (already temperature-scaled,
+    top-k-filtered) logits: keep the smallest prefix of the descending
+    distribution whose probability mass reaches top_p (always >= 1
+    token). top_p >= 1 disables."""
+    sorted_desc = -jnp.sort(-z, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix mass
+    keep = cum_excl < jnp.clip(top_p, 0.0, 1.0)[:, None]
+    keep = keep.at[:, 0].set(True)
+    # threshold: the smallest kept logit per row
+    kept_min = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1)
+    filt = jnp.where(z >= kept_min[:, None], z, _NEG_INF)
+    return jnp.where((top_p >= 1.0)[:, None], z, filt)
+
+
+def sample_rows(logits, temperature, top_k, top_p, seed, step, mask=None):
+    """Select one token per row.
+
+    logits [rows, V] f32; temperature [rows] f32; top_k [rows] i32;
+    top_p [rows] f32; seed [rows] u32/i32; step [rows] i32 (tokens this
+    request has sampled so far); mask [rows, V] f32 or None. Returns
+    ids [rows] i32. temperature == 0 rows take the masked argmax (no
+    randomness consumed); sampled rows draw from the temperature-scaled,
+    top-k- then top-p-filtered distribution with key
+    ``fold_in(PRNGKey(seed), step)``.
+    """
+    z = apply_mask(logits.astype(jnp.float32), mask)
+    greedy = jnp.argmax(z, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)
+    zs = z / temp[:, None]
+    zs = _topk_filter(zs, top_k.astype(jnp.int32))
+    zs = _topp_filter(zs, top_p.astype(jnp.float32))
+
+    def draw(seed_r, step_r, z_r):
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed_r.astype(jnp.uint32)),
+            step_r.astype(jnp.uint32))
+        return jax.random.categorical(key, z_r)
+
+    sampled = jax.vmap(draw)(seed, step, zs).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -2,6 +2,6 @@
 from . import (activation_ops, attention_ops, control_flow_ops, crf_ops,
                ctc_ops, detection_ops, fusion_ops, legacy_ops, loss_ops,
                math_ops, metric_ops, moe_ops, nn_ops, optimizer_ops,
-               pipeline_ops, rnn_ops, sequence_ops, sparse_ops, tail_ops,
-               tensor_ops)  # noqa: F401
+               pipeline_ops, rnn_ops, seq2seq_ops, sequence_ops,
+               sparse_ops, tail_ops, tensor_ops)  # noqa: F401
 from . import extra_ops  # noqa: F401  (last: aliases resolve base kernels)

@@ -763,6 +763,49 @@ def transformer_stack_slot_decode(attrs, ins, rng=None):
 # is CAPACITY. A Pallas per-page-DMA kernel is the follow-on TPU lever.)
 # ---------------------------------------------------------------------------
 
+_SAMPLING_SLOTS = ("Temperature", "TopK", "TopP", "Seed", "Step", "Mask")
+
+
+def _row_sampling(ins):
+    """The per-row sampling plane, when fed: (temperature [rows], top_k
+    [rows], top_p [rows], seed [rows], step [rows], mask [rows, V] or
+    None) — or None when the program predates per-request sampling (the
+    legacy engine-wide attrs path)."""
+    temp = maybe(ins, "Temperature")
+    if temp is None:
+        return None
+    return (temp, single(ins, "TopK"), single(ins, "TopP"),
+            single(ins, "Seed"), single(ins, "Step"), maybe(ins, "Mask"))
+
+
+def _pick_rows(attrs, ins, rng, vocab, logits, step0=0):
+    """Next-token selection for the paged decode family: the per-row
+    plane (kernels/sampling.sample_rows — seeds are INPUTS, the scope
+    RNG stays untouched) when fed, else the legacy engine-wide
+    attrs/rng path."""
+    from ..kernels.sampling import sample_rows
+
+    plane = _row_sampling(ins)
+    if plane is None:
+        pick = _make_pick(attrs.get("temperature") or 0.0,
+                          attrs.get("top_k") or 0, vocab, rng)
+        return pick(logits, step0)
+    temp, top_k, top_p, seed, step, mask = plane
+    return sample_rows(logits, temp, top_k, top_p, seed, step, mask)
+
+
+def _maybe_topk(attrs, ins, logits, outs):
+    """Attach TopV/TopI (each row's top-``emit_topk`` masked log-probs)
+    to ``outs`` when the program asks for the beam plane."""
+    k = attrs.get("emit_topk") or 0
+    if k:
+        from ..kernels.sampling import top_logprobs
+
+        vals, ids = top_logprobs(logits, int(k), maybe(ins, "Mask"))
+        outs["TopV"], outs["TopI"] = [vals], [ids]
+    return outs
+
+
 def _gather_pages(pool_l, table):
     """pool_l [N, Hkv, ps, dh] gathered by table [b, P] -> the flattened
     context [b, Hkv, P*ps, dh]: flattened position j holds the token at
@@ -774,7 +817,8 @@ def _gather_pages(pool_l, table):
     return ctx.transpose(0, 2, 1, 3, 4).reshape(b, hkv, P * ps, dh)
 
 
-@register_op("transformer_stack_paged_prefill", optional_inputs=("PosEmb",),
+@register_op("transformer_stack_paged_prefill",
+             optional_inputs=("PosEmb",) + _SAMPLING_SLOTS,
              needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
 def transformer_stack_paged_prefill(attrs, ins, rng=None):
     """Prefill ONE CHUNK of each row's prompt into its block-table pages.
@@ -798,7 +842,17 @@ def transformer_stack_paged_prefill(attrs, ins, rng=None):
     row attends the shared pages it never prefilled — token-exact vs the
     dense one-shot prefill. Pages beyond a row's extent sit at flattened
     positions > p and are masked by the same rule.
+
+    Optional per-row sampling plane (Temperature/TopK/TopP/Seed/Step [b]
+    + Mask [b, V]): when fed, NextTok comes from
+    ``kernels.sampling.sample_rows`` — each row's policy and seed ride
+    the request, the scope RNG is never consumed, and the token is a
+    pure function of (request, seed, step). ``emit_topk`` > 0 adds
+    TopV/TopI [b, emit_topk] (masked top-k log-probs of the last valid
+    position) — the beam-search expansion plane.
     """
+    # per-row sampling slots, read via _row_sampling/_maybe_topk:
+    # "Temperature", "TopK", "TopP", "Seed", "Step", "Mask"
     chunk = single(ins, "Chunk")
     start = single(ins, "StartPos").astype(jnp.int32)
     lengths = single(ins, "Lengths").astype(jnp.int32)
@@ -827,8 +881,6 @@ def transformer_stack_paged_prefill(attrs, ins, rng=None):
     x = tok_emb[chunk]
     if pos_emb is not None:
         x = x + pos_emb[jnp.clip(pos, 0, pos_emb.shape[0] - 1)]
-    pick = _make_pick(attrs.get("temperature") or 0.0,
-                      attrs.get("top_k") or 0, head_w.shape[1], rng)
     from ..kernels.flash_attention import reference_attention
 
     def layer(h, inp):
@@ -847,12 +899,15 @@ def transformer_stack_paged_prefill(attrs, ins, rng=None):
     h, (cache_k, cache_v) = jax.lax.scan(layer, x,
                                          (params, cache_k, cache_v))
     last = h[jnp.arange(b), jnp.clip(lengths, 1, Tc) - 1]  # [b, d]
-    nxt = pick(_logits_fn(ln_s, ln_b, head_w)(last), 0)
-    return out(NextTok=nxt.astype(chunk.dtype),
+    logits = _logits_fn(ln_s, ln_b, head_w)(last)
+    nxt = _pick_rows(attrs, ins, rng, head_w.shape[1], logits)
+    outs = out(NextTok=nxt.astype(chunk.dtype),
                CacheK=cache_k, CacheV=cache_v)
+    return _maybe_topk(attrs, ins, logits, outs)
 
 
-@register_op("transformer_stack_paged_decode", optional_inputs=("PosEmb",),
+@register_op("transformer_stack_paged_decode",
+             optional_inputs=("PosEmb",) + _SAMPLING_SLOTS,
              needs_rng=lambda attrs: (attrs.get("temperature") or 0) > 0)
 def transformer_stack_paged_decode(attrs, ins, rng=None):
     """One decode step over every slot's paged context.
@@ -869,7 +924,17 @@ def transformer_stack_paged_decode(attrs, ins, rng=None):
     compiled shape never depends on occupancy or sequence lengths — the
     same one-compile steady state as the dense slot decode, over a pool
     sized by TOKENS IN FLIGHT instead of slots*Tmax.
+
+    Optional per-row sampling plane (Temperature/TopK/TopP/Seed/Step [S]
+    + Mask [S, V]): per-REQUEST decode policy inside the one compiled
+    step — greedy, temperature, top-k, top-p, and grammar-masked rows
+    mix freely, and each row's token depends only on (its context, its
+    policy, its seed, its step). ``emit_topk`` > 0 adds TopV/TopI
+    [S, emit_topk] — beam hypotheses expand from these without a second
+    model pass.
     """
+    # per-row sampling slots, read via _row_sampling/_maybe_topk:
+    # "Temperature", "TopK", "TopP", "Seed", "Step", "Mask"
     tok = single(ins, "Tok")
     pos = single(ins, "Pos").astype(jnp.int32)
     table = single(ins, "BlockTable").astype(jnp.int32)
@@ -895,8 +960,6 @@ def transformer_stack_paged_decode(attrs, ins, rng=None):
     if pos_emb is not None:
         x = x + pos_emb[jnp.clip(pos, 0, pos_emb.shape[0] - 1)]
     h1 = x[:, None, :]  # [S, 1, d]
-    pick = _make_pick(attrs.get("temperature") or 0.0,
-                      attrs.get("top_k") or 0, head_w.shape[1], rng)
     srange = jnp.arange(S)
     page_id = table[srange, pos // ps]  # [S]
     page_row = pos % ps
@@ -916,9 +979,11 @@ def transformer_stack_paged_decode(attrs, ins, rng=None):
 
     h1, (cache_k, cache_v) = jax.lax.scan(layer, h1,
                                           (params, cache_k, cache_v))
-    nxt = pick(_logits_fn(ln_s, ln_b, head_w)(h1[:, 0]), 0)
-    return out(NextTok=nxt.astype(tok.dtype),
+    logits = _logits_fn(ln_s, ln_b, head_w)(h1[:, 0])
+    nxt = _pick_rows(attrs, ins, rng, head_w.shape[1], logits)
+    outs = out(NextTok=nxt.astype(tok.dtype),
                CacheK=cache_k, CacheV=cache_v)
+    return _maybe_topk(attrs, ins, logits, outs)
 
 
 @register_op("kv_cache_page_copy")

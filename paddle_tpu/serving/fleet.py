@@ -326,10 +326,16 @@ class HttpReplica(Replica):
     def begin(self, payload, meta: dict,
               timeout_ms: Optional[float]) -> _Attempt:
         fut = Future()
-        if isinstance(payload, dict) and "prompt" in payload:
+        if isinstance(payload, dict) and ("prompt" in payload
+                                          or "src" in payload):
+            from .server import GENERATE_META
+
             path = "/v1/generate"
-            body = {"prompt": np.asarray(payload["prompt"]).tolist()}
-            for k in ("max_new_tokens", "eos_id"):
+            body = {}
+            for key in ("prompt", "src"):
+                if payload.get(key) is not None:
+                    body[key] = np.asarray(payload[key]).tolist()
+            for k in GENERATE_META:
                 if meta.get(k) is not None:
                     body[k] = meta[k]
         else:
@@ -551,12 +557,34 @@ class Fleet:
                     if timeout_ms is not None else None)
         fut = Future()
         meta = dict(meta)
+        self._pin_seed(meta)
         span = trace.start_span(
             "fleet/request", detached=True, timeout_ms=timeout_ms,
             parent=trace.extract(meta.pop("traceparent", None)))
         self._pool.submit(self._run, fut, payload, meta, deadline,
                           span)
         return fut
+
+    @staticmethod
+    def _pin_seed(meta: dict) -> None:
+        """Pin ONE per-request seed BEFORE any attempt dispatches: a
+        sampled request served by hedged/retried attempts on different
+        replicas must produce identical tokens whichever attempt wins —
+        the (request, seed) determinism contract extended fleet-wide."""
+        import os
+
+        sp = meta.get("sampling_params")
+        sampled = (meta.get("temperature") or 0) > 0 or (
+            sp is not None and getattr(sp, "sampled", False))
+        if not sampled:
+            return
+        if sp is not None:
+            if sp.seed is None:
+                meta["sampling_params"] = sp.with_seed(
+                    int.from_bytes(os.urandom(4), "big") & 0x7FFFFFFF)
+        elif meta.get("seed") is None:
+            meta["seed"] = int.from_bytes(os.urandom(4),
+                                          "big") & 0x7FFFFFFF
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
@@ -970,13 +998,27 @@ class Fleet:
                 if tp:
                     meta["traceparent"] = tp
                 if self.path == "/v1/generate":
-                    fut = fleet.submit(
-                        {"prompt": req["prompt"]},
-                        timeout_ms=req.get("timeout_ms"),
-                        max_new_tokens=req.get("max_new_tokens"),
-                        eos_id=req.get("eos_id"), **meta)
-                    ids = fut.result(timeout=req.get("timeout_s", 60))
-                    self._send(200, {"ids": np.asarray(ids).tolist()})
+                    from .server import GENERATE_META
+
+                    meta.update({k: req[k] for k in GENERATE_META
+                                 if req.get(k) is not None})
+                    payload = ({"src": req["src"],
+                                "prompt": req.get("prompt")}
+                               if req.get("src") is not None
+                               else {"prompt": req["prompt"]})
+                    fut = fleet.submit(payload,
+                                       timeout_ms=req.get("timeout_ms"),
+                                       **meta)
+                    res = fut.result(timeout=req.get("timeout_s", 60))
+                    if isinstance(res, tuple):
+                        ids, scores = res
+                        self._send(200, {
+                            "ids": np.asarray(ids)[0].tolist(),
+                            "beams": np.asarray(ids).tolist(),
+                            "scores": np.asarray(scores).tolist()})
+                    else:
+                        self._send(200,
+                                   {"ids": np.asarray(res).tolist()})
                 elif self.path == "/v1/infer":
                     inputs = {k: np.asarray(v)
                               for k, v in req["inputs"].items()}

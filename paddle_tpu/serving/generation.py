@@ -41,6 +41,9 @@ from ..trace import flight as trace_flight
 from ..core.executor import Executor, TPUPlace
 from ..core.program import Program, program_guard
 from ..core.scope import Scope
+from ..decoding.beam import BeamJob
+from ..decoding.params import BeamParams, SamplingParams
+from ..decoding.stops import StopMatcher
 from ..layers import data as data_layer
 from ..layers.layer_helper import LayerHelper
 from .batcher import Request
@@ -200,7 +203,7 @@ class RequestTimeline:
 
 class _Slot:
     __slots__ = ("request", "generated", "max_new", "eos_id", "prompt",
-                 "timeline")
+                 "timeline", "truncate_to")
 
     def __init__(self, request: Request, prompt: np.ndarray,
                  max_new: int, eos_id: Optional[int]):
@@ -210,6 +213,9 @@ class _Slot:
         self.max_new = max_new
         self.eos_id = eos_id
         self.timeline = RequestTimeline(request.enqueue_t, prompt.size)
+        # set by a stop-sequence match: keep only this many generated
+        # tokens in the returned ids (the stop itself is dropped)
+        self.truncate_to: Optional[int] = None
 
 
 class GenerationEngine:
@@ -234,6 +240,7 @@ class GenerationEngine:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  prefill_batch_buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, top_k: int = 0,
+                 sampling: Optional[SamplingParams] = None,
                  default_max_new_tokens: int = 16,
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  place=None, metrics: Optional[MetricsRegistry] = None,
@@ -251,8 +258,16 @@ class GenerationEngine:
         if spec.use_rope is False and self.tmax > spec.max_len:
             raise ValueError(f"max_seq_len {self.tmax} exceeds the "
                              f"position table ({spec.max_len})")
-        self.temperature = float(temperature)
-        self.top_k = int(top_k)
+        # DEPRECATED: engine-wide ``temperature=``/``top_k=`` survive as
+        # the *default* SamplingParams — per-request fields win
+        # (paddle_tpu.decoding.SamplingParams.from_meta). Pass
+        # ``sampling=`` for the full default policy.
+        self.default_sampling = sampling if sampling is not None else \
+            SamplingParams(temperature=float(temperature),
+                           top_k=int(top_k))
+        self.default_sampling.validate(spec.vocab_size)
+        self.temperature = float(self.default_sampling.temperature)
+        self.top_k = int(self.default_sampling.top_k)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
@@ -475,6 +490,12 @@ class GenerationEngine:
         import contextlib
         return contextlib.nullcontext()
 
+    def _needs_scope_rng(self) -> bool:
+        """Does the decode family draw from the SCOPE RNG plane? Only
+        the dense engine's legacy attrs-based sampling does; the paged
+        engine's per-request plane carries seeds as inputs."""
+        return self.temperature > 0
+
     # -- serving ---------------------------------------------------------
     def warmup(self) -> int:
         """Compile every prefill (batch-bucket x prompt-bucket) pair and
@@ -482,7 +503,7 @@ class GenerationEngine:
         the scrap slot, so live slots are never polluted. Returns the
         number of shapes compiled."""
         combos = 0
-        if self.temperature > 0:
+        if self._needs_scope_rng():
             # sampled serving threads the scope RNG plane: seed it BEFORE
             # warmup so the scope key set (part of the compile-cache key)
             # is identical between warmup and live traffic
@@ -542,7 +563,7 @@ class GenerationEngine:
         manifest = manifest_mod.try_load(dirname)
         if manifest is None:
             return None
-        if self.temperature > 0:
+        if self._needs_scope_rng():
             # same contract as warmup(): seed the RNG plane first so the
             # scope key set matches live traffic
             self.executor._rng_state(self._decode_prog[0], self.scope)
@@ -668,6 +689,18 @@ class GenerationEngine:
         else:              # every later token: one TPOT sample
             self.metrics.observe_hist("tpot", delta)
         st.generated.append(token)
+        stop = getattr(st, "stop_matcher", None)
+        if stop:
+            keep = stop.match(st.generated)
+            if keep is not None:
+                # the stop sequence ends here (anywhere — including
+                # mid-page on the paged cache): truncate before the
+                # match and finish; the already-written K/V rows past
+                # the cut are released with the request's pages
+                st.truncate_to = keep
+                self.metrics.inc("stop_sequence_hits")
+                self._finish(slot)
+                return
         if (len(st.generated) >= st.max_new
                 or (st.eos_id is not None and token == st.eos_id)):
             self._finish(slot)
@@ -675,8 +708,9 @@ class GenerationEngine:
     def _finish(self, slot: int) -> None:
         st = self._slots[slot]
         self._slots[slot] = None
-        ids = np.concatenate([st.prompt,
-                              np.asarray(st.generated, np.int64)])
+        gen = (st.generated if st.truncate_to is None
+               else st.generated[:st.truncate_to])
+        ids = np.concatenate([st.prompt, np.asarray(gen, np.int64)])
         latency = time.monotonic() - st.request.enqueue_t
         tl = st.timeline
         if st.request.span is not None and tl.n_tokens > 1:
@@ -814,15 +848,24 @@ class GenerationEngine:
 # ---------------------------------------------------------------------------
 class _PagedSlot(_Slot):
     __slots__ = ("pages", "shared_tokens", "cow_reserve", "prefill_done",
-                 "state")
+                 "state", "sampling", "stop_matcher", "mask_proc",
+                 "beam_job", "role", "xrow")
 
-    def __init__(self, request, prompt, max_new, eos_id):
+    def __init__(self, request, prompt, max_new, eos_id,
+                 sampling: Optional[SamplingParams] = None):
         super().__init__(request, prompt, max_new, eos_id)
         self.pages: List[int] = []       # physical page per table entry
         self.shared_tokens = 0           # prefix-cache hit length
         self.cow_reserve = 0             # pages held for copy-on-write
         self.prefill_done = 0            # prompt tokens whose K/V is cached
         self.state = "decode"            # "prefill" while chunks stream in
+                                         # ("hold"/"beam_wait" for beams)
+        self.sampling = sampling or SamplingParams()
+        self.stop_matcher = StopMatcher(self.sampling.stop)
+        self.mask_proc = self.sampling.logits_processor
+        self.beam_job = None             # set for beam-owned slots
+        self.role = "normal"             # beam_parent | beam | hold
+        self.xrow = None                 # seq2seq: cross-KV cache row
 
 
 class PagedGenerationEngine(GenerationEngine):
@@ -864,6 +907,7 @@ class PagedGenerationEngine(GenerationEngine):
                  n_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_sharing: bool = True,
+                 beam_width: int = 0, mask_plane: bool = True,
                  kv_cache: Optional[str] = None, **kw):
         if kv_cache not in (None, "paged"):
             raise ValueError(
@@ -872,10 +916,20 @@ class PagedGenerationEngine(GenerationEngine):
                 "for the dense slot table")
         if page_size is not None and page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if beam_width < 0:
+            raise ValueError("beam_width must be >= 0")
         self._page_size_arg = page_size
         self._n_pages_arg = n_pages
         self._prefill_chunk_arg = prefill_chunk
         self._prefix_sharing = bool(prefix_sharing)
+        # beam_width > 0 compiles the TopV/TopI (emit_topk) plane into
+        # the decode/prefill programs; beam requests up to this width
+        # then ride the one steady-state compile
+        self.beam_width = int(beam_width)
+        # mask_plane=False drops the [slots, vocab] Mask feed from the
+        # programs (per-tick host->device bytes scale with vocab; turn
+        # it off for large-V deployments that never constrain decoding)
+        self.mask_plane = bool(mask_plane)
         super().__init__(spec, scope, **kw)
 
     # -- cache / program construction -----------------------------------
@@ -888,8 +942,12 @@ class PagedGenerationEngine(GenerationEngine):
         self.page_size = int(self._page_size_arg or min(64, self.tmax))
         # table width: enough entries for a full-context sequence
         self.pmax = -(-self.tmax // self.page_size)
+        # beam engines default to a bigger pool: K fully-diverged
+        # hypotheses can each hold a full table plus a COW spare
+        beam_extra = (self.slots + 2 * self.beam_width
+                      if getattr(self, "beam_width", 0) else 0)
         self.n_pages = int(self._n_pages_arg
-                           or self.slots * self.pmax + 1)
+                           or self.slots * self.pmax + 1 + beam_extra)
         if self.n_pages < 2:
             raise ValueError("need at least 2 pages (one is scrap)")
         chunk = self._prefill_chunk_arg
@@ -910,6 +968,9 @@ class PagedGenerationEngine(GenerationEngine):
         self._pos = np.zeros(self._nslots, np.int32)
         self._deferred = deque()  # pool-blocked validated admissions
         self._pf_cursor = 0       # round-robin over prefilling slots
+        self._beam_jobs: List[BeamJob] = []
+        self._seed_counter = 0    # default per-request seeds (sampled
+                                  # requests without an explicit seed)
         shape = (s.n_layers, self.n_pages, s.kv_heads, self.page_size,
                  s.head_dim)
         self.scope.set(PAGED_CACHE_K, jnp.zeros(shape, jnp.float32))
@@ -932,12 +993,75 @@ class PagedGenerationEngine(GenerationEngine):
         return ck, cv
 
     def _decode_attrs(self):
+        # per-request sampling rides the input plane, never the attrs
+        # (and never the scope RNG) — attrs stay policy-free so every
+        # request shape shares one compile-cache entry
         attrs = super()._decode_attrs()
+        attrs["temperature"] = 0.0
+        attrs["top_k"] = 0
         attrs["page_size"] = self.page_size
+        if self.beam_width:
+            attrs["emit_topk"] = self.beam_width
         return attrs
 
-    _PREFILL_FEEDS = ("serving.chunk", "serving.start", "serving.chunk_len",
-                      "serving.block_table")
+    def _needs_scope_rng(self) -> bool:
+        return False  # seeds are inputs: the scope RNG is never drawn
+
+    _SAMPLING_FEEDS = ("serving.temp", "serving.topk", "serving.topp",
+                       "serving.seed", "serving.step")
+
+    @property
+    def _prefill_feed_names(self):
+        names = ["serving.chunk", "serving.start", "serving.chunk_len",
+                 "serving.block_table", *self._SAMPLING_FEEDS]
+        if self.mask_plane:
+            names.append("serving.mask")
+        return names
+
+    @property
+    def _decode_feed_names(self):
+        names = ["serving.tok", "serving.pos", "serving.block_table",
+                 *self._SAMPLING_FEEDS]
+        if self.mask_plane:
+            names.append("serving.mask")
+        return names
+
+    def _sampling_vars(self, rows: Optional[int]):
+        """Declare the per-row sampling-plane feeds. ``rows`` is None for
+        batch-dim programs (prefill: the batch axis is implicit) or the
+        static slot count (decode)."""
+        batched = rows is None
+
+        def vec(name, dtype):
+            if batched:
+                return data_layer(name, shape=[], dtype=dtype)
+            return data_layer(name, shape=[rows], dtype=dtype,
+                              append_batch_size=False)
+
+        ins = {"Temperature": [vec("serving.temp", "float32")],
+               "TopK": [vec("serving.topk", "int32")],
+               "TopP": [vec("serving.topp", "float32")],
+               "Seed": [vec("serving.seed", "int32")],
+               "Step": [vec("serving.step", "int32")]}
+        if self.mask_plane:
+            V = self.spec.vocab_size
+            mask = (data_layer("serving.mask", shape=[V], dtype="float32")
+                    if batched else
+                    data_layer("serving.mask", shape=[rows, V],
+                               dtype="float32", append_batch_size=False))
+            ins["Mask"] = [mask]
+        return ins
+
+    def _beam_out_vars(self, helper, rows: int, prefix: str):
+        """TopV/TopI output vars when the beam plane is on."""
+        if not self.beam_width:
+            return {}
+        shape = [rows, self.beam_width] if rows else [-1, self.beam_width]
+        tv = helper.block.create_var(name=f"{prefix}.topv", shape=shape,
+                                     dtype="float32", stop_gradient=True)
+        ti = helper.block.create_var(name=f"{prefix}.topi", shape=shape,
+                                     dtype="int32", stop_gradient=True)
+        return {"TopV": [tv], "TopI": [ti]}
 
     def _build_prefill(self, tc: int):
         prog, startup = Program(), Program()
@@ -957,14 +1081,17 @@ class PagedGenerationEngine(GenerationEngine):
             ins = {"Chunk": [chunk], "StartPos": [start],
                    "Lengths": [length], "BlockTable": [table],
                    "CacheK": [ck], "CacheV": [cv]}
+            ins.update(self._sampling_vars(None))
             ins.update(self._lm_ins(helper))
-            helper.append_op(
-                "transformer_stack_paged_prefill", ins,
-                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
-                self._decode_attrs())
-        self._transpile(prog, list(self._PREFILL_FEEDS), [nxt.name],
+            outs = {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]}
+            outs.update(self._beam_out_vars(helper, 0, "serving.pf"))
+            helper.append_op("transformer_stack_paged_prefill", ins,
+                             outs, self._decode_attrs())
+        fetches = [nxt.name] + [v[0].name for k, v in sorted(outs.items())
+                                if k in ("TopV", "TopI")]
+        self._transpile(prog, list(self._prefill_feed_names), fetches,
                         f"transpile/prefill{tc}/")
-        return prog, nxt
+        return prog, outs
 
     def _build_decode(self):
         prog, startup = Program(), Program()
@@ -984,15 +1111,18 @@ class PagedGenerationEngine(GenerationEngine):
                 shape=[self._nslots], dtype="int64", stop_gradient=True)
             ins = {"Tok": [tok], "Pos": [pos], "BlockTable": [table],
                    "CacheK": [ck], "CacheV": [cv]}
+            ins.update(self._sampling_vars(self._nslots))
             ins.update(self._lm_ins(helper))
-            helper.append_op(
-                "transformer_stack_paged_decode", ins,
-                {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]},
-                self._decode_attrs())
-        self._transpile(prog, ["serving.tok", "serving.pos",
-                               "serving.block_table"], [nxt.name],
+            outs = {"NextTok": [nxt], "CacheK": [ck], "CacheV": [cv]}
+            outs.update(self._beam_out_vars(helper, self._nslots,
+                                            "serving.dec"))
+            helper.append_op("transformer_stack_paged_decode", ins,
+                             outs, self._decode_attrs())
+        fetches = [nxt.name] + [v[0].name for k, v in sorted(outs.items())
+                                if k in ("TopV", "TopI")]
+        self._transpile(prog, list(self._decode_feed_names), fetches,
                         "transpile/decode/")
-        return prog, nxt
+        return prog, outs
 
     @property
     def _page_copy_prog(self):
@@ -1035,16 +1165,64 @@ class PagedGenerationEngine(GenerationEngine):
     def _entries_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
+    # -- program plumbing --------------------------------------------------
+    def _fetches(self, outs) -> list:
+        """Fetch vars for a paged program: NextTok plus the beam plane
+        when compiled in. The fetch list is IDENTICAL for warmup and
+        live ticks — fetch-set changes would fork the compiled
+        signature and break the zero-recompile steady state."""
+        fetches = [outs["NextTok"][0]]
+        if self.beam_width:
+            fetches += [outs["TopV"][0], outs["TopI"][0]]
+        return fetches
+
+    def _neutral_sampling_feed(self, rows: int) -> Dict[str, np.ndarray]:
+        """The sampling plane for rows with no live policy (warmup,
+        vacant slots, padding): greedy, mask wide open."""
+        feed = {
+            "serving.temp": np.zeros(rows, np.float32),
+            "serving.topk": np.zeros(rows, np.int32),
+            "serving.topp": np.ones(rows, np.float32),
+            "serving.seed": np.zeros(rows, np.int32),
+            "serving.step": np.zeros(rows, np.int32),
+        }
+        if self.mask_plane:
+            feed["serving.mask"] = np.ones(
+                (rows, self.spec.vocab_size), np.float32)
+        return feed
+
+    def _slot_sampling_feed(self, row: int, st, feed: dict,
+                            step: int) -> None:
+        """Write one slot's policy into row ``row`` of a sampling feed."""
+        sp = st.sampling
+        feed["serving.temp"][row] = sp.temperature
+        feed["serving.topk"][row] = sp.top_k
+        feed["serving.topp"][row] = sp.top_p
+        feed["serving.seed"][row] = (sp.seed or 0) & 0x7FFFFFFF
+        feed["serving.step"][row] = step
+        if st.mask_proc is not None and self.mask_plane:
+            mask = np.asarray(
+                st.mask_proc.mask(step, st.generated), np.float32)
+            if mask.shape != (self.spec.vocab_size,):
+                raise BadRequestError(
+                    f"logits processor returned shape {mask.shape}, "
+                    f"want ({self.spec.vocab_size},)")
+            if mask.max() <= 0:  # dead end: fail open, count it
+                self.metrics.inc("mask_dead_ends")
+            else:
+                feed["serving.mask"][row] = mask
+
     # -- warmup / manifests ----------------------------------------------
     def warmup(self) -> int:
         """Compile every (chunk-width x batch-bucket) prefill shape, the
         decode step, and the copy-on-write page copy. All warmup rows
-        write the scrap page, so live pages are never touched."""
+        write the scrap page, so live pages are never touched. The
+        sampling plane warms with its neutral (greedy) values — policy
+        is data, so sampled/masked/beam traffic hits the same
+        executables."""
         combos = 0
-        if self.temperature > 0:
-            self.executor._rng_state(self._decode_prog[0], self.scope)
         for tc in self._chunk_widths:
-            prog, nxt = self._prefill_prog(tc)
+            prog, outs = self._prefill_prog(tc)
             for b in self.prefill_batch_buckets:
                 feed = {
                     "serving.chunk": np.full((b, tc), self.pad_id,
@@ -1054,8 +1232,10 @@ class PagedGenerationEngine(GenerationEngine):
                     "serving.block_table": np.zeros((b, self.pmax),
                                                     np.int32),
                 }
+                feed.update(self._neutral_sampling_feed(b))
                 with self._device_ctx():
-                    self.executor.run(prog, feed=feed, fetch_list=[nxt],
+                    self.executor.run(prog, feed=feed,
+                                      fetch_list=self._fetches(outs),
                                       scope=self.scope)
                 combos += 1
         with self._device_ctx():
@@ -1080,16 +1260,18 @@ class PagedGenerationEngine(GenerationEngine):
         formula."""
         from .. import analysis
 
-        prog, nxt = self._decode_prog
+        prog, outs = self._decode_prog
         mem = analysis.check_memory_budget(
-            prog, ["serving.tok", "serving.pos", "serving.block_table"],
-            [nxt.name], budget, scope=self.scope, batch_size=self._nslots,
+            prog, list(self._decode_feed_names),
+            [v.name for v in self._fetches(outs)], budget,
+            scope=self.scope, batch_size=self._nslots,
             what=f"PagedGenerationEngine decode step (slots={self.slots}, "
                  f"pages={self.n_pages}x{self.page_size})")
         tc = self._chunk_widths[-1]
-        pprog, pnxt = self._prefill_prog(tc)
+        pprog, pouts = self._prefill_prog(tc)
         pmem = analysis.check_memory_budget(
-            pprog, list(self._PREFILL_FEEDS), [pnxt.name], budget,
+            pprog, list(self._prefill_feed_names),
+            [v.name for v in self._fetches(pouts)], budget,
             scope=self.scope,
             batch_size=self.prefill_batch_buckets[-1],
             what=f"PagedGenerationEngine prefill (chunk {tc})")
@@ -1165,6 +1347,55 @@ class PagedGenerationEngine(GenerationEngine):
         super()._finish(slot)
 
     # -- admission ---------------------------------------------------------
+    def _validate(self, req: Request):
+        """Base validation plus the per-request decode policy: a
+        SamplingParams merged request-over-engine-default (request wins
+        field by field — the compat contract for the deprecated
+        engine-wide ``temperature=``/``top_k=``), and BeamParams when
+        the request asks for beam search."""
+        prompt, max_new, eos = super()._validate(req)
+        meta = req.meta or {}
+        sp = meta.get("sampling_params")
+        try:
+            sampling = (sp if isinstance(sp, SamplingParams)
+                        else SamplingParams.from_meta(
+                            meta, self.default_sampling))
+            sampling.validate(self.spec.vocab_size)
+            beam = BeamParams.from_meta(meta)
+            if beam is not None:
+                if beam.eos_id is None and eos is not None:
+                    beam = dataclasses.replace(beam, eos_id=eos)
+                beam.validate(self.spec.vocab_size)
+        except (ValueError, TypeError) as exc:
+            raise BadRequestError(str(exc))
+        if beam is not None:
+            if not self.beam_width:
+                raise BadRequestError(
+                    "beam request on an engine built without the beam "
+                    "plane — construct with beam_width >= beam_size")
+            if beam.beam_size > self.beam_width:
+                raise BadRequestError(
+                    f"beam_size {beam.beam_size} exceeds the engine's "
+                    f"beam_width ({self.beam_width})")
+            if beam.beam_size > self.slots:
+                raise BadRequestError(
+                    f"beam_size {beam.beam_size} exceeds the slot count "
+                    f"({self.slots}) — a hypothesis occupies one slot")
+        if sampling.sampled and sampling.seed is None:
+            # engine-assigned default: reproducible against THIS engine
+            # only — pass a seed (the fleet pins one before hedging) for
+            # cross-replica reproducibility
+            sampling = sampling.with_seed(self._seed_counter)
+            self._seed_counter = (self._seed_counter + 1) & 0x7FFFFFFF
+        if sampling.max_tokens is not None \
+                and meta.get("max_new_tokens") is None:
+            max_new = int(sampling.max_tokens)
+            if prompt.size + max_new > self.tmax:
+                raise BadRequestError(
+                    f"prompt ({prompt.size}) + max_tokens ({max_new}) "
+                    f"exceeds the serving context ({self.tmax})")
+        return prompt, max_new, eos, sampling, beam
+
     def admit(self, requests: List[Request]) -> int:
         """Admit a group of requests: prefix-cache lookup + page
         allocation per request, then ONE bucketed prefill over everyone
@@ -1172,7 +1403,9 @@ class PagedGenerationEngine(GenerationEngine):
         prompts claim their slot and stream in via :meth:`prefill_tick`.
         Requests the pool cannot hold right now are DEFERRED (retried
         each tick as pages free) — only a request that can never fit
-        fails, typed. Returns the number admitted to a slot."""
+        fails, typed. A beam request claims ``beam_size`` slots (parent
+        plus holds its hypotheses fork into). Returns the number
+        admitted to a slot."""
         todo = []
         for req in requests:
             try:
@@ -1183,9 +1416,6 @@ class PagedGenerationEngine(GenerationEngine):
                 req.future.set_exception(exc)
         if not todo:
             return 0
-        if len(todo) > self.free_slots:
-            raise RuntimeError(f"admit() got {len(todo)} requests for "
-                               f"{self.free_slots} free slots")
         group: list = []
         admitted = 0
         for item in todo:
@@ -1202,13 +1432,18 @@ class PagedGenerationEngine(GenerationEngine):
         self._gauges()
         return admitted
 
-    def _admit_one(self, req, prompt, max_new, eos, group) -> str:
+    def _admit_one(self, req, prompt, max_new, eos, sampling, beam,
+                   group) -> str:
         """Claim a slot + pages for one validated request. Returns "ok"
         (slot taken; short prefills appended to ``group``), "defer"
-        (transient pool pressure), or "failed" (future completed with
-        CacheExhaustedError — the request can NEVER fit)."""
+        (transient pool/slot pressure), or "failed" (future completed
+        with CacheExhaustedError — the request can NEVER fit)."""
         from .errors import CacheExhaustedError
 
+        slots_needed = beam.beam_size if beam is not None else 1
+        if self.free_slots < slots_needed:
+            self.metrics.inc("admission_deferred")
+            return "defer"
         plen = int(prompt.size)
         entries_total = self._entries_for(plen + max_new)
         # worst-case pages: entries_total when unshared; a shared prefix
@@ -1247,7 +1482,7 @@ class PagedGenerationEngine(GenerationEngine):
         if cow:
             self.pool.reserve(cow)
         slot = self._slots.index(None)
-        st = _PagedSlot(req, prompt, max_new, eos)
+        st = _PagedSlot(req, prompt, max_new, eos, sampling)
         st.pages = list(spages) + owned
         st.shared_tokens = shared
         st.cow_reserve = cow
@@ -1255,6 +1490,26 @@ class PagedGenerationEngine(GenerationEngine):
         st.timeline.prefix_hit_tokens = shared
         self.metrics.observe_hist("queue_wait", st.timeline.queue_wait_s)
         self._slots[slot] = st
+        if beam is not None:
+            # parent + (K-1) parked hold slots the hypotheses fork into;
+            # holds occupy the slot table now so later admissions can't
+            # starve the expansion
+            holds = []
+            for _ in range(beam.beam_size - 1):
+                h = self._slots.index(None)
+                hs = _PagedSlot(req, prompt, max_new, eos, sampling)
+                hs.state = "hold"
+                hs.role = "hold"
+                self._slots[h] = hs
+                holds.append(h)
+            job = BeamJob(self, req, prompt, max_new, beam,
+                          parent_slot=slot, hold_slots=holds)
+            st.beam_job = job
+            st.role = "beam_parent"
+            for h in holds:
+                self._slots[h].beam_job = job
+            self._beam_jobs.append(job)
+            self.metrics.inc("beam_jobs")
         if shared:
             self.metrics.inc("prefix_hits")
             self.metrics.inc("prefix_hit_tokens", shared)
@@ -1296,26 +1551,30 @@ class PagedGenerationEngine(GenerationEngine):
         start = np.zeros(bucket, np.int32)
         length = np.zeros(bucket, np.int32)
         table = np.zeros((bucket, self.pmax), np.int32)
+        feed = self._neutral_sampling_feed(bucket)
         for row, (req, st, slot) in enumerate(group):
             r = rem[row]
             chunk[row, :r] = st.prompt[st.prefill_done:]
             start[row] = st.prefill_done
             length[row] = r
             table[row, :len(st.pages)] = st.pages
-        prog, nxt = self._prefill_prog(tc)
+            self._slot_sampling_feed(row, st, feed, step=0)
+        feed.update({"serving.chunk": chunk, "serving.start": start,
+                     "serving.chunk_len": length,
+                     "serving.block_table": table})
+        prog, outs = self._prefill_prog(tc)
         t0 = time.perf_counter()
         with self._device_ctx(), profiler.timer("serving/prefill"):
-            first, = self.executor.run(
-                prog, feed={"serving.chunk": chunk,
-                            "serving.start": start,
-                            "serving.chunk_len": length,
-                            "serving.block_table": table},
-                fetch_list=[nxt], scope=self.scope)
+            res = self.executor.run(prog, feed=feed,
+                                    fetch_list=self._fetches(outs),
+                                    scope=self.scope)
         t1 = time.perf_counter()
+        first = np.asarray(res[0])
+        topv, topi = ((np.asarray(res[1]), np.asarray(res[2]))
+                      if self.beam_width else (None, None))
         self.metrics.observe_latency(t1 - t0, name="prefill")
         self.metrics.inc("prefills")
         self.metrics.set_gauge("prefill_occupancy", len(group) / bucket)
-        first = np.asarray(first)
         for row, (req, st, slot) in enumerate(group):
             if req.span is not None:
                 trace.record("serving/execute", t0, t1, parent=req.span,
@@ -1324,10 +1583,17 @@ class PagedGenerationEngine(GenerationEngine):
                              prompt_bucket=tc, batch_bucket=bucket)
             st.timeline.chunk(t0, t1, rem[row])
             st.prefill_done = st.prompt.size
+            self._register_prefix(st)
+            if st.role == "beam_parent":
+                # the parent's top-K row expands the hypothesis set; the
+                # job takes over the slot bookkeeping from here
+                st.state = "decode"
+                st.role = "beam"
+                st.beam_job.on_parent_row(topv[row], topi[row])
+                continue
             st.state = "decode"
             self._tok[slot] = first[row]
             self._pos[slot] = st.prompt.size
-            self._register_prefix(st)
             self._emit(slot, int(first[row]))
 
     def _admit_deferred(self) -> int:
@@ -1340,7 +1606,7 @@ class PagedGenerationEngine(GenerationEngine):
 
         admitted = 0
         while self._deferred:
-            req, prompt, max_new, eos = self._deferred[0]
+            req, prompt, max_new, eos, sampling, beam = self._deferred[0]
             if req.expired():
                 self._deferred.popleft()
                 self.metrics.inc("timeouts")
@@ -1352,7 +1618,8 @@ class PagedGenerationEngine(GenerationEngine):
             if self.free_slots == 0:
                 break
             group: list = []
-            r = self._admit_one(req, prompt, max_new, eos, group=group)
+            r = self._admit_one(req, prompt, max_new, eos, sampling,
+                                beam, group=group)
             if r == "defer":
                 if self.active == 0 and admitted == 0:
                     self._deferred.popleft()
@@ -1408,17 +1675,19 @@ class PagedGenerationEngine(GenerationEngine):
         start[0] = start0
         length[0] = k
         table[0, :len(st.pages)] = st.pages
-        prog, nxt = self._prefill_prog(tc)
+        feed = self._neutral_sampling_feed(bucket)
+        self._slot_sampling_feed(0, st, feed, step=0)
+        feed.update({"serving.chunk": chunk, "serving.start": start,
+                     "serving.chunk_len": length,
+                     "serving.block_table": table})
+        prog, outs = self._prefill_prog(tc)
         t0 = time.perf_counter()
         with self._device_ctx(), profiler.timer("serving/prefill"), \
                 trace.span("serving/prefill_chunk", slot=slot,
                            start=start0, tokens=k):
-            first, = self.executor.run(
-                prog, feed={"serving.chunk": chunk,
-                            "serving.start": start,
-                            "serving.chunk_len": length,
-                            "serving.block_table": table},
-                fetch_list=[nxt], scope=self.scope)
+            res = self.executor.run(prog, feed=feed,
+                                    fetch_list=self._fetches(outs),
+                                    scope=self.scope)
         t1 = time.perf_counter()
         self.metrics.observe_latency(t1 - t0, name="prefill_chunk")
         self.metrics.inc("prefill_chunks")
@@ -1430,11 +1699,18 @@ class PagedGenerationEngine(GenerationEngine):
         st.prefill_done = start0 + k
         if st.prefill_done >= plen:
             self.metrics.inc("prefills")
-            st.state = "decode"
-            self._tok[slot] = np.asarray(first)[0]
-            self._pos[slot] = plen
+            first = np.asarray(res[0])
             self._register_prefix(st)
-            self._emit(slot, int(np.asarray(first)[0]))
+            if st.role == "beam_parent":
+                st.state = "decode"
+                st.role = "beam"
+                st.beam_job.on_parent_row(np.asarray(res[1])[0],
+                                          np.asarray(res[2])[0])
+            else:
+                st.state = "decode"
+                self._tok[slot] = first[0]
+                self._pos[slot] = plen
+                self._emit(slot, int(first[0]))
             self._gauges()
         return True
 
@@ -1442,23 +1718,33 @@ class PagedGenerationEngine(GenerationEngine):
         table = np.zeros((self._nslots, self.pmax), np.int32)
         tok = np.zeros(self._nslots, np.int64)
         pos = np.zeros(self._nslots, np.int32)
+        feed = self._neutral_sampling_feed(self._nslots)
         for s in range(self.slots):
             st = self._slots[s]
             if st is not None and st.state == "decode":
                 tok[s] = self._tok[s]
                 pos[s] = self._pos[s]
                 table[s, :len(st.pages)] = st.pages
-        prog, nxt = self._decode_prog
-        res, = self.executor.run(
-            prog, feed={"serving.tok": tok, "serving.pos": pos,
-                        "serving.block_table": table},
-            fetch_list=[nxt], scope=self.scope)
-        return np.asarray(res)
+                # step = tokens this request has sampled so far — a pure
+                # function of the request, never of the batch around it
+                self._slot_sampling_feed(s, st, feed,
+                                         step=len(st.generated))
+        feed.update({"serving.tok": tok, "serving.pos": pos,
+                     "serving.block_table": table})
+        prog, outs = self._decode_prog
+        res = self.executor.run(prog, feed=feed,
+                                fetch_list=self._fetches(outs),
+                                scope=self.scope)
+        if self.beam_width:
+            return (np.asarray(res[0]), np.asarray(res[1]),
+                    np.asarray(res[2]))
+        return np.asarray(res[0]), None, None
 
     def decode_tick(self) -> bool:
         """Advance every DECODING slot one token (prefilling slots sit
-        out — their block tables are mid-write). One compiled step, same
-        shape regardless of occupancy."""
+        out — their block tables are mid-write; a pool-parked beam job's
+        slots wait in ``beam_wait``). One compiled step, same shape
+        regardless of occupancy or policy mix."""
         decoding = [s for s in range(self.slots)
                     if self._slots[s] is not None
                     and self._slots[s].state == "decode"]
@@ -1468,20 +1754,218 @@ class PagedGenerationEngine(GenerationEngine):
         t0 = time.perf_counter()
         with self._device_ctx(), profiler.timer("serving/decode_step"), \
                 trace.span("serving/decode_step", active=len(decoding)):
-            nxt = self._run_decode()
+            nxt, topv, topi = self._run_decode()
         self.metrics.observe_latency(time.perf_counter() - t0,
                                      name="decode_step")
         self.metrics.inc("decode_steps")
         self.metrics.set_gauge("batch_occupancy",
                                len(decoding) / self.slots)
+        beam_rows: Dict[BeamJob, dict] = {}
+        parent_rows = []  # (job, slot) — full-prefix-hit first rows
         for slot in decoding:
-            if self._slots[slot] is None:
+            st = self._slots[slot]
+            if st is None:
+                continue
+            if st.beam_job is not None:
+                if st.role == "beam_parent":
+                    st.role = "beam"
+                    parent_rows.append((st.beam_job, slot))
+                else:
+                    beam_rows.setdefault(st.beam_job, {})[slot] = (
+                        topv[slot], topi[slot])
                 continue
             self._pos[slot] += 1
             self._tok[slot] = nxt[slot]
             self._emit(slot, int(nxt[slot]))
+        for job, slot in parent_rows:
+            job.on_parent_row(topv[slot], topi[slot])
+        for job, rows in beam_rows.items():
+            job.on_decode_rows(rows)
         self._gauges()
         return True
+
+    # -- beam search as paged forks ----------------------------------------
+    def _fork_layout(self, pages: List[int], n_written: int):
+        """How a fork views the source's table after ``n_written``
+        positions: (pages shared as-is, fresh pages to allocate, does
+        the boundary fall inside a page). Fully-written pages are shared
+        by refcount; the partially-written boundary page is shared with
+        one copy-on-write spare; entries not yet written get FRESH pages
+        (no point sharing what diverges immediately)."""
+        ps = self.page_size
+        n_share = min(len(pages),
+                      n_written // ps + (1 if n_written % ps else 0))
+        return n_share, len(pages) - n_share, bool(n_written % ps)
+
+    def _beam_can_fork(self, job, n_forks: int, n_written: int) -> bool:
+        """Pool feasibility for ``n_forks`` forks of ``job``'s cache view
+        (checked BEFORE any state mutates, so a rerank either applies
+        whole or parks whole)."""
+        if n_forks <= 0:
+            return True
+        slot = (job.parent_slot if not job.expanded
+                else job.live_slots()[0])
+        st = self._slots[slot]
+        _, own_n, partial = self._fork_layout(st.pages, n_written)
+        per = own_n + (2 if partial else 0)  # fork COW + source top-up
+        need = n_forks * per
+        if need and self.pool.available() < need \
+                and self.prefix_index is not None:
+            self.prefix_index.evict_until(need)
+        return self.pool.available() >= need
+
+    def _beam_fork(self, src_slot: int, hold_slot: int,
+                   n_written: int) -> int:
+        """Fork ``src_slot``'s hypothesis into a parked hold slot: the
+        written prefix is SHARED (refcount bumps on an int32 table copy
+        — no cache bytes move), the boundary page gets a copy-on-write
+        spare, and future entries allocate fresh. Feasibility was
+        checked by _beam_can_fork."""
+        st_src = self._slots[src_slot]
+        n_share, own_n, partial = self._fork_layout(st_src.pages,
+                                                    n_written)
+        shared = st_src.pages[:n_share]
+        for pid in shared:
+            self.pool.incref(pid)
+        owned = [self.pool.alloc() for _ in range(own_n)]
+        st = self._slots[hold_slot]
+        st.pages = list(shared) + owned
+        if partial:
+            self.pool.reserve(1)
+            st.cow_reserve = 1
+            if st_src.cow_reserve == 0:
+                # the source's boundary page just became shared too —
+                # whichever sibling writes first copies, so both hold a
+                # spare
+                self.pool.reserve(1)
+                st_src.cow_reserve = 1
+        st.state = "decode"
+        st.role = "beam"
+        st.prefill_done = int(st.prompt.size)
+        self.metrics.inc("beam_forks")
+        self.metrics.inc("beam_shared_pages", n_share)
+        return hold_slot
+
+    def _beam_release(self, slot: int, job) -> None:
+        """A hypothesis died (or froze): its pages go back to the pool,
+        the slot parks as a hold for future forks of this job."""
+        st = self._slots[slot]
+        self._release_pages(st)
+        st.state = "hold"
+        st.role = "hold"
+        job.holds.append(slot)
+
+    def _beam_park(self, job) -> None:
+        """Pool-parked: the job's slots sit out decode ticks until a
+        retry (serve_step) finds pages."""
+        for h in job.hyps:
+            if h.slot is not None:
+                self._slots[h.slot].state = "beam_wait"
+        if not job.expanded:
+            self._slots[job.parent_slot].state = "beam_wait"
+        self.metrics.inc("beam_parked")
+
+    def _beam_unpark(self, job) -> None:
+        for h in job.hyps:
+            if h.slot is not None:
+                self._slots[h.slot].state = "decode"
+        if not job.expanded:
+            self._slots[job.parent_slot].state = "decode"
+
+    def _beam_free_slots(self, job) -> None:
+        slots = list(job.holds)
+        slots.extend(h.slot for h in job.hyps if h.slot is not None)
+        if not job.expanded:
+            slots.append(job.parent_slot)
+        for slot in set(slots):
+            st = self._slots[slot]
+            if st is not None:
+                if st.pages:
+                    self._release_pages(st)
+                self._slots[slot] = None
+        job.holds = []
+
+    def _beam_finish(self, job, ids: np.ndarray,
+                     scores: np.ndarray) -> None:
+        """All hypotheses frozen or at horizon: free the job's slots and
+        complete the request — ``(ids [K, Tp+N], scores [K])`` when the
+        request asked for all beams, else the best beam's ids truncated
+        after its eos."""
+        self._beam_free_slots(job)
+        if job in self._beam_jobs:
+            self._beam_jobs.remove(job)
+        if job.params.return_all:
+            result = (ids, scores)
+        else:
+            best = ids[0]
+            plen = int(job.prompt.size)
+            if job.eos_id >= 0:
+                gen = best[plen:]
+                hits = np.nonzero(gen == job.eos_id)[0]
+                if hits.size:
+                    best = best[:plen + int(hits[0]) + 1]
+            result = best
+        latency = time.monotonic() - job.request.enqueue_t
+        self._recent.append({
+            "beam_size": job.K, "prompt_len": int(job.prompt.size),
+            "tokens": job.max_new, "status": "ok",
+            "latency_s": round(latency, 6)})
+        job.request.future.set_result(result)
+        job.request.end_trace(status="ok", beam_size=job.K,
+                              latency_s=round(latency, 6))
+        self.metrics.inc("completed")
+        self.metrics.observe_latency(latency)
+
+    def _beam_abort(self, job, exc) -> None:
+        self._beam_free_slots(job)
+        if job in self._beam_jobs:
+            self._beam_jobs.remove(job)
+        job.done = True
+        self.metrics.inc("cache_exhausted")
+        job.request.end_trace(status="cache_exhausted")
+        job.request.future.set_exception(exc)
+
+    def _beam_maintenance(self) -> bool:
+        """Retry pool-parked beam jobs; a job that can NEVER get its
+        pages (nothing else runs and eviction already failed) aborts
+        typed instead of parking forever."""
+        from .errors import CacheExhaustedError
+
+        did = False
+        for job in list(self._beam_jobs):
+            if not job.waiting:
+                continue
+            if job.retry():
+                did = True
+                continue
+            others = any(
+                st is not None and st.beam_job is not job
+                for st in self._slots)
+            if not others and not self._deferred:
+                self._beam_abort(job, CacheExhaustedError(
+                    f"beam_size {job.K} cannot get its fork pages "
+                    f"({self.pool.available()} available and nothing "
+                    "else in flight) — shrink the beam or grow n_pages",
+                    pages_needed=job.K, pages_free=self.pool.available()))
+        return did
+
+    def generate_beam(self, prompt, beam_size: int = 4,
+                      max_new_tokens: Optional[int] = None,
+                      eos_id: Optional[int] = None,
+                      length_penalty: float = 0.0,
+                      return_all: bool = True):
+        """Synchronous beam search through the engine loop. Returns
+        ``(ids [K, Tp+N] best-first, scores [K])`` (``return_all=False``:
+        the best beam's ids). Token-exact against
+        ``transformer_stack_beam_search`` over the same weights."""
+        req = Request({"prompt": prompt},
+                      {"max_new_tokens": (max_new_tokens
+                                          or self.default_max_new_tokens),
+                       "eos_id": eos_id, "beam_size": int(beam_size),
+                       "length_penalty": float(length_penalty),
+                       "return_beams": bool(return_all)}, None)
+        self._drive([req])
+        return req.future.result(timeout=0.1)
 
     def _gauges(self):
         super()._gauges()
@@ -1489,6 +1973,7 @@ class PagedGenerationEngine(GenerationEngine):
                                self.pool.pages_in_use())
         self.metrics.set_gauge("mem/kv_pages_free",
                                self.pool.available())
+        self.metrics.set_gauge("beam_active_jobs", len(self._beam_jobs))
         if self.prefix_index is not None:
             self.metrics.set_gauge("kv_prefix_entries",
                                    len(self.prefix_index))
@@ -1530,7 +2015,8 @@ class PagedGenerationEngine(GenerationEngine):
     # -- server-driver interface ------------------------------------------
     def serve_step(self, batcher,
                    idle_wait_s: Optional[float] = None) -> bool:
-        did = self._admit_deferred() > 0
+        did = self._beam_maintenance()
+        did = self._admit_deferred() > 0 or did
         free = self.free_slots
         if free and not self._deferred:
             wait = 0 if (self.active or did) else idle_wait_s
@@ -1541,21 +2027,32 @@ class PagedGenerationEngine(GenerationEngine):
         did = self.decode_tick() or did
         return did
 
-    def generate_all(self, prompts: Sequence[Sequence[int]],
-                     max_new_tokens: Optional[int] = None,
-                     eos_id: Optional[int] = None) -> List[np.ndarray]:
-        max_new = max_new_tokens or self.default_max_new_tokens
-        reqs = [Request({"prompt": p},
-                        {"max_new_tokens": max_new, "eos_id": eos_id},
-                        None)
-                for p in prompts]
+    def _drive(self, reqs: List[Request]) -> None:
+        """Run the engine loop until every given request completes (the
+        in-process analogue of a loaded server, beam jobs included)."""
         pending = list(reqs)
-        while pending or self.active or self._deferred:
+        while pending or self.active or self._deferred or self._beam_jobs:
             if pending and self.free_slots and not self._deferred:
                 k = min(len(pending), self.free_slots)
                 self.admit(pending[:k])
                 pending = pending[k:]
+            self._beam_maintenance()
             self._admit_deferred()
             self.prefill_tick()
             self.decode_tick()
+
+    def generate_all(self, prompts: Sequence[Sequence[int]],
+                     max_new_tokens: Optional[int] = None,
+                     eos_id: Optional[int] = None,
+                     sampling=None) -> List[np.ndarray]:
+        """``sampling``: one SamplingParams for every prompt, or a list
+        (one per prompt) — mixed policies ride one continuous batch."""
+        max_new = max_new_tokens or self.default_max_new_tokens
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling] * len(list(prompts))
+        reqs = [Request({"prompt": p},
+                        {"max_new_tokens": max_new, "eos_id": eos_id,
+                         "sampling_params": sp}, None)
+                for p, sp in zip(prompts, sampling)]
+        self._drive(reqs)
         return [r.future.result(timeout=0.1) for r in reqs]

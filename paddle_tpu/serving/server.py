@@ -9,8 +9,17 @@ queue: the local-replica pattern (one engine per device via ``place``).
 The HTTP endpoint is stdlib ``http.server`` (no framework dependency —
 the container bakes none), JSON in/out:
 
-    POST /v1/generate  {"prompt": [ids], "max_new_tokens": n, "eos_id": e}
-                       -> {"ids": [...]}
+    POST /v1/generate  {"prompt": [ids], "max_new_tokens": n, "eos_id": e,
+                        # decode-platform fields (all optional; absent =
+                        # legacy greedy, byte-identical):
+                        "temperature": t, "top_k": k, "top_p": p,
+                        "seed": s, "stop": [[ids], ...],
+                        "beam_size": K, "length_penalty": a,
+                        "return_beams": bool,
+                        # seq2seq engines: "src" replaces/joins "prompt"
+                        "src": [ids]}
+                       -> {"ids": [...]} (+ "beams"/"scores" for
+                       return_beams)
     POST /v1/infer     {"inputs": {feed: nested-list-row}}
                        -> {"outputs": [...]}
     GET  /metrics      -> MetricsRegistry snapshot + serving timers
@@ -44,6 +53,12 @@ from .errors import (BadRequestError, EngineClosedError, QueueFullError,
 from .metrics import MetricsRegistry
 
 _IDLE_WAIT_S = 0.02  # dispatch-loop poll when the queue is empty
+
+#: /v1/generate request fields forwarded into the engine meta — the
+#: decode-platform schema (paddle_tpu.decoding.SamplingParams/BeamParams)
+GENERATE_META = ("max_new_tokens", "eos_id", "temperature", "top_k",
+                 "top_p", "seed", "stop", "beam_size", "length_penalty",
+                 "return_beams")
 
 
 class Server:
@@ -407,13 +422,28 @@ class Server:
                     if self.path.startswith("/admin/"):
                         self._admin(req)
                     elif self.path == "/v1/generate":
+                        # sampling / stop / beam request fields — absent
+                        # fields keep the legacy greedy behavior
+                        # byte-identical (GENERATE_META names the schema)
+                        meta = {k: req[k] for k in GENERATE_META
+                                if req.get(k) is not None}
+                        payload = ({"src": req["src"],
+                                    "prompt": req.get("prompt")}
+                                   if req.get("src") is not None
+                                   else {"prompt": req["prompt"]})
                         fut = server.submit(
-                            {"prompt": req["prompt"]},
-                            timeout_ms=req.get("timeout_ms"),
-                            max_new_tokens=req.get("max_new_tokens"),
-                            eos_id=req.get("eos_id"), **tmeta)
-                        ids = fut.result(timeout=req.get("timeout_s", 60))
-                        self._send(200, {"ids": np.asarray(ids).tolist()})
+                            payload, timeout_ms=req.get("timeout_ms"),
+                            **meta, **tmeta)
+                        res = fut.result(timeout=req.get("timeout_s", 60))
+                        if isinstance(res, tuple):  # all beams requested
+                            ids, scores = res
+                            self._send(200, {
+                                "ids": np.asarray(ids)[0].tolist(),
+                                "beams": np.asarray(ids).tolist(),
+                                "scores": np.asarray(scores).tolist()})
+                        else:
+                            self._send(200,
+                                       {"ids": np.asarray(res).tolist()})
                     elif self.path == "/v1/infer":
                         inputs = {k: np.asarray(v)
                                   for k, v in req["inputs"].items()}

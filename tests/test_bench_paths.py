@@ -313,3 +313,22 @@ def test_online_bench_path_runs():
     assert res["sparse_peak_mb"] < res["dense_peak_mb"]
     assert res["publish_generation"] == 1
     assert res["storm_failed"] == 0
+
+
+@pytest.mark.slow
+def test_decode_platform_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    res = _bench().bench_decode_platform(
+        jax, pt, layers, models, tmax=64, page_size=8, slots=4,
+        prompt_len=12, max_new=6, n_requests=8, d=16, L=2, H=2,
+        vocab=32, beam_k=3, beam_new=6)
+    # mixed sampling rides the SAME executables as greedy
+    assert res["mixed_sampling"]["fresh_compiles"] == 0
+    assert res["greedy"]["ms_per_token"] > 0
+    # beam forks share prefix pages: under the dense K-copy baseline
+    assert res["beam"]["pages_hwm"] < res["beam"]["dense_copy_pages"]
+    assert res["beam"]["forks"] >= res["beam"]["beam_size"] - 1

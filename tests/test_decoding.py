@@ -1,0 +1,424 @@
+"""Decode-platform pins: per-request SamplingParams (batch-composition
+invariance, engine-default compat shim, mixed-policy zero-recompile),
+stop-sequence mid-page truncation, the JSON-schema token-mask hook, beam
+search as paged forks (token-exact + score-identical vs the fused
+reference, sub-linear page growth), and fleet hedging's pinned seed."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+from paddle_tpu.decoding import (BeamParams, JsonSchemaMask,
+                                 SamplingParams, TokenBanMask)
+from paddle_tpu.serving import (DynamicBatcher, GenerationEngine, LMSpec,
+                                Request)
+
+VOCAB, D, L, H, MAXLEN = 32, 16, 2, 2, 64
+
+# module-level weight cache (the PR 10 pattern): the LM startup compiles
+# once; fresh scopes share the immutable weight arrays
+_WEIGHTS = {}
+
+
+def _init_lm_scope(seed=7, **lm_kwargs):
+    key = (seed, tuple(sorted(lm_kwargs.items())))
+    exe = pt.Executor(pt.TPUPlace())
+    if key not in _WEIGHTS:
+        scope = pt.Scope()
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            prompt = layers.data("p_init", shape=[8], dtype="int64")
+            models.transformer_lm_generate(
+                prompt, vocab_size=VOCAB, d_model=D, n_layers=L,
+                num_heads=H, max_len=MAXLEN, max_new_tokens=1, **lm_kwargs)
+        startup.random_seed = seed
+        exe.run(startup, scope=scope)
+        _WEIGHTS[key] = {n: scope.get(n) for n in scope.keys()}
+    scope = pt.Scope()
+    for n, v in _WEIGHTS[key].items():
+        scope.set(n, v)
+    return scope, exe
+
+
+def _spec(**kw):
+    return LMSpec(vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+                  max_len=MAXLEN, **kw)
+
+
+def _engine(scope, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prompt_buckets", (8, 16))
+    return GenerationEngine(_spec(), scope, **kw)
+
+
+# one default engine shared by the tests that only need "an engine over
+# the seed-7 weights" — drives leave no slot/page state behind, and
+# sampled tokens are batch/engine-state invariant by construction
+# (tier-1 budget: every fresh engine is a fresh compile set)
+_SHARED = [None]
+
+
+def _shared_engine():
+    if _SHARED[0] is None:
+        _SHARED[0] = _engine(_init_lm_scope(7)[0], prefix_sharing=False)
+    return _SHARED[0]
+
+
+def _beam_reference(scope, exe, prompt, K, N, alpha, eos):
+    """The fused dense-cache beam op: an independent implementation path
+    — candidate semantics the paged-fork beam must reproduce exactly."""
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        p = layers.data(f"p_beam{prompt.size}_{N}", shape=[prompt.size],
+                        dtype="int64")
+        ids_v, sc_v = models.transformer_lm_beam_search(
+            p, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=N, beam_size=K,
+            length_penalty=alpha, eos_id=eos)
+    ids, sc = exe.run(prog, feed={f"p_beam{prompt.size}_{N}":
+                                  prompt[None]},
+                      fetch_list=[ids_v, sc_v], scope=scope)
+    return np.asarray(ids)[0], np.asarray(sc)[0]
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams semantics (no engine needed)
+# ---------------------------------------------------------------------------
+class TestSamplingParams:
+    def test_request_fields_win_over_engine_default(self):
+        default = SamplingParams(temperature=0.7, top_k=5, seed=1)
+        got = SamplingParams.from_meta({"temperature": 0.0,
+                                        "top_p": 0.9}, default)
+        assert got.temperature == 0.0      # request wins
+        assert got.top_p == 0.9
+        assert got.top_k == 5 and got.seed == 1  # absent -> inherited
+        assert SamplingParams.from_meta({}, default) is default
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SamplingParams(temperature=-1).validate()
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=0.0).validate()
+        with pytest.raises(ValueError):
+            SamplingParams(top_k=99).validate(vocab_size=32)
+        with pytest.raises(ValueError):
+            SamplingParams(stop=((1, 99),)).validate(vocab_size=32)
+        SamplingParams(temperature=1.0, top_k=4, top_p=0.5,
+                       seed=3, stop=((1, 2),)).validate(vocab_size=32)
+
+    def test_beam_params_from_meta(self):
+        assert BeamParams.from_meta({"beam_size": 1}) is None
+        bp = BeamParams.from_meta({"beam_size": 4,
+                                   "length_penalty": 0.6, "eos_id": 1})
+        assert bp.beam_size == 4 and bp.length_penalty == 0.6
+        assert bp.eos_id == 1
+
+
+# ---------------------------------------------------------------------------
+# per-request sampling on the engine
+# ---------------------------------------------------------------------------
+class TestPerRequestSampling:
+    def test_batch_composition_invariance(self):
+        """THE determinism pin: a seeded sampled request emits identical
+        tokens alone, co-batched with different companions, and across
+        different tick interleavings."""
+        eng = _shared_engine()
+        rng = np.random.RandomState(1)
+        target = rng.randint(0, VOCAB, (6,)).astype("int64")
+        sp = SamplingParams(temperature=0.9, top_k=12, seed=42)
+        alone = eng.generate_all([target], max_new_tokens=6,
+                                 sampling=sp)[0]
+        others = [rng.randint(0, VOCAB, (n,)).astype("int64")
+                  for n in (3, 9, 5)]
+        mix = [sp, SamplingParams(temperature=1.3, seed=9), None,
+               SamplingParams(temperature=0.8, top_p=0.8, seed=10)]
+        batched = eng.generate_all([target] + others, max_new_tokens=6,
+                                   sampling=mix)[0]
+        np.testing.assert_array_equal(alone, batched)
+        # and across a different co-batch entirely
+        batched2 = eng.generate_all([others[1], target],
+                                    max_new_tokens=6,
+                                    sampling=[None, sp])[1]
+        np.testing.assert_array_equal(alone, batched2)
+        # same seed on a FRESH engine over the same weights (the
+        # cross-replica reproducibility hedging relies on)
+        eng2 = _engine(_init_lm_scope(7)[0])
+        np.testing.assert_array_equal(
+            alone, eng2.generate_all([target], max_new_tokens=6,
+                                     sampling=sp)[0])
+        # different seed -> different stream (overwhelmingly)
+        other = eng.generate_all([target], max_new_tokens=6,
+                                 sampling=sp.with_seed(43))[0]
+        assert not np.array_equal(alone, other)
+
+    def test_engine_kwarg_compat_shim(self):
+        """Deprecated GenerationEngine(temperature=, top_k=) == the same
+        default SamplingParams; a request-level field overrides it
+        (request wins), pinned against explicit per-request params."""
+        sp = SamplingParams(temperature=0.9, top_k=8)
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(0, VOCAB, (5,)).astype("int64")
+        legacy = _engine(_init_lm_scope(7)[0], temperature=0.9, top_k=8)
+        assert legacy.default_sampling.temperature == 0.9
+        assert legacy.default_sampling.top_k == 8
+        explicit = _engine(_init_lm_scope(7)[0], sampling=sp)
+        # engine-assigned default seeds are a per-engine counter, so
+        # fresh engines with identical defaults emit identical streams
+        a = legacy.generate_all([prompt], max_new_tokens=5)[0]
+        b = explicit.generate_all([prompt], max_new_tokens=5)[0]
+        np.testing.assert_array_equal(a, b)
+        # request-level greedy overrides the sampled engine default
+        greedy_eng = _engine(_init_lm_scope(7)[0])
+        want = greedy_eng.generate_all([prompt], max_new_tokens=5)[0]
+        got = legacy.generate_all(
+            [prompt], max_new_tokens=5,
+            sampling=SamplingParams(temperature=0.0))[0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_mixed_policy_zero_recompile(self):
+        """THE compile pin: greedy + temperature + top-p + masked rows
+        in one continuous batch add ZERO fresh compiles after warmup."""
+        scope, _ = _init_lm_scope(7)
+        eng = _engine(scope, prefill_batch_buckets=(1, 2, 4))
+        eng.warmup()
+        misses0 = eng.cache_stats()["misses"]
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, VOCAB, (rng.randint(2, 12),))
+                   .astype("int64") for _ in range(4)]
+        mix = [None,
+               SamplingParams(temperature=1.0, seed=5),
+               SamplingParams(temperature=0.9, top_p=0.7, seed=6),
+               SamplingParams(temperature=1.0, seed=7,
+                              logits_processor=TokenBanMask(VOCAB,
+                                                            [2, 3]))]
+        outs = eng.generate_all(prompts, max_new_tokens=5, sampling=mix)
+        assert eng.cache_stats()["misses"] == misses0, eng.cache_stats()
+        assert all(o.size for o in outs)
+        # the banned tokens never surface on the masked row
+        banned_row = outs[3][prompts[3].size:]
+        assert not np.isin(banned_row, [2, 3]).any()
+
+    def test_stop_sequence_mid_page_truncation(self):
+        """THE stop pin: a two-token stop sequence that completes
+        mid-page truncates the result BEFORE the match, finishes the
+        request, and releases every page."""
+        eng = _shared_engine()
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(0, VOCAB, (6,)).astype("int64")
+        sp = SamplingParams(temperature=0.9, seed=11)
+        free = eng.generate_all([prompt], max_new_tokens=8,
+                                sampling=sp)[0]
+        gen = free[prompt.size:]
+        assert gen.size == 8
+        # stop on generated tokens 3..4 -> keep exactly 3, mid-stream
+        stop = (int(gen[3]), int(gen[4]))
+        stopped = eng.generate_all(
+            [prompt], max_new_tokens=8,
+            sampling=sp.__class__(temperature=0.9, seed=11,
+                                  stop=(stop,)))[0]
+        np.testing.assert_array_equal(stopped,
+                                      free[:prompt.size + 3])
+        assert eng.metrics.counter("stop_sequence_hits") >= 1
+        assert eng.pool.pages_in_use() == 0  # everything released
+
+    def test_json_schema_mask_constrained_decode(self):
+        """The shipped LogitsProcessor exemplar: a high-temperature
+        sampled stream constrained by JsonSchemaMask emits text that
+        parses as JSON matching the schema, BY CONSTRUCTION."""
+        chars = dict(enumerate('{}[]",:0123456789abcdefghijklmnopqrstuv'))
+        # only the first VOCAB ids exist on this model
+        chars = {k: v for k, v in chars.items() if k < VOCAB}
+        schema = {"type": "object", "properties": {"a": {"type":
+                                                         "integer"}}}
+        proc = JsonSchemaMask(chars, schema, vocab_size=VOCAB)
+        eng = _shared_engine()
+        prompt = np.asarray([5, 9, 2], np.int64)
+        sp = SamplingParams(temperature=1.5, seed=21,
+                            logits_processor=proc)
+        got = eng.generate_all([prompt], max_new_tokens=9,
+                               sampling=sp)[0]
+        text = proc.text_of(got[prompt.size:])
+        # the emitted prefix is always viable; a complete prefix parses
+        complete = [i for i in range(1, len(text) + 1)
+                    if proc.complete(got[prompt.size:prompt.size + i])]
+        assert complete, text
+        doc = json.loads(text[:complete[-1]])
+        assert set(doc) == {"a"} and isinstance(doc["a"], int), text
+
+
+# ---------------------------------------------------------------------------
+# beam search as paged forks
+# ---------------------------------------------------------------------------
+class TestBeamPagedForks:
+    def test_beam_token_exact_and_sublinear_pages(self):
+        """THE beam acceptance pin: K=4 length-normalized beam through
+        paged forks is token-exact and score-identical vs the fused
+        dense-cache reference, while the pool high-water stays UNDER the
+        K-dense-copy baseline (forked beams share prefix pages)."""
+        scope_r, exe = _init_lm_scope(7)
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, VOCAB, (17,)).astype("int64")  # 3 pages
+        K, N, alpha, eos = 4, 8, 0.6, 1
+        ref_ids, ref_sc = _beam_reference(scope_r, exe, prompt, K, N,
+                                          alpha, eos)
+        eng = _engine(_init_lm_scope(7)[0], slots=K + 1, page_size=8,
+                      beam_width=K, prefix_sharing=False,
+                      prompt_buckets=(32,))
+        hwm = [0]
+        orig = eng._gauges
+
+        def gauged():
+            orig()
+            hwm[0] = max(hwm[0], eng.pool.pages_in_use())
+
+        eng._gauges = gauged
+        ids, sc = eng.generate_beam(prompt, beam_size=K,
+                                    max_new_tokens=N, eos_id=eos,
+                                    length_penalty=alpha)
+        np.testing.assert_array_equal(ids, ref_ids)
+        np.testing.assert_allclose(sc, ref_sc, rtol=1e-4, atol=1e-5)
+        # sub-linear page growth: K dense copies would hold K x entries
+        entries = -(-(prompt.size + N) // eng.page_size)
+        assert hwm[0] < K * entries, (hwm[0], K * entries)
+        assert eng.metrics.counter("beam_forks") >= K - 1
+        assert eng.pool.pages_in_use() == 0  # all released at finish
+
+    def test_beam_rides_the_continuous_batch(self):
+        """A beam request and greedy requests share the SAME decode
+        ticks: both finish with exactly their solo results."""
+        scope, exe = _init_lm_scope(7)
+        rng = np.random.RandomState(6)
+        prompt_b = rng.randint(0, VOCAB, (9,)).astype("int64")
+        prompt_g = rng.randint(0, VOCAB, (5,)).astype("int64")
+        K, N = 3, 6
+        ref_ids, ref_sc = _beam_reference(scope, exe, prompt_b, K, N,
+                                          0.0, -1)
+        solo_g = _shared_engine().generate_all(
+            [prompt_g], max_new_tokens=4)[0]
+        eng = _engine(_init_lm_scope(7)[0], slots=K + 2, beam_width=K)
+        batcher = DynamicBatcher(buckets=(1, 2, 4), max_wait_ms=1)
+        fut_b = batcher.submit({"prompt": prompt_b}, beam_size=K,
+                               max_new_tokens=N, return_beams=True)
+        fut_g = batcher.submit({"prompt": prompt_g}, max_new_tokens=4)
+        for _ in range(300):
+            eng.serve_step(batcher, idle_wait_s=0)
+            if fut_b.done() and fut_g.done():
+                break
+        ids, sc = fut_b.result(timeout=1)
+        np.testing.assert_array_equal(ids, ref_ids)
+        np.testing.assert_allclose(sc, ref_sc, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(fut_g.result(timeout=1), solo_g)
+
+    @pytest.mark.slow
+    def test_beam_gqa_rope_leg(self):
+        """The GQA/RoPE beam leg: per-row rotary offsets + grouped KV
+        through the fork path vs the fused reference."""
+        kw = dict(use_rope=True, num_kv_heads=1)
+        scope_r, exe = _init_lm_scope(5, **kw)
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, VOCAB, (10,)).astype("int64")
+        ref_ids, ref_sc = _beam_reference_kw(scope_r, exe, prompt, 4, 6,
+                                             0.6, 1, **kw)
+        eng = GenerationEngine(_spec(**kw), _init_lm_scope(5, **kw)[0],
+                               slots=5, page_size=4, beam_width=4,
+                               prompt_buckets=(16,))
+        ids, sc = eng.generate_beam(prompt, beam_size=4,
+                                    max_new_tokens=6, eos_id=1,
+                                    length_penalty=0.6)
+        np.testing.assert_array_equal(ids, ref_ids)
+        np.testing.assert_allclose(sc, ref_sc, rtol=1e-4, atol=1e-5)
+
+    def test_beam_request_validation(self):
+        eng = _engine(_init_lm_scope(7)[0])  # beam_width=0
+        req = Request({"prompt": np.arange(4, dtype=np.int64)},
+                      {"beam_size": 4, "max_new_tokens": 4}, None)
+        assert eng.admit([req]) == 0
+        with pytest.raises(Exception) as ei:
+            req.future.result(timeout=1)
+        assert "beam" in str(ei.value)
+
+
+def _beam_reference_kw(scope, exe, prompt, K, N, alpha, eos, **kw):
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        p = layers.data("p_beam_kw", shape=[prompt.size], dtype="int64")
+        ids_v, sc_v = models.transformer_lm_beam_search(
+            p, vocab_size=VOCAB, d_model=D, n_layers=L, num_heads=H,
+            max_len=MAXLEN, max_new_tokens=N, beam_size=K,
+            length_penalty=alpha, eos_id=eos, **kw)
+    ids, sc = exe.run(prog, feed={"p_beam_kw": prompt[None]},
+                      fetch_list=[ids_v, sc_v], scope=scope)
+    return np.asarray(ids)[0], np.asarray(sc)[0]
+
+
+# ---------------------------------------------------------------------------
+# fleet: hedging never changes sampled tokens
+# ---------------------------------------------------------------------------
+class TestFleetSeedPinning:
+    def test_hedged_attempts_share_one_seed(self):
+        """The hedging pin: a sampled request WITHOUT a seed gets ONE
+        fleet-assigned seed BEFORE any attempt dispatches, so the
+        primary and the hedge (different replicas) would sample
+        identical tokens whichever wins."""
+        import threading
+        import time as time_mod
+
+        from paddle_tpu.serving.batcher import Future
+        from paddle_tpu.serving.fleet import Fleet, Replica, _Attempt
+
+        captured = []
+
+        class FakeReplica(Replica):
+            def __init__(self, name, delay):
+                self.name = name
+                self._delay = delay
+
+            @property
+            def routable(self):
+                return True
+
+            def healthz(self):
+                return {"state": "ready", "ok": True}
+
+            def begin(self, payload, meta, timeout_ms):
+                captured.append((self.name, dict(meta)))
+                fut = Future()
+
+                def finish():
+                    time_mod.sleep(self._delay)
+                    fut.set_result(np.asarray([1, 2, 3]))
+
+                threading.Thread(target=finish, daemon=True).start()
+                return _Attempt(fut, self)
+
+        fleet = Fleet([FakeReplica("a", 0.25), FakeReplica("b", 0.0)],
+                      hedge_delay_ms=10.0)
+        try:
+            out = fleet.submit({"prompt": [1]}, temperature=0.9,
+                               max_new_tokens=4).result(timeout=10)
+            assert out.tolist() == [1, 2, 3]
+            deadline = time_mod.monotonic() + 5
+            while len(captured) < 2 and time_mod.monotonic() < deadline:
+                time_mod.sleep(0.01)
+            assert len(captured) >= 2, captured
+            seeds = {m.get("seed") for _, m in captured}
+            assert len(seeds) == 1 and None not in seeds, captured
+        finally:
+            fleet.stop()
+
+    def test_explicit_seed_survives(self):
+        from paddle_tpu.serving.fleet import Fleet
+
+        meta = {"temperature": 1.0, "seed": 77}
+        Fleet._pin_seed(meta)
+        assert meta["seed"] == 77
+        meta2 = {"temperature": 0.0}
+        Fleet._pin_seed(meta2)
+        assert "seed" not in meta2  # greedy untouched
+        sp = SamplingParams(temperature=1.0)
+        meta3 = {"sampling_params": sp}
+        Fleet._pin_seed(meta3)
+        assert meta3["sampling_params"].seed is not None

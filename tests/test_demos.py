@@ -28,7 +28,10 @@ def _run_demo(path, *argv):
 
 # tier-1 budget: the heaviest demo rides the slow tier; every other
 # demo stays a tier-1 integration guard
-_SLOW_DEMOS = ("traffic_prediction.py",)
+_SLOW_DEMOS = ("traffic_prediction.py", "nmt_transformer.py")
+# nmt_transformer rides the slow tier for the tier-1 budget: its
+# topology is CI-gated via proglint --demo nmt and its engine paths are
+# pinned token-exact in tests/test_nmt_decode.py
 
 
 @pytest.mark.parametrize(

@@ -2,6 +2,7 @@
 loop must agree token-for-token with iterative full re-forwarding through
 the training graph — the O(T) cache path vs the O(T^2) naive path."""
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers, models
@@ -346,12 +347,16 @@ def _decode_vs_reforward(lm_kwargs):
     np.testing.assert_array_equal(got, cur)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): the rope+gqa COMBINED leg
+# below covers both mechanisms; the single-feature variants are the
+# redundant twins
 def test_gqa_stack_decode_matches_reforwarding():
     """Grouped-query attention (multi-query extreme, Hkv=1): the cache
     holds one KV head plane and decode must equal re-forwarding."""
     _decode_vs_reforward({"num_kv_heads": 1})
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): see the gqa twin above
 def test_rope_stack_decode_matches_reforwarding():
     """RoPE: rotated keys enter the cache at their absolute positions,
     so incremental decode must equal re-forwarding (which re-rotates
@@ -406,6 +411,9 @@ class TestSpeculativeDecoding:
         np.testing.assert_array_equal(np.asarray(s_), np.asarray(g))
         assert 1 <= int(np.asarray(r)[0]) <= N
 
+    @pytest.mark.slow  # tier-1 budget (PR 14): EXPERIMENTAL plane —
+    # the exactness guarantee above stays tier-1; acceptance-rate is a
+    # speed diagnostic
     def test_trained_draft_head_accepts_more(self):
         """A draft head distilled to mimic the full head should cut the
         verify-round count well below N (the speedup mechanism)."""
@@ -487,6 +495,8 @@ def test_generation_on_dp_mesh_matches_single_device():
     np.testing.assert_array_equal(sharded, single)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): EXPERIMENTAL plane; the
+# single-device exactness pin stays tier-1
 def test_speculative_on_dp_mesh_matches_single_device():
     """The while-loop + gather machinery of speculative decode must also
     compile and agree under a data-parallel mesh."""

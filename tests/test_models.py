@@ -38,6 +38,8 @@ def test_alexnet_forward():
                  batch=1)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): zoo smoke — alexnet/cifar
+# stay tier-1, the heavy stacks ride the slow tier with googlenet
 def test_vgg16_forward():
     # 64x64 keeps CPU compile+run time reasonable; spatial dims stay valid.
     _run_forward(lambda im: models.vgg(im, num_classes=10, depth=16),
@@ -50,6 +52,7 @@ def test_googlenet_forward():
                  (224, 224, 3), batch=1)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): see vgg16 above
 def test_mobilenet_forward():
     _run_forward(lambda im: models.mobilenet(im, num_classes=10, scale=0.25),
                  (64, 64, 3), batch=1)

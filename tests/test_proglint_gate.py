@@ -150,3 +150,23 @@ def test_mem_gate_tiny_budget_fails_sane_budget_passes(proglint, capsys):
                 if i["rule"] == "memory-budget"]
     assert findings and all(i["severity"] == "warning" for i in findings)
     assert all("static peak HBM" in i["message"] for i in findings)
+
+
+def test_nmt_demo_lints_clean_with_mem(proglint, capsys):
+    """The encoder-decoder topology gate: ``--demo nmt --mem`` lints
+    clean — the teacher-forced training graph, the admission-time
+    encode program, and the cross-attention decode step (WITH the
+    engine scope, so the memory finding prices the cross-KV slot cache
+    next to the page pool)."""
+    rc = proglint.main(["--demo", "nmt", "--mem", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["errors"] == 0
+    tags = [t["target"] for t in out["targets"]]
+    assert any("nmt[train]" in t for t in tags)
+    assert "nmt[encode]" in tags
+    assert "nmt[cross_decode]" in tags
+    mem = [i for t in out["targets"] for i in t["issues"]
+           if i["rule"] == "memory-budget"
+           and t["target"] == "nmt[cross_decode]"]
+    assert mem and "static peak HBM" in mem[0]["message"]

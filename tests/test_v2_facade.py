@@ -2,6 +2,7 @@
 tail, attention composites, and the seqToseq / model-zoo recipes — all
 expressed through the v2 namespace only (no paddle_tpu.layers)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.v2 import activation, layer as l2, networks
@@ -249,6 +250,8 @@ def test_model_zoo_resnet_expresses_in_v2_namespace():
     np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): the facade's conv path is
+# covered by the cnn/lenet facade tests; the vgg stack is the heavy twin
 def test_small_vgg_builds_and_serves():
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):

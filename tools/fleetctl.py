@@ -13,6 +13,15 @@ reach the fleet.
     fleetctl.py --url http://host:port chaos 'replica_crash@1,slow_replica@2'
     fleetctl.py --url http://host:port metrics [--prom]
     fleetctl.py --url http://host:port flightdump [--out bundle.json]
+    fleetctl.py --url http://host:port generate --prompt 1,2,3 \
+        [--src 4,5,6] [--max-new-tokens N] [--temperature T] [--top-k K] \
+        [--top-p P] [--seed S] [--stop 7,8] [--beam-size K] \
+        [--length-penalty A] [--return-beams] [--eos-id E]
+
+``generate`` drives the /v1/generate data plane with the full
+decode-platform request schema — per-request sampling (temperature /
+top-k / top-p / seed), stop token-sequences, and beam search; flags you
+omit keep the fleet's default (greedy) behavior byte-identical.
 
 ``status`` reports, per replica, health/breaker/inflight plus the decode
 latency columns (TTFT/TPOT p50/p99 from the replica's histograms) and,
@@ -137,6 +146,27 @@ def main(argv=None) -> int:
                        help="fetch the fleet's flight-recorder bundle")
     p.add_argument("--out", default=None,
                    help="write the bundle here instead of stdout")
+    p = sub.add_parser("generate",
+                       help="submit one /v1/generate request (sampling/"
+                            "stop/beam fields included)")
+    p.add_argument("--prompt", default=None,
+                   help="comma-separated prompt token ids")
+    p.add_argument("--src", default=None,
+                   help="comma-separated SOURCE ids (seq2seq engines)")
+    p.add_argument("--max-new-tokens", type=int, default=None)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None,
+                   help="per-request seed: sampled output becomes a "
+                        "pure function of (request, seed)")
+    p.add_argument("--stop", action="append", default=None,
+                   help="stop token-sequence, comma-separated "
+                        "(repeatable)")
+    p.add_argument("--beam-size", type=int, default=None)
+    p.add_argument("--length-penalty", type=float, default=None)
+    p.add_argument("--return-beams", action="store_true", default=None)
     args = ap.parse_args(argv)
 
     def _replica(value):
@@ -170,6 +200,31 @@ def main(argv=None) -> int:
                            timeout=args.timeout, raw=True))
                 return 0
             out = call(args.url + "/metrics", timeout=args.timeout)
+        elif args.cmd == "generate":
+            if args.prompt is None and args.src is None:
+                ap.error("generate needs --prompt and/or --src")
+            body = {}
+            if args.prompt is not None:
+                body["prompt"] = [int(t) for t in
+                                  args.prompt.split(",") if t]
+            if args.src is not None:
+                body["src"] = [int(t) for t in args.src.split(",") if t]
+            if args.stop is not None:
+                body["stop"] = [[int(t) for t in s.split(",") if t]
+                                for s in args.stop]
+            for flag, key in (("max_new_tokens", "max_new_tokens"),
+                              ("eos_id", "eos_id"),
+                              ("temperature", "temperature"),
+                              ("top_k", "top_k"), ("top_p", "top_p"),
+                              ("seed", "seed"),
+                              ("beam_size", "beam_size"),
+                              ("length_penalty", "length_penalty"),
+                              ("return_beams", "return_beams")):
+                v = getattr(args, flag)
+                if v is not None:
+                    body[key] = v
+            out = call(args.url + "/v1/generate", "POST", body,
+                       timeout=args.timeout)
         elif args.cmd == "flightdump":
             out = call(args.url + "/fleet/flightdump",
                        timeout=args.timeout)

@@ -27,7 +27,7 @@ from typing import List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DEMOS = ("quick_start", "serving_lm", "wide_deep")
+DEMOS = ("quick_start", "serving_lm", "wide_deep", "nmt")
 
 
 # --------------------------------------------------------------------------
@@ -147,10 +147,50 @@ def build_demo(name: str):
         eng = GenerationEngine(
             LMSpec(vocab_size=97, d_model=32, n_layers=2, num_heads=4,
                    max_len=64), slots=4, page_size=16)
-        dprog, dnxt = eng._decode_prog
+        dprog, douts = eng._decode_prog
         yield ("serving_lm[paged_decode]", dprog,
-               ["serving.tok", "serving.pos", "serving.block_table"],
-               [dnxt.name], eng.scope)
+               list(eng._decode_feed_names),
+               [v.name for v in eng._fetches(douts)], eng.scope)
+    elif name == "nmt":
+        # the encoder-decoder (seq2seq) topology: the teacher-forced
+        # TRAINING graph plus the serving engine's admission-time
+        # encoder and cross-attention decode step WITH the engine scope,
+        # so --mem prices the cross-KV slot cache [L, S+1, Hkv, Ts, dh]
+        # next to the self-attention page pool
+        VS, VT = 48, 52
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            src = layers.data("src", shape=[12], dtype="int64")
+            slen = layers.data("slen", shape=[], dtype="int32")
+            tgt_in = layers.data("tgt_in", shape=[10], dtype="int64")
+            tgt_next = layers.data("tgt_next", shape=[10], dtype="int64")
+            logits = models.transformer_nmt_teacher(
+                src, slen, tgt_in, src_vocab_size=VS, tgt_vocab_size=VT,
+                d_model=32, n_layers=2, num_heads=4,
+                max_src_len=16, max_tgt_len=32)
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.reshape(logits, shape=[-1, VT]),
+                layers.reshape(tgt_next, shape=[-1, 1])))
+            pt.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(
+                loss, startup_program=startup)
+        yield ("nmt[train]", main, ["src", "slen", "tgt_in", "tgt_next"],
+               [loss.name], None)
+        yield ("nmt[train]/startup", startup, [], [], None)
+        from paddle_tpu.decoding import (Seq2SeqGenerationEngine,
+                                         Seq2SeqSpec)
+
+        eng = Seq2SeqGenerationEngine(
+            Seq2SeqSpec(src_vocab_size=VS, tgt_vocab_size=VT,
+                        d_model=32, n_layers=2, num_heads=4,
+                        max_src_len=16, max_tgt_len=32),
+            slots=4, page_size=8, beam_width=4)
+        eprog, eok = eng._encode_prog(16)
+        yield ("nmt[encode]", eprog,
+               ["serving.src", "serving.src_n", "serving.src_row"],
+               [eok.name], eng.scope)
+        dprog, douts = eng._decode_prog
+        yield ("nmt[cross_decode]", dprog, list(eng._decode_feed_names),
+               [v.name for v in eng._fetches(douts)], eng.scope)
     elif name == "wide_deep":
         # the online-CTR topology (demos/online_ctr.py): sparse high-dim
         # embeddings whose SelectedRows grads feed the row-granular
