@@ -1105,6 +1105,132 @@ def bench_online(jax, pt, layers, vocab=1_000_000, embed_dim=16, slots=8,
     }
 
 
+def bench_elastic(jax, pt, layers, n_tasks=4, records_per_task=32,
+                  batch=16):
+    """Elastic-training chaos witness (ISSUE 15): a 3-trainer relay over
+    one master queue — T1 is fenced mid-run as a zombie (its last acks
+    rejected by token), T2 hard-crashes holding a claim, T3 (T2's
+    reincarnation) rejoins and drains the pass — priced as recovery
+    wall time (fence -> successor's first trained step) and steps
+    retrained, with the exactly-once check (every task acked once, zero
+    discarded, final params bitwise vs an uninterrupted single-trainer
+    run) part of the record. Host/control-plane bench: the CPU row is
+    the witness."""
+    import re
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import dataset
+    from paddle_tpu.master import MasterServer
+    from paddle_tpu.online import StreamingTrainer
+    from paddle_tpu.resilience import (CheckpointConfig, FaultPlan,
+                                       SimulatedCrash)
+
+    VOCAB = 128
+    SLOTS = dataset.ctr.SLOTS
+    DD = dataset.ctr.DENSE_DIM
+
+    def build(seed=7):
+        main, startup = pt.Program(), pt.Program()
+        startup.random_seed = seed
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", shape=[SLOTS], dtype="int64")
+            dense = layers.data("dense", shape=[DD])
+            label = layers.data("label", shape=[1])
+            logit = pt.models.wide_deep(ids, dense, vocab_size=VOCAB,
+                                        embed_dim=4, hidden_sizes=(8,))
+            loss, _ = pt.models.wide_deep_loss(logit, label)
+            sgd = pt.trainer.SGD(
+                loss, pt.optimizer.SGDOptimizer(learning_rate=0.05),
+                [ids, dense, label], scope=pt.Scope())
+        return sgd
+
+    descs = dataset.ctr.task_descs(n_tasks,
+                                   records_per_shard=records_per_task,
+                                   vocab=VOCAB)
+    every = max(records_per_task // batch, 1)  # generation per task
+
+    def stream(addr, ck, bundle, trainer_id, fault=None, first_step=None):
+        st = StreamingTrainer(
+            bundle, addr, dataset.ctr.task_reader, task_descs=descs,
+            batch_size=batch,
+            checkpoint=CheckpointConfig(ck, every_n_steps=every,
+                                        background=False),
+            max_passes=1, trainer_id=trainer_id, rejoin=False,
+            install_signal_handlers=False)
+        handler = None
+        if first_step is not None:
+            def handler(e, _seen=[False]):  # noqa: B006 - latch
+                if not _seen[0] and isinstance(e, pt.event.EndIteration):
+                    _seen[0] = True
+                    first_step.append(time.perf_counter())
+        crashed = False
+        ctx = fault.active() if fault is not None else None
+        try:
+            if ctx is not None:
+                ctx.__enter__()
+            try:
+                st.run(event_handler=handler)
+            except SimulatedCrash:
+                crashed = True
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+        return st, crashed
+
+    # uninterrupted single-trainer baseline
+    srv_u = MasterServer(timeout_s=30, port=0)
+    addr_u = srv_u.start()
+    bu = build()
+    t0 = time.perf_counter()
+    st_u, _ = stream(addr_u, tempfile.mkdtemp(prefix="el-u"), bu, "solo")
+    base_wall = time.perf_counter() - t0
+    srv_u.stop()
+
+    # the chaos relay
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    ck = tempfile.mkdtemp(prefix="el-c")
+    b = build()
+    st1, _ = stream(addr, ck, b, "host-a",
+                    fault=FaultPlan().at(step=2, kind="zombie_ack"))
+    st2, crashed = stream(addr, ck, b, "host-b",
+                          fault=FaultPlan().at(step=2,
+                                               kind="trainer_crash"))
+    crash_t = time.perf_counter()
+    first = []
+    # recovery: crash -> the reincarnation's first trained step (fence
+    # of the dead lease + front-requeue + checkpoint restore + resume)
+    st3, _ = stream(addr, ck, b, "host-b", first_step=first)
+    counts = st3.state()["queue"]
+    srv.stop()
+
+    def okeys(scope):
+        def key(name):
+            m = re.search(r"_(\d+)$", name)
+            return (0, int(m.group(1))) if m else (1, name)
+        return sorted(scope.keys(), key=key)
+
+    bitwise = all(
+        np.array_equal(np.asarray(bu.scope.get(a)),
+                       np.asarray(b.scope.get(bk)))
+        for a, bk in zip(okeys(bu.scope), okeys(b.scope)))
+    relay_steps = st1.steps + st2.steps + st3.steps
+    acks = (st1.tasks_finished + st2.tasks_finished + st3.tasks_finished)
+    return {
+        "tasks": n_tasks,
+        "recovery_s": round(first[0] - crash_t, 4) if first else None,
+        "steps_lost": relay_steps - st_u.steps,
+        "acks_exactly_once": acks == n_tasks,
+        "zombie_acks_rejected": counts["zombie_acks_rejected"],
+        "lease_expired_total": counts["lease_expired_total"],
+        "discarded": counts["discarded"],
+        "bitwise_vs_uninterrupted": bool(bitwise),
+        "uninterrupted_wall_s": round(base_wall, 3),
+    }
+
+
 def bench_paged_kv(jax, pt, layers, models, tmax=2048, page_size=64,
                    dense_slots=4, prompt_len=48, max_new=8,
                    n_requests=24, d=32, L=2, H=4, vocab=128,
@@ -1955,6 +2081,10 @@ def run_bench(platform):
     # (sparse update + publisher are host/HBM-stream planes; the CPU
     # row is the witness, the TPU row prices real HBM scatter rates)
     step("online", bench_online, jax, pt, layers)
+    # elastic-training chaos relay: zombie fence + crash + rejoin on one
+    # master queue — recovery wall + steps retrained + exactly-once +
+    # bitwise checks (pure control plane; the CPU row is the witness)
+    step("elastic", bench_elastic, jax, pt, layers)
     # one-sharding-plane A/B (single vs dp vs dp x tp): on CPU it spawns
     # the 8-device virtual-mesh child (the witness); the TPU row waits
     # for a multi-chip window — single-chip children skip it

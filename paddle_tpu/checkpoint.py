@@ -38,6 +38,34 @@ from .core.program import RNG_VAR
 from .core.scope import global_scope
 
 META_NAME = "checkpoint.meta"
+PIN_NAME = "publisher.pin"
+
+
+def pin_generation(dirname: str, step: Optional[int]) -> None:
+    """Pin generation ``step`` against retention GC (the Publisher pins
+    what the serving fleet is CURRENTLY serving, so a replica restart
+    can always re-load it). ``step=None`` removes the pin. Atomic."""
+    path = os.path.join(dirname, PIN_NAME)
+    if step is None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        return
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step)}, f)
+    os.replace(tmp, path)
+
+
+def pinned_step(dirname: str) -> Optional[int]:
+    """The GC-pinned generation step, or None."""
+    try:
+        with open(os.path.join(dirname, PIN_NAME)) as f:
+            return int(json.load(f)["step"])
+    except (FileNotFoundError, ValueError, KeyError,
+            json.JSONDecodeError):
+        return None
 
 
 def _process_info():
@@ -210,7 +238,10 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
 
     # prune old checkpoints: keep the newest max_keep by step, but the one
     # just written (what meta['latest'] points to) always survives even if
-    # its step is lower than leftovers from an abandoned longer run
+    # its step is lower than leftovers from an abandoned longer run — and
+    # so does the Publisher-pinned generation (the one the serving fleet
+    # is live on), however old: endless-pass online training GCs its
+    # history without ever deleting what production serves
     cks = sorted(
         (p for p in os.listdir(dirname)
          if p.startswith("ckpt-") and p.endswith(".npz")
@@ -218,6 +249,9 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
         key=lambda p: int(p[5:-4]))
     keep = max(int(max_keep), 1)
     keep_set = set(cks[max(len(cks) - keep, 0):]) | {os.path.basename(payload)}
+    pin = pinned_step(dirname)
+    if pin is not None:
+        keep_set.add(f"ckpt-{pin}.npz")
     for old in cks:
         if old not in keep_set:
             os.remove(os.path.join(dirname, old))
@@ -232,7 +266,14 @@ def save_checkpoint(dirname: str, scope=None, step: int = 0,
 
 class _Stage:
     """Staging target for a restore: values land here first so a load
-    that fails mid-way never leaves the real scope half-written."""
+    that fails mid-way never leaves the real scope half-written.
+
+    ``commit(scope, plan=...)`` is the reshard-on-restore half: staged
+    values are FULL host values by construction (main payload entries,
+    or sidecar shards stitched through their global index metadata), so
+    re-placing them is one ``device_put`` per value onto the new plan's
+    PartitionSpec — a checkpoint saved under mesh/plan A restores under
+    mesh/plan B (different axis split, fewer devices) bitwise."""
 
     def __init__(self):
         self._vars = {}
@@ -240,9 +281,28 @@ class _Stage:
     def set(self, name, value):
         self._vars[name] = value
 
-    def commit(self, scope):
+    def commit(self, scope, plan=None):
+        if plan is None:
+            for name, value in self._vars.items():
+                scope.set(name, value)
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(plan.mesh, PartitionSpec())
         for name, value in self._vars.items():
-            scope.set(name, value)
+            if name == RNG_VAR:
+                scope.set(name, jax.device_put(value, replicated))
+                continue
+            arr = np.asarray(value) if not hasattr(value, "ndim") else value
+            try:
+                sharding = plan.state_sharding(name, arr.ndim,
+                                               shape=arr.shape)
+                scope.set(name, jax.device_put(value, sharding))
+            except Exception:  # noqa: BLE001 - plan misfit (e.g. an
+                # evaluator accumulator no rule covers): restore the raw
+                # host value; the executor re-places it at the next step
+                scope.set(name, value)
 
 
 def _step_of(payload_name: str) -> int:
@@ -257,6 +317,13 @@ def _step_info(dirname: str, payload_name: str) -> Optional[dict]:
             return json.load(f)
     except (FileNotFoundError, json.JSONDecodeError):
         return None
+
+
+def generation_info(dirname: str, step: int) -> Optional[dict]:
+    """Public view of one generation's per-step meta (md5, timestamp,
+    ``extra`` — including an elastic trainer's lineage manifest), or
+    None when the step has no sidecar."""
+    return _step_info(dirname, f"ckpt-{int(step)}.npz")
 
 
 def _candidates(dirname: str, meta: dict) -> List[str]:
@@ -307,7 +374,8 @@ def _restore_payload(dirname: str, payload_name: str, scope,
 
 
 def load_checkpoint(dirname: str, scope=None, verify: bool = True,
-                    strict: bool = False) -> dict:
+                    strict: bool = False, plan=None,
+                    accept=None) -> dict:
     """Restore the latest *intact* checkpoint into the scope; returns its
     meta dict. Raises FileNotFoundError if none exists.
 
@@ -320,7 +388,20 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True,
     reference's ErrCheckpointNotFound path). If NO intact checkpoint
     remains, the latest's original error is raised either way. A restore
     stages into a buffer first, so the scope is never left half-written.
-    """
+
+    ``plan`` (a :class:`paddle_tpu.parallel.ShardingPlan`) RESHARDS on
+    restore: staged values — full host values, whether they came from
+    the main payload or from stitching ``.shard{i}.npz`` sidecars
+    through their global index metadata — commit as device arrays
+    sharded by the plan's PartitionSpecs, so a checkpoint saved under
+    ``dp=8`` restores bitwise into a scope lowered under ``dp=4×mp=2``
+    or onto a smaller mesh (the elastic mesh-shape-change path).
+
+    ``accept`` (callable ``meta -> bool``) filters candidates by their
+    meta/lineage BEFORE any bytes are read: a generation the predicate
+    rejects (e.g. one whose lineage is inconsistent with the master's
+    queue state) is skipped exactly like a torn one, walking back to the
+    newest acceptable intact generation."""
     scope = scope or global_scope()
     meta_path = os.path.join(dirname, META_NAME)
     if not os.path.exists(meta_path):
@@ -331,6 +412,18 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True,
     for payload_name in _candidates(dirname, meta):
         is_latest = payload_name == meta["latest"]
         info = meta if is_latest else _step_info(dirname, payload_name)
+        if accept is not None:
+            cand = dict(info or {})
+            cand.setdefault("step", _step_of(payload_name))
+            cand.setdefault("extra", {})
+            if not accept(cand):
+                exc = ValueError(
+                    f"checkpoint {payload_name} rejected by accept "
+                    "predicate (lineage inconsistent)")
+                errors.append((payload_name, exc))
+                if strict:
+                    raise exc
+                continue
         stage = _Stage()
         try:
             _restore_payload(
@@ -343,7 +436,7 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True,
             if strict:
                 raise
             continue
-        stage.commit(scope)
+        stage.commit(scope, plan=plan)
         if is_latest:
             return meta
         out = dict(info or {})
@@ -354,7 +447,7 @@ def load_checkpoint(dirname: str, scope=None, verify: bool = True,
         out["fallback_from"] = meta["latest"]
         out["fallback_errors"] = [f"{n}: {e}" for n, e in errors]
         warnings.warn(
-            f"checkpoint {meta['latest']} in {dirname} is corrupt "
+            f"checkpoint {meta['latest']} in {dirname} is not usable "
             f"({errors[0][1]}); fell back to intact {payload_name} "
             f"(step {out['step']})", RuntimeWarning, stacklevel=2)
         return out
@@ -446,20 +539,29 @@ def load_manifest(dirname: str):
     return manifest_mod.try_load(dirname)
 
 
-def latest_step(dirname: str, verify: bool = True) -> Optional[int]:
+def latest_step(dirname: str, verify: bool = True,
+                accept=None) -> Optional[int]:
     """The step of the latest INTACT checkpoint, or None. A torn latest
     is skipped the same way ``load_checkpoint`` falls back; pass
-    ``verify=False`` for the raw meta value."""
+    ``verify=False`` for the raw meta value. ``accept`` applies the same
+    meta/lineage predicate ``load_checkpoint`` takes, so a Publisher can
+    watch for the newest generation *consistent with the queue state*."""
     try:
         with open(os.path.join(dirname, META_NAME)) as f:
             meta = json.load(f)
-        if not verify:
+        if not verify and accept is None:
             return meta["step"]
         for payload_name in _candidates(dirname, meta):
             is_latest = payload_name == meta["latest"]
             info = meta if is_latest else _step_info(dirname, payload_name)
-            if _looks_intact(dirname, payload_name,
-                             (info or {}).get("md5")):
+            if accept is not None:
+                cand = dict(info or {})
+                cand.setdefault("step", _step_of(payload_name))
+                cand.setdefault("extra", {})
+                if not accept(cand):
+                    continue
+            if not verify or _looks_intact(dirname, payload_name,
+                                           (info or {}).get("md5")):
                 return meta["step"] if is_latest else _step_of(payload_name)
         return None
     except (FileNotFoundError, KeyError, json.JSONDecodeError, ValueError):

@@ -23,6 +23,21 @@ Fault tolerance semantics match the reference: tasks time out and re-queue,
 K-strikes discard (service.go:313-366), finished passes recycle, snapshots
 go to a file with atomic replace and can be recovered after a master
 restart (service.go:166-230).
+
+Elastic multi-trainer training adds a *lease plane* on top of the task
+queue (the analogue of the reference's etcd-leased task ownership):
+trainers ``register_trainer(trainer_id)`` for a monotonically increasing
+**fencing token** and a lease they renew implicitly on every call (or
+explicitly via ``heartbeat``). A lease that expires — or a re-registration
+of the same trainer id (the preempted host's reincarnation) — *fences*
+the old token: the fenced trainer's claims are requeued at the FRONT of
+the queue (no failure strike — losing a lease is not the task's fault,
+and front placement keeps the effective task order stable for
+checkpoint-lineage-consistent resume), and every later report carrying
+the stale token is rejected and counted (``zombie_acks_rejected``) — a
+zombie that wakes up after a long GC pause can neither ack a task it no
+longer owns nor double-count a batch. Token monotonicity survives master
+restarts via a tokens sidecar next to the snapshot.
 """
 from __future__ import annotations
 
@@ -32,6 +47,7 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, Iterable, List, Optional, Sequence
 
 from ..native import load_library
@@ -40,11 +56,68 @@ PASS_DONE = -2
 NO_TASK = -1
 _DESC_BUF = 65536
 
+#: ``task_status`` engine codes -> names
+TASK_STATES = {0: "todo", 1: "pending", 2: "done", 3: "discarded"}
+
+
+class FencedTokenError(RuntimeError):
+    """The caller's fencing token is stale: its lease expired (or its
+    trainer id re-registered) and the master requeued its claims. The
+    trainer must re-register — a fresh token — and roll its state back
+    to the newest durable checkpoint generation before continuing.
+    Deliberately NOT retryable: retrying the same RPC with the same
+    token can never succeed."""
+
+
+def snapshot_durable(master: "Master", path: str) -> bool:
+    """Atomic + durable snapshot rotation: the engine writes ``path.new``
+    (itself tmp+rename), the file is fsync'd, the previous snapshot is
+    rotated to ``path.prev``, and ``path.new`` renames into place — so a
+    crash at ANY point leaves either the new or the previous snapshot
+    intact on disk, never only a torn file that ``recover()`` silently
+    drops."""
+    new = path + ".new"
+    if not master.snapshot(new):
+        return False
+    try:
+        with open(new, "rb") as f:
+            os.fsync(f.fileno())
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+        os.replace(new, path)
+        dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        return False
+    return True
+
+
+def recover_durable(master: "Master", path: str) -> Optional[str]:
+    """Recover from ``path``, walking back to ``path.prev`` when the
+    latest snapshot is missing/truncated/corrupt (the crash-mid-rotation
+    case). Returns the file that recovered, or None."""
+    for cand in (path, path + ".prev"):
+        if os.path.exists(cand) and master.recover(cand):
+            if cand != path:
+                from .. import profiler
+
+                profiler.global_stat.add_count(
+                    "master/snapshot_fallbacks", 1)
+            return cand
+    return None
+
 
 class Master:
-    """In-process task-queue engine (C++; thread-safe)."""
+    """In-process task-queue engine (C++; thread-safe) plus the Python
+    lease/fencing plane (monotonic trainer tokens, lease-expiry requeue,
+    zombie-report rejection) layered over it."""
 
-    def __init__(self, timeout_s: int = 60, max_failures: int = 3):
+    def __init__(self, timeout_s: int = 60, max_failures: int = 3,
+                 token_path: Optional[str] = None, now_fn=None):
         self._lib = load_library("master")
         if self._lib is None:
             raise RuntimeError("no C++ toolchain; cannot build master engine")
@@ -61,6 +134,11 @@ class Master:
             getattr(lib, f"ptmaster_{fn}").argtypes = [ctypes.c_void_p,
                                                        ctypes.c_int,
                                                        ctypes.c_int]
+        lib.ptmaster_requeue.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int]
+        lib.ptmaster_touch.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.ptmaster_task_status.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.ptmaster_pass.argtypes = [ctypes.c_void_p]
         lib.ptmaster_new_pass.argtypes = [ctypes.c_void_p]
         lib.ptmaster_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -68,6 +146,25 @@ class Master:
         lib.ptmaster_counts.argtypes = [ctypes.c_void_p] + [
             ctypes.POINTER(ctypes.c_int)] * 4
         self._h = lib.ptmaster_create(timeout_s, max_failures)
+        # ---- lease plane (Python-side; engine stays policy-free) ----
+        self._now = now_fn or time.monotonic
+        self._lease_lock = threading.Lock()
+        self._leases: dict = {}   # trainer_id -> {token, deadline, lease_s}
+        self._token_owner: dict = {}   # token -> trainer_id (ever issued)
+        self._fenced: set = set()      # tokens no longer valid
+        self._claims: dict = {}   # task_id -> (token, epoch, claim_seq)
+        self._claim_seq = 0
+        self._next_token = 1
+        self.lease_expired_total = 0
+        self.zombie_acks_rejected = 0
+        self.token_path = token_path
+        if token_path and os.path.exists(token_path):
+            try:
+                with open(token_path) as f:
+                    self._next_token = max(
+                        self._next_token, int(json.load(f)["next_token"]))
+            except (OSError, ValueError, KeyError):
+                pass  # corrupt sidecar: tokens restart (documented risk)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -86,10 +183,150 @@ class Master:
         arr = (ctypes.c_char_p * len(encoded))(*encoded)
         self._lib.ptmaster_set_dataset(self._h, arr, len(encoded))
 
-    def get_task(self):
+    # -- lease plane ----------------------------------------------------
+    def _persist_tokens_locked(self) -> None:
+        if not self.token_path:
+            return
+        tmp = self.token_path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"next_token": self._next_token}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.token_path)
+        except OSError:
+            pass  # best effort: in-memory monotonicity still holds
+
+    def _fence_locked(self, trainer_id: str, reason: str) -> None:
+        lease = self._leases.pop(trainer_id, None)
+        if lease is None:
+            return
+        token = lease["token"]
+        self._fenced.add(token)
+        if reason == "expired":
+            self.lease_expired_total += 1
+        from .. import profiler, trace
+
+        profiler.global_stat.add_count("master/lease_expired", 1)
+        t = time.perf_counter()
+        trace.record("master/lease_expired", t, t, trainer=trainer_id,
+                     token=token, reason=reason)
+        # requeue the fenced token's claims at the queue FRONT, earliest
+        # claim first (reverse-seq front pushes), with no failure strike
+        owned = sorted((c for c in self._claims.items()
+                        if c[1][0] == token),
+                       key=lambda c: c[1][2], reverse=True)
+        for task_id, (_, epoch, _seq) in owned:
+            self._lib.ptmaster_requeue(self._h, task_id, epoch, 1)
+            del self._claims[task_id]
+
+    def _check_leases_locked(self) -> None:
+        now = self._now()
+        for tid in [t for t, l in self._leases.items()
+                    if l["deadline"] <= now]:
+            self._fence_locked(tid, "expired")
+
+    def _renew_locked(self, token: int) -> str:
+        """Validate + renew the lease owning ``token``; raises
+        FencedTokenError on a stale/unknown token."""
+        trainer_id = self._token_owner.get(token)
+        lease = self._leases.get(trainer_id) if trainer_id else None
+        if lease is None or lease["token"] != token:
+            raise FencedTokenError(
+                f"fencing token {token} is stale (lease expired or "
+                f"trainer re-registered); re-register for a fresh token")
+        lease["deadline"] = self._now() + lease["lease_s"]
+        return trainer_id
+
+    def register_trainer(self, trainer_id: str,
+                         lease_s: float = 30.0) -> int:
+        """Grant ``trainer_id`` a lease and a fresh monotonic fencing
+        token. Re-registering a live trainer id fences its previous
+        token first (the preempted host's reincarnation must never race
+        its own zombie)."""
+        with self._lease_lock:
+            self._check_leases_locked()
+            if trainer_id in self._leases:
+                self._fence_locked(trainer_id, "re-registered")
+            token = self._next_token
+            self._next_token += 1
+            self._persist_tokens_locked()
+            self._leases[trainer_id] = {
+                "token": token, "lease_s": float(lease_s),
+                "deadline": self._now() + float(lease_s)}
+            self._token_owner[token] = trainer_id
+            from .. import profiler
+
+            profiler.global_stat.add_count("master/trainer_registered", 1)
+            return token
+
+    def heartbeat(self, token: int) -> bool:
+        """Renew ``token``'s lease and the engine deadlines of its
+        claims; False when the token is fenced (the caller must
+        re-register)."""
+        with self._lease_lock:
+            self._check_leases_locked()
+            try:
+                self._renew_locked(token)
+            except FencedTokenError:
+                return False
+            for task_id, (tok, epoch, _seq) in list(self._claims.items()):
+                if tok == token:
+                    self._lib.ptmaster_touch(self._h, task_id, epoch)
+            return True
+
+    def token_active(self, token: int) -> bool:
+        with self._lease_lock:
+            self._check_leases_locked()
+            trainer_id = self._token_owner.get(token)
+            lease = self._leases.get(trainer_id) if trainer_id else None
+            return lease is not None and lease["token"] == token
+
+    def expire_trainer(self, trainer_id: str) -> bool:
+        """Administratively revoke a trainer's lease NOW (operator evict;
+        also how chaos tests simulate a network partition outliving the
+        lease without wall-clock sleeps)."""
+        with self._lease_lock:
+            if trainer_id not in self._leases:
+                return False
+            self._fence_locked(trainer_id, "expired")
+            return True
+
+    def lease_state(self) -> dict:
+        """Operator view of the lease plane."""
+        with self._lease_lock:
+            self._check_leases_locked()
+            now = self._now()
+            return {
+                "trainers": {
+                    tid: {"token": l["token"],
+                          "expires_in_s": round(l["deadline"] - now, 3)}
+                    for tid, l in self._leases.items()},
+                "next_token": self._next_token,
+                "lease_expired_total": self.lease_expired_total,
+                "zombie_acks_rejected": self.zombie_acks_rejected,
+            }
+
+    def _reject_zombie(self, op: str, task_id: int, token: int) -> None:
+        self.zombie_acks_rejected += 1
+        from .. import profiler, trace
+
+        profiler.global_stat.add_count("master/zombie_acks_rejected", 1)
+        t = time.perf_counter()
+        trace.record("master/zombie_ack_rejected", t, t, op=op,
+                     task_id=task_id, token=token)
+
+    # -- task queue (token-aware) --------------------------------------
+    def get_task(self, token: Optional[int] = None):
         """-> (task_id, desc, epoch) | NO_TASK | PASS_DONE. The epoch must
         be echoed back to task_finished/task_failed — stale reports from a
-        timed-out claim are rejected."""
+        timed-out claim are rejected. With ``token`` the claim is
+        lease-owned: expiry requeues it (front) and fences later reports;
+        a stale token raises :class:`FencedTokenError`."""
+        if token is not None:
+            with self._lease_lock:
+                self._check_leases_locked()
+                self._renew_locked(token)
         buf = ctypes.create_string_buffer(_DESC_BUF)
         epoch = ctypes.c_int()
         tid = self._lib.ptmaster_get_task(self._h, buf, _DESC_BUF,
@@ -98,14 +335,55 @@ class Master:
             raise ValueError(f"task desc exceeds {_DESC_BUF} bytes")
         if tid < 0:
             return tid
+        if token is not None:
+            with self._lease_lock:
+                self._claim_seq += 1
+                self._claims[tid] = (token, epoch.value, self._claim_seq)
         return tid, buf.value.decode(), epoch.value
 
-    def task_finished(self, task_id: int, epoch: int) -> bool:
-        return self._lib.ptmaster_task_finished(self._h, task_id,
-                                                epoch) == 0
+    def _report(self, op: str, engine_fn, task_id: int, epoch: int,
+                token: Optional[int]) -> bool:
+        """Shared fencing guard + engine call for task_finished/
+        task_failed. The tokened path holds the lease lock across check
+        AND engine call, so a fence can never interleave between the
+        two (lock order is always lease lock -> engine mutex)."""
+        if token is None:
+            return engine_fn(self._h, task_id, epoch) == 0
+        with self._lease_lock:
+            self._check_leases_locked()
+            try:
+                self._renew_locked(token)
+            except FencedTokenError:
+                self._reject_zombie(op, task_id, token)
+                return False
+            claim = self._claims.get(task_id)
+            if claim is not None and claim[0] != token:
+                # the task was requeued and is now owned by a NEWER
+                # claim: this caller's lease is alive but its claim is
+                # gone — a zombie report all the same
+                self._reject_zombie(op, task_id, token)
+                return False
+            ok = engine_fn(self._h, task_id, epoch) == 0
+            if ok:
+                self._claims.pop(task_id, None)
+            return ok
 
-    def task_failed(self, task_id: int, epoch: int) -> bool:
-        return self._lib.ptmaster_task_failed(self._h, task_id, epoch) == 0
+    def task_finished(self, task_id: int, epoch: int,
+                      token: Optional[int] = None) -> bool:
+        return self._report("task_finished",
+                            self._lib.ptmaster_task_finished,
+                            task_id, epoch, token)
+
+    def task_failed(self, task_id: int, epoch: int,
+                    token: Optional[int] = None) -> bool:
+        return self._report("task_failed", self._lib.ptmaster_task_failed,
+                            task_id, epoch, token)
+
+    def task_status(self, task_id: int) -> Optional[str]:
+        """'todo' | 'pending' | 'done' | 'discarded' | None — the
+        queue-state probe lineage-consistency checks use."""
+        return TASK_STATES.get(
+            self._lib.ptmaster_task_status(self._h, task_id))
 
     def new_pass(self) -> int:
         """Recycle done tasks for the next epoch; -1 while tasks pending."""
@@ -124,10 +402,40 @@ class Master:
         return self._lib.ptmaster_pass(self._h)
 
     def counts(self):
+        with self._lease_lock:
+            self._check_leases_locked()
+            trainers_active = len(self._leases)
+            lease_expired = self.lease_expired_total
+            zombies = self.zombie_acks_rejected
         vals = [ctypes.c_int() for _ in range(4)]
         self._lib.ptmaster_counts(self._h, *[ctypes.byref(v) for v in vals])
         return {"todo": vals[0].value, "pending": vals[1].value,
-                "done": vals[2].value, "discarded": vals[3].value}
+                "done": vals[2].value, "discarded": vals[3].value,
+                "trainers_active": trainers_active,
+                "lease_expired_total": lease_expired,
+                "zombie_acks_rejected": zombies}
+
+    def prometheus_text(self) -> str:
+        """The master's queue + lease plane as Prometheus gauges (served
+        by ``MasterServer`` op ``metrics``; scrape-ready text)."""
+        c = self.counts()
+        names = {
+            "master_tasks_todo": c["todo"],
+            "master_tasks_pending": c["pending"],
+            "master_tasks_done": c["done"],
+            "master_tasks_discarded": c["discarded"],
+            "master_pass": self.pass_id,
+            "master_trainers_active": c["trainers_active"],
+            "master_lease_expired_total": c["lease_expired_total"],
+            "master_zombie_acks_rejected": c["zombie_acks_rejected"],
+        }
+        lines = []
+        for name, value in names.items():
+            kind = "counter" if name.endswith(("_total", "_rejected")) \
+                else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------
@@ -154,25 +462,26 @@ class _Handler(socketserver.StreamRequestHandler):
             try:
                 req = json.loads(line)
                 op = req["op"]
+                token = req.get("token")
                 mutated = False
                 if op == "set_dataset":
                     master.set_dataset(req["tasks"])
                     resp = {"ok": True}
                     mutated = True
                 elif op == "get_task":
-                    r = master.get_task()
+                    r = master.get_task(token=token)
                     if isinstance(r, tuple):
                         resp = {"ok": True, "task_id": r[0], "desc": r[1],
                                 "epoch": r[2]}
                     else:
                         resp = {"ok": True, "task_id": r}
                 elif op == "task_finished":
-                    resp = {"ok": master.task_finished(req["task_id"],
-                                                       req.get("epoch", 0))}
+                    resp = {"ok": master.task_finished(
+                        req["task_id"], req.get("epoch", 0), token=token)}
                     mutated = True
                 elif op == "task_failed":
-                    resp = {"ok": master.task_failed(req["task_id"],
-                                                     req.get("epoch", 0))}
+                    resp = {"ok": master.task_failed(
+                        req["task_id"], req.get("epoch", 0), token=token)}
                     mutated = True
                 elif op == "new_pass":
                     resp = {"ok": True, "pass": master.new_pass()}
@@ -180,8 +489,31 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif op == "counts":
                     resp = {"ok": True, **master.counts(),
                             "pass": master.pass_id}
+                elif op == "register_trainer":
+                    resp = {"ok": True, "token": master.register_trainer(
+                        req["trainer_id"],
+                        lease_s=float(req.get("lease_s") or 30.0))}
+                    mutated = True
+                elif op == "heartbeat":
+                    resp = {"ok": True, "alive": master.heartbeat(token)}
+                elif op == "expire_trainer":
+                    resp = {"ok": True, "expired": master.expire_trainer(
+                        req["trainer_id"])}
+                    mutated = True
+                elif op == "lease_state":
+                    resp = {"ok": True, "leases": master.lease_state()}
+                elif op == "task_status":
+                    resp = {"ok": True,
+                            "status": master.task_status(req["task_id"])}
+                elif op == "metrics":
+                    resp = {"ok": True, "text": master.prometheus_text()}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
+            except FencedTokenError as e:
+                # typed for the client: NOT retryable, the trainer must
+                # re-register and roll back
+                resp = {"ok": False, "fenced": True, "error": str(e)}
+                mutated = False
             except Exception as e:  # noqa: BLE001 — service must not die
                 resp = {"ok": False, "error": str(e)}
                 mutated = False
@@ -193,14 +525,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 # (stop() flushes a final snapshot for graceful shutdown.)
                 srv = self.server
                 with srv.snapshot_lock:
-                    if op in ("set_dataset", "new_pass"):
-                        master.snapshot(snapshot_path)
+                    if op in ("set_dataset", "new_pass",
+                              "register_trainer", "expire_trainer"):
+                        snapshot_durable(master, snapshot_path)
                         srv.mutations_since_snapshot = 0
                     else:
                         srv.mutations_since_snapshot += 1
                         if (srv.mutations_since_snapshot
                                 >= srv.snapshot_every):
-                            master.snapshot(snapshot_path)
+                            snapshot_durable(master, snapshot_path)
                             srv.mutations_since_snapshot = 0
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
@@ -220,9 +553,16 @@ class MasterServer:
     def __init__(self, timeout_s=60, max_failures=3, host="127.0.0.1",
                  port=0, snapshot_path: Optional[str] = None,
                  snapshot_every: int = 32):
-        self.master = Master(timeout_s, max_failures)
-        if snapshot_path and os.path.exists(snapshot_path):
-            self.master.recover(snapshot_path)  # master fault tolerance
+        # the tokens sidecar keeps fencing monotonic across master
+        # restarts: a zombie from before the restart must still rank
+        # below every token the reborn master grants
+        self.master = Master(
+            timeout_s, max_failures,
+            token_path=snapshot_path + ".tokens" if snapshot_path else None)
+        if snapshot_path:
+            # recover the latest intact snapshot, walking back to .prev
+            # when the latest is truncated/corrupt (crash mid-rotation)
+            recover_durable(self.master, snapshot_path)
         self._srv = _ReusableTCPServer((host, port), _Handler)
         self._srv.daemon_threads = True
         self._srv.master = self.master  # type: ignore[attr-defined]
@@ -266,7 +606,7 @@ class MasterServer:
             # daemon handler threads may still be mid-request: take the same
             # lock they use so the final flush cannot interleave with theirs
             with self._srv.snapshot_lock:  # type: ignore[attr-defined]
-                self.master.snapshot(self._snapshot_path)
+                snapshot_durable(self.master, self._snapshot_path)
 
     def __enter__(self):
         return self.start()
@@ -300,6 +640,9 @@ class MasterClient:
         self._sock = None
         self._f = None
         self._ncalls = 0
+        self.token: Optional[int] = None       # set by register()
+        self.trainer_id: Optional[str] = None
+        self.lease_s: Optional[float] = None
         if self._retry is not None:
             self._retry.call(self._connect)
         else:
@@ -330,6 +673,17 @@ class MasterClient:
             # call) reconnects
             self._teardown()
             raise ConnectionError("master connection dropped (injected)")
+        if plan is not None and self.trainer_id is not None \
+                and plan.fire("master_partition", call_idx) is not None:
+            # injected partition outliving the lease: the master fences
+            # us while we are "away" (simulated via an admin expire on a
+            # side connection), then this attempt dies like a network
+            # drop — the reconnecting client's next tokened call finds
+            # its token stale and raises FencedTokenError
+            self._expire_self()
+            self._teardown()
+            raise ConnectionError(
+                "master partition (injected): lease expired while away")
         if self._sock is None:
             self._connect()
         try:
@@ -348,11 +702,33 @@ class MasterClient:
             self._teardown()
             raise ConnectionError(
                 f"torn response from master: {exc}") from exc
+        if resp.get("fenced"):
+            # typed so callers can rejoin (re-register + roll back to the
+            # newest durable generation) instead of dying on RuntimeError
+            raise FencedTokenError(resp.get("error",
+                                            "fencing token is stale"))
         if not resp.get("ok", False) and "error" in resp:
             # an application-level error is NOT retryable: the request
             # reached the engine and was rejected
             raise RuntimeError(f"master error: {resp['error']}")
         return resp
+
+    def _expire_self(self):
+        """Fault-injection helper: expire our own lease server-side over
+        a throwaway connection (the master-side effect of a partition
+        that outlives the lease)."""
+        if self.trainer_id is None:
+            return
+        try:
+            with socket.create_connection(self.addr, timeout=5.0) as s:
+                f = s.makefile("rwb")
+                f.write((json.dumps({"op": "expire_trainer",
+                                     "trainer_id": self.trainer_id})
+                         + "\n").encode())
+                f.flush()
+                f.readline()
+        except OSError:
+            pass
 
     def _call(self, **req):
         self._ncalls += 1
@@ -364,8 +740,49 @@ class MasterClient:
     def set_dataset(self, tasks: Sequence[str]):
         self._call(op="set_dataset", tasks=list(tasks))
 
+    # -- lease plane ----------------------------------------------------
+    def register(self, trainer_id: str,
+                 lease_s: Optional[float] = None) -> int:
+        """Register for a lease + fencing token; every subsequent
+        ``get_task``/``task_finished``/``task_failed`` carries the token
+        automatically. Re-registering (or :meth:`rejoin`) fences the
+        previous token server-side."""
+        self.trainer_id = trainer_id
+        self.lease_s = lease_s
+        self.token = int(self._call(op="register_trainer",
+                                    trainer_id=trainer_id,
+                                    lease_s=lease_s)["token"])
+        return self.token
+
+    def rejoin(self) -> int:
+        """Fresh token for the same trainer id — the preempted host's
+        reincarnation path. The caller must roll its training state back
+        to the newest durable checkpoint generation first."""
+        if self.trainer_id is None:
+            raise RuntimeError("rejoin() requires a prior register()")
+        return self.register(self.trainer_id, lease_s=self.lease_s)
+
+    def heartbeat(self) -> bool:
+        """Renew the lease (and the engine deadlines of our claims);
+        False when our token is fenced — the rejoin signal."""
+        if self.token is None:
+            return True
+        return bool(self._call(op="heartbeat",
+                               token=self.token)["alive"])
+
+    def task_status(self, task_id: int) -> Optional[str]:
+        return self._call(op="task_status", task_id=task_id)["status"]
+
+    def lease_state(self) -> dict:
+        return self._call(op="lease_state")["leases"]
+
+    def metrics_text(self) -> str:
+        """The master's Prometheus gauge text (queue + lease plane)."""
+        return self._call(op="metrics")["text"]
+
+    # -- task queue -----------------------------------------------------
     def get_task(self):
-        resp = self._call(op="get_task")
+        resp = self._call(op="get_task", token=self.token)
         tid = resp["task_id"]
         if tid < 0:
             return tid
@@ -373,11 +790,11 @@ class MasterClient:
 
     def task_finished(self, task_id: int, epoch: int = 0) -> bool:
         return bool(self._call(op="task_finished", task_id=task_id,
-                               epoch=epoch)["ok"])
+                               epoch=epoch, token=self.token)["ok"])
 
     def task_failed(self, task_id: int, epoch: int = 0) -> bool:
         return bool(self._call(op="task_failed", task_id=task_id,
-                               epoch=epoch)["ok"])
+                               epoch=epoch, token=self.token)["ok"])
 
     def new_pass(self) -> int:
         return self._call(op="new_pass")["pass"]

@@ -144,6 +144,50 @@ struct Master {
     return 0;
   }
 
+  // Lease-preemption requeue: put a pending claim back in todo WITHOUT a
+  // failure strike (losing a lease is not the task's fault). front=1
+  // pushes to the queue head so a rejoining trainer re-trains it before
+  // streaming on — keeping the effective task order stable for
+  // checkpoint-lineage-consistent resume.
+  int requeue(int id, int epoch, int front) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return -1;
+    if (it->second.epoch != epoch) return -1;
+    Task t = it->second;
+    pending.erase(it);
+    t.deadline = 0;
+    if (front) todo.push_front(std::move(t));
+    else todo.push_back(std::move(t));
+    return 0;
+  }
+
+  // Deadline renewal for a live claim (the lease plane's heartbeat
+  // extends claims so a long task under a healthy lease never hits the
+  // per-task timeout requeue).
+  int touch(int id, int epoch) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return -1;
+    if (it->second.epoch != epoch) return -1;
+    it->second.deadline = now_s() + timeout_s;
+    return 0;
+  }
+
+  // 0 todo / 1 pending / 2 done / 3 discarded / -1 unknown — the
+  // queue-state probe checkpoint-lineage consistency checks run.
+  int task_status(int id) {
+    std::lock_guard<std::mutex> g(mu);
+    for (const auto &t : todo)
+      if (t.id == id) return 0;
+    if (pending.count(id)) return 1;
+    for (const auto &t : done)
+      if (t.id == id) return 2;
+    for (const auto &t : discarded)
+      if (t.id == id) return 3;
+    return -1;
+  }
+
   void start_new_pass_locked() {
     // all tasks done -> recycle into todo for the next pass
     pass += 1;
@@ -262,6 +306,15 @@ int ptmaster_task_finished(void *m, int id, int epoch) {
 }
 int ptmaster_task_failed(void *m, int id, int epoch) {
   return static_cast<Master *>(m)->task_failed(id, epoch);
+}
+int ptmaster_requeue(void *m, int id, int epoch, int front) {
+  return static_cast<Master *>(m)->requeue(id, epoch, front);
+}
+int ptmaster_touch(void *m, int id, int epoch) {
+  return static_cast<Master *>(m)->touch(id, epoch);
+}
+int ptmaster_task_status(void *m, int id) {
+  return static_cast<Master *>(m)->task_status(id);
 }
 int ptmaster_snapshot(void *m, const char *path) {
   return static_cast<Master *>(m)->snapshot(path);

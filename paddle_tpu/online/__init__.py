@@ -11,10 +11,17 @@ freshness SLO on the PR 12 observability plane.
 - :class:`StreamingTrainer` — endless-pass training off a master task
   queue; preemption-safe (graceful stop at task boundaries, checkpoint
   resume, deterministic task replay) so a preempted trainer rejoins the
-  stream without losing or double-counting tasks.
+  stream without losing or double-counting tasks. **Elastic mode**
+  (``trainer_id=``): N trainers share one queue under the master's
+  lease/fencing plane — acks defer until a durable checkpoint generation
+  covers them (each generation carries a lineage manifest), zombies are
+  fenced out by token, and a preempted trainer rejoins with a fresh
+  token by rolling back to the newest durable generation.
 - :class:`Publisher` — watches the trainer's checkpoint directory and
   drives rolling ``Fleet.update_weights`` swaps; exports weight-version
-  and staleness gauges and the ``freshness`` SLO objective.
+  and staleness gauges and the ``freshness`` SLO objective; pins the
+  served generation against retention GC and skips (with a counter) a
+  generation GC'd between discovery and load.
 """
 from .publisher import Publisher
 from .trainer import StreamingTrainer

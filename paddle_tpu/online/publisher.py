@@ -59,18 +59,32 @@ class Publisher:
     verify:      forward to ``update_weights`` (warm-manifest verify).
     min_interval_s: publish rate limit — generations landing faster
                  than this coalesce (the newest wins).
+    accept:      optional meta predicate (``checkpoint.load_checkpoint``
+                 ``accept=`` semantics): only generations it passes are
+                 publishable — e.g. reject a lineage whose writer token
+                 the master fenced, so a zombie's generation never
+                 reaches the serving fleet.
+    pin:         pin the published generation against the trainer's
+                 retention GC (``checkpoint.pin_generation``) so the
+                 weights production is serving survive ``keep_last_n``
+                 pruning — a replica restart can always re-load them
+                 (default True).
     """
 
     def __init__(self, fleet, dirname: str, poll_s: float = 0.25,
-                 verify: bool = True, min_interval_s: float = 0.0):
+                 verify: bool = True, min_interval_s: float = 0.0,
+                 accept=None, pin: bool = True):
         self.fleet = fleet
         self.dirname = str(dirname)
         self.poll_s = float(poll_s)
         self.verify = bool(verify)
         self.min_interval_s = float(min_interval_s)
+        self.accept = accept
+        self.pin = bool(pin)
         self.published_step: Optional[int] = None
         self.published_ckpt_time: Optional[float] = None
         self.generations = 0          # successful publishes
+        self.skipped = 0              # discovered-then-GC'd races skipped
         self.last_publish_s: Optional[float] = None  # roll wall time
         self.last_error: Optional[str] = None
         self._published_at: Optional[float] = None   # monotonic-ish
@@ -93,7 +107,7 @@ class Publisher:
             return None
 
     def latest_step(self) -> Optional[int]:
-        return ckpt_mod.latest_step(self.dirname)
+        return ckpt_mod.latest_step(self.dirname, accept=self.accept)
 
     def staleness_s(self) -> float:
         """Seconds the SERVED weights are behind the trainer's newest
@@ -118,7 +132,8 @@ class Publisher:
         from ..core.scope import Scope
 
         staging = Scope()
-        meta = ckpt_mod.load_checkpoint(self.dirname, scope=staging)
+        meta = ckpt_mod.load_checkpoint(self.dirname, scope=staging,
+                                        accept=self.accept)
         return _PinnedGeneration(
             {k: staging.get(k) for k in staging.keys()},
             self.dirname, int(meta.get("step", step)))
@@ -146,6 +161,17 @@ class Publisher:
                                 dirname=self.dirname):
                     self.fleet.update_weights(source, verify=self.verify)
             except Exception as exc:  # noqa: BLE001 - keep serving old
+                payload = os.path.join(self.dirname, f"ckpt-{latest}.npz")
+                if isinstance(exc, FileNotFoundError) \
+                        or not os.path.exists(payload):
+                    # discovered-then-GC'd race: the trainer's retention
+                    # pruned this generation between our latest_step()
+                    # and the load — not an error, the NEXT poll sees a
+                    # newer one. Skip with a counter; keep serving old.
+                    self.skipped += 1
+                    self.fleet.metrics.inc("weight_publish_skipped")
+                    self.refresh_gauges()
+                    return None
                 self.last_error = f"{type(exc).__name__}: {exc}"
                 self.fleet.metrics.inc("weight_publish_errors")
                 self.refresh_gauges()
@@ -157,6 +183,13 @@ class Publisher:
             self.generations += 1
             self.last_error = None
             self.fleet.metrics.inc("weight_generations")
+            if self.pin:
+                # the serving fleet is live on this generation: retention
+                # GC must never delete it, however old it grows
+                try:
+                    ckpt_mod.pin_generation(self.dirname, step)
+                except OSError:
+                    pass
             self.refresh_gauges()
             return step
 
@@ -177,6 +210,7 @@ class Publisher:
             "latest_step": self.latest_step(),
             "staleness_s": round(self.staleness_s(), 6),
             "generations": self.generations,
+            "skipped": self.skipped,
             "last_publish_s": self.last_publish_s,
             "last_error": self.last_error,
             "watching": self._thread is not None,

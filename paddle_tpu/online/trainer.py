@@ -22,18 +22,47 @@ Preemption contract (pinned by tests/test_online.py):
   checkpoint and replays re-served tasks — at-least-once, exactly the
   reference's semantics.
 
+**Elastic mode** (``trainer_id=`` given; pinned by tests/test_elastic.py)
+turns at-least-once into exactly-once-effective for N trainers sharing
+one master queue, under crash + rejoin + zombie chaos:
+
+- the trainer registers for a lease + monotonic **fencing token**; every
+  queue op carries the token, so a zombie (lease expired while it was
+  partitioned/paused) can neither ack a task it no longer owns nor — via
+  the ``pre_save_fn`` heartbeat veto — publish a checkpoint generation.
+- acks are **deferred until the covering generation is durable**: a
+  finished task waits in a local pending list, every checkpoint save
+  stamps a *lineage manifest* into the generation's meta (writer token,
+  master pass, acked horizon, covered-but-unacked task ids), and the
+  post-write hook flushes the acks. The ack horizon therefore never runs
+  ahead of durable state: a crash after the save but before the ack
+  re-serves a task whose updates are already in the checkpoint — which
+  the successor detects from the lineage and **skip-acks without
+  retraining** (exactly-once effective).
+- a fenced trainer (``FencedTokenError``) **rejoins**: fresh token, roll
+  the scope back to the newest durable generation (discarding only
+  unacked updates — the master requeued those tasks at the queue FRONT,
+  so the effective task order is stable), rebuild the covered set from
+  the generation's lineage, continue streaming. ``rejoin=False`` exits
+  instead (the relay case: a different host takes over).
+
 The checkpoint cadence (``CheckpointConfig.every_n_steps``) is the
 weight-generation cadence: every periodic save is a publishable
 generation the :class:`~paddle_tpu.online.Publisher` can roll into a
-serving fleet.
+serving fleet. Align it with the task size (``records_per_shard /
+batch_size``) and every generation lands at a task boundary — the
+configuration under which the crash/rejoin chaos matrix is bitwise.
 """
 from __future__ import annotations
 
 import time
 from typing import Callable, Optional, Sequence
 
+from .. import checkpoint as ckpt_mod
 from .. import event as evt
-from ..master import NO_TASK, PASS_DONE, MasterClient
+from ..master import NO_TASK, PASS_DONE, FencedTokenError, MasterClient
+from ..resilience import faults
+from ..resilience.faults import SimulatedCrash
 from ..resilience.signals import ShutdownFlag, graceful_shutdown
 
 
@@ -58,6 +87,20 @@ class StreamingTrainer:
                       Signal handling moves HERE (task-boundary stop),
                       so the config's ``install_signal_handlers`` is
                       forced off.
+    trainer_id:       enables ELASTIC mode: register with the master's
+                      lease plane under this id (the "host name" — a
+                      preempted host rejoins by re-registering the same
+                      id), carry the fencing token on every queue op,
+                      defer acks until the covering generation is
+                      durable, and stamp lineage manifests onto every
+                      generation. Requires ``checkpoint``; forces
+                      ``checkpoint.background = False`` (the ack flush
+                      must follow the write on the trainer thread).
+    lease_s:          lease duration for elastic mode (default 30 s).
+    rejoin:           elastic mode: on fencing, re-register + roll back
+                      to the newest durable generation and continue
+                      (True, default) or stop the run (False — a
+                      different host takes over).
     max_steps / max_passes: bound the run (None = endless; ``stop()``
                       or a signal ends it).
     """
@@ -67,18 +110,36 @@ class StreamingTrainer:
                  batch_size: int = 64, checkpoint=None,
                  max_steps: Optional[int] = None,
                  max_passes: Optional[int] = None,
-                 client_retry=None, install_signal_handlers: bool = True):
+                 client_retry=None, install_signal_handlers: bool = True,
+                 trainer_id: Optional[str] = None,
+                 lease_s: float = 30.0, rejoin: bool = True):
         self.sgd = sgd
         self.master_addr = tuple(master_addr)
         self.make_task_reader = make_task_reader
         self.task_descs = list(task_descs) if task_descs else None
         self.batch_size = int(batch_size)
         self.checkpoint = checkpoint
+        self.trainer_id = trainer_id
+        self.lease_s = float(lease_s)
+        self._rejoin = bool(rejoin)
+        self._elastic = trainer_id is not None
+        if self._elastic and checkpoint is None:
+            raise ValueError(
+                "elastic mode (trainer_id=...) requires a checkpoint "
+                "config: deferred acks are only safe against durable "
+                "generations")
         if checkpoint is not None:
             # the trainer owns signal handling (task-boundary stop);
             # SGD's own handler would stop mid-task and break the
             # no-double-count contract
             checkpoint.install_signal_handlers = False
+        if self._elastic:
+            # ack-after-durable needs the write (and the ack flush that
+            # follows it) on the trainer thread, in program order
+            checkpoint.background = False
+            checkpoint.extra_fn = self._lineage
+            checkpoint.pre_save_fn = self._pre_save
+            checkpoint.on_saved = self._flush_acks
         self.max_steps = max_steps
         self.max_passes = max_passes
         self._client_retry = client_retry
@@ -87,8 +148,21 @@ class StreamingTrainer:
         self.steps = 0
         self.passes = 0
         self.tasks_finished = 0
+        self.tasks_skip_acked = 0   # covered-by-lineage, acked not retrained
+        self.rejoins = 0
+        self.lease_lost = 0
+        self.zombie_acks = 0        # our own acks the master fenced out
         self.last_cost: Optional[float] = None
+        self.token: Optional[int] = None
         self._started_at: Optional[float] = None
+        self._client: Optional[MasterClient] = None
+        self._master_pass = 0
+        self._covered: dict = {}        # task_id -> master pass (skip-ack)
+        self._finished_pending: list = []   # (tid, epoch): trained, undurable
+        self._finishing = None              # (tid, epoch) mid final batch
+        self._acked_early: set = set()      # acked by the flush pre-resume
+        self._generations = 0               # saves that landed this run
+        self._fenced_latch = False
 
     # -- control --------------------------------------------------------
     def stop(self, reason: str = "stop() called") -> None:
@@ -107,6 +181,12 @@ class StreamingTrainer:
                "last_cost": self.last_cost,
                "uptime_s": (time.monotonic() - self._started_at
                             if self._started_at else 0.0)}
+        if self._elastic:
+            out.update({"trainer_id": self.trainer_id, "token": self.token,
+                        "rejoins": self.rejoins,
+                        "lease_lost": self.lease_lost,
+                        "zombie_acks": self.zombie_acks,
+                        "tasks_skip_acked": self.tasks_skip_acked})
         try:
             client = MasterClient(self.master_addr,
                                   retry=self._client_retry)
@@ -115,6 +195,147 @@ class StreamingTrainer:
         except Exception:  # noqa: BLE001 - state() must not die
             out["queue"] = None
         return out
+
+    # -- elastic plumbing ----------------------------------------------
+    def _lineage(self) -> dict:
+        """The checkpoint-lineage manifest stamped into every
+        generation's ``extra``: who wrote it (fencing token), at which
+        master pass, how far the ack horizon reached, and which trained
+        tasks the generation covers WITHOUT a master ack yet — the set a
+        resuming successor must skip-ack instead of retraining."""
+        if not self._elastic:
+            return {}
+        covered = [tid for tid, _ in self._finished_pending]
+        if self._finishing is not None:
+            covered.append(self._finishing[0])
+        return {"lineage": {
+            "writer_token": self.token,
+            "trainer_id": self.trainer_id,
+            "master_pass": self._master_pass,
+            "acked_tasks": self.tasks_finished,
+            "covered_unacked": covered,
+        }}
+
+    def _pre_save(self) -> bool:
+        """Fencing veto: a zombie must not publish a generation. A
+        transport failure reaching the master does NOT veto — fencing
+        hygiene must not block checkpointing through a master restart."""
+        if not self._elastic or self._client is None:
+            return True
+        try:
+            alive = self._client.heartbeat()
+        except FencedTokenError:
+            alive = False
+        except Exception:  # noqa: BLE001 - can't tell; save anyway
+            return True
+        if not alive:
+            self._fenced_latch = True
+        return alive
+
+    def _flush_acks(self, step: int, extra: dict) -> None:
+        """Post-write hook: the generation at ``step`` is durable, so
+        every task it covers may now ack. A rejected ack either means we
+        are fenced (latch the rejoin) or the claim timed out server-side
+        — then the task is covered by this very generation, and the
+        re-serve will be skip-acked."""
+        if not self._elastic or self._client is None:
+            return
+        self._generations += 1
+        plan = faults.active_plan()
+        if plan is not None and plan.fire("zombie_ack",
+                                          self._generations) is not None:
+            # injected partition outliving the lease, right before the
+            # flush: the acks below must bounce off the fencing check
+            self._client._expire_self()
+        pending = list(self._finished_pending)
+        if self._finishing is not None:
+            pending.append(self._finishing)
+        acked = set()
+        for tid, epoch in pending:
+            try:
+                ok = self._client.task_finished(tid, epoch)
+            except FencedTokenError:
+                ok = False
+            if ok:
+                acked.add(tid)
+                self.tasks_finished += 1
+                if self._finishing is not None \
+                        and tid == self._finishing[0]:
+                    self._acked_early.add(tid)
+                continue
+            alive = False
+            try:
+                alive = self._client.heartbeat()
+            except Exception:  # noqa: BLE001 - fenced or unreachable
+                alive = False
+            if not alive:
+                self.zombie_acks += 1
+                self._fenced_latch = True
+                break
+            # lease alive, claim gone (per-task timeout requeued it):
+            # durable in THIS generation -> skip-ack on re-serve
+            self._covered[tid] = self._master_pass
+        self._finished_pending = [
+            p for p in self._finished_pending if p[0] not in acked]
+
+    def _load_covered(self, client: MasterClient) -> None:
+        """Rebuild the skip-ack set from the newest durable generation's
+        lineage: tasks it covers that the master will re-serve (todo or
+        pending at the SAME master pass) ack without retraining."""
+        self._covered = {}
+        dirname = getattr(self.checkpoint, "dirname", None)
+        if not dirname:
+            return
+        step = ckpt_mod.latest_step(dirname)
+        if step is None:
+            return
+        info = ckpt_mod.generation_info(dirname, step) or {}
+        lineage = (info.get("extra") or {}).get("lineage") or {}
+        if lineage.get("master_pass") != self._master_pass:
+            return  # the pass advanced: everything covered completed
+        for tid in lineage.get("covered_unacked", ()):
+            if client.task_status(int(tid)) in ("todo", "pending"):
+                self._covered[int(tid)] = self._master_pass
+
+    def _skip_if_covered(self, client: MasterClient, tid: int,
+                         epoch: int) -> bool:
+        if self._covered.get(tid) != self._master_pass:
+            return False
+        del self._covered[tid]
+        if client.task_finished(tid, epoch):
+            self.tasks_finished += 1
+            self.tasks_skip_acked += 1
+        return True
+
+    def _handle_fenced(self, client: MasterClient) -> bool:
+        """Our token went stale (lease expired / host re-registered).
+        Either rejoin — fresh token, scope rolled back to the newest
+        durable generation, covered set rebuilt — or end the run for a
+        successor host. Returns True when streaming may continue."""
+        from .. import profiler, trace
+
+        self._fenced_latch = False
+        self.lease_lost += 1
+        profiler.global_stat.add_count("trainer/lease_lost", 1)
+        if not self._rejoin:
+            self.stop("fencing token lost (rejoin disabled)")
+            return False
+        with trace.span("trainer/rejoin", trainer_id=self.trainer_id):
+            self.token = client.rejoin()
+            dirname = getattr(self.checkpoint, "dirname", None)
+            if dirname and ckpt_mod.latest_step(dirname) is not None:
+                # discard unacked updates: the master requeued their
+                # tasks (front), so we retrain them from durable state
+                ckpt_mod.load_checkpoint(dirname, scope=self.sgd.scope,
+                                         plan=self.sgd.exe.plan)
+            self._finished_pending = []
+            self._finishing = None
+            self._acked_early = set()
+            self._master_pass = int(client.counts().get("pass", 0))
+            self._load_covered(client)
+        self.rejoins += 1
+        profiler.global_stat.add_count("trainer/rejoins", 1)
+        return True
 
     # -- the stream -----------------------------------------------------
     def _maybe_seed(self, client: MasterClient) -> None:
@@ -134,56 +355,136 @@ class StreamingTrainer:
             return False
         return True
 
+    def _task_batches(self, desc: str, tid: int, epoch: int):
+        """One task's records as training batches, with one-batch
+        lookahead: ``_finishing`` is set just before the FINAL batch is
+        yielded, so a checkpoint save firing while the step loop trains
+        that batch knows the task is fully covered by the generation."""
+        prev = None
+        rows = []
+        for rec in self.make_task_reader(desc):
+            rows.append(rec)
+            if len(rows) == self.batch_size:
+                if prev is not None:
+                    yield prev
+                    self.steps += 1
+                prev, rows = rows, []
+        if rows:  # trailing partial batch still trains
+            if prev is not None:
+                yield prev
+                self.steps += 1
+            prev = rows
+        if prev is not None:
+            if self._elastic:
+                self._finishing = (tid, epoch)
+            yield prev
+            self.steps += 1
+
+    def _note_task_trained(self, client: MasterClient, tid: int,
+                           epoch: int) -> None:
+        if not self._elastic:
+            client.task_finished(tid, epoch)
+            self.tasks_finished += 1
+            return
+        self._finishing = None
+        if tid in self._acked_early:
+            # the generation covering this task's final batch already
+            # landed AND its flush acked it
+            self._acked_early.discard(tid)
+            return
+        self._finished_pending.append((tid, epoch))
+
     def _stream_reader(self):
         """The endless batched reader ``SGD.train`` consumes: one
         "pass" from SGD's perspective, internally recycling master
         passes. Tasks ack AFTER their last batch is yielded (the step
         loop trains a yielded batch before pulling the next — sync
-        loop), and the stop flag is honored only at task boundaries."""
+        loop) — in elastic mode only once a durable generation covers
+        them — and the stop flag is honored only at task boundaries."""
 
         def reader():
             client = MasterClient(self.master_addr,
                                   retry=self._client_retry)
+            self._client = client
             try:
+                if self._elastic:
+                    self.token = client.register(self.trainer_id,
+                                                 lease_s=self.lease_s)
                 self._maybe_seed(client)
+                if self._elastic:
+                    self._master_pass = int(
+                        client.counts().get("pass", 0))
+                    self._load_covered(client)
+                task_no = 0
                 while self._budget_left():
-                    t = client.get_task()
+                    if self._fenced_latch \
+                            and not self._handle_fenced(client):
+                        return
+                    plan = faults.active_plan()
+                    if plan is not None and plan.fire(
+                            "trainer_preempt_rejoin",
+                            task_no + 1) is not None:
+                        self.stop("fault-plan preemption (rejoin "
+                                  "expected)")
+                        continue  # the budget check ends the stream
+                    try:
+                        t = client.get_task()
+                    except FencedTokenError:
+                        self._fenced_latch = True
+                        continue
                     if t == PASS_DONE:
                         self.passes += 1
                         # recycle BEFORE the budget check so a bounded
                         # run always leaves the queue at a fresh pass
                         # boundary for its successor (new_pass is a
                         # no-op while another trainer holds tasks)
-                        client.new_pass()
+                        p = client.new_pass()
+                        if p >= 0:
+                            self._master_pass = p
+                            self._covered = {}
                         continue
                     if t == NO_TASK:
                         # another trainer holds the pending tail
                         time.sleep(0.02)
                         continue
                     tid, desc, epoch = t
+                    task_no += 1
+                    if plan is not None and plan.fire(
+                            "trainer_crash", task_no) is not None:
+                        # hard kill with the claim left DANGLING: the
+                        # lease plane must fence us and front-requeue it
+                        raise SimulatedCrash(
+                            f"fault plan: trainer hard crash holding "
+                            f"task {tid} (claim #{task_no})")
+                    if self._elastic:
+                        try:
+                            if self._skip_if_covered(client, tid, epoch):
+                                continue
+                        except FencedTokenError:
+                            self._fenced_latch = True
+                            continue
                     try:
-                        rows = []
-                        for rec in self.make_task_reader(desc):
-                            rows.append(rec)
-                            if len(rows) == self.batch_size:
-                                yield rows
-                                self.steps += 1
-                                rows = []
-                        if rows:  # trailing partial batch still trains
-                            yield rows
-                            self.steps += 1
+                        yield from self._task_batches(desc, tid, epoch)
                     except GeneratorExit:
                         # consumer torn down mid-task (trainer crash /
                         # interpreter exit): leave the claim to expire
                         # back into the queue
                         raise
                     except Exception:  # noqa: BLE001 - task retry
-                        client.task_failed(tid, epoch)
+                        self._finishing = None
+                        try:
+                            client.task_failed(tid, epoch)
+                        except FencedTokenError:
+                            self._fenced_latch = True
                         continue
-                    client.task_finished(tid, epoch)
-                    self.tasks_finished += 1
+                    self._note_task_trained(client, tid, epoch)
             finally:
-                client.close()
+                if not self._elastic:
+                    # elastic keeps the client open: SGD's FINAL
+                    # checkpoint (written after this generator closes)
+                    # must still flush its deferred acks; run() closes it
+                    self._client = None
+                    client.close()
 
         # the master tracks consumption; a checkpoint-resumed run must
         # not ALSO skip batches from this stream
@@ -208,8 +509,13 @@ class StreamingTrainer:
 
         ctx = (graceful_shutdown(flag=self._flag)
                if self._install_signals else contextlib.nullcontext())
-        with ctx:
-            self.sgd.train(self._stream_reader(), num_passes=1,
-                           event_handler=handler, run_log=run_log,
-                           checkpoint=self.checkpoint, **train_kw)
+        try:
+            with ctx:
+                self.sgd.train(self._stream_reader(), num_passes=1,
+                               event_handler=handler, run_log=run_log,
+                               checkpoint=self.checkpoint, **train_kw)
+        finally:
+            client, self._client = self._client, None
+            if client is not None:
+                client.close()
         return self.state()

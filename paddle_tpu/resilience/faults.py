@@ -30,6 +30,28 @@ points:
                       replica is delayed by ``delay_s`` (default 0.05) —
                       the tail-latency case hedging must absorb.
 
+The elastic crash/rejoin chaos matrix (StreamingTrainer + master lease
+plane) adds trainer-granular kinds:
+
+- ``trainer_crash``   StreamingTrainer, right after claiming its k-th task:
+                      raises :class:`SimulatedCrash` with the claim left
+                      dangling — the lease plane must fence the dead
+                      trainer and requeue the claim (front) for the next
+                      registrant.
+- ``trainer_preempt_rejoin`` StreamingTrainer, at its k-th task boundary:
+                      graceful stop before claiming (the preemption notice
+                      case); the harness restarts the trainer, whose
+                      re-registration fences the old incarnation.
+- ``zombie_ack``      StreamingTrainer, at the ack flush of its k-th saved
+                      generation: the trainer's lease is expired server-side
+                      first (a partition outliving the lease), so the acks
+                      it then sends are rejected by token and counted
+                      (``master/zombie_acks_rejected``).
+- ``master_partition`` MasterClient, at RPC #k: the lease is expired
+                      server-side and the connection torn — the
+                      reconnecting client's next tokened call raises
+                      ``FencedTokenError`` (the rejoin signal).
+
 Manual chaos runs go through ``--fault_plan`` (flags.py), e.g.
 ``--fault_plan=preempt@5,torn_checkpoint@3`` — the trainer parses it when
 no plan is installed programmatically.
@@ -41,7 +63,9 @@ import threading
 from typing import List, Optional, Tuple
 
 FAULT_KINDS = ("crash", "preempt", "executor_error", "torn_checkpoint",
-               "master_drop", "replica_crash", "slow_replica")
+               "master_drop", "replica_crash", "slow_replica",
+               "trainer_crash", "trainer_preempt_rejoin", "zombie_ack",
+               "master_partition")
 
 
 class SimulatedCrash(RuntimeError):

@@ -61,6 +61,30 @@ class CheckpointConfig:
                         tracks consumed tasks).
     install_signal_handlers: wrap the training loop in
                         :func:`graceful_shutdown`.
+    keep_last_n:        retention alias for ``keep`` (bounded checkpoint
+                        GC for endless-pass online training; overrides
+                        ``keep`` when given). The newest intact
+                        generation and a Publisher-pinned one (see
+                        ``checkpoint.pin_generation``) always survive.
+    extra_fn:           callable ``() -> dict`` merged into each save's
+                        ``extra`` record at save time — how the elastic
+                        StreamingTrainer stamps its checkpoint-lineage
+                        manifest (writer token, master pass, covered
+                        tasks) onto every generation.
+    pre_save_fn:        callable ``() -> bool`` consulted right before a
+                        save; returning False VETOES it (counted as
+                        ``ckpt/saves_vetoed``) — the fencing hook that
+                        stops a zombie trainer from publishing a
+                        generation after its lease expired.
+    on_saved:           callable ``(step, extra) -> None`` invoked after
+                        a save's write completes (on the background
+                        thread when ``background=True``) — the elastic
+                        trainer flushes its deferred task acks here, so
+                        the ack horizon never runs ahead of durable
+                        state.
+    accept_fn:          callable ``meta -> bool`` filtering resume
+                        candidates by their meta/lineage (forwarded to
+                        ``load_checkpoint(accept=...)``).
     """
 
     def __init__(self, dirname: str, every_n_steps: int = 100,
@@ -68,12 +92,15 @@ class CheckpointConfig:
                  resume: bool = True, strict: bool = False,
                  save_on_interrupt: bool = True, save_final: bool = True,
                  skip_batches_on_resume: Optional[bool] = None,
-                 install_signal_handlers: bool = True):
+                 install_signal_handlers: bool = True,
+                 keep_last_n: Optional[int] = None,
+                 extra_fn=None, pre_save_fn=None, on_saved=None,
+                 accept_fn=None):
         if every_n_steps < 0:
             raise ValueError("every_n_steps must be >= 0")
         self.dirname = dirname
         self.every_n_steps = int(every_n_steps)
-        self.keep = int(keep)
+        self.keep = int(keep if keep_last_n is None else keep_last_n)
         self.background = bool(background)
         self.resume = bool(resume)
         self.strict = bool(strict)
@@ -81,6 +108,10 @@ class CheckpointConfig:
         self.save_final = bool(save_final)
         self.skip_batches_on_resume = skip_batches_on_resume
         self.install_signal_handlers = bool(install_signal_handlers)
+        self.extra_fn = extra_fn
+        self.pre_save_fn = pre_save_fn
+        self.on_saved = on_saved
+        self.accept_fn = accept_fn
 
     def __repr__(self):
         return (f"CheckpointConfig({self.dirname!r}, "
@@ -131,11 +162,15 @@ class CheckpointManager:
     resume-time data loss.
     """
 
-    def __init__(self, config: CheckpointConfig, scope=None):
+    def __init__(self, config: CheckpointConfig, scope=None, plan=None):
         from ..core.scope import global_scope
 
         self.config = config
         self.scope = scope if scope is not None else global_scope()
+        # reshard-on-restore: with a plan, resume() re-places every
+        # restored value through the plan's PartitionSpecs (a checkpoint
+        # saved under another mesh shape lands directly sharded)
+        self.plan = plan
         self.last_saved_step: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -161,7 +196,9 @@ class CheckpointManager:
         with trace.span("ckpt/restore", dirname=self.config.dirname) as sp:
             meta = ckpt_mod.load_checkpoint(self.config.dirname,
                                             scope=self.scope,
-                                            strict=self.config.strict)
+                                            strict=self.config.strict,
+                                            plan=self.plan,
+                                            accept=self.config.accept_fn)
             if sp is not None:
                 sp.set_attrs(step=meta.get("step"),
                              fallback=bool(meta.get("fallback")))
@@ -182,8 +219,20 @@ class CheckpointManager:
         (interrupt/final checkpoints must hit disk before exit)."""
         from .. import profiler, trace
 
+        if self.config.pre_save_fn is not None \
+                and not self.config.pre_save_fn():
+            # fencing veto: a zombie (lease-expired) trainer must not
+            # publish a generation — the master already requeued its
+            # tasks to a live trainer
+            profiler.global_stat.add_count("ckpt/saves_vetoed", 1)
+            t = time.perf_counter()
+            trace.record("ckpt/save_vetoed", t, t, step=step,
+                         reason=reason)
+            return
         extra = {"pass_id": int(pass_id), "iteration": int(iteration),
                  "samples_seen": int(samples_seen), "reason": reason}
+        if self.config.extra_fn is not None:
+            extra.update(self.config.extra_fn() or {})
         background = self.config.background and not wait
         with profiler.timer("ckpt/stall"), \
                 trace.span("ckpt/save", step=step, reason=reason,
@@ -215,6 +264,11 @@ class CheckpointManager:
             _tear(payload)
         trace.record("ckpt/write", t0, time.perf_counter(), step=step,
                      bytes=snap.nbytes())
+        if self.config.on_saved is not None:
+            # generation-durable hook (elastic ack flush): runs on the
+            # writer thread — the trainer thread for sync saves, the
+            # background thread otherwise
+            self.config.on_saved(step, extra)
 
     def _write_guarded(self, snap, step, extra) -> None:
         try:
@@ -270,11 +324,12 @@ class TrainResilience:
     - ``finalize()`` after the pass loop (final checkpoint + join).
     """
 
-    def __init__(self, config: Optional[CheckpointConfig], scope=None):
+    def __init__(self, config: Optional[CheckpointConfig], scope=None,
+                 plan=None):
         from ..flags import FLAGS
 
         self.config = config
-        self.manager = (CheckpointManager(config, scope=scope)
+        self.manager = (CheckpointManager(config, scope=scope, plan=plan)
                         if config is not None else None)
         plan = active_plan()
         if plan is None and FLAGS.fault_plan:

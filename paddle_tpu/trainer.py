@@ -208,7 +208,11 @@ class SGD:
         from .resilience import TrainResilience, faults
         if (checkpoint is not None or FLAGS.fault_plan
                 or faults.active_plan() is not None):
-            rs = TrainResilience(checkpoint, scope=self.scope)
+            # reshard-on-restore: the executor's plan rides into the
+            # restore, so a checkpoint saved under a different mesh/plan
+            # re-places bitwise through THIS plan's PartitionSpecs
+            rs = TrainResilience(checkpoint, scope=self.scope,
+                                 plan=self.exe.plan)
             rs.resume()  # restores scope + position from the latest ckpt
             if checkpoint is not None and getattr(checkpoint, "dirname",
                                                   None):

@@ -1,0 +1,706 @@
+"""Elastic training plane (ISSUE 15): multi-trainer leases with fencing,
+reshard-on-restore checkpoints, and the crash/rejoin chaos matrix.
+
+Acceptance pins:
+- reshard: a checkpoint saved under ``dp=8`` restores BITWISE under
+  ``dp=4×mp=2`` (and under a 4-device mesh) through
+  ``SGD.train(plan=...)`` resume on the CPU mesh;
+- elasticity: 3 StreamingTrainers through injected crash + rejoin +
+  zombie-ack chaos finish with bitwise-identical final params vs an
+  uninterrupted single-trainer run — no task lost, none double-counted,
+  zombie writes fenced out.
+
+Satellite pins: stale-token ``task_finished`` returns False and is
+counted at BOTH the ``Master`` unit level and through a real two-client
+``MasterServer``; truncated master snapshots walk back to the previous
+intact one; ``keep_last_n`` retention GC never deletes the newest or the
+Publisher-pinned generation; a generation GC'd between discovery and
+load is skipped with a counter.
+
+Tier-1 budget: one shared CTR builder, tiny models, redundant variants
+(`preempt_rejoin` kind, in-place rejoin, bench path) are
+``@pytest.mark.slow``.
+"""
+import importlib.util
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint as ckpt_mod
+from paddle_tpu import dataset, layers
+from paddle_tpu.master import (FencedTokenError, Master, MasterClient,
+                               MasterServer, recover_durable)
+from paddle_tpu.online import StreamingTrainer
+from paddle_tpu.resilience import (CheckpointConfig, FaultPlan,
+                                   SimulatedCrash)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, SLOTS, DD = 128, dataset.ctr.SLOTS, dataset.ctr.DENSE_DIM
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _build_ctr(seed=7):
+    """Fresh CTR bundle (order-seeded init: two identically-built
+    bundles initialize bit-identically)."""
+    main, startup = pt.Program(), pt.Program()
+    startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        ids = layers.data("ids", shape=[SLOTS], dtype="int64")
+        dense = layers.data("dense", shape=[DD])
+        label = layers.data("label", shape=[1])
+        logit = pt.models.wide_deep(ids, dense, vocab_size=VOCAB,
+                                    embed_dim=4, hidden_sizes=(8,))
+        loss, _ = pt.models.wide_deep_loss(logit, label)
+        sgd = pt.trainer.SGD(
+            loss, pt.optimizer.SGDOptimizer(learning_rate=0.05),
+            [ids, dense, label], scope=pt.Scope())
+    return sgd
+
+
+def _okeys(scope):
+    """Scope keys in CREATION order (numeric unique-name suffix): two
+    identically-built bundles align positionally even though the global
+    name counter gives them different suffixes."""
+    def key(name):
+        m = re.search(r"_(\d+)$", name)
+        return (0, int(m.group(1))) if m else (1, name)
+    return sorted(scope.keys(), key=key)
+
+
+def _assert_scopes_bitwise(a, b):
+    ka, kb = _okeys(a), _okeys(b)
+    assert len(ka) == len(kb)
+    for na, nb in zip(ka, kb):
+        np.testing.assert_array_equal(np.asarray(a.get(na)),
+                                      np.asarray(b.get(nb)),
+                                      err_msg=f"{na} vs {nb}")
+
+
+def _stream(addr, ck, bundle, trainer_id, descs, fault=None,
+            rejoin=False, every=2, handler=None):
+    st = StreamingTrainer(
+        bundle, addr, dataset.ctr.task_reader, task_descs=descs,
+        batch_size=16,
+        checkpoint=CheckpointConfig(ck, every_n_steps=every,
+                                    background=False),
+        max_passes=1, trainer_id=trainer_id, rejoin=rejoin,
+        install_signal_handlers=False)
+    crashed = False
+    ctx = fault.active() if fault is not None else None
+    try:
+        if ctx is not None:
+            ctx.__enter__()
+        try:
+            stats = st.run(event_handler=handler)
+        except SimulatedCrash:
+            crashed, stats = True, None
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    return st, stats, crashed
+
+
+# ---------------------------------------------------------------------------
+# master lease plane (engine + server)
+# ---------------------------------------------------------------------------
+class TestMasterLeases:
+    def test_lease_fence_requeues_front_no_strike(self):
+        m = Master(timeout_s=60, max_failures=2)
+        m.set_dataset(["a", "b", "c"])
+        tok = m.register_trainer("host-a", lease_s=30.0)
+        tid, desc, ep = m.get_task(token=tok)
+        assert desc == "a"
+        assert m.expire_trainer("host-a")
+        # front requeue: the next registrant re-trains "a" BEFORE "b"
+        tok2 = m.register_trainer("host-b")
+        tid2, desc2, ep2 = m.get_task(token=tok2)
+        assert (tid2, desc2) == (tid, "a") and ep2 > ep
+        # no failure strike: expire again, claim again — never discarded
+        assert m.expire_trainer("host-b")
+        tok3 = m.register_trainer("host-c")
+        tid3, desc3, ep3 = m.get_task(token=tok3)
+        assert desc3 == "a"
+        assert m.counts()["discarded"] == 0
+        assert m.task_finished(tid3, ep3, token=tok3)
+
+    def test_stale_token_ack_rejected_and_counted_unit(self):
+        """SATELLITE PIN (Master unit level): a fenced token's
+        task_finished returns False and bumps zombie_acks_rejected."""
+        m = Master(timeout_s=60)
+        m.set_dataset(["a"])
+        tok = m.register_trainer("host-a")
+        tid, _, ep = m.get_task(token=tok)
+        assert m.expire_trainer("host-a")
+        assert m.task_finished(tid, ep, token=tok) is False
+        assert m.task_failed(tid, ep, token=tok) is False
+        c = m.counts()
+        assert c["zombie_acks_rejected"] == 2
+        assert c["lease_expired_total"] == 1
+        assert m.heartbeat(tok) is False
+        with pytest.raises(FencedTokenError):
+            m.get_task(token=tok)
+        # monotonic: the reincarnation outranks every prior token
+        tok2 = m.register_trainer("host-a")
+        assert tok2 > tok
+        tid2, _, ep2 = m.get_task(token=tok2)
+        assert m.task_finished(tid2, ep2, token=tok2) is True
+
+    def test_heartbeat_extends_claim_deadlines(self):
+        """A long task under a healthy lease never hits the per-task
+        timeout requeue: heartbeats touch the engine deadlines."""
+        m = Master(timeout_s=1, max_failures=5)
+        m.set_dataset(["x"])
+        tok = m.register_trainer("host-a", lease_s=30.0)
+        tid, _, ep = m.get_task(token=tok)
+        for _ in range(2):
+            time.sleep(0.7)
+            assert m.heartbeat(tok)
+        # 1.4s elapsed > timeout_s, but the claim was touched: still ours
+        assert m.get_task(token=tok) in (-1, -2)
+        assert m.task_finished(tid, ep, token=tok) is True
+
+    def test_two_client_zombie_ack_through_server(self, tmp_path):
+        """SATELLITE PIN (two real clients through a MasterServer): the
+        partitioned trainer's ack bounces (False + counted), its task is
+        re-served front to the live trainer, and the gauges land in the
+        master's Prometheus text."""
+        snap = str(tmp_path / "m.snap")
+        srv = MasterServer(timeout_s=60, snapshot_path=snap, port=0)
+        addr = srv.start()
+        try:
+            ca, cb = MasterClient(addr), MasterClient(addr)
+            ca.set_dataset(["t0", "t1"])
+            ta = ca.register("A", lease_s=30)
+            tb = cb.register("B")
+            assert tb > ta
+            tid, desc, ep = ca.get_task()
+            cb._call(op="expire_trainer", trainer_id="A")  # partition
+            assert ca.task_finished(tid, ep) is False      # zombie
+            assert ca.heartbeat() is False
+            with pytest.raises(FencedTokenError):
+                ca.get_task()
+            t2 = cb.get_task()   # front requeue: B re-trains t0 first
+            assert t2[1] == desc
+            assert cb.task_finished(t2[0], t2[2])
+            cnt = cb.counts()
+            assert cnt["zombie_acks_rejected"] == 1
+            assert cnt["lease_expired_total"] == 1
+            assert cnt["trainers_active"] == 1
+            prom = cb.metrics_text()
+            assert "master_zombie_acks_rejected 1" in prom
+            assert "master_lease_expired_total 1" in prom
+            assert "master_trainers_active 1" in prom
+            # the reincarnation rejoins with a fresh, higher token
+            ta2 = ca.rejoin()
+            assert ta2 > tb
+            t3 = ca.get_task()
+            assert ca.task_finished(t3[0], t3[2])
+        finally:
+            srv.stop()
+
+    def test_tokens_monotonic_across_master_restart(self, tmp_path):
+        snap = str(tmp_path / "m.snap")
+        srv = MasterServer(timeout_s=60, snapshot_path=snap, port=0)
+        addr = srv.start()
+        c = MasterClient(addr)
+        c.set_dataset(["a"])
+        tok = c.register("A")
+        srv.stop()
+        srv2 = MasterServer(timeout_s=60, snapshot_path=snap, port=0)
+        addr2 = srv2.start()
+        try:
+            c2 = MasterClient(addr2)
+            # queue state recovered AND the token counter kept rising:
+            # a pre-restart zombie still ranks below every new token
+            assert c2.counts()["todo"] == 1
+            assert c2.register("B") > tok
+        finally:
+            srv2.stop()
+
+    def test_truncated_snapshot_walks_back_to_prev(self, tmp_path):
+        """SATELLITE PIN: the durable snapshot rotation means a crash
+        mid-write can never lose the queue — a truncated latest recovers
+        from the previous intact snapshot."""
+        snap = str(tmp_path / "m.snap")
+        srv = MasterServer(timeout_s=60, snapshot_path=snap, port=0)
+        addr = srv.start()
+        c = MasterClient(addr)
+        c.set_dataset(["a", "b", "c"])       # snapshot 1 (rotates)
+        t = c.get_task()
+        c.task_finished(t[0], t[2])
+        srv.stop()                           # snapshot 2 (rotates 1 to .prev)
+        assert os.path.exists(snap + ".prev")
+        with open(snap, "r+b") as f:         # tear the latest
+            f.truncate(os.path.getsize(snap) // 2)
+        m = Master(timeout_s=60)
+        assert m.recover(snap) is False      # the torn file itself: refused
+        assert recover_durable(m, snap) == snap + ".prev"
+        srv3 = MasterServer(timeout_s=60, snapshot_path=snap, port=0)
+        addr3 = srv3.start()
+        try:
+            # .prev holds the pre-finish state: nothing silently dropped
+            c3 = MasterClient(addr3)
+            assert c3.counts()["todo"] == 3
+        finally:
+            srv3.stop()
+
+
+# ---------------------------------------------------------------------------
+# reshard-on-restore
+# ---------------------------------------------------------------------------
+def _build_dense(seed=3):
+    main, startup = pt.Program(), pt.Program()
+    startup.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=16, act="relu")
+        out = layers.fc(h, size=1)
+        loss = layers.mean(layers.square(out - y))
+        sgd = pt.trainer.SGD(
+            loss, pt.optimizer.SGDOptimizer(learning_rate=0.1),
+            [x, y], scope=pt.Scope())
+    return sgd
+
+
+def _dense_batches(n=2, batch=8):
+    rng = np.random.RandomState(0)
+    return [[(rng.rand(8).astype(np.float32),
+              rng.rand(1).astype(np.float32)) for _ in range(batch)]
+            for _ in range(n)]
+
+
+def test_reshard_restore_dp8_to_dp4mp2_bitwise(tmp_path, cpu_mesh8,
+                                               cpu_mesh_dp_mp):
+    """ACCEPTANCE PIN: a checkpoint saved under dp=8 restores BITWISE
+    into a scope lowered under dp=4 x mp=2 — through
+    ``SGD.train(plan=...)`` resume — with the big parameters actually
+    re-placed on the new mesh's PartitionSpecs (not replicated)."""
+    from paddle_tpu.parallel import data_parallel_plan, megatron_plan
+
+    data = _dense_batches()
+    sgd = _build_dense()
+    sgd.train(lambda: iter(data), num_passes=1,
+              event_handler=lambda e: None,
+              plan=data_parallel_plan(cpu_mesh8))
+    d = str(tmp_path / "ck")
+    ckpt_mod.save_checkpoint(d, scope=sgd.scope, step=2)
+    want = {k: np.asarray(sgd.scope.get(k)).copy()
+            for k in sgd.scope.keys()}
+
+    plan_b = megatron_plan(cpu_mesh_dp_mp)
+    # direct restore: full stitch + re-place
+    s2 = pt.Scope()
+    ckpt_mod.load_checkpoint(d, scope=s2, plan=plan_b)
+    for k, w in want.items():
+        np.testing.assert_array_equal(np.asarray(s2.get(k)), w,
+                                      err_msg=k)
+    fc_w = next(k for k in want
+                if want[k].ndim == 2 and want[k].shape[1] == 16)
+    arr = s2.get(fc_w)
+    assert len(arr.addressable_shards) == 8       # on the new mesh
+    assert "mp" in str(arr.sharding.spec)         # megatron split, not
+    #                                               a replicated copy
+
+    # THROUGH the trainer: SGD.train(plan=plan_b) resume restores
+    # bitwise and training continues under the new plan
+    sgd2 = _build_dense()
+    cfg = CheckpointConfig(d, every_n_steps=0, background=False,
+                           save_final=False, save_on_interrupt=False)
+    sgd2.train(lambda: iter([]), num_passes=1, checkpoint=cfg,
+               event_handler=lambda e: None, plan=plan_b)
+    for (ka, w), kb in zip(sorted(want.items()),
+                           sorted(sgd2.scope.keys())):
+        np.testing.assert_array_equal(np.asarray(sgd2.scope.get(ka)), w,
+                                      err_msg=ka)
+    sgd2.train(lambda: iter(data), num_passes=1,
+               event_handler=lambda e: None, plan=plan_b)
+
+
+def test_reshard_restore_shrinks_to_4_devices(tmp_path, cpu_mesh8):
+    """ACCEPTANCE PIN (mesh shrink): the dp=8 checkpoint restores
+    bitwise onto a 4-device mesh — the 'preempted hosts do not come
+    back' half of elasticity."""
+    import jax
+
+    from paddle_tpu.parallel import data_parallel_plan, make_mesh
+
+    data = _dense_batches()
+    sgd = _build_dense()
+    sgd.train(lambda: iter(data), num_passes=1,
+              event_handler=lambda e: None,
+              plan=data_parallel_plan(cpu_mesh8))
+    d = str(tmp_path / "ck")
+    ckpt_mod.save_checkpoint(d, scope=sgd.scope, step=2)
+    want = {k: np.asarray(sgd.scope.get(k)).copy()
+            for k in sgd.scope.keys()}
+
+    mesh4 = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    plan4 = data_parallel_plan(mesh4)
+    sgd2 = _build_dense()
+    cfg = CheckpointConfig(d, every_n_steps=0, background=False,
+                           save_final=False, save_on_interrupt=False)
+    sgd2.train(lambda: iter([]), num_passes=1, checkpoint=cfg,
+               event_handler=lambda e: None, plan=plan4)
+    for k, w in want.items():
+        got = sgd2.scope.get(k)
+        np.testing.assert_array_equal(np.asarray(got), w, err_msg=k)
+        if hasattr(got, "sharding"):
+            assert len({s.device for s in got.addressable_shards}) <= 4
+    sgd2.train(lambda: iter(data), num_passes=1,
+               event_handler=lambda e: None, plan=plan4)
+
+
+def test_sidecar_stitch_restores_under_new_plan(tmp_path, cpu_mesh_dp_mp):
+    """A checkpoint written by a LARGER fleet (per-process .shard{i}.npz
+    sidecars with global index metadata) stitches into full values and
+    re-shards through the new plan's PartitionSpecs — the shrink-fleet
+    restore path."""
+    import hashlib
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    rng = np.random.RandomState(4)
+    w = rng.rand(8, 4).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    # main payload: the replicated value, written by "process 0"
+    payload = os.path.join(d, "ckpt-1.npz")
+    with open(payload, "wb") as f:
+        np.savez(f, b=b, __dtypes__=np.frombuffer(
+            json.dumps({"b": "float32"}).encode(), dtype=np.uint8))
+    # two per-process sidecars, each holding half of w's rows
+    for pid, rows in enumerate(((0, 4), (4, 8))):
+        info = {"meta": {"w": {"shape": [8, 4],
+                               "indices": [[[rows[0], rows[1]], [0, 4]]]}},
+                "dtypes": {"w@shard0": "float32"}}
+        with open(os.path.join(d, f"ckpt-1.shard{pid}.npz"), "wb") as f:
+            np.savez(f, **{"w@shard0": w[rows[0]:rows[1]],
+                           "__shards__": np.frombuffer(
+                               json.dumps(info).encode(), dtype=np.uint8)})
+    md5 = hashlib.md5(open(payload, "rb").read()).hexdigest()
+    meta = {"latest": "ckpt-1.npz", "step": 1, "md5": md5,
+            "timestamp": time.time(), "shard_files": 2,
+            "shard_values": ["w"], "extra": {}}
+    with open(os.path.join(d, ckpt_mod.META_NAME), "w") as f:
+        json.dump(meta, f)
+
+    plan = ShardingPlan(cpu_mesh_dp_mp, rules=[("w", P("dp"))],
+                        data_axis="dp")
+    scope = pt.Scope()
+    out = ckpt_mod.load_checkpoint(d, scope=scope, plan=plan)
+    assert out["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope.get("w")), w)
+    np.testing.assert_array_equal(np.asarray(scope.get("b")), b)
+    arr = scope.get("w")
+    assert arr.sharding.spec == P("dp")
+    assert len(arr.addressable_shards) == 8
+
+
+# ---------------------------------------------------------------------------
+# the crash/rejoin chaos matrix
+# ---------------------------------------------------------------------------
+def test_chaos_matrix_three_trainer_relay_bitwise(tmp_path):
+    """ACCEPTANCE PIN: 3 StreamingTrainers relay through one master
+    queue under injected chaos — T1 fenced as a ZOMBIE at its 2nd
+    generation's ack flush (acks rejected by stale token), T2
+    hard-CRASHES holding a claim, T3 (T2's reincarnation, same trainer
+    id) REJOINS, skip-acks the lineage-covered task, and drains the
+    pass. Every task is acked exactly once, nothing is discarded, and
+    the final params are BITWISE an uninterrupted single-trainer run."""
+    descs = dataset.ctr.task_descs(4, records_per_shard=32, vocab=VOCAB)
+
+    # leg A: uninterrupted single trainer
+    srv_u = MasterServer(timeout_s=30, port=0)
+    addr_u = srv_u.start()
+    bu = _build_ctr()
+    st_u, _, _ = _stream(addr_u, str(tmp_path / "ck_u"), bu, "solo",
+                         descs)
+    srv_u.stop()
+    assert st_u.tasks_finished == 4
+
+    # leg B: the relay (one bundle == each leg is a fresh process
+    # rebuilding the same program; resume overwrites the whole scope)
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    ck = str(tmp_path / "ck_chaos")
+    b = _build_ctr()
+    try:
+        st1, _, _ = _stream(addr, ck, b, "host-a", descs,
+                            fault=FaultPlan().at(step=2,
+                                                 kind="zombie_ack"))
+        # T1 trained t0+t1; only t0's ack landed before the fence
+        assert st1.zombie_acks == 1 and st1.tasks_finished == 1
+        assert st1.stopping  # fenced with rejoin=False -> stopped
+        # the zombie's generation carries its lineage manifest
+        step = ckpt_mod.latest_step(ck)
+        lineage = ckpt_mod.generation_info(ck, step)["extra"]["lineage"]
+        assert lineage["writer_token"] == st1.token
+        assert len(lineage["covered_unacked"]) == 1
+
+        st2, _, crashed = _stream(addr, ck, b, "host-b", descs,
+                                  fault=FaultPlan().at(
+                                      step=2, kind="trainer_crash"))
+        assert crashed
+        # t1 was covered by T1's durable generation: skip-acked, never
+        # retrained (exactly-once effective)
+        assert st2.tasks_skip_acked == 1 and st2.tasks_finished == 1
+
+        st3, s3, _ = _stream(addr, ck, b, "host-b", descs)
+        q = s3["queue"]
+    finally:
+        srv.stop()
+
+    acked = st1.tasks_finished + st2.tasks_finished + st3.tasks_finished
+    assert acked == 4                       # no task lost, none doubled
+    assert q["discarded"] == 0
+    assert q["zombie_acks_rejected"] >= 1   # zombie writes fenced out
+    assert q["lease_expired_total"] >= 1
+    assert st3.passes == 1                  # the pass completed once
+    _assert_scopes_bitwise(bu.scope, b.scope)
+
+
+def test_zombie_checkpoint_write_vetoed(tmp_path):
+    """A fenced trainer's checkpoint-generation write is REJECTED by the
+    pre-save heartbeat: after its lease is revoked mid-run, no further
+    generation lands (counted as ckpt/saves_vetoed) and the trainer
+    stops at the next boundary."""
+    from paddle_tpu import profiler
+
+    def vetoed_count():
+        d = profiler.global_stat.as_dict(prefix="ckpt/saves_vetoed")
+        return d.get("ckpt/saves_vetoed", {}).get("total_ms", 0)
+
+    descs = dataset.ctr.task_descs(3, records_per_shard=32, vocab=VOCAB)
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    ck = str(tmp_path / "ck")
+    b = _build_ctr()
+    admin = MasterClient(addr)
+    seen = {"n": 0}
+    v0 = vetoed_count()
+
+    def handler(e):
+        if isinstance(e, pt.event.EndIteration):
+            seen["n"] += 1
+            if seen["n"] == 3:   # mid-second-task, before its save
+                admin._call(op="expire_trainer", trainer_id="host-v")
+
+    try:
+        st, _, _ = _stream(addr, ck, b, "host-v", descs, handler=handler)
+    finally:
+        srv.stop()
+    assert st.stopping and st.lease_lost == 1
+    # only the pre-fence generation exists; the zombie's saves (periodic
+    # AND final) were vetoed
+    assert ckpt_mod.latest_step(ck) == 2
+    assert vetoed_count() >= v0 + 1
+
+
+@pytest.mark.slow
+def test_master_partition_rejoin_in_place(tmp_path):
+    """The rejoin=True path: a network partition outliving the lease
+    (master_partition fault) fences the trainer mid-run; it re-registers,
+    rolls back to the newest durable generation, retrains the requeued
+    tail, and the run still acks every task exactly once."""
+    descs = dataset.ctr.task_descs(3, records_per_shard=32, vocab=VOCAB)
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    b = _build_ctr()
+    try:
+        st, stats, _ = _stream(
+            addr, str(tmp_path / "ck"), b, "host-r", descs, rejoin=True,
+            fault=FaultPlan().at(step=9, kind="master_partition"))
+    finally:
+        srv.stop()
+    assert st.rejoins == 1
+    assert st.tasks_finished == len(descs)
+    assert stats["queue"]["discarded"] == 0
+    assert st.passes == 1
+
+
+@pytest.mark.slow
+def test_trainer_preempt_rejoin_fault_relay(tmp_path):
+    """The graceful half of the matrix: trainer_preempt_rejoin stops T1
+    at a task boundary; T2 re-registers the same id and finishes —
+    bitwise vs uninterrupted (the graceful relay never needs skip-acks:
+    every acked task was checkpoint-covered first)."""
+    descs = dataset.ctr.task_descs(3, records_per_shard=32, vocab=VOCAB)
+    srv_u = MasterServer(timeout_s=30, port=0)
+    addr_u = srv_u.start()
+    bu = _build_ctr()
+    st_u, _, _ = _stream(addr_u, str(tmp_path / "u"), bu, "solo", descs)
+    srv_u.stop()
+
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    ck = str(tmp_path / "ck")
+    b = _build_ctr()
+    try:
+        st1, _, _ = _stream(addr, ck, b, "host-p", descs,
+                            fault=FaultPlan().at(
+                                step=2, kind="trainer_preempt_rejoin"))
+        assert st1.stopping and 0 < st1.tasks_finished < len(descs)
+        st2, s2, _ = _stream(addr, ck, b, "host-p", descs)
+    finally:
+        srv.stop()
+    assert st1.tasks_finished + st2.tasks_finished == len(descs)
+    assert s2["queue"]["discarded"] == 0
+    _assert_scopes_bitwise(bu.scope, b.scope)
+
+
+# ---------------------------------------------------------------------------
+# retention GC + publisher satellites
+# ---------------------------------------------------------------------------
+def test_keep_last_n_gc_bounded_and_pin_survives(tmp_path):
+    """SATELLITE PIN: bounded retention never deletes the newest intact
+    generation nor the Publisher-pinned one — endless-pass training
+    stops filling the disk."""
+    d = str(tmp_path / "ck")
+    scope = pt.Scope()
+    scope.set("w", np.arange(4, dtype=np.float32))
+    cfg = CheckpointConfig(d, keep_last_n=2, background=False)
+    assert cfg.keep == 2
+    ckpt_mod.save_checkpoint(d, scope=scope, step=2, max_keep=cfg.keep)
+    ckpt_mod.pin_generation(d, 2)        # the fleet serves step 2
+    for step in (4, 6, 8, 10):
+        scope.set("w", np.full(4, step, np.float32))
+        ckpt_mod.save_checkpoint(d, scope=scope, step=step,
+                                 max_keep=cfg.keep)
+    files = sorted(p for p in os.listdir(d)
+                   if p.startswith("ckpt-") and p.endswith(".npz"))
+    # newest 2 + the pinned generation; everything else GC'd
+    assert files == ["ckpt-10.npz", "ckpt-2.npz", "ckpt-8.npz"]
+    # their per-step meta sidecars follow the same retention
+    jsons = sorted(p for p in os.listdir(d) if p.endswith(".json"))
+    assert jsons == ["ckpt-10.json", "ckpt-2.json", "ckpt-8.json"]
+    # unpin: the old generation becomes collectable at the next save
+    ckpt_mod.pin_generation(d, None)
+    scope.set("w", np.full(4, 12, np.float32))
+    ckpt_mod.save_checkpoint(d, scope=scope, step=12, max_keep=cfg.keep)
+    files = sorted(p for p in os.listdir(d)
+                   if p.startswith("ckpt-") and p.endswith(".npz"))
+    assert files == ["ckpt-10.npz", "ckpt-12.npz"]
+
+
+class _FakeFleet:
+    """The Publisher's fleet surface: metrics + update_weights."""
+
+    def __init__(self, fail=None):
+        from paddle_tpu.serving.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self.replicas = []
+        self.publisher = None
+        self.updates = []
+        self._fail = fail
+
+    def update_weights(self, source, verify=True):
+        if self._fail is not None:
+            raise self._fail
+        self.updates.append(source)
+
+
+def test_publisher_race_gcd_generation_skipped_with_counter(tmp_path):
+    """SATELLITE PIN: a generation discovered then GC'd before the load
+    is SKIPPED (counter bump), not raised out of the poll loop; the next
+    intact generation publishes normally."""
+    from paddle_tpu.online import Publisher
+
+    d = str(tmp_path / "ck")
+    scope = pt.Scope()
+    scope.set("w", np.arange(4, dtype=np.float32))
+    ckpt_mod.save_checkpoint(d, scope=scope, step=1)
+    fleet = _FakeFleet()
+    pub = Publisher(fleet, d, pin=False)
+
+    orig = pub._pinned_source
+
+    def racing(step):
+        # the trainer's GC wins the race: the whole generation vanishes
+        # between discovery and load
+        for p in os.listdir(d):
+            os.remove(os.path.join(d, p))
+        return orig(step)
+
+    pub._pinned_source = racing
+    assert pub.poll_once() is None
+    assert pub.skipped == 1 and pub.generations == 0
+    assert pub.last_error is None                  # a race, not an error
+    assert fleet.metrics.snapshot()["counters"].get(
+        "weight_publish_skipped") == 1
+
+    pub._pinned_source = orig                      # next generation: fine
+    ckpt_mod.save_checkpoint(d, scope=scope, step=3)
+    assert pub.poll_once() == 3
+    assert pub.generations == 1 and len(fleet.updates) == 1
+
+
+def test_publisher_pins_published_generation(tmp_path):
+    """The publisher pins what it serves: retention GC keeps the served
+    generation alive however many newer ones land."""
+    from paddle_tpu.online import Publisher
+
+    d = str(tmp_path / "ck")
+    scope = pt.Scope()
+    scope.set("w", np.arange(4, dtype=np.float32))
+    ckpt_mod.save_checkpoint(d, scope=scope, step=1)
+    fleet = _FakeFleet()
+    pub = Publisher(fleet, d)
+    assert pub.poll_once() == 1
+    assert ckpt_mod.pinned_step(d) == 1
+    for step in (2, 3, 4):
+        ckpt_mod.save_checkpoint(d, scope=scope, step=step, max_keep=1)
+    files = {p for p in os.listdir(d)
+             if p.startswith("ckpt-") and p.endswith(".npz")}
+    assert "ckpt-1.npz" in files                   # served: pinned
+    assert "ckpt-2.npz" not in files               # history: GC'd
+
+
+def test_trace_summary_resilience_grows_lease_rejoin_lines():
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_REPO, "tools", "trace_summary.py"))
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    events = [
+        {"name": "master/lease_expired", "dur": 0.0,
+         "args": {"trainer": "host-a", "reason": "expired"}},
+        {"name": "master/zombie_ack_rejected", "dur": 0.0,
+         "args": {"op": "task_finished", "token": 1}},
+        {"name": "trainer/rejoin", "dur": 2500.0,
+         "args": {"trainer_id": "host-a"}},
+        {"name": "ckpt/save_vetoed", "dur": 0.0, "args": {"step": 4}},
+    ]
+    out = ts.summarize_resilience(events)
+    assert "leases expired/fenced:   1" in out and "host-a" in out
+    assert "zombie acks rejected:    1" in out
+    assert "task_finished x1" in out
+    assert "trainer rejoins:         1" in out
+    assert "VETOED" in out
+
+
+@pytest.mark.slow
+def test_bench_elastic_path_runs():
+    """The CPU witness path works end to end and reports the
+    exactly-once + bitwise record."""
+    import importlib
+
+    import jax
+
+    bench = importlib.import_module("bench")
+    out = bench.bench_elastic(jax, pt, layers, n_tasks=3)
+    assert out["acks_exactly_once"] is True
+    assert out["bitwise_vs_uninterrupted"] is True
+    assert out["discarded"] == 0
+    assert out["zombie_acks_rejected"] >= 1

@@ -13,8 +13,11 @@ name, sorted by total — the offline analogue of
 ``--runlog`` summarizes a trace.RunLog training journal instead:
 per-pass cost, examples/sec, and the pass-end StatSet highlights.
 ``--pipeline`` shows the async-trainer host-gap view; ``--resilience``
-shows checkpoint stall (ckpt/save vs ckpt/write) and retry pressure
-(retry/attempt spans per policy).
+shows checkpoint stall (ckpt/save vs ckpt/write), retry pressure
+(retry/attempt spans per policy), and the elastic-training lease plane:
+leases expired/fenced per trainer, zombie acks the master rejected by
+token, vetoed (fenced-writer) checkpoint saves, and trainer rejoin
+counts with rollback wall time.
 
 ``--distributed`` stitches N JSONL journals from DIFFERENT processes
 (the fleet router's + each replica's, written via
@@ -174,6 +177,10 @@ def summarize_resilience(events):
         lines.append(f"ckpt restores:           {len(restores)}"
                      + (f" ({len(fb)} FELL BACK past a torn checkpoint)"
                         if fb else ""))
+    vetoed = by_name("ckpt/save_vetoed")
+    if vetoed:
+        lines.append(f"ckpt saves VETOED:       {len(vetoed)} "
+                     "(fenced writer — zombie generation blocked)")
     retries = by_name("retry/attempt")
     if retries:
         pols = {}
@@ -185,6 +192,26 @@ def summarize_resilience(events):
         for pol, (n, err) in sorted(pols.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"retry pressure [{pol}]:   {n} failed attempts"
                          + (f"  last: {err}" if err else ""))
+    # elastic plane: lease churn, fenced zombies, rejoin cost
+    leases = by_name("master/lease_expired")
+    if leases:
+        trainers = sorted({e.get("args", {}).get("trainer", "?")
+                           for e in leases})
+        lines.append(f"leases expired/fenced:   {len(leases)} "
+                     f"(trainers: {', '.join(trainers)})")
+    zombies = by_name("master/zombie_ack_rejected")
+    if zombies:
+        ops = {}
+        for e in zombies:
+            op = e.get("args", {}).get("op", "?")
+            ops[op] = ops.get(op, 0) + 1
+        detail = ", ".join(f"{k} x{v}" for k, v in sorted(ops.items()))
+        lines.append(f"zombie acks rejected:    {len(zombies)} ({detail})")
+    rejoins = by_name("trainer/rejoin")
+    if rejoins:
+        lines.append(f"trainer rejoins:         {len(rejoins)}, "
+                     f"rollback {tot_ms(rejoins):.3f} ms total "
+                     f"({tot_ms(rejoins) / len(rejoins):.3f} avg)")
     return "\n".join(lines) if lines else \
         "(no ckpt/* or retry/* spans — resilience idle)"
 
@@ -340,7 +367,8 @@ def main(argv=None):
     ap.add_argument("--pipeline", action="store_true",
                     help="host-gap view of trainer dispatch/resolve spans")
     ap.add_argument("--resilience", action="store_true",
-                    help="checkpoint-stall + retry-pressure view")
+                    help="checkpoint-stall + retry-pressure + elastic "
+                         "lease/rejoin view")
     ap.add_argument("--distributed", action="store_true",
                     help="stitch N process journals by trace id; print "
                          "the cross-process tree + critical path")
