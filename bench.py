@@ -1576,6 +1576,326 @@ def _sharding_measure(jax, pt, layers, batch=64, dim=256, steps=12,
     return report
 
 
+def _lm_serving_scope(pt, layers, models, vocab, d, L, H, tmax, seed=7):
+    """Initialized LM weights for the serving benches (one startup run
+    per seed; callers copy into fresh scopes as needed)."""
+    scope = pt.Scope()
+    exe = pt.Executor(pt.TPUPlace())
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        p = layers.data("p_init", shape=[8], dtype="int64")
+        models.transformer_lm_generate(
+            p, vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+            max_len=tmax, max_new_tokens=1)
+    startup.random_seed = seed
+    exe.run(startup, scope=scope)
+    return scope
+
+
+def bench_multi_tenant(jax, pt, layers, models, vocab=32, d=16, L=2, H=2,
+                       tmax=64, slots=4, page_size=8, n_replicas=2,
+                       jobs_per_thread=8, storm_threads=3):
+    """Multi-tenant serving witness: two resident models ('ranker'
+    greedy, 'chat' seeded-sampled) on one N-replica fleet behind one
+    /v1 surface, under a mixed concurrent storm — per-tenant
+    availability and latency, ZERO steady-state fresh compiles — then
+    an independent tenant roll (a tenant-scoped Publisher publishing a
+    new generation for 'ranker' WHILE 'chat' keeps serving): roll wall
+    time and zero failed requests either side. Host/admission plane:
+    the CPU row is the witness."""
+    import tempfile
+    import threading
+
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu.decoding import SamplingParams
+    from paddle_tpu.online import Publisher
+    from paddle_tpu.serving import Fleet, GenerationEngine, LMSpec
+    from paddle_tpu.serving.tenancy import ModelRegistry, MultiTenantServer
+
+    spec = LMSpec(vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+                  max_len=tmax)
+    weights = {}
+
+    def scope_for(seed):
+        if seed not in weights:
+            s = _lm_serving_scope(pt, layers, models, vocab, d, L, H,
+                                  tmax, seed=seed)
+            weights[seed] = {n: s.get(n) for n in s.keys()}
+        scope = pt.Scope()
+        for n, v in weights[seed].items():
+            scope.set(n, v)
+        return scope
+
+    def engine(seed):
+        eng = GenerationEngine(spec, scope_for(seed), slots=slots,
+                               page_size=page_size, kv_cache="paged",
+                               prompt_buckets=(8,),
+                               prefill_batch_buckets=(1, 2, 4))
+        eng.warmup()
+        return eng
+
+    servers = []
+    for _ in range(n_replicas):
+        reg = ModelRegistry()
+        reg.register("ranker", [engine(7)])
+        reg.register("chat", [engine(13)],
+                     sampling=SamplingParams(temperature=0.7, top_k=8,
+                                             seed=5))
+        srv = MultiTenantServer(reg)
+        srv.start()
+        servers.append(srv)
+    fleet = Fleet(servers, hedge=False, default_timeout_ms=60_000)
+
+    def fresh_compiles():
+        return sum(e.cache_stats()["misses"]
+                   for srv in servers for t in srv.registry
+                   for e in t.engines)
+
+    lock = threading.Lock()
+    lat = {"ranker": [], "chat": []}
+    errors = []
+
+    def storm(model, n, seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            prompt = rng.randint(1, vocab, (3,)).tolist()
+            t0 = time.perf_counter()
+            try:
+                fleet.submit({"prompt": prompt}, model=model,
+                             max_new_tokens=6).result(timeout=60)
+                with lock:
+                    lat[model].append(time.perf_counter() - t0)
+            except Exception as exc:  # noqa: BLE001 - availability
+                with lock:
+                    errors.append(repr(exc)[:100])
+
+    def run_storm():
+        threads = [threading.Thread(
+            target=storm, args=(["ranker", "chat"][i % 2],
+                                jobs_per_thread, 100 + i))
+            for i in range(storm_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def pq(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(
+            xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))] * 1e3, 2)
+
+    with fleet:
+        storm("ranker", 2, 0)   # touch every replica once
+        storm("chat", 2, 1)
+        for m in lat:
+            lat[m].clear()
+        misses0 = fresh_compiles()
+        wall = run_storm()
+        storm_compiles = fresh_compiles() - misses0
+
+        # independent tenant roll under live traffic on the OTHER tenant
+        with tempfile.TemporaryDirectory() as ck:
+            ckpt.save_checkpoint(ck, scope=scope_for(99), step=5)
+            pub = Publisher(fleet, ck, verify=False, pin=False,
+                            tenant="ranker")
+            chat_jobs = threading.Thread(
+                target=storm, args=("chat", 2 * jobs_per_thread, 200))
+            chat_jobs.start()
+            t0 = time.perf_counter()
+            rolled = pub.poll_once()
+            roll_wall = time.perf_counter() - t0
+            chat_jobs.join()
+        snap = fleet.metrics.snapshot().get("labeled", {})
+    total = sum(len(v) for v in lat.values())
+    return {
+        "replicas": n_replicas, "tenants": 2,
+        "storm_wall_s": round(wall, 3),
+        "failed": len(errors),
+        "fresh_compiles_storm": storm_compiles,
+        "ranker": {"ok": len(lat["ranker"]), "p50_ms": pq(lat["ranker"], 0.5),
+                   "p99_ms": pq(lat["ranker"], 0.99)},
+        "chat": {"ok": len(lat["chat"]), "p50_ms": pq(lat["chat"], 0.5),
+                 "p99_ms": pq(lat["chat"], 0.99)},
+        "roll": {"published_step": rolled,
+                 "wall_s": round(roll_wall, 3),
+                 "weights_version_ranker": snap.get(
+                     "weights_version", {}).get('{tenant="ranker"}'),
+                 "weights_version_chat": snap.get(
+                     "weights_version", {}).get('{tenant="chat"}', 0.0)},
+        "availability": round(total / max(1, total + len(errors)), 4),
+    }
+
+
+def bench_disagg(jax, pt, layers, models, vocab=64, d=32, L=2, H=4,
+                 tmax=256, page_size=16, slots=6,
+                 n_long=8, n_short=16, long_len=96, short_len=8,
+                 long_new=4, short_new=32, slo_factor=3.0):
+    """Prefill/decode disaggregation A/B at EQUAL engine count: a
+    unified 2-engine pool vs a 1 prefill + 1 decode split
+    (``DisaggEngine``) serving the same interference workload — long
+    prompts (prefill-heavy) storming alongside short decode-heavy
+    requests. Judged on goodput, not QPS: the SLO budget is
+    ``slo_factor`` x each class's unloaded latency, and the metric is
+    the SLO-good fraction of the decode-heavy class (the one a prefill
+    burst stalls in a unified pool) plus decode TPOT p95. Byte-identity
+    of the handoff and zero prefill recompute are asserted in-bench.
+    Host/cache-migration plane: the CPU row is the witness."""
+    import threading
+
+    from paddle_tpu.serving import (DisaggEngine, GenerationEngine,
+                                    LMSpec, Server)
+    from paddle_tpu.serving.batcher import Request
+
+    spec = LMSpec(vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+                  max_len=tmax)
+    base = _lm_serving_scope(pt, layers, models, vocab, d, L, H, tmax)
+    weights = {n: base.get(n) for n in base.keys()}
+
+    def scope():
+        s = pt.Scope()
+        for n, v in weights.items():
+            s.set(n, v)
+        return s
+
+    kw = dict(slots=slots, page_size=page_size,
+              prompt_buckets=(short_len, long_len),
+              prefill_batch_buckets=(1, 2, 4))
+
+    rng = np.random.RandomState(0)
+    longs = [rng.randint(1, vocab, (long_len,)).astype("int64")
+             for _ in range(n_long)]
+    shorts = [rng.randint(1, vocab, (short_len,)).astype("int64")
+              for _ in range(n_short)]
+
+    # -- correctness gate: handoff byte-identical, zero prefill recompute
+    uni_ref = GenerationEngine(spec, scope(), kv_cache="paged", **kw)
+    want = uni_ref.generate_all([p.tolist() for p in shorts[:4]],
+                                max_new_tokens=short_new)
+    dis_ref = DisaggEngine.build(spec, prefill_replicas=1,
+                                 decode_replicas=1, scope=scope(), **kw)
+    reqs = [Request({"prompt": p.tolist()},
+                    {"max_new_tokens": short_new}, None)
+            for p in shorts[:4]]
+    dis_ref._drive(reqs)
+    byte_identical = all(
+        np.array_equal(np.asarray(r.future.result(timeout=0)), w)
+        for r, w in zip(reqs, want))
+    decode_counters = dis_ref.decode.engines[0].metrics.snapshot()[
+        "counters"]
+    zero_prefill_recompute = decode_counters.get("prefills", 0) == 0 \
+        and decode_counters.get("kv_handoffs_in", 0) == len(reqs)
+
+    # SLO calibration: each class's unloaded steady-state latency on ONE
+    # warmed unified engine; the budget (slo_factor x quiet) is shared
+    # by both legs so the good-fraction comparison is apples-to-apples
+    uni_ref.warmup()
+    quiet = {}
+    for cls, p, n in (("short", shorts[0], short_new),
+                      ("long", longs[0], long_new)):
+        t0 = time.perf_counter()
+        uni_ref.generate_all([p.tolist()], max_new_tokens=n)
+        quiet[cls] = time.perf_counter() - t0
+    budget = {c: slo_factor * q for c, q in quiet.items()}
+
+    # -- the A/B legs -----------------------------------------------------
+    def leg(split):
+        if split:
+            eng = DisaggEngine.build(spec, prefill_replicas=1,
+                                     decode_replicas=1, scope=scope(),
+                                     **kw)
+            engines = eng.engines
+            served = [eng]
+        else:
+            engines = [GenerationEngine(spec, scope(), kv_cache="paged",
+                                        **kw) for _ in range(2)]
+            served = engines
+        for e in engines:
+            e.warmup()
+        srv = Server(served)
+        srv.start()
+        lock = threading.Lock()
+        lat = {"short": [], "long": []}
+        errors = []
+
+        def client(cls, prompts, max_new):
+            for p in prompts:
+                t0 = time.perf_counter()
+                try:
+                    srv.submit({"prompt": p.tolist()},
+                               max_new_tokens=max_new).result(timeout=120)
+                    with lock:
+                        lat[cls].append(time.perf_counter() - t0)
+                except Exception as exc:  # noqa: BLE001 - availability
+                    with lock:
+                        errors.append(repr(exc)[:100])
+
+        try:
+            # prime the submit path once per class, then storm
+            client("short", shorts[:1], short_new)
+            client("long", longs[:1], long_new)
+            for c in lat:
+                lat[c].clear()
+            threads = [
+                threading.Thread(target=client,
+                                 args=("long", longs, long_new)),
+                threading.Thread(target=client,
+                                 args=("short", shorts[:n_short // 2],
+                                       short_new)),
+                threading.Thread(target=client,
+                                 args=("short", shorts[n_short // 2:],
+                                       short_new)),
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            srv.stop()
+        tpots = [r["tpot_s"] for e in engines for r in e._recent
+                 if r.get("tpot_s")]
+        tpots.sort()
+
+        def good(cls):
+            return (round(sum(1 for x in lat[cls] if x <= budget[cls])
+                          / max(1, len(lat[cls])), 4))
+
+        return {
+            "wall_s": round(wall, 3), "failed": len(errors),
+            "slo_good_short": good("short"),
+            "slo_good_long": good("long"),
+            "tpot_p95_ms": (round(
+                tpots[int(0.95 * (len(tpots) - 1))] * 1e3, 3)
+                if tpots else None),
+            "short_p99_ms": (round(sorted(lat["short"])[
+                int(0.99 * (len(lat["short"]) - 1))] * 1e3, 2)
+                if lat["short"] else None),
+        }
+
+    unified = leg(split=False)
+    split = leg(split=True)
+    return {
+        "engines_per_leg": 2,
+        "workload": {"long": {"n": n_long, "prompt": long_len,
+                              "new": long_new},
+                     "short": {"n": n_short, "prompt": short_len,
+                               "new": short_new}},
+        "handoff_byte_identical": byte_identical,
+        "zero_prefill_recompute": zero_prefill_recompute,
+        "slo_budget_ms": {c: round(b * 1e3, 2)
+                          for c, b in budget.items()},
+        "unified": unified,
+        "disagg": split,
+        "slo_good_short_gain": (round(
+            split["slo_good_short"] - unified["slo_good_short"], 4)),
+    }
+
+
 def bench_obs_overhead(jax, pt, layers, models, vocab=64, d=128, L=3, H=4,
                        tmax=256, slots=8, page_size=16, n_requests=24,
                        max_new=24, rounds=5):
@@ -2081,6 +2401,15 @@ def run_bench(platform):
     # (sparse update + publisher are host/HBM-stream planes; the CPU
     # row is the witness, the TPU row prices real HBM scatter rates)
     step("online", bench_online, jax, pt, layers)
+    # multi-tenant serving plane: two resident models behind one /v1
+    # under a mixed storm + an independent tenant roll under live
+    # traffic (host/admission plane; the CPU row is the witness)
+    step("multi_tenant", bench_multi_tenant, jax, pt, layers, models)
+    # prefill/decode disaggregation A/B vs a unified pool at equal
+    # engine count, judged on SLO-good fraction; handoff byte-identity
+    # + zero prefill recompute asserted in-bench (host/cache-migration
+    # plane; the CPU row is the witness)
+    step("disagg", bench_disagg, jax, pt, layers, models)
     # elastic-training chaos relay: zombie fence + crash + rejoin on one
     # master queue — recovery wall + steps retrained + exactly-once +
     # bitwise checks (pure control plane; the CPU row is the witness)

@@ -3,11 +3,14 @@
 :class:`Seq2SeqGenerationEngine` extends the paged continuous batcher
 with the encoder-decoder split:
 
-- **Admission runs the encoder once.** A request carries a SOURCE
-  sentence; admission buckets it, runs ``transformer_encdec_encode``,
-  and parks the per-layer cross-attention K/V in a slot-resident cache
-  ``[L, slots+1, Hkv, Ts, dh]`` (row ``slots`` is scrap) next to the
-  self-attention page pool — the analysis plane prices both.
+- **Admission runs the encoder once — pooled.** A request carries a
+  SOURCE sentence; admission buckets it and QUEUES the encoder pass,
+  and the queue flushes as bucket-padded batches (one
+  ``transformer_encdec_encode`` call per source bucket per admission
+  round, padded to ``encode_batch_buckets``) before anything attends
+  the rows. The per-layer cross-attention K/V parks in a slot-resident
+  cache ``[L, slots+1, Hkv, Ts, dh]`` (row ``slots`` is scrap) next to
+  the self-attention page pool — the analysis plane prices both.
 - **Decode is the paged loop plus one cross read per layer.** The
   decoder is the stacked LM (same weight contract) whose
   ``transformer_stack_cross_decode`` step additionally attends the
@@ -97,6 +100,7 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
     def __init__(self, spec: Seq2SeqSpec, scope=None, *,
                  bos_id: int = 0,
                  src_buckets: Optional[Sequence[int]] = None,
+                 encode_batch_buckets: Optional[Sequence[int]] = None,
                  beam_width: int = 4, **kw):
         self.seq2seq = spec
         self.bos_id = int(bos_id)
@@ -107,6 +111,14 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
         kw.pop("prefix_sharing", None)  # unsound across sources
         super().__init__(spec.lm_spec(), scope, beam_width=beam_width,
                          prefix_sharing=False, **kw)
+        # encoder-pool batching: sources admitted in one admission round
+        # are encoded together, padded to these batch buckets (so the
+        # steady state compiles len(src_buckets) x len(batch buckets)
+        # encode programs and nothing else). (1,) restores the
+        # encode-per-request behavior token-exactly.
+        self.encode_batch_buckets = sorted(set(
+            max(1, min(int(b), self.slots))
+            for b in (encode_batch_buckets or (1, 2, 4, 8))))
 
     # -- cross-KV cache ----------------------------------------------------
     def _init_cache(self):
@@ -125,6 +137,7 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
         self._xrow_ref = np.zeros(self.slots, np.int32)
         self._xrow_len = np.ones(self.slots, np.int32)
         self._encode_progs: Dict[int, tuple] = {}
+        self._pending_encodes: List[tuple] = []  # (xrow, src) queue
         self.metrics.set_gauge(
             "mem/cross_kv_bytes", 2.0 * float(np.prod(shape)) * 4)
 
@@ -339,30 +352,73 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
             if self._xrow_ref[row] == 0:
                 self._xrow_free.append(row)
 
-    def _encode_src(self, row: int, src: np.ndarray) -> None:
-        """The once-per-request encoder pass: bucket the source, run
-        transformer_encdec_encode into cross row ``row``."""
+    def _enc_bucket_for(self, n: int) -> int:
+        for b in self.encode_batch_buckets:
+            if n <= b:
+                return b
+        return self.encode_batch_buckets[-1]
+
+    def _encode_batch(self, ts: int, items) -> None:
+        """One encoder pass for up to a batch bucket of admitted
+        sources: transformer_encdec_encode scatters each source's
+        cross K/V into its row; padding rows target the scrap row."""
         import time
 
         from .. import profiler, trace
 
-        ts = self._src_bucket_for(src.size)
+        nb = self._enc_bucket_for(len(items))
         prog, ok = self._encode_prog(ts)
         feed = {
-            "serving.src": np.full((1, ts), 0, np.int64),
-            "serving.src_n": np.asarray([src.size], np.int32),
-            "serving.src_row": np.asarray([row], np.int32),
+            "serving.src": np.zeros((nb, ts), np.int64),
+            "serving.src_n": np.ones(nb, np.int32),
+            "serving.src_row": np.full(nb, self.slots, np.int32),
         }
-        feed["serving.src"][0, :src.size] = src
+        for i, (row, src) in enumerate(items):
+            feed["serving.src"][i, :src.size] = src
+            feed["serving.src_n"][i] = src.size
+            feed["serving.src_row"][i] = row
         t0 = time.perf_counter()
         with self._device_ctx(), profiler.timer("serving/encode"), \
-                trace.span("serving/encode", src_len=int(src.size),
-                           bucket=ts):
+                trace.span("serving/encode", batch=len(items),
+                           bucket=ts, padded=nb):
             self.executor.run(prog, feed=feed, fetch_list=[ok],
                               scope=self.scope)
         self.metrics.observe_latency(time.perf_counter() - t0,
                                      name="encode")
-        self.metrics.inc("encodes")
+        self.metrics.inc("encodes", len(items))
+        self.metrics.inc("encode_batches")
+
+    def _encode_src(self, row: int, src: np.ndarray) -> None:
+        """Encode ONE source immediately (the pre-batching seam, kept
+        for direct callers); admission queues into ``_pending_encodes``
+        and flushes in buckets instead."""
+        self._encode_batch(self._src_bucket_for(src.size), [(row, src)])
+
+    def _flush_encodes(self) -> None:
+        """Run every queued encoder pass, grouped by source bucket and
+        padded to ``encode_batch_buckets`` — admission stays O(1) and
+        the encoder runs at batch efficiency. MUST complete before any
+        prefill/decode step attends the new cross rows."""
+        if not self._pending_encodes:
+            return
+        pending, self._pending_encodes = self._pending_encodes, []
+        # a request cancelled between admit and flush released its row
+        # (possibly re-taken in the same round): keep only the NEWEST
+        # pending write per still-referenced row, so the scatter never
+        # sees a duplicate or stale SlotId
+        live: Dict[int, np.ndarray] = {}
+        for row, src in pending:
+            if self._xrow_ref[row] > 0:
+                live[row] = src
+        by_ts: Dict[int, list] = {}
+        for row, src in live.items():
+            by_ts.setdefault(self._src_bucket_for(src.size),
+                             []).append((row, src))
+        cap = self.encode_batch_buckets[-1]
+        for ts in sorted(by_ts):
+            group = by_ts[ts]
+            for i in range(0, len(group), cap):
+                self._encode_batch(ts, group[i:i + cap])
 
     def _admit_one(self, req, prompt, max_new, eos, sampling, beam,
                    group) -> str:
@@ -376,8 +432,21 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
         src = req.meta["_src"]
         row = self._take_xrow(src)
         self._slots[slot].xrow = row
-        self._encode_src(row, src)
+        self._pending_encodes.append((row, src))
         return r
+
+    # every path into the device that attends cross rows flushes first
+    def _run_prefill_group(self, group) -> None:
+        self._flush_encodes()
+        super()._run_prefill_group(group)
+
+    def prefill_tick(self) -> bool:
+        self._flush_encodes()
+        return super().prefill_tick()
+
+    def decode_tick(self) -> bool:
+        self._flush_encodes()
+        return super().decode_tick()
 
     # -- beam forks share the cross row ------------------------------------
     def _beam_fork(self, src_slot: int, hold_slot: int,
@@ -393,14 +462,18 @@ class Seq2SeqGenerationEngine(PagedGenerationEngine):
         combos = super().warmup()
         for ts in self.src_buckets:
             prog, ok = self._encode_prog(ts)
-            feed = {"serving.src": np.zeros((1, ts), np.int64),
-                    "serving.src_n": np.ones(1, np.int32),
-                    "serving.src_row": np.full(1, self.slots, np.int32)}
-            with self._device_ctx():
-                self.executor.run(prog, feed=feed, fetch_list=[ok],
-                                  scope=self.scope)
-            combos += 1
-        self.metrics.inc("warmup_compiles", len(self.src_buckets))
+            for nb in self.encode_batch_buckets:
+                feed = {"serving.src": np.zeros((nb, ts), np.int64),
+                        "serving.src_n": np.ones(nb, np.int32),
+                        "serving.src_row": np.full(nb, self.slots,
+                                                   np.int32)}
+                with self._device_ctx():
+                    self.executor.run(prog, feed=feed, fetch_list=[ok],
+                                      scope=self.scope)
+                combos += 1
+        self.metrics.inc("warmup_compiles",
+                         len(self.src_buckets)
+                         * len(self.encode_batch_buckets))
         return combos
 
     def _warm_programs(self):

@@ -69,11 +69,19 @@ class Publisher:
                  weights production is serving survive ``keep_last_n``
                  pruning — a replica restart can always re-load them
                  (default True).
+    tenant:      scope every publish to ONE resident model on
+                 multi-tenant replicas: rolls go through
+                 ``update_weights(tenant=...)`` (only that tenant
+                 drains; the others serve through it) and the freshness
+                 gauges become labeled series
+                 (``weights_version{tenant=...}``). One Publisher per
+                 tenant rolls each model independently.
     """
 
     def __init__(self, fleet, dirname: str, poll_s: float = 0.25,
                  verify: bool = True, min_interval_s: float = 0.0,
-                 accept=None, pin: bool = True):
+                 accept=None, pin: bool = True,
+                 tenant: Optional[str] = None):
         self.fleet = fleet
         self.dirname = str(dirname)
         self.poll_s = float(poll_s)
@@ -81,6 +89,7 @@ class Publisher:
         self.min_interval_s = float(min_interval_s)
         self.accept = accept
         self.pin = bool(pin)
+        self.tenant = tenant
         self.published_step: Optional[int] = None
         self.published_ckpt_time: Optional[float] = None
         self.generations = 0          # successful publishes
@@ -91,7 +100,14 @@ class Publisher:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        fleet.publisher = self
+        if tenant is None:
+            fleet.publisher = self
+        else:
+            # tenant-scoped publishers register per name; the untenanted
+            # fleet.publisher slot stays for the single-model fleet shape
+            if not hasattr(fleet, "tenant_publishers"):
+                fleet.tenant_publishers = {}
+            fleet.tenant_publishers[tenant] = self
 
     # -- watching --------------------------------------------------------
     def _ckpt_time(self, step: int) -> Optional[float]:
@@ -158,8 +174,15 @@ class Publisher:
                 source = self._pinned_source(latest)
                 step = getattr(source, "step", latest)
                 with trace.span("online/publish", step=step,
-                                dirname=self.dirname):
-                    self.fleet.update_weights(source, verify=self.verify)
+                                dirname=self.dirname,
+                                tenant=self.tenant or ""):
+                    # the tenant kwarg only exists on tenant-aware fleets;
+                    # the untenanted call shape stays byte-compatible
+                    if self.tenant is None:
+                        self.fleet.update_weights(source, verify=self.verify)
+                    else:
+                        self.fleet.update_weights(source, verify=self.verify,
+                                                  tenant=self.tenant)
             except Exception as exc:  # noqa: BLE001 - keep serving old
                 payload = os.path.join(self.dirname, f"ckpt-{latest}.npz")
                 if isinstance(exc, FileNotFoundError) \
@@ -196,6 +219,20 @@ class Publisher:
     # -- observability ---------------------------------------------------
     def refresh_gauges(self) -> None:
         m = self.fleet.metrics
+        if self.tenant is not None:
+            # one freshness plane per tenant, as labeled series
+            m.set_labeled("weights_version",
+                          float(self.published_step or 0),
+                          tenant=self.tenant)
+            m.set_labeled("weights_staleness_s",
+                          round(self.staleness_s(), 6),
+                          tenant=self.tenant)
+            if self.published_ckpt_time is not None:
+                m.set_labeled(
+                    "weights_age_s",
+                    round(time.time() - self.published_ckpt_time, 6),
+                    tenant=self.tenant)
+            return
         m.set_gauge("weights_version", float(self.published_step or 0))
         m.set_gauge("weights_staleness_s", round(self.staleness_s(), 6))
         if self.published_ckpt_time is not None:
@@ -206,6 +243,7 @@ class Publisher:
         """The ``weights`` block of ``/fleet/status``."""
         return {
             "dirname": self.dirname,
+            "tenant": self.tenant,
             "published_step": self.published_step,
             "latest_step": self.latest_step(),
             "staleness_s": round(self.staleness_s(), 6),

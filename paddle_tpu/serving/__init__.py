@@ -20,17 +20,30 @@ model becomes a production server loop with
   or remote HTTP) behind a :class:`Router` with per-replica circuit
   breakers, deadline-propagating retries to a different replica,
   tail-latency hedging, typed load shedding, and zero-downtime rolling
-  weight updates (``Fleet.update_weights``).
+  weight updates (``Fleet.update_weights``);
+- :class:`ModelRegistry` / :class:`Tenant` / :class:`MultiTenantServer`
+  — several resident models per replica behind ONE ``/v1`` surface,
+  routed on the request's ``model``/``tenant`` field, with per-tenant
+  sampling defaults, admission quotas, labeled SLO gauges, and
+  tenant-scoped weight rolls (the other tenants serve through them);
+- :class:`DisaggEngine` + :class:`PrefillPool`/:class:`DecodePool` —
+  prefill/decode disaggregation: split engine pools with KV handoff by
+  refcounted page migration (same-process) or serialized page ranges
+  over ``POST /v1/adopt`` (:class:`RemoteDecodeLeg`) — never a prefill
+  recompute.
 
 See demos/serving_lm.py and demos/serving_fleet.py for the end-to-end
 walkthroughs.
 """
 from .batcher import DynamicBatcher, Future, Request
+from .disagg import (DecodePool, DisaggEngine, PrefillPool,
+                     RemoteDecodeLeg)
 from .engine import InferenceEngine, load_param_arrays, swap_scope_params
 from .errors import (BadRequestError, CacheExhaustedError,
                      EngineClosedError, FleetOverloadedError,
-                     QueueFullError, ReplicaUnavailableError,
-                     RequestTimeoutError, ServingError)
+                     ModelNotFoundError, QueueFullError,
+                     ReplicaUnavailableError, RequestTimeoutError,
+                     ServingError)
 from .fleet import Fleet, HttpReplica, LocalReplica, Replica
 from .generation import (GenerationEngine, LMSpec, PagedGenerationEngine,
                          RequestTimeline, spec_from_program_dict)
@@ -39,6 +52,7 @@ from .paging import PagePool, PrefixIndex
 from .router import (CircuitBreaker, LeastLoadedPolicy, RoundRobinPolicy,
                      Router, SessionAffinityPolicy)
 from .server import Server
+from .tenancy import ModelRegistry, MultiTenantServer, Tenant
 
 __all__ = [
     "DynamicBatcher", "Future", "Request",
@@ -49,7 +63,9 @@ __all__ = [
     "Fleet", "Replica", "LocalReplica", "HttpReplica",
     "Router", "CircuitBreaker", "RoundRobinPolicy", "LeastLoadedPolicy",
     "SessionAffinityPolicy", "load_param_arrays", "swap_scope_params",
+    "ModelRegistry", "Tenant", "MultiTenantServer",
+    "DisaggEngine", "PrefillPool", "DecodePool", "RemoteDecodeLeg",
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "BadRequestError", "EngineClosedError", "ReplicaUnavailableError",
-    "FleetOverloadedError", "CacheExhaustedError",
+    "FleetOverloadedError", "CacheExhaustedError", "ModelNotFoundError",
 ]

@@ -35,6 +35,14 @@ class EngineClosedError(ServingError):
     """Submit after the server/engine was stopped."""
 
 
+class ModelNotFoundError(ServingError):
+    """The request named a ``model``/``tenant`` id that no resident
+    model serves. Maps to HTTP 404 on ``/v1/*`` (and ``HttpReplica``
+    maps 404 back to this type) — an unknown id is a routing error, not
+    an overload, so it must never silently fall through to a default
+    engine or be retried against another replica."""
+
+
 class CacheExhaustedError(ServingError):
     """The paged KV cache cannot hold this request: the pages its prompt
     + max_new_tokens need exceed what the pool can EVER free for it.

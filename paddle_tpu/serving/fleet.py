@@ -46,9 +46,9 @@ from ..resilience.faults import TransientFault, active_plan
 from ..resilience.retry import Retry
 from .batcher import Future
 from .errors import (BadRequestError, EngineClosedError,
-                     FleetOverloadedError, QueueFullError,
-                     ReplicaUnavailableError, RequestTimeoutError,
-                     ServingError)
+                     FleetOverloadedError, ModelNotFoundError,
+                     QueueFullError, ReplicaUnavailableError,
+                     RequestTimeoutError, ServingError)
 from .metrics import MetricsRegistry
 from .router import Router
 
@@ -56,8 +56,11 @@ from .router import Router
 FLEET_RETRYABLE = (ConnectionError, TimeoutError, TransientFault,
                    QueueFullError, EngineClosedError,
                    ReplicaUnavailableError)
-#: errors that must escape immediately (bad input, expired deadline)
-FLEET_GIVE_UP = (BadRequestError, RequestTimeoutError)
+#: errors that must escape immediately (bad input, expired deadline,
+#: unknown model/tenant id — every replica serves the same registry, so
+#: retrying a 404 elsewhere only burns attempts)
+FLEET_GIVE_UP = (BadRequestError, RequestTimeoutError,
+                 ModelNotFoundError)
 
 #: fleet-control meta keys never forwarded to the replica's batcher
 _FLEET_META = ("session", "idempotent")
@@ -116,7 +119,7 @@ class Replica:
     def rejoin(self) -> None:
         raise NotImplementedError
 
-    def swap_params(self, source) -> dict:
+    def swap_params(self, source, tenant: Optional[str] = None) -> dict:
         raise NotImplementedError
 
     def warm_verify(self) -> Optional[int]:
@@ -216,12 +219,11 @@ class LocalReplica(Replica):
     def rejoin(self) -> None:
         self.server.resume()
 
-    def swap_params(self, source) -> dict:
-        stats: Dict[str, int] = {}
-        for eng in self.server.engines:
-            for k, v in eng.swap_params(source).items():
-                stats[k] = stats.get(k, 0) + v
-        return stats
+    def swap_params(self, source, tenant: Optional[str] = None) -> dict:
+        # the server owns the swap: a MultiTenantServer scopes it to one
+        # tenant (draining only that tenant's queue/engines); a plain
+        # Server answers tenant-scoped swaps with a typed 404
+        return self.server.swap_params(source, tenant=tenant)
 
     def warm_verify(self) -> Optional[int]:
         warmed = None
@@ -310,6 +312,8 @@ class HttpReplica(Replica):
                 raise RequestTimeoutError(msg) from None
             if exc.code == 400:
                 raise BadRequestError(msg) from None
+            if exc.code == 404:
+                raise ModelNotFoundError(msg) from None
             raise ServingError(msg) from None
         except urllib.error.URLError as exc:
             raise ConnectionError(
@@ -338,6 +342,8 @@ class HttpReplica(Replica):
             for k in GENERATE_META:
                 if meta.get(k) is not None:
                     body[k] = meta[k]
+            if meta.get("model") is not None:
+                body["model"] = meta["model"]
         else:
             path = "/v1/infer"
             body = {"inputs": {k: np.asarray(v).tolist()
@@ -399,10 +405,11 @@ class HttpReplica(Replica):
         self._http("POST", "/admin/resume", {})
         self._draining = False
 
-    def swap_params(self, source) -> dict:
-        return self._http("POST", "/admin/swap",
-                          {"checkpoint_dir": str(source)},
-                          timeout_s=120.0)
+    def swap_params(self, source, tenant: Optional[str] = None) -> dict:
+        body = {"checkpoint_dir": str(source)}
+        if tenant is not None:
+            body["tenant"] = tenant
+        return self._http("POST", "/admin/swap", body, timeout_s=120.0)
 
     def warm_verify(self) -> Optional[int]:
         out = self._http("POST", "/admin/warm", {}, timeout_s=300.0)
@@ -453,8 +460,11 @@ class Fleet:
         # (bucket sums), so attainment/burn are correct fleet-wide
         self.slo_tracker = SLOTracker(slo) if slo is not None else None
         # a paddle_tpu.online.Publisher attaches itself here; /fleet/
-        # status then grows the weights/freshness block
+        # status then grows the weights/freshness block. Tenant-scoped
+        # publishers (Publisher(tenant=...)) register per tenant name
+        # instead, each rolling its tenant independently.
         self.publisher = None
+        self.tenant_publishers: Dict[str, object] = {}
         self.flight = trace.get_recorder()
         self.replicas: List[Replica] = []
         for i, rep in enumerate(replicas):
@@ -769,25 +779,40 @@ class Fleet:
 
     # -- rolling weight updates ------------------------------------------
     def update_weights(self, checkpoint_dir: str, *, verify: bool = True,
-                       drain_timeout: float = 30.0) -> dict:
+                       drain_timeout: float = 30.0,
+                       tenant: Optional[str] = None) -> dict:
         """Zero-downtime rolling param swap: one replica at a time is
         drained (healthz flips to 503, the router stops sending, in-
         flight work finishes), hot-swapped from ``checkpoint_dir`` (a
         resilience checkpoint dir or a ``save_inference_model`` dir —
         same shapes/dtypes, so the warm compile caches survive),
         warm-verified (manifest replay), and rejoined before the next
-        one drains. The rest of the fleet serves throughout."""
+        one drains. The rest of the fleet serves throughout.
+
+        ``tenant=`` narrows the roll to ONE resident model on
+        multi-tenant replicas: the replica stays ready (no whole-server
+        drain) and the server drains just that tenant's queue/engines —
+        the other tenants never see the update."""
         results = []
         for rep in self.replicas:
             t0 = time.monotonic()
             with trace.span("fleet/rolling_update", replica=rep.name,
-                            checkpoint_dir=str(checkpoint_dir)):
-                rep.drain(wait=True, timeout=drain_timeout)
+                            checkpoint_dir=str(checkpoint_dir),
+                            tenant=tenant or ""):
+                if tenant is None:
+                    rep.drain(wait=True, timeout=drain_timeout)
                 try:
-                    swap = rep.swap_params(checkpoint_dir)
+                    # untenanted rolls keep the pre-tenancy call shape so
+                    # single-model replicas (old swap_params signature)
+                    # serve unchanged
+                    if tenant is None:
+                        swap = rep.swap_params(checkpoint_dir)
+                    else:
+                        swap = rep.swap_params(checkpoint_dir, tenant=tenant)
                     warmed = rep.warm_verify() if verify else None
                 finally:
-                    rep.rejoin()
+                    if tenant is None:
+                        rep.rejoin()
             self.metrics.inc("weight_updates")
             results.append({"replica": rep.name, "swap": swap,
                             "warm_verified": warmed,
@@ -807,6 +832,8 @@ class Fleet:
     def _refresh_labels(self) -> None:
         if self.publisher is not None:
             self.publisher.refresh_gauges()
+        for pub in self.tenant_publishers.values():
+            pub.refresh_gauges()
         for rep in self.replicas:
             health = rep.healthz()
             self.metrics.set_labeled(
@@ -861,8 +888,32 @@ class Fleet:
                     if self.slo_tracker is not None else None),
             "weights": (self.publisher.status()
                         if self.publisher is not None else None),
+            # multi-tenant replicas: per-tenant rows (queue/SLO burn/
+            # weights version/pages), merged with any tenant-scoped
+            # publishers — what fleetctl's TENANTS table renders
+            "tenants": self._tenant_rows(),
         }
         return status
+
+    def _tenant_rows(self) -> Optional[list]:
+        rows = None
+        for rep in self.replicas:
+            ts = getattr(getattr(rep, "server", None),
+                         "tenant_status", None)
+            if ts is not None:
+                rows = ts()
+                break
+        if rows is None and not self.tenant_publishers:
+            return None
+        rows = rows or [{"tenant": name}
+                        for name in sorted(self.tenant_publishers)]
+        for row in rows:
+            pub = self.tenant_publishers.get(row.get("tenant"))
+            if pub is not None:
+                row["weights"] = pub.status()
+                if pub.published_step is not None:
+                    row["weights_version"] = float(pub.published_step)
+        return rows
 
     def _slo_view(self, merged: dict) -> dict:
         """What the SLO evaluates: the fleet-merged decode histograms +
@@ -983,6 +1034,8 @@ class Fleet:
                     self._send(429, {"error": str(exc)})
                 except (RequestTimeoutError, TimeoutError) as exc:
                     self._send(504, {"error": str(exc) or "timed out"})
+                except ModelNotFoundError as exc:
+                    self._send(404, {"error": str(exc)})
                 except (EngineClosedError, ServingError) as exc:
                     self._send(503, {"error": str(exc)})
                 except ConnectionError as exc:
@@ -1002,6 +1055,11 @@ class Fleet:
 
                     meta.update({k: req[k] for k in GENERATE_META
                                  if req.get(k) is not None})
+                    model = (req.get("model")
+                             if req.get("model") is not None
+                             else req.get("tenant"))
+                    if model is not None:
+                        meta["model"] = model
                     payload = ({"src": req["src"],
                                 "prompt": req.get("prompt")}
                                if req.get("src") is not None
@@ -1042,7 +1100,8 @@ class Fleet:
                 elif self.path == "/fleet/update_weights":
                     out = fleet.update_weights(
                         req["checkpoint_dir"],
-                        verify=req.get("verify", True))
+                        verify=req.get("verify", True),
+                        tenant=req.get("tenant"))
                     self._send(200, out)
                 elif self.path == "/fleet/chaos":
                     from ..resilience.faults import (FaultPlan,

@@ -245,6 +245,7 @@ class GenerationEngine:
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  place=None, metrics: Optional[MetricsRegistry] = None,
                  mem_budget: Optional[float] = None,
+                 namespace: str = "",
                  kv_cache: Optional[str] = None):
         if kv_cache not in (None, "dense", "paged"):
             raise ValueError(f"kv_cache must be 'paged' or 'dense', "
@@ -271,6 +272,10 @@ class GenerationEngine:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
+        # compile-cache/manifest namespace: a registry hosting several
+        # resident models against ONE artifact directory keeps each
+        # tenant's warmup manifest under its own filename
+        self.namespace = str(namespace or "")
         self._place = place
         self.metrics = metrics or MetricsRegistry()
         # flight recorder: live engine state + last-N request timelines
@@ -537,6 +542,20 @@ class GenerationEngine:
         progs.extend(self._prefill_prog(tp)[0] for tp in self.prompt_buckets)
         return progs
 
+    @property
+    def manifest_name(self) -> str:
+        """Warmup-manifest filename, namespaced per tenant: several
+        resident models sharing one artifact directory each persist
+        their own signature set instead of clobbering a global file."""
+        from ..core.manifest import MANIFEST_NAME
+
+        if not self.namespace:
+            return MANIFEST_NAME
+        stem, dot, ext = MANIFEST_NAME.rpartition(".")
+        if not dot:
+            return f"{MANIFEST_NAME}.{self.namespace}"
+        return f"{stem}.{self.namespace}.{ext}"
+
     def save_manifest(self, dirname: Optional[str] = None) -> Optional[str]:
         """Persist the compiled (prefill x batch bucket, decode)
         signature set next to the saved model for AOT replay on the next
@@ -545,7 +564,8 @@ class GenerationEngine:
         if dirname is None or len(self.executor.manifest) == 0:
             return None
         try:
-            return self.executor.manifest.save(dirname)
+            return self.executor.manifest.save(dirname,
+                                               name=self.manifest_name)
         except OSError:  # read-only artifact volume: serving still works
             return None
 
@@ -560,7 +580,7 @@ class GenerationEngine:
         dirname = dirname or self.model_dir
         if dirname is None:
             return None
-        manifest = manifest_mod.try_load(dirname)
+        manifest = manifest_mod.try_load(dirname, name=self.manifest_name)
         if manifest is None:
             return None
         if self._needs_scope_rng():
@@ -908,6 +928,7 @@ class PagedGenerationEngine(GenerationEngine):
                  prefill_chunk: Optional[int] = None,
                  prefix_sharing: bool = True,
                  beam_width: int = 0, mask_plane: bool = True,
+                 share_cache_with: Optional["PagedGenerationEngine"] = None,
                  kv_cache: Optional[str] = None, **kw):
         if kv_cache not in (None, "paged"):
             raise ValueError(
@@ -922,6 +943,10 @@ class PagedGenerationEngine(GenerationEngine):
         self._n_pages_arg = n_pages
         self._prefill_chunk_arg = prefill_chunk
         self._prefix_sharing = bool(prefix_sharing)
+        # disaggregation: a decode-pool engine built on the PREFILL
+        # engine's scope adopts its page pool/prefix index — a KV
+        # handoff between the two is then a pure slot-table transfer
+        self._share_cache_src = share_cache_with
         # beam_width > 0 compiles the TopV/TopI (emit_topk) plane into
         # the decode/prefill programs; beam requests up to this width
         # then ride the one steady-state compile
@@ -939,15 +964,32 @@ class PagedGenerationEngine(GenerationEngine):
         from .paging import PagePool, PrefixIndex
 
         s = self.spec
-        self.page_size = int(self._page_size_arg or min(64, self.tmax))
+        src = self._share_cache_src
+        if src is not None:
+            if self.scope is not src.scope:
+                raise ValueError(
+                    "share_cache_with requires constructing this engine "
+                    "on the source engine's scope — the page tensors "
+                    "live there")
+            if s != src.spec or self.tmax != src.tmax:
+                raise ValueError(
+                    "share_cache_with requires an identical LMSpec and "
+                    "max_seq_len — the page geometry and weight contract "
+                    "must match for a block table to transfer")
+            self.page_size = src.page_size
+        else:
+            self.page_size = int(self._page_size_arg
+                                 or min(64, self.tmax))
         # table width: enough entries for a full-context sequence
         self.pmax = -(-self.tmax // self.page_size)
         # beam engines default to a bigger pool: K fully-diverged
         # hypotheses can each hold a full table plus a COW spare
         beam_extra = (self.slots + 2 * self.beam_width
                       if getattr(self, "beam_width", 0) else 0)
-        self.n_pages = int(self._n_pages_arg
-                           or self.slots * self.pmax + 1 + beam_extra)
+        self.n_pages = (src.n_pages if src is not None
+                        else int(self._n_pages_arg
+                                 or self.slots * self.pmax + 1
+                                 + beam_extra))
         if self.n_pages < 2:
             raise ValueError("need at least 2 pages (one is scrap)")
         chunk = self._prefill_chunk_arg
@@ -958,9 +1000,13 @@ class PagedGenerationEngine(GenerationEngine):
         self._chunk_widths = sorted(
             {b for b in self.prompt_buckets if b <= self.prefill_chunk}
             | {self.prefill_chunk})
-        self.pool = PagePool(self.n_pages, self.page_size)
-        self.prefix_index = (PrefixIndex(self.pool)
-                             if self._prefix_sharing else None)
+        if src is not None:
+            self.pool = src.pool
+            self.prefix_index = src.prefix_index
+        else:
+            self.pool = PagePool(self.n_pages, self.page_size)
+            self.prefix_index = (PrefixIndex(self.pool)
+                                 if self._prefix_sharing else None)
         # no scrap SLOT here — padding/vacant rows write the scrap PAGE,
         # so the decode batch is exactly the slot count
         self._nslots = self.slots
@@ -973,8 +1019,11 @@ class PagedGenerationEngine(GenerationEngine):
                                   # requests without an explicit seed)
         shape = (s.n_layers, self.n_pages, s.kv_heads, self.page_size,
                  s.head_dim)
-        self.scope.set(PAGED_CACHE_K, jnp.zeros(shape, jnp.float32))
-        self.scope.set(PAGED_CACHE_V, jnp.zeros(shape, jnp.float32))
+        if src is None:
+            self.scope.set(PAGED_CACHE_K, jnp.zeros(shape, jnp.float32))
+            self.scope.set(PAGED_CACHE_V, jnp.zeros(shape, jnp.float32))
+        # shared-pool engines never re-zero: the scope tensors already
+        # hold the source pool's live pages
         self._page_copy_prog_cache = None
         self.metrics.set_gauge("mem/kv_cache_bytes",
                                2.0 * float(np.prod(shape)) * 4)
@@ -1406,6 +1455,23 @@ class PagedGenerationEngine(GenerationEngine):
         fails, typed. A beam request claims ``beam_size`` slots (parent
         plus holds its hypotheses fork into). Returns the number
         admitted to a slot."""
+        hand = [r for r in requests
+                if isinstance(r.payload, dict)
+                and r.payload.get("handoff") is not None]
+        adopted = 0
+        if hand:
+            # cross-process KV migration: the payload carries serialized
+            # page ranges + the block table; installation writes the
+            # bytes and resumes decode — never a prefill recompute
+            from .disagg import install_serialized_handoff
+
+            for req in hand:
+                if install_serialized_handoff(self, req):
+                    adopted += 1
+            requests = [r for r in requests if r not in hand]
+            if not requests:
+                self._gauges()
+                return adopted
         todo = []
         for req in requests:
             try:
@@ -1415,9 +1481,9 @@ class PagedGenerationEngine(GenerationEngine):
                 req.end_trace(status="bad_request")
                 req.future.set_exception(exc)
         if not todo:
-            return 0
+            return adopted
         group: list = []
-        admitted = 0
+        admitted = adopted
         for item in todo:
             if self._deferred:  # keep FIFO order behind blocked work
                 self._deferred.append(item)
@@ -2011,6 +2077,62 @@ class PagedGenerationEngine(GenerationEngine):
                 self.metrics.inc("prefix_entries_invalidated", dropped)
             self._gauges()
         return stats
+
+    # -- prefill/decode disaggregation: KV handoff -------------------------
+    def handoff_ready(self) -> List[int]:
+        """Slots eligible to migrate to a decode pool: prompt K/V fully
+        cached, next step a plain decode tick. Beam-owned slots stay
+        (their job holds engine-local state) and seq2seq slots stay
+        (their cross-KV row is engine-resident)."""
+        out = []
+        for i in range(self.slots):
+            st = self._slots[i]
+            if st is not None and st.state == "decode" \
+                    and st.role == "normal" and st.beam_job is None \
+                    and getattr(st, "xrow", None) is None:
+                out.append(i)
+        return out
+
+    def export_slot(self, slot: int) -> dict:
+        """Migrate one decoding slot OUT of this engine. The slot-table
+        entry is vacated but the pages keep their refcounts — the
+        returned handoff owns them. Same-process: :meth:`adopt_slot` on
+        an engine built with ``share_cache_with=`` transfers by
+        refcount; cross-process: ``disagg.serialize_handoff`` moves the
+        page bytes. Either way the migration is the block table + pages
+        — never a prefill recompute."""
+        st = self._slots[slot]
+        if st is None or st.state != "decode" or st.beam_job is not None \
+                or getattr(st, "xrow", None) is not None:
+            raise ValueError(f"slot {slot} is not handoff-eligible")
+        self._slots[slot] = None
+        self.metrics.inc("kv_handoffs_out")
+        self.metrics.inc("kv_handoff_pages", len(st.pages))
+        self._gauges()
+        return {"st": st, "tok": int(self._tok[slot]),
+                "pos": int(self._pos[slot]), "pool": self.pool}
+
+    def adopt_slot(self, handoff: dict) -> int:
+        """Install a migrated slot (same-process leg). This engine must
+        share the exporter's page pool (``share_cache_with=``) — the
+        pages' refcounts simply transfer with the block table. Returns
+        the slot index; decode resumes on the next tick, bit-identically
+        (copy-on-write still guards any page the prefix index shares)."""
+        if handoff.get("pool") is not self.pool:
+            raise ValueError(
+                "same-process adoption needs a shared page pool — build "
+                "the decode engine with share_cache_with=<prefill "
+                "engine> (cross-process migration goes through "
+                "disagg.serialize_handoff)")
+        if self.free_slots == 0:
+            raise RuntimeError("no free slot to adopt the handoff into")
+        slot = self._slots.index(None)
+        self._slots[slot] = handoff["st"]
+        self._tok[slot] = handoff["tok"]
+        self._pos[slot] = handoff["pos"]
+        self.metrics.inc("kv_handoffs_in")
+        self._gauges()
+        return slot
 
     # -- server-driver interface ------------------------------------------
     def serve_step(self, batcher,

@@ -99,6 +99,15 @@ class PagePool:
         self._ref[page] = 1
         return page
 
+    def alloc_many(self, n: int) -> List[int]:
+        """Pop ``n`` free pages atomically: either all allocate or a
+        RuntimeError leaves the pool untouched (a multi-page claim — a
+        migrated-in KV handoff — must never half-land)."""
+        if self.available() < n:
+            raise RuntimeError(
+                f"alloc_many({n}) with only {self.available()} available")
+        return [self.alloc() for _ in range(n)]
+
     def incref(self, page: int) -> None:
         if page == SCRAP_PAGE or self._ref[page] < 1:
             raise RuntimeError(f"incref of unallocated page {page}")

@@ -48,7 +48,8 @@ from ..resilience.faults import TransientFault, active_plan
 from ..trace import flight as trace_flight
 from ..trace.slo import SLOTracker
 from .batcher import DynamicBatcher, Future
-from .errors import (BadRequestError, EngineClosedError, QueueFullError,
+from .errors import (BadRequestError, EngineClosedError,
+                     ModelNotFoundError, QueueFullError,
                      RequestTimeoutError, ServingError)
 from .metrics import MetricsRegistry
 
@@ -69,10 +70,15 @@ class Server:
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  default_timeout_ms: Optional[float] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 serve_retry=None, warmup=False, slo=None):
+                 serve_retry=None, warmup=False, slo=None,
+                 model_ids: Sequence[str] = ()):
         self.engines = list(engine) if isinstance(
             engine, (list, tuple)) else [engine]
         self.metrics = metrics or self.engines[0].metrics
+        # ids this replica answers a "model"/"tenant" request field
+        # with; anything else is a typed 404 — an unknown id must never
+        # silently fall through to the default engine
+        self.model_ids = tuple(model_ids)
         self.batcher = batcher or DynamicBatcher(
             buckets=batch_buckets, max_wait_ms=max_wait_ms,
             max_queue=max_queue, default_timeout_ms=default_timeout_ms,
@@ -133,12 +139,14 @@ class Server:
         gracefully releases engines that support ``close``."""
         if drain:
             self._state = "draining"
-            self.batcher.close(drain=True)
+            for b in self._batchers():
+                b.close(drain=True)
             deadline = time.monotonic() + timeout
-            while self.batcher.depth > 0 and time.monotonic() < deadline:
+            while self._queue_depth() > 0 and time.monotonic() < deadline:
                 time.sleep(0.01)
         self._running = False
-        self.batcher.close()  # fail whatever remains (no-op when drained)
+        for b in self._batchers():
+            b.close()  # fail whatever remains (no-op when drained)
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
@@ -179,7 +187,7 @@ class Server:
             return
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            busy = self.batcher.depth > 0 or any(
+            busy = self._queue_depth() > 0 or any(
                 getattr(eng, "active", 0) or getattr(eng, "_inflight", 0)
                 for eng in self.engines)
             if not busy:
@@ -193,9 +201,17 @@ class Server:
         if self._state == "draining" and self._running:
             self._state = "ready"
 
-    def swap_params(self, source, *, strict: bool = True) -> dict:
+    def swap_params(self, source, *, strict: bool = True,
+                    tenant: Optional[str] = None) -> dict:
         """Hot-swap every engine's params (see engine.swap_params);
-        call between :meth:`pause` and :meth:`resume`."""
+        call between :meth:`pause` and :meth:`resume`. ``tenant``
+        scopes the swap on a multi-tenant server; this single-model
+        server answers a tenant-scoped swap with a typed 404."""
+        if tenant is not None:
+            raise ModelNotFoundError(
+                f"unknown tenant {tenant!r}: this replica hosts one "
+                "unnamed model (tenant-scoped swaps need a "
+                "MultiTenantServer)")
         stats: dict = {}
         for eng in self.engines:
             for k, v in eng.swap_params(source, strict=strict).items():
@@ -227,12 +243,28 @@ class Server:
         if self._state == "warming":  # stop() during warmup wins
             self._state = "ready"
 
+    def _batchers(self):
+        """Every admission queue this server owns — one for the base
+        server; one per tenant on a MultiTenantServer."""
+        return [self.batcher]
+
+    def _queue_depth(self) -> int:
+        return sum(b.depth for b in self._batchers())
+
+    def _dispatch_pairs(self):
+        """(engine, batcher) pairs the dispatch loop round-robins. The
+        base server shares ONE admission queue across its engines; a
+        MultiTenantServer pairs each tenant's engines with that
+        tenant's own queue."""
+        return [(eng, self.batcher) for eng in self.engines]
+
     def _loop(self) -> None:
         if self._warmup:
             self._do_warmup()
         idx = 0
         while self._running:
-            engine = self.engines[idx % len(self.engines)]
+            pairs = self._dispatch_pairs()
+            engine, batcher = pairs[idx % len(pairs)]
             idx += 1
             try:
                 plan = active_plan()
@@ -243,10 +275,10 @@ class Server:
                         "serving dispatch loop")
                 if self._serve_retry is not None:
                     did = self._serve_retry.call(
-                        engine.serve_step, self.batcher,
+                        engine.serve_step, batcher,
                         idle_wait_s=_IDLE_WAIT_S)
                 else:
-                    did = engine.serve_step(self.batcher,
+                    did = engine.serve_step(batcher,
                                             idle_wait_s=_IDLE_WAIT_S)
             except Exception as exc:  # noqa: BLE001 - keep dispatching
                 # engine errors fail their requests individually; a crash
@@ -259,7 +291,7 @@ class Server:
             else:
                 if did:
                     self._dispatch_step += 1
-            if not did and len(self.engines) > 1:
+            if not did and len(pairs) > 1:
                 continue  # try the next replica before idling
 
     # -- in-process API ----------------------------------------------------
@@ -273,6 +305,13 @@ class Server:
             raise EngineClosedError(
                 "server is draining (paused for a rolling update); "
                 "route to another replica")
+        model = meta.pop("model", None)
+        if model is not None and model not in self.model_ids:
+            self.metrics.inc("model_not_found")
+            raise ModelNotFoundError(
+                f"unknown model/tenant {model!r}: this replica serves "
+                + (f"{sorted(self.model_ids)}" if self.model_ids
+                   else "one unnamed model"))
         return self.batcher.submit(payload, timeout_ms=timeout_ms, **meta)
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
@@ -290,7 +329,7 @@ class Server:
         for i, eng in enumerate(self.engines):
             if hasattr(eng, "cache_stats"):
                 snap[f"compile_cache/engine{i}"] = eng.cache_stats()
-        snap["queue_depth"] = self.batcher.depth
+        snap["queue_depth"] = self._queue_depth()
         if self.slo_tracker is not None:
             snap["slo"] = self.slo_tracker.publish_gauges(
                 self.metrics, self.slo_tracker.status(snap))
@@ -301,7 +340,7 @@ class Server:
         the registry + serving timers + compile-cache/queue gauges +
         TTFT/TPOT histograms and SLO burn-rate gauges."""
         self.metrics.update_device_gauges()
-        self.metrics.set_gauge("queue_depth", self.batcher.depth)
+        self.metrics.set_gauge("queue_depth", self._queue_depth())
         for i, eng in enumerate(self.engines):
             if hasattr(eng, "cache_stats"):
                 for k, v in eng.cache_stats().items():
@@ -380,7 +419,7 @@ class Server:
                     self._send(200 if state == "ready" else 503, {
                         "ok": state == "ready",
                         "state": state,
-                        "queue": server.batcher.depth,
+                        "queue": server._queue_depth(),
                         "engines": len(server.engines),
                         "engine_states": [getattr(e, "state", "ready")
                                           for e in server.engines],
@@ -427,6 +466,13 @@ class Server:
                         # byte-identical (GENERATE_META names the schema)
                         meta = {k: req[k] for k in GENERATE_META
                                 if req.get(k) is not None}
+                        # multi-tenant routing field ("tenant" is an
+                        # accepted alias); unknown ids are a typed 404
+                        model = (req.get("model")
+                                 if req.get("model") is not None
+                                 else req.get("tenant"))
+                        if model is not None:
+                            meta["model"] = model
                         payload = ({"src": req["src"],
                                     "prompt": req.get("prompt")}
                                    if req.get("src") is not None
@@ -444,6 +490,25 @@ class Server:
                         else:
                             self._send(200,
                                        {"ids": np.asarray(res).tolist()})
+                    elif self.path == "/v1/adopt":
+                        # cross-process KV handoff: the prefill pool
+                        # POSTs serialized page ranges + the block
+                        # table; the engine installs them and resumes
+                        # decode (never a prefill recompute). Blocks
+                        # until generation completes, like /v1/generate.
+                        meta = {}
+                        model = (req.get("model")
+                                 if req.get("model") is not None
+                                 else req.get("tenant"))
+                        if model is not None:
+                            meta["model"] = model
+                        fut = server.submit(
+                            {"handoff": req["handoff"]},
+                            timeout_ms=req.get("timeout_ms"),
+                            **meta, **tmeta)
+                        res = fut.result(timeout=req.get("timeout_s", 60))
+                        self._send(200,
+                                   {"ids": np.asarray(res).tolist()})
                     elif self.path == "/v1/infer":
                         inputs = {k: np.asarray(v)
                                   for k, v in req["inputs"].items()}
@@ -465,6 +530,8 @@ class Server:
                     self._send(429, {"error": str(exc)})
                 except (RequestTimeoutError, TimeoutError) as exc:
                     self._send(504, {"error": str(exc) or "timed out"})
+                except ModelNotFoundError as exc:
+                    self._send(404, {"error": str(exc)})
                 except (EngineClosedError, ServingError) as exc:
                     self._send(503, {"error": str(exc)})
 
@@ -481,7 +548,8 @@ class Server:
                 elif self.path == "/admin/swap":
                     stats = server.swap_params(
                         req["checkpoint_dir"],
-                        strict=req.get("strict", True))
+                        strict=req.get("strict", True),
+                        tenant=req.get("tenant"))
                     self._send(200, stats)
                 elif self.path == "/admin/warm":
                     warmed = 0

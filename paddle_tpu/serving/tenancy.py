@@ -1,0 +1,372 @@
+"""Multi-tenant model serving: several resident models, one ``/v1``.
+
+One replica process often has room for more than one model (or more
+than one weight generation of the same model) — small rerankers riding
+next to the headline LM, or a canary generation serving 5% of traffic.
+This module is the composition layer that makes that a first-class
+deployment shape instead of N separate ports:
+
+- :class:`Tenant` — one resident model: its engines, its OWN admission
+  queue (quota = the queue bound, so per-tenant backpressure is the
+  same typed :class:`~.errors.QueueFullError` contract as everywhere
+  else), its own :class:`~paddle_tpu.trace.slo.SLOTracker`, sampling
+  defaults, and compile-cache/warmup-manifest namespace (the engine's
+  ``namespace`` → ``warmup_manifest.<tenant>.json``, so tenants warm
+  and verify independently).
+- :class:`ModelRegistry` — the name -> Tenant map behind the request's
+  ``model``/``tenant`` field. Unknown names are a typed
+  :class:`~.errors.ModelNotFoundError` (HTTP 404), never a silent
+  fall-through to the default model.
+- :class:`MultiTenantServer` — a :class:`~.server.Server` whose
+  dispatch loop round-robins (engine, tenant-queue) pairs, so one
+  tenant's burst queues against ITS quota while the others keep their
+  latency. Tenant-scoped rolling updates
+  (``swap_params(tenant=...)``) drain only that tenant's queue and
+  engines — the other tenants serve straight through the roll.
+
+Per-tenant observability rides the labeled-gauge plane:
+``tenant_queue_depth{tenant=...}``, ``weights_version{tenant=...}``,
+and — via ``SLOTracker.publish_gauges(..., tenant=...)`` — one full
+SLO burn-rate plane per tenant. ``fleetctl status`` renders the
+per-tenant table from ``/fleet/status``'s ``tenants`` block.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from ..trace.slo import SLOTracker
+from .batcher import DynamicBatcher, Future
+from .errors import EngineClosedError, ModelNotFoundError
+from .metrics import MetricsRegistry
+from .server import Server
+
+
+class Tenant:
+    """One resident model inside a multi-tenant replica.
+
+    name:        the id requests select with their ``model``/``tenant``
+                 field (and the engine's compile-cache/manifest
+                 namespace when the engine doesn't already have one).
+    engines:     the engine (or engines) serving this tenant. They keep
+                 their own Scope/Executor/page pool — tenancy is
+                 composition, not sharing.
+    sampling:    optional :class:`~paddle_tpu.decoding.SamplingParams`
+                 installed as the tenant's engine-wide default (request
+                 fields still win field-by-field).
+    max_pending: admission quota — the bound of the tenant's OWN queue;
+                 beyond it submits fail typed (QueueFullError/429), so
+                 one tenant's burst can never consume another's queue.
+    slo:         optional :class:`~paddle_tpu.trace.slo.SLO` evaluated
+                 over THIS tenant's engine metrics only.
+    weights_dir: checkpoint dir a tenant-scoped Publisher watches
+                 (informational here; the Publisher drives the rolls).
+    """
+
+    def __init__(self, name: str, engines, *, sampling=None,
+                 max_pending: int = 256,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_wait_ms: float = 5.0,
+                 default_timeout_ms: Optional[float] = None,
+                 slo=None, weights_dir: Optional[str] = None):
+        if not name:
+            raise ValueError("a tenant needs a non-empty name")
+        self.name = str(name)
+        self.engines = list(engines) if isinstance(
+            engines, (list, tuple)) else [engines]
+        if not self.engines:
+            raise ValueError(f"tenant {name!r} needs at least one engine")
+        # the tenant's own admission queue: its bound IS the quota
+        self.batcher = DynamicBatcher(
+            buckets=batch_buckets, max_wait_ms=max_wait_ms,
+            max_queue=max_pending, default_timeout_ms=default_timeout_ms,
+            metrics=self.engines[0].metrics)
+        self.max_pending = int(max_pending)
+        self.slo_tracker = SLOTracker(slo) if slo is not None else None
+        self.weights_dir = weights_dir
+        self.paused = False          # tenant-scoped drain (rolling update)
+        self.weights_version = 0.0   # bumped by note_swap / Publisher
+        self.swaps = 0
+        for eng in self.engines:
+            # manifest/compile-cache namespace: tenants on one replica
+            # must not clobber each other's warmup_manifest.json
+            if not getattr(eng, "namespace", ""):
+                eng.namespace = self.name
+        if sampling is not None:
+            for eng in self.engines:
+                vocab = getattr(getattr(eng, "spec", None),
+                                "vocab_size", None)
+                sampling.validate(vocab)
+                eng.default_sampling = sampling
+                # keep the deprecated engine-wide mirrors coherent
+                eng.temperature = float(sampling.temperature)
+                eng.top_k = int(sampling.top_k)
+        self.sampling = sampling
+
+    # -- metrics -----------------------------------------------------------
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self.engines[0].metrics
+
+    def snapshot(self) -> dict:
+        """This tenant's metrics view: one engine's snapshot, or the
+        bucket-sum merge across a multi-engine tenant (the same merge
+        the fleet uses, so SLO attainment stays exact)."""
+        if len(self.engines) == 1:
+            return self.engines[0].metrics.snapshot()
+        return MetricsRegistry.merge(
+            {f"e{i}": e.metrics.snapshot()
+             for i, e in enumerate(self.engines)})
+
+    def active(self) -> int:
+        return sum(getattr(e, "active", 0) for e in self.engines)
+
+    def pages_in_use(self) -> int:
+        return sum(e.pool.pages_in_use() for e in self.engines
+                   if getattr(e, "pool", None) is not None)
+
+    def note_swap(self, source) -> None:
+        """Record a completed weight swap: the version gauge follows the
+        checkpoint step when the source carries one (a Publisher's
+        pinned generation), else a monotonic roll counter."""
+        self.swaps += 1
+        step = getattr(source, "step", None)
+        self.weights_version = (float(step) if step is not None
+                                else float(self.swaps))
+
+    def status(self) -> dict:
+        """One row of the ``tenants`` block on ``/fleet/status``."""
+        snap = self.snapshot()
+        counters = snap.get("counters") or {}
+        slo_status = (self.slo_tracker.status(snap)
+                      if self.slo_tracker is not None else None)
+        max_burn = 0.0
+        if slo_status is not None:
+            for obj in slo_status["objectives"].values():
+                for win in obj["burn"].values():
+                    max_burn = max(max_burn, win["burn_rate"])
+        return {
+            "tenant": self.name,
+            "engines": len(self.engines),
+            "paused": self.paused,
+            "queue_depth": self.batcher.depth,
+            "max_pending": self.max_pending,
+            "active": self.active(),
+            "pages_in_use": self.pages_in_use(),
+            "weights_version": self.weights_version,
+            "completed": int(counters.get("completed", 0)),
+            "failed": int(counters.get("failed", 0)
+                          + counters.get("bad_requests", 0)
+                          + counters.get("timeouts", 0)),
+            "slo": slo_status,
+            "slo_max_burn": round(max_burn, 4),
+            "slo_alerting": bool(slo_status and slo_status["alerting"]),
+        }
+
+
+class ModelRegistry:
+    """Name -> :class:`Tenant` map — the routing table behind the
+    request's ``model``/``tenant`` field. The first registered tenant
+    is the default (requests without a model field); an unknown name is
+    a typed :class:`ModelNotFoundError`, by contract never a fallback."""
+
+    def __init__(self):
+        self._tenants: "OrderedDict[str, Tenant]" = OrderedDict()
+
+    def register(self, name: str, engines=None, *,
+                 tenant: Optional[Tenant] = None, **kwargs) -> Tenant:
+        """Add a tenant: either a prebuilt :class:`Tenant` or engines +
+        Tenant kwargs. Duplicate names are an error — re-registering a
+        live tenant would strand its queue."""
+        if name in self._tenants:
+            raise ValueError(f"tenant {name!r} already registered")
+        if tenant is None:
+            if engines is None:
+                raise ValueError("register() needs engines or tenant=")
+            tenant = Tenant(name, engines, **kwargs)
+        elif tenant.name != name:
+            raise ValueError(f"tenant name mismatch: {tenant.name!r} "
+                             f"registered as {name!r}")
+        self._tenants[name] = tenant
+        return tenant
+
+    def get(self, name: str) -> Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            raise ModelNotFoundError(
+                f"unknown model/tenant {name!r}: this replica serves "
+                f"{sorted(self._tenants)}")
+        return t
+
+    def resolve(self, name: Optional[str]) -> Tenant:
+        """The admission-path lookup: None selects the default tenant,
+        anything else must match exactly."""
+        if name is None:
+            return self.default
+        return self.get(name)
+
+    @property
+    def default(self) -> Tenant:
+        if not self._tenants:
+            raise ValueError("empty registry has no default tenant")
+        return next(iter(self._tenants.values()))
+
+    def names(self) -> tuple:
+        return tuple(self._tenants)
+
+    def __iter__(self):
+        return iter(self._tenants.values())
+
+    def __len__(self) -> int:
+        return len(self._tenants)
+
+    def __contains__(self, name) -> bool:
+        return name in self._tenants
+
+
+class MultiTenantServer(Server):
+    """One dispatch loop, N resident models, one ``/v1`` surface.
+
+    Requests route on their ``model``/``tenant`` field into the named
+    tenant's own queue (quota, typed backpressure) and engines; the
+    shared dispatch thread round-robins every (engine, tenant-queue)
+    pair, so tenants share compute fairly but never share a queue.
+    ``swap_params(tenant=...)`` is the tenant-scoped rolling update:
+    only that tenant drains — the others serve through the roll.
+
+    The server's own registry carries the cross-tenant labeled gauges
+    (``tenant_queue_depth{tenant=...}``, ``weights_version{tenant=...}``,
+    per-tenant SLO burn rates); each tenant's engine registry stays its
+    private single-tenant view.
+    """
+
+    def __init__(self, registry: ModelRegistry, *,
+                 metrics: Optional[MetricsRegistry] = None,
+                 serve_retry=None, warmup=False, slo=None):
+        if len(registry) == 0:
+            raise ValueError("a MultiTenantServer needs >= 1 tenant")
+        engines = [eng for t in registry for eng in t.engines]
+        super().__init__(
+            engines, batcher=registry.default.batcher,
+            metrics=metrics or MetricsRegistry(),
+            serve_retry=serve_retry, warmup=warmup, slo=slo,
+            model_ids=registry.names())
+        self.registry = registry
+
+    # -- dispatch plumbing -------------------------------------------------
+    def _batchers(self):
+        return [t.batcher for t in self.registry]
+
+    def _dispatch_pairs(self):
+        return [(eng, t.batcher)
+                for t in self.registry for eng in t.engines]
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, payload, timeout_ms: Optional[float] = None,
+               **meta) -> Future:
+        """Route into the named tenant's queue. ``meta['model']`` (the
+        ``model``/``tenant`` request field) picks the tenant; absent
+        means the default tenant. Unknown ids raise ModelNotFoundError
+        (404) — and a tenant mid-roll answers like a draining replica
+        (EngineClosedError), which the fleet retries elsewhere."""
+        if self._paused:
+            raise EngineClosedError(
+                "server is draining (paused for a rolling update); "
+                "route to another replica")
+        model = meta.pop("model", None)
+        try:
+            tenant = self.registry.resolve(model)
+        except ModelNotFoundError:
+            self.metrics.inc("model_not_found")
+            raise
+        if tenant.paused:
+            raise EngineClosedError(
+                f"tenant {tenant.name!r} is draining for a rolling "
+                "update on this replica; route to another replica")
+        return tenant.batcher.submit(payload, timeout_ms=timeout_ms,
+                                     **meta)
+
+    # -- tenant-scoped rolling updates -------------------------------------
+    def pause_tenant(self, name: str, wait: bool = True,
+                     timeout: float = 30.0) -> Tenant:
+        """Drain ONE tenant: its submits start failing retryable, its
+        queue and engines run dry; every other tenant keeps serving on
+        the same dispatch thread. The safe point for a tenant-scoped
+        ``swap_params``."""
+        tenant = self.registry.get(name)
+        tenant.paused = True
+        if wait:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if tenant.batcher.depth == 0 and tenant.active() == 0:
+                    break
+                time.sleep(0.005)
+        return tenant
+
+    def resume_tenant(self, name: str) -> None:
+        self.registry.get(name).paused = False
+
+    def swap_params(self, source, *, strict: bool = True,
+                    tenant: Optional[str] = None) -> dict:
+        """Hot-swap params. With ``tenant=`` this is the whole
+        tenant-scoped roll — drain that tenant, swap its engines, note
+        the new generation, resume — while other tenants serve
+        uninterrupted (their queues never pause, their compiled
+        programs and KV pages are untouched). Without ``tenant`` every
+        engine swaps; the caller owns the whole-server drain, exactly
+        like the base class."""
+        if tenant is None:
+            stats = super().swap_params(source, strict=strict)
+            for t in self.registry:
+                t.note_swap(source)
+            return stats
+        t = self.pause_tenant(tenant)
+        try:
+            stats: Dict[str, int] = {}
+            for eng in t.engines:
+                for k, v in eng.swap_params(source,
+                                            strict=strict).items():
+                    stats[k] = stats.get(k, 0) + v
+            t.note_swap(source)
+            self.metrics.inc("tenant_swaps")
+        finally:
+            self.resume_tenant(tenant)
+        return stats
+
+    # -- observability -----------------------------------------------------
+    def publish_tenant_gauges(self) -> None:
+        """Export every tenant's plane as labeled series on the shared
+        registry: queue/active/pages/weights gauges plus — when the
+        tenant declares an SLO — its full burn-rate plane
+        (``slo_burn_rate{objective=...,tenant=...,window=...}``)."""
+        for t in self.registry:
+            self.metrics.set_labeled("tenant_queue_depth",
+                                     t.batcher.depth, tenant=t.name)
+            self.metrics.set_labeled("tenant_active_slots", t.active(),
+                                     tenant=t.name)
+            self.metrics.set_labeled("tenant_kv_pages_in_use",
+                                     t.pages_in_use(), tenant=t.name)
+            self.metrics.set_labeled("weights_version",
+                                     t.weights_version, tenant=t.name)
+            if t.slo_tracker is not None:
+                t.slo_tracker.publish_gauges(
+                    self.metrics,
+                    t.slo_tracker.status(t.snapshot()),
+                    tenant=t.name)
+
+    def tenant_status(self) -> List[dict]:
+        """The ``tenants`` block of ``/fleet/status`` (and the rows of
+        ``fleetctl status``'s TENANTS table)."""
+        self.publish_tenant_gauges()
+        return [t.status() for t in self.registry]
+
+    def metrics_snapshot(self) -> dict:
+        self.publish_tenant_gauges()
+        snap = super().metrics_snapshot()
+        snap["tenants"] = [t.status() for t in self.registry]
+        return snap
+
+    def metrics_prometheus(self) -> str:
+        self.publish_tenant_gauges()
+        return super().metrics_prometheus()

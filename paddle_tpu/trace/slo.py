@@ -226,24 +226,29 @@ class SLOTracker:
         return {"slo": self.slo.to_dict(), "objectives": objectives,
                 "alerting": alerting}
 
-    def publish_gauges(self, registry, status: Optional[dict] = None) -> dict:
+    def publish_gauges(self, registry, status: Optional[dict] = None,
+                       **labels) -> dict:
         """Export the evaluation as labeled gauges on a MetricsRegistry
         (``slo_attainment{objective=...}``,
         ``slo_error_budget_remaining{...}``,
         ``slo_burn_rate{objective=...,window=...}``,
         ``slo_alerting{...}``) so ``/metrics?format=prom`` carries the
-        whole SLO plane. Returns the status dict it published."""
+        whole SLO plane. Extra ``labels`` ride every series — the
+        multi-tenant registry publishes one burn-rate plane per tenant
+        as ``slo_burn_rate{objective=...,tenant=...,window=...}``.
+        Returns the status dict it published."""
         st = status or self.status()
         for name, obj in st["objectives"].items():
             registry.set_labeled("slo_attainment", obj["attainment"],
-                                 objective=name)
+                                 objective=name, **labels)
             registry.set_labeled("slo_error_budget_remaining",
                                  obj["error_budget_remaining"],
-                                 objective=name)
+                                 objective=name, **labels)
             registry.set_labeled("slo_alerting",
                                  1.0 if obj["alerting"] else 0.0,
-                                 objective=name)
+                                 objective=name, **labels)
             for win, w in obj["burn"].items():
                 registry.set_labeled("slo_burn_rate", w["burn_rate"],
-                                     objective=name, window=win)
+                                     objective=name, window=win,
+                                     **labels)
         return st

@@ -188,6 +188,29 @@ class TestNmtDecode:
         assert max_ref[0] >= 2  # forks really shared the row
         assert int(eng._xrow_ref.sum()) == 0  # and released it
 
+    def test_encoder_pool_batching_token_exact(self):
+        """Satellite pin: sources admitted together encode as bucket-
+        padded BATCHES (fewer encoder passes than sources), and the
+        pooled tokens are byte-identical to the batch-1 path on the
+        same engine — padding rows land in the scrap row, never a live
+        cross-KV row."""
+        rng = np.random.RandomState(17)
+        srcs = [rng.randint(2, VS, (n,)).astype("int64")
+                for n in (6, 9, 11)]
+        eng = _shared_engine()
+        # batch-1 reference: one source per admission round
+        want = [eng.translate([s], max_new_tokens=5)[0] for s in srcs]
+        e0 = eng.metrics.counter("encodes")
+        b0 = eng.metrics.counter("encode_batches")
+        got = eng.translate(srcs, max_new_tokens=5)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        assert eng.metrics.counter("encodes") - e0 == len(srcs)
+        # lengths (6, 9, 11) group as src buckets {8: [6], 16: [9, 11]}
+        assert eng.metrics.counter("encode_batches") - b0 == 2
+        assert eng.pool.pages_in_use() == 0
+        assert int(eng._xrow_ref.sum()) == 0
+
     def test_cross_kv_priced_by_memplan(self):
         """The analysis plane prices the cross-KV slot cache: the
         engine-scope decode target's resident bytes cover the page pool

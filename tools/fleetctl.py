@@ -81,6 +81,25 @@ def render_status_table(status: dict) -> str:
         f"{_fmt_ms(fleet_row.get('ttft_p99_ms')):>10}"
         f"{_fmt_ms(fleet_row.get('tpot_p50_ms')):>10}"
         f"{_fmt_ms(fleet_row.get('tpot_p99_ms')):>10}")
+    tenants = status.get("tenants")
+    if tenants:
+        lines.append("")
+        thead = (f"{'tenant':<12}{'queue':>7}{'active':>8}{'pages':>8}"
+                 f"{'weights':>9}{'slo burn':>10}{'state':>10}")
+        lines.append(thead)
+        lines.append("-" * len(thead))
+        for t in tenants:
+            burn = t.get("slo_max_burn")
+            state = ("paused" if t.get("paused")
+                     else "ALERT" if t.get("slo_alerting") else "ok")
+            lines.append(
+                f"{t.get('tenant', '?'):<12}"
+                f"{t.get('queue_depth', 0):>7}"
+                f"{t.get('active', 0):>8}"
+                f"{t.get('pages_in_use', 0):>8}"
+                f"{t.get('weights_version', 0):>9g}"
+                f"{('-' if burn is None else f'{burn:g}x'):>10}"
+                f"{state:>10}")
     weights = status.get("weights")
     if weights:
         lines.append("")
@@ -136,6 +155,9 @@ def main(argv=None) -> int:
     p.add_argument("checkpoint_dir")
     p.add_argument("--no-verify", action="store_true",
                    help="skip the warm-start manifest verify step")
+    p.add_argument("--tenant", default=None,
+                   help="roll only this tenant on multi-tenant replicas "
+                        "(others keep serving; no replica drains)")
     p = sub.add_parser("chaos",
                        help="install a fault plan, e.g. replica_crash@1")
     p.add_argument("plan")
@@ -151,6 +173,9 @@ def main(argv=None) -> int:
                             "stop/beam fields included)")
     p.add_argument("--prompt", default=None,
                    help="comma-separated prompt token ids")
+    p.add_argument("--model", default=None,
+                   help="model/tenant id on multi-tenant replicas "
+                        "(unknown ids are HTTP 404)")
     p.add_argument("--src", default=None,
                    help="comma-separated SOURCE ids (seq2seq engines)")
     p.add_argument("--max-new-tokens", type=int, default=None)
@@ -187,10 +212,12 @@ def main(argv=None) -> int:
                        {"replica": _replica(args.replica)},
                        timeout=args.timeout)
         elif args.cmd == "update-weights":
+            body = {"checkpoint_dir": args.checkpoint_dir,
+                    "verify": not args.no_verify}
+            if args.tenant is not None:
+                body["tenant"] = args.tenant
             out = call(args.url + "/fleet/update_weights", "POST",
-                       {"checkpoint_dir": args.checkpoint_dir,
-                        "verify": not args.no_verify},
-                       timeout=args.timeout)
+                       body, timeout=args.timeout)
         elif args.cmd == "chaos":
             out = call(args.url + "/fleet/chaos", "POST",
                        {"plan": args.plan}, timeout=args.timeout)
@@ -212,7 +239,8 @@ def main(argv=None) -> int:
             if args.stop is not None:
                 body["stop"] = [[int(t) for t in s.split(",") if t]
                                 for s in args.stop]
-            for flag, key in (("max_new_tokens", "max_new_tokens"),
+            for flag, key in (("model", "model"),
+                              ("max_new_tokens", "max_new_tokens"),
                               ("eos_id", "eos_id"),
                               ("temperature", "temperature"),
                               ("top_k", "top_k"), ("top_p", "top_p"),

@@ -27,7 +27,8 @@ from typing import List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DEMOS = ("quick_start", "serving_lm", "wide_deep", "nmt")
+DEMOS = ("quick_start", "serving_lm", "serving_tenancy", "wide_deep",
+         "nmt")
 
 
 # --------------------------------------------------------------------------
@@ -151,6 +152,29 @@ def build_demo(name: str):
         yield ("serving_lm[paged_decode]", dprog,
                list(eng._decode_feed_names),
                [v.name for v in eng._fetches(douts)], eng.scope)
+    elif name == "serving_tenancy":
+        # the multi-tenant topology: TWO resident models of different
+        # widths registered behind one /v1 surface — each tenant's
+        # paged decode step lints WITH its own scope (its page pool +
+        # block tables priced separately), pinning that two
+        # compile-cache namespaces coexist in one serving process
+        from paddle_tpu.serving import GenerationEngine, LMSpec
+        from paddle_tpu.serving.tenancy import ModelRegistry
+
+        reg = ModelRegistry()
+        for tenant, (vocab, dm) in (("ranker", (97, 32)),
+                                    ("chat", (61, 48))):
+            eng = GenerationEngine(
+                LMSpec(vocab_size=vocab, d_model=dm, n_layers=2,
+                       num_heads=4, max_len=64),
+                slots=4, page_size=16)
+            reg.register(tenant, [eng])
+        for t in reg:
+            eng = t.engines[0]
+            dprog, douts = eng._decode_prog
+            yield (f"serving_tenancy[{t.name}/decode]", dprog,
+                   list(eng._decode_feed_names),
+                   [v.name for v in eng._fetches(douts)], eng.scope)
     elif name == "nmt":
         # the encoder-decoder (seq2seq) topology: the teacher-forced
         # TRAINING graph plus the serving engine's admission-time
