@@ -1231,6 +1231,203 @@ def bench_elastic(jax, pt, layers, n_tasks=4, records_per_task=32,
     }
 
 
+def bench_feedback_loop(jax, pt, layers, vocab=512, n_requests=192,
+                        batch=32, storm_threads=2):
+    """Feedback-loop witness (PR 17): (a) serving-side impression-hook
+    overhead — the hot path is one bounded-deque append per completed
+    request, priced directly against the request's own service time
+    (<1% is the acceptance pin) and cross-checked with an attached-vs-
+    detached request storm A/B; (b) loop freshness under storm — wall
+    time from the first served impression to the trained generation
+    PUBLISHED back into the same live fleet, with the zero-failed-
+    requests count part of the record; (c) the capacity-bounded a2a
+    embedding exchange: modeled interconnect bytes vs the gather path
+    (cut ~= n_shards; bitwise parity is pinned on the CPU mesh in
+    tests/test_feedback.py). Host/control-plane bench: the CPU row is
+    the witness."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import io
+    from paddle_tpu.dataset import ctr
+    from paddle_tpu.feedback import (Compactor, FeedbackHook,
+                                     ImpressionLog, OutcomeJoiner,
+                                     task_reader)
+    from paddle_tpu.master import MasterClient, MasterServer
+    from paddle_tpu.online import Publisher, StreamingTrainer
+    from paddle_tpu.parallel.sharded_embedding import exchange_bytes
+    from paddle_tpu.resilience import CheckpointConfig
+    from paddle_tpu.serving import InferenceEngine
+    from paddle_tpu.serving.fleet import Fleet
+
+    main, startup = pt.Program(), pt.Program()
+    startup.random_seed = 11
+    with pt.program_guard(main, startup):
+        ids_v = layers.data("ids", shape=[ctr.SLOTS], dtype="int64")
+        dense_v = layers.data("dense", shape=[ctr.DENSE_DIM])
+        label_v = layers.data("label", shape=[1])
+        logit = pt.models.wide_deep(ids_v, dense_v, vocab_size=vocab,
+                                    embed_dim=4, hidden_sizes=(8,))
+        loss, prob = pt.models.wide_deep_loss(logit, label_v)
+        sgd = pt.trainer.SGD(
+            loss, pt.optimizer.AdagradOptimizer(learning_rate=0.05),
+            [ids_v, dense_v, label_v], scope=pt.Scope())
+    serve_prog = io.prune_program(main, ["ids", "dense"], [prob.name])
+
+    def engine(seed):
+        scope = pt.Scope()
+        startup.random_seed = seed
+        pt.Executor(pt.TPUPlace()).run(startup, scope=scope)
+        return InferenceEngine(program=serve_prog,
+                               feed_names=["ids", "dense"],
+                               fetch_names=[prob.name], scope=scope,
+                               batch_buckets=(4,), place=pt.CPUPlace())
+
+    workdir = tempfile.mkdtemp(prefix="bench-feedback")
+    log_dir = os.path.join(workdir, "impressions")
+    joined_dir = os.path.join(workdir, "joined")
+    ckdir = os.path.join(workdir, "ck")
+    rng = np.random.RandomState(0)
+    ids_all, dense_all, label_all = ctr._impressions(rng, n_requests,
+                                                     vocab)
+
+    def storm_rows(fleet, n, collect=None):
+        failed = []
+
+        def worker(tid):
+            for i in range(tid, n, storm_threads):
+                try:
+                    fut = fleet.submit({"ids": ids_all[i],
+                                        "dense": dense_all[i]},
+                                       timeout_ms=20_000)
+                    fut.result(timeout=30)
+                    if collect is not None:
+                        collect.append((fut.request_id, i))
+                except Exception as exc:  # noqa: BLE001 - the record
+                    failed.append(repr(exc))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(storm_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, failed
+
+    engines = [engine(3), engine(4)]
+    fleet = Fleet(engines, hedge=False)
+    log = ImpressionLog(log_dir, segment_records=64, flush_s=0.005)
+    joiner = OutcomeJoiner(log_dir, joined_dir, window_s=0.05,
+                           park_ttl_s=30.0, segment_records=64)
+    hook = FeedbackHook(log, joiner=joiner)
+
+    srv = MasterServer(timeout_s=30, port=0)
+    addr = srv.start()
+    with fleet:
+        for eng in engines:
+            eng.run({"ids": np.zeros((1, ctr.SLOTS), np.int64),
+                     "dense": np.ones((1, ctr.DENSE_DIM), np.float32)})
+        # (a) hook overhead: detached baseline vs attached storm, plus
+        # the direct hot-path price of on_served itself
+        plain_s, f0 = storm_rows(fleet, n_requests)
+        fleet.attach_feedback(hook)
+        t_loop0 = time.time()
+        served = []
+        hooked_s, f1 = storm_rows(fleet, n_requests, collect=served)
+        row = {"ids": ids_all[0], "dense": dense_all[0]}
+        res = [np.zeros((1, 1), np.float32)]
+        scratch = ImpressionLog(os.path.join(workdir, "scratch"),
+                                segment_records=4096, flush_s=60.0)
+        scratch_hook = FeedbackHook(scratch)
+        reps, t0 = 2000, time.perf_counter()
+        for i in range(reps):
+            scratch_hook.on_served(f"bench-{i}", row, res)
+        hook_us = (time.perf_counter() - t0) / reps * 1e6
+        scratch.close()
+        req_ms_plain = plain_s / n_requests * 1e3
+        req_ms_hooked = hooked_s / n_requests * 1e3
+
+        # (b) the loop closes under storm: join -> feed -> train ->
+        # publish, while background traffic keeps hitting the fleet
+        log.seal()
+        for rid, i in served:
+            if label_all[i, 0] > 0.5:
+                joiner.post_outcome(rid, 1.0)
+        joiner.poll_once()
+        time.sleep(0.1)
+        joiner.poll_once()
+        joiner.seal()
+        stop = threading.Event()
+        bg_failed, bg_served = [], [0]
+
+        def bg_storm():
+            while not stop.is_set():
+                try:
+                    fleet.submit({"ids": ids_all[0],
+                                  "dense": dense_all[0]},
+                                 timeout_ms=10_000).result(timeout=15)
+                    bg_served[0] += 1
+                except Exception as exc:  # noqa: BLE001 - the record
+                    bg_failed.append(repr(exc))
+
+        bg = [threading.Thread(target=bg_storm)
+              for _ in range(storm_threads)]
+        for t in bg:
+            t.start()
+        client = MasterClient(addr)
+        comp = Compactor(joined_dir)
+        descs = comp.enqueue(client)
+        st = StreamingTrainer(
+            sgd, addr, task_reader, task_descs=None, batch_size=batch,
+            checkpoint=CheckpointConfig(ckdir, every_n_steps=8,
+                                        background=False),
+            max_passes=1)
+        stats = st.run()
+        pub = Publisher(fleet, ckdir)
+        published = pub.poll_once()
+        freshness_s = time.time() - t_loop0
+        stop.set()
+        for t in bg:
+            t.join()
+        client.close()
+    log.close()
+    srv.stop()
+
+    # (c) capacity-bounded a2a vs gather: modeled exchange bytes for a
+    # merged 4096-row stream of D=16 float32 values over 8 vocab shards
+    n, nmp, width = 4096, 8, 4 + 16 * 4   # id lane + value lanes
+    bw = exchange_bytes(n, nmp, width, capacity_factor=1.0)
+    bw2 = exchange_bytes(n, nmp, width, capacity_factor=2.0)
+    js = joiner.stats()
+    return {
+        "hook_on_served_us": round(hook_us, 2),
+        "request_ms_detached": round(req_ms_plain, 3),
+        "request_ms_attached": round(req_ms_hooked, 3),
+        # the pin: the hot-path append is <1% of the request's own
+        # service time (the storm A/B is the noisy cross-check)
+        "hook_overhead_pct": round(
+            hook_us / 1e3 / req_ms_plain * 100, 3),
+        "storm_ab_delta_pct": round(
+            (hooked_s - plain_s) / plain_s * 100, 2),
+        "storm_failed": len(f0) + len(f1) + len(bg_failed),
+        "loop_examples": js["joined"] + js["expired_negatives"],
+        "loop_joined": js["joined"],
+        "loop_expired_negatives": js["expired_negatives"],
+        "segments_fed": len(descs),
+        "trained_steps": stats["steps"],
+        "published_generation": published,
+        "freshness_s": round(freshness_s, 3),
+        "bg_served_during_train": bg_served[0],
+        "a2a_gather_bytes": bw["gather"],
+        "a2a_bytes_cap1": bw["a2a"],
+        "a2a_bytes_cap2": bw2["a2a"],
+        "a2a_cut_x": round(bw["gather"] / bw["a2a"], 2),
+    }
+
+
 def bench_paged_kv(jax, pt, layers, models, tmax=2048, page_size=64,
                    dense_slots=4, prompt_len=48, max_new=8,
                    n_requests=24, d=32, L=2, H=4, vocab=128,
@@ -2414,6 +2611,11 @@ def run_bench(platform):
     # master queue — recovery wall + steps retrained + exactly-once +
     # bitwise checks (pure control plane; the CPU row is the witness)
     step("elastic", bench_elastic, jax, pt, layers)
+    # closed feedback loop: impression-hook overhead A/B + serve->join->
+    # train->publish freshness under storm + modeled a2a-vs-gather
+    # exchange bytes (host/control-plane bench: the CPU row is the
+    # witness; the a2a bitwise pin lives in tests/test_feedback.py)
+    step("feedback_loop", bench_feedback_loop, jax, pt, layers)
     # one-sharding-plane A/B (single vs dp vs dp x tp): on CPU it spawns
     # the 8-device virtual-mesh child (the witness); the TPU row waits
     # for a multi-chip window — single-chip children skip it

@@ -21,7 +21,8 @@ import numpy as np
 from . import common
 
 __all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
-           "age_table", "movie_categories", "get_movie_title_dict"]
+           "age_table", "movie_categories", "get_movie_title_dict",
+           "ctr_train", "ctr_test", "ctr_vocab_size", "CTR_DENSE_DIM"]
 
 N_USERS = 512
 N_MOVIES = 256
@@ -178,3 +179,71 @@ def test():
     if _real_path():
         return _real_reader(is_test=True)
     return _reader(TEST_SIZE, "movielens-test")
+
+
+# ---------------------------------------------------------------------------
+# CTR impressions through the varlen plane (ROADMAP 4c): each rating
+# becomes one impression whose sparse features are a single VARIABLE-
+# LENGTH id list — the fixed slots (user, gender, age, job, movie) plus
+# every category id and title word, each slot offset into its own
+# disjoint band of one shared vocabulary. The ragged lists flow through
+# reader.bucket_by_length + DataFeeder(pad_to_multiple=...) into an
+# embedding + sequence_pool CTR tower; the label is click/no-click
+# (score >= 4). Works identically off the real ml-1m.zip or the
+# synthetic fallback — tests never touch the network.
+# ---------------------------------------------------------------------------
+
+CTR_DENSE_DIM = 4
+
+
+def _ctr_bands():
+    """(band base offsets, total vocab) for the shared id space."""
+    n_users = max_user_id() + 1
+    n_movies = max_movie_id() + 1
+    n_jobs = max_job_id() + 1
+    n_cats = len(movie_categories())
+    n_title = len(get_movie_title_dict())
+    bases = {}
+    off = 0
+    for name, size in (("user", n_users), ("gender", 2),
+                       ("age", len(age_table)), ("job", n_jobs),
+                       ("movie", n_movies), ("category", n_cats),
+                       ("title", n_title)):
+        bases[name] = off
+        off += size
+    return bases, off
+
+
+def ctr_vocab_size() -> int:
+    return _ctr_bands()[1]
+
+
+def _ctr_reader(base_reader):
+    bases, _ = _ctr_bands()
+
+    def reader():
+        for (uid, gender, age, job, mid, cats, titles,
+             score) in base_reader():
+            ids = [bases["user"] + uid, bases["gender"] + gender,
+                   bases["age"] + age, bases["job"] + job,
+                   bases["movie"] + mid]
+            ids += [bases["category"] + c for c in cats]
+            ids += [bases["title"] + t for t in titles]
+            dense = np.asarray(
+                [age / len(age_table), gender,
+                 len(cats) / 6.0, len(titles) / 8.0], np.float32)
+            label = np.asarray([1.0 if score >= 4.0 else 0.0],
+                               np.float32)
+            yield np.asarray(ids, np.int64), dense, label
+
+    return reader
+
+
+def ctr_train():
+    """Varlen CTR impressions: ``(id_list int64[varlen],
+    dense float32[CTR_DENSE_DIM], click float32[1])`` rows."""
+    return _ctr_reader(train())
+
+
+def ctr_test():
+    return _ctr_reader(test())

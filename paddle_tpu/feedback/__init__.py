@@ -1,0 +1,26 @@
+"""paddle_tpu.feedback: the serving fleet as the online plane's data
+source — serve -> log -> join-outcome -> train -> publish as ONE loop.
+
+- :mod:`.log` — the impression log: a crash-safe, segmented,
+  length-prefixed record log written by a serving-side hook on ``/v1/*``
+  (:class:`FeedbackHook`); bounded buffer + drop counters keep the hook
+  off the serving hot path.
+- :mod:`.join` — the outcome joiner: ``POST /v1/outcome`` keyed by
+  request id, windowed join with TTL'd pending state emitting labeled
+  click/no-click examples; restart-safe by replaying sealed segments
+  against the sealed-output coverage map (never a duplicate example).
+- :mod:`.compact` — the compactor/feeder: sealed joined segments become
+  ``dataset/ctr.py``-format task descs on the master queue, so elastic
+  :class:`~paddle_tpu.online.StreamingTrainer`\\ s train on what the
+  fleet actually served and :class:`~paddle_tpu.online.Publisher` ships
+  the update back.
+"""
+from .compact import Compactor, loop_status, task_desc, task_reader
+from .join import OutcomeJoiner
+from .log import FeedbackHook, ImpressionLog, read_records, sealed_segments
+
+__all__ = [
+    "ImpressionLog", "FeedbackHook", "read_records", "sealed_segments",
+    "OutcomeJoiner", "Compactor", "task_desc", "task_reader",
+    "loop_status",
+]

@@ -1,0 +1,181 @@
+"""Compactor/feeder: sealed joined segments -> master-queue task descs.
+
+The last hop before training: each sealed ``joined-*.ptlog`` becomes ONE
+task desc (``ctrlog:<records>:<path>``) whose :func:`task_reader`
+re-reads the sealed file deterministically — the same
+replay-on-reserve contract as ``dataset/ctr.py`` descs (a desc alone
+regenerates its rows, so master requeue-on-timeout and elastic
+skip-if-covered semantics hold unchanged). Rows come out in the ctr
+feed shape ``(ids int64[SLOTS], dense float32[DENSE_DIM],
+label float32[1])`` so the existing CTR topology trains on them as-is.
+
+Enqueue protocol — the C++ master's ``set_dataset`` REPLACES the queue
+(native/master.cc), so the compactor only feeds when the queue is fully
+drained (todo == pending == 0), and records what it fed in an atomic
+``enqueued.json`` manifest next to the segments: a restarted compactor
+never re-feeds a segment, so a training example enters the master queue
+at most once per feed decision.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .log import read_records, sealed_segments, segment_meta
+
+DESC_PREFIX = "ctrlog"
+
+
+def task_desc(path: str, records: int) -> str:
+    return f"{DESC_PREFIX}:{int(records)}:{path}"
+
+
+def task_reader(desc: str):
+    """Rows of one sealed joined segment, ctr-feed-shaped. A desc is
+    self-sufficient: re-reading the sealed file yields the identical
+    row stream every time (master requeue replays exactly)."""
+    prefix, records, path = desc.split(":", 2)
+    if prefix != DESC_PREFIX:
+        raise ValueError(f"not a {DESC_PREFIX} desc: {desc!r}")
+    n = int(records)
+    for idx, ex in read_records(path):
+        if idx >= n:
+            break
+        feats = ex.get("features") or {}
+        ids = np.asarray(feats.get("ids", []), np.int64).reshape(-1)
+        dense = np.asarray(feats.get("dense", []),
+                           np.float32).reshape(-1)
+        label = np.asarray([ex.get("label", 0.0)], np.float32)
+        yield ids, dense, label
+
+
+class Compactor:
+    """Feed sealed joined segments to a master queue, exactly once.
+
+    joined_dir:  the :class:`~paddle_tpu.feedback.join.OutcomeJoiner`
+                 output directory.
+    state_path:  the durable fed-segment manifest (default
+                 ``<joined_dir>/enqueued.json``; atomic tmp+rename).
+    """
+
+    def __init__(self, joined_dir: str, *,
+                 state_path: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        self.joined_dir = str(joined_dir)
+        self.state_path = state_path or os.path.join(
+            self.joined_dir, "enqueued.json")
+        self.clock = clock
+        self.segments_enqueued = 0
+        self.examples_enqueued = 0
+        self.last_enqueue_t: Optional[float] = None
+        self._enqueued = set()
+        self._load_state()
+
+    def _load_state(self) -> None:
+        try:
+            with open(self.state_path) as fh:
+                state = json.load(fh)
+        except (OSError, ValueError):
+            return
+        self._enqueued = set(state.get("segments", []))
+        self.segments_enqueued = len(self._enqueued)
+        self.examples_enqueued = int(state.get("examples", 0))
+        self.last_enqueue_t = state.get("t")
+
+    def _save_state(self) -> None:
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"segments": sorted(self._enqueued),
+                       "examples": self.examples_enqueued,
+                       "t": self.last_enqueue_t}, fh)
+        os.rename(tmp, self.state_path)
+
+    # -- feeding -------------------------------------------------------
+    def pending_descs(self) -> List[str]:
+        descs = []
+        for path in sealed_segments(self.joined_dir):
+            if os.path.basename(path).startswith("joined-") \
+                    and path not in self._enqueued:
+                try:
+                    n = int(segment_meta(path)["records"])
+                except (OSError, ValueError, KeyError):
+                    n = sum(1 for _ in read_records(path))
+                if n:
+                    descs.append(task_desc(path, n))
+        return descs
+
+    def enqueue(self, client, *, require_drained: bool = True
+                ) -> List[str]:
+        """Feed every not-yet-fed sealed segment as one dataset
+        (set_dataset REPLACES the queue — only safe on a drained one).
+        Returns the descs fed ([] when nothing new or not drained)."""
+        if require_drained:
+            counts = client.counts()
+            if counts.get("todo", 0) or counts.get("pending", 0):
+                return []
+        descs = self.pending_descs()
+        if not descs:
+            return []
+        client.set_dataset(descs)
+        for d in descs:
+            _, n, path = d.split(":", 2)
+            self._enqueued.add(path)
+            self.examples_enqueued += int(n)
+        self.segments_enqueued = len(self._enqueued)
+        self.last_enqueue_t = self.clock()
+        self._save_state()
+        return descs
+
+    def stats(self) -> dict:
+        return {"segments_enqueued": self.segments_enqueued,
+                "examples_enqueued": self.examples_enqueued,
+                "backlog_segments": len(self.pending_descs()),
+                "last_enqueue_t": self.last_enqueue_t}
+
+
+def loop_status(log_dir: str, joined_dir: str,
+                ckpt_dir: Optional[str] = None,
+                clock: Callable[[], float] = time.time) -> dict:
+    """One offline snapshot of loop lag, stage by stage — what
+    ``tools/loopctl.py`` prints and the loop-lag gauges sample:
+
+    - log_lag_s:     age of the newest sealed impression segment
+    - join_lag_s:    age of the newest sealed joined segment
+    - train_lag_s:   age of the newest checkpoint generation
+    - backlog:       sealed-but-unfed segments awaiting the compactor
+    """
+    now = clock()
+
+    def _newest_seal(dirname):
+        ts = []
+        for p in sealed_segments(dirname):
+            try:
+                ts.append(float(segment_meta(p).get("t_sealed") or 0))
+            except (OSError, ValueError):
+                ts.append(os.path.getmtime(p))
+        return max(ts) if ts else None
+
+    status = {"t": now}
+    t_log = _newest_seal(log_dir)
+    status["log_lag_s"] = None if t_log is None else round(now - t_log, 3)
+    t_join = _newest_seal(joined_dir)
+    status["join_lag_s"] = (None if t_join is None
+                            else round(now - t_join, 3))
+    comp = Compactor(joined_dir)
+    status["backlog_segments"] = len(comp.pending_descs())
+    status["examples_enqueued"] = comp.examples_enqueued
+    if ckpt_dir:
+        from .. import checkpoint as ckpt_mod
+
+        step = ckpt_mod.latest_step(ckpt_dir)
+        status["trained_step"] = step
+        if step is not None:
+            info = ckpt_mod._step_info(ckpt_dir, f"ckpt-{step}.npz") or {}
+            t_ck = info.get("timestamp")
+            status["train_lag_s"] = (None if not t_ck
+                                     else round(now - float(t_ck), 3))
+    return status

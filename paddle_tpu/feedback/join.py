@@ -1,0 +1,267 @@
+"""Outcome joiner: windowed impression/outcome join with TTL'd state.
+
+Impressions stream in from the :mod:`.log` sealed segments; outcomes
+arrive via ``POST /v1/outcome`` (-> :meth:`OutcomeJoiner.post_outcome`),
+keyed by request id. Each impression emits EXACTLY ONE labeled example:
+
+- outcome inside the window  -> positive (the outcome's label),
+- window expiry              -> negative (click/no-click semantics),
+- outcome before impression  -> parked with its own TTL, joined the
+  moment the impression lands (out-of-order HTTP arrival is normal),
+- duplicate outcome          -> first wins, counted.
+
+Durability — examples write to ``joined-%06d`` segments in the log.py
+format; ONLY sealed segments are real. Every sealed joined meta carries
+``source``: the exact per-impression-segment record indexes its
+examples cover. On restart the joiner rebuilds coverage from sealed
+metas, discards any ``.open`` joined tail (counted), and re-ingests
+precisely the uncovered impressions — a crash loses the in-memory
+pending window (those impressions re-expire as negatives: bounded,
+counted) but can never emit a training example twice, because coverage
+is committed atomically with the examples it describes.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from .log import (OPEN_SUFFIX, SEALED_SUFFIX, read_records,
+                  scan_segment, sealed_segments, segment_meta,
+                  write_record)
+
+
+class OutcomeJoiner:
+    def __init__(self, log_dir: str, out_dir: str, *,
+                 window_s: float = 30.0,
+                 park_ttl_s: Optional[float] = None,
+                 segment_records: int = 256,
+                 negative_label: float = 0.0,
+                 clock: Callable[[], float] = time.time):
+        self.log_dir = str(log_dir)
+        self.out_dir = str(out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.window_s = float(window_s)
+        self.park_ttl_s = (2.0 * self.window_s if park_ttl_s is None
+                           else float(park_ttl_s))
+        self.segment_records = int(segment_records)
+        self.negative_label = float(negative_label)
+        self.clock = clock
+        # counters
+        self.ingested = 0
+        self.joined = 0              # outcome met impression in-window
+        self.parked_joins = 0        # ... where the outcome came first
+        self.expired_negatives = 0
+        self.duplicate_outcomes = 0
+        self.orphan_outcomes = 0     # parked outcomes whose TTL lapsed
+        self.replayed = 0            # re-ingested after restart
+        self.discarded_open_examples = 0
+        self.torn_source_bytes = 0
+        # state
+        self._lock = threading.RLock()
+        #: rid -> (segment_name, record_idx, record, deadline)
+        self._pending: Dict[str, Tuple[str, int, dict, float]] = {}
+        self._parked: Dict[str, Tuple[dict, float]] = {}
+        self._emitted_rids = set()
+        #: segment_name -> set(record_idx) already durably emitted
+        self._covered: Dict[str, set] = {}
+        self._open_fh = None
+        self._open_path: Optional[str] = None
+        self._open_records = 0
+        self._open_source: Dict[str, list] = {}
+        self._next_seg = 0
+        self._recover()
+
+    # -- restart safety ------------------------------------------------
+    def _recover(self) -> None:
+        for sealed in sorted(glob.glob(
+                os.path.join(self.out_dir, "joined-*" + SEALED_SUFFIX))):
+            stem = os.path.basename(sealed)[
+                len("joined-"):-len(SEALED_SUFFIX)]
+            self._next_seg = max(self._next_seg, int(stem) + 1)
+            try:
+                src = segment_meta(sealed).get("source", {})
+            except (OSError, ValueError):
+                # sealed payload without its meta (crash between the two
+                # renames): its source coverage is unknown — replaying
+                # those impressions would DUPLICATE examples, so recover
+                # coverage from the records themselves
+                src = {}
+                for _, rec in read_records(sealed):
+                    src.setdefault(rec.get("source_segment", ""),
+                                   []).append(rec.get("source_idx", -1))
+            for seg, idxs in src.items():
+                self._covered.setdefault(seg, set()).update(idxs)
+        for torn in sorted(glob.glob(
+                os.path.join(self.out_dir, "joined-*" + OPEN_SUFFIX))):
+            records, _, lost = scan_segment(torn)
+            stem = os.path.basename(torn)[len("joined-"):-len(OPEN_SUFFIX)]
+            self._next_seg = max(self._next_seg, int(stem) + 1)
+            # unsealed examples never reached the training plane: drop
+            # them (counted); their source impressions stay uncovered
+            # and re-ingest, so they are emitted exactly once
+            self.discarded_open_examples += records
+            self.torn_source_bytes += lost
+            os.remove(torn)
+
+    # -- outcome ingress -----------------------------------------------
+    def post_outcome(self, request_id: str, outcome) -> str:
+        """'joined' | 'parked' | 'duplicate'. ``outcome`` is a label
+        number or a dict with a ``label`` field (extra keys ride into
+        the example)."""
+        if isinstance(outcome, dict):
+            label = float(outcome.get("label", 1.0))
+            extra = {k: v for k, v in outcome.items() if k != "label"}
+        else:
+            label = 1.0 if outcome is None else float(outcome)
+            extra = {}
+        with self._lock:
+            if request_id in self._emitted_rids \
+                    or request_id in self._parked:
+                self.duplicate_outcomes += 1
+                return "duplicate"
+            hit = self._pending.pop(request_id, None)
+            if hit is not None:
+                seg, idx, rec, _ = hit
+                self._emit(seg, idx, rec, label, extra,
+                           t_outcome=self.clock())
+                self.joined += 1
+                return "joined"
+            self._parked[request_id] = (
+                {"label": label, "extra": extra, "t": self.clock()},
+                self.clock() + self.park_ttl_s)
+            return "parked"
+
+    # -- impression ingress --------------------------------------------
+    def poll_once(self) -> dict:
+        """Ingest new sealed impression segments, then run expiries.
+        Returns a stats snapshot (what loopctl prints)."""
+        with self._lock:
+            for path in sealed_segments(self.log_dir):
+                seg = os.path.basename(path)
+                covered = self._covered.get(seg, set())
+                for idx, rec in read_records(path):
+                    if idx in covered:
+                        continue
+                    rid = rec.get("rid")
+                    if rid is None or rid in self._emitted_rids \
+                            or rid in self._pending:
+                        continue
+                    self.ingested += 1
+                    if covered:
+                        # this segment already has durable coverage: we
+                        # are re-walking it after a restart
+                        self.replayed += 1
+                    park = self._parked.pop(rid, None)
+                    if park is not None:
+                        out, _ = park
+                        self._emit(seg, idx, rec, out["label"],
+                                   out["extra"], t_outcome=out["t"])
+                        self.joined += 1
+                        self.parked_joins += 1
+                        continue
+                    self._pending[rid] = (
+                        seg, idx, rec, self.clock() + self.window_s)
+            self._expire()
+        return self.stats()
+
+    def _expire(self) -> None:
+        now = self.clock()
+        for rid in [r for r, (_, _, _, d) in self._pending.items()
+                    if d <= now]:
+            seg, idx, rec, _ = self._pending.pop(rid)
+            self._emit(seg, idx, rec, self.negative_label, {},
+                       t_outcome=None)
+            self.expired_negatives += 1
+        for rid in [r for r, (_, d) in self._parked.items()
+                    if d <= now]:
+            self._parked.pop(rid)
+            self.orphan_outcomes += 1
+
+    # -- example egress ------------------------------------------------
+    def _emit(self, seg: str, idx: int, rec: dict, label: float,
+              extra: dict, t_outcome: Optional[float]) -> None:
+        rid = rec.get("rid")
+        self._emitted_rids.add(rid)
+        example = {
+            "rid": rid, "label": float(label),
+            "features": rec.get("features"),
+            "served": rec.get("served"),
+            "model": rec.get("model"),
+            "weights_version": rec.get("weights_version"),
+            "t_impression": rec.get("t"), "t_outcome": t_outcome,
+            "source_segment": seg, "source_idx": idx,
+        }
+        if extra:
+            example["outcome"] = extra
+        if self._open_fh is None:
+            self._open_path = os.path.join(
+                self.out_dir, f"joined-{self._next_seg:06d}{OPEN_SUFFIX}")
+            self._next_seg += 1
+            self._open_fh = open(self._open_path, "wb")
+            self._open_records = 0
+            self._open_source = {}
+        write_record(self._open_fh, example)
+        self._open_fh.flush()
+        self._open_records += 1
+        self._open_source.setdefault(seg, []).append(idx)
+        self._covered.setdefault(seg, set()).add(idx)
+        if self._open_records >= self.segment_records:
+            self._seal_open()
+
+    def _seal_open(self) -> None:
+        fh, self._open_fh = self._open_fh, None
+        if fh is None:
+            return
+        fh.close()
+        path, self._open_path = self._open_path, None
+        sealed = path[:-len(OPEN_SUFFIX)] + SEALED_SUFFIX
+        meta = {"records": self._open_records,
+                "bytes": os.path.getsize(path),
+                "source": {k: sorted(v)
+                           for k, v in self._open_source.items()},
+                "t_sealed": self.clock()}
+        tmp = sealed[:-len(SEALED_SUFFIX)] + ".json.tmp"
+        with open(tmp, "w") as out:
+            json.dump(meta, out)
+        os.rename(path, sealed)          # the commit point
+        os.rename(tmp, sealed[:-len(SEALED_SUFFIX)] + ".json")
+        self._open_records = 0
+        self._open_source = {}
+
+    def seal(self) -> None:
+        """Seal the open joined segment so the compactor can feed it."""
+        with self._lock:
+            self._seal_open()
+
+    def close(self) -> None:
+        self.seal()
+
+    # -- observability -------------------------------------------------
+    def oldest_pending_s(self) -> float:
+        with self._lock:
+            if not self._pending:
+                return 0.0
+            now = self.clock()
+            return max(0.0, now - min(
+                d - self.window_s
+                for (_, _, _, d) in self._pending.values()))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "ingested": self.ingested, "joined": self.joined,
+                "parked_joins": self.parked_joins,
+                "expired_negatives": self.expired_negatives,
+                "duplicate_outcomes": self.duplicate_outcomes,
+                "orphan_outcomes": self.orphan_outcomes,
+                "replayed": self.replayed,
+                "discarded_open_examples":
+                    self.discarded_open_examples,
+                "pending": len(self._pending),
+                "parked": len(self._parked),
+                "oldest_pending_s": round(self.oldest_pending_s(), 6),
+            }

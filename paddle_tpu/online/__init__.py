@@ -23,7 +23,8 @@ freshness SLO on the PR 12 observability plane.
   served generation against retention GC and skips (with a counter) a
   generation GC'd between discovery and load.
 """
+from .lifecycle import SparseLifecycle
 from .publisher import Publisher
 from .trainer import StreamingTrainer
 
-__all__ = ["StreamingTrainer", "Publisher"]
+__all__ = ["StreamingTrainer", "Publisher", "SparseLifecycle"]

@@ -1,0 +1,149 @@
+"""Frequency-adaptive sparse-row lifecycle (ROADMAP 4b).
+
+Real CTR vocabularies are heavy-tailed: most ids are seen a handful of
+times and their embedding rows are noise. The reference stack handled
+this on the parameter server with admit/evict thresholds; here the same
+policy runs host-side on the :class:`~paddle_tpu.online.StreamingTrainer`
+at batch/task boundaries (the device program is untouched — training
+stays bitwise identical for admitted rows):
+
+- **admit-by-touch-count** — a row trains for real only once its id has
+  been seen ``admit_touches`` times; until then the policy resets it to
+  its deterministic init after every step, so a one-off id never leaves
+  noise in the table.
+- **TTL-expire** — an id untouched for ``ttl_steps`` optimizer steps is
+  evicted: row (and any optimizer accumulators) reset to the
+  deterministic init, its touch history dropped. A re-admitted id
+  therefore REINITIALIZES DETERMINISTICALLY — byte-equal to its first
+  admission (the test pin).
+
+``row_init(row_id)`` is a pure function of (seed, row_id); two trainers
+— or one trainer before and after an eviction — produce the identical
+row bytes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class SparseLifecycle:
+    """Admit/evict policy over one sparse table.
+
+    table:         scope name of the [V, D] embedding table.
+    admit_touches: touches before an id's row starts accumulating
+                   training (1 = admit immediately).
+    ttl_steps:     evict an id untouched for this many steps.
+    row_init:      ``fn(row_id) -> [D] np.ndarray`` deterministic init;
+                   default: seeded per-id uniform in [-scale, scale].
+    scale, seed:   parameters of the default ``row_init``.
+    ids_index:     position of the id column in a training row tuple
+                   (ctr rows are ``(ids, dense, label)`` -> 0).
+    """
+
+    def __init__(self, table: str, *, admit_touches: int = 2,
+                 ttl_steps: int = 200,
+                 row_init: Optional[Callable[[int], np.ndarray]] = None,
+                 scale: float = 0.1, seed: int = 0, ids_index: int = 0):
+        self.table = table
+        self.admit_touches = int(admit_touches)
+        self.ttl_steps = int(ttl_steps)
+        self.scale = float(scale)
+        self.seed = int(seed)
+        self.ids_index = int(ids_index)
+        self._row_init = row_init
+        self._dim: Optional[int] = None
+        self._dtype = None
+        #: id -> [touches, last_step, admitted]
+        self._touch: Dict[int, List] = {}
+        self.admitted = 0
+        self.evicted = 0
+        self.suppressed = 0   # pre-admission row resets
+
+    # -- deterministic init --------------------------------------------
+    def row_init(self, row_id: int) -> np.ndarray:
+        if self._row_init is not None:
+            return np.asarray(self._row_init(int(row_id)))
+        rng = np.random.default_rng((self.seed, int(row_id)))
+        return rng.uniform(-self.scale, self.scale,
+                           self._dim).astype(self._dtype or np.float32)
+
+    # -- policy hooks (StreamingTrainer calls these) -------------------
+    def _batch_ids(self, batch_rows) -> np.ndarray:
+        ids = [np.asarray(row[self.ids_index]).reshape(-1)
+               for row in batch_rows]
+        return np.unique(np.concatenate(ids)) if ids else np.empty(
+            0, np.int64)
+
+    def _accs(self, scope):
+        """Optimizer accumulators riding the table (e.g. adagrad's
+        ``<table>_moment_acc``) — reset to zero wherever the row is."""
+        return [k for k in scope.keys()
+                if k.startswith(self.table + "_") and k.endswith("_acc")]
+
+    def _reset_rows(self, scope, ids: List[int]) -> None:
+        import jax.numpy as jnp
+
+        w = scope.get(self.table)
+        idx = jnp.asarray(np.asarray(ids, np.int32))
+        init = jnp.asarray(np.stack([self.row_init(i) for i in ids]))
+        scope.set(self.table, w.at[idx].set(init.astype(w.dtype)))
+        for acc in self._accs(scope):
+            a = scope.get(acc)
+            scope.set(acc, a.at[idx].set(jnp.zeros((), a.dtype)))
+
+    def after_batch(self, batch_rows, scope, step: int) -> None:
+        """Post-step admit gate: count this batch's touches; rows still
+        below the admission threshold are reset to their deterministic
+        init (their update this step is discarded)."""
+        if self.table not in scope:
+            return
+        if self._dim is None:
+            w = scope.get(self.table)
+            self._dim, self._dtype = int(w.shape[-1]), np.dtype(
+                str(w.dtype))
+        vocab = int(scope.get(self.table).shape[0])
+        reset = []
+        for i in self._batch_ids(batch_rows):
+            i = int(i)
+            if i < 0 or i >= vocab:
+                continue  # sentinel / padding ids are not rows
+            ent = self._touch.get(i)
+            if ent is None:
+                ent = self._touch[i] = [0, step, False]
+            ent[0] += 1
+            ent[1] = step
+            if not ent[2]:
+                if ent[0] >= self.admit_touches:
+                    ent[2] = True
+                    self.admitted += 1
+                    # admission resets ONCE more so training starts from
+                    # the deterministic init, not suppressed remnants
+                    reset.append(i)
+                else:
+                    reset.append(i)
+                    self.suppressed += 1
+        if reset:
+            self._reset_rows(scope, reset)
+
+    def on_task_end(self, scope, step: int) -> None:
+        """Task-boundary TTL sweep: evict cold ids."""
+        if self.table not in scope:
+            return
+        cold = [i for i, (_, last, _a) in self._touch.items()
+                if step - last > self.ttl_steps]
+        if not cold:
+            return
+        for i in cold:
+            del self._touch[i]
+        self.evicted += len(cold)
+        self._reset_rows(scope, cold)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        return {"resident": sum(1 for e in self._touch.values()
+                                if e[2]),
+                "tracked": len(self._touch),
+                "admitted": self.admitted, "evicted": self.evicted,
+                "suppressed": self.suppressed}

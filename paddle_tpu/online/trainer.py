@@ -112,8 +112,14 @@ class StreamingTrainer:
                  max_passes: Optional[int] = None,
                  client_retry=None, install_signal_handlers: bool = True,
                  trainer_id: Optional[str] = None,
-                 lease_s: float = 30.0, rejoin: bool = True):
+                 lease_s: float = 30.0, rejoin: bool = True,
+                 sparse_lifecycle=None):
         self.sgd = sgd
+        #: optional frequency-adaptive row policy (online.lifecycle.
+        #: SparseLifecycle): admit gate after every trained batch, TTL
+        #: eviction sweep at task boundaries — host-side only, the
+        #: device step program is untouched
+        self.sparse_lifecycle = sparse_lifecycle
         self.master_addr = tuple(master_addr)
         self.make_task_reader = make_task_reader
         self.task_descs = list(task_descs) if task_descs else None
@@ -367,18 +373,27 @@ class StreamingTrainer:
             if len(rows) == self.batch_size:
                 if prev is not None:
                     yield prev
-                    self.steps += 1
+                    self._post_batch(prev)
                 prev, rows = rows, []
         if rows:  # trailing partial batch still trains
             if prev is not None:
                 yield prev
-                self.steps += 1
+                self._post_batch(prev)
             prev = rows
         if prev is not None:
             if self._elastic:
                 self._finishing = (tid, epoch)
             yield prev
-            self.steps += 1
+            self._post_batch(prev)
+
+    def _post_batch(self, batch) -> None:
+        """After a yielded batch RESUMES it has been trained (the step
+        loop is synchronous) — count the step and run the sparse-row
+        admit gate against the just-updated table."""
+        self.steps += 1
+        if self.sparse_lifecycle is not None:
+            self.sparse_lifecycle.after_batch(batch, self.sgd.scope,
+                                              self.steps)
 
     def _note_task_trained(self, client: MasterClient, tid: int,
                            epoch: int) -> None:
@@ -478,6 +493,9 @@ class StreamingTrainer:
                             self._fenced_latch = True
                         continue
                     self._note_task_trained(client, tid, epoch)
+                    if self.sparse_lifecycle is not None:
+                        self.sparse_lifecycle.on_task_end(
+                            self.sgd.scope, self.steps)
             finally:
                 if not self._elastic:
                     # elastic keeps the client open: SGD's FINAL

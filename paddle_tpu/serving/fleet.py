@@ -465,6 +465,9 @@ class Fleet:
         # instead, each rolling its tenant independently.
         self.publisher = None
         self.tenant_publishers: Dict[str, object] = {}
+        # paddle_tpu.feedback hook: attach_feedback() logs every
+        # completed request as an impression and opens /v1/outcome
+        self.feedback = None
         self.flight = trace.get_recorder()
         self.replicas: List[Replica] = []
         for i, rep in enumerate(replicas):
@@ -571,9 +574,30 @@ class Fleet:
         span = trace.start_span(
             "fleet/request", detached=True, timeout_ms=timeout_ms,
             parent=trace.extract(meta.pop("traceparent", None)))
+        rid = None
+        if self.feedback is not None:
+            rid = self.feedback.new_request_id()
+            fut.request_id = rid
         self._pool.submit(self._run, fut, payload, meta, deadline,
-                          span)
+                          span, rid)
         return fut
+
+    # -- feedback plane --------------------------------------------------
+    def attach_feedback(self, hook):
+        """Start the impression log on this fleet: every COMPLETED
+        request (whichever replica won) logs one record through
+        ``hook`` (:class:`paddle_tpu.feedback.FeedbackHook`), submits
+        gain a ``request_id``, and the HTTP plane serves
+        ``POST /v1/outcome`` into the hook's joiner. The hook's
+        ``weights_version`` defaults to the attached Publisher's
+        published generation — impressions record which weights served
+        them."""
+        self.feedback = hook
+        if hook.version_source is None:
+            hook.version_source = (
+                lambda: self.publisher.published_step
+                if self.publisher is not None else None)
+        return hook
 
     @staticmethod
     def _pin_seed(meta: dict) -> None:
@@ -624,7 +648,7 @@ class Fleet:
         return max(self.hedge_min_ms / 1e3, p99)
 
     def _run(self, fut: Future, payload, meta: dict,
-             deadline: Optional[float], span) -> None:
+             deadline: Optional[float], span, rid=None) -> None:
         t0 = time.monotonic()
         try:
             result = self._execute(payload, meta, deadline, span)
@@ -639,6 +663,15 @@ class Fleet:
             if span is not None:
                 span.finish(status="ok")
             fut.set_result(result)
+            if self.feedback is not None and rid is not None:
+                # impression AFTER the caller unblocks: one bounded
+                # non-blocking append, failures never touch the request
+                try:
+                    self.feedback.on_served(
+                        rid, payload, result, model=meta.get("model"),
+                        trace_id=getattr(span, "trace_id", None))
+                except Exception:  # noqa: BLE001
+                    pass
         finally:
             with self._lock:
                 self._pending -= 1
@@ -1068,15 +1101,18 @@ class Fleet:
                                        timeout_ms=req.get("timeout_ms"),
                                        **meta)
                     res = fut.result(timeout=req.get("timeout_s", 60))
+                    rid = getattr(fut, "request_id", None)
                     if isinstance(res, tuple):
                         ids, scores = res
-                        self._send(200, {
+                        body = {
                             "ids": np.asarray(ids)[0].tolist(),
                             "beams": np.asarray(ids).tolist(),
-                            "scores": np.asarray(scores).tolist()})
+                            "scores": np.asarray(scores).tolist()}
                     else:
-                        self._send(200,
-                                   {"ids": np.asarray(res).tolist()})
+                        body = {"ids": np.asarray(res).tolist()}
+                    if rid is not None:  # feedback plane attached
+                        body["request_id"] = rid
+                    self._send(200, body)
                 elif self.path == "/v1/infer":
                     inputs = {k: np.asarray(v)
                               for k, v in req["inputs"].items()}
@@ -1084,8 +1120,23 @@ class Fleet:
                                        timeout_ms=req.get("timeout_ms"),
                                        **meta)
                     outs = fut.result(timeout=req.get("timeout_s", 60))
-                    self._send(200, {"outputs": [np.asarray(o).tolist()
-                                                 for o in outs]})
+                    body = {"outputs": [np.asarray(o).tolist()
+                                        for o in outs]}
+                    rid = getattr(fut, "request_id", None)
+                    if rid is not None:  # feedback plane attached
+                        body["request_id"] = rid
+                    self._send(200, body)
+                elif self.path == "/v1/outcome":
+                    joiner = getattr(fleet.feedback, "joiner", None)
+                    if joiner is None:
+                        self._send(404, {
+                            "error": "no outcome joiner attached to "
+                                     "this fleet"})
+                    else:
+                        status = joiner.post_outcome(
+                            req["request_id"],
+                            req.get("outcome", req.get("label")))
+                        self._send(200, {"status": status})
                 elif self.path == "/fleet/drain":
                     rep = fleet._replica_by(req["replica"])
                     rep.drain(wait=req.get("wait", True),

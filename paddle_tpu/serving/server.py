@@ -109,6 +109,9 @@ class Server:
         self._running = False
         self._paused = False
         self._state = "ready"
+        # feedback plane (paddle_tpu.feedback): attach_feedback() starts
+        # impression logging + the /v1/outcome endpoint
+        self.feedback = None
 
     @property
     def state(self) -> str:
@@ -312,7 +315,42 @@ class Server:
                 f"unknown model/tenant {model!r}: this replica serves "
                 + (f"{sorted(self.model_ids)}" if self.model_ids
                    else "one unnamed model"))
-        return self.batcher.submit(payload, timeout_ms=timeout_ms, **meta)
+        fut = self.batcher.submit(payload, timeout_ms=timeout_ms, **meta)
+        return self._feedback_tap(fut, payload, model)
+
+    # -- feedback plane ----------------------------------------------------
+    def attach_feedback(self, hook) -> "Server":
+        """Start logging served impressions through ``hook``
+        (:class:`paddle_tpu.feedback.FeedbackHook`): every successful
+        submit gains a ``request_id`` (returned on the HTTP surface) and
+        lands one impression record in the hook's log; ``POST
+        /v1/outcome`` routes into the hook's joiner."""
+        self.feedback = hook
+        return self
+
+    def _feedback_tap(self, fut: Future, payload, model):
+        """Tag the future with a request id and log the impression at
+        completion. The tap rides set_result (success only — failed
+        requests are not impressions) and costs one bounded-buffer
+        append on the dispatch thread; the serving thread pays
+        nothing."""
+        fb = self.feedback
+        if fb is None:
+            return fut
+        rid = fb.new_request_id()
+        fut.request_id = rid
+        inner = fut.set_result
+
+        def tapped(result, _inner=inner, _rid=rid, _payload=payload,
+                   _model=model):
+            _inner(result)
+            try:
+                fb.on_served(_rid, _payload, result, model=_model)
+            except Exception:  # noqa: BLE001 - never fail the request
+                pass
+
+        fut.set_result = tapped
+        return fut
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
@@ -481,15 +519,18 @@ class Server:
                             payload, timeout_ms=req.get("timeout_ms"),
                             **meta, **tmeta)
                         res = fut.result(timeout=req.get("timeout_s", 60))
+                        rid = getattr(fut, "request_id", None)
                         if isinstance(res, tuple):  # all beams requested
                             ids, scores = res
-                            self._send(200, {
+                            body = {
                                 "ids": np.asarray(ids)[0].tolist(),
                                 "beams": np.asarray(ids).tolist(),
-                                "scores": np.asarray(scores).tolist()})
+                                "scores": np.asarray(scores).tolist()}
                         else:
-                            self._send(200,
-                                       {"ids": np.asarray(res).tolist()})
+                            body = {"ids": np.asarray(res).tolist()}
+                        if rid is not None:  # feedback plane attached
+                            body["request_id"] = rid
+                        self._send(200, body)
                     elif self.path == "/v1/adopt":
                         # cross-process KV handoff: the prefill pool
                         # POSTs serialized page ranges + the block
@@ -516,8 +557,26 @@ class Server:
                                             timeout_ms=req.get("timeout_ms"),
                                             **tmeta)
                         outs = fut.result(timeout=req.get("timeout_s", 60))
-                        self._send(200, {"outputs": [
-                            np.asarray(o).tolist() for o in outs]})
+                        body = {"outputs": [
+                            np.asarray(o).tolist() for o in outs]}
+                        rid = getattr(fut, "request_id", None)
+                        if rid is not None:  # feedback plane attached
+                            body["request_id"] = rid
+                        self._send(200, body)
+                    elif self.path == "/v1/outcome":
+                        # the joiner ingress: outcomes post back keyed
+                        # by the request_id a /v1/* response carried
+                        fb = server.feedback
+                        joiner = getattr(fb, "joiner", None)
+                        if joiner is None:
+                            self._send(404, {
+                                "error": "no outcome joiner attached "
+                                         "to this replica"})
+                        else:
+                            status = joiner.post_outcome(
+                                req["request_id"],
+                                req.get("outcome", req.get("label")))
+                            self._send(200, {"status": status})
                     else:
                         self._send(404, {"error": "not found"})
                 except KeyError as exc:

@@ -284,8 +284,11 @@ class MultiTenantServer(Server):
             raise EngineClosedError(
                 f"tenant {tenant.name!r} is draining for a rolling "
                 "update on this replica; route to another replica")
-        return tenant.batcher.submit(payload, timeout_ms=timeout_ms,
-                                     **meta)
+        fut = tenant.batcher.submit(payload, timeout_ms=timeout_ms,
+                                    **meta)
+        # impressions carry the RESOLVED tenant name (default routing
+        # included), so the joined examples are per-model attributable
+        return self._feedback_tap(fut, payload, tenant.name)
 
     # -- tenant-scoped rolling updates -------------------------------------
     def pause_tenant(self, name: str, wait: bool = True,

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""loopctl — inspect a running serve->log->join->train->publish loop.
+
+Reads the feedback loop's on-disk state (impression-log dir, joined
+dir, trainer checkpoint dir) and optionally a live fleet's
+``/fleet/status`` for the publish stage, then prints per-stage lag —
+the operator's view of the ``freshness_s`` SLO:
+
+    loopctl.py --log-dir /data/impressions --joined-dir /data/joined \
+        [--ckpt-dir /ckpt/run1] [--url http://host:port] [--json]
+
+Stages:
+    log      age of the newest SEALED impression segment (+ drop count)
+    join     age of the newest sealed joined segment, pending window
+    train    newest checkpoint generation + its age
+    publish  fleet weights block (published step / staleness) when
+             --url is given
+
+Exit status: 0 on success, 1 when a stage directory is unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+
+def _fleet_weights(url: str):
+    with urllib.request.urlopen(f"{url}/fleet/status", timeout=10) as r:
+        return json.load(r).get("weights")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--log-dir", required=True)
+    ap.add_argument("--joined-dir", required=True)
+    ap.add_argument("--ckpt-dir")
+    ap.add_argument("--url", help="fleet HTTP plane for the publish row")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.feedback import loop_status
+    from paddle_tpu.feedback.log import sealed_segments, segment_meta
+
+    try:
+        status = loop_status(args.log_dir, args.joined_dir,
+                             ckpt_dir=args.ckpt_dir)
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    # per-stage extras: torn/drop accounting from segment metas
+    torn = lost = 0
+    for p in sealed_segments(args.log_dir):
+        try:
+            m = segment_meta(p)
+        except (OSError, ValueError):
+            continue
+        torn += int(bool(m.get("torn")))
+        lost += int(m.get("lost_bytes") or 0)
+    status["torn_segments"] = torn
+    status["torn_lost_bytes"] = lost
+    if args.url:
+        try:
+            status["publish"] = _fleet_weights(args.url.rstrip("/"))
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            status["publish_error"] = str(exc)
+
+    if args.as_json:
+        print(json.dumps(status, indent=1, sort_keys=True))
+        return 0
+
+    def row(stage, lag, extra=""):
+        lag = "-" if lag is None else f"{lag:9.3f}s"
+        print(f"{stage:<8} {lag:>10}  {extra}")
+
+    print(f"{'STAGE':<8} {'LAG':>10}")
+    row("log", status.get("log_lag_s"),
+        f"torn={torn} lost_bytes={lost}")
+    row("join", status.get("join_lag_s"),
+        f"backlog={status.get('backlog_segments')} "
+        f"fed_examples={status.get('examples_enqueued')}")
+    if args.ckpt_dir:
+        row("train", status.get("train_lag_s"),
+            f"step={status.get('trained_step')}")
+    pub = status.get("publish")
+    if pub:
+        row("publish", pub.get("staleness_s"),
+            f"step={pub.get('published_step')} "
+            f"generations={pub.get('generations')}")
+    elif status.get("publish_error"):
+        row("publish", None, f"error: {status['publish_error']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
