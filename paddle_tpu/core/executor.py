@@ -506,6 +506,12 @@ class Executor:
         self.persistent_hits = 0
         self.fresh_compiles = 0
         self.donation_fallbacks = 0
+        # cumulative seconds inside ``.lower().compile()``, split by
+        # source — the goodput plane's fresh_compile bucket deltas
+        # fresh_compile_seconds around each run to re-attribute compile
+        # wall out of device_compute
+        self.compile_seconds = 0.0
+        self.fresh_compile_seconds = 0.0
         from .manifest import SignatureManifest
 
         # every compiled signature is recorded here; engines/trainer
@@ -763,8 +769,11 @@ class Executor:
         (executable, restored_from_disk) and bumps the source counters."""
         from .. import profiler
 
+        t0 = time.perf_counter()
         with _compile_window() as window:
             executable = jitted.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        self.compile_seconds += dt
         restored = window["persistent_hits"] > 0
         if restored:
             self.persistent_hits += 1
@@ -772,8 +781,10 @@ class Executor:
                 "executor/compile_cache/persistent_hit", 1)
         else:
             self.fresh_compiles += 1
+            self.fresh_compile_seconds += dt
             profiler.global_stat.add_count(
                 "executor/compile_cache/fresh_compile", 1)
+            profiler.global_stat.add("executor/fresh_compile", dt)
         return executable, restored
 
     def _finish_compile(self, compiled: "_Compiled", feed_vals,

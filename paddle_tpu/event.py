@@ -35,7 +35,8 @@ class BeginIteration:
 
 class EndIteration(WithMetric):
     def __init__(self, pass_id, batch_id, cost, metrics=None,
-                 batch_size=None):
+                 batch_size=None, host_wall_s=None, device_wall_s=None,
+                 mfu=None):
         super().__init__(metrics)
         self.pass_id = pass_id
         self.batch_id = batch_id
@@ -44,6 +45,14 @@ class EndIteration(WithMetric):
         # something len() can't see through) — trace.RunLog derives
         # examples/sec from it
         self.batch_size = batch_size
+        # goodput split of this step's wall (seconds): host-side
+        # dispatch/feed vs time blocked on device results; and the
+        # step's achieved model-FLOPs-utilization when the trainer's
+        # GoodputMeter priced the program. All optional — events from
+        # older/custom loops carry None.
+        self.host_wall_s = host_wall_s
+        self.device_wall_s = device_wall_s
+        self.mfu = mfu
 
 
 class TestResult(WithMetric):

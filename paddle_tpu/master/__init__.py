@@ -48,6 +48,7 @@ import socket
 import socketserver
 import threading
 import time
+from collections import deque
 from typing import Callable, Iterable, List, Optional, Sequence
 
 from ..native import load_library
@@ -58,6 +59,15 @@ _DESC_BUF = 65536
 
 #: ``task_status`` engine codes -> names
 TASK_STATES = {0: "todo", 1: "pending", 2: "done", 3: "discarded"}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (empty -> 0.0)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
 
 
 class FencedTokenError(RuntimeError):
@@ -116,8 +126,18 @@ class Master:
     lease/fencing plane (monotonic trainer tokens, lease-expiry requeue,
     zombie-report rejection) layered over it."""
 
+    #: straggler verdict: a trainer whose recent-mean step wall exceeds
+    #: ``straggler_skew`` x the cross-trainer median (with at least
+    #: ``straggler_min_trainers`` trainers reporting telemetry).  The
+    #: quorum is 3: with only two samples the nearest-rank median IS the
+    #: faster trainer, so any natural 2x spread between two healthy
+    #: trainers would read as skew
+    STRAGGLER_SKEW = 2.0
+    STRAGGLER_MIN_TRAINERS = 3
+
     def __init__(self, timeout_s: int = 60, max_failures: int = 3,
-                 token_path: Optional[str] = None, now_fn=None):
+                 token_path: Optional[str] = None, now_fn=None,
+                 straggler_skew: Optional[float] = None):
         self._lib = load_library("master")
         if self._lib is None:
             raise RuntimeError("no C++ toolchain; cannot build master engine")
@@ -157,6 +177,14 @@ class Master:
         self._next_token = 1
         self.lease_expired_total = 0
         self.zombie_acks_rejected = 0
+        # ---- straggler plane: per-trainer step-time digests fed by ----
+        # ---- heartbeat telemetry, skew-checked on every beat        ----
+        self.straggler_skew = float(straggler_skew
+                                    if straggler_skew is not None
+                                    else self.STRAGGLER_SKEW)
+        self._telemetry: dict = {}   # trainer_id -> digest dict
+        self._stragglers: set = set()    # currently-flagged trainer ids
+        self.stragglers_detected_total = 0
         self.token_path = token_path
         if token_path and os.path.exists(token_path):
             try:
@@ -260,20 +288,114 @@ class Master:
             profiler.global_stat.add_count("master/trainer_registered", 1)
             return token
 
-    def heartbeat(self, token: int) -> bool:
+    def heartbeat(self, token: int, telemetry: Optional[dict] = None) -> bool:
         """Renew ``token``'s lease and the engine deadlines of its
         claims; False when the token is fenced (the caller must
-        re-register)."""
+        re-register). ``telemetry`` (optional: ``step_wall_s``,
+        ``steps``, ``goodput``, ``mfu``) feeds the per-trainer
+        step-time digest the straggler plane skew-checks on every
+        beat."""
         with self._lease_lock:
             self._check_leases_locked()
             try:
-                self._renew_locked(token)
+                trainer_id = self._renew_locked(token)
             except FencedTokenError:
                 return False
             for task_id, (tok, epoch, _seq) in list(self._claims.items()):
                 if tok == token:
                     self._lib.ptmaster_touch(self._h, task_id, epoch)
+            if telemetry:
+                self._note_telemetry_locked(trainer_id, telemetry)
             return True
+
+    # -- straggler plane ------------------------------------------------
+    def _note_telemetry_locked(self, trainer_id: str,
+                               telemetry: dict) -> None:
+        d = self._telemetry.setdefault(
+            trainer_id, {"walls": deque(maxlen=32), "steps": 0,
+                         "goodput": None, "mfu": None, "beats": 0})
+        d["beats"] += 1
+        wall = telemetry.get("step_wall_s")
+        if wall is not None and float(wall) > 0:
+            d["walls"].append(float(wall))
+        for key in ("steps", "goodput", "mfu"):
+            if telemetry.get(key) is not None:
+                d[key] = telemetry[key]
+        self._check_stragglers_locked()
+
+    def _check_stragglers_locked(self) -> None:
+        """Skew check over the per-trainer recent-mean step walls: a
+        trainer running ``straggler_skew`` x slower than the
+        cross-trainer median is flagged (trace record + counter at
+        onset, cleared when it catches back up)."""
+        means = {tid: sum(d["walls"]) / len(d["walls"])
+                 for tid, d in self._telemetry.items()
+                 if d["walls"] and tid in self._leases}
+        if len(means) < self.STRAGGLER_MIN_TRAINERS:
+            return
+        vals = sorted(means.values())
+        p50 = _percentile(vals, 0.50)
+        if p50 <= 0:
+            return
+        flagged = {tid for tid, mean in means.items()
+                   if mean > self.straggler_skew * p50}
+        for tid in flagged - self._stragglers:
+            self.stragglers_detected_total += 1
+            from .. import profiler, trace
+
+            profiler.global_stat.add_count("master/straggler_detected", 1)
+            t = time.perf_counter()
+            trace.record("master/straggler_detected", t, t, trainer=tid,
+                         mean_step_s=round(means[tid], 6),
+                         p50_step_s=round(p50, 6),
+                         skew=round(means[tid] / p50, 3))
+        self._stragglers = flagged
+
+    def train_status(self) -> dict:
+        """The training-fleet aggregate the straggler plane exports:
+        per-trainer digests (recent-mean step wall, steps, goodput,
+        MFU), the cross-trainer p50/p99 step-time skew, and the
+        currently-flagged stragglers."""
+        with self._lease_lock:
+            self._check_leases_locked()
+            trainers = {}
+            means = []
+            for tid, d in self._telemetry.items():
+                mean = (sum(d["walls"]) / len(d["walls"])
+                        if d["walls"] else None)
+                active = tid in self._leases
+                if mean is not None and active:
+                    means.append(mean)
+                trainers[tid] = {
+                    "step_seconds": (round(mean, 6)
+                                     if mean is not None else None),
+                    "steps": d["steps"], "goodput": d["goodput"],
+                    "mfu": d["mfu"], "active": active,
+                    "straggler": tid in self._stragglers,
+                }
+            means.sort()
+            p50 = _percentile(means, 0.50) if means else None
+            p99 = _percentile(means, 0.99) if means else None
+            goodputs = [t["goodput"] for t in trainers.values()
+                        if t["active"] and t["goodput"] is not None]
+            mfus = [t["mfu"] for t in trainers.values()
+                    if t["active"] and t["mfu"] is not None]
+            return {
+                "trainers": trainers,
+                "step_seconds_p50": (round(p50, 6)
+                                     if p50 is not None else None),
+                "step_seconds_p99": (round(p99, 6)
+                                     if p99 is not None else None),
+                "skew": (round(p99 / p50, 3)
+                         if p50 and p99 is not None else None),
+                "goodput": (round(sum(goodputs) / len(goodputs), 4)
+                            if goodputs else None),
+                "mfu": (round(sum(mfus) / len(mfus), 6)
+                        if mfus else None),
+                "stragglers": sorted(self._stragglers),
+                "stragglers_detected_total":
+                    self.stragglers_detected_total,
+            }
 
     def token_active(self, token: int) -> bool:
         with self._lease_lock:
@@ -417,8 +539,12 @@ class Master:
 
     def prometheus_text(self) -> str:
         """The master's queue + lease plane as Prometheus gauges (served
-        by ``MasterServer`` op ``metrics``; scrape-ready text)."""
+        by ``MasterServer`` op ``metrics``; scrape-ready text), plus the
+        straggler plane's labeled per-trainer series
+        (``trainer_step_seconds{trainer=...}``, goodput fraction, MFU)
+        and the ``master_straggler`` gauge."""
         c = self.counts()
+        ts = self.train_status()
         names = {
             "master_tasks_todo": c["todo"],
             "master_tasks_pending": c["pending"],
@@ -428,6 +554,9 @@ class Master:
             "master_trainers_active": c["trainers_active"],
             "master_lease_expired_total": c["lease_expired_total"],
             "master_zombie_acks_rejected": c["zombie_acks_rejected"],
+            "master_straggler": len(ts["stragglers"]),
+            "master_stragglers_detected_total":
+                ts["stragglers_detected_total"],
         }
         lines = []
         for name, value in names.items():
@@ -435,6 +564,22 @@ class Master:
                 else "gauge"
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")
+        labeled = {"trainer_step_seconds": "step_seconds",
+                   "trainer_goodput_fraction": "goodput",
+                   "trainer_mfu": "mfu",
+                   "trainer_straggler": "straggler"}
+        for metric, key in labeled.items():
+            rows = []
+            for tid, t in sorted(ts["trainers"].items()):
+                val = t.get(key)
+                if key == "straggler":
+                    val = 1 if val else 0
+                if val is None:
+                    continue
+                rows.append(f'{metric}{{trainer="{tid}"}} {val}')
+            if rows:
+                lines.append(f"# TYPE {metric} gauge")
+                lines.extend(rows)
         return "\n".join(lines) + "\n"
 
 
@@ -495,7 +640,8 @@ class _Handler(socketserver.StreamRequestHandler):
                         lease_s=float(req.get("lease_s") or 30.0))}
                     mutated = True
                 elif op == "heartbeat":
-                    resp = {"ok": True, "alive": master.heartbeat(token)}
+                    resp = {"ok": True, "alive": master.heartbeat(
+                        token, telemetry=req.get("telemetry"))}
                 elif op == "expire_trainer":
                     resp = {"ok": True, "expired": master.expire_trainer(
                         req["trainer_id"])}
@@ -507,6 +653,8 @@ class _Handler(socketserver.StreamRequestHandler):
                             "status": master.task_status(req["task_id"])}
                 elif op == "metrics":
                     resp = {"ok": True, "text": master.prometheus_text()}
+                elif op == "train_status":
+                    resp = {"ok": True, "train": master.train_status()}
                 else:
                     resp = {"ok": False, "error": f"unknown op {op!r}"}
             except FencedTokenError as e:
@@ -762,13 +910,22 @@ class MasterClient:
             raise RuntimeError("rejoin() requires a prior register()")
         return self.register(self.trainer_id, lease_s=self.lease_s)
 
-    def heartbeat(self) -> bool:
+    def heartbeat(self, telemetry: Optional[dict] = None) -> bool:
         """Renew the lease (and the engine deadlines of our claims);
-        False when our token is fenced — the rejoin signal."""
+        False when our token is fenced — the rejoin signal. Optional
+        ``telemetry`` ({step_wall_s, steps, goodput, mfu}) rides the
+        beat into the master's straggler plane."""
         if self.token is None:
             return True
-        return bool(self._call(op="heartbeat",
-                               token=self.token)["alive"])
+        req = {"op": "heartbeat", "token": self.token}
+        if telemetry:
+            req["telemetry"] = telemetry
+        return bool(self._call(**req)["alive"])
+
+    def train_status(self) -> dict:
+        """The master's straggler-plane aggregate (per-trainer step
+        digests, p50/p99 skew, flagged stragglers)."""
+        return self._call(op="train_status")["train"]
 
     def task_status(self, task_id: int) -> Optional[str]:
         return self._call(op="task_status", task_id=task_id)["status"]

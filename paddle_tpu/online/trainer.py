@@ -55,7 +55,9 @@ configuration under which the crash/rejoin chaos matrix is bitwise.
 """
 from __future__ import annotations
 
+import contextlib
 import time
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 from .. import checkpoint as ckpt_mod
@@ -113,7 +115,8 @@ class StreamingTrainer:
                  client_retry=None, install_signal_handlers: bool = True,
                  trainer_id: Optional[str] = None,
                  lease_s: float = 30.0, rejoin: bool = True,
-                 sparse_lifecycle=None):
+                 sparse_lifecycle=None,
+                 telemetry_every_s: Optional[float] = None):
         self.sgd = sgd
         #: optional frequency-adaptive row policy (online.lifecycle.
         #: SparseLifecycle): admit gate after every trained batch, TTL
@@ -169,6 +172,18 @@ class StreamingTrainer:
         self._acked_early: set = set()      # acked by the flush pre-resume
         self._generations = 0               # saves that landed this run
         self._fenced_latch = False
+        #: step-telemetry heartbeat cadence (elastic mode): each beat
+        #: renews the lease AND ships {step wall, steps, goodput, mfu}
+        #: to the master's straggler plane. Default: a third of the
+        #: lease so telemetry rides the renewals the lease needs anyway.
+        self.telemetry_every_s = (float(telemetry_every_s)
+                                  if telemetry_every_s is not None
+                                  else max(0.5, self.lease_s / 3.0))
+        self.goodput = None                 # set by run()
+        self._recent_walls: deque = deque(maxlen=16)
+        self._last_end_t: Optional[float] = None
+        self._last_stall_s = 0.0
+        self._last_telemetry_t = 0.0
 
     # -- control --------------------------------------------------------
     def stop(self, reason: str = "stop() called") -> None:
@@ -193,6 +208,8 @@ class StreamingTrainer:
                         "lease_lost": self.lease_lost,
                         "zombie_acks": self.zombie_acks,
                         "tasks_skip_acked": self.tasks_skip_acked})
+        if self.goodput is not None:
+            out["goodput"] = self.goodput.snapshot()
         try:
             client = MasterClient(self.master_addr,
                                   retry=self._client_retry)
@@ -313,6 +330,45 @@ class StreamingTrainer:
             self.tasks_skip_acked += 1
         return True
 
+    def _goodput_region(self, bucket: str):
+        """The shared meter's region timer, or a no-op when the run is
+        uninstrumented."""
+        if self.goodput is None:
+            return contextlib.nullcontext()
+        return self.goodput.measure(bucket)
+
+    def _maybe_telemetry(self, client: MasterClient) -> None:
+        """Cadenced heartbeat carrying step telemetry (median recent
+        step wall, steps done, goodput fraction, MFU): renews the lease and
+        feeds the master's per-trainer straggler digests. Telemetry must
+        never kill the stream — transport errors are dropped (the lease
+        plane's own renewal paths still run)."""
+        if not self._elastic or self.token is None:
+            return
+        now = time.monotonic()
+        if now - self._last_telemetry_t < self.telemetry_every_s:
+            return
+        self._last_telemetry_t = now
+        # median, not mean: a couple of cold-start walls (our own jit
+        # compile, or a neighbor's hogging the host) would otherwise sit
+        # in the window for its whole depth and read as sustained skew
+        walls = sorted(self._recent_walls)
+        wall = walls[len(walls) // 2] if walls else None
+        if self.goodput is not None:
+            payload = self.goodput.telemetry(last_step_wall_s=wall)
+        else:
+            payload = {}
+            if wall is not None:
+                payload["step_wall_s"] = round(wall, 6)
+        payload["steps"] = self.steps
+        try:
+            with self._goodput_region("master_wait"):
+                client.heartbeat(telemetry=payload)
+        except FencedTokenError:
+            self._fenced_latch = True
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
     def _handle_fenced(self, client: MasterClient) -> bool:
         """Our token went stale (lease expired / host re-registered).
         Either rejoin — fresh token, scope rolled back to the newest
@@ -326,7 +382,8 @@ class StreamingTrainer:
         if not self._rejoin:
             self.stop("fencing token lost (rejoin disabled)")
             return False
-        with trace.span("trainer/rejoin", trainer_id=self.trainer_id):
+        with trace.span("trainer/rejoin", trainer_id=self.trainer_id), \
+                self._goodput_region("recovery_rollback"):
             self.token = client.rejoin()
             dirname = getattr(self.checkpoint, "dirname", None)
             if dirname and ckpt_mod.latest_step(dirname) is not None:
@@ -366,6 +423,11 @@ class StreamingTrainer:
         lookahead: ``_finishing`` is set just before the FINAL batch is
         yielded, so a checkpoint save firing while the step loop trains
         that batch knows the task is fully covered by the generation."""
+        # restart the step-wall clock at the task boundary: the gap to
+        # the previous task's last step is queue wait (get_task RPCs,
+        # NO_TASK backoff), and letting it into the telemetry digest
+        # makes a task-starved trainer look like a straggler
+        self._last_end_t = None
         prev = None
         rows = []
         for rec in self.make_task_reader(desc):
@@ -435,6 +497,7 @@ class StreamingTrainer:
                     if self._fenced_latch \
                             and not self._handle_fenced(client):
                         return
+                    self._maybe_telemetry(client)
                     plan = faults.active_plan()
                     if plan is not None and plan.fire(
                             "trainer_preempt_rejoin",
@@ -443,7 +506,8 @@ class StreamingTrainer:
                                   "expected)")
                         continue  # the budget check ends the stream
                     try:
-                        t = client.get_task()
+                        with self._goodput_region("master_wait"):
+                            t = client.get_task()
                     except FencedTokenError:
                         self._fenced_latch = True
                         continue
@@ -453,14 +517,16 @@ class StreamingTrainer:
                         # run always leaves the queue at a fresh pass
                         # boundary for its successor (new_pass is a
                         # no-op while another trainer holds tasks)
-                        p = client.new_pass()
+                        with self._goodput_region("master_wait"):
+                            p = client.new_pass()
                         if p >= 0:
                             self._master_pass = p
                             self._covered = {}
                         continue
                     if t == NO_TASK:
                         # another trainer holds the pending tail
-                        time.sleep(0.02)
+                        with self._goodput_region("master_wait"):
+                            time.sleep(0.02)
                         continue
                     tid, desc, epoch = t
                     task_no += 1
@@ -510,20 +576,70 @@ class StreamingTrainer:
         return reader
 
     # -- run ------------------------------------------------------------
+    def _flight_state(self) -> dict:
+        """Live-state flight-recorder source: progress counters, the
+        goodput waterfall and last-N step walls — no network calls, so
+        a dump never blocks on a dead master."""
+        return {"trainer_id": self.trainer_id, "steps": self.steps,
+                "passes": self.passes,
+                "tasks_finished": self.tasks_finished,
+                "last_cost": self.last_cost,
+                "goodput": (self.goodput.snapshot()
+                            if self.goodput is not None else None),
+                "recent_step_walls_s": [
+                    round(w, 6) for w in self._recent_walls]}
+
     def run(self, event_handler: Optional[Callable] = None,
             run_log=None, **train_kw) -> dict:
         """Train until the budget/stop flag ends the stream; returns the
         final :meth:`state`. Extra kwargs forward to ``SGD.train``
-        (e.g. ``mem_budget``, ``plan``)."""
+        (e.g. ``mem_budget``, ``plan``). ``goodput`` behaves as in
+        :meth:`SGD.train` — the default builds a meter SHARED between
+        the step loop and this trainer's master-side accounting, so
+        queue idle and rejoin rollback show up as master_wait /
+        recovery_rollback instead of inflating data_wait."""
         self._started_at = time.monotonic()
+        from ..trace.flight import get_recorder
+        from ..trace.goodput import GoodputMeter
+
+        g = train_kw.pop("goodput", None)
+        if g is False:
+            meter = None
+        elif g is None or g is True:
+            meter = GoodputMeter()
+        else:
+            meter = g
+        self.goodput = meter
+        get_recorder().add_source("streaming_trainer",
+                                  self._flight_state)
+
+        def _stalls():
+            # already-attributed badput the skew check must NOT see: a
+            # synchronous checkpoint write or a fresh compile inside a
+            # step interval is bursty I/O, not sustained slowness, and
+            # it would flag whoever drew the slowest fsync
+            if meter is None:
+                return 0.0
+            return (meter.bucket_seconds("checkpoint_stall")
+                    + meter.bucket_seconds("fresh_compile"))
 
         def handler(e):
-            if isinstance(e, evt.EndIteration):
+            if isinstance(e, evt.BeginPass):
+                self._last_end_t = None
+            elif isinstance(e, evt.EndIteration):
                 self.last_cost = e.cost
+                # resolve-ordered step walls feed the telemetry digest
+                now = time.perf_counter()
+                stall = _stalls()
+                if self._last_end_t is not None:
+                    wall = ((now - self._last_end_t)
+                            - (stall - self._last_stall_s))
+                    if wall > 0:
+                        self._recent_walls.append(wall)
+                self._last_end_t = now
+                self._last_stall_s = stall
             if event_handler is not None:
                 event_handler(e)
-
-        import contextlib
 
         ctx = (graceful_shutdown(flag=self._flag)
                if self._install_signals else contextlib.nullcontext())
@@ -531,7 +647,9 @@ class StreamingTrainer:
             with ctx:
                 self.sgd.train(self._stream_reader(), num_passes=1,
                                event_handler=handler, run_log=run_log,
-                               checkpoint=self.checkpoint, **train_kw)
+                               checkpoint=self.checkpoint,
+                               goodput=meter if meter is not None
+                               else False, **train_kw)
         finally:
             client, self._client = self._client, None
             if client is not None:

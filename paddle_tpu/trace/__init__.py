@@ -28,6 +28,7 @@ from .device import (device_memory_stats, live_bytes,
 from .slo import SLO, SLOTracker
 from .flight import (FlightRecorder, get_recorder,
                      install_signal_handler)
+from .goodput import BUCKETS, GoodputMeter, program_flops
 
 __all__ = [
     "DEFAULT_CAPACITY", "Span", "SpanContext", "Tracer", "RunLog",
@@ -39,4 +40,5 @@ __all__ = [
     "per_device_memory_stats",
     "SLO", "SLOTracker",
     "FlightRecorder", "get_recorder", "install_signal_handler",
+    "BUCKETS", "GoodputMeter", "program_flops",
 ]

@@ -40,8 +40,16 @@ class RunLog:
         self.stat_set = stat_set
         self.echo_stats = echo_stats
         self._iter_t0: Optional[float] = None
+        # resolve-ordered clock: the previous EndIteration (or
+        # BeginPass). Under ``async_depth>1`` BeginIteration k+1 fires
+        # BEFORE EndIteration k resolves, so dispatch-anchored walls
+        # measure only the resolve block and overstate throughput; the
+        # interval between consecutive EndIterations is the true
+        # per-step wall on both paths.
+        self._last_end_t: Optional[float] = None
         self._pass_t0: Optional[float] = None
         self._pass_examples = 0
+        self._mfu_ema: Optional[float] = None
         self._write({"type": "run_header", "t_unix": time.time()})
 
     # -- plumbing ----------------------------------------------------------
@@ -64,13 +72,18 @@ class RunLog:
         now = time.perf_counter()
         if isinstance(e, evt.BeginPass):
             self._pass_t0 = now
+            self._last_end_t = now
             self._pass_examples = 0
             self._write({"type": "pass_begin", "pass": e.pass_id})
         elif isinstance(e, evt.BeginIteration):
             self._iter_t0 = now
         elif isinstance(e, evt.EndIteration):
-            wall = (now - self._iter_t0) if self._iter_t0 is not None \
-                else None
+            # resolve-ordered wall (time since the previous step
+            # RESOLVED): correct under async pipelining, identical to
+            # the dispatch-anchored wall when synchronous
+            wall = (now - self._last_end_t) \
+                if self._last_end_t is not None else None
+            self._last_end_t = now
             bs = getattr(e, "batch_size", None)
             if bs:
                 self._pass_examples += bs
@@ -81,6 +94,22 @@ class RunLog:
                 row["wall_ms"] = round(wall * 1e3, 3)
                 if bs and wall > 0:
                     row["examples_per_sec"] = round(bs / wall, 2)
+            # goodput split + live MFU when the trainer measured them
+            host_w = getattr(e, "host_wall_s", None)
+            dev_w = getattr(e, "device_wall_s", None)
+            mfu = getattr(e, "mfu", None)
+            if host_w is not None:
+                row["host_wall_ms"] = round(host_w * 1e3, 3)
+            if dev_w is not None:
+                row["device_wall_ms"] = round(dev_w * 1e3, 3)
+            if mfu is not None:
+                if self._mfu_ema is None:
+                    self._mfu_ema = float(mfu)
+                else:
+                    self._mfu_ema = (0.1 * float(mfu)
+                                     + 0.9 * self._mfu_ema)
+                row["mfu"] = round(float(mfu), 6)
+                row["mfu_ema"] = round(self._mfu_ema, 6)
             if bs:
                 row["batch_size"] = bs
             self._write(row)

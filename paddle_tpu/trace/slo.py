@@ -51,6 +51,14 @@ class SLO:
     tpot_ms: Optional[float] = None
     availability: Optional[float] = None
     freshness_s: Optional[float] = None
+    #: training objective: the goodput fraction (device-compute seconds
+    #: over total attributed seconds, exported by
+    #: :class:`paddle_tpu.trace.GoodputMeter` as the cumulative
+    #: ``goodput_good_ms_total`` / ``goodput_total_ms_total`` counter
+    #: pair) must stay at or above this value — badput (data stalls,
+    #: compiles, checkpoint stalls, recovery) burns error budget under
+    #: the same multi-window machinery as a slow decode
+    goodput: Optional[float] = None
     target: float = 0.99
     #: (short, long) sliding burn-rate windows, seconds
     windows_s: Tuple[float, float] = (60.0, 300.0)
@@ -76,13 +84,19 @@ class SLO:
                                 "metric": "weights_staleness_s",
                                 "threshold_s": float(self.freshness_s),
                                 "target": self.target}
+        if self.goodput is not None:
+            out["goodput"] = {"kind": "ratio",
+                              "good": "goodput_good_ms_total",
+                              "total": "goodput_total_ms_total",
+                              "target": float(self.goodput)}
         return out
 
     def to_dict(self) -> dict:
         return {"name": self.name, "ttft_ms": self.ttft_ms,
                 "tpot_ms": self.tpot_ms,
                 "availability": self.availability,
-                "freshness_s": self.freshness_s, "target": self.target,
+                "freshness_s": self.freshness_s,
+                "goodput": self.goodput, "target": self.target,
                 "windows_s": list(self.windows_s),
                 "burn_thresholds": list(self.burn_thresholds)}
 
@@ -151,6 +165,12 @@ class SLOTracker:
                     if float(val) <= obj["threshold_s"] * (1 + 1e-9):
                         cum[0] += 1
                 out[name] = (cum[0], cum[1])
+            elif obj["kind"] == "ratio":
+                # already-cumulative counter pair (goodput ms over
+                # total attributed ms): the windowed differencing
+                # yields the window's goodput fraction directly
+                out[name] = (int(counters.get(obj["good"], 0)),
+                             int(counters.get(obj["total"], 0)))
             else:
                 good = int(counters.get("completed", 0))
                 out[name] = (good, good + int(counters.get("failed", 0)))

@@ -131,7 +131,7 @@ class SGD:
               test_reader: Optional[Callable] = None,
               run_log=None, async_depth: int = 1,
               checkpoint=None, mem_budget: Optional[float] = None,
-              plan=None):
+              plan=None, goodput=None):
         """Run ``num_passes`` over ``reader`` (a batched reader: yields
         minibatches of rows ordered like ``feed_list``).
 
@@ -187,7 +187,16 @@ class SGD:
         ``EndIteration``. Numerics are unchanged: the same programs run
         in the same order on the same device state (async-vs-sync parity
         is pinned bitwise by tests/test_async_training.py). The default
-        ``async_depth=1`` is the fully synchronous reference loop."""
+        ``async_depth=1`` is the fully synchronous reference loop.
+
+        ``goodput`` controls the training observatory
+        (:class:`paddle_tpu.trace.GoodputMeter`): the default ``None``
+        creates a fresh meter so every second of the run decomposes into
+        the goodput/badput buckets and ``EndIteration`` events carry
+        host/device walls + live MFU; pass an existing meter to share
+        accounting (the elastic ``StreamingTrainer`` does), or ``False``
+        to run the bare uninstrumented loop (the bench A/B off-leg).
+        The active meter is exposed as ``self.goodput``."""
         user_handler = event_handler or _default_log_handler()
         if run_log is not None:
             def event_handler(e, _h=user_handler, _r=run_log):
@@ -203,6 +212,16 @@ class SGD:
         self._init_params()
         self._mem_budget = mem_budget
         self._mem_checked = False
+        from .trace.goodput import GoodputMeter
+
+        if goodput is False:
+            meter = None
+        elif goodput is None or goodput is True:
+            meter = GoodputMeter()
+        else:
+            meter = goodput
+        self.goodput = meter
+        self._flops_priced = meter is None
         rs = None
         from .flags import FLAGS
         from .resilience import TrainResilience, faults
@@ -223,16 +242,30 @@ class SGD:
                 self._replay_manifest(checkpoint.dirname)
         import contextlib
 
+        from .trace.flight import get_recorder
+
+        # live trainer state rides every flight bundle (position,
+        # goodput snapshot, recent step walls); WeakMethod-held so a
+        # dropped SGD never pins memory
+        from collections import deque
+
+        self._flight_pos = {"pass_id": None, "batch_id": None}
+        self._step_walls = deque(maxlen=32)
+        recorder = get_recorder()
+        recorder.add_source("trainer", self._flight_state)
         ctx = rs.signal_context() if rs is not None \
             else contextlib.nullcontext()
         try:
             self._train_passes(ctx, rs, reader, num_passes, event_handler,
-                               test_reader, async_depth)
-        except BaseException:
+                               test_reader, async_depth, meter)
+        except BaseException as exc:
             if rs is not None:
                 # join (never mask) an in-flight background save so no
                 # thread keeps mutating the ckpt dir after the crash
                 rs.abort()
+            # black box for the postmortem: throttled bundle capturing
+            # the exact position/goodput state at the failure
+            recorder.auto_dump("trainer_error", error=exc)
             raise
         if rs is not None:
             rs.finalize()
@@ -279,19 +312,37 @@ class SGD:
             pass  # checkpoint volume gone: the run itself still succeeded
 
     def _train_passes(self, ctx, rs, reader, num_passes, event_handler,
-                      test_reader, async_depth):
+                      test_reader, async_depth, meter=None):
+        import time as time_mod
+
         with ctx:
+            if meter is not None:
+                # the residual anchor carries ACROSS passes: event
+                # dispatch, reader setup, and the EndPass->BeginPass gap
+                # all belong to the decomposition, not just the step loop
+                t_anchor = time_mod.perf_counter()
+                acc0 = meter.total_seconds()
             for pass_id in range(rs.start_pass if rs else 0, num_passes):
                 event_handler(evt.BeginPass(pass_id))
                 skip_n = rs.skip_for_pass(pass_id, reader) if rs else 0
                 if async_depth > 1:
                     pass_costs, pass_metrics = self._run_pass_async(
                         pass_id, reader, event_handler, int(async_depth),
-                        rs=rs, skip_n=skip_n)
+                        rs=rs, skip_n=skip_n, meter=meter)
                 else:
                     pass_costs, pass_metrics = self._run_pass_sync(
                         pass_id, reader, event_handler, rs=rs,
-                        skip_n=skip_n)
+                        skip_n=skip_n, meter=meter)
+                if meter is not None:
+                    # the residual (event handlers, splits, loop
+                    # bookkeeping) closes the decomposition: bucket
+                    # seconds sum to the measured pass wall
+                    wall = time_mod.perf_counter() - t_anchor
+                    meter.account("host_dispatch",
+                                  wall - (meter.total_seconds() - acc0))
+                    meter.publish_stats(profiler.global_stat)
+                    t_anchor = time_mod.perf_counter()
+                    acc0 = meter.total_seconds()
                 summary = _mean_metrics(pass_metrics)
                 summary["cost"] = float(np.mean(pass_costs)) \
                     if pass_costs else 0.0
@@ -330,28 +381,122 @@ class SGD:
             scope=self.scope, batch_size=batch,
             what="SGD.train step program", plan=self.exe.plan)
 
+    def _maybe_price_flops(self, feed, meter):
+        """One-shot MFU numerator: price the step program through the
+        calibrated cost model at the first batch (batch size now known).
+        Unpriceable programs simply leave MFU off."""
+        if meter is None or getattr(self, "_flops_priced", True):
+            return
+        self._flops_priced = True
+        from .trace.goodput import program_flops
+
+        batch = 1
+        for v in feed.values():
+            shape = getattr(v, "shape", None)
+            if shape:
+                batch = int(shape[0])
+                break
+        # the static analysis costs ~10ms — cache per batch size so
+        # repeated train() calls on one trainer price it once
+        cached = getattr(self, "_flops_cache", None)
+        if cached is not None and cached[0] == batch:
+            meter.set_program_flops(cached[1])
+            return
+        fetches = [self.cost.name] + [v.name for v in
+                                      self.metrics.values()]
+        flops = program_flops(
+            self.main_program, self._feed_names, fetches,
+            scope=self.scope, batch_size=batch, plan=self.exe.plan)
+        self._flops_cache = (batch, flops)
+        meter.set_program_flops(flops)
+
+    def _flight_state(self):
+        """Live-state source for the flight recorder: where the run is,
+        its goodput waterfall, and the last-N step walls."""
+        meter = getattr(self, "goodput", None)
+        return {
+            "position": dict(getattr(self, "_flight_pos", {}) or {}),
+            "goodput": meter.snapshot() if meter is not None else None,
+            "recent_step_walls_s": [
+                round(w, 6) for w in getattr(self, "_step_walls", [])],
+        }
+
     def _run_pass_sync(self, pass_id, reader, event_handler, rs=None,
-                       skip_n=0):
+                       skip_n=0, meter=None):
+        import time as time_mod
+
         from . import trace
 
+        m = meter
+        perf = time_mod.perf_counter
+        exe = self.exe
         pass_costs, pass_metrics = [], []
-        for batch_id, batch in enumerate(reader()):
-            if batch_id < skip_n:
-                continue  # consumed before the interrupt (resume replay)
+        it = enumerate(reader())
+        while True:
+            # the reader pull is the data-wait bucket; a master-backed
+            # reader (StreamingTrainer) accounts its queue idle +
+            # rollback time into the shared meter DURING next(), so
+            # those inner seconds are re-attributed out of data_wait
+            if m is not None:
+                inner0 = (m.bucket_seconds("master_wait")
+                          + m.bucket_seconds("recovery_rollback"))
+                t_read0 = perf()
+            try:
+                batch_id, batch = next(it)
+                while batch_id < skip_n:
+                    # consumed before the interrupt (resume replay)
+                    batch_id, batch = next(it)
+            except StopIteration:
+                if m is not None:
+                    inner = (m.bucket_seconds("master_wait")
+                             + m.bucket_seconds("recovery_rollback")
+                             - inner0)
+                    m.account("data_wait", perf() - t_read0 - inner)
+                break
+            if m is not None:
+                inner = (m.bucket_seconds("master_wait")
+                         + m.bucket_seconds("recovery_rollback")
+                         - inner0)
+                m.account("data_wait", perf() - t_read0 - inner)
+                t_step0 = perf()
+                self._flight_pos["pass_id"] = pass_id
+                self._flight_pos["batch_id"] = batch_id
             if rs is not None:
-                rs.before_step()
+                # transient-fault retries (backoff included) are
+                # recovery, not compute
+                if m is not None:
+                    with m.measure("recovery_rollback"):
+                        rs.before_step()
+                else:
+                    rs.before_step()
             event_handler(evt.BeginIteration(pass_id, batch_id))
             # REGISTER_TIMER("TrainBatch") parity: the step timer
             # accumulates in the global StatSet, which RunLog dumps
             # (and print_all_status prints) at pass end
+            device_dt = step_mfu = None
             with trace.span("trainer/iteration", pass_id=pass_id,
                             batch_id=batch_id) as sp, \
                     profiler.timer("trainer/step"):
+                if m is not None:
+                    t_feed0 = perf()
                 feed = self.feeder.feed(batch)
+                if m is not None:
+                    m.account("data_wait", perf() - t_feed0)
                 self._maybe_check_mem_budget(feed)
-                fetched = self.exe.run(self.main_program, feed=feed,
-                                       fetch_list=self._fetch_list(),
-                                       scope=self.scope)
+                self._maybe_price_flops(feed, m)
+                if m is not None:
+                    fc0 = exe.fresh_compile_seconds
+                    t_run0 = perf()
+                fetched = exe.run(self.main_program, feed=feed,
+                                  fetch_list=self._fetch_list(),
+                                  scope=self.scope)
+                if m is not None:
+                    run_dt = perf() - t_run0
+                    fc_dt = min(exe.fresh_compile_seconds - fc0, run_dt)
+                    device_dt = run_dt - fc_dt
+                    m.account("fresh_compile", fc_dt)
+                    m.account("device_compute", device_dt)
+                    step_mfu = m.note_step(device_dt)
                 cost, mvals = self._split(fetched)
                 if sp is not None:
                     sp.set_attr("cost", cost)
@@ -361,14 +506,29 @@ class SGD:
                 bs = len(batch)
             except TypeError:
                 bs = None
+            host_dt = None
+            if m is not None:
+                step_wall = perf() - t_step0
+                host_dt = max(0.0, step_wall - (device_dt or 0.0))
+                self._step_walls.append(step_wall)
             event_handler(evt.EndIteration(pass_id, batch_id, cost,
-                                           mvals, batch_size=bs))
-            if rs is not None and rs.after_step(pass_id, batch_id, bs):
-                break  # graceful interrupt: checkpoint already written
+                                           mvals, batch_size=bs,
+                                           host_wall_s=host_dt,
+                                           device_wall_s=device_dt,
+                                           mfu=step_mfu))
+            if rs is not None:
+                # a due/periodic save stalls the loop right here
+                if m is not None:
+                    with m.measure("checkpoint_stall"):
+                        stop = rs.after_step(pass_id, batch_id, bs)
+                else:
+                    stop = rs.after_step(pass_id, batch_id, bs)
+                if stop:
+                    break  # graceful interrupt: checkpoint written
         return pass_costs, pass_metrics
 
     def _run_pass_async(self, pass_id, reader, event_handler, depth,
-                        rs=None, skip_n=0):
+                        rs=None, skip_n=0, meter=None):
         """The overlapped pipeline: a background feeder stage keeps
         device-resident batches ready, the dispatch loop enqueues step
         k+1 while step k executes (bounded at ``depth`` in flight), and
@@ -377,6 +537,7 @@ class SGD:
         ``trainer/resolve`` phases carrying a ``queue_depth`` attr, so
         tools/trace_summary.py --pipeline shows host gap vs device
         time."""
+        import time as time_mod
         from collections import deque
 
         import jax
@@ -407,11 +568,22 @@ class SGD:
                                       else v)
                                   for k, v in feed.items()}
 
-        pending = deque()  # (batch_id, batch_size, RunHandle)
+        m = meter
+        perf = time_mod.perf_counter
+        exe = self.exe
+        pending = deque()  # (batch_id, batch_size, RunHandle, host_wall)
         pass_costs, pass_metrics = [], []
+        # device wall per step on the overlapped path = the
+        # resolve-ordered interval (EndIteration k-1 -> EndIteration k):
+        # with the window full the device is the bottleneck, so that
+        # interval IS the step's device time — the MFU denominator and
+        # the runlog's examples/sec base
+        last_resolve = [None]
 
         def resolve_oldest():
-            batch_id, bs, handle = pending.popleft()
+            batch_id, bs, handle, host_dt = pending.popleft()
+            if m is not None:
+                t0 = perf()
             with trace.span("trainer/resolve", pass_id=pass_id,
                             batch_id=batch_id,
                             queue_depth=len(pending) + 1) as sp, \
@@ -419,10 +591,23 @@ class SGD:
                 cost, mvals = self._split(handle.result())
                 if sp is not None:
                     sp.set_attr("cost", cost)
+            device_dt = step_mfu = None
+            if m is not None:
+                now = perf()
+                # host blocked on device results: the goodput numerator
+                m.account("device_compute", now - t0)
+                if last_resolve[0] is not None:
+                    device_dt = now - last_resolve[0]
+                    step_mfu = m.note_step(device_dt)
+                    self._step_walls.append(device_dt)
+                last_resolve[0] = now
             pass_costs.append(cost)
             pass_metrics.append(mvals)
             event_handler(evt.EndIteration(pass_id, batch_id, cost,
-                                           mvals, batch_size=bs))
+                                           mvals, batch_size=bs,
+                                           host_wall_s=host_dt,
+                                           device_wall_s=device_dt,
+                                           mfu=step_mfu))
             if rs is not None:
                 # defer: a snapshot here would race the in-flight window
                 # (donated state) — the dispatch loop drains, then
@@ -433,19 +618,47 @@ class SGD:
                                   transform=to_device)
         stopped = False
         try:
-            for batch_id, bs, feed in stream():
+            sit = iter(stream())
+            while True:
+                # blocked on the background feed stage = data wait
+                if m is not None:
+                    t_read0 = perf()
+                try:
+                    batch_id, bs, feed = next(sit)
+                except StopIteration:
+                    if m is not None:
+                        m.account("data_wait", perf() - t_read0)
+                    break
+                if m is not None:
+                    m.account("data_wait", perf() - t_read0)
+                    self._flight_pos["pass_id"] = pass_id
+                    self._flight_pos["batch_id"] = batch_id
                 if rs is not None:
-                    rs.before_step()
+                    if m is not None:
+                        with m.measure("recovery_rollback"):
+                            rs.before_step()
+                    else:
+                        rs.before_step()
                 self._maybe_check_mem_budget(feed)
+                self._maybe_price_flops(feed, m)
                 event_handler(evt.BeginIteration(pass_id, batch_id))
+                host_dt = None
+                if m is not None:
+                    fc0 = exe.fresh_compile_seconds
+                    t_disp0 = perf()
                 with trace.span("trainer/dispatch", pass_id=pass_id,
                                 batch_id=batch_id,
                                 queue_depth=len(pending)), \
                         profiler.timer("trainer/dispatch"):
-                    handle = self.exe.run_async(self.main_program, feed=feed,
-                                                fetch_list=self._fetch_list(),
-                                                scope=self.scope)
-                pending.append((batch_id, bs, handle))
+                    handle = exe.run_async(self.main_program, feed=feed,
+                                           fetch_list=self._fetch_list(),
+                                           scope=self.scope)
+                if m is not None:
+                    host_dt = perf() - t_disp0
+                    fc_dt = min(exe.fresh_compile_seconds - fc0, host_dt)
+                    m.account("fresh_compile", fc_dt)
+                    m.account("host_dispatch", host_dt - fc_dt)
+                pending.append((batch_id, bs, handle, host_dt))
                 while len(pending) >= depth:
                     resolve_oldest()
                 if rs is not None and rs.pause_requested:
@@ -453,13 +666,22 @@ class SGD:
                     # resolved == dispatched == scope state, then save
                     while pending:
                         resolve_oldest()
-                    if rs.commit(pass_id):
+                    if m is not None:
+                        with m.measure("checkpoint_stall"):
+                            stop = rs.commit(pass_id)
+                    else:
+                        stop = rs.commit(pass_id)
+                    if stop:
                         stopped = True
                         break
             while pending:  # drain: every EndIteration precedes EndPass
                 resolve_oldest()
             if not stopped and rs is not None and rs.pause_requested:
-                rs.commit(pass_id)
+                if m is not None:
+                    with m.measure("checkpoint_stall"):
+                        rs.commit(pass_id)
+                else:
+                    rs.commit(pass_id)
         except BaseException:
             # In-flight steps' state writes have already landed in the
             # scope; drain their handles (costs/metrics + EndIteration
@@ -471,7 +693,7 @@ class SGD:
                 try:
                     resolve_oldest()
                 except BaseException:
-                    for _, _, h in pending:
+                    for _, _, h, _ in pending:
                         try:
                             h.block()
                         except Exception:

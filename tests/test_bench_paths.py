@@ -221,6 +221,25 @@ def test_train_pipeline_bench_path_runs():
     assert "host_gap_sync_ms" in res and "host_gap_async_ms" in res
 
 
+def test_goodput_bench_path_runs():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    res = _bench().bench_goodput(jax, pt, layers, batch=8, dim=16,
+                                 depth=3, steps=4, warmup=1, rounds=1)
+    assert res["off_ms_per_step"] > 0
+    assert res["on_ms_per_step"] > 0
+    assert res["async_depth"] == 3
+    # overhead is a subtraction; both signs are legal on a noisy CPU
+    # smoke run, but the record keys must exist for PERF.md
+    assert "overhead_pct" in res
+    # the instrumented run actually attributed time somewhere
+    assert res["buckets_attributed"] >= 1
+    assert 0.0 <= (res["goodput_fraction"] or 0.0) <= 1.0
+
+
 @pytest.mark.slow  # tier-1 budget (PR 12): 31s — two resnet50 compiles;
 # the op-cut + pass-stats contracts are pinned tier-1 in
 # test_transpiler.py, so only the bench-path crash guard rides here

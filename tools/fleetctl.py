@@ -27,8 +27,11 @@ omit keep the fleet's default (greedy) behavior byte-identical.
 latency columns (TTFT/TPOT p50/p99 from the replica's histograms) and,
 when the fleet declares an SLO, per-objective attainment, error-budget
 remaining, and multi-window burn rates (``--table`` renders the same
-data as a terminal table). ``flightdump`` fetches the fleet's flight
-recorder bundle (recent spans + metric history + engine state).
+data as a terminal table). With ``--master host:port`` (the training
+master's JSON-lines TCP plane) ``status`` also prints a TRAIN row:
+fleet goodput %, MFU, step-time skew, and flagged stragglers.
+``flightdump`` fetches the fleet's flight recorder bundle (recent
+spans + metric history + engine state).
 
 Exit status: 0 on success, 1 on an HTTP/transport error (the body's
 ``error`` field is printed to stderr).
@@ -37,9 +40,45 @@ from __future__ import annotations
 
 import argparse
 import json
+import socket
 import sys
 import urllib.error
 import urllib.request
+
+
+def master_call(addr: str, timeout: float = 10.0, **req):
+    """One JSON-lines request/response round trip to the training
+    master (it speaks newline-delimited JSON over TCP, not HTTP)."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        f = s.makefile("r", encoding="utf-8")
+        line = f.readline()
+    resp = json.loads(line or "{}")
+    if not resp.get("ok", False):
+        raise RuntimeError(resp.get("error") or "master error")
+    return resp
+
+
+def render_train_row(train: dict) -> str:
+    """One-line training-observatory summary from the master's
+    train_status aggregate (goodput %, MFU, step-time skew,
+    flagged stragglers)."""
+    gp = train.get("goodput")
+    mfu = train.get("mfu")
+    skew = train.get("skew")
+    stragglers = train.get("stragglers") or []
+    parts = [f"trainers={len(train.get('trainers') or {})}"]
+    if gp is not None:
+        parts.append(f"goodput={100.0 * gp:.1f}%")
+    if mfu is not None:
+        parts.append(f"mfu={mfu:.4f}")
+    if skew is not None:
+        parts.append(f"p99/p50={skew:g}x")
+    parts.append("stragglers=" + (",".join(stragglers) if stragglers
+                                  else "none"))
+    return f"{'TRAIN':<10} " + " ".join(parts)
 
 
 def call(url: str, method: str = "GET", body: dict | None = None,
@@ -138,6 +177,10 @@ def main(argv=None) -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--url", required=True,
                     help="fleet base URL (Fleet.serve_http)")
+    ap.add_argument("--master", default=None,
+                    help="training master host:port (JSON-lines TCP); "
+                         "status gains a TRAIN row — fleet goodput %%, "
+                         "MFU, and flagged stragglers")
     ap.add_argument("--timeout", type=float, default=120.0)
     sub = ap.add_subparsers(dest="cmd", required=True)
     p = sub.add_parser("status", help="replica health, breakers, "
@@ -200,8 +243,19 @@ def main(argv=None) -> int:
     try:
         if args.cmd == "status":
             out = call(args.url + "/fleet/status", timeout=args.timeout)
+            if args.master:
+                try:
+                    out["train"] = master_call(
+                        args.master, op="train_status")["train"]
+                except (OSError, RuntimeError, ValueError) as exc:
+                    out["train_error"] = str(exc)
             if args.table:
                 print(render_status_table(out))
+                if out.get("train") is not None:
+                    print()
+                    print(render_train_row(out["train"]))
+                elif out.get("train_error"):
+                    print(f"\nTRAIN      unreachable: {out['train_error']}")
                 return 0
         elif args.cmd == "drain":
             out = call(args.url + "/fleet/drain", "POST",

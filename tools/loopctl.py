@@ -12,7 +12,8 @@ the operator's view of the ``freshness_s`` SLO:
 Stages:
     log      age of the newest SEALED impression segment (+ drop count)
     join     age of the newest sealed joined segment, pending window
-    train    newest checkpoint generation + its age
+    train    newest checkpoint generation + its age; with --runlog
+             (the trainer's RunLog journal) also goodput % and MFU
     publish  fleet weights block (published step / staleness) when
              --url is given
 
@@ -33,11 +34,45 @@ def _fleet_weights(url: str):
         return json.load(r).get("weights")
 
 
+def _runlog_goodput(path: str):
+    """Goodput fraction + MFU EMA from a trainer RunLog journal: the
+    newest pass_end's cumulative ``goodput/*`` StatSet mirror and the
+    newest iteration's ``mfu_ema`` gauge."""
+    buckets = {}
+    mfu_ema = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                if row.get("type") == "pass_end":
+                    for name, s in (row.get("stat_set") or {}).items():
+                        if name.startswith("goodput/"):
+                            buckets[name[len("goodput/"):]] = \
+                                float(s.get("total_ms", 0.0))
+                elif row.get("type") == "iteration" \
+                        and row.get("mfu_ema") is not None:
+                    mfu_ema = float(row["mfu_ema"])
+    except (OSError, ValueError) as exc:
+        return {"error": str(exc)}
+    total = sum(buckets.values())
+    out = {"mfu": mfu_ema}
+    if total > 0:
+        out["goodput"] = round(
+            buckets.get("device_compute", 0.0) / total, 4)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--log-dir", required=True)
     ap.add_argument("--joined-dir", required=True)
     ap.add_argument("--ckpt-dir")
+    ap.add_argument("--runlog",
+                    help="trainer RunLog journal: adds goodput %% / MFU "
+                         "to the train row")
     ap.add_argument("--url", help="fleet HTTP plane for the publish row")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
@@ -62,6 +97,8 @@ def main(argv=None) -> int:
         lost += int(m.get("lost_bytes") or 0)
     status["torn_segments"] = torn
     status["torn_lost_bytes"] = lost
+    if args.runlog:
+        status["goodput"] = _runlog_goodput(args.runlog)
     if args.url:
         try:
             status["publish"] = _fleet_weights(args.url.rstrip("/"))
@@ -82,9 +119,17 @@ def main(argv=None) -> int:
     row("join", status.get("join_lag_s"),
         f"backlog={status.get('backlog_segments')} "
         f"fed_examples={status.get('examples_enqueued')}")
+    gp = status.get("goodput") or {}
+    gp_extra = ""
+    if gp.get("goodput") is not None:
+        gp_extra += f" goodput={100.0 * gp['goodput']:.1f}%"
+    if gp.get("mfu") is not None:
+        gp_extra += f" mfu={gp['mfu']:.4f}"
     if args.ckpt_dir:
         row("train", status.get("train_lag_s"),
-            f"step={status.get('trained_step')}")
+            f"step={status.get('trained_step')}" + gp_extra)
+    elif gp_extra:
+        row("train", None, gp_extra.strip())
     pub = status.get("publish")
     if pub:
         row("publish", pub.get("staleness_s"),

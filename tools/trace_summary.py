@@ -12,6 +12,10 @@ name, sorted by total — the offline analogue of
 
 ``--runlog`` summarizes a trace.RunLog training journal instead:
 per-pass cost, examples/sec, and the pass-end StatSet highlights.
+``--goodput`` renders the training-observatory waterfall from the same
+journal — per-bucket attributed seconds (device-compute vs badput), the
+MFU trend, and (with ``--master-metrics FILE``, a saved master
+Prometheus exposition) the per-trainer step-time skew table.
 ``--pipeline`` shows the async-trainer host-gap view; ``--resilience``
 shows checkpoint stall (ckpt/save vs ckpt/write), retry pressure
 (retry/attempt spans per policy), and the elastic-training lease plane:
@@ -100,6 +104,109 @@ def summarize_runlog(path):
             f"mean cost={m.get('cost', '?')}"
             + (f", {eps} examples/s" if eps else ""))
     return "\n".join(lines) if lines else "(no passes)"
+
+
+#: goodput taxonomy display order (paddle_tpu.trace.goodput.BUCKETS) —
+#: hardcoded so the tool summarizes journals without importing jax
+_GOODPUT_BUCKETS = ("device_compute", "host_dispatch", "data_wait",
+                    "fresh_compile", "checkpoint_stall", "master_wait",
+                    "recovery_rollback")
+
+
+def _parse_trainer_series(text):
+    """``trainer_<metric>{trainer="id"} value`` rows from a master
+    Prometheus exposition -> {trainer: {metric: value}}."""
+    import re
+
+    out = {}
+    pat = re.compile(r'^trainer_(\w+)\{trainer="([^"]+)"\}\s+(\S+)$')
+    for line in text.splitlines():
+        m = pat.match(line.strip())
+        if m:
+            metric, tid, val = m.group(1), m.group(2), float(m.group(3))
+            out.setdefault(tid, {})[metric] = val
+    return out
+
+
+def summarize_goodput(path, master_metrics=None):
+    """Goodput waterfall from a RunLog journal: where every attributed
+    second went (per-bucket seconds and share), the MFU trend from the
+    per-iteration gauges, and — given ``--master-metrics`` (a saved
+    master Prometheus exposition) — the per-trainer step-time skew
+    table the straggler detector works from."""
+    iters = []
+    buckets = {}
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        t = row.get("type")
+        if t == "iteration":
+            iters.append(row)
+        elif t == "pass_end":
+            # publish_stats mirrors cumulative bucket seconds into the
+            # StatSet, so the LAST pass_end carries the run totals
+            for name, s in (row.get("stat_set") or {}).items():
+                if name.startswith("goodput/"):
+                    buckets[name[len("goodput/"):]] = \
+                        float(s.get("total_ms", 0.0)) / 1e3
+    lines = []
+    total = sum(buckets.values())
+    wall = sum(r.get("wall_ms", 0.0) for r in iters) / 1e3
+    if buckets:
+        lines.append(f"{'bucket':<20}{'seconds':>12}{'share':>9}")
+        lines.append("-" * 41)
+        ordered = [b for b in _GOODPUT_BUCKETS if b in buckets] + \
+            sorted(set(buckets) - set(_GOODPUT_BUCKETS))
+        for b in ordered:
+            s = buckets[b]
+            pct = 100.0 * s / total if total > 0 else 0.0
+            lines.append(f"{b:<20}{s:>12.3f}{pct:>8.1f}%")
+        lines.append(f"{'total attributed':<20}{total:>12.3f}")
+        if wall > 0:
+            lines.append(f"{'measured step wall':<20}{wall:>12.3f}"
+                         f"{100.0 * total / wall:>8.1f}% attributed")
+        good = buckets.get("device_compute", 0.0)
+        lines.append(f"goodput: {100.0 * good / total:.1f}% "
+                     "(device-compute share of attributed time)"
+                     if total > 0 else "goodput: n/a")
+    else:
+        lines.append("(no goodput/* stats in any pass_end — run with "
+                     "SGD.train(goodput=...) enabled)")
+    mfus = [r["mfu"] for r in iters if r.get("mfu") is not None]
+    if mfus:
+        emas = [r["mfu_ema"] for r in iters if r.get("mfu_ema") is not None]
+        lines.append("")
+        lines.append(f"MFU: first={mfus[0]:.4f} last={mfus[-1]:.4f} "
+                     f"mean={sum(mfus) / len(mfus):.4f}"
+                     + (f" ema={emas[-1]:.4f}" if emas else "")
+                     + f"  ({len(mfus)} steps)")
+    if master_metrics:
+        series = _parse_trainer_series(open(master_metrics).read())
+        if series:
+            steps = [d.get("step_seconds") for d in series.values()
+                     if d.get("step_seconds")]
+            p50 = sorted(steps)[len(steps) // 2] if steps else 0.0
+            lines.append("")
+            head = (f"{'trainer':<16}{'step s':>10}{'skew':>7}"
+                    f"{'goodput':>9}{'mfu':>8}{'flag':>6}")
+            lines.append(head)
+            lines.append("-" * len(head))
+            for tid in sorted(series):
+                d = series[tid]
+                ss = d.get("step_seconds")
+                skew = (ss / p50) if ss and p50 > 0 else None
+                gp = d.get("goodput_fraction")
+                mfu = d.get("mfu")
+                lines.append(
+                    f"{tid:<16}"
+                    f"{(f'{ss:.4f}' if ss is not None else '-'):>10}"
+                    f"{(f'{skew:.2f}x' if skew else '-'):>7}"
+                    f"{(f'{gp:.3f}' if gp is not None else '-'):>9}"
+                    f"{(f'{mfu:.3f}' if mfu is not None else '-'):>8}"
+                    f"{('STRAG' if d.get('straggler') else ''):>6}")
+    return "\n".join(lines)
 
 
 def summarize_pipeline(events):
@@ -364,6 +471,12 @@ def main(argv=None):
                     help="only span names with this prefix")
     ap.add_argument("--runlog", action="store_true",
                     help="input is a trace.RunLog training journal")
+    ap.add_argument("--goodput", action="store_true",
+                    help="goodput/badput waterfall + MFU trend from a "
+                         "RunLog journal (SGD.train(goodput=...) runs)")
+    ap.add_argument("--master-metrics", default=None,
+                    help="with --goodput: a saved master Prometheus "
+                         "exposition; adds the per-trainer skew table")
     ap.add_argument("--pipeline", action="store_true",
                     help="host-gap view of trainer dispatch/resolve spans")
     ap.add_argument("--resilience", action="store_true",
@@ -381,6 +494,10 @@ def main(argv=None):
         return 0
     if len(args.trace) != 1:
         ap.error("multiple trace files need --distributed")
+    if args.goodput:
+        print(summarize_goodput(args.trace[0],
+                                master_metrics=args.master_metrics))
+        return 0
     if args.runlog:
         print(summarize_runlog(args.trace[0]))
         return 0
