@@ -2186,6 +2186,152 @@ def bench_disagg(jax, pt, layers, models, vocab=64, d=32, L=2, H=4,
     }
 
 
+def bench_recovery(jax, pt, layers, models, vocab=32, d=16, L=2, H=2,
+                   tmax=64, slots=8, page_size=8, n_requests=8,
+                   prompt_len=4, max_new=12, waves=3, kill_after=4):
+    """Work-preserving recovery A/B: the same seeded-sampled workload on
+    a 2-replica paged fleet, one leg uninterrupted and one leg under a
+    kill storm (a fault-plan ``replica_kill`` hard-crashes a replica
+    mid-stream EVERY wave; it is revived between waves). The legs are
+    interleaved wave-by-wave so machine drift cancels. The record:
+    availability under the storm (must be 1.0 — lineage resume turns a
+    crash into a retryable, never a failure), bitwise token identity
+    against the quiet leg, recovered-token reuse (the killed leg decodes
+    STRICTLY FEWER tokens than the quiet leg: crashed streams re-enter
+    via chunked prefill, never re-decode), the bounded recovery-prefill
+    bill, and added TTFT on the recovered streams (tagged per-request by
+    the engine). Host/router plane: the CPU row is the witness."""
+    from paddle_tpu.decoding import SamplingParams
+    from paddle_tpu.resilience import FaultPlan, Retry
+    from paddle_tpu.serving import Fleet, GenerationEngine, LMSpec, Server
+
+    spec = LMSpec(vocab_size=vocab, d_model=d, n_layers=L, num_heads=H,
+                  max_len=tmax)
+    base = _lm_serving_scope(pt, layers, models, vocab, d, L, H, tmax)
+    weights = {n: base.get(n) for n in base.keys()}
+
+    def scope():
+        s = pt.Scope()
+        for n, v in weights.items():
+            s.set(n, v)
+        return s
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, vocab, (prompt_len,)).astype("int64")
+               for _ in range(n_requests)]
+    sampling = SamplingParams(temperature=0.7, top_k=4, seed=11)
+
+    def build_leg():
+        engines = [GenerationEngine(spec, scope(), slots=slots,
+                                    page_size=page_size, kv_cache="paged")
+                   for _ in range(2)]
+        for e in engines:
+            e.warmup()
+        # patient retries: mid-wave both breakers can be open for a beat
+        # (the quarantined kill + the probe window) — the storm outwaits
+        # the recovery timer instead of failing fast through it
+        fleet = Fleet([Server(e) for e in engines], hedge=False,
+                      retry=Retry(max_attempts=8, backoff=0.05,
+                                  multiplier=2.0, max_backoff=0.5,
+                                  name="fleet"))
+        return engines, fleet, {"lat": [], "failed": [], "outs": []}
+
+    def wave(engines, fleet, acc, kill):
+        plan = FaultPlan()
+        if kill:
+            plan.at(kind="replica_kill", after_tokens=kill_after)
+        with plan.active():
+            t0s, futs = [], []
+            for p in prompts:
+                t0s.append(time.perf_counter())
+                futs.append(fleet.submit({"prompt": p},
+                                         max_new_tokens=max_new,
+                                         sampling_params=sampling))
+            got = []
+            for t0, f in zip(t0s, futs):
+                try:
+                    got.append(np.asarray(f.result(timeout=120)))
+                    acc["lat"].append(time.perf_counter() - t0)
+                except Exception as exc:  # noqa: BLE001 - availability
+                    acc["failed"].append(repr(exc)[:100])
+                    got.append(None)
+            acc["outs"].append(got)
+        for e in engines:
+            e.revive()
+
+    quiet = build_leg()
+    storm = build_leg()
+    try:
+        for _ in range(waves):  # interleaved: quiet wave, then storm wave
+            wave(*quiet, kill=False)
+            wave(*storm, kill=True)
+
+        def close(engines, fleet, acc):
+            fc = fleet.metrics.snapshot()["counters"]
+            ec = [e.metrics.snapshot()["counters"] for e in engines]
+            rows = [r for e in engines for r in e._recent
+                    if r.get("ttft_s") is not None]
+            lat = sorted(acc["lat"])
+
+            def pq(xs, q):
+                return (round(xs[min(len(xs) - 1,
+                                     int(round(q * (len(xs) - 1))))]
+                              * 1e3, 3) if xs else None)
+
+            total = len(lat) + len(acc["failed"])
+            return {
+                "availability": round(len(lat) / max(1, total), 4),
+                "ok": len(lat), "failed": len(acc["failed"]),
+                "p50_ms": pq(lat, 0.50), "p99_ms": pq(lat, 0.99),
+                "decode_tokens": sum(c.get("decode_tokens", 0)
+                                     for c in ec),
+                "replica_kills": sum(c.get("replica_kills", 0)
+                                     for c in ec),
+                "requests_recovered": fc.get("requests_recovered", 0),
+                "recovered_tokens": fc.get("recovered_tokens", 0),
+                "recovery_prefill_tokens": sum(
+                    c.get("recovery_prefill_tokens", 0) for c in ec),
+                "ttft_ms": {
+                    "fresh": pq(sorted(r["ttft_s"] for r in rows
+                                       if not r.get("resumed")), 0.50),
+                    "recovered": pq(sorted(r["ttft_s"] for r in rows
+                                           if r.get("resumed")), 0.50),
+                },
+            }
+
+        q = close(*quiet)
+        s = close(*storm)
+    finally:
+        quiet[1].stop()
+        storm[1].stop()
+
+    # bitwise identity: every storm wave must match the quiet baseline
+    token_exact = all(
+        o is not None and w is not None and np.array_equal(o, w)
+        for so, qo in zip(storm[2]["outs"], quiet[2]["outs"])
+        for o, w in zip(so, qo))
+    # bounded prefill bill: a recovered stream re-prefills at most its
+    # prompt + everything emitted before the crash — never more
+    bill_cap = s["requests_recovered"] * (prompt_len + max_new) \
+        if s["requests_recovered"] else 0
+    added = (None if s["ttft_ms"]["recovered"] is None
+             or q["ttft_ms"]["fresh"] is None
+             else round(s["ttft_ms"]["recovered"]
+                        - q["ttft_ms"]["fresh"], 3))
+    return {
+        "waves": waves, "requests_per_wave": n_requests,
+        "max_new": max_new, "kill_after_tokens": kill_after,
+        "token_exact": token_exact,
+        "tokens_reused": max(0, q["decode_tokens"] - s["decode_tokens"]),
+        "no_redecode": s["decode_tokens"] < q["decode_tokens"],
+        "prefill_bill_bounded": (
+            s["recovery_prefill_tokens"] <= bill_cap),
+        "added_ttft_recovered_ms": added,
+        "quiet": q,
+        "storm": s,
+    }
+
+
 def bench_obs_overhead(jax, pt, layers, models, vocab=64, d=128, L=3, H=4,
                        tmax=256, slots=8, page_size=16, n_requests=24,
                        max_new=24, rounds=5):
@@ -2706,6 +2852,11 @@ def run_bench(platform):
     # + zero prefill recompute asserted in-bench (host/cache-migration
     # plane; the CPU row is the witness)
     step("disagg", bench_disagg, jax, pt, layers, models)
+    # work-preserving recovery A/B under a replica kill storm:
+    # availability 1.0 + bitwise identity + recovered-token reuse +
+    # bounded recovery-prefill bill + added TTFT on recovered streams
+    # (lineage/router plane; the CPU row is the witness)
+    step("recovery", bench_recovery, jax, pt, layers, models)
     # elastic-training chaos relay: zombie fence + crash + rejoin on one
     # master queue — recovery wall + steps retrained + exactly-once +
     # bitwise checks (pure control plane; the CPU row is the witness)

@@ -15,10 +15,18 @@ format; ONLY sealed segments are real. Every sealed joined meta carries
 ``source``: the exact per-impression-segment record indexes its
 examples cover. On restart the joiner rebuilds coverage from sealed
 metas, discards any ``.open`` joined tail (counted), and re-ingests
-precisely the uncovered impressions — a crash loses the in-memory
-pending window (those impressions re-expire as negatives: bounded,
-counted) but can never emit a training example twice, because coverage
-is committed atomically with the examples it describes.
+precisely the uncovered impressions — coverage is committed atomically
+with the examples it describes, so an example can never be emitted
+twice.
+
+The in-memory join WINDOW is durable too: every pending impression,
+parked outcome, and removal appends one length-prefixed record to a
+``window.spill`` sidecar (same format as the segments, flushed per
+write). A restart replays the sidecar — pending impressions keep their
+ORIGINAL deadlines and parked outcomes their TTLs, so a joiner crash
+no longer turns in-window outcomes into false negatives. The sidecar
+is compacted (atomic tmp+rename of the live entries) whenever drops
+dominate and at ``seal()``/``close()``.
 """
 from __future__ import annotations
 
@@ -60,6 +68,9 @@ class OutcomeJoiner:
         self.replayed = 0            # re-ingested after restart
         self.discarded_open_examples = 0
         self.torn_source_bytes = 0
+        self.window_spilled = 0      # window ops appended to the sidecar
+        self.window_replayed = 0     # window entries restored on restart
+        self.spill_errors = 0        # sidecar writes that failed (shed)
         # state
         self._lock = threading.RLock()
         #: rid -> (segment_name, record_idx, record, deadline)
@@ -73,7 +84,12 @@ class OutcomeJoiner:
         self._open_records = 0
         self._open_source: Dict[str, list] = {}
         self._next_seg = 0
+        # crash-safe window sidecar (see module docstring)
+        self._spill_path = os.path.join(self.out_dir, "window.spill")
+        self._spill_fh = None
+        self._spill_drops = 0
         self._recover()
+        self._replay_window()
 
     # -- restart safety ------------------------------------------------
     def _recover(self) -> None:
@@ -107,6 +123,84 @@ class OutcomeJoiner:
             self.torn_source_bytes += lost
             os.remove(torn)
 
+    # -- window durability (the spill sidecar) -------------------------
+    def _replay_window(self) -> None:
+        """Rebuild the pending/parked window from ``window.spill``:
+        replay ops in append order (last op per rid wins), skip
+        anything coverage says was already durably emitted, keep the
+        ORIGINAL deadlines — a restart continues the window, it does
+        not restart it."""
+        if not os.path.exists(self._spill_path):
+            return
+        pend: Dict[str, Tuple[str, int, dict, float]] = {}
+        park: Dict[str, Tuple[dict, float]] = {}
+        for _, op in read_records(self._spill_path):
+            rid, kind = op.get("rid"), op.get("op")
+            if rid is None:
+                continue
+            if kind == "pending":
+                pend[rid] = (op["seg"], int(op["idx"]), op["rec"],
+                             float(op["deadline"]))
+                park.pop(rid, None)
+            elif kind == "parked":
+                park[rid] = (op["outcome"], float(op["deadline"]))
+                pend.pop(rid, None)
+            elif kind == "drop":
+                pend.pop(rid, None)
+                park.pop(rid, None)
+        for rid, (seg, idx, rec, deadline) in pend.items():
+            if idx in self._covered.get(seg, set()):
+                continue  # its example is already sealed
+            self._pending[rid] = (seg, idx, rec, deadline)
+            self.window_replayed += 1
+        for rid, (out, deadline) in park.items():
+            self._parked[rid] = (out, deadline)
+            self.window_replayed += 1
+        self._compact_spill()
+
+    def _spill(self, op: dict) -> None:
+        """One flushed length-prefixed append; failures shed (counted)
+        — durability of the window must never block the join path."""
+        try:
+            if self._spill_fh is None:
+                self._spill_fh = open(self._spill_path, "ab")
+            write_record(self._spill_fh, op)
+            self._spill_fh.flush()
+        except OSError:
+            self.spill_errors += 1
+            return
+        self.window_spilled += 1
+        if op.get("op") == "drop":
+            self._spill_drops += 1
+            live = len(self._pending) + len(self._parked)
+            if self._spill_drops > 2 * live + 64:
+                self._compact_spill()
+
+    def _spill_drop(self, rid: str) -> None:
+        self._spill({"op": "drop", "rid": rid})
+
+    def _compact_spill(self) -> None:
+        """Rewrite the sidecar as just the LIVE window (atomic
+        tmp+rename, like every other commit in the feedback plane)."""
+        if self._spill_fh is not None:
+            self._spill_fh.close()
+            self._spill_fh = None
+        tmp = self._spill_path + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                for rid, (seg, idx, rec, d) in self._pending.items():
+                    write_record(fh, {"op": "pending", "rid": rid,
+                                      "seg": seg, "idx": idx,
+                                      "rec": rec, "deadline": d})
+                for rid, (out, d) in self._parked.items():
+                    write_record(fh, {"op": "parked", "rid": rid,
+                                      "outcome": out, "deadline": d})
+            os.replace(tmp, self._spill_path)
+        except OSError:
+            self.spill_errors += 1
+            return
+        self._spill_drops = 0
+
     # -- outcome ingress -----------------------------------------------
     def post_outcome(self, request_id: str, outcome) -> str:
         """'joined' | 'parked' | 'duplicate'. ``outcome`` is a label
@@ -128,11 +222,14 @@ class OutcomeJoiner:
                 seg, idx, rec, _ = hit
                 self._emit(seg, idx, rec, label, extra,
                            t_outcome=self.clock())
+                self._spill_drop(request_id)
                 self.joined += 1
                 return "joined"
-            self._parked[request_id] = (
-                {"label": label, "extra": extra, "t": self.clock()},
-                self.clock() + self.park_ttl_s)
+            entry = ({"label": label, "extra": extra, "t": self.clock()},
+                     self.clock() + self.park_ttl_s)
+            self._parked[request_id] = entry
+            self._spill({"op": "parked", "rid": request_id,
+                         "outcome": entry[0], "deadline": entry[1]})
             return "parked"
 
     # -- impression ingress --------------------------------------------
@@ -160,11 +257,15 @@ class OutcomeJoiner:
                         out, _ = park
                         self._emit(seg, idx, rec, out["label"],
                                    out["extra"], t_outcome=out["t"])
+                        self._spill_drop(rid)
                         self.joined += 1
                         self.parked_joins += 1
                         continue
-                    self._pending[rid] = (
-                        seg, idx, rec, self.clock() + self.window_s)
+                    deadline = self.clock() + self.window_s
+                    self._pending[rid] = (seg, idx, rec, deadline)
+                    self._spill({"op": "pending", "rid": rid, "seg": seg,
+                                 "idx": idx, "rec": rec,
+                                 "deadline": deadline})
             self._expire()
         return self.stats()
 
@@ -175,10 +276,12 @@ class OutcomeJoiner:
             seg, idx, rec, _ = self._pending.pop(rid)
             self._emit(seg, idx, rec, self.negative_label, {},
                        t_outcome=None)
+            self._spill_drop(rid)
             self.expired_negatives += 1
         for rid in [r for r, (_, d) in self._parked.items()
                     if d <= now]:
             self._parked.pop(rid)
+            self._spill_drop(rid)
             self.orphan_outcomes += 1
 
     # -- example egress ------------------------------------------------
@@ -233,12 +336,18 @@ class OutcomeJoiner:
         self._open_source = {}
 
     def seal(self) -> None:
-        """Seal the open joined segment so the compactor can feed it."""
+        """Seal the open joined segment so the compactor can feed it;
+        compacts the window sidecar down to the live entries too."""
         with self._lock:
             self._seal_open()
+            self._compact_spill()
 
     def close(self) -> None:
         self.seal()
+        with self._lock:
+            if self._spill_fh is not None:
+                self._spill_fh.close()
+                self._spill_fh = None
 
     # -- observability -------------------------------------------------
     def oldest_pending_s(self) -> float:
@@ -259,6 +368,9 @@ class OutcomeJoiner:
                 "duplicate_outcomes": self.duplicate_outcomes,
                 "orphan_outcomes": self.orphan_outcomes,
                 "replayed": self.replayed,
+                "window_spilled": self.window_spilled,
+                "window_replayed": self.window_replayed,
+                "spill_errors": self.spill_errors,
                 "discarded_open_examples":
                     self.discarded_open_examples,
                 "pending": len(self._pending),

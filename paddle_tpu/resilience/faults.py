@@ -52,6 +52,23 @@ plane) adds trainer-granular kinds:
                       reconnecting client's next tokened call raises
                       ``FencedTokenError`` (the rejoin signal).
 
+The work-preserving serving-recovery matrix adds mid-stream kinds (the
+``replica_crash``/``slow_replica`` kinds fire BEFORE an attempt begins;
+these fire with generations in flight):
+
+- ``replica_kill``    serving engine, once ``after_tokens`` (default 1)
+                      tokens have been emitted engine-wide: the engine
+                      hard-dies mid-stream — every in-flight generation
+                      fails with ConnectionError, pages are released,
+                      and subsequent admissions raise EngineClosedError
+                      until ``revive()``. The fleet's lineage plane must
+                      resume every survivor on a healthy replica.
+- ``decode_leg_crash`` disagg remote decode leg, at KV handoff #k: the
+                      leg dies AFTER ``serialize_handoff`` released the
+                      prefill pages (the no-rollback window) — the
+                      DisaggEngine must fail over by re-prefilling the
+                      handoff context on another leg.
+
 Manual chaos runs go through ``--fault_plan`` (flags.py), e.g.
 ``--fault_plan=preempt@5,torn_checkpoint@3`` — the trainer parses it when
 no plan is installed programmatically.
@@ -65,7 +82,7 @@ from typing import List, Optional, Tuple
 FAULT_KINDS = ("crash", "preempt", "executor_error", "torn_checkpoint",
                "master_drop", "replica_crash", "slow_replica",
                "trainer_crash", "trainer_preempt_rejoin", "zombie_ack",
-               "master_partition")
+               "master_partition", "replica_kill", "decode_leg_crash")
 
 
 class SimulatedCrash(RuntimeError):
@@ -125,6 +142,22 @@ class FaultPlan:
                 from .. import profiler
 
                 profiler.global_stat.add_count(f"fault/{kind}", 1)
+                return dict(e.params)
+        return None
+
+    def peek(self, kind: str,
+             step: Optional[int] = None) -> Optional[dict]:
+        """Params of the first unfired entry matching (kind, step)
+        WITHOUT consuming it — for injection points that must check a
+        threshold carried in the params (e.g. ``replica_kill``'s
+        ``after_tokens``) before committing to fire."""
+        with self._lock:
+            for e in self._entries:
+                if e.fired or e.kind != kind:
+                    continue
+                if e.step is not None and step is not None \
+                        and e.step != step:
+                    continue
                 return dict(e.params)
         return None
 

@@ -30,7 +30,13 @@ model becomes a production server loop with
   prefill/decode disaggregation: split engine pools with KV handoff by
   refcounted page migration (same-process) or serialized page ranges
   over ``POST /v1/adopt`` (:class:`RemoteDecodeLeg`) — never a prefill
-  recompute.
+  recompute;
+- :class:`LineageStore` / :class:`LineageRecord` — work-preserving
+  recovery: every admitted generation's prompt + pinned sampling policy
+  + emitted-tokens-so-far, kept router-side so a replica that dies
+  mid-stream triggers a RESUME on a healthy replica (``resume_tokens``
+  chunk-prefill, token-exact by (request, seed) determinism) instead of
+  a failure.
 
 See demos/serving_lm.py and demos/serving_fleet.py for the end-to-end
 walkthroughs.
@@ -40,15 +46,16 @@ from .disagg import (DecodePool, DisaggEngine, PrefillPool,
                      RemoteDecodeLeg)
 from .engine import InferenceEngine, load_param_arrays, swap_scope_params
 from .errors import (BadRequestError, CacheExhaustedError,
-                     EngineClosedError, FleetOverloadedError,
-                     ModelNotFoundError, QueueFullError,
-                     ReplicaUnavailableError, RequestTimeoutError,
-                     ServingError)
+                     ConnectionDroppedError, EngineClosedError,
+                     FleetOverloadedError, ModelNotFoundError,
+                     QueueFullError, ReplicaUnavailableError,
+                     RequestTimeoutError, ServingError)
 from .fleet import Fleet, HttpReplica, LocalReplica, Replica
 from .generation import (GenerationEngine, LMSpec, PagedGenerationEngine,
                          RequestTimeline, spec_from_program_dict)
 from .metrics import MetricsRegistry
 from .paging import PagePool, PrefixIndex
+from .recovery import LineageRecord, LineageStore
 from .router import (CircuitBreaker, LeastLoadedPolicy, RoundRobinPolicy,
                      Router, SessionAffinityPolicy)
 from .server import Server
@@ -65,7 +72,9 @@ __all__ = [
     "SessionAffinityPolicy", "load_param_arrays", "swap_scope_params",
     "ModelRegistry", "Tenant", "MultiTenantServer",
     "DisaggEngine", "PrefillPool", "DecodePool", "RemoteDecodeLeg",
+    "LineageStore", "LineageRecord",
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "BadRequestError", "EngineClosedError", "ReplicaUnavailableError",
     "FleetOverloadedError", "CacheExhaustedError", "ModelNotFoundError",
+    "ConnectionDroppedError",
 ]

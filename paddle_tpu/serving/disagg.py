@@ -32,11 +32,14 @@ from __future__ import annotations
 
 import base64
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from .errors import BadRequestError, QueueFullError
+from .. import trace
+from .errors import BadRequestError, EngineClosedError, QueueFullError
 from .metrics import MetricsRegistry
 from .router import LeastLoadedPolicy, Router
 
@@ -71,6 +74,10 @@ def serialize_handoff(engine, handoff: dict, release: bool = True) -> dict:
         "v": HANDOFF_V,
         "prompt": np.asarray(st.prompt, np.int64).tolist(),
         "generated": [int(t) for t in st.generated],
+        # a resumed slot's prompt CONTAINS its first ``resumed`` emitted
+        # tokens (the re-prefilled context); _finish strips the overlap,
+        # so the cursor must migrate with the slot
+        "resumed": int(getattr(st, "resumed", 0) or 0),
         "max_new": int(st.max_new),
         "eos_id": None if st.eos_id is None else int(st.eos_id),
         "tok": int(handoff["tok"]),
@@ -159,6 +166,7 @@ def install_handoff(engine, blob: dict, request) -> bool:
     st.prefill_done = prompt.size
     st.state = "decode"
     st.generated = [int(t) for t in blob["generated"]]
+    st.resumed = int(blob.get("resumed", 0) or 0)
     # tokens already emitted at the source: advance the timeline so the
     # next emit records TPOT (the migration gap, honestly), not a fake
     # TTFT on this pool
@@ -277,6 +285,10 @@ class RemoteDecodeLeg:
         self._rep = HttpReplica(base_url, name=self.name)
         self._lock = threading.Lock()
         self._inflight = 0
+        # DisaggEngine installs its failover hook here: a leg that dies
+        # AFTER the pages were serialized away (the no-rollback window)
+        # hands (blob, request) back instead of failing the future
+        self.on_failure = None
 
     @property
     def routable(self) -> bool:
@@ -291,14 +303,39 @@ class RemoteDecodeLeg:
     def healthz(self) -> dict:
         return self._rep.healthz()
 
-    def adopt(self, blob: dict, request) -> None:
+    def _fail(self, blob: dict, request, exc: BaseException) -> None:
+        """A dead/overloaded leg hands the work BACK (the failover
+        hook re-prefills it elsewhere); non-retryable errors still fail
+        the future typed."""
+        cb = self.on_failure
+        if cb is not None and isinstance(
+                exc, (ConnectionError, TimeoutError, EngineClosedError,
+                      QueueFullError)):
+            cb(self, blob, request, exc)
+        else:
+            request.future.set_exception(exc)
+
+    def adopt(self, blob: dict, request) -> bool:
         """Ship the serialized handoff; resolve the source request's
-        future from the remote decode (or fail it typed — the pages
-        were already released to the bytes, so there is no rollback
-        past this point)."""
+        future from the remote decode. The pages were already released
+        to the bytes, so there is no rollback past this point — a leg
+        that dies here goes through :meth:`_fail`, and the failover
+        hook re-prefills the blob's context on another leg. Returns
+        False when the leg died before dispatch (the fault-plan
+        ``decode_leg_crash`` window) so the caller records a failure,
+        not a success."""
         body: Dict[str, object] = {"handoff": blob}
         if self.model is not None:
             body["model"] = self.model
+        from ..resilience import faults
+
+        plan = faults.active_plan()
+        if plan is not None \
+                and plan.fire("decode_leg_crash") is not None:
+            self._fail(blob, request, ConnectionError(
+                f"{self.name} died after KV handoff (fault-plan "
+                "decode_leg_crash) — pages already serialized away"))
+            return False
         with self._lock:
             self._inflight += 1
 
@@ -308,13 +345,14 @@ class RemoteDecodeLeg:
                                       timeout_s=self.timeout_s)
                 request.future.set_result(np.asarray(out["ids"]))
             except BaseException as exc:  # noqa: BLE001 - typed upstream
-                request.future.set_exception(exc)
+                self._fail(blob, request, exc)
             finally:
                 with self._lock:
                     self._inflight -= 1
 
         threading.Thread(target=run, name=f"kv-handoff-{self.name}",
                          daemon=True).start()
+        return True
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +404,13 @@ class DisaggEngine:
         self.router = Router(dlegs, policy=policy or LeastLoadedPolicy())
         self._remote = [leg for leg in dlegs
                         if isinstance(leg, RemoteDecodeLeg)]
+        # decode-leg failover: a remote leg that dies after the KV
+        # handoff parks (blob, request) here; _failover_tick re-prefills
+        # the context on another leg — work-preserving, never a failure
+        self._failover: deque = deque()
+        self._failover_lock = threading.Lock()
+        for leg in self._remote:
+            leg.on_failure = self._decode_leg_failed
         self.engines = self.prefill.engines + self.decode.engines
         self.spec = self.engines[0].spec
 
@@ -445,8 +490,8 @@ class DisaggEngine:
                     hand = src.export_slot(slot)
                     req = hand["st"].request
                     blob = serialize_handoff(src, hand, release=True)
-                    leg.adopt(blob, req)
-                    self.router.record(leg, ok=True)
+                    if leg.adopt(blob, req):
+                        self.router.record(leg, ok=True)
                 elif leg.engine.pool is src.pool:
                     hand = src.export_slot(slot)
                     leg.engine.adopt_slot(hand)
@@ -464,9 +509,49 @@ class DisaggEngine:
                 self.metrics.inc("kv_migrations")
         return moved
 
+    def _decode_leg_failed(self, leg, blob: dict, request,
+                           exc: BaseException) -> None:
+        """RemoteDecodeLeg failure hook (handoff-thread-safe: only
+        enqueues). The leg is quarantined immediately — a mid-handoff
+        death is a strong signal — and the blob re-enters via
+        :meth:`_failover_tick` on the drive loop."""
+        self.router.record(leg, ok=False, reason=type(exc).__name__)
+        self.router.quarantine(leg, reason="decode leg crash")
+        with self._failover_lock:
+            self._failover.append((blob, request))
+        self.metrics.inc("decode_leg_failovers")
+        now = time.perf_counter()
+        trace.record("disagg/decode_leg_failover", now, now,
+                     leg=leg.name, error=repr(exc)[:200],
+                     tokens_reused=len(blob.get("generated", [])))
+
+    def _failover_tick(self) -> bool:
+        """Re-admit every parked failover: the blob's already-emitted
+        tokens become ``resume_tokens`` (chunk-prefilled, never
+        re-decoded) and ``recovery=True`` buys priority admission on
+        the prefill pool — pressure defers NEW work, not recoveries."""
+        did = False
+        while True:
+            with self._failover_lock:
+                if not self._failover:
+                    return did
+                blob, req = self._failover.popleft()
+            meta = dict(req.meta or {})
+            meta["resume_tokens"] = [int(t) for t in blob["generated"]]
+            meta["recovery"] = True
+            req.meta = meta
+            leg = self._prefill_router.route(meta)
+            eng = (leg.engine
+                   if leg is not None and not getattr(leg, "remote",
+                                                      False)
+                   else self.prefill.engines[0])
+            eng.admit([req])
+            did = True
+
     def serve_step(self, batcher,
                    idle_wait_s: Optional[float] = None) -> bool:
-        did = self._migrate() > 0
+        did = self._failover_tick()
+        did = self._migrate() > 0 or did
         free = self.prefill.free_slots
         deferred = any(e._deferred for e in self.engines)
         if free and not deferred:
@@ -488,7 +573,7 @@ class DisaggEngine:
         """Run the split-pool loop until every request completes — the
         in-process test/bench harness, like the engine's own."""
         pending = list(reqs)
-        while pending or self.active \
+        while pending or self.active or self._failover \
                 or any(e._deferred for e in self.engines) \
                 or any(e._beam_jobs for e in self.engines):
             if pending and self.prefill.free_slots:
@@ -496,6 +581,7 @@ class DisaggEngine:
                 for eng, group in self._place(pending[:k]).items():
                     eng.admit(group)
                 pending = pending[k:]
+            self._failover_tick()
             self._migrate()
             for eng in self.prefill.engines:
                 eng._admit_deferred()

@@ -62,6 +62,16 @@ class CacheExhaustedError(ServingError):
         self.retry_after_s = float(retry_after_s)
 
 
+class ConnectionDroppedError(ServingError, ConnectionError):
+    """The replica connection died MID-RESPONSE (reset, truncated body,
+    socket torn after the status line). Distinct from a refused connect:
+    the request may have been partially served, so the fleet treats it as
+    retryable — with request lineage, the retry resumes from the tokens
+    already emitted instead of starting over. Subclasses
+    ``ConnectionError`` so every existing retry-on-ConnectionError policy
+    already covers it."""
+
+
 class ReplicaUnavailableError(ServingError):
     """No replica could be routed to for an attempt: every candidate is
     draining, crashed, or behind an open circuit breaker. Retryable —

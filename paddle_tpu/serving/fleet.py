@@ -45,11 +45,13 @@ from .. import trace
 from ..resilience.faults import TransientFault, active_plan
 from ..resilience.retry import Retry
 from .batcher import Future
-from .errors import (BadRequestError, EngineClosedError,
-                     FleetOverloadedError, ModelNotFoundError,
-                     QueueFullError, ReplicaUnavailableError,
-                     RequestTimeoutError, ServingError)
+from .errors import (BadRequestError, ConnectionDroppedError,
+                     EngineClosedError, FleetOverloadedError,
+                     ModelNotFoundError, QueueFullError,
+                     ReplicaUnavailableError, RequestTimeoutError,
+                     ServingError)
 from .metrics import MetricsRegistry
+from .recovery import LineageStore
 from .router import Router
 
 #: attempt errors worth resubmitting to a different replica
@@ -266,19 +268,28 @@ class HttpReplica(Replica):
     """
 
     def __init__(self, base_url: str, name: Optional[str] = None,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 read_timeout_s: Optional[float] = None):
         self.base_url = base_url.rstrip("/")
         if name is not None:
             self.name = name
+        # connect and read are SEPARATE failure modes: a refused/hung
+        # connect means a dead peer (fail fast, retry elsewhere); a slow
+        # response means a busy one (wait out read_timeout_s — or the
+        # per-request deadline when one is set). read_timeout_s=None
+        # falls back to the request timeout, then connect_timeout_s.
         self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = (None if read_timeout_s is None
+                               else float(read_timeout_s))
         self._draining = False
 
     # -- transport -------------------------------------------------------
     def _http(self, method: str, path: str, body: Optional[dict] = None,
               timeout_s: Optional[float] = None,
               headers: Optional[dict] = None) -> dict:
-        import urllib.error
-        import urllib.request
+        import http.client
+        import socket
+        import urllib.parse
 
         data = json.dumps(body).encode() if body is not None else None
         hdrs = {"Content-Type": "application/json"}
@@ -291,36 +302,72 @@ class HttpReplica(Replica):
             tp = trace.inject()
             if tp is not None:
                 hdrs["traceparent"] = tp
-        req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
-            headers=hdrs)
+        url = urllib.parse.urlsplit(self.base_url + path)
+        conn_cls = (http.client.HTTPSConnection
+                    if url.scheme == "https" else
+                    http.client.HTTPConnection)
+        conn = conn_cls(url.hostname, url.port,
+                        timeout=self.connect_timeout_s)
+        read_timeout = (timeout_s if timeout_s is not None
+                        else self.read_timeout_s
+                        if self.read_timeout_s is not None
+                        else self.connect_timeout_s)
         try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout_s or self.connect_timeout_s) as r:
-                return json.loads(r.read() or b"{}")
-        except urllib.error.HTTPError as exc:
             try:
-                detail = json.loads(exc.read() or b"{}").get("error", "")
-            except (ValueError, OSError):
-                detail = ""
-            msg = f"{self.name} {path} -> {exc.code}: {detail}"
-            if exc.code == 429:
-                raise QueueFullError(msg) from None
-            if exc.code in (503, 502):
-                raise EngineClosedError(msg) from None
-            if exc.code in (504, 408):
-                raise RequestTimeoutError(msg) from None
-            if exc.code == 400:
-                raise BadRequestError(msg) from None
-            if exc.code == 404:
-                raise ModelNotFoundError(msg) from None
-            raise ServingError(msg) from None
-        except urllib.error.URLError as exc:
-            raise ConnectionError(
-                f"{self.name} unreachable: {exc.reason}") from None
-        except TimeoutError:
-            raise RequestTimeoutError(
-                f"{self.name} {path} timed out") from None
+                conn.connect()
+            except socket.timeout:
+                raise ConnectionError(
+                    f"{self.name} connect timed out after "
+                    f"{self.connect_timeout_s}s") from None
+            except OSError as exc:
+                raise ConnectionError(
+                    f"{self.name} unreachable: {exc}") from None
+            if conn.sock is not None:
+                conn.sock.settimeout(read_timeout)
+            target = url.path or "/"
+            if url.query:
+                target += f"?{url.query}"
+            try:
+                conn.request(method, target, body=data, headers=hdrs)
+                resp = conn.getresponse()
+                status = resp.status
+                raw = resp.read()
+            except socket.timeout:
+                raise RequestTimeoutError(
+                    f"{self.name} {path} timed out") from None
+            except (http.client.HTTPException, OSError) as exc:
+                # the peer died MID-EXCHANGE (reset, truncated body,
+                # torn status line): typed retryable, distinct from a
+                # bad request — with lineage the retry RESUMES from the
+                # tokens already emitted
+                raise ConnectionDroppedError(
+                    f"{self.name} {path} connection dropped "
+                    f"mid-response: {exc!r}") from None
+        finally:
+            conn.close()
+        if status < 400:
+            try:
+                return json.loads(raw or b"{}")
+            except ValueError as exc:
+                raise ConnectionDroppedError(
+                    f"{self.name} {path} returned a torn body: "
+                    f"{exc}") from None
+        try:
+            detail = json.loads(raw or b"{}").get("error", "")
+        except ValueError:
+            detail = ""
+        msg = f"{self.name} {path} -> {status}: {detail}"
+        if status == 429:
+            raise QueueFullError(msg)
+        if status in (503, 502):
+            raise EngineClosedError(msg)
+        if status in (504, 408):
+            raise RequestTimeoutError(msg)
+        if status == 400:
+            raise BadRequestError(msg)
+        if status == 404:
+            raise ModelNotFoundError(msg)
+        raise ServingError(msg)
 
     # -- Replica interface ----------------------------------------------
     @property
@@ -450,7 +497,8 @@ class Fleet:
                  hedge_min_ms: float = 20.0, max_pending: int = 256,
                  default_timeout_ms: Optional[float] = 30_000.0,
                  breaker: Optional[dict] = None, workers: Optional[int] = None,
-                 metrics: Optional[MetricsRegistry] = None, slo=None):
+                 metrics: Optional[MetricsRegistry] = None, slo=None,
+                 lineage_limit: int = 512):
         from ..trace.slo import SLOTracker
 
         if not replicas:
@@ -492,8 +540,13 @@ class Fleet:
         # Prometheus text) show them before the first shed/hedge happens
         for counter in ("requests", "completed", "failed", "attempts",
                         "retries", "hedges", "hedge_wins", "sheds",
-                        "breaker_opens"):
+                        "breaker_opens", "requests_recovered"):
             self.metrics.inc(counter, 0)
+        # work-preserving recovery: every admitted generation registers a
+        # lineage record; a retry after mid-stream progress RESUMES from
+        # the tokens the client already has instead of starting over
+        self.lineage = LineageStore(limit=lineage_limit)
+        self._lineage_seq = 0
         self._attempt_lat: deque = deque(maxlen=512)  # hedge-delay source
         self._lock = threading.Lock()
         self._pending = 0
@@ -571,6 +624,7 @@ class Fleet:
         fut = Future()
         meta = dict(meta)
         self._pin_seed(meta)
+        self._register_lineage(payload, meta, deadline)
         span = trace.start_span(
             "fleet/request", detached=True, timeout_ms=timeout_ms,
             parent=trace.extract(meta.pop("traceparent", None)))
@@ -598,6 +652,60 @@ class Fleet:
                 lambda: self.publisher.published_step
                 if self.publisher is not None else None)
         return hook
+
+    def _register_lineage(self, payload, meta: dict,
+                          deadline: Optional[float]) -> None:
+        """Give this generation a recovery identity BEFORE any attempt.
+
+        The record carries the prompt + pinned meta; the ``on_token``
+        progress callback streams every emitted token back into it, so
+        if the serving attempt dies mid-stream the retry loop can turn
+        the next attempt into a resume. Beam jobs are skipped: beams are
+        engine-local search state, not a resumable token stream."""
+        if not isinstance(payload, dict):
+            return
+        prompt = payload.get("prompt")
+        if prompt is None or meta.get("beam_size"):
+            return
+        with self._lock:
+            self._lineage_seq += 1
+            key = f"req-{self._lineage_seq}"
+        store = self.lineage
+        store.register(key, np.asarray(prompt).reshape(-1).tolist(),
+                       meta, deadline)
+        meta["_lineage_key"] = key
+        meta["on_token"] = (
+            lambda step, tok: store.progress(key, step, int(tok)))
+
+    def _maybe_resume(self, meta: dict, span) -> None:
+        """Between attempts: if the dead attempt emitted tokens, turn
+        this retry into a RESUME — the engine chunk-prefills
+        ``prompt + emitted`` and continues at the right step counter,
+        never re-decoding a token the client already has."""
+        key = meta.get("_lineage_key")
+        if key is None:
+            return
+        rec = self.lineage.get(key)
+        if rec is None or not rec.emitted:
+            return  # no progress yet — a plain retry from scratch
+        rec = self.lineage.mark_recovery(key)
+        emitted = rec.resume_tokens()
+        meta["resume_tokens"] = emitted
+        meta["recovery"] = True
+        if rec.recoveries == 1:
+            self.metrics.inc("requests_recovered")
+        self.metrics.inc("recovered_tokens", len(emitted))
+        now = time.perf_counter()
+        trace.record("fleet/recover", now, now, parent=span,
+                     tokens_reused=len(emitted),
+                     recoveries=rec.recoveries)
+
+    def _had_progress(self, meta: dict) -> bool:
+        key = meta.get("_lineage_key")
+        if key is None:
+            return False
+        rec = self.lineage.get(key)
+        return bool(rec is not None and rec.emitted)
 
     @staticmethod
     def _pin_seed(meta: dict) -> None:
@@ -673,6 +781,9 @@ class Fleet:
                 except Exception:  # noqa: BLE001
                     pass
         finally:
+            key = meta.get("_lineage_key")
+            if key is not None:
+                self.lineage.discard(key)
             with self._lock:
                 self._pending -= 1
 
@@ -705,6 +816,7 @@ class Fleet:
                 tried.append(replica.name)
             if len(tried) > 1:
                 self.metrics.inc("retries")
+            self._maybe_resume(meta, span)
             return self._attempt_with_hedge(replica, payload, meta,
                                             deadline, span,
                                             hedge=idempotent and self.hedge)
@@ -758,6 +870,13 @@ class Fleet:
                     last_exc = exc
                     self.router.record(att.replica, ok=False,
                                        reason=type(exc).__name__)
+                    if isinstance(exc, ConnectionError) \
+                            and self._had_progress(meta):
+                        # the replica died with a stream in flight:
+                        # quarantine it immediately so the resume never
+                        # routes back to the corpse
+                        self.router.quarantine(
+                            att.replica, reason="mid-stream drop")
                     self._attempt_lat.append(t1 - att.t0)
                     self.metrics.observe_latency(t1 - att.t0,
                                                  name="attempt")

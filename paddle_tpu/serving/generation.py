@@ -299,6 +299,11 @@ class GenerationEngine:
         # last-N completed request timelines — the flight recorder's
         # per-engine "what was in flight when it fell over" ring
         self._recent: "deque" = deque(maxlen=64)
+        # mid-stream chaos: kill() flips this — in-flight futures fail
+        # retryable and serve_step drains the queue the same way until
+        # revive(); _emitted_total arms the replica_kill fault threshold
+        self._killed = False
+        self._emitted_total = 0
         # slot table: index `slots` is the scrap slot (prefill padding)
         self._nslots = self.slots + 1
         self._slots: List[Optional[_Slot]] = [None] * self.slots
@@ -709,6 +714,15 @@ class GenerationEngine:
         else:              # every later token: one TPOT sample
             self.metrics.observe_hist("tpot", delta)
         st.generated.append(token)
+        self._emitted_total += 1
+        cb = (st.request.meta or {}).get("on_token")
+        if cb is not None:
+            # progress streaming for the lineage plane: position, token.
+            # Never let an observer kill the decode loop.
+            try:
+                cb(len(st.generated) - 1, token)
+            except Exception:
+                self.metrics.inc("progress_callback_errors")
         stop = getattr(st, "stop_matcher", None)
         if stop:
             keep = stop.match(st.generated)
@@ -730,7 +744,13 @@ class GenerationEngine:
         self._slots[slot] = None
         gen = (st.generated if st.truncate_to is None
                else st.generated[:st.truncate_to])
-        ids = np.concatenate([st.prompt, np.asarray(gen, np.int64)])
+        # a RESUMED slot's prompt is original-prompt + already-emitted
+        # context while ``generated`` also starts with those emitted
+        # tokens — strip the overlap so the result ids match an
+        # uninterrupted run exactly
+        resumed = getattr(st, "resumed", 0)
+        prompt = st.prompt[:-resumed] if resumed else st.prompt
+        ids = np.concatenate([prompt, np.asarray(gen, np.int64)])
         latency = time.monotonic() - st.request.enqueue_t
         tl = st.timeline
         if st.request.span is not None and tl.n_tokens > 1:
@@ -741,7 +761,8 @@ class GenerationEngine:
                          tokens=tl.n_tokens,
                          tpot_ms=round((tl.tpot_s or 0.0) * 1e3, 3))
         self._recent.append(dict(tl.to_dict(), status="ok",
-                                 latency_s=round(latency, 6)))
+                                 latency_s=round(latency, 6),
+                                 resumed=bool(resumed)))
         st.request.future.set_result(ids)
         st.request.end_trace(status="ok",
                              tokens_generated=len(st.generated),
@@ -769,6 +790,11 @@ class GenerationEngine:
         self.metrics.observe_latency(time.perf_counter() - t0,
                                      name="decode_step")
         self.metrics.inc("decode_steps")
+        # per-TOKEN decode work (decode_steps is per tick) — the pin a
+        # recovery run is judged by: resumed context re-enters via
+        # prefill, so total decode_tokens stays below an uninterrupted
+        # run's, never above
+        self.metrics.inc("decode_tokens", self.active)
         self.metrics.set_gauge("batch_occupancy", self.active / self.slots)
         for slot in range(self.slots):
             if self._slots[slot] is None:
@@ -776,6 +802,7 @@ class GenerationEngine:
             self._pos[slot] += 1
             self._tok[slot] = nxt[slot]
             self._emit(slot, int(nxt[slot]))
+        self._maybe_replica_kill()
         self._gauges()
         return True
 
@@ -804,12 +831,85 @@ class GenerationEngine:
         return {
             "engine": type(self).__name__,
             "slots_total": self.slots,
+            "killed": self._killed,
             "slots": slots,
             "recent_requests": list(self._recent),
         }
 
     def cache_stats(self) -> dict:
         return self.executor.cache_stats()
+
+    # -- mid-stream chaos: hard engine death ------------------------------
+    def _abort_slot_resources(self, st) -> None:
+        """Layout hook: release whatever a killed slot held (the paged
+        engine returns its pages to the pool)."""
+
+    def kill(self, reason: str = "chaos") -> int:
+        """Hard-kill the engine mid-stream (the ``replica_kill`` chaos
+        path): every in-flight generation fails with ``ConnectionError``
+        — RETRYABLE, so a fleet's lineage plane resumes the survivors on
+        a healthy replica — resources are released, and the engine
+        refuses traffic (serve_step drains the queue the same way) until
+        :meth:`revive`. Returns the number of futures failed."""
+        exc = ConnectionError(
+            f"replica killed mid-stream ({reason}); in-flight "
+            "generations are resumable from their lineage")
+        failed = 0
+        for slot in range(self.slots):
+            st = self._slots[slot]
+            if st is None:
+                continue
+            self._slots[slot] = None
+            self._abort_slot_resources(st)
+            st.request.end_trace(status="killed")
+            if not st.request.future.done():
+                st.request.future.set_exception(exc)
+                failed += 1
+        self._killed = True
+        self.metrics.inc("replica_kills")
+        self.metrics.inc("killed_in_flight", failed)
+        self._gauges()
+        return failed
+
+    def revive(self) -> None:
+        """Bring a killed engine back (slots are empty; the KV pages a
+        kill released are reusable immediately). The emit counter
+        restarts: ``after_tokens`` thresholds are per-incarnation."""
+        self._killed = False
+        self._emitted_total = 0
+
+    def _maybe_replica_kill(self) -> None:
+        """Fire an armed ``replica_kill`` fault once the engine has
+        emitted ``after_tokens`` tokens (default 1) across all streams —
+        the deterministic stand-in for a process dying mid-decode."""
+        from ..resilience import faults
+
+        plan = faults.active_plan()
+        if plan is None or self._killed:
+            return
+        params = plan.peek("replica_kill")
+        if params is None:
+            return
+        if self._emitted_total < int(params.get("after_tokens", 1)):
+            return
+        # fire() is the atomic claim: two engines can both pass the
+        # peek, but only the one that consumes the entry dies
+        if plan.fire("replica_kill") is None:
+            return
+        self.kill(reason="fault-plan replica_kill")
+
+    def _drain_killed(self, batcher) -> bool:
+        """A killed engine's serve loop: fail everything the batcher
+        hands it, retryable, so the fleet routes around the corpse."""
+        reqs = batcher.next_batch(max_n=max(self.slots, 1), wait_s=0)
+        if not reqs:
+            return False
+        exc = ConnectionError("replica is down (killed mid-stream)")
+        for req in reqs:
+            req.end_trace(status="killed")
+            if not req.future.done():
+                req.future.set_exception(exc)
+        return True
 
     def swap_params(self, source, *, strict: bool = True):
         """Zero-recompile param hot-swap for rolling weight updates:
@@ -831,6 +931,8 @@ class GenerationEngine:
         """One engine tick: admit queued requests into free slots (a
         non-blocking grab while decoding, a coalescing wait when idle),
         then advance the decode loop one step."""
+        if self._killed:
+            return self._drain_killed(batcher)
         did = False
         free = self.free_slots
         if free:
@@ -869,7 +971,7 @@ class GenerationEngine:
 class _PagedSlot(_Slot):
     __slots__ = ("pages", "shared_tokens", "cow_reserve", "prefill_done",
                  "state", "sampling", "stop_matcher", "mask_proc",
-                 "beam_job", "role", "xrow")
+                 "beam_job", "role", "xrow", "resumed")
 
     def __init__(self, request, prompt, max_new, eos_id,
                  sampling: Optional[SamplingParams] = None):
@@ -886,6 +988,8 @@ class _PagedSlot(_Slot):
         self.beam_job = None             # set for beam-owned slots
         self.role = "normal"             # beam_parent | beam | hold
         self.xrow = None                 # seq2seq: cross-KV cache row
+        self.resumed = 0                 # recovery: emitted tokens that
+                                         # re-entered as prefill context
 
 
 class PagedGenerationEngine(GenerationEngine):
@@ -1485,6 +1589,17 @@ class PagedGenerationEngine(GenerationEngine):
         group: list = []
         admitted = adopted
         for item in todo:
+            if self._is_recovery(item[0]):
+                # PRIORITY admission: a recovery re-admission never
+                # queues behind deferred NEW work — under pool pressure
+                # new requests defer first, and a blocked recovery goes
+                # to the FRONT of the deferred queue
+                r = self._admit_one(*item, group=group)
+                if r == "ok":
+                    admitted += 1
+                elif r == "defer":
+                    self._deferred.appendleft(item)
+                continue
             if self._deferred:  # keep FIFO order behind blocked work
                 self._deferred.append(item)
                 continue
@@ -1498,6 +1613,11 @@ class PagedGenerationEngine(GenerationEngine):
         self._gauges()
         return admitted
 
+    @staticmethod
+    def _is_recovery(req: Request) -> bool:
+        meta = req.meta or {}
+        return bool(meta.get("recovery") or meta.get("resume_tokens"))
+
     def _admit_one(self, req, prompt, max_new, eos, sampling, beam,
                    group) -> str:
         """Claim a slot + pages for one validated request. Returns "ok"
@@ -1510,8 +1630,26 @@ class PagedGenerationEngine(GenerationEngine):
         if self.free_slots < slots_needed:
             self.metrics.inc("admission_deferred")
             return "defer"
+        resume = ((req.meta or {}).get("resume_tokens")
+                  if beam is None else None)
+        if resume:
+            # resume-from-token re-admission: the tokens the client
+            # already holds re-enter as CONTEXT — chunk-prefilled into
+            # fresh pages, never re-decoded. Decode then continues at
+            # step len(emitted), and sampling's (seed, step) fold keeps
+            # the stream token-exact vs an uninterrupted run. A resume
+            # carrying the whole generation re-decodes only its final
+            # token (the completed attempt's result was lost in flight).
+            resume = [int(t) for t in resume][:max(max_new - 1, 0)]
+            if resume:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(resume, np.int64)])
+        resumed_k = len(resume) if resume else 0
         plen = int(prompt.size)
-        entries_total = self._entries_for(plen + max_new)
+        # total tokens this slot will ever hold: context (original
+        # prompt + resumed) plus only the NEW tokens left to decode —
+        # identical to the uninterrupted request's bound
+        entries_total = self._entries_for(plen + max_new - resumed_k)
         # worst-case pages: entries_total when unshared; a shared prefix
         # trades >=1 allocated page for <=1 copy-on-write spare, so the
         # bound never grows — entries_total > capacity can NEVER fit
@@ -1554,6 +1692,8 @@ class PagedGenerationEngine(GenerationEngine):
         st.cow_reserve = cow
         st.prefill_done = shared
         st.timeline.prefix_hit_tokens = shared
+        if resumed_k:
+            self._install_resume(st, resume)
         self.metrics.observe_hist("queue_wait", st.timeline.queue_wait_s)
         self._slots[slot] = st
         if beam is not None:
@@ -1583,6 +1723,12 @@ class PagedGenerationEngine(GenerationEngine):
             req.span.set_attrs(slot=slot, prompt_len=plen,
                                prefix_hit_tokens=shared)
         remaining = plen - shared
+        if resumed_k:
+            # the bounded cost of recovery: context tokens re-entering
+            # via (chunked) prefill — decode work is never repeated
+            self.metrics.inc("recovery_prefill_tokens", remaining)
+            if req.span is not None:
+                req.span.set_attrs(resumed_tokens=resumed_k)
         if remaining == 0:
             # full prefix hit: skip prefill entirely and enter the decode
             # loop one step behind — re-feeding the last prompt token at
@@ -1599,6 +1745,23 @@ class PagedGenerationEngine(GenerationEngine):
         else:
             st.state = "prefill"  # streams via prefill_tick
         return "ok"
+
+    def _install_resume(self, st: _PagedSlot, resume: List[int]) -> None:
+        """Seed a re-admitted slot with the tokens its interrupted
+        predecessor already emitted: they live in ``generated`` (so the
+        decode step counter, stop matching, and max_new accounting all
+        continue where the dead replica stopped) AND in the prompt tail
+        (so prefill writes their K/V). ``_finish`` strips the overlap."""
+        st.resumed = len(resume)
+        st.generated = list(resume)
+        now = time.monotonic()
+        for _ in resume:
+            # replay timeline marks (the install_handoff idiom): TTFT
+            # stays the original admission's concern; TPOT samples for
+            # replayed tokens are ~0 and the recovered stream's real
+            # added latency shows up as the resume prefill
+            st.timeline.mark_token(now)
+        self.metrics.inc("requests_resumed")
 
     def _run_prefill_group(self, group) -> None:
         """One bucketed prefill call over freshly-admitted requests whose
@@ -1624,7 +1787,11 @@ class PagedGenerationEngine(GenerationEngine):
             start[row] = st.prefill_done
             length[row] = r
             table[row, :len(st.pages)] = st.pages
-            self._slot_sampling_feed(row, st, feed, step=0)
+            # step = tokens already sampled: 0 for a fresh request; a
+            # RESUMED one samples its next token at step len(emitted),
+            # keeping (seed, step) aligned with the uninterrupted stream
+            self._slot_sampling_feed(row, st, feed,
+                                     step=len(st.generated))
         feed.update({"serving.chunk": chunk, "serving.start": start,
                      "serving.chunk_len": length,
                      "serving.block_table": table})
@@ -1687,7 +1854,13 @@ class PagedGenerationEngine(GenerationEngine):
             r = self._admit_one(req, prompt, max_new, eos, sampling,
                                 beam, group=group)
             if r == "defer":
-                if self.active == 0 and admitted == 0:
+                if self.active == 0 and admitted == 0 \
+                        and not self._is_recovery(req):
+                    # (a RECOVERY head is never pop-failed here: its
+                    # page bound equals the original admission's, so if
+                    # it can never fit the original would have failed
+                    # typed already — pool pressure only defers it, and
+                    # the deadline still expires it above)
                     self._deferred.popleft()
                     need = self._entries_for(prompt.size + max_new)
                     self.metrics.inc("cache_exhausted")
@@ -1742,7 +1915,8 @@ class PagedGenerationEngine(GenerationEngine):
         length[0] = k
         table[0, :len(st.pages)] = st.pages
         feed = self._neutral_sampling_feed(bucket)
-        self._slot_sampling_feed(0, st, feed, step=0)
+        # same step contract as the group path: 0 unless resumed
+        self._slot_sampling_feed(0, st, feed, step=len(st.generated))
         feed.update({"serving.chunk": chunk, "serving.start": start,
                      "serving.chunk_len": length,
                      "serving.block_table": table})
@@ -1824,6 +1998,7 @@ class PagedGenerationEngine(GenerationEngine):
         self.metrics.observe_latency(time.perf_counter() - t0,
                                      name="decode_step")
         self.metrics.inc("decode_steps")
+        self.metrics.inc("decode_tokens", len(decoding))
         self.metrics.set_gauge("batch_occupancy",
                                len(decoding) / self.slots)
         beam_rows: Dict[BeamJob, dict] = {}
@@ -1847,6 +2022,7 @@ class PagedGenerationEngine(GenerationEngine):
             job.on_parent_row(topv[slot], topi[slot])
         for job, rows in beam_rows.items():
             job.on_decode_rows(rows)
+        self._maybe_replica_kill()
         self._gauges()
         return True
 
@@ -2078,6 +2254,36 @@ class PagedGenerationEngine(GenerationEngine):
             self._gauges()
         return stats
 
+    # -- mid-stream chaos --------------------------------------------------
+    def _abort_slot_resources(self, st) -> None:
+        if st.pages:
+            self._release_pages(st)
+
+    def kill(self, reason: str = "chaos") -> int:
+        """Paged kill: beam jobs and the deferred queue die with the
+        slots (every future fails retryable), pages go back to the
+        pool."""
+        exc = ConnectionError(
+            f"replica killed mid-stream ({reason}); in-flight "
+            "generations are resumable from their lineage")
+        failed = 0
+        for job in list(self._beam_jobs):
+            self._beam_free_slots(job)
+            self._beam_jobs.remove(job)
+            job.done = True
+            job.request.end_trace(status="killed")
+            if not job.request.future.done():
+                job.request.future.set_exception(exc)
+                failed += 1
+        failed += super().kill(reason)
+        while self._deferred:
+            req = self._deferred.popleft()[0]
+            req.end_trace(status="killed")
+            if not req.future.done():
+                req.future.set_exception(exc)
+                failed += 1
+        return failed
+
     # -- prefill/decode disaggregation: KV handoff -------------------------
     def handoff_ready(self) -> List[int]:
         """Slots eligible to migrate to a decode pool: prompt K/V fully
@@ -2137,6 +2343,8 @@ class PagedGenerationEngine(GenerationEngine):
     # -- server-driver interface ------------------------------------------
     def serve_step(self, batcher,
                    idle_wait_s: Optional[float] = None) -> bool:
+        if self._killed:
+            return self._drain_killed(batcher)
         did = self._beam_maintenance()
         did = self._admit_deferred() > 0 or did
         free = self.free_slots

@@ -1,0 +1,169 @@
+"""Request lineage — the work-preserving serving-recovery plane.
+
+The reference system's control plane re-queues work from dead trainers
+so a crash never loses the job; serving needs the same story. PR 14
+made every token a pure function of ``(request, seed)`` — sampling
+folds ``(seed, step)`` per emitted position — which means a generation
+interrupted mid-stream is *replayable*: re-prefill ``prompt + emitted``
+on any healthy replica and keep decoding at the right step counter, and
+the resumed stream is bitwise-identical to an uninterrupted one.
+
+This module keeps the router-side state that makes that possible:
+
+- :class:`LineageRecord` — one admitted generation's recovery identity:
+  the prompt ids, the request meta snapshot (with the fleet-pinned seed
+  — :meth:`Fleet._pin_seed` runs BEFORE any attempt, so retries and
+  hedges share one policy), the tokens emitted so far (streamed back by
+  the engine through the ``on_token`` progress callback), tenant/model,
+  and the deadline.
+- :class:`LineageStore` — a bounded (LRU-evicting) thread-safe map from
+  request key to record, registered as a flight-recorder source so a
+  crash dump shows exactly which streams were in flight and how far
+  each had gotten.
+
+The fleet's retry loop consults the store between attempts: a record
+with emitted tokens turns the retry into a RESUME (``resume_tokens`` in
+the attempt meta) — the engine chunk-prefills the resumed context into
+fresh pages and never re-decodes a token the client already has.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["LineageRecord", "LineageStore"]
+
+
+class LineageRecord:
+    """Everything needed to re-admit one interrupted generation."""
+
+    __slots__ = ("key", "prompt", "meta", "emitted", "deadline",
+                 "recoveries")
+
+    def __init__(self, key: str, prompt: Sequence[int], meta: dict,
+                 deadline: Optional[float] = None):
+        self.key = key
+        self.prompt: List[int] = [int(t) for t in prompt]
+        self.meta = dict(meta)            # seed already fleet-pinned
+        self.emitted: List[int] = []      # tokens the client already has
+        self.deadline = deadline          # absolute monotonic, or None
+        self.recoveries = 0               # resumes performed so far
+
+    def progress(self, step: int, token: int) -> None:
+        """Record that position ``step`` decoded ``token``.
+
+        Positional (not append-only) on purpose: hedged attempts may
+        both stream progress, and (request, seed) determinism guarantees
+        they emit IDENTICAL tokens per position — last write wins and
+        writes the same value. A resumed attempt re-reports positions
+        the record already holds; those are idempotent too.
+        """
+        step = int(step)
+        if step < len(self.emitted):
+            self.emitted[step] = int(token)
+            return
+        if step != len(self.emitted):
+            # a gap means a progress callback went missing (an attempt
+            # died between emits); truncate is impossible — positions
+            # only ever extend — so pad conservatively never happens:
+            # the engine reports every emit in order per attempt, and a
+            # resumed attempt starts at len(resume_tokens).
+            raise ValueError(
+                f"non-contiguous progress for {self.key!r}: step {step} "
+                f"with {len(self.emitted)} emitted")
+        self.emitted.append(int(token))
+
+    def resume_tokens(self) -> List[int]:
+        return list(self.emitted)
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "prompt_len": len(self.prompt),
+            "emitted": len(self.emitted),
+            "recoveries": self.recoveries,
+            "model": self.meta.get("model"),
+            "seed": self.meta.get("seed"),
+        }
+
+
+class LineageStore:
+    """Bounded, thread-safe lineage map (router-side).
+
+    ``limit`` bounds memory: the store is an LRU over *registration* —
+    when full, the oldest record is evicted (and counted). Records are
+    discarded eagerly on completion/terminal failure, so eviction only
+    bites under pathological churn.
+    """
+
+    def __init__(self, limit: int = 512, *, register_flight: bool = True):
+        if limit < 1:
+            raise ValueError("limit must be >= 1")
+        self.limit = int(limit)
+        self._records: "OrderedDict[str, LineageRecord]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.registered = 0
+        self.discarded = 0
+        self.evicted = 0
+        self.recovered = 0
+        if register_flight:
+            from ..trace import flight as trace_flight
+
+            trace_flight.get_recorder().add_source("lineage",
+                                                   self.flight_state)
+
+    def register(self, key: str, prompt: Sequence[int], meta: dict,
+                 deadline: Optional[float] = None) -> LineageRecord:
+        rec = LineageRecord(key, prompt, meta, deadline)
+        with self._lock:
+            self._records[key] = rec
+            self._records.move_to_end(key)
+            self.registered += 1
+            while len(self._records) > self.limit:
+                self._records.popitem(last=False)
+                self.evicted += 1
+        return rec
+
+    def progress(self, key: str, step: int, token: int) -> None:
+        with self._lock:
+            rec = self._records.get(key)
+        if rec is not None:
+            rec.progress(step, token)
+
+    def get(self, key: str) -> Optional[LineageRecord]:
+        with self._lock:
+            return self._records.get(key)
+
+    def mark_recovery(self, key: str) -> Optional[LineageRecord]:
+        """Fetch the record for a resume attempt and count the recovery."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is not None:
+                rec.recoveries += 1
+                self.recovered += 1
+        return rec
+
+    def discard(self, key: str) -> None:
+        with self._lock:
+            if self._records.pop(key, None) is not None:
+                self.discarded += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"live": len(self._records),
+                    "registered": self.registered,
+                    "discarded": self.discarded,
+                    "evicted": self.evicted,
+                    "recovered": self.recovered}
+
+    def flight_state(self) -> dict:
+        """Flight-recorder source: which streams are in flight and how
+        far each has gotten — the crash dump IS the recovery worklist."""
+        with self._lock:
+            records = [rec.to_dict() for rec in self._records.values()]
+        return dict(self.stats(), records=records[-32:])

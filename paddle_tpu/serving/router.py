@@ -286,6 +286,15 @@ class Router:
         """Abandoned attempt (no outcome): free a possible probe slot."""
         self.breakers[replica.name].release_probe()
 
+    def quarantine(self, replica, reason: str = "quarantine") -> None:
+        """Trip the replica's breaker open NOW, without waiting for
+        ``failure_threshold`` outcomes. A connection that died
+        MID-STREAM (tokens already emitted, then reset) is a far
+        stronger death signal than one refused connect — the fleet's
+        recovery path uses this so resumed re-admissions never route
+        back to the replica that just dropped them."""
+        self.breakers[replica.name].force_open(reason)
+
     def any_routable(self) -> bool:
         """At least one replica could accept traffic now (or is due a
         probe) — False means admission should shed before queueing."""

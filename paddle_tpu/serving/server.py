@@ -59,7 +59,11 @@ _IDLE_WAIT_S = 0.02  # dispatch-loop poll when the queue is empty
 #: decode-platform schema (paddle_tpu.decoding.SamplingParams/BeamParams)
 GENERATE_META = ("max_new_tokens", "eos_id", "temperature", "top_k",
                  "top_p", "seed", "stop", "beam_size", "length_penalty",
-                 "return_beams")
+                 "return_beams",
+                 # work-preserving recovery: a resumed stream carries the
+                 # already-emitted tokens (re-entering as prefill
+                 # context) and the recovery flag (priority admission)
+                 "resume_tokens", "recovery")
 
 
 class Server:

@@ -159,6 +159,8 @@ class TestTransformer:
         (lo,) = exe.run(main, feed={"x": xb}, fetch_list=[loss], scope=scope)
         assert np.isfinite(lo)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): convergence sweep; the
+    # attention math stays tier-1 via the parity/grad tests in this file
     def test_tiny_lm_learns_induction_task(self):
         """Causal LM on the induction/copy task: the sequence's second half
         repeats its first half, so next-token prediction there requires

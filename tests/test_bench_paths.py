@@ -180,6 +180,8 @@ def test_trace_overhead_bench_path_runs():
     assert not trace.enabled()
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): like the other bench-path
+# sweeps in this file, the obs-overhead A/B rides the slow tier
 def test_obs_overhead_bench_path_runs():
     import jax
 

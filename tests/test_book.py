@@ -132,6 +132,9 @@ def test_understand_sentiment_conv():
     assert final_acc > 0.85, final_acc
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): heaviest book chapter; the
+# LSTM plane stays tier-1 via test_rnn/test_legacy_layers and the conv
+# sentiment chapter
 def test_understand_sentiment_stacked_lstm():
     """Stacked-LSTM sentiment classifier on imdb
     (book/test_understand_sentiment_dynamic_lstm.py): the recurrent
@@ -167,6 +170,8 @@ def test_understand_sentiment_stacked_lstm():
     assert final_acc > 0.8, final_acc
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): CRF chunk-F1 training sweep;
+# CRF op/grad correctness stays tier-1 via test_crf
 def test_label_semantic_roles():
     """SRL tagging with CRF on conll05 (book/test_label_semantic_roles.py):
     word+context+mark features -> fc -> CRF; chunk F1 must become strong."""
@@ -216,6 +221,8 @@ def test_label_semantic_roles():
     assert f1 > 0.6, f1
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): seq2seq training + beam sweep;
+# the fused beam decode is pinned token-exact in test_nmt_decode
 def test_machine_translation():
     """Seq2seq GRU encoder-decoder on wmt14 with beam-search generation
     (book/test_machine_translation.py). Teacher-forced training loss must

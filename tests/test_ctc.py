@@ -76,6 +76,8 @@ class TestWarpCTCOp:
             expect = brute_force_ctc(lp, label[i, :llen[i]].tolist())
             np.testing.assert_allclose(losses[i], expect, rtol=1e-4)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): finite-difference sweep;
+    # CTC forward correctness stays tier-1 via the brute-force tests
     def test_gradient_matches_finite_difference(self):
         rng = np.random.RandomState(2)
         T, C = 5, 3

@@ -193,6 +193,9 @@ class TestBeamSearch:
                         scope=scope)
         np.testing.assert_array_equal(np.asarray(bm)[:, 0], np.asarray(g))
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): full-reforward score
+    # audit; beam ordering/semantics stay tier-1 via beam1==greedy and
+    # the eos/length-penalty tests
     def test_scores_match_independent_forward(self):
         """The reported beam scores must equal the sum of next-token
         log-probs of the RETURNED sequences computed by a full forward —
@@ -271,6 +274,8 @@ class TestBeamSearch:
         np.testing.assert_allclose(sc[0, done[0]], lp[eos], rtol=2e-3,
                                    atol=2e-3)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): single-step edge variant
+    # of the beam plane; core beam behavior stays tier-1 above
     def test_single_new_token_beams(self):
         Tp, K = 8, 3
         exe, scope, rng = self._trained(Tp)

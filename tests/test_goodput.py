@@ -498,6 +498,9 @@ def _slow_handler(delay_s):
     return handler
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): the 3-trainer skew drill is
+# the heaviest goodput case; the meter/decomposition/MFU contracts stay
+# tier-1 via the other goodput tests
 def test_straggler_pin_three_trainers(tmp_path):
     """ACCEPTANCE PIN: 3 StreamingTrainers heartbeat one master
     concurrently; one is throttled 6x per step. The master's skew check

@@ -127,6 +127,9 @@ def _exhaustive_beam(scope, exe, src, K, N, alpha, eos):
 
 
 class TestNmtDecode:
+    @pytest.mark.slow  # tier-1 budget (PR 20): the beam-vs-exhaustive
+    # pin below covers the same encoder-decoder decode path and more;
+    # the greedy sweep rides the slow tier
     def test_greedy_token_exact_vs_teacher(self):
         """Admission-time encoder + paged cross-attention decode emits
         exactly the teacher-forced argmax rollout, across a mixed-length

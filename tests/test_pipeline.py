@@ -133,6 +133,9 @@ class TestPipelinedStackLayer:
         assert np.isfinite(last).all()
         assert float(last) < float(first)
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): full pp-vs-single parity
+    # run; the pipeline schedule/partition contracts stay tier-1 via the
+    # other tests in this class
     def test_pp_matches_single_device(self):
         """Same seed, same feed: the pipelined mesh run must track the
         single-device stacked run step for step."""

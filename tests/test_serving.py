@@ -249,6 +249,9 @@ class TestContinuousBatching:
         assert snap["counters"]["completed"] == 24
         assert "decode_step_ms" in snap["latency"]
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): GQA+RoPE decode parity is
+    # pinned by test_generate's rope/gqa reforwarding tests; this serving
+    # variant rides the slow tier
     def test_gqa_rope_variant(self):
         scope, exe = _init_lm_scope(use_rope=True, num_kv_heads=1)
         rng = np.random.RandomState(5)

@@ -92,6 +92,9 @@ def _per_device_param_bytes(scope):
 # The acceptance pins: dp / tp / dp x tp vs single device
 # ---------------------------------------------------------------------------
 class TestPlanTraining:
+    @pytest.mark.slow  # tier-1 budget (PR 20): the dp axis stays pinned
+    # tier-1 by test_dp2_tp4_compose_on_one_mesh below; the dp8-only
+    # sweep rides the slow tier
     def test_dp8_matches_single_device(self, cpu_mesh8):
         ref, _ = _train_leg("single", None)
         got, sgd = _train_leg("dp8", data_parallel_plan(cpu_mesh8))

@@ -260,6 +260,9 @@ def test_wide_deep_ctr_trains_large_vocab():
     assert auc.eval(exe, scope) > 0.65
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): dp x mp CTR training sweep;
+# sharded_embedding correctness stays tier-1 via the unit tests above
+# and the large-vocab train test
 def test_wide_deep_ctr_vocab_sharded_mesh():
     """CTR under dp x mp: vocab dim sharded over mp (the ICI replacement for
     the sparse pserver), batch over dp; loss matches single-device run."""

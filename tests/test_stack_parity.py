@@ -21,6 +21,9 @@ def _build(pipeline_stack):
     return main, startup, logits
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): full stacked-vs-per-layer
+# parity sweep; stack correctness stays tier-1 via test_attention and
+# the sharded-stack tests
 def test_stacked_matches_per_layer_with_copied_weights():
     exe = pt.Executor(pt.TPUPlace())
 

@@ -293,6 +293,10 @@ def _fleet_engines(servers):
     return [eng for srv in servers for eng in srv.engines]
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): the 2-replica x 2-model
+# fleet fixture alone costs ~50 s of warmup; the tenancy contracts
+# (routing, quotas, labeled gauges, tenant-scoped swap) stay tier-1 via
+# TestRegistry/TestMultiTenantServer above
 class TestTenantFleet:
     def test_two_model_storm_no_interference_no_recompiles(
             self, tenant_fleet):

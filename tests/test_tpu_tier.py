@@ -19,10 +19,10 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # First tunnel contact can take tens of seconds; a DOWN tunnel hangs
 # the probe child until this timeout, which tier-1 pays on every run
 # (the tunnel has been unreachable through bench rounds r03-r05, and
-# tier-1 sits against its 870 s ceiling — PR 14). 20 s still clears a
-# healthy tunnel's first contact; a cold-but-alive window can raise it
-# via env before running the tier.
-_PROBE_TIMEOUT_S = int(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT_S", 20))
+# tier-1 sits against its verify ceiling — PR 14, re-budgeted PR 20).
+# 8 s clears a warm tunnel's first contact; a cold-but-alive window can
+# raise it via env before running the tier.
+_PROBE_TIMEOUT_S = int(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT_S", 8))
 _TIER_TIMEOUT_S = 1800  # 15 checks x first-compile latencies
 
 # Chip-side check names, derived from tpu_tier.py's CHECKS registry by a

@@ -90,6 +90,9 @@ class TestFramework:
 # Faithfulness: dropout→scale + DCE bit-exact
 # --------------------------------------------------------------------------
 class TestDropoutAndDCE:
+    @pytest.mark.slow  # tier-1 budget (PR 20): full bit-exact A/B sweep;
+    # the dropout->scale and DCE rewrites stay tier-1 via the structural
+    # tests in this class
     def test_bit_exact_vs_untranspiled_is_test(self):
         main, startup = pt.Program(), pt.Program()
         with pt.program_guard(main, startup):

@@ -81,6 +81,8 @@ class TestV1SparseContract:
         assert feed["fvals@val"].shape == (8, 3)
         assert feed["fvals@val"].dtype == np.float32
 
+    @pytest.mark.slow  # tier-1 budget (PR 20): 1e5-dim training sweep;
+    # the v1 sparse feed/layer contract stays tier-1 via the tests above
     def test_ctr_trains_at_1e5_dim(self):
         cost = self._build()
         parameters = paddle.parameters.create(cost)

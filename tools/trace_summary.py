@@ -21,7 +21,9 @@ shows checkpoint stall (ckpt/save vs ckpt/write), retry pressure
 (retry/attempt spans per policy), and the elastic-training lease plane:
 leases expired/fenced per trainer, zombie acks the master rejected by
 token, vetoed (fenced-writer) checkpoint saves, and trainer rejoin
-counts with rollback wall time.
+counts with rollback wall time — plus the serving-recovery plane:
+recovered requests (``fleet/recover`` resumes with emitted tokens
+re-admitted via prefill) and disagg decode-leg failovers.
 
 ``--distributed`` stitches N JSONL journals from DIFFERENT processes
 (the fleet router's + each replica's, written via
@@ -319,6 +321,25 @@ def summarize_resilience(events):
         lines.append(f"trainer rejoins:         {len(rejoins)}, "
                      f"rollback {tot_ms(rejoins):.3f} ms total "
                      f"({tot_ms(rejoins) / len(rejoins):.3f} avg)")
+    # serving recovery plane: lineage resumes + decode-leg failovers
+    recovers = by_name("fleet/recover")
+    if recovers:
+        reqs = sum(1 for e in recovers
+                   if int(e.get("args", {}).get("recoveries", 1)) == 1)
+        reused = sum(int(e.get("args", {}).get("tokens_reused", 0))
+                     for e in recovers)
+        lines.append(f"recovered requests:      {reqs} "
+                     f"({len(recovers)} resumes), {reused} emitted "
+                     f"tokens re-admitted via prefill (never re-decoded)")
+    failovers = by_name("disagg/decode_leg_failover")
+    if failovers:
+        legs = sorted({str(e.get("args", {}).get("leg", "?"))
+                       for e in failovers})
+        reused = sum(int(e.get("args", {}).get("tokens_reused", 0))
+                     for e in failovers)
+        lines.append(f"decode-leg failovers:    {len(failovers)} "
+                     f"(legs: {', '.join(legs)}), {reused} tokens "
+                     f"re-prefilled on another leg")
     return "\n".join(lines) if lines else \
         "(no ckpt/* or retry/* spans — resilience idle)"
 
